@@ -1,0 +1,5 @@
+"""L1 core utilities: settings, errors, metrics, units, xcontent.
+
+Reference: server/.../org/elasticsearch/common/** and libs/* (SURVEY.md §1 L1,
+§2.1 rows 4-6). Sits below everything; depends on nothing above it.
+"""

@@ -1,0 +1,185 @@
+"""Error taxonomy.
+
+Reference: org.elasticsearch.ElasticsearchException and friends — every
+exception carries an HTTP status for the REST layer and serializes to a
+structured JSON body (``type``, ``reason``, nested ``caused_by``). We keep
+that contract: the REST layer renders any EsException subclass without
+special-casing.
+
+Key reference anchors:
+  - ElasticsearchException (server/.../ElasticsearchException.java)
+  - index/engine/VersionConflictEngineException
+  - common/breaker/CircuitBreakingException
+  - common/util/concurrent/EsRejectedExecutionException
+  - action/search/SearchPhaseExecutionException
+  - cluster/coordination/FailedToCommitClusterStateException
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class EsException(Exception):
+    """Base exception; carries an HTTP status and structured metadata."""
+
+    status = 500
+
+    def __init__(self, reason: str, **metadata: Any):
+        super().__init__(reason)
+        self.reason = reason
+        self.metadata: Dict[str, Any] = metadata
+
+    @property
+    def error_type(self) -> str:
+        # e.g. VersionConflictEngineException -> version_conflict_engine_exception
+        name = type(self).__name__
+        out = []
+        for i, ch in enumerate(name):
+            if ch.isupper() and i > 0:
+                out.append("_")
+            out.append(ch.lower())
+        return "".join(out)
+
+    def to_xcontent(self) -> Dict[str, Any]:
+        body: Dict[str, Any] = {"type": self.error_type, "reason": self.reason}
+        if self.metadata:
+            body.update(self.metadata)
+        cause = self.__cause__
+        if isinstance(cause, EsException):
+            body["caused_by"] = cause.to_xcontent()
+        elif cause is not None:
+            body["caused_by"] = {"type": type(cause).__name__, "reason": str(cause)}
+        return body
+
+
+class ResourceNotFoundException(EsException):
+    status = 404
+
+
+class ResourceAlreadyExistsException(EsException):
+    status = 400
+
+
+class IndexNotFoundException(ResourceNotFoundException):
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+
+
+class IndexAlreadyExistsException(ResourceAlreadyExistsException):
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists", index=index)
+
+
+class ShardNotFoundException(ResourceNotFoundException):
+    pass
+
+
+class DocumentMissingException(ResourceNotFoundException):
+    status = 404
+
+
+class ParsingException(EsException):
+    status = 400
+
+
+class IllegalArgumentException(EsException):
+    status = 400
+
+
+class MapperParsingException(ParsingException):
+    status = 400
+
+
+class QueryShardException(EsException):
+    status = 400
+
+
+class VersionConflictEngineException(EsException):
+    """Reference: index/engine/VersionConflictEngineException — optimistic
+    concurrency failure on versioned/if_seq_no writes."""
+
+    status = 409
+
+
+class EngineClosedException(EsException):
+    status = 503
+
+
+class CircuitBreakingException(EsException):
+    """Reference: common/breaker/CircuitBreakingException — request rejected
+    by memory accounting before OOM."""
+
+    status = 429
+
+    def __init__(self, reason: str, bytes_wanted: int = 0, byte_limit: int = 0, **md: Any):
+        super().__init__(reason, bytes_wanted=bytes_wanted, bytes_limit=byte_limit, **md)
+
+
+class EsRejectedExecutionException(EsException):
+    """Reference: common/util/concurrent/EsRejectedExecutionException —
+    bounded-queue backpressure."""
+
+    status = 429
+
+
+class TaskCancelledException(EsException):
+    status = 400
+
+
+class SearchPhaseExecutionException(EsException):
+    status = 503
+
+    def __init__(self, phase: str, reason: str, shard_failures: Optional[list] = None):
+        super().__init__(reason, phase=phase, grouped=True)
+        self.shard_failures = shard_failures or []
+
+    def to_xcontent(self) -> Dict[str, Any]:
+        body = super().to_xcontent()
+        body["failed_shards"] = [
+            f.to_xcontent() if isinstance(f, EsException) else f for f in self.shard_failures
+        ]
+        return body
+
+
+class NotMasterException(EsException):
+    """Reference: cluster/NotMasterException — a master-only action reached a
+    node that is not (any longer) the elected master; callers retry."""
+
+    status = 503
+
+
+class FailedToCommitClusterStateException(EsException):
+    status = 503
+
+
+class NodeDisconnectedException(EsException):
+    status = 503
+
+
+class ConnectTransportException(EsException):
+    status = 503
+
+
+class ReceiveTimeoutTransportException(EsException):
+    status = 503
+
+
+class ClusterBlockException(EsException):
+    status = 503
+
+
+class RecoveryFailedException(EsException):
+    status = 500
+
+
+class TranslogCorruptedException(EsException):
+    status = 500
+
+
+class InvalidAliasNameException(IllegalArgumentException):
+    pass
+
+
+class SettingsException(IllegalArgumentException):
+    pass

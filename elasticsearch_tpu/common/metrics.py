@@ -1,0 +1,110 @@
+"""Stats counters used by every subsystem.
+
+Reference: common/metrics/CounterMetric and MeanMetric, surfaced through the
+node/indices stats trees (SURVEY.md §2.1#47, §5.5). Each subsystem owns a
+small bag of these and renders them into the stats API response.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+
+class CounterMetric:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: int = 1) -> None:
+        self.inc(-n)
+
+    @property
+    def count(self) -> int:
+        return self._value
+
+
+class MeanMetric:
+    """Tracks a running (count, sum) pair — e.g. query count + total time."""
+
+    __slots__ = ("_count", "_sum", "_lock")
+
+    def __init__(self):
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, value: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+
+class EWMA:
+    """Exponentially-weighted moving average.
+
+    Reference: the adaptive-replica-selection rank in
+    node/ResponseCollectorService keeps EWMAs of service time and queue size
+    per node (SURVEY.md §2.3 P2)."""
+
+    __slots__ = ("alpha", "_value")
+
+    def __init__(self, alpha: float = 0.3, initial: float = 0.0):
+        self.alpha = alpha
+        self._value = initial
+
+    def add(self, sample: float) -> None:
+        self._value = self.alpha * sample + (1 - self.alpha) * self._value
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class StopWatch:
+    __slots__ = ("_start",)
+
+    def __init__(self):
+        self._start = time.monotonic()
+
+    def elapsed_seconds(self) -> float:
+        return time.monotonic() - self._start
+
+    def elapsed_millis(self) -> float:
+        return self.elapsed_seconds() * 1000.0
+
+
+def stats_to_xcontent(stats: Dict[str, Any]) -> Dict[str, Any]:
+    """Render a dict possibly containing metric objects into plain JSON."""
+    out: Dict[str, Any] = {}
+    for k, v in stats.items():
+        if isinstance(v, CounterMetric):
+            out[k] = v.count
+        elif isinstance(v, MeanMetric):
+            out[k] = {"count": v.count, "total_millis": v.sum, "mean_millis": v.mean}
+        elif isinstance(v, EWMA):
+            out[k] = v.value
+        elif isinstance(v, dict):
+            out[k] = stats_to_xcontent(v)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,314 @@
+"""Typed, validated, scoped, dynamically-updatable settings.
+
+Reference: common/settings/ — Settings (immutable flat key→value map),
+Setting<T> (typed accessor with default/parser/validator/properties),
+ClusterSettings#applySettings (dynamic update dispatch to registered
+consumers), IndexScopedSettings (SURVEY.md §2.1#4, §5.6).
+
+Precedence (reference: §5.6): transient > persistent > config file > default.
+Unknown registered-scope settings fail validation, as upstream fails node
+start on unknown settings.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Dict, Generic, Iterable, List, Optional, TypeVar
+
+from elasticsearch_tpu.common.errors import SettingsException
+from elasticsearch_tpu.common.units import ByteSizeValue, TimeValue
+
+T = TypeVar("T")
+
+
+class Property(enum.Flag):
+    NODE_SCOPE = enum.auto()
+    INDEX_SCOPE = enum.auto()
+    DYNAMIC = enum.auto()
+    FINAL = enum.auto()
+    DEPRECATED = enum.auto()
+    FILTERED = enum.auto()  # redacted from API output
+
+
+class Setting(Generic[T]):
+    def __init__(
+        self,
+        key: str,
+        default: Any,
+        parser: Callable[[Any], T],
+        properties: Property = Property.NODE_SCOPE,
+        validator: Optional[Callable[[T], None]] = None,
+    ):
+        self.key = key
+        self._default = default
+        self._parser = parser
+        self.properties = properties
+        self._validator = validator
+
+    # -- constructors mirroring the reference's Setting.intSetting etc. -----
+
+    @staticmethod
+    def bool_setting(key: str, default: bool, properties=Property.NODE_SCOPE) -> "Setting[bool]":
+        def parse(v):
+            if isinstance(v, bool):
+                return v
+            s = str(v).lower()
+            if s in ("true", "1"):
+                return True
+            if s in ("false", "0"):
+                return False
+            raise SettingsException(f"cannot parse boolean [{v}] for setting [{key}]")
+
+        return Setting(key, default, parse, properties)
+
+    @staticmethod
+    def int_setting(
+        key: str, default: int, min_value: Optional[int] = None,
+        max_value: Optional[int] = None, properties=Property.NODE_SCOPE,
+    ) -> "Setting[int]":
+        def validate(v: int):
+            if min_value is not None and v < min_value:
+                raise SettingsException(f"[{key}] must be >= {min_value}, got {v}")
+            if max_value is not None and v > max_value:
+                raise SettingsException(f"[{key}] must be <= {max_value}, got {v}")
+
+        return Setting(key, default, lambda v: int(v), properties, validate)
+
+    @staticmethod
+    def float_setting(
+        key: str, default: float, min_value: Optional[float] = None,
+        properties=Property.NODE_SCOPE,
+    ) -> "Setting[float]":
+        def validate(v: float):
+            if min_value is not None and v < min_value:
+                raise SettingsException(f"[{key}] must be >= {min_value}, got {v}")
+
+        return Setting(key, default, lambda v: float(v), properties, validate)
+
+    @staticmethod
+    def string_setting(key: str, default: str = "", properties=Property.NODE_SCOPE,
+                       validator=None) -> "Setting[str]":
+        return Setting(key, default, str, properties, validator)
+
+    @staticmethod
+    def byte_size_setting(key: str, default: str, properties=Property.NODE_SCOPE) -> "Setting[ByteSizeValue]":
+        return Setting(key, default, ByteSizeValue.parse, properties)
+
+    @staticmethod
+    def time_setting(key: str, default: str, properties=Property.NODE_SCOPE) -> "Setting[TimeValue]":
+        return Setting(key, default, TimeValue.parse, properties)
+
+    @staticmethod
+    def list_setting(key: str, default: Optional[List[str]] = None,
+                     properties=Property.NODE_SCOPE) -> "Setting[List[str]]":
+        def parse(v):
+            if isinstance(v, (list, tuple)):
+                return [str(x) for x in v]
+            s = str(v).strip()
+            return [p.strip() for p in s.split(",") if p.strip()] if s else []
+
+        return Setting(key, default or [], parse, properties)
+
+    # -----------------------------------------------------------------------
+
+    @property
+    def dynamic(self) -> bool:
+        return bool(self.properties & Property.DYNAMIC)
+
+    @property
+    def final(self) -> bool:
+        return bool(self.properties & Property.FINAL)
+
+    def default_value(self, settings: "Settings") -> T:
+        d = self._default(settings) if callable(self._default) else self._default
+        if d is None:
+            return d
+        value = self._parser(d)
+        if self._validator:
+            self._validator(value)
+        return value
+
+    def get(self, settings: "Settings") -> T:
+        raw = settings.raw_get(self.key)
+        if raw is None:
+            return self.default_value(settings)
+        value = self._parser(raw)
+        if self._validator:
+            self._validator(value)
+        return value
+
+    def exists(self, settings: "Settings") -> bool:
+        return settings.raw_get(self.key) is not None
+
+
+class Settings:
+    """Immutable flat key→value map. Nested dicts flatten to dotted keys."""
+
+    EMPTY: "Settings"
+
+    def __init__(self, flat: Optional[Dict[str, Any]] = None):
+        self._map: Dict[str, Any] = dict(flat or {})
+
+    @staticmethod
+    def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in d.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out.update(Settings._flatten(v, key + "."))
+            else:
+                out[key] = v
+        return out
+
+    @classmethod
+    def of(cls, d: Optional[Dict[str, Any]] = None, **kwargs: Any) -> "Settings":
+        merged = dict(d or {})
+        merged.update(kwargs)
+        return cls(cls._flatten(merged))
+
+    def raw_get(self, key: str) -> Any:
+        return self._map.get(key)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._map.get(key, default)
+
+    def get_as_dict(self) -> Dict[str, Any]:
+        return dict(self._map)
+
+    def keys(self) -> Iterable[str]:
+        return self._map.keys()
+
+    def filter_prefix(self, prefix: str) -> "Settings":
+        return Settings({k: v for k, v in self._map.items() if k.startswith(prefix)})
+
+    def merged_with(self, other: "Settings") -> "Settings":
+        """`other` wins on conflicts (used for precedence chains)."""
+        m = dict(self._map)
+        m.update(other._map)
+        return Settings(m)
+
+    def with_removed(self, keys: Iterable[str]) -> "Settings":
+        drop = set(keys)
+        return Settings({k: v for k, v in self._map.items() if k not in drop})
+
+    def to_xcontent(self, filtered_keys: Iterable[str] = ()) -> Dict[str, Any]:
+        """Re-nest dotted keys into a JSON tree (the _settings API shape)."""
+        drop = set(filtered_keys)
+        tree: Dict[str, Any] = {}
+        for k, v in sorted(self._map.items()):
+            if k in drop:
+                continue
+            parts = k.split(".")
+            node = tree
+            for p in parts[:-1]:
+                nxt = node.get(p)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    node[p] = nxt
+                node = nxt
+            node[parts[-1]] = v
+        return tree
+
+    def __eq__(self, other):
+        return isinstance(other, Settings) and other._map == self._map
+
+    def __hash__(self):
+        # values may be unhashable (e.g. list settings) — hash a stable repr
+        return hash(tuple(sorted((k, repr(v)) for k, v in self._map.items())))
+
+    def __len__(self):
+        return len(self._map)
+
+    def __repr__(self):
+        return f"Settings({self._map!r})"
+
+
+Settings.EMPTY = Settings()
+
+
+class AbstractScopedSettings:
+    """Registry + validator + dynamic-update dispatcher for one scope.
+
+    Reference: common/settings/AbstractScopedSettings;
+    ClusterSettings#applySettings drives registered update consumers."""
+
+    def __init__(self, scope: Property, registered: Iterable[Setting]):
+        self.scope = scope
+        self._registry: Dict[str, Setting] = {}
+        self._consumers: List[tuple] = []  # (setting, callback)
+        for s in registered:
+            self.register(s)
+
+    def register(self, setting: Setting) -> None:
+        if not (setting.properties & self.scope):
+            raise SettingsException(
+                f"setting [{setting.key}] is not scoped {self.scope}"
+            )
+        if setting.key in self._registry:
+            raise SettingsException(f"setting [{setting.key}] already registered")
+        self._registry[setting.key] = setting
+
+    def get_setting(self, key: str) -> Optional[Setting]:
+        return self._registry.get(key)
+
+    def validate(self, settings: Settings, allow_unknown: bool = False) -> None:
+        for key in settings.keys():
+            setting = self._registry.get(key)
+            if setting is None:
+                if not allow_unknown:
+                    raise SettingsException(f"unknown setting [{key}]")
+                continue
+            setting.get(settings)  # parse + validate
+
+    def validate_dynamic(self, settings: Settings) -> None:
+        """Reject updates to non-dynamic or unknown settings."""
+        for key in settings.keys():
+            setting = self._registry.get(key)
+            if setting is None:
+                raise SettingsException(f"unknown setting [{key}]")
+            if setting.final:
+                raise SettingsException(f"final setting [{key}] cannot be updated")
+            if not setting.dynamic:
+                raise SettingsException(f"setting [{key}] is not dynamically updateable")
+            setting.get(settings)
+
+    def add_settings_update_consumer(self, setting: Setting, consumer: Callable[[Any], None]) -> None:
+        if setting.key not in self._registry:
+            raise SettingsException(f"setting [{setting.key}] not registered")
+        if not setting.dynamic:
+            raise SettingsException(f"setting [{setting.key}] is not dynamic")
+        self._consumers.append((setting, consumer))
+
+    def apply_settings(self, current: Settings, updates: Settings) -> Settings:
+        """Validate `updates`, merge over `current`, fire changed consumers.
+        Returns the new effective Settings. A value of None removes a key
+        (reset to default), mirroring `"setting": null` in the REST API."""
+        self.validate_dynamic(
+            Settings({k: v for k, v in updates.get_as_dict().items() if v is not None})
+        )
+        removed = [k for k, v in updates.get_as_dict().items() if v is None]
+        for k in removed:
+            s = self._registry.get(k)
+            if s is None:
+                raise SettingsException(f"unknown setting [{k}]")
+            if not s.dynamic:
+                raise SettingsException(f"setting [{k}] is not dynamically updateable")
+        effective = current.merged_with(
+            Settings({k: v for k, v in updates.get_as_dict().items() if v is not None})
+        ).with_removed(removed)
+        for setting, consumer in self._consumers:
+            old = setting.get(current)
+            new = setting.get(effective)
+            if old != new:
+                consumer(new)
+        return effective
+
+
+class ClusterSettings(AbstractScopedSettings):
+    def __init__(self, registered: Iterable[Setting]):
+        super().__init__(Property.NODE_SCOPE, registered)
+
+
+class IndexScopedSettings(AbstractScopedSettings):
+    def __init__(self, registered: Iterable[Setting]):
+        super().__init__(Property.INDEX_SCOPE, registered)

@@ -1,0 +1,132 @@
+"""Byte-size and time-value units.
+
+Reference: common/unit/ByteSizeValue and common/unit/TimeValue — every
+setting that is a size or duration parses/prints these suffixed forms
+("512mb", "30s"). We keep the exact suffix grammar so yml/REST settings
+round-trip identically.
+"""
+
+from __future__ import annotations
+
+import re
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+_BYTE_SUFFIXES = {
+    "b": 1,
+    "kb": 1024,
+    "mb": 1024**2,
+    "gb": 1024**3,
+    "tb": 1024**4,
+    "pb": 1024**5,
+}
+
+_TIME_SUFFIXES = {
+    "nanos": 1e-9,
+    "micros": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+    "d": 86400.0,
+}
+
+
+class ByteSizeValue:
+    __slots__ = ("bytes",)
+
+    def __init__(self, nbytes: int):
+        self.bytes = int(nbytes)
+
+    @classmethod
+    def parse(cls, value) -> "ByteSizeValue":
+        if isinstance(value, ByteSizeValue):
+            return value
+        if isinstance(value, (int, float)):
+            return cls(int(value))
+        s = str(value).strip().lower()
+        m = re.fullmatch(r"(-?\d+(?:\.\d+)?)\s*([kmgtp]?b)?", s)
+        if not m:
+            raise IllegalArgumentException(f"failed to parse byte size [{value}]")
+        num, suffix = m.groups()
+        if "." in num and suffix in (None, "b"):
+            # fractional bytes are meaningless; fail validation rather than
+            # silently truncating a typo'd limit to 0
+            raise IllegalArgumentException(f"failed to parse byte size [{value}]: fractional bytes")
+        mult = _BYTE_SUFFIXES[suffix or "b"]
+        return cls(int(float(num) * mult))
+
+    def __int__(self):
+        return self.bytes
+
+    def __eq__(self, other):
+        return isinstance(other, ByteSizeValue) and other.bytes == self.bytes
+
+    def __hash__(self):
+        return hash(self.bytes)
+
+    def __lt__(self, other):
+        return self.bytes < other.bytes
+
+    def __le__(self, other):
+        return self.bytes <= other.bytes
+
+    def __repr__(self):
+        return f"ByteSizeValue({self})"
+
+    def __str__(self):
+        n = self.bytes
+        for suffix in ("pb", "tb", "gb", "mb", "kb"):
+            mult = _BYTE_SUFFIXES[suffix]
+            if n >= mult and n % mult == 0:
+                return f"{n // mult}{suffix}"
+        return f"{n}b"
+
+
+class TimeValue:
+    __slots__ = ("seconds",)
+
+    def __init__(self, seconds: float):
+        self.seconds = float(seconds)
+
+    @classmethod
+    def parse(cls, value) -> "TimeValue":
+        if isinstance(value, TimeValue):
+            return value
+        if isinstance(value, (int, float)):
+            if value == -1:  # the -1 sentinel (infinite/disabled) in any form
+                return cls(-1.0)
+            # bare numbers are milliseconds, as in the reference's TimeValue
+            return cls(float(value) / 1000.0)
+        s = str(value).strip().lower()
+        if s == "-1":
+            return cls(-1.0)
+        m = re.fullmatch(r"(-?\d+(?:\.\d+)?)\s*(nanos|micros|ms|s|m|h|d)", s)
+        if not m:
+            raise IllegalArgumentException(f"failed to parse time value [{value}]")
+        num, suffix = m.groups()
+        return cls(float(num) * _TIME_SUFFIXES[suffix])
+
+    def millis(self) -> float:
+        return self.seconds * 1000.0
+
+    def __eq__(self, other):
+        return isinstance(other, TimeValue) and other.seconds == self.seconds
+
+    def __hash__(self):
+        return hash(self.seconds)
+
+    def __lt__(self, other):
+        return self.seconds < other.seconds
+
+    def __repr__(self):
+        return f"TimeValue({self})"
+
+    def __str__(self):
+        s = self.seconds
+        if s < 0:
+            return "-1"
+        for suffix, mult in (("d", 86400.0), ("h", 3600.0), ("m", 60.0), ("s", 1.0)):
+            if s >= mult and (s / mult) == int(s / mult):
+                return f"{int(s / mult)}{suffix}"
+        return f"{int(s * 1000)}ms"

@@ -1,0 +1,84 @@
+"""Structured-content helpers (the x-content analog).
+
+Reference: libs/x-content — XContentParser/XContentBuilder/ObjectParser
+(SURVEY.md §2.1#6). The reference abstracts over JSON/YAML/SMILE/CBOR; here
+JSON is the canonical wire format (CBOR available via the stdlib-free
+fallback is out of scope this round). What we keep is the *declarative
+parser* idea: ObjectParser maps field names to typed consumers and rejects
+unknown fields — every REST body parser in the engine is built on it, which
+is what makes DSL parse errors uniform.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+from elasticsearch_tpu.common.errors import ParsingException
+
+T = TypeVar("T")
+
+
+def json_loads(data) -> Any:
+    if isinstance(data, (bytes, bytearray)):
+        data = data.decode("utf-8")
+    try:
+        return json.loads(data)
+    except json.JSONDecodeError as e:
+        raise ParsingException(f"failed to parse JSON: {e}") from e
+
+
+def json_dumps(obj: Any) -> str:
+    return json.dumps(obj, separators=(",", ":"), default=_default)
+
+
+def _default(o: Any):
+    to_x = getattr(o, "to_xcontent", None)
+    if callable(to_x):
+        return to_x()
+    raise TypeError(f"not JSON serializable: {type(o)!r}")
+
+
+class ObjectParser(Generic[T]):
+    """Declarative object parser.
+
+    Reference: libs/x-content ObjectParser/ConstructingObjectParser — each
+    known field registers a consumer; unknown fields raise (strict mode) so
+    malformed requests fail with a named field, matching the reference's
+    error UX."""
+
+    def __init__(self, name: str, strict: bool = True):
+        self.name = name
+        self.strict = strict
+        self._fields: Dict[str, Callable[[T, Any], None]] = {}
+        self._required: List[str] = []
+
+    def declare_field(self, field: str, consumer: Callable[[T, Any], None],
+                      required: bool = False) -> "ObjectParser[T]":
+        self._fields[field] = consumer
+        if required:
+            self._required.append(field)
+        return self
+
+    def parse(self, obj: Dict[str, Any], target: T) -> T:
+        if not isinstance(obj, dict):
+            raise ParsingException(f"[{self.name}] expected an object, got {type(obj).__name__}")
+        for field, value in obj.items():
+            consumer = self._fields.get(field)
+            if consumer is None:
+                if self.strict:
+                    raise ParsingException(f"[{self.name}] unknown field [{field}]")
+                continue
+            consumer(target, value)
+        for field in self._required:
+            if field not in obj:
+                raise ParsingException(f"[{self.name}] required field [{field}] missing")
+        return target
+
+
+def ensure_type(name: str, field: str, value: Any, types, type_name: str) -> Any:
+    if not isinstance(value, types) or isinstance(value, bool) and bool not in (
+        types if isinstance(types, tuple) else (types,)
+    ):
+        raise ParsingException(f"[{name}] field [{field}] must be {type_name}")
+    return value

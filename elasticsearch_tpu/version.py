@@ -1,0 +1,34 @@
+"""Version identity.
+
+Reference: server/src/main/java/org/elasticsearch/Version.java — a dense
+int id (major*1_000_000 + minor*10_000 + revision*100) used for wire and
+index compatibility negotiation. We keep the same dense-id scheme so
+serialized artifacts (WAL records, segment manifests, RPC frames) can gate
+on a comparable version number.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Version:
+    major: int
+    minor: int
+    revision: int
+
+    @property
+    def id(self) -> int:
+        return self.major * 1_000_000 + self.minor * 10_000 + self.revision * 100
+
+    @classmethod
+    def from_id(cls, vid: int) -> "Version":
+        return cls(vid // 1_000_000, (vid // 10_000) % 100, (vid // 100) % 100)
+
+    def __str__(self) -> str:
+        return f"{self.major}.{self.minor}.{self.revision}"
+
+
+CURRENT = Version(0, 1, 0)
+__version__ = str(CURRENT)

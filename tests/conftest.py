@@ -1,0 +1,53 @@
+"""Test harness configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh (the real
+environment has a single TPU chip); this must be configured before jax is
+first imported anywhere in the test process.
+
+Also ports the reference's ESTestCase seeded-randomness idea (SURVEY.md
+§4.1): every test gets a reproducible RNG; set TESTS_SEED to reproduce.
+"""
+
+import hashlib
+import os
+import random
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+_SEED = int(os.environ.get("TESTS_SEED", "0")) or random.SystemRandom().randint(1, 2**31)
+
+
+def pytest_report_header(config):
+    return f"tests seed: {_SEED} (reproduce with TESTS_SEED={_SEED})"
+
+
+def _test_seed(nodeid: str) -> int:
+    # stable across processes (hash() is salted per-process; sha256 is not)
+    digest = hashlib.sha256(nodeid.encode()).hexdigest()
+    return (_SEED ^ int(digest[:8], 16)) & 0x7FFFFFFF
+
+
+@pytest.fixture
+def seeded_random(request):
+    """Per-test deterministic RNG derived from the suite seed + test id."""
+    return random.Random(_test_seed(request.node.nodeid))
+
+
+@pytest.fixture
+def seeded_np(request):
+    return np.random.default_rng(_test_seed(request.node.nodeid))
+
+
+@pytest.fixture
+def tmp_data_path(tmp_path):
+    p = tmp_path / "data"
+    p.mkdir()
+    return p

@@ -1,0 +1,157 @@
+"""Core-lib tests: settings, units, errors, breaker, xcontent."""
+
+import pytest
+
+from elasticsearch_tpu.common.breaker import HierarchyCircuitBreakerService
+from elasticsearch_tpu.common.errors import (
+    CircuitBreakingException,
+    EsException,
+    IndexNotFoundException,
+    SettingsException,
+    VersionConflictEngineException,
+)
+from elasticsearch_tpu.common.settings import (
+    ClusterSettings,
+    IndexScopedSettings,
+    Property,
+    Setting,
+    Settings,
+)
+from elasticsearch_tpu.common.units import ByteSizeValue, TimeValue
+from elasticsearch_tpu.common.xcontent import ObjectParser, ParsingException, json_loads
+
+
+class TestUnits:
+    def test_byte_size_parse(self):
+        assert ByteSizeValue.parse("512mb").bytes == 512 * 1024**2
+        assert ByteSizeValue.parse("1gb").bytes == 1024**3
+        assert ByteSizeValue.parse("10kb").bytes == 10240
+        assert ByteSizeValue.parse("123").bytes == 123
+        assert ByteSizeValue.parse(77).bytes == 77
+
+    def test_byte_size_str_roundtrip(self):
+        for s in ("512mb", "1gb", "123b", "10kb"):
+            assert str(ByteSizeValue.parse(s)) == s
+
+    def test_time_parse(self):
+        assert TimeValue.parse("30s").seconds == 30
+        assert TimeValue.parse("5m").seconds == 300
+        assert TimeValue.parse("100ms").seconds == pytest.approx(0.1)
+        assert TimeValue.parse(1500).millis() == 1500
+        assert TimeValue.parse("-1").seconds == -1
+
+    def test_bad_values(self):
+        with pytest.raises(Exception):
+            ByteSizeValue.parse("twelve")
+        with pytest.raises(Exception):
+            TimeValue.parse("1 fortnight")
+
+
+class TestSettings:
+    def test_flatten_and_nest(self):
+        s = Settings.of({"index": {"number_of_shards": 3}, "cluster.name": "c1"})
+        assert s.get("index.number_of_shards") == 3
+        assert s.to_xcontent() == {
+            "cluster": {"name": "c1"},
+            "index": {"number_of_shards": 3},
+        }
+
+    def test_typed_setting_with_default(self):
+        shards = Setting.int_setting("index.number_of_shards", 1, min_value=1,
+                                     properties=Property.INDEX_SCOPE)
+        assert shards.get(Settings.EMPTY) == 1
+        assert shards.get(Settings.of({"index.number_of_shards": "4"})) == 4
+        with pytest.raises(SettingsException):
+            shards.get(Settings.of({"index.number_of_shards": 0}))
+
+    def test_registry_rejects_unknown(self):
+        reg = ClusterSettings([Setting.string_setting("cluster.name", "es")])
+        reg.validate(Settings.of({"cluster.name": "x"}))
+        with pytest.raises(SettingsException):
+            reg.validate(Settings.of({"cluster.nmae": "typo"}))
+
+    def test_dynamic_update_fires_consumer(self):
+        s = Setting.int_setting("search.batch", 8,
+                                properties=Property.NODE_SCOPE | Property.DYNAMIC)
+        static = Setting.int_setting("node.port", 9200)
+        reg = ClusterSettings([s, static])
+        seen = []
+        reg.add_settings_update_consumer(s, seen.append)
+        cur = Settings.EMPTY
+        cur = reg.apply_settings(cur, Settings.of({"search.batch": 32}))
+        assert seen == [32]
+        assert s.get(cur) == 32
+        with pytest.raises(SettingsException):
+            reg.apply_settings(cur, Settings.of({"node.port": 1}))
+
+    def test_null_resets_to_default(self):
+        s = Setting.int_setting("search.batch", 8,
+                                properties=Property.NODE_SCOPE | Property.DYNAMIC)
+        reg = ClusterSettings([s])
+        cur = reg.apply_settings(Settings.EMPTY, Settings.of({"search.batch": 32}))
+        cur = reg.apply_settings(cur, Settings({"search.batch": None}))
+        assert s.get(cur) == 8
+
+    def test_index_scope_enforced(self):
+        with pytest.raises(SettingsException):
+            IndexScopedSettings([Setting.int_setting("node.thing", 1)])
+
+
+class TestErrors:
+    def test_error_type_naming(self):
+        assert IndexNotFoundException("i").error_type == "index_not_found_exception"
+        assert VersionConflictEngineException("v").status == 409
+
+    def test_caused_by_chain(self):
+        try:
+            try:
+                raise ValueError("root")
+            except ValueError as e:
+                raise EsException("wrapper") from e
+        except EsException as e:
+            body = e.to_xcontent()
+            assert body["caused_by"]["reason"] == "root"
+
+
+class TestBreaker:
+    def test_child_breaker_trips(self):
+        svc = HierarchyCircuitBreakerService(1000)
+        b = svc.get_breaker("request")  # limit 600
+        b.add_estimate_bytes_and_maybe_break(500, "a")
+        with pytest.raises(CircuitBreakingException):
+            b.add_estimate_bytes_and_maybe_break(200, "b")
+        assert b.used == 500
+        b.release(500)
+        assert b.used == 0
+
+    def test_parent_limit_over_children(self):
+        svc = HierarchyCircuitBreakerService(1000, {"a": 800, "b": 800})
+        svc.get_breaker("a").add_estimate_bytes_and_maybe_break(700, "x")
+        with pytest.raises(CircuitBreakingException):
+            svc.get_breaker("b").add_estimate_bytes_and_maybe_break(600, "y")
+        # failed reservation must roll back
+        assert svc.get_breaker("b").used == 0
+
+
+class TestXContent:
+    def test_object_parser_strict(self):
+        class Tgt:
+            pass
+
+        p = ObjectParser("test").declare_field("size", lambda t, v: setattr(t, "size", v))
+        t = p.parse({"size": 5}, Tgt())
+        assert t.size == 5
+        with pytest.raises(ParsingException):
+            p.parse({"siez": 5}, Tgt())
+
+    def test_required_field(self):
+        class Tgt:
+            pass
+
+        p = ObjectParser("t").declare_field("q", lambda t, v: None, required=True)
+        with pytest.raises(ParsingException):
+            p.parse({}, Tgt())
+
+    def test_json_error(self):
+        with pytest.raises(ParsingException):
+            json_loads(b"{nope")
