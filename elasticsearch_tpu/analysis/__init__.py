@@ -1,0 +1,31 @@
+"""Text analysis: tokenizers, token filters, analyzers, and the registry.
+
+Reference: index/analysis/ (AnalysisRegistry#build, IndexAnalyzers,
+NamedAnalyzer) with the stock implementations from modules/analysis-common
+(SURVEY.md §2.1#28). The registry maps per-index settings to built analyzer
+chains; field mappers resolve analyzers by name at mapping-build time.
+"""
+
+from elasticsearch_tpu.analysis.analyzers import (
+    Analyzer,
+    AnalysisRegistry,
+    CustomAnalyzer,
+    KeywordAnalyzer,
+    SimpleAnalyzer,
+    StandardAnalyzer,
+    StopAnalyzer,
+    WhitespaceAnalyzer,
+    ENGLISH_STOP_WORDS,
+)
+
+__all__ = [
+    "Analyzer",
+    "AnalysisRegistry",
+    "CustomAnalyzer",
+    "KeywordAnalyzer",
+    "SimpleAnalyzer",
+    "StandardAnalyzer",
+    "StopAnalyzer",
+    "WhitespaceAnalyzer",
+    "ENGLISH_STOP_WORDS",
+]

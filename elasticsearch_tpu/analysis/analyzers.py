@@ -1,0 +1,267 @@
+"""Analyzer implementations.
+
+Reference behavior contracts (modules/analysis-common, CommonAnalysisPlugin):
+  - ``standard``: UAX#29-style word-break tokenizer + lowercase, NO stop
+    words by default (upstream default since 5.x), max_token_length 255.
+  - ``simple``: split on non-letters + lowercase.
+  - ``whitespace``: split on whitespace, no lowercasing.
+  - ``keyword``: the whole input as a single token.
+  - ``stop``: simple + English stop-word removal.
+  - custom: configurable tokenizer + filter chain from index settings
+    (AnalysisRegistry#build).
+
+The tokenizer here approximates UAX#29 word breaks with a Unicode
+word-character regex that keeps ASCII apostrophes/periods inside tokens the
+way users typically observe Lucene behave for plain English text; exact ICU
+segmentation is out of scope (reference keeps it in a plugin too:
+analysis-icu).
+
+A token stream is a list of Token(term, position), with position increments
+respecting removed stop words (holes) — phrase queries need the gaps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Sequence
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+# the classic Lucene EnglishAnalyzer/StopAnalyzer default stop set
+ENGLISH_STOP_WORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split()
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Token:
+    term: str
+    position: int
+
+
+# Unicode "word" runs; \w covers letters/digits/underscore across scripts.
+_WORD_RE = re.compile(r"\w+(?:[.']\w+)*", re.UNICODE)
+_LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+
+def standard_tokenize(text: str, max_token_length: int = 255) -> List[str]:
+    out = []
+    for m in _WORD_RE.finditer(text):
+        t = m.group(0).replace("_", "")
+        if not t:
+            continue
+        # overlong tokens are split at max_token_length, as the reference does
+        while len(t) > max_token_length:
+            out.append(t[:max_token_length])
+            t = t[max_token_length:]
+        if t:
+            out.append(t)
+    return out
+
+
+def letter_tokenize(text: str) -> List[str]:
+    return _LETTER_RE.findall(text)
+
+
+def whitespace_tokenize(text: str) -> List[str]:
+    return text.split()
+
+
+class Analyzer:
+    """Base: subclasses provide tokenize() and a filter chain."""
+
+    name = "base"
+
+    def tokenize(self, text: str) -> List[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def filters(self) -> Sequence[Callable[[List[Optional[str]]], List[Optional[str]]]]:
+        return ()
+
+    def analyze(self, text: str) -> List[Token]:
+        """Run the chain. Filters see/emit per-slot terms; a filter marks a
+        removed token as None, which leaves a position hole."""
+        slots: List[Optional[str]] = list(self.tokenize(text))
+        for f in self.filters():
+            slots = f(slots)
+        tokens: List[Token] = []
+        for pos, term in enumerate(slots):
+            if term:
+                tokens.append(Token(term, pos))
+        return tokens
+
+    def terms(self, text: str) -> List[str]:
+        return [t.term for t in self.analyze(text)]
+
+
+def lowercase_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
+    return [s.lower() if s else s for s in slots]
+
+
+def make_stop_filter(stopwords) -> Callable:
+    stopset = frozenset(stopwords)
+
+    def stop_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
+        return [None if s and s in stopset else s for s in slots]
+
+    return stop_filter
+
+
+def make_length_filter(min_len: int = 0, max_len: int = 2**31) -> Callable:
+    def length_filter(slots):
+        return [s if s and min_len <= len(s) <= max_len else None for s in slots]
+
+    return length_filter
+
+
+def asciifolding_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
+    import unicodedata
+
+    def fold(s: str) -> str:
+        return "".join(
+            c for c in unicodedata.normalize("NFKD", s) if not unicodedata.combining(c)
+        )
+
+    return [fold(s) if s else s for s in slots]
+
+
+class StandardAnalyzer(Analyzer):
+    name = "standard"
+
+    def __init__(self, max_token_length: int = 255, stopwords=()):
+        self.max_token_length = max_token_length
+        self._filters = [lowercase_filter]
+        if stopwords:
+            self._filters.append(make_stop_filter(stopwords))
+
+    def tokenize(self, text: str) -> List[str]:
+        return standard_tokenize(text, self.max_token_length)
+
+    def filters(self):
+        return self._filters
+
+
+class SimpleAnalyzer(Analyzer):
+    name = "simple"
+
+    def tokenize(self, text: str) -> List[str]:
+        return letter_tokenize(text)
+
+    def filters(self):
+        return (lowercase_filter,)
+
+
+class WhitespaceAnalyzer(Analyzer):
+    name = "whitespace"
+
+    def tokenize(self, text: str) -> List[str]:
+        return whitespace_tokenize(text)
+
+
+class KeywordAnalyzer(Analyzer):
+    name = "keyword"
+
+    def tokenize(self, text: str) -> List[str]:
+        return [text] if text else []
+
+
+class StopAnalyzer(SimpleAnalyzer):
+    name = "stop"
+
+    def __init__(self, stopwords=ENGLISH_STOP_WORDS):
+        self._stop = make_stop_filter(stopwords)
+
+    def filters(self):
+        return (lowercase_filter, self._stop)
+
+
+class CustomAnalyzer(Analyzer):
+    name = "custom"
+
+    def __init__(self, tokenizer: Callable[[str], List[str]], filters: Sequence[Callable]):
+        self._tokenizer = tokenizer
+        self._filters = list(filters)
+
+    def tokenize(self, text: str) -> List[str]:
+        return self._tokenizer(text)
+
+    def filters(self):
+        return self._filters
+
+
+_TOKENIZERS: Dict[str, Callable[[str], List[str]]] = {
+    "standard": standard_tokenize,
+    "letter": letter_tokenize,
+    "lowercase": letter_tokenize,  # letter + lowercase filter added below
+    "whitespace": whitespace_tokenize,
+    "keyword": lambda text: [text] if text else [],
+}
+
+
+class AnalysisRegistry:
+    """Builds per-index analyzers from index settings.
+
+    Reference: index/analysis/AnalysisRegistry#build — resolves
+    ``index.analysis.analyzer.<name>`` definitions (type custom/standard/...)
+    into NamedAnalyzer instances; ``IndexAnalyzers`` then serves lookups for
+    mappers and query parsing."""
+
+    BUILTIN = {
+        "standard": StandardAnalyzer,
+        "simple": SimpleAnalyzer,
+        "whitespace": WhitespaceAnalyzer,
+        "keyword": KeywordAnalyzer,
+        "stop": StopAnalyzer,
+    }
+
+    def build(self, index_settings) -> Dict[str, Analyzer]:
+        """index_settings: a common.settings.Settings scoped to one index."""
+        analyzers: Dict[str, Analyzer] = {name: cls() for name, cls in self.BUILTIN.items()}
+        prefix = "index.analysis.analyzer."
+        custom: Dict[str, Dict] = {}
+        for key in index_settings.keys():
+            if not key.startswith(prefix):
+                continue
+            rest = key[len(prefix):]
+            name, _, prop = rest.partition(".")
+            custom.setdefault(name, {})[prop] = index_settings.raw_get(key)
+        for name, props in custom.items():
+            analyzers[name] = self._build_one(name, props)
+        return analyzers
+
+    def _build_one(self, name: str, props: Dict) -> Analyzer:
+        atype = props.get("type", "custom")
+        if atype in self.BUILTIN and atype != "custom":
+            if atype == "standard":
+                stop = props.get("stopwords") or ()
+                if stop == "_english_":
+                    stop = ENGLISH_STOP_WORDS
+                return StandardAnalyzer(
+                    max_token_length=int(props.get("max_token_length", 255)),
+                    stopwords=stop,
+                )
+            return self.BUILTIN[atype]()
+        if atype != "custom":
+            raise IllegalArgumentException(f"unknown analyzer type [{atype}] for [{name}]")
+        tok_name = props.get("tokenizer", "standard")
+        tokenizer = _TOKENIZERS.get(tok_name)
+        if tokenizer is None:
+            raise IllegalArgumentException(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
+        filters = []
+        if tok_name == "lowercase":
+            filters.append(lowercase_filter)
+        raw_filters = props.get("filter", [])
+        if isinstance(raw_filters, str):
+            raw_filters = [f.strip() for f in raw_filters.split(",") if f.strip()]
+        for f in raw_filters:
+            if f == "lowercase":
+                filters.append(lowercase_filter)
+            elif f == "stop":
+                filters.append(make_stop_filter(ENGLISH_STOP_WORDS))
+            elif f == "asciifolding":
+                filters.append(asciifolding_filter)
+            else:
+                raise IllegalArgumentException(f"unknown token filter [{f}] for analyzer [{name}]")
+        return CustomAnalyzer(tokenizer, filters)

@@ -1,0 +1,158 @@
+"""Device segment packs: postings as padded HBM-resident tensors.
+
+Reference boundary being replaced (SURVEY.md §1 L0, §3.3): Lucene's
+query-time kernels — postings block decode (ForUtil), conjunction
+(ConjunctionDISI), BM25 scoring (BM25Similarity$BM25Scorer) and top-k
+collection (TopScoreDocCollector) — become array programs over these packs
+(ops/bm25.py).
+
+Layout per (segment, field):
+  flat_docs  int32[P_pad]  all terms' postings concatenated, sorted per term
+  flat_tfs   int32[P_pad]  term frequencies, aligned with flat_docs
+  row_start  int64[V+1]    postings row boundaries per term row (host side)
+  norms_u8   uint8[D_pad]  SmallFloat4-encoded field lengths
+  vocab      {term: row}   host-side dict (the terms dict / FST analog)
+  doc_freq   int64[V]      per-segment df (shard-level idf sums across packs)
+
+Padding sentinels: flat_docs pads with D_pad (one past the last real doc
+row) so scatter-adds drop padded lanes; norms pad with 0. All device arrays
+are sized to multiples of LANE (128) to keep XLA tiling happy.
+
+The pack is a *derived cache* of the host Segment (§5.4): rebuildable at any
+time, so HBM eviction under the `hbm` circuit breaker is always safe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.index.segment import Segment
+
+LANE = 128  # pad unit: TPU lane width
+
+
+def _pad_to(n: int, unit: int = LANE) -> int:
+    return ((n + unit - 1) // unit) * unit if n else unit
+
+
+@dataclasses.dataclass
+class FieldPack:
+    """One field's postings + norms for one segment, as device arrays.
+
+    Arrays start as numpy; jax.device_put on first use (or eagerly by the
+    shard's pack manager) moves them to HBM — they are never mutated."""
+
+    field: str
+    num_docs: int
+    d_pad: int
+    flat_docs: np.ndarray   # int32[P_pad]
+    flat_tfs: np.ndarray    # int32[P_pad]
+    row_start: np.ndarray   # int64[V+1]
+    norms_u8: np.ndarray    # uint8[D_pad]
+    vocab: Dict[str, int]
+    doc_freq: np.ndarray    # int64[V]
+
+    def term_row(self, term: str) -> int:
+        return self.vocab.get(term, -1)
+
+    def row_slice(self, row: int) -> Tuple[int, int]:
+        if row < 0:
+            return 0, 0
+        s, e = int(self.row_start[row]), int(self.row_start[row + 1])
+        return s, e - s
+
+    def nbytes(self) -> int:
+        return (self.flat_docs.nbytes + self.flat_tfs.nbytes
+                + self.norms_u8.nbytes)
+
+
+@dataclasses.dataclass
+class SegmentPack:
+    """All packed fields of one segment + doc-value columns."""
+
+    segment_name: str
+    num_docs: int
+    d_pad: int
+    fields: Dict[str, FieldPack]
+    # doc-value columns, padded to d_pad; i64 pads with MISSING, f64 with nan,
+    # ord with -1
+    dv_i64: Dict[str, np.ndarray]
+    dv_f64: Dict[str, np.ndarray]
+    dv_ord: Dict[str, np.ndarray]
+    dv_ord_terms: Dict[str, List[str]]
+    live_mask: np.ndarray  # bool[D_pad]; False for tombstoned/padded docs
+
+    def nbytes(self) -> int:
+        total = sum(f.nbytes() for f in self.fields.values())
+        for d in (self.dv_i64, self.dv_f64, self.dv_ord):
+            total += sum(a.nbytes for a in d.values())
+        return total + self.live_mask.nbytes
+
+
+def build_field_pack(segment: Segment, field: str, d_pad: int) -> Optional[FieldPack]:
+    postings = segment.postings.get(field)
+    if not postings:
+        return None
+    terms = sorted(postings.keys())
+    vocab = {t: i for i, t in enumerate(terms)}
+    sizes = [len(postings[t][0]) for t in terms]
+    total = sum(sizes)
+    p_pad = _pad_to(total)
+    flat_docs = np.full(p_pad, d_pad, dtype=np.int32)
+    flat_tfs = np.zeros(p_pad, dtype=np.int32)
+    row_start = np.zeros(len(terms) + 1, dtype=np.int64)
+    pos = 0
+    for i, t in enumerate(terms):
+        docs, tfs = postings[t]
+        row_start[i] = pos
+        flat_docs[pos:pos + len(docs)] = docs
+        flat_tfs[pos:pos + len(docs)] = tfs
+        pos += len(docs)
+    row_start[len(terms)] = pos
+    norms = np.zeros(d_pad, dtype=np.uint8)
+    seg_norms = segment.norms.get(field)
+    if seg_norms is not None:
+        norms[: segment.num_docs] = seg_norms
+    doc_freq = np.array(sizes, dtype=np.int64)
+    return FieldPack(field, segment.num_docs, d_pad, flat_docs, flat_tfs,
+                     row_start, norms, vocab, doc_freq)
+
+
+def build_segment_pack(segment: Segment,
+                       live_docs: Optional[np.ndarray] = None) -> SegmentPack:
+    from elasticsearch_tpu.index.segment import MISSING_I64
+
+    d_pad = _pad_to(segment.num_docs)
+    fields: Dict[str, FieldPack] = {}
+    for field in segment.postings:
+        fp = build_field_pack(segment, field, d_pad)
+        if fp is not None:
+            fields[field] = fp
+    dv_i64: Dict[str, np.ndarray] = {}
+    dv_f64: Dict[str, np.ndarray] = {}
+    dv_ord: Dict[str, np.ndarray] = {}
+    dv_ord_terms: Dict[str, List[str]] = {}
+    for field, col in segment.doc_values.items():
+        if col.kind == "i64":
+            a = np.full(d_pad, MISSING_I64, dtype=np.int64)
+            a[: segment.num_docs] = col.values
+            dv_i64[field] = a
+        elif col.kind == "f64":
+            a = np.full(d_pad, np.nan, dtype=np.float64)
+            a[: segment.num_docs] = col.values
+            dv_f64[field] = a
+        else:
+            a = np.full(d_pad, -1, dtype=np.int32)
+            a[: segment.num_docs] = col.values
+            dv_ord[field] = a
+            dv_ord_terms[field] = list(col.ord_terms or [])
+    live = np.zeros(d_pad, dtype=bool)
+    if live_docs is not None:
+        live[: segment.num_docs] = live_docs
+    else:
+        live[: segment.num_docs] = True
+    return SegmentPack(segment.name, segment.num_docs, d_pad, fields,
+                       dv_i64, dv_f64, dv_ord, dv_ord_terms, live)

@@ -1,0 +1,113 @@
+"""ShardReader — an immutable point-in-time view of a shard for search.
+
+Reference: the engine's SearcherSupplier/ReaderContext (SURVEY.md §3.3:
+"#createContext: pins an engine SearcherSupplier = Lucene segment
+snapshot"). A reader holds the segment set + device packs + live-doc masks
+at acquire time; refreshes/merges create new readers and never mutate one.
+
+Shard-level statistics (doc_count, avgdl, docFreq) are computed here across
+all segments — Lucene idf uses SHARD-level stats via CollectionStatistics
+(SURVEY.md §7.3#2), so these must span segments, not come per-segment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.index.pack import SegmentPack, build_segment_pack
+from elasticsearch_tpu.index.segment import Segment
+from elasticsearch_tpu.mapping import MapperService
+
+
+@dataclasses.dataclass
+class SegmentView:
+    segment: Segment
+    pack: SegmentPack
+    live_mask: np.ndarray  # bool[d_pad] — tombstones applied, padding False
+
+
+class ShardReader:
+    def __init__(self, segments: List[Tuple[Segment, Optional[np.ndarray]]],
+                 mapper: MapperService, k1: float = 1.2, b: float = 0.75,
+                 packs: Optional[Dict[str, SegmentPack]] = None):
+        """segments: [(segment, live_docs bool[num_docs] or None)].
+        packs: reusable device packs keyed by segment name (immutable), so
+        refresh doesn't rebuild packs for unchanged segments. Tombstone
+        masks are NOT part of the pack — they change between readers."""
+        self.mapper = mapper
+        self.k1 = k1
+        self.b = b
+        self.views: List[SegmentView] = []
+        packs = packs or {}
+        for seg, live in segments:
+            pack = packs.get(seg.name)
+            if pack is None:
+                pack = build_segment_pack(seg)
+            live_mask = np.zeros(pack.d_pad, dtype=bool)
+            if live is not None:
+                live_mask[: seg.num_docs] = live
+            else:
+                live_mask[: seg.num_docs] = True
+            self.views.append(SegmentView(seg, pack, live_mask))
+        self._has_field_cache: Dict[Tuple[int, str], np.ndarray] = {}
+
+    # ---------------- shard-level stats ----------------
+
+    def field_stats(self, field: str) -> Tuple[int, float]:
+        """(doc_count, avgdl) across segments. NOTE: like Lucene, stats
+        include tombstoned docs until they are merged away."""
+        doc_count = 0
+        sum_ttf = 0
+        for v in self.views:
+            st = v.segment.field_stats.get(field)
+            if st:
+                doc_count += st.doc_count
+                sum_ttf += st.sum_total_term_freq
+        return doc_count, (sum_ttf / doc_count if doc_count else 1.0)
+
+    def doc_freq(self, field: str, term: str) -> int:
+        return sum(v.segment.doc_freq(field, term) for v in self.views)
+
+    def num_docs(self) -> int:
+        return sum(int(v.live_mask.sum()) for v in self.views)
+
+    def max_docs(self) -> int:
+        return sum(v.segment.num_docs for v in self.views)
+
+    # ---------------- per-segment helpers ----------------
+
+    def has_field_mask(self, view_idx: int, field: str) -> np.ndarray:
+        """bool[d_pad]: docs where `field` exists (exists-query support):
+        text → norm length recorded; others → doc-value present."""
+        key = (view_idx, field)
+        cached = self._has_field_cache.get(key)
+        if cached is not None:
+            return cached
+        v = self.views[view_idx]
+        d_pad = v.pack.d_pad
+        mask = np.zeros(d_pad, dtype=bool)
+        seg = v.segment
+        exact = seg.exact_lengths.get(field)
+        if exact is not None:
+            mask[: seg.num_docs] |= exact >= 0
+        if field in v.pack.dv_i64:
+            from elasticsearch_tpu.index.segment import MISSING_I64
+            mask |= v.pack.dv_i64[field] != MISSING_I64
+        if field in v.pack.dv_f64:
+            mask |= ~np.isnan(v.pack.dv_f64[field])
+        if field in v.pack.dv_ord:
+            mask |= v.pack.dv_ord[field] >= 0
+        self._has_field_cache[key] = mask
+        return mask
+
+    def resolve_ids(self, view_idx: int, ids: List[str]) -> np.ndarray:
+        v = self.views[view_idx]
+        mask = np.zeros(v.pack.d_pad, dtype=bool)
+        for i in ids:
+            ord_ = v.segment.id_to_ord.get(i)
+            if ord_ is not None:
+                mask[ord_] = True
+        return mask

@@ -1,0 +1,329 @@
+"""Host-side immutable segments — the storage unit of a shard.
+
+Reference: Lucene segments (SURVEY.md L0) reinterpreted for the TPU design
+(§7.1 table): a segment here is an immutable, host-resident inverted index
+plus doc-values columns and stored source; the device-side "segment pack"
+(index/pack.py) is a derived, rebuildable cache of its postings as padded
+tensors. SegmentWriter plays the role of Lucene's DocumentsWriter (in-memory
+buffer → frozen segment at refresh), and merging segments (§3.2 [async]
+merges) is plain concatenation + tombstone purge here.
+
+Per-field structures:
+  postings[field][term] -> (doc_ids int32[], tfs int32[])   sorted by doc id
+  positions[field][term] -> {local_doc: positions int32[]}  (phrase queries)
+  norms[field] -> u8[num_docs]   SmallFloat4-encoded token counts
+  doc_count[field], sum_total_term_freq[field]              BM25 stats
+  doc_values[field] -> i64/f64 column (+ ord dict for keywords)
+
+Live docs (deletes) are a bitmap owned by the containing shard's engine —
+segments themselves stay immutable (soft deletes, like the reference's
+soft-deletes model §2.1#24).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.mapping import ParsedDocument
+from elasticsearch_tpu.ops.smallfloat import encode_norm
+
+MISSING_I64 = -(2**63)
+
+
+@dataclasses.dataclass
+class DocValuesColumn:
+    kind: str  # "i64" | "f64" | "ord"
+    values: np.ndarray  # i64/f64; for "ord": i32 ordinals into ord_terms, -1 = missing
+    # multi-valued docs: values stores the FIRST value; extra values per doc here
+    extra: Dict[int, List[Any]]
+    ord_terms: Optional[List[str]] = None  # sorted unique terms for "ord"
+
+    def value_count(self) -> int:
+        return int((self.values != (MISSING_I64 if self.kind != "ord" else -1)).sum()) + sum(
+            len(v) for v in self.extra.values()
+        )
+
+
+@dataclasses.dataclass
+class FieldStats:
+    doc_count: int = 0            # docs with this field
+    sum_total_term_freq: int = 0  # total tokens (Σ field length)
+
+    def merged(self, other: "FieldStats") -> "FieldStats":
+        return FieldStats(self.doc_count + other.doc_count,
+                          self.sum_total_term_freq + other.sum_total_term_freq)
+
+
+class Segment:
+    """Immutable after construction (by SegmentWriter.freeze or merge)."""
+
+    def __init__(self, name: str, num_docs: int,
+                 doc_ids: List[str],
+                 postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]],
+                 norms: Dict[str, np.ndarray],
+                 field_stats: Dict[str, FieldStats],
+                 doc_values: Dict[str, DocValuesColumn],
+                 stored_source: List[Optional[dict]],
+                 positions: Optional[Dict[str, Dict[str, Dict[int, np.ndarray]]]] = None,
+                 exact_lengths: Optional[Dict[str, np.ndarray]] = None):
+        self.name = name
+        self.num_docs = num_docs
+        self.doc_ids = doc_ids                    # local doc ord -> external _id
+        self.postings = postings
+        self.norms = norms
+        self.field_stats = field_stats
+        self.doc_values = doc_values
+        self.stored_source = stored_source
+        self.positions = positions or {}
+        # exact token counts per doc (i64, -1 = field absent): norms are the
+        # lossy scoring representation; stats (avgdl) must stay EXACT across
+        # merges, as Lucene maintains sumTotalTermFreq exactly
+        self.exact_lengths = exact_lengths or {}
+        self.id_to_ord: Dict[str, int] = {d: i for i, d in enumerate(doc_ids)}
+
+    def doc_freq(self, field: str, term: str) -> int:
+        entry = self.postings.get(field, {}).get(term)
+        return 0 if entry is None else len(entry[0])
+
+    def terms(self, field: str):
+        return self.postings.get(field, {}).keys()
+
+    def ram_bytes_estimate(self) -> int:
+        total = 0
+        for field_postings in self.postings.values():
+            for docs, tfs in field_postings.values():
+                total += docs.nbytes + tfs.nbytes
+        for n in self.norms.values():
+            total += n.nbytes
+        for col in self.doc_values.values():
+            total += col.values.nbytes
+        return total
+
+
+class SegmentWriter:
+    """In-memory document buffer; freeze() emits an immutable Segment.
+
+    The reference analog is Lucene's DWPT: documents accumulate in RAM and
+    become a searchable segment at refresh (SURVEY.md §3.2 [async] refresh).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._doc_ids: List[str] = []
+        self._postings: Dict[str, Dict[str, List[Tuple[int, int]]]] = {}
+        self._positions: Dict[str, Dict[str, Dict[int, List[int]]]] = {}
+        self._field_lengths: Dict[str, Dict[int, int]] = {}
+        self._field_stats: Dict[str, FieldStats] = {}
+        self._doc_values: Dict[str, Dict[int, Any]] = {}
+        self._dv_kinds: Dict[str, str] = {}
+        self._stored: List[Optional[dict]] = []
+
+    @property
+    def num_docs(self) -> int:
+        return len(self._doc_ids)
+
+    def add_document(self, doc: ParsedDocument, dv_kinds: Dict[str, str]) -> int:
+        """dv_kinds: field → "i64"|"f64"|"ord" from the mapper's field types.
+        Returns the local doc ordinal."""
+        ord_ = len(self._doc_ids)
+        self._doc_ids.append(doc.doc_id)
+        self._stored.append(doc.source)
+        for field, terms in doc.postings_terms.items():
+            field_postings = self._postings.setdefault(field, {})
+            tf: Dict[str, int] = {}
+            for t in terms:
+                tf[t] = tf.get(t, 0) + 1
+            for t, f in tf.items():
+                field_postings.setdefault(t, []).append((ord_, f))
+        for field, toks in doc.positions.items():
+            fp = self._positions.setdefault(field, {})
+            for term, pos in toks:
+                fp.setdefault(term, {}).setdefault(ord_, []).append(pos)
+        for field, length in doc.field_lengths.items():
+            self._field_lengths.setdefault(field, {})[ord_] = length
+            stats = self._field_stats.setdefault(field, FieldStats())
+            stats.doc_count += 1
+            stats.sum_total_term_freq += length
+        for field, dv in doc.doc_values.items():
+            self._doc_values.setdefault(field, {})[ord_] = dv
+            if field in dv_kinds:
+                self._dv_kinds[field] = dv_kinds[field]
+        return ord_
+
+    def freeze(self) -> Segment:
+        n = len(self._doc_ids)
+        postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
+        for field, terms in self._postings.items():
+            out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+            for term, pl in terms.items():
+                docs = np.array([d for d, _ in pl], dtype=np.int32)
+                tfs = np.array([f for _, f in pl], dtype=np.int32)
+                out[term] = (docs, tfs)
+            postings[field] = out
+        norms: Dict[str, np.ndarray] = {}
+        exact_lengths: Dict[str, np.ndarray] = {}
+        for field, lengths in self._field_lengths.items():
+            col = np.zeros(n, dtype=np.uint8)
+            exact = np.full(n, -1, dtype=np.int64)
+            for ord_, length in lengths.items():
+                col[ord_] = encode_norm(length)
+                exact[ord_] = length
+            norms[field] = col
+            exact_lengths[field] = exact
+        doc_values: Dict[str, DocValuesColumn] = {}
+        for field, per_doc in self._doc_values.items():
+            kind = self._dv_kinds.get(field, "i64")
+            doc_values[field] = _build_dv_column(kind, per_doc, n)
+        positions = {
+            field: {term: {d: np.array(p, dtype=np.int32) for d, p in docs.items()}
+                    for term, docs in terms.items()}
+            for field, terms in self._positions.items()
+        }
+        return Segment(self.name, n, list(self._doc_ids), postings, norms,
+                       dict(self._field_stats), doc_values, list(self._stored),
+                       positions, exact_lengths)
+
+
+def _build_dv_column(kind: str, per_doc: Dict[int, Any], n: int) -> DocValuesColumn:
+    extra: Dict[int, List[Any]] = {}
+    if kind == "ord":
+        uniq = set()
+        for v in per_doc.values():
+            for x in (v if isinstance(v, list) else [v]):
+                uniq.add(x)
+        ord_terms = sorted(uniq)
+        ord_of = {t: i for i, t in enumerate(ord_terms)}
+        values = np.full(n, -1, dtype=np.int32)
+        for d, v in per_doc.items():
+            vs = v if isinstance(v, list) else [v]
+            values[d] = ord_of[vs[0]]
+            if len(vs) > 1:
+                extra[d] = [ord_of[x] for x in vs[1:]]
+        return DocValuesColumn("ord", values, extra, ord_terms)
+    if kind == "f64":
+        values = np.full(n, np.nan, dtype=np.float64)
+    else:
+        values = np.full(n, MISSING_I64, dtype=np.int64)
+    for d, v in per_doc.items():
+        vs = v if isinstance(v, list) else [v]
+        values[d] = vs[0]
+        if len(vs) > 1:
+            extra[d] = vs[1:]
+    return DocValuesColumn(kind, values, extra)
+
+
+def merge_segments(name: str, segments: List[Segment],
+                   live_docs: Optional[List[np.ndarray]] = None) -> Segment:
+    """Concatenate segments into one, dropping tombstoned docs.
+
+    Reference analog: Lucene segment merging via ConcurrentMergeScheduler
+    (§3.2 [async]); here a host job that re-packs arrays. live_docs[i] is a
+    bool mask over segments[i] docs (None = all live)."""
+    doc_ids: List[str] = []
+    stored: List[Optional[dict]] = []
+    remap: List[np.ndarray] = []  # per segment: old ord -> new ord (-1 dropped)
+    for i, seg in enumerate(segments):
+        mask = live_docs[i] if live_docs is not None and live_docs[i] is not None \
+            else np.ones(seg.num_docs, dtype=bool)
+        m = np.full(seg.num_docs, -1, dtype=np.int64)
+        keep = np.nonzero(mask)[0]
+        m[keep] = np.arange(len(doc_ids), len(doc_ids) + len(keep))
+        remap.append(m)
+        for ord_ in keep:
+            doc_ids.append(seg.doc_ids[ord_])
+            stored.append(seg.stored_source[ord_])
+    n = len(doc_ids)
+
+    postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
+    positions: Dict[str, Dict[str, Dict[int, np.ndarray]]] = {}
+    norms: Dict[str, np.ndarray] = {}
+    field_stats: Dict[str, FieldStats] = {}
+    dv_parts: Dict[str, List[Tuple[int, DocValuesColumn, np.ndarray]]] = {}
+
+    all_fields = set()
+    for seg in segments:
+        all_fields.update(seg.postings.keys())
+        all_fields.update(seg.norms.keys())
+        all_fields.update(seg.doc_values.keys())
+
+    exact_lengths: Dict[str, np.ndarray] = {}
+    for field in all_fields:
+        acc: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        norm_col = np.zeros(n, dtype=np.uint8)
+        exact_col = np.full(n, -1, dtype=np.int64)
+        has_norms = False
+        stats = FieldStats()
+        for i, seg in enumerate(segments):
+            m = remap[i]
+            for term, (docs, tfs) in seg.postings.get(field, {}).items():
+                new = m[docs]
+                keep = new >= 0
+                if keep.any():
+                    acc.setdefault(term, []).append(
+                        (new[keep].astype(np.int32), tfs[keep]))
+            for term, docpos in seg.positions.get(field, {}).items():
+                for d, pos in docpos.items():
+                    nd = int(m[d])
+                    if nd >= 0:
+                        positions.setdefault(field, {}).setdefault(term, {})[nd] = pos
+            if field in seg.norms:
+                has_norms = True
+                src = seg.norms[field]
+                keep = m >= 0
+                norm_col[m[keep]] = src[keep]
+                # stats stay EXACT across merges (Lucene maintains
+                # sumTotalTermFreq exactly; recomputing from the lossy norm
+                # bytes would shift avgdl and silently break scoring parity)
+                src_exact = seg.exact_lengths.get(field)
+                if src_exact is None:
+                    raise ValueError(
+                        f"segment [{seg.name}] lacks exact lengths for [{field}]")
+                exact_col[m[keep]] = src_exact[keep]
+                surviving = src_exact[keep]
+                present = surviving >= 0
+                stats.doc_count += int(present.sum())
+                stats.sum_total_term_freq += int(surviving[present].sum())
+            if field in seg.doc_values:
+                dv_parts.setdefault(field, []).append((i, seg.doc_values[field], m))
+        if acc:
+            merged_terms = {}
+            for term, parts in acc.items():
+                docs = np.concatenate([p[0] for p in parts])
+                tfs = np.concatenate([p[1] for p in parts])
+                order = np.argsort(docs, kind="stable")
+                merged_terms[term] = (docs[order], tfs[order])
+            postings[field] = merged_terms
+        if has_norms:
+            norms[field] = norm_col
+            exact_lengths[field] = exact_col
+            field_stats[field] = stats
+
+    doc_values: Dict[str, DocValuesColumn] = {}
+    for field, parts in dv_parts.items():
+        kind = parts[0][1].kind
+        per_doc: Dict[int, Any] = {}
+        for _, col, m in parts:
+            for old in range(len(col.values)):
+                new = int(m[old])
+                if new < 0:
+                    continue
+                if col.kind == "ord":
+                    if col.values[old] < 0:
+                        continue
+                    vals = [col.ord_terms[col.values[old]]]
+                    vals += [col.ord_terms[x] for x in col.extra.get(old, [])]
+                else:
+                    v = col.values[old]
+                    if col.kind == "i64" and v == MISSING_I64:
+                        continue
+                    if col.kind == "f64" and np.isnan(v):
+                        continue
+                    vals = [v] + list(col.extra.get(old, []))
+                per_doc[new] = vals if len(vals) > 1 else vals[0]
+        doc_values[field] = _build_dv_column(kind, per_doc, n)
+
+    return Segment(name, n, doc_ids, postings, norms, field_stats, doc_values,
+                   stored, positions, exact_lengths)

@@ -1,0 +1,22 @@
+"""Document schema: field types, MapperService, DocumentParser.
+
+Reference: index/mapper/ (SURVEY.md §2.1#27).
+"""
+
+from elasticsearch_tpu.mapping.mapper import DocumentMapper, MapperService, ParsedDocument
+from elasticsearch_tpu.mapping.types import (
+    BooleanFieldType,
+    DateFieldType,
+    FieldType,
+    KeywordFieldType,
+    NumberFieldType,
+    TextFieldType,
+    field_type_for,
+    parse_date_millis,
+)
+
+__all__ = [
+    "DocumentMapper", "MapperService", "ParsedDocument",
+    "BooleanFieldType", "DateFieldType", "FieldType", "KeywordFieldType",
+    "NumberFieldType", "TextFieldType", "field_type_for", "parse_date_millis",
+]

@@ -1,0 +1,264 @@
+"""Field types.
+
+Reference: index/mapper/ — MappedFieldType and the FieldMapper subtypes
+(TextFieldMapper, KeywordFieldMapper, NumberFieldMapper, DateFieldMapper,
+BooleanFieldMapper; SURVEY.md §2.1#27). A field type knows how to:
+  - produce index terms from a source value (text analysis / normalization),
+  - produce doc-values (columnar) entries for aggs/sort/range,
+  - normalize a query-side value to comparable form (term/range queries).
+
+Values are indexed into two device-visible structures (see index/pack.py):
+postings (term → docs, with tf) and doc-value columns (numeric i64/f64).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, List, Optional, Tuple
+
+from elasticsearch_tpu.analysis import Analyzer, KeywordAnalyzer, StandardAnalyzer
+from elasticsearch_tpu.common.errors import IllegalArgumentException, MapperParsingException
+
+# sentinel doc-value for "field missing in this doc" in i64 columns
+MISSING_I64 = -(2**63)
+
+
+def parse_date_millis(value: Any) -> int:
+    """`strict_date_optional_time||epoch_millis` default format behavior."""
+    if isinstance(value, bool):
+        raise MapperParsingException(f"failed to parse date [{value!r}]")
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value)
+    if s.isdigit() or (s.startswith("-") and s[1:].isdigit()):
+        return int(s)
+    try:
+        iso = s.replace("Z", "+00:00")
+        dt = datetime.datetime.fromisoformat(iso)
+    except ValueError:
+        # date-only fast path e.g. 2024-01-01
+        try:
+            dt = datetime.datetime.strptime(s, "%Y-%m-%d")
+        except ValueError as e:
+            raise MapperParsingException(f"failed to parse date [{value!r}]") from e
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+class FieldType:
+    """Base field type. ``type_name`` matches the mapping JSON ``type``."""
+
+    type_name = "base"
+    # does this field produce doc-values columns (for aggs/sort/range)?
+    has_doc_values = True
+    # does this field produce postings (for term/match queries)?
+    is_indexed = True
+    # is the doc-values column i64 ("long"-comparable) or f64?
+    dv_kind = "i64"  # "i64" | "f64" | "ord" (string ordinal)
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        self.name = name
+        self.params = dict(params or {})
+        if self.params.get("index") is False:
+            self.is_indexed = False
+        if self.params.get("doc_values") is False:
+            self.has_doc_values = False
+
+    # ---- indexing ----
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        """→ (terms for postings, token count for norms). Position-aware
+        analysis is used only by text fields (phrase support)."""
+        raise NotImplementedError
+
+    def doc_value(self, value: Any):
+        """→ comparable doc-value (int for i64 cols, float for f64, str for ord)."""
+        raise NotImplementedError
+
+    # ---- query side ----
+    def normalize_term(self, value: Any) -> str:
+        """Query-side single-term normalization (term query)."""
+        raise NotImplementedError
+
+    def normalize_range_bound(self, value: Any):
+        """Query-side range bound → comparable numeric."""
+        raise IllegalArgumentException(
+            f"field [{self.name}] of type [{self.type_name}] does not support range queries"
+        )
+
+    def to_mapping(self) -> dict:
+        out = {"type": self.type_name}
+        out.update(self.params)
+        return out
+
+
+class TextFieldType(FieldType):
+    type_name = "text"
+    has_doc_values = False  # like the reference: no doc_values on text
+    dv_kind = "none"
+
+    def __init__(self, name: str, params: Optional[dict] = None,
+                 analyzer: Optional[Analyzer] = None,
+                 search_analyzer: Optional[Analyzer] = None):
+        super().__init__(name, params)
+        self.analyzer = analyzer or StandardAnalyzer()
+        self.search_analyzer = search_analyzer or self.analyzer
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        tokens = self.analyzer.analyze(str(value))
+        # token count (incl. stop-word holes) is the Lucene field length used
+        # for the BM25 norm: Lucene counts emitted tokens only, so use len(tokens)
+        return [t.term for t in tokens], len(tokens)
+
+    def index_tokens(self, value: Any):
+        return self.analyzer.analyze(str(value))
+
+    def doc_value(self, value: Any):
+        raise MapperParsingException(f"text field [{self.name}] has no doc_values")
+
+    def normalize_term(self, value: Any) -> str:
+        terms = self.search_analyzer.terms(str(value))
+        return terms[0] if terms else ""
+
+    def search_terms(self, value: Any) -> List[str]:
+        return self.search_analyzer.terms(str(value))
+
+
+class KeywordFieldType(FieldType):
+    type_name = "keyword"
+    dv_kind = "ord"
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, params)
+        self.ignore_above = int(self.params.get("ignore_above", 2**31 - 1))
+        self._analyzer = KeywordAnalyzer()
+
+    def _norm(self, value: Any) -> str:
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        return str(value)
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        s = self._norm(value)
+        if len(s) > self.ignore_above:
+            return [], 0
+        return [s], 1
+
+    def doc_value(self, value: Any) -> str:
+        return self._norm(value)
+
+    def normalize_term(self, value: Any) -> str:
+        return self._norm(value)
+
+
+class NumberFieldType(FieldType):
+    """integer/long/short/byte/double/float — numeric terms + doc values.
+
+    Reference: NumberFieldMapper — numerics are indexed as points and
+    doc-values; term and range queries compare numerically. Here both paths
+    use the doc-value column; `index_terms` returns the canonical decimal
+    string so exact term queries work through postings too."""
+
+    INT_TYPES = {"long", "integer", "short", "byte"}
+    FLOAT_TYPES = {"double", "float", "half_float"}
+
+    def __init__(self, name: str, num_type: str, params: Optional[dict] = None):
+        if num_type not in self.INT_TYPES | self.FLOAT_TYPES:
+            raise IllegalArgumentException(f"unknown number type [{num_type}]")
+        self.type_name = num_type
+        self.dv_kind = "i64" if num_type in self.INT_TYPES else "f64"
+        super().__init__(name, params)
+
+    def _parse(self, value: Any):
+        if isinstance(value, bool):
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}] of type [{self.type_name}]: boolean"
+            )
+        try:
+            if self.dv_kind == "i64":
+                f = float(value)
+                i = int(f)
+                if f != i:
+                    raise ValueError(f"{value} is not an integer")
+                return i
+            return float(value)
+        except (TypeError, ValueError) as e:
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}] of type [{self.type_name}]: {value!r}"
+            ) from e
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [repr(self._parse(value))], 1
+
+    def doc_value(self, value: Any):
+        return self._parse(value)
+
+    def normalize_term(self, value: Any) -> str:
+        return repr(self._parse(value))
+
+    def normalize_range_bound(self, value: Any):
+        return self._parse(value)
+
+
+class DateFieldType(FieldType):
+    type_name = "date"
+    dv_kind = "i64"
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [repr(parse_date_millis(value))], 1
+
+    def doc_value(self, value: Any) -> int:
+        return parse_date_millis(value)
+
+    def normalize_term(self, value: Any) -> str:
+        return repr(parse_date_millis(value))
+
+    def normalize_range_bound(self, value: Any) -> int:
+        return parse_date_millis(value)
+
+
+class BooleanFieldType(FieldType):
+    type_name = "boolean"
+    dv_kind = "i64"
+
+    def _parse(self, value: Any) -> bool:
+        if isinstance(value, bool):
+            return value
+        s = str(value).lower()
+        if s == "true":
+            return True
+        if s in ("false", ""):
+            return False
+        raise MapperParsingException(f"failed to parse boolean [{value!r}] for [{self.name}]")
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return ["T" if self._parse(value) else "F"], 1
+
+    def doc_value(self, value: Any) -> int:
+        return 1 if self._parse(value) else 0
+
+    def normalize_term(self, value: Any) -> str:
+        return "T" if self._parse(value) else "F"
+
+    def normalize_range_bound(self, value: Any) -> int:
+        return 1 if self._parse(value) else 0
+
+
+def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
+    """Build a FieldType from one field's mapping JSON."""
+    t = mapping.get("type")
+    params = {k: v for k, v in mapping.items() if k not in ("type", "fields")}
+    analyzers = analyzers or {}
+    if t == "text":
+        an = analyzers.get(mapping.get("analyzer", "standard"))
+        san = analyzers.get(mapping.get("search_analyzer", mapping.get("analyzer", "standard")))
+        return TextFieldType(name, params, analyzer=an, search_analyzer=san)
+    if t == "keyword":
+        return KeywordFieldType(name, params)
+    if t in NumberFieldType.INT_TYPES | NumberFieldType.FLOAT_TYPES:
+        return NumberFieldType(name, t, params)
+    if t == "date":
+        return DateFieldType(name, params)
+    if t == "boolean":
+        return BooleanFieldType(name, params)
+    raise MapperParsingException(f"no handler for type [{t}] declared on field [{name}]")

@@ -1,0 +1,14 @@
+"""TPU kernels: BM25 scoring, boolean masks, top-k, SmallFloat norms.
+
+This package replaces the reference's L0 query-time kernels (SURVEY.md §1,
+§3.3): postings decode + intersection + BM25 + top-k become array programs.
+
+64-bit mode is enabled process-wide: doc-values columns are i64 (date
+millis and longs overflow i32) and postings offsets may exceed 2^31 on
+large shards. All hot-path arrays declare explicit narrow dtypes (f32/i32/
+u8), so this does not widen the scoring kernels.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

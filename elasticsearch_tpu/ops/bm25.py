@@ -1,0 +1,152 @@
+"""BM25 scoring + boolean-mask kernels (JAX/XLA).
+
+This module replaces the reference's per-segment hot loop (SURVEY.md §3.3:
+Weight#bulkScorer → postings decode → BM25Similarity$BM25Scorer#score →
+TopScoreDocCollector#collect) with batched array programs:
+
+  score_and_mask:   micro-batch of B queries × one segment pack → dense
+                    per-doc BM25 accumulators [B, D_pad] plus a per-doc
+                    term-presence bitmask [B, D_pad] (bit t set ⇔ query
+                    term-slot t matched the doc). Because a single term's
+                    postings list never repeats a doc, scatter-ADD of
+                    (1 << t) is an exact bitwise OR.
+  eval_bool_masks:  flat boolean algebra over the bitmask — must (AND over
+                    clauses, OR within), must_not, minimum_should_match —
+                    the ConjunctionDISI / BooleanScorer analog, evaluated
+                    densely instead of by doc-at-a-time leapfrog.
+  range_mask_*:     doc-values range filters (numeric/date).
+  topk:             TopScoreDocCollector analog via lax.top_k (ties break
+                    toward the smaller doc id, matching Lucene).
+
+Shapes are static per (T, L, D_pad) signature; the planner buckets query
+term counts and postings lengths so the jit cache stays small (SURVEY.md
+§7.3#1). The scoring formula is exactly Lucene's (§3.3):
+
+    idf(t) · (k1+1) · tf / (tf + k1·(1−b+b·dl/avgdl))
+
+with dl decoded from the SmallFloat4 norm byte via the 256-entry table and
+idf/avgdl computed from SHARD-level stats at query time (§7.3#2). The idf
+factor (and any query boost) arrives premultiplied per term slot.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "d_pad"))
+def score_and_mask(
+    flat_docs: jax.Array,    # int32[P_pad] postings doc ids, pad = d_pad
+    flat_tfs: jax.Array,     # int32[P_pad]
+    norms_u8: jax.Array,     # uint8[D_pad]
+    norm_cache: jax.Array,   # f32[256] = k1*(1-b+b*LENGTH_TABLE/avgdl)
+    starts: jax.Array,       # int32[B, T] row start offsets into flat arrays
+    lengths: jax.Array,      # int32[B, T] row lengths (0 = absent term)
+    idf_boost: jax.Array,    # f32[B, T]  idf * (k1+1) * boost; 0 ⇒ non-scoring slot
+    *,
+    max_len: int,            # static: padded postings length bucket
+    d_pad: int,              # static: padded doc-axis size
+) -> Tuple[jax.Array, jax.Array]:
+    """→ (scores f32[B, D_pad+1], termmask int32[B, D_pad+1]).
+
+    The +1 column is the scatter drop-slot for padded lanes; callers slice
+    it off (or keep it — topk over D_pad+1 with -inf there is also fine).
+    Sequential scan over term slots keeps peak memory at B×max_len instead
+    of B×T×max_len (stopword-scale postings would otherwise blow HBM)."""
+    b, t = starts.shape
+    norms_i32 = norms_u8.astype(jnp.int32)
+    idx = jnp.arange(max_len, dtype=jnp.int32)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+
+    def gather_one(s, ln):
+        # NOT dynamic_slice: it clamps out-of-bounds starts, which would
+        # silently read a neighboring term's postings when a row sits closer
+        # than max_len to the end of the flat array. OOB lanes fill with the
+        # drop sentinel instead.
+        pos = s + idx
+        docs = jnp.take(flat_docs, pos, mode="fill", fill_value=d_pad)
+        tfs = jnp.take(flat_tfs, pos, mode="fill", fill_value=0)
+        valid = idx < ln
+        return jnp.where(valid, docs, d_pad), jnp.where(valid, tfs, 0)
+
+    scores = jnp.zeros((b, d_pad + 1), dtype=jnp.float32)
+    mask = jnp.zeros((b, d_pad + 1), dtype=jnp.int32)
+
+    # unrolled python loop over T (T is small and static) — keeps each slot's
+    # presence bit a compile-time constant and bounds peak memory at B×max_len
+    for slot in range(t):
+        start, length, w = starts[:, slot], lengths[:, slot], idf_boost[:, slot]
+        docs, tfs = jax.vmap(gather_one)(start, length)       # [B, L]
+        # norm lookup: dl term of the BM25 denominator for each matched doc
+        safe_docs = jnp.minimum(docs, d_pad - 1)
+        denom_add = norm_cache[norms_i32[safe_docs]]          # [B, L]
+        tf = tfs.astype(jnp.float32)
+        impact = w[:, None] * tf / (tf + denom_add)           # [B, L]
+        impact = jnp.where(tfs > 0, impact, 0.0)
+        scores = scores.at[rows, docs].add(impact, mode="drop")
+        matched = jnp.where(tfs > 0, jnp.int32(1) << slot, 0)
+        mask = mask.at[rows, docs].add(matched, mode="drop")
+    return scores, mask
+
+
+@jax.jit
+def eval_bool_masks(
+    termmask: jax.Array,      # int32[B, D]
+    must_masks: jax.Array,    # int32[B, C]; 0 ⇒ neutral (always satisfied)
+    must_not_mask: jax.Array, # int32[B];   0 ⇒ nothing excluded
+    should_masks: jax.Array,  # int32[B, S]; 0 ⇒ ignored slot
+    min_should_match: jax.Array,  # int32[B]
+) -> jax.Array:
+    """Flat one-level boolean evaluation → bool[B, D] match mask.
+
+    must clause  : OR-of-terms (mask & clause) != 0, AND across clauses
+    must_not     : (mask & mnm) == 0
+    should       : count of matched should clauses >= min_should_match
+    Nested bools are evaluated recursively by the planner by combining the
+    masks this returns (SURVEY.md §7.3#7)."""
+    tm = termmask[:, None, :]                                  # [B, 1, D]
+    must = must_masks[:, :, None]                              # [B, C, 1]
+    must_ok = jnp.all(((tm & must) != 0) | (must == 0), axis=1)  # [B, D]
+    mn_ok = (termmask & must_not_mask[:, None]) == 0
+    should = should_masks[:, :, None]
+    should_hits = jnp.sum(((tm & should) != 0) & (should != 0), axis=1)
+    should_ok = should_hits >= min_should_match[:, None]
+    return must_ok & mn_ok & should_ok
+
+
+@jax.jit
+def range_mask_i64(col: jax.Array, lo: jax.Array, hi: jax.Array,
+                   include_missing_sentinel: bool = False) -> jax.Array:
+    """col i64[D]; lo/hi i64[B] → bool[B, D]. Missing sentinel (int64 min)
+    never matches because lo > sentinel for any real bound."""
+    return (col[None, :] >= lo[:, None]) & (col[None, :] <= hi[:, None])
+
+
+@jax.jit
+def range_mask_f64(col: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
+    ok = (col[None, :] >= lo[:, None]) & (col[None, :] <= hi[:, None])
+    return ok & ~jnp.isnan(col)[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk(scores: jax.Array, *, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k per query row with Lucene tie-breaking (equal scores → smaller
+    doc id wins). lax.top_k already returns the earliest index among equals,
+    which is exactly that order for a doc-ordinal axis."""
+    k = min(k, scores.shape[-1])
+    return jax.lax.top_k(scores, k)
+
+
+@jax.jit
+def mask_scores(scores: jax.Array, match: jax.Array,
+                live: jax.Array) -> jax.Array:
+    """Apply the boolean match mask + live-docs (tombstone) mask: docs that
+    fail either get -inf so they never surface in top-k."""
+    ok = match & live[None, :]
+    return jnp.where(ok, scores, NEG_INF)

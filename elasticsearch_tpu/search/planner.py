@@ -1,0 +1,335 @@
+"""Query planner/executor: DSL AST → kernel programs per segment.
+
+Reference analog: index/query/QueryShardContext#toQuery + the per-segment
+execution in search/query/QueryPhase#executeInternal (SURVEY.md §3.3). The
+reference walks postings doc-at-a-time through BooleanScorer/ConjunctionDISI;
+here every node of the query tree evaluates densely over the segment's
+padded doc axis:
+
+  node → (match_mask bool[d_pad], score f32[d_pad])
+
+with the invariant that `score` is already zeroed outside `match_mask`.
+Parent nodes combine children by mask algebra + score addition, which
+reproduces Lucene's boolean scoring semantics (sum of matched scoring
+clauses) without per-doc control flow — and makes nested conjunctive
+subtrees in should-context safe by construction (SURVEY.md §7.3#7).
+
+Scoring leaves launch one score_and_mask kernel per leaf (terms padded to
+power-of-two buckets to bound the jit cache, §7.3#1). Phrase verification
+is host-side over the candidate docs (postings positions live on host).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import QueryShardException
+from elasticsearch_tpu.index.reader import SegmentView, ShardReader
+from elasticsearch_tpu.index.segment import MISSING_I64
+from elasticsearch_tpu.mapping.types import (
+    FieldType,
+    KeywordFieldType,
+    NumberFieldType,
+    TextFieldType,
+)
+from elasticsearch_tpu.ops import bm25
+from elasticsearch_tpu.ops.smallfloat import bm25_norm_cache
+from elasticsearch_tpu.search import dsl
+
+MAX_SLOTS_PER_PASS = 32
+
+
+def _bucket(n: int, minimum: int = 1) -> int:
+    """Round up to a power of two (jit-cache bounding, SURVEY.md §7.3#1)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class SegmentQueryExecutor:
+    """Evaluates one parsed query against one segment view."""
+
+    def __init__(self, reader: ShardReader, view_idx: int):
+        self.reader = reader
+        self.view_idx = view_idx
+        self.view: SegmentView = reader.views[view_idx]
+        self.d_pad = self.view.pack.d_pad
+
+    # -------------- public --------------
+
+    def execute(self, node: dsl.QueryNode) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """→ (mask bool[d_pad], score f32[d_pad]); score zero off-mask."""
+        return self._eval(node, scoring=True)
+
+    # -------------- recursive eval --------------
+
+    def _eval(self, node: dsl.QueryNode, scoring: bool):
+        if isinstance(node, dsl.MatchAllQuery):
+            mask = jnp.ones(self.d_pad, dtype=bool)
+            score = jnp.full(self.d_pad, node.boost if scoring else 0.0,
+                             dtype=jnp.float32)
+            return mask, score
+        if isinstance(node, dsl.MatchQuery):
+            return self._eval_match(node, scoring)
+        if isinstance(node, dsl.TermQuery):
+            return self._eval_terms(node.field, [node.value], node.boost,
+                                    scoring, "or", 1)
+        if isinstance(node, dsl.TermsQuery):
+            return self._eval_terms(node.field, node.values, node.boost,
+                                    scoring, "or", 1)
+        if isinstance(node, dsl.RangeQuery):
+            return self._eval_range(node)
+        if isinstance(node, dsl.ExistsQuery):
+            mask = jnp.asarray(self.reader.has_field_mask(self.view_idx, node.field))
+            return mask, jnp.where(mask, node.boost if scoring else 0.0, 0.0).astype(jnp.float32)
+        if isinstance(node, dsl.IdsQuery):
+            mask = jnp.asarray(self.reader.resolve_ids(self.view_idx, node.values))
+            return mask, jnp.where(mask, node.boost if scoring else 0.0, 0.0).astype(jnp.float32)
+        if isinstance(node, dsl.MatchPhraseQuery):
+            return self._eval_phrase(node, scoring)
+        if isinstance(node, dsl.ConstantScoreQuery):
+            mask, _ = self._eval(node.filter_query, scoring=False)
+            return mask, jnp.where(mask, node.boost if scoring else 0.0, 0.0).astype(jnp.float32)
+        if isinstance(node, dsl.BoolQuery):
+            return self._eval_bool(node, scoring)
+        raise QueryShardException(f"unsupported query [{node.query_name()}]")
+
+    def _eval_bool(self, node: dsl.BoolQuery, scoring: bool):
+        mask = jnp.ones(self.d_pad, dtype=bool)
+        score = jnp.zeros(self.d_pad, dtype=jnp.float32)
+        for child in node.must:
+            cmask, cscore = self._eval(child, scoring)
+            mask = mask & cmask
+            score = score + cscore
+        for child in node.filter:
+            cmask, _ = self._eval(child, scoring=False)
+            mask = mask & cmask
+        for child in node.must_not:
+            cmask, _ = self._eval(child, scoring=False)
+            mask = mask & ~cmask
+        if node.should:
+            msm = node.minimum_should_match
+            if msm is None:
+                # the reference default: 1 when there is nothing mandatory,
+                # else 0 (should becomes purely score-boosting)
+                msm = 0 if (node.must or node.filter) else 1
+            count = jnp.zeros(self.d_pad, dtype=jnp.int32)
+            for child in node.should:
+                cmask, cscore = self._eval(child, scoring)
+                count = count + cmask.astype(jnp.int32)
+                score = score + cscore
+            if msm > 0:
+                mask = mask & (count >= msm)
+        score = jnp.where(mask, score * node.boost, 0.0)
+        return mask, score
+
+    # -------------- leaves --------------
+
+    def _field_type(self, field: str) -> FieldType:
+        ft = self.reader.mapper.field_type(field)
+        if ft is None:
+            # unmapped fields match nothing (reference: unmapped term queries
+            # return MatchNoDocsQuery under lenient resolution)
+            raise _UnmappedField(field)
+        return ft
+
+    def _eval_match(self, node: dsl.MatchQuery, scoring: bool):
+        try:
+            ft = self._field_type(node.field)
+        except _UnmappedField:
+            return self._none()
+        if isinstance(ft, TextFieldType):
+            terms = ft.search_terms(node.query)
+        else:
+            # match on keyword/numeric behaves like a term query
+            terms = [ft.normalize_term(node.query)]
+        if not terms:
+            return self._none()
+        msm = 1 if node.operator == "or" else len(terms)
+        if node.minimum_should_match is not None and node.operator == "or":
+            msm = node.minimum_should_match
+        return self._eval_terms(node.field, terms, node.boost, scoring,
+                                node.operator, msm, pre_analyzed=True)
+
+    def _eval_terms(self, field: str, values: Sequence, boost: float,
+                    scoring: bool, operator: str, msm: int,
+                    pre_analyzed: bool = False):
+        try:
+            ft = self._field_type(field)
+        except _UnmappedField:
+            return self._none()
+        if pre_analyzed:
+            terms = [str(v) for v in values]
+        elif isinstance(ft, TextFieldType):
+            # term/terms queries are NOT analyzed (reference: TermQueryBuilder
+            # compares raw bytes even on text fields)
+            terms = [str(v) for v in values]
+        else:
+            terms = [ft.normalize_term(v) for v in values]
+        fp = self.view.pack.fields.get(field)
+        if fp is None:
+            return self._none()
+        k1, b = self.reader.k1, self.reader.b
+        doc_count, avgdl = self.reader.field_stats(field)
+        cache = bm25_norm_cache(k1, b, avgdl)
+
+        total_mask = None
+        total_count = jnp.zeros(self.d_pad, dtype=jnp.int32)
+        total_score = jnp.zeros(self.d_pad, dtype=jnp.float32)
+        # chunk terms into ≤32-slot kernel passes
+        for chunk_start in range(0, len(terms), MAX_SLOTS_PER_PASS):
+            chunk = terms[chunk_start: chunk_start + MAX_SLOTS_PER_PASS]
+            t_pad = _bucket(len(chunk))
+            starts = np.zeros((1, t_pad), dtype=np.int32)
+            lengths = np.zeros((1, t_pad), dtype=np.int32)
+            idf_boost = np.zeros((1, t_pad), dtype=np.float32)
+            max_len = 1
+            for t, term in enumerate(chunk):
+                row = fp.term_row(term)
+                s, ln = fp.row_slice(row)
+                df = self.reader.doc_freq(field, term)
+                starts[0, t], lengths[0, t] = s, ln
+                if scoring and df > 0:
+                    idf = math.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+                    idf_boost[0, t] = boost * idf * (k1 + 1.0)
+                max_len = max(max_len, ln)
+            max_len = _bucket(max_len, 128)
+            scores, termmask = bm25.score_and_mask(
+                jnp.asarray(fp.flat_docs), jnp.asarray(fp.flat_tfs),
+                jnp.asarray(fp.norms_u8), jnp.asarray(cache),
+                jnp.asarray(starts), jnp.asarray(lengths), jnp.asarray(idf_boost),
+                max_len=max_len, d_pad=self.d_pad)
+            tm = termmask[0, : self.d_pad]
+            total_score = total_score + scores[0, : self.d_pad]
+            # per-slot presence → per-chunk match count
+            bits = jnp.asarray([1 << t for t in range(len(chunk))], dtype=jnp.int32)
+            present = (tm[None, :] & bits[:, None]) != 0
+            total_count = total_count + jnp.sum(present, axis=0).astype(jnp.int32)
+        if operator == "and":
+            mask = total_count >= len(terms)
+        else:
+            mask = total_count >= max(1, msm)
+        score = jnp.where(mask, total_score, 0.0)
+        return mask, score
+
+    def _eval_range(self, node: dsl.RangeQuery):
+        try:
+            ft = self._field_type(node.field)
+        except _UnmappedField:
+            return self._none()
+        if isinstance(ft, (TextFieldType, KeywordFieldType)):
+            raise QueryShardException(
+                f"range query on [{ft.type_name}] field [{node.field}] is not supported")
+        lo_raw = node.gte if node.gte is not None else node.gt
+        hi_raw = node.lte if node.lte is not None else node.lt
+        pack = self.view.pack
+        if node.field in pack.dv_i64:
+            col = pack.dv_i64[node.field]
+            lo = -(2**62) if lo_raw is None else int(ft.normalize_range_bound(lo_raw))
+            hi = 2**62 if hi_raw is None else int(ft.normalize_range_bound(hi_raw))
+            if node.gt is not None and node.gte is None:
+                lo += 1
+            if node.lt is not None and node.lte is None:
+                hi -= 1
+            mask = bm25.range_mask_i64(
+                jnp.asarray(col), jnp.asarray([lo], dtype=jnp.int64),
+                jnp.asarray([hi], dtype=jnp.int64))[0]
+        elif node.field in pack.dv_f64:
+            col = pack.dv_f64[node.field]
+            lo = -np.inf if lo_raw is None else float(ft.normalize_range_bound(lo_raw))
+            hi = np.inf if hi_raw is None else float(ft.normalize_range_bound(hi_raw))
+            mask = bm25.range_mask_f64(
+                jnp.asarray(col), jnp.asarray([lo], dtype=jnp.float64),
+                jnp.asarray([hi], dtype=jnp.float64))[0]
+            if node.gt is not None and node.gte is None:
+                mask = mask & (jnp.asarray(col) != lo)
+            if node.lt is not None and node.lte is None:
+                mask = mask & (jnp.asarray(col) != hi)
+        else:
+            return self._none()
+        # constant_score semantics: ranges don't score (reference wraps range
+        # in filter context scoring = 1*boost when in scoring context)
+        score = jnp.where(mask, jnp.float32(node.boost), 0.0).astype(jnp.float32)
+        return mask, score
+
+    def _eval_phrase(self, node: dsl.MatchPhraseQuery, scoring: bool):
+        try:
+            ft = self._field_type(node.field)
+        except _UnmappedField:
+            return self._none()
+        if not isinstance(ft, TextFieldType):
+            return self._eval_terms(node.field, [node.query], node.boost,
+                                    scoring, "and", 1)
+        terms = ft.search_terms(node.query)
+        if not terms:
+            return self._none()
+        seg = self.view.segment
+        positions = seg.positions.get(node.field, {})
+        # candidates: docs containing all terms (host intersection over the
+        # postings — phrase verification is host-side round 1)
+        doc_sets = []
+        for t in terms:
+            entry = seg.postings.get(node.field, {}).get(t)
+            if entry is None:
+                return self._none()
+            doc_sets.append(set(int(d) for d in entry[0]))
+        candidates = sorted(set.intersection(*doc_sets))
+        if not candidates:
+            return self._none()
+        k1, b = self.reader.k1, self.reader.b
+        doc_count, avgdl = self.reader.field_stats(node.field)
+        dfs = [self.reader.doc_freq(node.field, t) for t in terms]
+        idf_sum = sum(math.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+                      for df in dfs if df > 0)
+        from elasticsearch_tpu.ops.smallfloat import LENGTH_TABLE
+        mask = np.zeros(self.d_pad, dtype=bool)
+        score = np.zeros(self.d_pad, dtype=np.float32)
+        for d in candidates:
+            plists = [positions.get(t, {}).get(d) for t in terms]
+            if any(p is None for p in plists):
+                continue
+            freq = _phrase_freq(plists, node.slop)
+            if freq <= 0:
+                continue
+            mask[d] = True
+            if scoring:
+                dl = float(LENGTH_TABLE[seg.norms[node.field][d]])
+                denom = freq + k1 * (1 - b + b * dl / (avgdl or 1.0))
+                score[d] = node.boost * idf_sum * (k1 + 1.0) * freq / denom
+        return jnp.asarray(mask), jnp.asarray(score)
+
+    def _none(self):
+        return (jnp.zeros(self.d_pad, dtype=bool),
+                jnp.zeros(self.d_pad, dtype=jnp.float32))
+
+
+class _UnmappedField(Exception):
+    def __init__(self, field: str):
+        self.field = field
+
+
+def _phrase_freq(plists: List[np.ndarray], slop: int) -> int:
+    """Exact phrase count (slop=0): positions p_i with p_i = p_0 + i.
+    For slop>0 uses a simple window check (approximation of sloppy freq)."""
+    first = plists[0]
+    count = 0
+    for p0 in first:
+        ok = True
+        for i, pl in enumerate(plists[1:], start=1):
+            target = p0 + i
+            if slop == 0:
+                if target not in pl:
+                    ok = False
+                    break
+            else:
+                if not ((np.abs(pl - target) <= slop).any()):
+                    ok = False
+                    break
+        if ok:
+            count += 1
+    return count

@@ -1,0 +1,113 @@
+"""Query + fetch phases for one shard.
+
+Reference: search/query/QueryPhase#executeInternal and
+search/fetch/FetchPhase#execute (SURVEY.md §2.1#36, §3.3). The query phase
+returns doc refs + scores only (no _source); the fetch phase resolves the
+winners' stored fields — same two-phase contract as the reference so the
+coordinator can fan out fetch to winning shards only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.index.reader import ShardReader
+from elasticsearch_tpu.ops import bm25
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.planner import SegmentQueryExecutor
+
+
+@dataclasses.dataclass
+class ShardDocRef:
+    segment: str
+    ord: int
+
+
+@dataclasses.dataclass
+class ShardHit:
+    doc_id: str
+    score: float
+    ref: ShardDocRef
+
+
+@dataclasses.dataclass
+class QuerySearchResult:
+    """Per-shard query-phase result (the QuerySearchResult analog):
+    top-k (doc ref, score) and total hits — no _source yet."""
+    hits: List[ShardHit]
+    total_hits: int
+    max_score: Optional[float]
+
+
+def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
+                  size: int = 10, from_: int = 0,
+                  min_score: Optional[float] = None) -> QuerySearchResult:
+    k = size + from_
+    per_segment: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    total = 0
+    for idx, view in enumerate(reader.views):
+        executor = SegmentQueryExecutor(reader, idx)
+        mask, score = executor.execute(query)
+        live = jnp.asarray(view.live_mask)
+        final = bm25.mask_scores(score[None, :], mask[None, :], live)[0]
+        total += int(jnp.sum(mask & live))
+        if k > 0:
+            vals, idxs = bm25.topk(final[None, :], k=min(k, view.pack.d_pad))
+            per_segment.append((idx, np.asarray(vals[0]), np.asarray(idxs[0])))
+    # merge across segments: (score desc, segment ord asc, doc ord asc) —
+    # the reference's tie-break order across leaf readers
+    merged: List[Tuple[float, int, int]] = []
+    for seg_idx, vals, idxs in per_segment:
+        for v, d in zip(vals, idxs):
+            if v == float("-inf"):
+                continue
+            if min_score is not None and v < min_score:
+                continue
+            merged.append((float(v), seg_idx, int(d)))
+    merged.sort(key=lambda t: (-t[0], t[1], t[2]))
+    window = merged[from_: from_ + size] if size > 0 else []
+    hits = []
+    for score, seg_idx, ord_ in window:
+        seg = reader.views[seg_idx].segment
+        hits.append(ShardHit(seg.doc_ids[ord_], score, ShardDocRef(seg.name, ord_)))
+    max_score = merged[0][0] if merged else None
+    return QuerySearchResult(hits, total, max_score)
+
+
+def execute_fetch(reader: ShardReader, hits: List[ShardHit],
+                  source: Any = True) -> List[Dict[str, Any]]:
+    """Fetch phase: resolve _source for winning docs.
+
+    `source`: True | False | list of field-name prefixes (the _source
+    filtering contract of the reference's fetch sub-phases)."""
+    by_name = {v.segment.name: v.segment for v in reader.views}
+    out = []
+    for hit in hits:
+        seg = by_name.get(hit.ref.segment)
+        doc: Dict[str, Any] = {"_id": hit.doc_id, "_score": hit.score}
+        if seg is not None and source is not False:
+            src = seg.stored_source[hit.ref.ord]
+            if isinstance(source, (list, tuple)):
+                src = _filter_source(src or {}, list(source))
+            doc["_source"] = src
+        out.append(doc)
+    return out
+
+
+def _filter_source(src: Dict[str, Any], includes: List[str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, value in src.items():
+        for inc in includes:
+            if key == inc or inc.startswith(key + ".") or key.startswith(inc + "."):
+                if isinstance(value, dict) and inc.startswith(key + "."):
+                    sub = _filter_source(value, [inc[len(key) + 1:]])
+                    if sub:
+                        out[key] = sub
+                else:
+                    out[key] = value
+                break
+    return out

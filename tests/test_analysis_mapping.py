@@ -1,0 +1,172 @@
+"""Analysis + mapping tests (SURVEY §4.1 unit tier; golden analyzer behavior)."""
+
+import pytest
+
+from elasticsearch_tpu.analysis import (
+    AnalysisRegistry,
+    KeywordAnalyzer,
+    SimpleAnalyzer,
+    StandardAnalyzer,
+    StopAnalyzer,
+    WhitespaceAnalyzer,
+)
+from elasticsearch_tpu.common.errors import MapperParsingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.mapping import MapperService, parse_date_millis
+
+
+class TestAnalyzers:
+    def test_standard_golden(self):
+        a = StandardAnalyzer()
+        assert a.terms("The Quick-Brown FOX, jumped!") == [
+            "the", "quick", "brown", "fox", "jumped",
+        ]
+        # apostrophes and interior dots stay in the token
+        assert a.terms("O'Neil's 3.5 visits") == ["o'neil's", "3.5", "visits"]
+
+    def test_simple_drops_digits(self):
+        assert SimpleAnalyzer().terms("abc123def 45") == ["abc", "def"]
+
+    def test_whitespace_no_lowercase(self):
+        assert WhitespaceAnalyzer().terms("Foo  BAR") == ["Foo", "BAR"]
+
+    def test_keyword_single_token(self):
+        assert KeywordAnalyzer().terms("New York") == ["New York"]
+
+    def test_stop_positions_have_holes(self):
+        tokens = StopAnalyzer().analyze("the quick fox")
+        assert [(t.term, t.position) for t in tokens] == [("quick", 1), ("fox", 2)]
+
+    def test_registry_custom_analyzer(self):
+        settings = Settings.of({
+            "index.analysis.analyzer.my.type": "custom",
+            "index.analysis.analyzer.my.tokenizer": "whitespace",
+            "index.analysis.analyzer.my.filter": ["lowercase", "stop"],
+        })
+        analyzers = AnalysisRegistry().build(settings)
+        assert analyzers["my"].terms("The Quick FOX") == ["quick", "fox"]
+        assert "standard" in analyzers
+
+    def test_registry_standard_with_stopwords(self):
+        settings = Settings.of({
+            "index.analysis.analyzer.eng.type": "standard",
+            "index.analysis.analyzer.eng.stopwords": "_english_",
+        })
+        analyzers = AnalysisRegistry().build(settings)
+        assert analyzers["eng"].terms("the fox and hound") == ["fox", "hound"]
+
+    def test_max_token_length_splits(self):
+        a = StandardAnalyzer(max_token_length=5)
+        assert a.terms("abcdefghij") == ["abcde", "fghij"]
+
+
+class TestDates:
+    def test_epoch_millis(self):
+        assert parse_date_millis(1700000000000) == 1700000000000
+        assert parse_date_millis("1700000000000") == 1700000000000
+
+    def test_iso(self):
+        assert parse_date_millis("1970-01-01T00:00:00Z") == 0
+        assert parse_date_millis("1970-01-02") == 86400000
+        assert parse_date_millis("1970-01-01T01:00:00+01:00") == 0
+
+    def test_bad_date(self):
+        with pytest.raises(MapperParsingException):
+            parse_date_millis("not a date")
+
+
+class TestMapperService:
+    def make(self, mapping=None):
+        return MapperService(Settings.EMPTY, mapping)
+
+    def test_explicit_mapping_parse(self):
+        ms = self.make({"properties": {
+            "title": {"type": "text"},
+            "tags": {"type": "keyword"},
+            "views": {"type": "long"},
+            "published": {"type": "date"},
+            "active": {"type": "boolean"},
+        }})
+        doc = ms.parse_document("1", {
+            "title": "Hello World hello",
+            "tags": ["a", "b"],
+            "views": 42,
+            "published": "2024-01-01",
+            "active": True,
+        })
+        assert doc.postings_terms["title"] == ["hello", "world", "hello"]
+        assert doc.field_lengths["title"] == 3
+        assert doc.postings_terms["tags"] == ["a", "b"]
+        assert doc.doc_values["views"] == 42
+        assert isinstance(doc.doc_values["published"], int)
+        assert doc.doc_values["active"] == 1
+
+    def test_dynamic_mapping_string_gets_keyword_subfield(self):
+        ms = self.make()
+        doc = ms.parse_document("1", {"name": "Alice Smith"})
+        assert ms.field_type("name").type_name == "text"
+        assert ms.field_type("name.keyword").type_name == "keyword"
+        assert doc.postings_terms["name"] == ["alice", "smith"]
+        assert doc.postings_terms["name.keyword"] == ["Alice Smith"]
+        assert doc.doc_values["name.keyword"] == "Alice Smith"
+
+    def test_dynamic_numbers_bools_dates(self):
+        ms = self.make()
+        ms.parse_document("1", {"n": 3, "f": 1.5, "b": False, "d": "2024-05-05T10:00:00Z"})
+        assert ms.field_type("n").type_name == "long"
+        assert ms.field_type("f").type_name == "double"
+        assert ms.field_type("b").type_name == "boolean"
+        assert ms.field_type("d").type_name == "date"
+
+    def test_objects_flatten(self):
+        ms = self.make()
+        doc = ms.parse_document("1", {"user": {"name": "bob", "age": 7}})
+        assert ms.field_type("user.name").type_name == "text"
+        assert doc.doc_values["user.age"] == 7
+
+    def test_dynamic_strict_rejects(self):
+        ms = self.make({"dynamic": "strict", "properties": {"a": {"type": "keyword"}}})
+        with pytest.raises(MapperParsingException):
+            ms.parse_document("1", {"b": "nope"})
+
+    def test_dynamic_false_ignores(self):
+        ms = self.make({"dynamic": "false", "properties": {"a": {"type": "keyword"}}})
+        doc = ms.parse_document("1", {"a": "x", "b": "skipped"})
+        assert "b" not in doc.postings_terms
+        assert ms.field_type("b") is None
+
+    def test_merge_conflict(self):
+        ms = self.make({"properties": {"a": {"type": "keyword"}}})
+        with pytest.raises(MapperParsingException):
+            ms.merge({"properties": {"a": {"type": "long"}}})
+
+    def test_type_errors(self):
+        ms = self.make({"properties": {"n": {"type": "long"}}})
+        with pytest.raises(MapperParsingException):
+            ms.parse_document("1", {"n": "not-a-number"})
+        with pytest.raises(MapperParsingException):
+            ms.parse_document("2", {"_id": "nope"})
+
+    def test_array_text_position_gap(self):
+        ms = self.make({"properties": {"t": {"type": "text"}}})
+        doc = ms.parse_document("1", {"t": ["one two", "three"]})
+        positions = dict(doc.positions["t"])
+        assert positions["one"] == 0
+        assert positions["two"] == 1
+        assert positions["three"] == 102  # 100-position array gap
+
+    def test_mapping_roundtrip_render(self):
+        mapping = {"properties": {
+            "title": {"type": "text"},
+            "user": {"properties": {"name": {"type": "keyword"}}},
+        }}
+        ms = self.make(mapping)
+        rendered = ms.mapper.to_mapping()
+        assert rendered["properties"]["title"]["type"] == "text"
+        assert rendered["properties"]["user"]["properties"]["name"]["type"] == "keyword"
+
+    def test_ignore_above(self):
+        ms = self.make({"properties": {"k": {"type": "keyword", "ignore_above": 3}}})
+        doc = ms.parse_document("1", {"k": "toolong"})
+        assert doc.postings_terms.get("k", []) == []
+        assert doc.doc_values["k"] == "toolong"  # doc value still stored

@@ -1,0 +1,216 @@
+"""BM25 kernel parity tests: JAX kernels vs the exact numpy oracle.
+
+Mirrors the reference's AggregatorTestCase/QueryPhaseTests pattern
+(SURVEY.md §4.1): build a random corpus, score it both ways, diff.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.pack import build_segment_pack
+from elasticsearch_tpu.index.segment import SegmentWriter, merge_segments
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.ops import bm25, reference_impl, smallfloat
+
+VOCAB = [f"w{i}" for i in range(50)]
+
+
+def make_segment(rng, n_docs, name="seg0", mapper=None):
+    ms = mapper or MapperService(Settings.EMPTY, {"properties": {"body": {"type": "text"}}})
+    w = SegmentWriter(name)
+    for i in range(n_docs):
+        n_tokens = rng.integers(1, 30)
+        # zipf-flavored term choice
+        words = [VOCAB[min(int(rng.zipf(1.3)) - 1, len(VOCAB) - 1)] for _ in range(n_tokens)]
+        doc = ms.parse_document(f"{name}-d{i}", {"body": " ".join(words)})
+        w.add_document(doc, {})
+    return w.freeze()
+
+
+class TestSmallFloat:
+    def test_byte4_roundtrip_small_exact(self):
+        for i in range(40):
+            assert smallfloat.byte4_to_int(smallfloat.int_to_byte4(i)) <= i
+        for i in range(8):  # subnormals are exact
+            assert smallfloat.byte4_to_int(smallfloat.int_to_byte4(i)) == i
+
+    def test_byte4_monotone(self):
+        prev = -1
+        for i in [0, 1, 3, 7, 8, 15, 16, 40, 100, 255, 1000, 10**6]:
+            enc = smallfloat.int_to_byte4(i)
+            assert enc >= prev
+            prev = enc
+            assert smallfloat.byte4_to_int(enc) <= i
+
+    def test_known_values(self):
+        # values with <4 bits store verbatim
+        assert smallfloat.int_to_byte4(7) == 7
+        # 8 = 0b1000: shift=1, mantissa 0b000 → (0|0x08)<<1 = 16 decodes
+        enc = smallfloat.int_to_byte4(8)
+        assert smallfloat.byte4_to_int(enc) == 8
+        # lossiness kicks in above 4 significant bits
+        assert smallfloat.byte4_to_int(smallfloat.int_to_byte4(1000)) == 960
+
+    def test_idf_formula(self):
+        v = smallfloat.idf(np.array([1]), 2)
+        assert v[0] == pytest.approx(np.log(1 + (2 - 1 + 0.5) / 1.5), rel=1e-6)
+
+
+class TestScoreParity:
+    @pytest.mark.parametrize("n_docs", [17, 300])
+    def test_single_segment_match_parity(self, seeded_np, n_docs):
+        seg = make_segment(seeded_np, n_docs)
+        pack = build_segment_pack(seg)
+        fp = pack.fields["body"]
+        terms = ["w0", "w1", "w5"]
+        k1, b = 1.2, 0.75
+
+        ref_scores = reference_impl.score_match_query([seg], "body", terms, k1, b)[0]
+
+        doc_count, avgdl = reference_impl.shard_stats([seg], "body")
+        cache = smallfloat.bm25_norm_cache(k1, b, avgdl)
+        T = 4  # padded term count
+        starts = np.zeros((1, T), dtype=np.int32)
+        lengths = np.zeros((1, T), dtype=np.int32)
+        idf_boost = np.zeros((1, T), dtype=np.float32)
+        max_len = 1
+        for t, term in enumerate(terms):
+            row = fp.term_row(term)
+            s, ln = fp.row_slice(row)
+            df = reference_impl.shard_doc_freq([seg], "body", term)
+            starts[0, t], lengths[0, t] = s, ln
+            idf_boost[0, t] = reference_impl.bm25_idf(doc_count, df) * (k1 + 1) if df else 0.0
+            max_len = max(max_len, ln)
+
+        scores, mask = bm25.score_and_mask(
+            jnp.asarray(fp.flat_docs), jnp.asarray(fp.flat_tfs),
+            jnp.asarray(fp.norms_u8), jnp.asarray(cache),
+            jnp.asarray(starts), jnp.asarray(lengths), jnp.asarray(idf_boost),
+            max_len=int(max_len), d_pad=fp.d_pad)
+        got = np.asarray(scores)[0, : seg.num_docs]
+        np.testing.assert_allclose(got, ref_scores, rtol=2e-5, atol=1e-6)
+
+        # termmask bit t set exactly for docs containing term t
+        m = np.asarray(mask)[0, : seg.num_docs]
+        for t, term in enumerate(terms):
+            entry = seg.postings["body"].get(term)
+            expect = np.zeros(seg.num_docs, dtype=bool)
+            if entry is not None:
+                expect[entry[0]] = True
+            np.testing.assert_array_equal((m & (1 << t)) != 0, expect)
+
+    def test_multi_segment_shard_stats(self, seeded_np):
+        """idf/avgdl must come from SHARD-level stats across segments."""
+        seg1 = make_segment(seeded_np, 40, "s1")
+        seg2 = make_segment(seeded_np, 60, "s2")
+        merged = merge_segments("m", [seg1, seg2])
+        terms = ["w0", "w2"]
+        # scoring the merged segment must equal scoring per-segment with
+        # shard stats (same docs, same stats)
+        ref_split = reference_impl.score_match_query([seg1, seg2], "body", terms)
+        ref_merged = reference_impl.score_match_query([merged], "body", terms)[0]
+        combined = np.concatenate(ref_split)
+        np.testing.assert_allclose(combined, ref_merged, rtol=1e-6)
+
+    def test_topk_tie_break(self):
+        scores = jnp.asarray([[1.0, 3.0, 3.0, 2.0]])
+        vals, idxs = bm25.topk(scores, k=3)
+        assert list(np.asarray(idxs)[0]) == [1, 2, 3]  # tie 3.0: smaller doc first
+
+    def test_bool_mask_eval(self):
+        # term bits: t0=1, t1=2, t2=4
+        termmask = jnp.asarray([[1, 3, 6, 0, 7]], dtype=jnp.int32)
+        must = jnp.asarray([[1, 2]], dtype=jnp.int32)  # needs bit0 AND bit1
+        mnm = jnp.asarray([4], dtype=jnp.int32)        # excludes bit2
+        should = jnp.zeros((1, 1), dtype=jnp.int32)
+        msm = jnp.zeros(1, dtype=jnp.int32)
+        got = np.asarray(bm25.eval_bool_masks(termmask, must, mnm, should, msm))[0]
+        #        doc0: only bit0 → fails must bit1
+        #        doc1: bits0+1 → pass; doc2: bits1+2 → fails must0 & excluded
+        #        doc3: none → fail; doc4: all bits → excluded by must_not
+        assert list(got) == [False, True, False, False, False]
+
+    def test_min_should_match(self):
+        termmask = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+        must = jnp.zeros((1, 1), dtype=jnp.int32)
+        mnm = jnp.zeros(1, dtype=jnp.int32)
+        should = jnp.asarray([[1, 2]], dtype=jnp.int32)
+        msm = jnp.asarray([2], dtype=jnp.int32)
+        got = np.asarray(bm25.eval_bool_masks(termmask, must, mnm, should, msm))[0]
+        assert list(got) == [False, False, True]
+
+    def test_range_masks(self):
+        col = jnp.asarray([5, 10, 15, -(2**63)], dtype=jnp.int64)
+        got = np.asarray(bm25.range_mask_i64(
+            col, jnp.asarray([6], dtype=jnp.int64), jnp.asarray([15], dtype=jnp.int64)))[0]
+        assert list(got) == [False, True, True, False]
+
+    def test_batched_queries(self, seeded_np):
+        """Two different queries in one micro-batch score independently."""
+        seg = make_segment(seeded_np, 100)
+        pack = build_segment_pack(seg)
+        fp = pack.fields["body"]
+        k1, b = 1.2, 0.75
+        doc_count, avgdl = reference_impl.shard_stats([seg], "body")
+        cache = smallfloat.bm25_norm_cache(k1, b, avgdl)
+
+        queries = [["w0"], ["w3", "w7"]]
+        T = 2
+        B = len(queries)
+        starts = np.zeros((B, T), dtype=np.int32)
+        lengths = np.zeros((B, T), dtype=np.int32)
+        idf_boost = np.zeros((B, T), dtype=np.float32)
+        max_len = 1
+        for qi, terms in enumerate(queries):
+            for t, term in enumerate(terms):
+                row = fp.term_row(term)
+                s, ln = fp.row_slice(row)
+                df = reference_impl.shard_doc_freq([seg], "body", term)
+                starts[qi, t], lengths[qi, t] = s, ln
+                idf_boost[qi, t] = (
+                    reference_impl.bm25_idf(doc_count, df) * (k1 + 1) if df else 0.0)
+                max_len = max(max_len, ln)
+
+        scores, _ = bm25.score_and_mask(
+            jnp.asarray(fp.flat_docs), jnp.asarray(fp.flat_tfs),
+            jnp.asarray(fp.norms_u8), jnp.asarray(cache),
+            jnp.asarray(starts), jnp.asarray(lengths), jnp.asarray(idf_boost),
+            max_len=int(max_len), d_pad=fp.d_pad)
+        for qi, terms in enumerate(queries):
+            ref = reference_impl.score_match_query([seg], "body", terms, k1, b)[0]
+            np.testing.assert_allclose(
+                np.asarray(scores)[qi, : seg.num_docs], ref, rtol=2e-5, atol=1e-6)
+
+
+class TestSegmentModel:
+    def test_merge_with_tombstones(self, seeded_np):
+        seg1 = make_segment(seeded_np, 30, "s1")
+        seg2 = make_segment(seeded_np, 20, "s2")
+        live1 = np.ones(30, dtype=bool)
+        live1[[3, 7]] = False
+        merged = merge_segments("m", [seg1, seg2], [live1, None])
+        assert merged.num_docs == 48
+        assert "s1-d3" not in merged.id_to_ord
+        assert "s2-d3" in merged.id_to_ord
+        assert merged.id_to_ord["s1-d0"] == 0
+        # stats exclude dropped docs
+        total_len = merged.field_stats["body"].sum_total_term_freq
+        assert total_len > 0
+        # postings stay doc-sorted
+        for term, (docs, _) in merged.postings["body"].items():
+            assert (np.diff(docs) > 0).all(), term
+
+    def test_pack_padding(self, seeded_np):
+        seg = make_segment(seeded_np, 100, "s")
+        pack = build_segment_pack(seg)
+        fp = pack.fields["body"]
+        assert fp.d_pad % 128 == 0
+        assert len(fp.flat_docs) % 128 == 0
+        # padded tail points at the drop slot
+        total = int(fp.row_start[-1])
+        assert (fp.flat_docs[total:] == fp.d_pad).all()
+        assert pack.live_mask[: seg.num_docs].all()
+        assert not pack.live_mask[seg.num_docs:].any()

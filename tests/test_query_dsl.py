@@ -1,0 +1,239 @@
+"""Query DSL parse + end-to-end shard search semantics tests.
+
+Mirrors the reference's AbstractQueryTestCase (parse round-trips/errors)
+and QueryPhaseTests (execution against a real segment) — SURVEY.md §4.1/4.3.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingException, QueryShardException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.reader import ShardReader
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.ops import reference_impl
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.query_phase import execute_fetch, execute_query
+
+MAPPING = {"properties": {
+    "title": {"type": "text"},
+    "body": {"type": "text"},
+    "tags": {"type": "keyword"},
+    "views": {"type": "long"},
+    "price": {"type": "double"},
+    "published": {"type": "date"},
+    "active": {"type": "boolean"},
+}}
+
+DOCS = [
+    {"title": "quick brown fox", "body": "the quick brown fox jumps over the lazy dog",
+     "tags": ["animal", "story"], "views": 100, "price": 9.99,
+     "published": "2024-01-01", "active": True},
+    {"title": "lazy dog", "body": "a lazy dog sleeps all day, the dog is very lazy",
+     "tags": ["animal"], "views": 50, "price": 5.0,
+     "published": "2024-02-01", "active": False},
+    {"title": "brown bear", "body": "brown bears eat fish in the river",
+     "tags": ["animal", "wild"], "views": 200, "price": 20.0,
+     "published": "2024-03-01", "active": True},
+    {"title": "stock market", "body": "the stock market rallied as tech stocks jumped",
+     "tags": ["finance"], "views": 1000, "price": 0.5,
+     "published": "2023-12-01", "active": True},
+    {"title": "fox hunting ban", "body": "the ban on fox hunting divided the countryside",
+     "tags": ["politics"], "views": 10, "price": 3.5,
+     "published": "2024-01-15", "active": False},
+]
+
+
+@pytest.fixture(scope="module")
+def reader():
+    ms = MapperService(Settings.EMPTY, MAPPING)
+    w = SegmentWriter("s0")
+    for i, doc in enumerate(DOCS):
+        w.add_document(ms.parse_document(f"d{i}", doc),
+                       {f: t.dv_kind for f, t in ms.mapper.fields.items()})
+    seg = w.freeze()
+    return ShardReader([(seg, None)], ms)
+
+
+def search(reader, body, **kw):
+    return execute_query(reader, dsl.parse_query(body), **kw)
+
+
+def ids(result):
+    return [h.doc_id for h in result.hits]
+
+
+class TestParse:
+    def test_parse_shapes(self):
+        q = dsl.parse_query({"match": {"title": "fox"}})
+        assert isinstance(q, dsl.MatchQuery) and q.field == "title"
+        q = dsl.parse_query({"match": {"title": {"query": "fox", "operator": "AND"}}})
+        assert q.operator == "and"
+        q = dsl.parse_query({"bool": {"must": {"term": {"tags": "animal"}}}})
+        assert isinstance(q.must[0], dsl.TermQuery)
+
+    def test_parse_errors(self):
+        with pytest.raises(ParsingException):
+            dsl.parse_query({"mathc": {"title": "fox"}})
+        with pytest.raises(ParsingException):
+            dsl.parse_query({"match": {"title": "a"}, "term": {"x": 1}})
+        with pytest.raises(ParsingException):
+            dsl.parse_query({"range": {"views": {"gte": 1, "bogus": 2}}})
+        with pytest.raises(ParsingException):
+            dsl.parse_query({"bool": {"mustt": []}})
+
+
+class TestSearch:
+    def test_match_basic(self, reader):
+        r = search(reader, {"match": {"body": "fox"}})
+        assert set(ids(r)) == {"d0", "d4"}
+        assert r.total_hits == 2
+        assert r.max_score == pytest.approx(max(h.score for h in r.hits))
+
+    def test_match_scores_equal_oracle(self, reader):
+        r = search(reader, {"match": {"body": "lazy dog"}})
+        segs = [v.segment for v in reader.views]
+        ref = reference_impl.score_match_query(segs, "body", ["lazy", "dog"])[0]
+        got = {h.doc_id: h.score for h in r.hits}
+        for doc_ord, score in enumerate(ref):
+            did = segs[0].doc_ids[doc_ord]
+            if score > 0:
+                assert got[did] == pytest.approx(score, rel=2e-5)
+        # d1 has dog x3 lazy x2 → highest
+        assert ids(r)[0] == "d1"
+
+    def test_match_operator_and(self, reader):
+        r = search(reader, {"match": {"body": {"query": "quick dog", "operator": "and"}}})
+        assert ids(r) == ["d0"]
+        r_or = search(reader, {"match": {"body": "quick dog"}})
+        assert set(ids(r_or)) == {"d0", "d1"}
+
+    def test_term_keyword(self, reader):
+        r = search(reader, {"term": {"tags": "finance"}})
+        assert ids(r) == ["d3"]
+        # term is not analyzed: no lowercase matching
+        r = search(reader, {"term": {"title": "Quick"}})
+        assert ids(r) == []
+
+    def test_terms_query(self, reader):
+        r = search(reader, {"terms": {"tags": ["wild", "politics"]}})
+        assert set(ids(r)) == {"d2", "d4"}
+
+    def test_range_long(self, reader):
+        r = search(reader, {"range": {"views": {"gte": 100}}})
+        assert set(ids(r)) == {"d0", "d2", "d3"}
+        r = search(reader, {"range": {"views": {"gt": 100, "lte": 1000}}})
+        assert set(ids(r)) == {"d2", "d3"}
+
+    def test_range_double_and_date(self, reader):
+        r = search(reader, {"range": {"price": {"lt": 5.0}}})
+        assert set(ids(r)) == {"d3", "d4"}
+        r = search(reader, {"range": {"published": {"gte": "2024-01-01", "lt": "2024-02-01"}}})
+        assert set(ids(r)) == {"d0", "d4"}
+
+    def test_range_on_text_rejected(self, reader):
+        with pytest.raises(QueryShardException):
+            search(reader, {"range": {"title": {"gte": "a"}}})
+
+    def test_bool_combination(self, reader):
+        r = search(reader, {"bool": {
+            "must": [{"match": {"body": "the"}}],
+            "filter": [{"term": {"active": True}}],
+            "must_not": [{"term": {"tags": "finance"}}],
+        }})
+        assert set(ids(r)) == {"d0", "d2"}
+
+    def test_bool_should_scoring_adds(self, reader):
+        base = search(reader, {"match": {"body": "fox"}})
+        boosted = search(reader, {"bool": {
+            "must": [{"match": {"body": "fox"}}],
+            "should": [{"match": {"title": "ban"}}],
+        }})
+        b_scores = {h.doc_id: h.score for h in boosted.hits}
+        m_scores = {h.doc_id: h.score for h in base.hits}
+        assert b_scores["d4"] > m_scores["d4"]
+        assert b_scores["d0"] == pytest.approx(m_scores["d0"], rel=1e-6)
+        assert ids(boosted)[0] == "d4"  # should-boost flips the order
+
+    def test_nested_bool_conjunction_in_should_no_pollution(self, reader):
+        """A failing inner conjunction must contribute NO score."""
+        r = search(reader, {"bool": {
+            "must": [{"match": {"body": "the"}}],
+            "should": [{"bool": {"must": [
+                {"match": {"body": "stock"}},
+                {"match": {"body": "nonexistentterm"}},
+            ]}}],
+        }})
+        plain = search(reader, {"match": {"body": "the"}})
+        got = {h.doc_id: h.score for h in r.hits}
+        want = {h.doc_id: h.score for h in plain.hits}
+        for k, v in want.items():
+            assert got[k] == pytest.approx(v, rel=1e-6), k
+
+    def test_minimum_should_match(self, reader):
+        r = search(reader, {"bool": {
+            "should": [{"match": {"body": "fox"}},
+                       {"match": {"body": "lazy"}},
+                       {"term": {"tags": "politics"}}],
+            "minimum_should_match": 2,
+        }})
+        assert set(ids(r)) == {"d0", "d4"}
+
+    def test_match_phrase(self, reader):
+        r = search(reader, {"match_phrase": {"body": "quick brown fox"}})
+        assert ids(r) == ["d0"]
+        r = search(reader, {"match_phrase": {"body": "brown quick"}})
+        assert ids(r) == []
+
+    def test_match_all_and_paging(self, reader):
+        r = search(reader, {"match_all": {}})
+        assert r.total_hits == 5
+        assert len(r.hits) == 5
+        r2 = search(reader, {"match_all": {}}, size=2, from_=2)
+        assert len(r2.hits) == 2
+        assert ids(r2) == ids(r)[2:4]
+
+    def test_exists_and_ids(self, reader):
+        r = search(reader, {"exists": {"field": "views"}})
+        assert r.total_hits == 5
+        r = search(reader, {"ids": {"values": ["d1", "d3", "nope"]}})
+        assert set(ids(r)) == {"d1", "d3"}
+
+    def test_constant_score(self, reader):
+        r = search(reader, {"constant_score": {
+            "filter": {"term": {"tags": "animal"}}, "boost": 2.5}})
+        assert set(ids(r)) == {"d0", "d1", "d2"}
+        assert all(h.score == pytest.approx(2.5) for h in r.hits)
+
+    def test_unmapped_field_matches_nothing(self, reader):
+        r = search(reader, {"match": {"nope": "x"}})
+        assert r.total_hits == 0
+
+    def test_fetch_phase(self, reader):
+        r = search(reader, {"match": {"body": "fox"}})
+        fetched = execute_fetch(reader, r.hits)
+        assert fetched[0]["_source"]["title"] in ("quick brown fox", "fox hunting ban")
+        filtered = execute_fetch(reader, r.hits, source=["title"])
+        assert set(filtered[0]["_source"].keys()) == {"title"}
+        no_src = execute_fetch(reader, r.hits, source=False)
+        assert "_source" not in no_src[0]
+
+
+class TestMultiSegment:
+    def test_search_across_segments_with_tombstones(self):
+        ms = MapperService(Settings.EMPTY, MAPPING)
+        dv = {f: t.dv_kind for f, t in ms.mapper.fields.items()}
+        w1 = SegmentWriter("s1")
+        for i, doc in enumerate(DOCS[:3]):
+            w1.add_document(ms.parse_document(f"a{i}", doc), dv)
+        w2 = SegmentWriter("s2")
+        for i, doc in enumerate(DOCS[3:]):
+            w2.add_document(ms.parse_document(f"b{i}", doc), dv)
+        seg1, seg2 = w1.freeze(), w2.freeze()
+        live1 = np.array([True, False, True])  # tombstone a1
+        reader = ShardReader([(seg1, live1), (seg2, None)], ms)
+        r = execute_query(reader, dsl.parse_query({"match": {"body": "lazy dog"}}))
+        assert set(h.doc_id for h in r.hits) == {"a0"}  # a1 deleted
+        r = execute_query(reader, dsl.parse_query({"match_all": {}}))
+        assert r.total_hits == 4
