@@ -1,0 +1,83 @@
+"""Headline benchmark: batched BM25 top-k retrieval throughput (QPS).
+
+Measures the north-star kernel path (SURVEY.md §3.3): S document shards ×
+B micro-batched queries through the impact-sorted-merge step
+(ops/sparse.py) on one chip. The corpus is synthetic zipf-ish postings at
+~1M-doc scale; queries mix common and rare terms. The baseline is the
+literature anchor for Elasticsearch BM25 throughput on a commodity CPU
+node — order 10¹–10² QPS (BASELINE.md; ES is the slowest system in the
+BM25S comparison, arxiv 2407.03618). vs_baseline uses the
+favorable-to-the-reference 100 QPS/node figure.
+
+Timing note: through the axon tunnel, block_until_ready returns before
+remote execution finishes; a host readback of one scalar per iteration is
+the honest completion barrier.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: ES_TPU_BENCH_{SHARDS,DOCS,VOCAB,AVGDF,BATCH,TERMS,K,REPEATS}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_QPS = 100.0  # BASELINE.md: ES BM25 order 10^1-10^2 QPS/node; top end
+
+
+def _env(name: str, default: int) -> int:
+    return int(os.environ.get(f"ES_TPU_BENCH_{name}", default))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _query_tensors, _synthetic_pack
+    from elasticsearch_tpu.parallel.distributed import make_local_search
+
+    on_tpu = jax.default_backend() == "tpu"
+    # TPU: ~1M docs over 8 shards; CPU (dev): tiny
+    n_shards = _env("SHARDS", 8 if on_tpu else 2)
+    n_docs = _env("DOCS", 131072 if on_tpu else 2048)
+    vocab = _env("VOCAB", 1024 if on_tpu else 128)
+    avg_df = _env("AVGDF", n_docs // 16)
+    batch = _env("BATCH", 256 if on_tpu else 8)
+    n_terms = _env("TERMS", 4)
+    k = _env("K", 1000 if on_tpu else 32)
+    repeats = _env("REPEATS", 10 if on_tpu else 3)
+
+    flat_docs, flat_impact, row_starts, d_pad, p_pad = _synthetic_pack(
+        n_shards, n_docs, vocab, avg_df)
+    starts, lengths, weights, min_count, max_len, t_slots = _query_tensors(
+        row_starts, n_shards, batch, n_terms, vocab)
+
+    fn = make_local_search(max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
+                           t_window=t_slots)
+    args = tuple(jnp.asarray(a) for a in
+                 (flat_docs, flat_impact, starts, lengths, weights, min_count))
+    vals, ids = fn(*args)
+    _ = float(vals[0, 0])  # forces compile + one real execution
+
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        vals, ids = fn(*args)
+        _ = float(vals[0, 0])  # honest completion barrier per call
+    dt = time.perf_counter() - t0
+
+    qps = batch * repeats / dt
+    out = {
+        "metric": "bm25_topk_qps_1chip",
+        "value": round(qps, 2),
+        "unit": f"queries/s (S={n_shards}x{n_docs}docs, B={batch}, "
+                f"T={n_terms}, k={k}, {jax.default_backend()})",
+        "vs_baseline": round(qps / BASELINE_QPS, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

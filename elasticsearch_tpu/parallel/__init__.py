@@ -1,0 +1,26 @@
+"""Parallelism layer: device meshes, sharded packs, distributed search.
+
+SURVEY.md §2.3 mapping: P1 (shard partitioning) → "shards" mesh axis;
+P2/P4 (replica/request concurrency) → "data" axis micro-batching;
+P3 (scatter-gather) → shard_map + all_gather top-k merge.
+"""
+
+from elasticsearch_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    SHARD_AXIS,
+    factorize_2d,
+    make_mesh,
+)
+from elasticsearch_tpu.parallel.distributed import (  # noqa: F401
+    CHUNK_CAP,
+    QueryBatch,
+    StackedShardPack,
+    build_stacked_pack,
+    decode_refs,
+    device_put_pack,
+    distributed_search,
+    make_distributed_search,
+    make_local_search,
+    prepare_query_batch,
+    resolve_hits,
+)

@@ -1,0 +1,378 @@
+"""Distributed BM25 search over a device mesh (SPMD, shard_map).
+
+This is the TPU-native replacement for the reference's scatter-gather
+search (SURVEY.md §3.3 / §2.3 P3): where the reference's coordinator fans a
+query out to one copy of every shard over RPC (`AbstractSearchAsyncAction`)
+and merges top-k on the coordinating node (`SearchPhaseController#
+reducedQueryPhase`), here the fan-out is a `shard_map` over the "shards"
+mesh axis and the merge is an `all_gather` + on-device top-k — zero host
+hops inside a slice (SURVEY.md §5.8 ICI tier).
+
+The per-device kernel is the impact-sorted-merge pipeline of
+ops/sparse.py (gather chunks → sort by doc → windowed sum → top-k); this
+module owns the data layout and the collective:
+
+  StackedShardPack — S shards' postings as [S, ...] tensors with eager
+    BM25 impacts, padded to common shapes, placed with NamedSharding over
+    the "shards" axis. Statistics (idf, avgdl) are INDEX-level across all
+    shards — the reference's dfs_query_then_fetch mode, the deterministic
+    choice when doc partitioning is a mesh implementation detail.
+  QueryBatch — per-(shard, query, slot) chunk tensors, sharded over
+    ("shards", "data").
+
+Global doc identity: shard s, local ordinal d → s * (d_pad + 1) + d (the
++1 keeps the kernel's d_pad sentinel lane decodable), decoded host-side by
+`decode_refs` after the kernel returns (fetch resolves ordinals to _ids).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import lru_cache, partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticsearch_tpu.index.pack import LANE, _pad_to
+from elasticsearch_tpu.index.segment import Segment
+from elasticsearch_tpu.ops import sparse
+from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+
+NEG_INF = float("-inf")
+CHUNK_CAP = 4096  # max postings chunk per slot; flat arrays pad by this much
+
+
+@dataclasses.dataclass
+class StackedShardPack:
+    """S shards' postings for one field, stacked and padded to common shapes.
+
+    Device tensors (sharded over the "shards" axis on a mesh):
+      flat_docs   int32[S, P_pad] postings doc ids; pad sentinel = d_pad
+      flat_impact f32[S, P_pad]   eager BM25 impacts (ops/sparse.py step 1)
+      live        bool[S, D_pad]  live-doc masks (False = tombstone/padding)
+
+    Host-side per shard: vocab dict, row_start offsets — plus index-level
+    stats for idf/avgdl at query time. flat_tfs stays host-side only (to
+    rebuild impacts when stats/k1/b change)."""
+
+    field: str
+    num_shards: int
+    d_pad: int
+    p_pad: int
+    flat_docs: np.ndarray
+    flat_impact: np.ndarray
+    flat_tfs: np.ndarray
+    live: np.ndarray
+    vocabs: List[Dict[str, int]]
+    row_starts: List[np.ndarray]
+    shard_num_docs: List[int]
+    shard_doc_ids: List[List[str]]
+    total_doc_count: int
+    avgdl: float
+    df: Dict[str, int]
+    k1: float = 1.2
+    b: float = 0.75
+
+    def nbytes_device(self) -> int:
+        return (self.flat_docs.nbytes + self.flat_impact.nbytes
+                + self.live.nbytes)
+
+
+def build_stacked_pack(segments: Sequence[Segment], field: str,
+                       live_docs: Optional[Sequence[Optional[np.ndarray]]] = None,
+                       k1: float = 1.2, b: float = 0.75,
+                       pad_shards_to: Optional[int] = None) -> StackedShardPack:
+    """Each segment is one doc-axis shard (SURVEY.md §2.3 P1). Shapes pad to
+    the max across shards + CHUNK_CAP slack so chunk slices never clamp."""
+    from elasticsearch_tpu.index.pack import build_field_pack
+
+    s_real = len(segments)
+    s = pad_shards_to or s_real
+    if s < s_real:
+        raise ValueError(
+            f"pad_shards_to={s} < {s_real} segments (would drop shards)")
+    d_pad = max(_pad_to(seg.num_docs) for seg in segments)
+    packs = [build_field_pack(seg, field, d_pad) for seg in segments]
+    p_pad = max((p.flat_docs.shape[0] for p in packs if p is not None),
+                default=LANE) + CHUNK_CAP
+    flat_docs = np.full((s, p_pad), d_pad, dtype=np.int32)
+    flat_tfs = np.zeros((s, p_pad), dtype=np.int32)
+    norms = np.zeros((s, d_pad), dtype=np.uint8)
+    live = np.zeros((s, d_pad), dtype=bool)
+    vocabs: List[Dict[str, int]] = []
+    row_starts: List[np.ndarray] = []
+    shard_num_docs: List[int] = []
+    shard_doc_ids: List[List[str]] = []
+    total_docs = 0
+    sum_ttf = 0
+    df: Dict[str, int] = {}
+    for i, seg in enumerate(segments):
+        fp = packs[i]
+        if fp is not None:
+            n = fp.flat_docs.shape[0]
+            flat_docs[i, :n] = fp.flat_docs
+            flat_tfs[i, :n] = fp.flat_tfs
+            norms[i] = fp.norms_u8
+            vocabs.append(fp.vocab)
+            row_starts.append(fp.row_start)
+            for term, row in fp.vocab.items():
+                df[term] = df.get(term, 0) + int(fp.doc_freq[row])
+        else:
+            vocabs.append({})
+            row_starts.append(np.zeros(1, dtype=np.int64))
+        mask = (live_docs[i] if live_docs is not None and live_docs[i] is not None
+                else np.ones(seg.num_docs, dtype=bool))
+        live[i, : seg.num_docs] = mask
+        shard_num_docs.append(seg.num_docs)
+        shard_doc_ids.append(seg.doc_ids)
+        st = seg.field_stats.get(field)
+        if st:
+            total_docs += st.doc_count
+            sum_ttf += st.sum_total_term_freq
+    for _ in range(s_real, s):
+        vocabs.append({})
+        row_starts.append(np.zeros(1, dtype=np.int64))
+        shard_num_docs.append(0)
+        shard_doc_ids.append([])
+    avgdl = (sum_ttf / total_docs) if total_docs else 1.0
+    flat_impact = np.zeros((s, p_pad), dtype=np.float32)
+    for i in range(s_real):
+        flat_impact[i] = sparse.eager_impacts(
+            flat_docs[i], flat_tfs[i], norms[i], k1, b, avgdl)
+        # tombstones bake into impacts: a dead doc's contributions all go
+        # to 0, so the kernel's total>0 mask drops it (packs are derived
+        # caches — a delete-refresh rebuilds them, SURVEY.md §5.4)
+        safe = np.minimum(flat_docs[i], d_pad - 1)
+        flat_impact[i] *= live[i][safe]
+    return StackedShardPack(field, s, d_pad, p_pad, flat_docs, flat_impact,
+                            flat_tfs, live, vocabs, row_starts,
+                            shard_num_docs, shard_doc_ids, total_docs, avgdl,
+                            df, k1, b)
+
+
+@dataclasses.dataclass
+class QueryBatch:
+    """Chunked slot tensors for B queries × S shards (ops/sparse.plan_slots
+    run over all (shard, query) rows so the static (T, L_c) bucket is
+    shared)."""
+
+    starts: np.ndarray     # int32[S, B, T] relative to each shard's flat base
+    lengths: np.ndarray    # int32[S, B, T]
+    weights: np.ndarray    # f32[S, B, T]
+    min_count: np.ndarray  # int32[B]
+    max_len: int
+    t_slots: int
+
+
+def prepare_query_batch(pack: StackedShardPack,
+                        queries: Sequence[Sequence[str]],
+                        boosts: Optional[Sequence[float]] = None,
+                        min_counts: Optional[Sequence[int]] = None,
+                        pad_batch_to: Optional[int] = None,
+                        chunk_cap: int = CHUNK_CAP) -> QueryBatch:
+    """Host-side planning: vocab lookups, index-level idf, chunk splitting.
+    min_counts[i] = required matched clauses (1 = OR, len(terms) = AND)."""
+    b_real = len(queries)
+    b = pad_batch_to or b_real
+    if b < b_real:
+        raise ValueError(
+            f"pad_batch_to={b} < {b_real} queries (would drop queries)")
+    if chunk_cap > CHUNK_CAP:
+        # the pack's flat arrays carry exactly CHUNK_CAP slack; a larger
+        # chunk bucket would let dynamic_slice read the next shard's rows
+        raise ValueError(f"chunk_cap={chunk_cap} exceeds pack slack {CHUNK_CAP}")
+    s = pack.num_shards
+    n_docs = pack.total_doc_count
+    rows: List[List[Tuple[int, int, float, int]]] = []
+    mins: List[int] = []
+    for si in range(s):
+        vocab = pack.vocabs[si]
+        rstart = pack.row_starts[si]
+        for qi in range(b):
+            if qi >= b_real:
+                rows.append([])
+                mins.append(1)
+                continue
+            terms = queries[qi]
+            boost = boosts[qi] if boosts is not None else 1.0
+            row = []
+            for tid, term in enumerate(terms):
+                dfv = pack.df.get(term, 0)
+                w = 0.0
+                if dfv > 0:
+                    idf = math.log(1.0 + (n_docs - dfv + 0.5) / (dfv + 0.5))
+                    w = boost * idf * (pack.k1 + 1.0)
+                r = vocab.get(term, -1)
+                if r >= 0:
+                    st = int(rstart[r])
+                    ln = int(rstart[r + 1] - rstart[r])
+                else:
+                    st, ln = 0, 0
+                row.append((st, ln, w, tid))
+            rows.append(row)
+            mins.append(int(min_counts[qi]) if min_counts is not None else 1)
+    plan = sparse.plan_slots(rows, mins, chunk_cap=chunk_cap)
+    shape3 = (s, b, plan.t_slots)
+    return QueryBatch(plan.starts.reshape(shape3),
+                      plan.lengths.reshape(shape3),
+                      plan.weights.reshape(shape3),
+                      plan.min_count.reshape(s, b)[0].copy(),
+                      plan.max_len, plan.t_slots)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
+                *, max_len: int, d_pad: int, p_pad: int, k: int,
+                t_window: int, with_counts: bool, shard_offset):
+    """Score this device's S_l shards × B queries and return per-query
+    (vals, global ids) merged over the local shards.
+
+    flat_docs/flat_impact: [S_l, P_pad]; starts/lengths/weights:
+    [S_l, B, T] (starts relative to each shard's base); min_count [B]."""
+    s_l, b, t = starts.shape
+    base = jnp.arange(s_l, dtype=jnp.int32) * p_pad
+    starts_abs = starts + base[:, None, None]
+    r = s_l * b
+    vals, docs = sparse.sorted_merge_topk(
+        flat_docs.reshape(-1), flat_impact.reshape(-1),
+        starts_abs.reshape(r, t), lengths.reshape(r, t),
+        weights.reshape(r, t),
+        jnp.tile(min_count, s_l),
+        max_len=max_len, d_pad=d_pad, k=k, t_window=t_window,
+        with_counts=with_counts)
+    k_l = vals.shape[1]
+    vals = vals.reshape(s_l, b, k_l)
+    docs = docs.reshape(s_l, b, k_l)
+    shard_ids = shard_offset + jnp.arange(s_l, dtype=jnp.int64)
+    gids = docs.astype(jnp.int64) + (shard_ids * (d_pad + 1))[:, None, None]
+    # [S_l, B, k_l] -> [B, S_l*k_l]; sentinel doc (=d_pad) keeps -inf score
+    vals_b = jnp.transpose(vals, (1, 0, 2)).reshape(b, -1)
+    gids_b = jnp.transpose(gids, (1, 0, 2)).reshape(b, -1)
+    return vals_b, gids_b
+
+
+def _merge_topk(vals_b, gids_b, k: int):
+    top_vals, pos = jax.lax.top_k(vals_b, min(k, vals_b.shape[1]))
+    top_ids = jnp.take_along_axis(gids_b, pos, axis=1)
+    return top_vals, top_ids
+
+
+@lru_cache(maxsize=64)
+def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
+                      t_window: int, with_counts: bool = False):
+    """Single-device search step: S shards × B queries → global top-k.
+    Used by the bench on one chip and as the compile-check entry point.
+    lru_cached so repeated bucket signatures reuse the jitted step (and
+    its XLA compile cache) instead of re-tracing per call."""
+
+    @jax.jit
+    def step(flat_docs, flat_impact, starts, lengths, weights, min_count):
+        vals_b, gids_b = _local_body(
+            flat_docs, flat_impact, starts, lengths, weights, min_count,
+            max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
+            t_window=t_window, with_counts=with_counts,
+            shard_offset=jnp.int64(0))
+        return _merge_topk(vals_b, gids_b, k)
+
+    return step
+
+
+@lru_cache(maxsize=64)
+def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
+                            p_pad: int, k: int, t_window: int,
+                            with_counts: bool = False):
+    """SPMD search step over a (data, shards) mesh: local sorted-merge
+    per device, then all_gather over "shards" + final top-k on device
+    (SURVEY.md §5.8: the P3 reduce rides ICI). lru_cached by (mesh, bucket
+    signature) so the query path hits the jit cache instead of re-tracing
+    every batch."""
+
+    def body(flat_docs, flat_impact, starts, lengths, weights, min_count):
+        s_l = flat_docs.shape[0]
+        my = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int64)
+        vals_b, gids_b = _local_body(
+            flat_docs, flat_impact, starts, lengths, weights, min_count,
+            max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
+            t_window=t_window, with_counts=with_counts,
+            shard_offset=my * s_l)
+        all_vals = jax.lax.all_gather(vals_b, SHARD_AXIS, axis=1, tiled=True)
+        all_ids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1, tiled=True)
+        return _merge_topk(all_vals, all_ids, k)
+
+    spec_post = P(SHARD_AXIS, None)
+    spec_sbt = P(SHARD_AXIS, DATA_AXIS, None)
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_post, spec_post, spec_sbt, spec_sbt, spec_sbt,
+                  P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def device_put_pack(pack: StackedShardPack, mesh: Optional[Mesh] = None):
+    """Place the postings tensors in HBM (sharded over "shards" when a mesh
+    is given) — the resident pack image (SURVEY.md §7.1 table)."""
+    if mesh is None:
+        return (jax.device_put(pack.flat_docs),
+                jax.device_put(pack.flat_impact))
+    sh = NamedSharding(mesh, P(SHARD_AXIS, None))
+    return (jax.device_put(pack.flat_docs, sh),
+            jax.device_put(pack.flat_impact, sh))
+
+
+def distributed_search(pack: StackedShardPack, batch: QueryBatch, k: int,
+                       mesh: Mesh, device_arrays=None,
+                       with_counts: bool = False):
+    """Run one distributed query step. Returns (scores [B,k'], refs) where
+    refs[q] = [(score, shard, local_ord), ...] decoded host-side."""
+    if device_arrays is None:
+        device_arrays = device_put_pack(pack, mesh)
+    flat_docs, flat_impact = device_arrays
+    fn = make_distributed_search(
+        mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
+        k=k, t_window=batch.t_slots, with_counts=with_counts)
+    sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
+    db = NamedSharding(mesh, P(DATA_AXIS))
+    vals, ids = fn(flat_docs, flat_impact,
+                   jax.device_put(batch.starts, sbt),
+                   jax.device_put(batch.lengths, sbt),
+                   jax.device_put(batch.weights, sbt),
+                   jax.device_put(batch.min_count, db))
+    return decode_refs(pack, np.asarray(vals), np.asarray(ids))
+
+
+def decode_refs(pack: StackedShardPack, vals: np.ndarray, ids: np.ndarray):
+    refs = []
+    for qi in range(vals.shape[0]):
+        row = []
+        for v, gid in zip(vals[qi], ids[qi]):
+            if v == NEG_INF:
+                continue
+            shard, ord_ = divmod(int(gid), pack.d_pad + 1)
+            if ord_ >= pack.d_pad:
+                continue  # sentinel lane
+            row.append((float(v), shard, ord_))
+        refs.append(row)
+    return vals, refs
+
+
+def resolve_hits(pack: StackedShardPack,
+                 refs: List[List[Tuple[float, int, int]]]):
+    """(score, shard, ord) → [{'_id', '_score'}] via the host doc-id maps."""
+    out = []
+    for row in refs:
+        hits = []
+        for score, shard, ord_ in row:
+            if shard < len(pack.shard_doc_ids) and ord_ < len(pack.shard_doc_ids[shard]):
+                hits.append({"_id": pack.shard_doc_ids[shard][ord_],
+                             "_score": score})
+        out.append(hits)
+    return out

@@ -12,12 +12,19 @@ import hashlib
 import os
 import random
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# the image's sitecustomize force-registers the TPU tunnel platform ("axon")
+# ahead of the env var; pin the config so tests really run on the 8-device
+# virtual CPU mesh
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

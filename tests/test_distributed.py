@@ -1,0 +1,112 @@
+"""Distributed (mesh) search vs the single-host oracle.
+
+The 8-device virtual CPU mesh (conftest) plays the role of the reference's
+multi-node cluster; correctness bar: the shard_map + all_gather search
+returns exactly the same (id, score) ranking as the host oracle with
+index-level stats (SURVEY.md §2.3 P3).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.ops import reference_impl
+from elasticsearch_tpu.parallel import distributed as dist
+from elasticsearch_tpu.parallel.mesh import factorize_2d, make_mesh
+
+VOCAB = [f"w{i}" for i in range(40)]
+
+
+def make_shards(rng, n_shards, docs_per_shard):
+    ms = MapperService(Settings.EMPTY,
+                       {"properties": {"body": {"type": "text"}}})
+    shards = []
+    for s in range(n_shards):
+        w = SegmentWriter(f"shard{s}")
+        for i in range(docs_per_shard):
+            n_tokens = int(rng.integers(1, 25))
+            words = [VOCAB[min(int(rng.zipf(1.4)) - 1, len(VOCAB) - 1)]
+                     for _ in range(n_tokens)]
+            w.add_document(ms.parse_document(f"s{s}-d{i}",
+                                             {"body": " ".join(words)}), {})
+        shards.append(w.freeze())
+    return shards
+
+
+def oracle_topk(segments, queries, k, k1=1.2, b=0.75):
+    """Global top-k over all shards via the numpy oracle (index-level stats)."""
+    out = []
+    for terms in queries:
+        per_seg = reference_impl.score_match_query(segments, "body", terms,
+                                                   k1=k1, b=b)
+        ranked = []
+        for si, scores in enumerate(per_seg):
+            for d, sc in reference_impl.topk_from_scores(scores, k):
+                ranked.append((float(sc), si, int(d)))
+        ranked.sort(key=lambda t: (-t[0], t[1], t[2]))
+        out.append(ranked[:k])
+    return out
+
+
+class TestFactorize:
+    def test_shapes(self):
+        assert factorize_2d(1) == (1, 1)
+        assert factorize_2d(8) == (2, 4)
+        assert factorize_2d(4) == (2, 2)
+        assert factorize_2d(16) == (4, 4)
+
+
+class TestDistributedSearch:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return make_mesh()  # 8 virtual devices → (2, 4)
+
+    def test_matches_oracle(self, seeded_np, mesh):
+        n_shards = mesh.shape["shards"] * 2  # 2 shards per device slot
+        segments = make_shards(seeded_np, n_shards, 60)
+        pack = dist.build_stacked_pack(segments, "body")
+        queries = [["w0"], ["w1", "w2"], ["w3", "w0", "w5", "w9"],
+                   ["absent-term"]]
+        # pad batch to the data axis (2) multiple
+        batch = dist.prepare_query_batch(pack, queries, pad_batch_to=4)
+        k = 12
+        vals, refs = dist.distributed_search(pack, batch, k, mesh)
+        expected = oracle_topk(segments, queries, k)
+        for qi, exp in enumerate(expected):
+            got = refs[qi]
+            assert len(got) == len(exp), f"query {qi}"
+            for (gs, gshard, gord), (es, eshard, eord) in zip(got, exp):
+                assert gs == pytest.approx(es, rel=1e-5, abs=1e-6)
+                # ranking identity is only guaranteed up to score ties across
+                # different shards (all_gather concat order vs (seg, ord)
+                # order) — compare by score here, identity below
+        # strict identity for the top hit of each query with matches
+        hits = dist.resolve_hits(pack, refs)
+        for qi, exp in enumerate(expected):
+            if not exp:
+                assert hits[qi] == []
+                continue
+            top_expected = pack.shard_doc_ids[exp[0][1]][exp[0][2]]
+            assert hits[qi][0]["_id"] == top_expected
+
+    def test_empty_query_row_padding(self, seeded_np, mesh):
+        segments = make_shards(seeded_np, mesh.shape["shards"], 30)
+        pack = dist.build_stacked_pack(segments, "body")
+        batch = dist.prepare_query_batch(pack, [["w0"]], pad_batch_to=2)
+        vals, refs = dist.distributed_search(pack, batch, 5, mesh)
+        assert len(refs) == 2
+        assert refs[1] == []  # padded query row matches nothing
+
+    def test_live_mask_excludes_tombstones(self, seeded_np, mesh):
+        segments = make_shards(seeded_np, mesh.shape["shards"], 30)
+        # tombstone every doc of shard 0
+        live = [np.zeros(segments[0].num_docs, dtype=bool)] + [
+            None for _ in segments[1:]]
+        pack = dist.build_stacked_pack(segments, "body", live_docs=live)
+        batch = dist.prepare_query_batch(pack, [["w0"]], pad_batch_to=2)
+        _, refs = dist.distributed_search(pack, batch, 50, mesh)
+        assert all(shard != 0 for _, shard, _ in refs[0])

@@ -1,0 +1,138 @@
+"""ops/sparse.py kernel tests: sorted-merge top-k vs numpy oracle,
+including chunk splitting and msm/AND counting."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops import sparse
+
+
+def brute_force(rows, flat_docs, flat_impact, d_pad, min_count):
+    """rows: [(start, ln, w, tid)...] per query; dense accumulate."""
+    out = []
+    for row, mc in zip(rows, min_count):
+        score = np.zeros(d_pad, dtype=np.float64)
+        cnt = np.zeros(d_pad, dtype=np.int64)
+        for (s, ln, w, _tid) in row:
+            d = flat_docs[s:s + ln]
+            imp = flat_impact[s:s + ln]
+            score[d] += w * imp
+            cnt[d] += 1
+        ok = (score > 0) & (cnt >= mc)
+        out.append([(int(d), float(score[d]))
+                    for d in np.nonzero(ok)[0]])
+    return out
+
+
+def make_flat(rng, n_terms, d_pad, max_df, slack=256):
+    rows = []
+    sizes = [int(rng.integers(1, max_df)) for _ in range(n_terms)]
+    total = sum(sizes)
+    flat_docs = np.full(total + slack, d_pad, dtype=np.int32)
+    flat_imp = np.zeros(total + slack, dtype=np.float32)
+    pos = 0
+    extents = []
+    for sz in sizes:
+        docs = np.sort(rng.choice(d_pad, size=sz, replace=False)).astype(np.int32)
+        flat_docs[pos:pos + sz] = docs
+        flat_imp[pos:pos + sz] = rng.uniform(0.1, 1.0, size=sz).astype(np.float32)
+        extents.append((pos, sz))
+        pos += sz
+    return flat_docs, flat_imp, extents
+
+
+def run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k, chunk_cap=4096,
+               with_counts=False):
+    plan = sparse.plan_slots(rows, mins, chunk_cap=chunk_cap, lane=8)
+    vals, docs = sparse.sorted_merge_topk(
+        jnp.asarray(flat_docs), jnp.asarray(flat_imp),
+        jnp.asarray(plan.starts), jnp.asarray(plan.lengths),
+        jnp.asarray(plan.weights), jnp.asarray(plan.min_count),
+        max_len=plan.max_len, d_pad=d_pad, k=k,
+        t_window=plan.t_slots, with_counts=with_counts)
+    return np.asarray(vals), np.asarray(docs)
+
+
+class TestSortedMergeTopk:
+    def test_or_query_matches_oracle(self, seeded_np):
+        d_pad = 512
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 6, d_pad, 200)
+        weights = [1.7, 0.9, 2.3, 0.5, 1.1, 3.0]
+        rows = [[(ext[t][0], ext[t][1], weights[t], t) for t in (0, 2, 4)],
+                [(ext[t][0], ext[t][1], weights[t], t) for t in (1, 3)],
+                [(ext[5][0], ext[5][1], weights[5], 5)]]
+        mins = [1, 1, 1]
+        vals, docs = run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k=600)
+        expected = brute_force(rows, flat_docs, flat_imp, d_pad, mins)
+        for qi, exp in enumerate(expected):
+            exp_sorted = sorted(exp, key=lambda t: (-t[1], t[0]))
+            got = [(int(d), float(v)) for v, d in zip(vals[qi], docs[qi])
+                   if v != float("-inf")]
+            assert len(got) == len(exp_sorted)
+            for (gd, gv), (ed, ev) in zip(got, exp_sorted):
+                assert gd == ed
+                assert gv == pytest.approx(ev, rel=1e-5)
+
+    def test_chunking_preserves_scores(self, seeded_np):
+        """Tiny chunk_cap forces every row to split into many slots; result
+        must be identical to the unchunked run."""
+        d_pad = 256
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 4, d_pad, 180)
+        rows = [[(ext[t][0], ext[t][1], 1.0 + t, t) for t in range(4)]]
+        v1, d1 = run_kernel(flat_docs, flat_imp, rows, [1], d_pad, k=300,
+                            chunk_cap=4096)
+        v2, d2 = run_kernel(flat_docs, flat_imp, rows, [1], d_pad, k=300,
+                            chunk_cap=16)
+        m1 = v1[0] != float("-inf")
+        m2 = v2[0] != float("-inf")
+        assert m1.sum() == m2.sum()
+        np.testing.assert_array_equal(d1[0][m1], d2[0][m2])
+        np.testing.assert_allclose(v1[0][m1], v2[0][m2], rtol=1e-5)
+
+    def test_and_semantics(self, seeded_np):
+        d_pad = 256
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 3, d_pad, 120)
+        rows = [[(ext[t][0], ext[t][1], 1.0, t) for t in range(3)]]
+        mins = [3]  # AND of 3 terms
+        vals, docs = run_kernel(flat_docs, flat_imp, rows, mins, d_pad,
+                                k=256, with_counts=True)
+        expected = brute_force(rows, flat_docs, flat_imp, d_pad, mins)[0]
+        got = {int(d) for v, d in zip(vals[0], docs[0]) if v != float("-inf")}
+        assert got == {d for d, _ in expected}
+
+    def test_and_semantics_with_chunking(self, seeded_np):
+        d_pad = 256
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 3, d_pad, 120)
+        rows = [[(ext[t][0], ext[t][1], 1.0, t) for t in range(3)]]
+        mins = [2]  # at least 2 of 3
+        v1, d1 = run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k=256,
+                            with_counts=True, chunk_cap=16)
+        expected = brute_force(rows, flat_docs, flat_imp, d_pad, mins)[0]
+        got = {int(d) for v, d in zip(v1[0], d1[0]) if v != float("-inf")}
+        assert got == {d for d, _ in expected}
+
+    def test_absent_term_zero_length_slot(self, seeded_np):
+        d_pad = 128
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 2, d_pad, 60)
+        # second "term" absent (zero-length row): AND can never match
+        rows = [[(ext[0][0], ext[0][1], 1.0, 0), (0, 0, 0.0, 1)]]
+        vals, docs = run_kernel(flat_docs, flat_imp, rows, [2], d_pad,
+                                k=128, with_counts=True)
+        assert (vals[0] == float("-inf")).all()
+        # OR still matches term 0's docs
+        vals, docs = run_kernel(flat_docs, flat_imp, rows, [1], d_pad,
+                                k=128, with_counts=True)
+        got = {int(d) for v, d in zip(vals[0], docs[0]) if v != float("-inf")}
+        assert got == set(int(x) for x in
+                          flat_docs[ext[0][0]:ext[0][0] + ext[0][1]])
+
+    def test_tie_break_smaller_doc_first(self):
+        d_pad = 64
+        # two docs with identical impact from one term
+        flat_docs = np.array([5, 9] + [d_pad] * 32, dtype=np.int32)
+        flat_imp = np.array([0.5, 0.5] + [0.0] * 32, dtype=np.float32)
+        rows = [[(0, 2, 1.0, 0)]]
+        vals, docs = run_kernel(flat_docs, flat_imp, rows, [1], d_pad, k=2)
+        assert docs[0][0] == 5 and docs[0][1] == 9
