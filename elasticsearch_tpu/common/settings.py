@@ -175,6 +175,32 @@ class Settings:
     def get_as_dict(self) -> Dict[str, Any]:
         return dict(self._map)
 
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self._map.get(key)
+        return default if v is None else int(v)
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self._map.get(key)
+        return default if v is None else float(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        """Strict boolean parsing like the reference (Booleans#parseBoolean
+        post-6.x: only true/false accepted — typos must not silently
+        disable features)."""
+        v = self._map.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        s = str(v).lower()
+        if s == "true":
+            return True
+        if s == "false":
+            return False
+        raise SettingsException(
+            f"Failed to parse value [{v}] for setting [{key}]: "
+            f"only [true] or [false] are allowed")
+
     def keys(self) -> Iterable[str]:
         return self._map.keys()
 

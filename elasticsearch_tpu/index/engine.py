@@ -1,0 +1,485 @@
+"""InternalEngine — versioned upserts over immutable segments + WAL.
+
+Reference: `index/engine/InternalEngine` (SURVEY.md §2.1#24, §3.2): the
+per-shard write machine. Kept behaviors:
+
+  - LiveVersionMap: uid → (seq_no, term, version, deleted) for realtime
+    version conflict checks and realtime GET before refresh.
+  - refresh: in-memory buffer freezes into an immutable segment and a new
+    point-in-time reader swaps in (NRT semantics); updates/deletes of
+    already-committed docs become tombstones applied to the new reader's
+    live bitmaps (soft deletes, §2.1#24).
+  - flush: refresh + write segments & manifest (safe commit) + translog
+    rollover/trim (§5.4: resume = load commit + replay translog tail).
+  - versioning: internal (monotonic per doc) with optional compare-and-set
+    via if_seq_no/if_primary_term, and external version mode.
+  - merges: size-tiered host job re-packing segments (ConcurrentMerge-
+    Scheduler analog, §3.2 [async]) purging tombstones.
+
+The device-side pack cache is keyed by segment name: refresh reuses packs
+of unchanged segments (the HBM image is a derived cache, §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingException,
+    EngineClosedException,
+    VersionConflictEngineException,
+)
+from elasticsearch_tpu.index import store as seg_store
+from elasticsearch_tpu.index.reader import ShardReader
+from elasticsearch_tpu.index.segment import Segment, SegmentWriter, merge_segments
+from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
+from elasticsearch_tpu.index.translog import Translog, TranslogOp
+from elasticsearch_tpu.mapping import MapperService
+
+
+@dataclasses.dataclass
+class VersionValue:
+    seq_no: int
+    primary_term: int
+    version: int
+    deleted: bool
+    # where the live copy is: ("buffer", ord) | ("segment", name, ord) | None
+    location: Optional[Tuple] = None
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    path: str
+    mapper: MapperService
+    primary_term: int = 1
+    durability: str = Translog.DURABILITY_REQUEST
+    k1: float = 1.2
+    b: float = 0.75
+    merge_segment_count_trigger: int = 10
+    merge_deletes_pct_trigger: float = 20.0
+
+
+@dataclasses.dataclass
+class IndexResult:
+    doc_id: str
+    seq_no: int
+    primary_term: int
+    version: int
+    created: bool
+    result: str  # "created" | "updated"
+
+
+@dataclasses.dataclass
+class DeleteResult:
+    doc_id: str
+    seq_no: int
+    primary_term: int
+    version: int
+    found: bool
+
+
+class InternalEngine:
+    """One shard's write path. Thread-safe via a single write lock (the
+    reference serializes per-uid; a shard-level lock is the simple correct
+    choice for a host-side control path whose heavy work is on device)."""
+
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        self._lock = threading.RLock()
+        self._closed = False
+        self._gen = 0
+        os.makedirs(config.path, exist_ok=True)
+
+        self._segments: List[Segment] = []
+        self._live: Dict[str, np.ndarray] = {}      # segment name -> bool[num_docs]
+        self._version_map: Dict[str, VersionValue] = {}
+        self._pending_seg_deletes: List[Tuple[str, int]] = []
+        self._buffer_tombstones: set = set()
+        self._writer = SegmentWriter(self._next_seg_name())
+        self.history_uuid = str(uuid.uuid4())
+        self._committed_segment_names: List[str] = []
+        self._commit_file_crcs: Dict[str, int] = {}
+
+        commit = seg_store.read_commit(config.path)
+        self.translog = Translog(os.path.join(config.path, "translog"),
+                                 config.durability)
+        if commit is not None:
+            self._recover_from_commit(commit)
+        else:
+            self.tracker = LocalCheckpointTracker()
+            # replay a translog that survived without a commit (all ops)
+            self._replay_translog(from_seq_no=0)
+        self._reader: Optional[ShardReader] = None
+        self._packs_cache: Dict[str, Any] = {}
+        self.refresh()
+
+    # ------------------------------------------------------------------
+    # lifecycle / recovery
+    # ------------------------------------------------------------------
+
+    def _next_seg_name(self) -> str:
+        self._gen += 1
+        return f"_{self._gen}"
+
+    def _recover_from_commit(self, commit: dict) -> None:
+        """SURVEY.md §3.1: load safe commit, replay translog tail."""
+        names = commit["segments"]
+        crcs = commit.get("file_crcs", {})
+        for name in names:
+            seg = seg_store.load_segment(self.config.path, name, crcs)
+            self._segments.append(seg)
+            live = np.ones(seg.num_docs, dtype=bool)
+            for ord_ in commit.get("tombstones", {}).get(name, []):
+                live[ord_] = False
+            self._live[seg.name] = live
+            gen_num = int(name[1:]) if name[1:].isdigit() else 0
+            self._gen = max(self._gen, gen_num)
+        self._committed_segment_names = list(names)
+        self._commit_file_crcs = dict(crcs)
+        self.history_uuid = commit.get("history_uuid", self.history_uuid)
+        self._writer = SegmentWriter(self._next_seg_name())
+        lcp = commit["local_checkpoint"]
+        self.tracker = LocalCheckpointTracker(
+            max_seq_no=commit["max_seq_no"], local_checkpoint=lcp)
+        # rebuild the version map for committed docs lazily: committed
+        # segments resolve versions via _resolve_committed on demand
+        self._replay_translog(from_seq_no=lcp + 1)
+
+    def _replay_translog(self, from_seq_no: int) -> int:
+        count = 0
+        for op in self.translog.snapshot(from_seq_no):
+            if op.op_type == "index":
+                self._apply_index(op.doc_id, op.source, seq_no=op.seq_no,
+                                  primary_term=op.primary_term,
+                                  version=op.version, log=False)
+            elif op.op_type == "delete":
+                self._apply_delete(op.doc_id, seq_no=op.seq_no,
+                                   primary_term=op.primary_term,
+                                   version=op.version, log=False)
+            self.tracker.advance_max_seq_no(op.seq_no)
+            self.tracker.mark_processed(op.seq_no)
+            self.tracker.mark_persisted(op.seq_no)
+            count += 1
+        return count
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self.translog.close()
+
+    def _ensure_open(self) -> None:
+        if self._closed:
+            raise EngineClosedException("engine is closed")
+
+    # ------------------------------------------------------------------
+    # version resolution
+    # ------------------------------------------------------------------
+
+    def _resolve_version(self, doc_id: str) -> Optional[VersionValue]:
+        vv = self._version_map.get(doc_id)
+        if vv is not None:
+            return vv
+        return self._resolve_committed(doc_id)
+
+    def _resolve_committed(self, doc_id: str) -> Optional[VersionValue]:
+        # newest segment wins (a doc lives in exactly one live location:
+        # updates tombstone the old copy)
+        for seg in reversed(self._segments):
+            ord_ = seg.id_to_ord.get(doc_id)
+            if ord_ is not None and self._live[seg.name][ord_]:
+                return VersionValue(NO_OPS_PERFORMED, 0, 1, False,
+                                    ("segment", seg.name, ord_))
+        return None
+
+    # ------------------------------------------------------------------
+    # write ops
+    # ------------------------------------------------------------------
+
+    def index(self, doc_id: str, source: dict, *,
+              seq_no: Optional[int] = None, primary_term: Optional[int] = None,
+              if_seq_no: Optional[int] = None,
+              if_primary_term: Optional[int] = None,
+              version: Optional[int] = None,
+              version_type: str = "internal") -> IndexResult:
+        """Primary path when seq_no is None (assigns one); replica/replay
+        path otherwise (SURVEY.md §3.2 applyIndexOperationOnPrimary/Replica).
+        """
+        with self._lock:
+            self._ensure_open()
+            existing = self._resolve_version(doc_id)
+            is_update = existing is not None and not existing.deleted
+
+            if seq_no is None:  # primary: run version checks
+                if if_seq_no is not None or if_primary_term is not None:
+                    if existing is None or existing.deleted:
+                        raise VersionConflictEngineException(
+                            f"[{doc_id}]: required seqNo [{if_seq_no}], "
+                            f"but no document was found")
+                    if (existing.seq_no != if_seq_no
+                            or (if_primary_term is not None
+                                and existing.primary_term != if_primary_term)):
+                        raise VersionConflictEngineException(
+                            f"[{doc_id}]: version conflict, required seqNo "
+                            f"[{if_seq_no}], current [{existing.seq_no}]")
+                if version_type == "external":
+                    cur = existing.version if is_update else 0
+                    if version is None or version <= cur:
+                        raise VersionConflictEngineException(
+                            f"[{doc_id}]: external version [{version}] <= "
+                            f"current [{cur}]")
+                    new_version = version
+                else:
+                    new_version = (existing.version + 1) if is_update else 1
+                seq_no = self.tracker.generate_seq_no()
+                primary_term = self.config.primary_term
+            else:
+                new_version = version if version is not None else 1
+                self.tracker.advance_max_seq_no(seq_no)
+
+            self._apply_index(doc_id, source, seq_no=seq_no,
+                              primary_term=primary_term, version=new_version,
+                              log=True)
+            self.tracker.mark_processed(seq_no)
+            self.tracker.mark_persisted(seq_no)
+            return IndexResult(doc_id, seq_no, primary_term, new_version,
+                               created=not is_update,
+                               result="updated" if is_update else "created")
+
+    def _apply_index(self, doc_id: str, source: dict, *, seq_no: int,
+                     primary_term: int, version: int, log: bool) -> None:
+        existing = self._resolve_version(doc_id)
+        if existing is not None and existing.location is not None:
+            self._tombstone_location(existing.location)
+        parsed = self.config.mapper.parse_document(doc_id, source)
+        ord_ = self._writer.add_document(parsed, self.config.mapper.dv_kinds())
+        self._version_map[doc_id] = VersionValue(
+            seq_no, primary_term, version, False, ("buffer", ord_))
+        if log:
+            self.translog.add(TranslogOp("index", seq_no, primary_term,
+                                         doc_id, source, version))
+
+    def delete(self, doc_id: str, *,
+               seq_no: Optional[int] = None, primary_term: Optional[int] = None,
+               if_seq_no: Optional[int] = None,
+               if_primary_term: Optional[int] = None) -> DeleteResult:
+        with self._lock:
+            self._ensure_open()
+            existing = self._resolve_version(doc_id)
+            found = existing is not None and not existing.deleted
+            if seq_no is None:
+                if if_seq_no is not None and (
+                        not found or existing.seq_no != if_seq_no
+                        or (if_primary_term is not None
+                            and existing.primary_term != if_primary_term)):
+                    raise VersionConflictEngineException(
+                        f"[{doc_id}]: version conflict on delete")
+                seq_no = self.tracker.generate_seq_no()
+                primary_term = self.config.primary_term
+            else:
+                self.tracker.advance_max_seq_no(seq_no)
+            version = (existing.version + 1) if found else 1
+            self._apply_delete(doc_id, seq_no=seq_no,
+                               primary_term=primary_term, version=version,
+                               log=True)
+            self.tracker.mark_processed(seq_no)
+            self.tracker.mark_persisted(seq_no)
+            return DeleteResult(doc_id, seq_no, primary_term, version, found)
+
+    def _apply_delete(self, doc_id: str, *, seq_no: int, primary_term: int,
+                      version: int, log: bool) -> None:
+        existing = self._resolve_version(doc_id)
+        if existing is not None and existing.location is not None:
+            self._tombstone_location(existing.location)
+        self._version_map[doc_id] = VersionValue(
+            seq_no, primary_term, version, True, None)
+        if log:
+            self.translog.add(TranslogOp("delete", seq_no, primary_term,
+                                         doc_id, None, version))
+
+    def no_op(self, seq_no: int, primary_term: int, reason: str) -> None:
+        """Seqno gap filler (reference: NoOp on primary failover)."""
+        with self._lock:
+            self.translog.add(TranslogOp("no_op", seq_no, primary_term,
+                                         reason=reason))
+            self.tracker.advance_max_seq_no(seq_no)
+            self.tracker.mark_processed(seq_no)
+            self.tracker.mark_persisted(seq_no)
+
+    def _tombstone_location(self, location: Tuple) -> None:
+        if location[0] == "buffer":
+            self._buffer_tombstones.add(location[1])
+        else:
+            _, seg_name, ord_ = location
+            self._pending_seg_deletes.append((seg_name, ord_))
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+
+    def get(self, doc_id: str) -> Optional[Dict[str, Any]]:
+        """Realtime get (reference: ShardGetService via LiveVersionMap →
+        translog/buffer, §2.1#40): sees un-refreshed writes."""
+        with self._lock:
+            self._ensure_open()
+            vv = self._resolve_version(doc_id)
+            if vv is None or vv.deleted:
+                return None
+            if vv.location is None:
+                return None
+            if vv.location[0] == "buffer":
+                source = self._writer._stored[vv.location[1]]
+            else:
+                _, seg_name, ord_ = vv.location
+                seg = next(s for s in self._segments if s.name == seg_name)
+                source = seg.stored_source[ord_]
+            return {"_id": doc_id, "_version": vv.version,
+                    "_seq_no": vv.seq_no, "_primary_term": vv.primary_term,
+                    "_source": source, "found": True}
+
+    def acquire_reader(self) -> ShardReader:
+        with self._lock:
+            self._ensure_open()
+            assert self._reader is not None
+            return self._reader
+
+    # ------------------------------------------------------------------
+    # refresh / flush / merge
+    # ------------------------------------------------------------------
+
+    def refresh(self) -> bool:
+        """Make buffered ops searchable (reference: InternalEngine#refresh,
+        the 1s NRT cycle §3.2 [async]). Returns True if anything changed."""
+        with self._lock:
+            self._ensure_open()
+            changed = False
+            if self._writer.num_docs > 0:
+                seg = self._writer.freeze()
+                live = np.ones(seg.num_docs, dtype=bool)
+                for ord_ in self._buffer_tombstones:
+                    live[ord_] = False
+                self._segments.append(seg)
+                self._live[seg.name] = live
+                # relocate version-map buffer pointers to the new segment
+                for doc_id, vv in self._version_map.items():
+                    if vv.location is not None and vv.location[0] == "buffer":
+                        vv.location = ("segment", seg.name, vv.location[1])
+                self._buffer_tombstones = set()
+                self._writer = SegmentWriter(self._next_seg_name())
+                changed = True
+            if self._pending_seg_deletes:
+                for seg_name, ord_ in self._pending_seg_deletes:
+                    if seg_name in self._live:
+                        self._live[seg_name][ord_] = False
+                self._pending_seg_deletes = []
+                changed = True
+            if changed or self._reader is None:
+                self._reader = ShardReader(
+                    [(s, self._live[s.name]) for s in self._segments],
+                    self.config.mapper, self.config.k1, self.config.b,
+                    packs=self._packs_cache)
+                self._packs_cache = {v.segment.name: v.pack
+                                     for v in self._reader.views}
+            return changed
+
+    def flush(self) -> None:
+        """Commit: refresh + persist segments + manifest, then roll/trim
+        the translog (reference: InternalEngine#flush = lucene commit +
+        translog trim, §5.4)."""
+        with self._lock:
+            self._ensure_open()
+            self.refresh()
+            self.translog.sync()
+            crcs = dict(self._commit_file_crcs)
+            committed = set(self._committed_segment_names)
+            for seg in self._segments:
+                if seg.name not in committed:
+                    crcs.update(seg_store.save_segment(self.config.path, seg))
+            names = [s.name for s in self._segments]
+            crcs = {fn: c for fn, c in crcs.items()
+                    if fn.split(".")[0] in {n for n in names}}
+            tombstones = {
+                s.name: np.nonzero(~self._live[s.name])[0].tolist()
+                for s in self._segments if not self._live[s.name].all()}
+            gen = self.translog.rollover()
+            seg_store.write_commit(
+                self.config.path, segments=names, tombstones=tombstones,
+                local_checkpoint=self.tracker.processed_checkpoint,
+                max_seq_no=self.tracker.max_seq_no,
+                primary_term=self.config.primary_term,
+                translog_generation=gen,
+                mapping=self.config.mapper.to_mapping(),
+                file_crcs=crcs, history_uuid=self.history_uuid)
+            self._committed_segment_names = names
+            self._commit_file_crcs = crcs
+            self.translog.trim(gen)
+            seg_store.cleanup_unreferenced(self.config.path, names)
+
+    def maybe_merge(self) -> bool:
+        """Size-tiered-ish merge policy: too many segments, or too many
+        tombstones → re-pack (reference: merge scheduling §3.2)."""
+        with self._lock:
+            total = sum(s.num_docs for s in self._segments) or 1
+            dead = sum(int((~self._live[s.name]).sum()) for s in self._segments)
+            if (len(self._segments) >= self.config.merge_segment_count_trigger
+                    or 100.0 * dead / total >= self.config.merge_deletes_pct_trigger):
+                return self.force_merge()
+            return False
+
+    def force_merge(self) -> bool:
+        with self._lock:
+            self._ensure_open()
+            self.refresh()
+            if len(self._segments) <= 1 and all(
+                    self._live[s.name].all() for s in self._segments):
+                return False
+            merged = merge_segments(self._next_seg_name(), self._segments,
+                                    [self._live[s.name] for s in self._segments])
+            self._segments = [merged]
+            self._live = {merged.name: np.ones(merged.num_docs, dtype=bool)}
+            # re-point version map at the merged segment
+            for doc_id, vv in self._version_map.items():
+                if vv.location is not None and vv.location[0] == "segment":
+                    ord_ = merged.id_to_ord.get(doc_id)
+                    if ord_ is not None:
+                        vv.location = ("segment", merged.name, ord_)
+            self._packs_cache = {}
+            self._reader = ShardReader(
+                [(merged, self._live[merged.name])], self.config.mapper,
+                self.config.k1, self.config.b)
+            self._packs_cache = {v.segment.name: v.pack
+                                 for v in self._reader.views}
+            return True
+
+    # ------------------------------------------------------------------
+    # stats / introspection
+    # ------------------------------------------------------------------
+
+    def num_docs(self) -> int:
+        with self._lock:
+            committed = sum(int(self._live[s.name].sum())
+                            for s in self._segments)
+            buffered = len({d for d, vv in self._version_map.items()
+                            if vv.location is not None
+                            and vv.location[0] == "buffer"
+                            and not vv.deleted})
+            return committed + buffered
+
+    def segment_count(self) -> int:
+        with self._lock:
+            return len(self._segments)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "num_docs": self.num_docs(),
+                "segments": len(self._segments),
+                "max_seq_no": self.tracker.max_seq_no,
+                "local_checkpoint": self.tracker.processed_checkpoint,
+                "translog": self.translog.stats(),
+            }

@@ -1,0 +1,214 @@
+"""Sequence numbers, checkpoints, retention leases.
+
+Reference: `index/seqno/` (SURVEY.md §2.1#26) — `SequenceNumbers`,
+`LocalCheckpointTracker` (max contiguous processed seqno),
+`ReplicationTracker` (global checkpoint = min local checkpoint over the
+in-sync set; retention leases guarantee ops-based recovery history).
+Semantics are kept; the bitset windowing is a Python set + rolling base
+(ops are acknowledged roughly in order, so the pending set stays tiny).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+NO_OPS_PERFORMED = -1
+UNASSIGNED_SEQ_NO = -2
+
+
+class LocalCheckpointTracker:
+    """Issues seqnos on the primary and tracks the max contiguous
+    processed/persisted marker (reference: LocalCheckpointTracker)."""
+
+    def __init__(self, max_seq_no: int = NO_OPS_PERFORMED,
+                 local_checkpoint: int = NO_OPS_PERFORMED):
+        self._lock = threading.Lock()
+        self._next_seq_no = max_seq_no + 1
+        self._processed = local_checkpoint
+        self._persisted = local_checkpoint
+        self._pending_processed: set = set()
+        self._pending_persisted: set = set()
+
+    def generate_seq_no(self) -> int:
+        with self._lock:
+            n = self._next_seq_no
+            self._next_seq_no += 1
+            return n
+
+    def advance_max_seq_no(self, seq_no: int) -> None:
+        """Replica path: seqnos arrive pre-assigned from the primary."""
+        with self._lock:
+            if seq_no >= self._next_seq_no:
+                self._next_seq_no = seq_no + 1
+
+    @property
+    def max_seq_no(self) -> int:
+        with self._lock:
+            return self._next_seq_no - 1
+
+    def mark_processed(self, seq_no: int) -> None:
+        with self._lock:
+            self._processed = _advance(self._processed, seq_no,
+                                       self._pending_processed)
+
+    def mark_persisted(self, seq_no: int) -> None:
+        with self._lock:
+            self._persisted = _advance(self._persisted, seq_no,
+                                       self._pending_persisted)
+
+    @property
+    def processed_checkpoint(self) -> int:
+        with self._lock:
+            return self._processed
+
+    @property
+    def persisted_checkpoint(self) -> int:
+        with self._lock:
+            return self._persisted
+
+    def contains(self, seq_no: int) -> bool:
+        """Has this seqno been processed? (reference: #hasProcessed)"""
+        with self._lock:
+            return seq_no <= self._processed or seq_no in self._pending_processed
+
+
+def _advance(checkpoint: int, seq_no: int, pending: set) -> int:
+    if seq_no <= checkpoint:
+        return checkpoint
+    pending.add(seq_no)
+    while checkpoint + 1 in pending:
+        checkpoint += 1
+        pending.discard(checkpoint)
+    return checkpoint
+
+
+@dataclasses.dataclass
+class RetentionLease:
+    """History-retention marker (reference: RetentionLease): ops with
+    seqno >= retaining_seq_no must stay replayable for `source`."""
+
+    id: str
+    retaining_seq_no: int
+    timestamp: float
+    source: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "RetentionLease":
+        return RetentionLease(d["id"], d["retaining_seq_no"],
+                              d["timestamp"], d["source"])
+
+
+class ReplicationTracker:
+    """Primary-side view of the replication group (reference:
+    ReplicationTracker): tracks each in-sync copy's local checkpoint and
+    computes the global checkpoint (min over in-sync copies)."""
+
+    def __init__(self, shard_allocation_id: str,
+                 lease_expiry_seconds: float = 12 * 3600.0):
+        self._lock = threading.Lock()
+        self.shard_allocation_id = shard_allocation_id
+        self._local_checkpoints: Dict[str, int] = {
+            shard_allocation_id: NO_OPS_PERFORMED}
+        self._in_sync: set = {shard_allocation_id}
+        self._tracked: set = {shard_allocation_id}
+        self._global_checkpoint = NO_OPS_PERFORMED
+        self._leases: Dict[str, RetentionLease] = {}
+        self._lease_expiry = lease_expiry_seconds
+
+    # ---------------- membership ----------------
+
+    def init_tracking(self, allocation_id: str) -> None:
+        with self._lock:
+            self._tracked.add(allocation_id)
+            self._local_checkpoints.setdefault(allocation_id, NO_OPS_PERFORMED)
+
+    def mark_in_sync(self, allocation_id: str) -> None:
+        with self._lock:
+            self._tracked.add(allocation_id)
+            self._local_checkpoints.setdefault(allocation_id, NO_OPS_PERFORMED)
+            self._in_sync.add(allocation_id)
+            self._recompute()
+
+    def remove_copy(self, allocation_id: str) -> None:
+        """Copy failed / node left: master removes it from the in-sync set
+        (reference: shard-failed → in-sync set shrink)."""
+        with self._lock:
+            if allocation_id == self.shard_allocation_id:
+                raise ValueError("cannot remove the primary's own copy")
+            self._in_sync.discard(allocation_id)
+            self._tracked.discard(allocation_id)
+            self._local_checkpoints.pop(allocation_id, None)
+            self._recompute()
+
+    @property
+    def in_sync_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._in_sync)
+
+    # ---------------- checkpoints ----------------
+
+    def update_local_checkpoint(self, allocation_id: str, checkpoint: int) -> None:
+        with self._lock:
+            prev = self._local_checkpoints.get(allocation_id, NO_OPS_PERFORMED)
+            if checkpoint > prev:
+                self._local_checkpoints[allocation_id] = checkpoint
+                self._recompute()
+
+    def _recompute(self) -> None:
+        cps = [self._local_checkpoints[a] for a in self._in_sync
+               if a in self._local_checkpoints]
+        if cps:
+            gcp = min(cps)
+            if gcp > self._global_checkpoint:
+                self._global_checkpoint = gcp
+
+    @property
+    def global_checkpoint(self) -> int:
+        with self._lock:
+            return self._global_checkpoint
+
+    def local_checkpoint_of(self, allocation_id: str) -> int:
+        with self._lock:
+            return self._local_checkpoints.get(allocation_id, UNASSIGNED_SEQ_NO)
+
+    # ---------------- retention leases ----------------
+
+    def add_lease(self, lease_id: str, retaining_seq_no: int,
+                  source: str, now: Optional[float] = None) -> RetentionLease:
+        with self._lock:
+            lease = RetentionLease(lease_id, retaining_seq_no,
+                                   now if now is not None else time.time(),
+                                   source)
+            self._leases[lease_id] = lease
+            return lease
+
+    def renew_lease(self, lease_id: str, retaining_seq_no: int,
+                    now: Optional[float] = None) -> None:
+        with self._lock:
+            lease = self._leases[lease_id]
+            lease.retaining_seq_no = max(lease.retaining_seq_no, retaining_seq_no)
+            lease.timestamp = now if now is not None else time.time()
+
+    def remove_lease(self, lease_id: str) -> None:
+        with self._lock:
+            self._leases.pop(lease_id, None)
+
+    def leases(self, now: Optional[float] = None) -> List[RetentionLease]:
+        with self._lock:
+            now = now if now is not None else time.time()
+            return [l for l in self._leases.values()
+                    if now - l.timestamp < self._lease_expiry]
+
+    def min_retained_seq_no(self, now: Optional[float] = None) -> int:
+        """History below this can be trimmed (no lease needs it)."""
+        live = self.leases(now)
+        if not live:
+            return self._global_checkpoint + 1
+        return min(min(l.retaining_seq_no for l in live),
+                   self._global_checkpoint + 1)

@@ -1,0 +1,146 @@
+"""IndexShard — the per-shard orchestration object.
+
+Reference: `index/shard/IndexShard` (SURVEY.md §2.1#23): routes operations
+to the engine with primary-term/seqno bookkeeping, tracks the replication
+group on primaries (ReplicationTracker), exposes recovery and stats.
+The reference's 4k-line god class shrinks a lot here because threading,
+Lucene plumbing and recovery states live elsewhere; the kept contract is
+the primary/replica op split (§3.2) and checkpoint reporting (§2.1#26).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.index.engine import (DeleteResult, EngineConfig,
+                                            IndexResult, InternalEngine)
+from elasticsearch_tpu.index.reader import ShardReader
+from elasticsearch_tpu.index.seqno import ReplicationTracker
+from elasticsearch_tpu.mapping import MapperService
+
+
+@dataclasses.dataclass
+class ShardId:
+    index_name: str
+    shard: int
+
+    def __str__(self) -> str:
+        return f"[{self.index_name}][{self.shard}]"
+
+    def __hash__(self):
+        return hash((self.index_name, self.shard))
+
+
+class IndexShard:
+    def __init__(self, shard_id: ShardId, path: str, mapper: MapperService,
+                 *, primary: bool, allocation_id: str, primary_term: int = 1,
+                 k1: float = 1.2, b: float = 0.75,
+                 durability: str = "request"):
+        self.shard_id = shard_id
+        self.allocation_id = allocation_id
+        self.primary = primary
+        self.primary_term = primary_term
+        self._lock = threading.Lock()
+        self.engine = InternalEngine(EngineConfig(
+            path=path, mapper=mapper, primary_term=primary_term,
+            durability=durability, k1=k1, b=b))
+        self.tracker: Optional[ReplicationTracker] = (
+            ReplicationTracker(allocation_id) if primary else None)
+        if self.tracker is not None:
+            self.tracker.update_local_checkpoint(
+                allocation_id, self.engine.tracker.processed_checkpoint)
+
+    # ---------------- write ops ----------------
+
+    def apply_index_on_primary(self, doc_id: str, source: dict,
+                               **version_kwargs) -> IndexResult:
+        self._ensure_primary()
+        result = self.engine.index(doc_id, source, **version_kwargs)
+        self._update_own_checkpoint()
+        return result
+
+    def apply_delete_on_primary(self, doc_id: str, **version_kwargs) -> DeleteResult:
+        self._ensure_primary()
+        result = self.engine.delete(doc_id, **version_kwargs)
+        self._update_own_checkpoint()
+        return result
+
+    def apply_index_on_replica(self, doc_id: str, source: dict, *,
+                               seq_no: int, primary_term: int,
+                               version: int) -> IndexResult:
+        return self.engine.index(doc_id, source, seq_no=seq_no,
+                                 primary_term=primary_term, version=version)
+
+    def apply_delete_on_replica(self, doc_id: str, *, seq_no: int,
+                                primary_term: int) -> DeleteResult:
+        return self.engine.delete(doc_id, seq_no=seq_no,
+                                  primary_term=primary_term)
+
+    def _ensure_primary(self) -> None:
+        if not self.primary:
+            raise IllegalArgumentException(
+                f"{self.shard_id} is not a primary")
+
+    def _update_own_checkpoint(self) -> None:
+        if self.tracker is not None:
+            self.tracker.update_local_checkpoint(
+                self.allocation_id, self.engine.tracker.processed_checkpoint)
+
+    # ---------------- promotion / term bumps ----------------
+
+    def promote_to_primary(self, new_primary_term: int) -> None:
+        """Replica → primary on failover (reference: in-sync promotion,
+        SURVEY.md §5.3): bump term, start tracking the group."""
+        with self._lock:
+            self.primary = True
+            self.primary_term = new_primary_term
+            self.engine.config.primary_term = new_primary_term
+            self.tracker = ReplicationTracker(self.allocation_id)
+            self.tracker.update_local_checkpoint(
+                self.allocation_id, self.engine.tracker.processed_checkpoint)
+
+    # ---------------- reads ----------------
+
+    def get(self, doc_id: str) -> Optional[Dict[str, Any]]:
+        return self.engine.get(doc_id)
+
+    def acquire_searcher(self) -> ShardReader:
+        return self.engine.acquire_reader()
+
+    # ---------------- maintenance ----------------
+
+    def refresh(self) -> bool:
+        return self.engine.refresh()
+
+    def flush(self) -> None:
+        self.engine.flush()
+
+    def close(self) -> None:
+        self.engine.close()
+
+    # ---------------- checkpoints ----------------
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self.engine.tracker.processed_checkpoint
+
+    @property
+    def global_checkpoint(self) -> int:
+        if self.tracker is not None:
+            return self.tracker.global_checkpoint
+        return self._replica_global_checkpoint if hasattr(
+            self, "_replica_global_checkpoint") else -1
+
+    def update_global_checkpoint_on_replica(self, gcp: int) -> None:
+        self._replica_global_checkpoint = gcp
+
+    def stats(self) -> Dict[str, Any]:
+        s = self.engine.stats()
+        s.update({"shard": self.shard_id.shard,
+                  "primary": self.primary,
+                  "allocation_id": self.allocation_id,
+                  "global_checkpoint": self.global_checkpoint})
+        return s

@@ -1,0 +1,6 @@
+"""Node-level index registry (reference: indices/, SURVEY.md §2.1#21)."""
+
+from elasticsearch_tpu.indices.service import (  # noqa: F401
+    IndexService,
+    IndicesService,
+)

@@ -1,0 +1,194 @@
+"""IndicesService / IndexService — node-level registry of open indices.
+
+Reference: `indices/IndicesService` + `index/IndexService` (SURVEY.md
+§2.1#21-22): creates and lifecycle-manages `IndexShard`s, owns per-index
+settings and the mapper. Routing a doc id to a shard uses the reference's
+exact function: murmur3_x86_32(utf8(_routing or _id)) mod num_shards
+(cluster/routing/OperationRouting#shardId, Murmur3HashFunction §2.1#19)
+so external routing behavior is bit-identical.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IndexAlreadyExistsException,
+    IndexNotFoundException,
+    ShardNotFoundException,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.shard import IndexShard, ShardId
+from elasticsearch_tpu.mapping import MapperService
+
+
+def murmur3_hash(key: str, encoding: str = "utf-16-le") -> int:
+    """murmur3_x86_32, seed 0, as signed i32. The reference's
+    Murmur3HashFunction#hash(String) feeds TWO BYTES PER JAVA CHAR
+    (little-endian UTF-16 code units), not UTF-8 — utf-16-le reproduces
+    that exactly, surrogate pairs included, so routing is bit-identical
+    (cluster/routing/Murmur3HashFunction, SURVEY.md §2.1#19)."""
+    data = key.encode(encoding)
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h1 = 0
+    n = len(data) & ~3
+    for i in range(0, n, 4):
+        k1 = int.from_bytes(data[i:i + 4], "little")
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+        h1 = ((h1 << 13) | (h1 >> 19)) & 0xFFFFFFFF
+        h1 = (h1 * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k1 = 0
+    tail = len(data) & 3
+    if tail >= 3:
+        k1 ^= data[n + 2] << 16
+    if tail >= 2:
+        k1 ^= data[n + 1] << 8
+    if tail >= 1:
+        k1 ^= data[n]
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+    h1 ^= len(data)
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1 - (1 << 32) if h1 >= (1 << 31) else h1
+
+
+def shard_for(routing: str, num_shards: int) -> int:
+    """OperationRouting#shardId: floorMod(murmur3(routing), num_shards)."""
+    return murmur3_hash(routing) % num_shards
+
+
+class IndexService:
+    """One open index on this node: settings, mapper, local shards."""
+
+    def __init__(self, name: str, index_uuid: str, settings: Settings,
+                 mapping: Optional[dict], data_path: str):
+        self.name = name
+        self.index_uuid = index_uuid
+        self.settings = settings
+        self.num_shards = settings.get_int("index.number_of_shards", 1)
+        self.num_replicas = settings.get_int("index.number_of_replicas", 0)
+        self.mapper = MapperService(settings, mapping)
+        self.data_path = data_path
+        self.shards: Dict[int, IndexShard] = {}
+        self._k1 = settings.get_float("index.similarity.default.k1", 1.2)
+        self._b = settings.get_float("index.similarity.default.b", 0.75)
+        self._durability = settings.get("index.translog.durability", "request")
+
+    def create_shard(self, shard_num: int, *, primary: bool = True,
+                     allocation_id: Optional[str] = None) -> IndexShard:
+        if shard_num in self.shards:
+            return self.shards[shard_num]
+        shard = IndexShard(
+            ShardId(self.name, shard_num),
+            os.path.join(self.data_path, str(shard_num)),
+            self.mapper, primary=primary,
+            allocation_id=allocation_id or str(uuid.uuid4()),
+            k1=self._k1, b=self._b, durability=self._durability)
+        self.shards[shard_num] = shard
+        return shard
+
+    def shard(self, shard_num: int) -> IndexShard:
+        s = self.shards.get(shard_num)
+        if s is None:
+            raise ShardNotFoundException(
+                f"shard [{self.name}][{shard_num}] not found on this node")
+        return s
+
+    def shard_for_id(self, doc_id: str, routing: Optional[str] = None) -> int:
+        return shard_for(routing or doc_id, self.num_shards)
+
+    def refresh(self) -> None:
+        for s in self.shards.values():
+            s.refresh()
+
+    def flush(self) -> None:
+        for s in self.shards.values():
+            s.flush()
+
+    def close(self) -> None:
+        for s in self.shards.values():
+            s.close()
+
+    def stats(self) -> Dict[str, Any]:
+        docs = sum(s.engine.num_docs() for s in self.shards.values())
+        return {"uuid": self.index_uuid, "shards": len(self.shards),
+                "docs": {"count": docs},
+                "per_shard": [s.stats() for s in self.shards.values()]}
+
+
+class IndicesService:
+    """Registry of open indices on this node (reference: IndicesService)."""
+
+    def __init__(self, data_path: str):
+        self.data_path = data_path
+        self._lock = threading.Lock()
+        self.indices: Dict[str, IndexService] = {}
+
+    def create_index(self, name: str, settings: Optional[Settings] = None,
+                     mapping: Optional[dict] = None,
+                     index_uuid: Optional[str] = None,
+                     create_shards: bool = True) -> IndexService:
+        with self._lock:
+            if name in self.indices:
+                raise IndexAlreadyExistsException(f"index [{name}] already exists")
+            _validate_index_name(name)
+            settings = settings or Settings.EMPTY
+            index_uuid = index_uuid or str(uuid.uuid4())
+            svc = IndexService(name, index_uuid, settings, mapping,
+                               os.path.join(self.data_path, index_uuid))
+            if create_shards:
+                for i in range(svc.num_shards):
+                    svc.create_shard(i, primary=True)
+            self.indices[name] = svc
+            return svc
+
+    def index(self, name: str) -> IndexService:
+        svc = self.indices.get(name)
+        if svc is None:
+            raise IndexNotFoundException(f"no such index [{name}]")
+        return svc
+
+    def has_index(self, name: str) -> bool:
+        return name in self.indices
+
+    def delete_index(self, name: str) -> None:
+        with self._lock:
+            svc = self.indices.pop(name, None)
+            if svc is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            svc.close()
+            import shutil
+            shutil.rmtree(svc.data_path, ignore_errors=True)
+
+    def close(self) -> None:
+        for svc in self.indices.values():
+            svc.close()
+
+    def stats(self) -> Dict[str, Any]:
+        return {name: svc.stats() for name, svc in self.indices.items()}
+
+
+def _validate_index_name(name: str) -> None:
+    """Reference: MetadataCreateIndexService#validateIndexName."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentException
+    if not name or name != name.lower():
+        raise IllegalArgumentException(
+            f"invalid index name [{name}], must be lowercase")
+    if name.startswith(("_", "-", "+")) or name in (".", ".."):
+        raise IllegalArgumentException(f"invalid index name [{name}]")
+    bad = set('\\/*?"<>| ,#:')
+    if any(c in bad for c in name):
+        raise IllegalArgumentException(
+            f"invalid index name [{name}], contains illegal characters")

@@ -152,6 +152,14 @@ class MapperService:
     def field_type(self, path: str) -> Optional[FieldType]:
         return self.mapper.fields.get(path)
 
+    def dv_kinds(self) -> Dict[str, str]:
+        """field → doc-value column kind, for SegmentWriter.add_document."""
+        return {f: t.dv_kind for f, t in self.mapper.fields.items()
+                if getattr(t, "dv_kind", "none") != "none"}
+
+    def to_mapping(self) -> dict:
+        return self.mapper.to_mapping()
+
     # ---------------- document parsing ----------------
 
     def parse_document(self, doc_id: str, source: Dict[str, Any],

@@ -117,11 +117,24 @@ class SlotPlan:
     min_count: np.ndarray  # int32[R]
     max_len: int          # L_c (static bucket)
     t_slots: int          # T (static)
+    window: int           # max same-doc entries per row = max terms/row
+                          # (chunks of one term partition docs, so the
+                          # kernel's t_window only needs to cover TERMS,
+                          # not slots — far fewer taps on chunked queries)
 
 
 def _len_bucket(n: int, lane: int = 128) -> int:
     b = lane
     while b < n:
+        b *= 2
+    return b
+
+
+def _cap_bucket(cap: int, lane: int) -> int:
+    """Largest lane-based power-of-two bucket that does NOT exceed cap
+    (rounding the cap UP would overrun callers' flat-array slack)."""
+    b = lane
+    while b * 2 <= cap:
         b *= 2
     return b
 
@@ -132,13 +145,15 @@ def plan_slots(rows: Sequence[Sequence[Tuple[int, int, float, int]]],
                lane: int = 128) -> SlotPlan:
     """rows[r] = [(start, length, weight, term_id), ...] — one entry per
     query term with its postings-row extent in the flat arrays. Long rows
-    split into chunks of ≤ L_c where L_c = min(chunk_cap, bucket(max row
-    length)). Returns padded static-shape slot tensors."""
+    split into chunks of ≤ L_c where L_c = min(bucket(max row length),
+    largest bucket ≤ chunk_cap). Returns padded static-shape slot tensors."""
     longest = 1
+    window = 1
     for row in rows:
+        window = max(window, len(row))
         for (_, ln, _, _) in row:
             longest = max(longest, ln)
-    max_len = min(_len_bucket(longest, lane), _len_bucket(chunk_cap, lane))
+    max_len = min(_len_bucket(longest, lane), _cap_bucket(chunk_cap, lane))
 
     chunked: List[List[Tuple[int, int, float, int]]] = []
     t_needed = 1
@@ -170,7 +185,8 @@ def plan_slots(rows: Sequence[Sequence[Tuple[int, int, float, int]]],
             lengths[ri, ti] = ln
             weights[ri, ti] = w
     return SlotPlan(starts, lengths, weights,
-                    np.asarray(min_counts, dtype=np.int32), max_len, t_slots)
+                    np.asarray(min_counts, dtype=np.int32), max_len, t_slots,
+                    window)
 
 
 def eager_impacts(flat_docs: np.ndarray, flat_tfs: np.ndarray,

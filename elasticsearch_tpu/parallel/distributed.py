@@ -166,6 +166,8 @@ class QueryBatch:
     min_count: np.ndarray  # int32[B]
     max_len: int
     t_slots: int
+    window: int            # max same-doc entries per row (= max terms/query)
+    need_counts: bool      # any query has min_count > 1 (msm/AND)
 
 
 def prepare_query_batch(pack: StackedShardPack,
@@ -217,11 +219,12 @@ def prepare_query_batch(pack: StackedShardPack,
             mins.append(int(min_counts[qi]) if min_counts is not None else 1)
     plan = sparse.plan_slots(rows, mins, chunk_cap=chunk_cap)
     shape3 = (s, b, plan.t_slots)
+    mc = plan.min_count.reshape(s, b)[0].copy()
     return QueryBatch(plan.starts.reshape(shape3),
                       plan.lengths.reshape(shape3),
                       plan.weights.reshape(shape3),
-                      plan.min_count.reshape(s, b)[0].copy(),
-                      plan.max_len, plan.t_slots)
+                      mc, plan.max_len, plan.t_slots, plan.window,
+                      bool((mc > 1).any()))
 
 
 # ---------------------------------------------------------------------------
@@ -330,15 +333,18 @@ def device_put_pack(pack: StackedShardPack, mesh: Optional[Mesh] = None):
 
 def distributed_search(pack: StackedShardPack, batch: QueryBatch, k: int,
                        mesh: Mesh, device_arrays=None,
-                       with_counts: bool = False):
+                       with_counts: Optional[bool] = None):
     """Run one distributed query step. Returns (scores [B,k'], refs) where
-    refs[q] = [(score, shard, local_ord), ...] decoded host-side."""
+    refs[q] = [(score, shard, local_ord), ...] decoded host-side.
+    with_counts defaults to the batch's own need (any min_count > 1)."""
     if device_arrays is None:
         device_arrays = device_put_pack(pack, mesh)
+    if with_counts is None:
+        with_counts = batch.need_counts
     flat_docs, flat_impact = device_arrays
     fn = make_distributed_search(
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
-        k=k, t_window=batch.t_slots, with_counts=with_counts)
+        k=k, t_window=batch.window, with_counts=with_counts)
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     db = NamedSharding(mesh, P(DATA_AXIS))
     vals, ids = fn(flat_docs, flat_impact,

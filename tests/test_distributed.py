@@ -110,3 +110,23 @@ class TestDistributedSearch:
         batch = dist.prepare_query_batch(pack, [["w0"]], pad_batch_to=2)
         _, refs = dist.distributed_search(pack, batch, 50, mesh)
         assert all(shard != 0 for _, shard, _ in refs[0])
+
+    def test_and_min_counts_default(self, seeded_np, mesh):
+        """min_counts>1 in the batch must activate counting without the
+        caller passing with_counts explicitly."""
+        segments = make_shards(seeded_np, mesh.shape["shards"], 40)
+        pack = dist.build_stacked_pack(segments, "body")
+        q = ["w0", "w1"]
+        batch = dist.prepare_query_batch(pack, [q], min_counts=[2],
+                                         pad_batch_to=2)
+        assert batch.need_counts
+        _, refs = dist.distributed_search(pack, batch, 500, mesh)
+        got = {(s, d) for _, s, d in refs[0]}
+        # oracle: docs containing BOTH terms
+        expected = set()
+        for si, seg in enumerate(segments):
+            p = seg.postings.get("body", {})
+            d0 = set(int(x) for x in p.get("w0", (np.array([]), 0))[0])
+            d1 = set(int(x) for x in p.get("w1", (np.array([]), 0))[0])
+            expected |= {(si, d) for d in d0 & d1}
+        assert got == expected

@@ -1,0 +1,260 @@
+"""Write-path tests: engine CRUD/versioning, translog durability and
+corruption, crash/resume, merges — the InternalEngineTests/TranslogTests
+shape from the reference (SURVEY.md §4.3)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (TranslogCorruptedException,
+                                             VersionConflictEngineException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import EngineConfig, InternalEngine
+from elasticsearch_tpu.index.seqno import (LocalCheckpointTracker,
+                                           ReplicationTracker)
+from elasticsearch_tpu.index.translog import Translog, TranslogOp
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.query_phase import execute_query
+
+MAPPING = {"properties": {"title": {"type": "text"},
+                          "views": {"type": "long"}}}
+
+
+def make_engine(path, **kw):
+    ms = MapperService(Settings.EMPTY, MAPPING)
+    return InternalEngine(EngineConfig(path=str(path), mapper=ms, **kw))
+
+
+def search_ids(engine, text):
+    reader = engine.acquire_reader()
+    res = execute_query(reader, dsl.MatchQuery(field="title", query=text), size=100)
+    return [h.doc_id for h in res.hits]
+
+
+class TestLocalCheckpointTracker:
+    def test_contiguous_advance(self):
+        t = LocalCheckpointTracker()
+        s0, s1, s2 = t.generate_seq_no(), t.generate_seq_no(), t.generate_seq_no()
+        assert (s0, s1, s2) == (0, 1, 2)
+        t.mark_processed(s1)
+        assert t.processed_checkpoint == -1  # gap at 0
+        t.mark_processed(s0)
+        assert t.processed_checkpoint == 1
+        t.mark_processed(s2)
+        assert t.processed_checkpoint == 2
+
+    def test_replica_advance(self):
+        t = LocalCheckpointTracker()
+        t.advance_max_seq_no(5)
+        assert t.max_seq_no == 5
+        assert t.generate_seq_no() == 6
+
+
+class TestReplicationTracker:
+    def test_global_checkpoint_min_over_in_sync(self):
+        rt = ReplicationTracker("p")
+        rt.update_local_checkpoint("p", 10)
+        assert rt.global_checkpoint == 10
+        rt.mark_in_sync("r1")
+        rt.update_local_checkpoint("r1", 4)
+        rt.update_local_checkpoint("p", 12)
+        # gcp stays at min(12, 4)... but never goes backwards from 10
+        assert rt.global_checkpoint == 10
+        rt.update_local_checkpoint("r1", 11)
+        assert rt.global_checkpoint == 11
+        rt.remove_copy("r1")
+        rt.update_local_checkpoint("p", 20)
+        assert rt.global_checkpoint == 20
+
+    def test_retention_leases(self):
+        rt = ReplicationTracker("p")
+        rt.update_local_checkpoint("p", 9)
+        rt.add_lease("peer-r1", 3, "peer recovery", now=100.0)
+        assert rt.min_retained_seq_no(now=101.0) == 3
+        rt.remove_lease("peer-r1")
+        assert rt.min_retained_seq_no(now=101.0) == 10
+
+
+class TestTranslog:
+    def test_roundtrip_and_torn_tail(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add(TranslogOp("index", 0, 1, "a", {"x": 1}))
+        tl.add(TranslogOp("delete", 1, 1, "a"))
+        tl.close()
+        # torn tail: partial record appended (crash mid-write)
+        gen_file = tmp_path / "tl" / "translog-1.tlog"
+        with open(gen_file, "ab") as f:
+            f.write(b"\x40\x00\x00\x00\x12")
+        tl2 = Translog(str(tmp_path / "tl"))
+        ops = list(tl2.snapshot())
+        assert [(o.op_type, o.seq_no) for o in ops] == [("index", 0), ("delete", 1)]
+
+    def test_crc_corruption_detected(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add(TranslogOp("index", 0, 1, "a", {"x": "y" * 50}))
+        tl.close()
+        gen_file = tmp_path / "tl" / "translog-1.tlog"
+        data = bytearray(gen_file.read_bytes())
+        data[30] ^= 0xFF  # flip a payload bit
+        gen_file.write_bytes(bytes(data))
+        tl2 = Translog(str(tmp_path / "tl"))
+        with pytest.raises(TranslogCorruptedException):
+            list(tl2.snapshot())
+
+    def test_rollover_and_trim(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add(TranslogOp("index", 0, 1, "a", {}))
+        gen = tl.rollover()
+        tl.add(TranslogOp("index", 1, 1, "b", {}))
+        assert len(list(tl.snapshot())) == 2
+        tl.trim(gen)
+        assert [o.seq_no for o in tl.snapshot()] == [1]
+
+
+class TestEngineCrud:
+    def test_index_get_update_delete(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        r1 = e.index("1", {"title": "hello world", "views": 3})
+        assert (r1.version, r1.created, r1.seq_no) == (1, True, 0)
+        got = e.get("1")  # realtime get before refresh
+        assert got["_source"]["title"] == "hello world"
+        r2 = e.index("1", {"title": "hello again", "views": 4})
+        assert (r2.version, r2.created, r2.result) == (2, False, "updated")
+        d = e.delete("1")
+        assert d.found and d.version == 3
+        assert e.get("1") is None
+        e.close()
+
+    def test_version_conflict_if_seq_no(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        r = e.index("1", {"title": "a"})
+        e.index("1", {"title": "b"})  # bumps seq_no
+        with pytest.raises(VersionConflictEngineException):
+            e.index("1", {"title": "c"}, if_seq_no=r.seq_no, if_primary_term=1)
+        e.close()
+
+    def test_external_versioning(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        e.index("1", {"title": "a"}, version=5, version_type="external")
+        with pytest.raises(VersionConflictEngineException):
+            e.index("1", {"title": "b"}, version=5, version_type="external")
+        r = e.index("1", {"title": "b"}, version=9, version_type="external")
+        assert r.version == 9
+        e.close()
+
+    def test_refresh_visibility(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        e.index("1", {"title": "quick fox"})
+        assert search_ids(e, "fox") == []  # not refreshed yet
+        e.refresh()
+        assert search_ids(e, "fox") == ["1"]
+        e.index("1", {"title": "lazy dog"})  # update tombstones old copy
+        e.refresh()
+        assert search_ids(e, "fox") == []
+        assert search_ids(e, "dog") == ["1"]
+        e.close()
+
+    def test_delete_then_search(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        for i in range(5):
+            e.index(str(i), {"title": f"doc number {i} fox"})
+        e.refresh()
+        assert len(search_ids(e, "fox")) == 5
+        e.delete("2")
+        e.refresh()
+        assert sorted(search_ids(e, "fox")) == ["0", "1", "3", "4"]
+        e.close()
+
+
+class TestEngineDurability:
+    def test_flush_and_reopen(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        e.index("1", {"title": "persisted fox", "views": 1})
+        e.index("2", {"title": "persisted dog", "views": 2})
+        e.flush()
+        e.close()
+        e2 = make_engine(tmp_path / "e")
+        assert e2.num_docs() == 2
+        assert sorted(search_ids(e2, "persisted")) == ["1", "2"]
+        assert e2.get("1")["_source"]["views"] == 1
+        e2.close()
+
+    def test_translog_replay_without_flush(self, tmp_path):
+        """Crash before flush: ops only in the translog must replay
+        (SURVEY.md §3.1 startup hot path)."""
+        e = make_engine(tmp_path / "e")
+        e.index("1", {"title": "wal only"})
+        e.index("2", {"title": "wal too"})
+        # simulate crash: no flush, no close (translog fsync'd per op)
+        e.translog.close()
+        e2 = make_engine(tmp_path / "e")
+        assert sorted(search_ids(e2, "wal")) == ["1", "2"]
+        assert e2.tracker.max_seq_no == 1
+        e2.close()
+
+    def test_commit_plus_tail_replay(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        e.index("1", {"title": "committed"})
+        e.flush()
+        e.index("2", {"title": "tail"})
+        e.delete("1")
+        e.translog.close()  # crash
+        e2 = make_engine(tmp_path / "e")
+        assert search_ids(e2, "committed") == []
+        assert search_ids(e2, "tail") == ["2"]
+        assert e2.num_docs() == 1
+        e2.close()
+
+    def test_tombstones_survive_flush(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        for i in range(4):
+            e.index(str(i), {"title": "keep me"})
+        e.flush()
+        e.delete("1")
+        e.flush()
+        e.close()
+        e2 = make_engine(tmp_path / "e")
+        assert sorted(search_ids(e2, "keep")) == ["0", "2", "3"]
+        e2.close()
+
+    def test_updates_replay_idempotent(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        for v in range(3):
+            e.index("1", {"title": f"rev {v} doc"})
+        e.translog.close()
+        e2 = make_engine(tmp_path / "e")
+        assert e2.num_docs() == 1
+        assert search_ids(e2, "rev") == ["1"]
+        got = e2.get("1")
+        assert got["_source"]["title"] == "rev 2 doc"
+        e2.close()
+
+
+class TestEngineMerge:
+    def test_force_merge_purges_tombstones(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        for i in range(6):
+            e.index(str(i), {"title": "merge fodder"})
+            e.refresh()  # one segment per doc
+        assert e.segment_count() == 6
+        e.delete("3")
+        e.refresh()
+        e.force_merge()
+        assert e.segment_count() == 1
+        assert sorted(search_ids(e, "fodder")) == ["0", "1", "2", "4", "5"]
+        # update-after-merge still works (version map relocated)
+        r = e.index("0", {"title": "merge fodder updated"})
+        assert r.result == "updated"
+        e.close()
+
+    def test_maybe_merge_trigger(self, tmp_path):
+        e = make_engine(tmp_path / "e", merge_segment_count_trigger=3)
+        for i in range(3):
+            e.index(str(i), {"title": "x y z"})
+            e.refresh()
+        assert e.maybe_merge() is True
+        assert e.segment_count() == 1
+        e.close()

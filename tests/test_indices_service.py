@@ -1,0 +1,134 @@
+"""IndexShard / IndicesService / routing tests (reference shapes:
+IndexShardTests, OperationRoutingTests — SURVEY.md §2.1#19/21/23)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             IndexAlreadyExistsException,
+                                             IndexNotFoundException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.indices.service import (IndicesService, murmur3_hash,
+                                               shard_for)
+
+
+class TestMurmur3Routing:
+    def test_published_vectors_utf8(self):
+        """Austin Appleby's murmur3_x86_32 seed-0 vectors (byte-level
+        correctness of the hash core, fed UTF-8 here)."""
+        vectors = [("", 0x0), ("a", 0x3C2569B2), ("abc", 0xB3DD93FA),
+                   ("hello", 0x248BFA47), ("Hello, world!", 0xC0363E43),
+                   ("The quick brown fox jumps over the lazy dog", 0x2E4FF723)]
+        for s, exp in vectors:
+            assert murmur3_hash(s, encoding="utf-8") & 0xFFFFFFFF == exp
+
+    def test_default_encoding_is_java_chars(self):
+        """ES's Murmur3HashFunction hashes 2 bytes per Java char
+        (little-endian UTF-16 code units) — ascii 'a' becomes b'a\\x00'."""
+        assert murmur3_hash("a") == murmur3_hash_bytes_oracle(b"a\x00")
+        assert murmur3_hash("ab") == murmur3_hash_bytes_oracle(b"a\x00b\x00")
+
+    def test_shard_distribution(self):
+        counts = [0] * 5
+        for i in range(2000):
+            counts[shard_for(f"doc-{i}", 5)] += 1
+        # murmur3 spreads well; each shard gets its fair share ±40%
+        for c in counts:
+            assert 0.6 * 400 < c < 1.4 * 400
+
+    def test_routing_stability(self):
+        assert shard_for("my-doc", 8) == shard_for("my-doc", 8)
+        assert 0 <= shard_for("x", 3) < 3
+
+
+def murmur3_hash_bytes_oracle(data: bytes) -> int:
+    """Independent reimplementation over raw bytes for the encoding test."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h1 = 0
+    n = len(data) & ~3
+    for i in range(0, n, 4):
+        k1 = int.from_bytes(data[i:i + 4], "little")
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+        h1 = ((h1 << 13) | (h1 >> 19)) & 0xFFFFFFFF
+        h1 = (h1 * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k1 = 0
+    tail = len(data) & 3
+    if tail >= 3:
+        k1 ^= data[n + 2] << 16
+    if tail >= 2:
+        k1 ^= data[n + 1] << 8
+    if tail >= 1:
+        k1 ^= data[n]
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+    h1 ^= len(data)
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1 - (1 << 32) if h1 >= (1 << 31) else h1
+
+
+class TestIndicesService:
+    def test_create_index_and_crud(self, tmp_path):
+        svc = IndicesService(str(tmp_path))
+        idx = svc.create_index(
+            "logs", Settings.of({"index": {"number_of_shards": 3}}),
+            {"properties": {"msg": {"type": "text"}}})
+        assert idx.num_shards == 3
+        assert len(idx.shards) == 3
+        sid = idx.shard_for_id("doc1")
+        shard = idx.shard(sid)
+        shard.apply_index_on_primary("doc1", {"msg": "hello shard"})
+        assert shard.get("doc1")["_source"]["msg"] == "hello shard"
+        svc.close()
+
+    def test_duplicate_and_missing(self, tmp_path):
+        svc = IndicesService(str(tmp_path))
+        svc.create_index("a")
+        with pytest.raises(IndexAlreadyExistsException):
+            svc.create_index("a")
+        with pytest.raises(IndexNotFoundException):
+            svc.index("nope")
+        svc.delete_index("a")
+        with pytest.raises(IndexNotFoundException):
+            svc.delete_index("a")
+        svc.close()
+
+    @pytest.mark.parametrize("bad", ["UPPER", "_hidden", "a b", "x/y", ".."])
+    def test_invalid_names(self, tmp_path, bad):
+        svc = IndicesService(str(tmp_path))
+        with pytest.raises(IllegalArgumentException):
+            svc.create_index(bad)
+
+    def test_shard_reopen_from_disk(self, tmp_path):
+        svc = IndicesService(str(tmp_path))
+        idx = svc.create_index("persist", index_uuid="fixed-uuid")
+        shard = idx.shard(0)
+        shard.apply_index_on_primary("d", {"field": "value"})
+        shard.flush()
+        svc.close()
+        svc2 = IndicesService(str(tmp_path))
+        idx2 = svc2.create_index("persist", index_uuid="fixed-uuid")
+        assert idx2.shard(0).get("d")["_source"]["field"] == "value"
+        svc2.close()
+
+
+class TestShardPromotion:
+    def test_replica_promotion(self, tmp_path):
+        svc = IndicesService(str(tmp_path))
+        idx = svc.create_index("x", create_shards=False)
+        replica = idx.create_shard(0, primary=False, allocation_id="r1")
+        with pytest.raises(IllegalArgumentException):
+            replica.apply_index_on_primary("d", {"a": 1})
+        replica.apply_index_on_replica("d", {"a": 1}, seq_no=0,
+                                       primary_term=1, version=1)
+        replica.promote_to_primary(2)
+        r = replica.apply_index_on_primary("d", {"a": 2})
+        assert r.primary_term == 2 and r.seq_no == 1
+        svc.close()

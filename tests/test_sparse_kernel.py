@@ -51,7 +51,7 @@ def run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k, chunk_cap=4096,
         jnp.asarray(plan.starts), jnp.asarray(plan.lengths),
         jnp.asarray(plan.weights), jnp.asarray(plan.min_count),
         max_len=plan.max_len, d_pad=d_pad, k=k,
-        t_window=plan.t_slots, with_counts=with_counts)
+        t_window=plan.window, with_counts=with_counts)
     return np.asarray(vals), np.asarray(docs)
 
 
@@ -136,3 +136,20 @@ class TestSortedMergeTopk:
         rows = [[(0, 2, 1.0, 0)]]
         vals, docs = run_kernel(flat_docs, flat_imp, rows, [1], d_pad, k=2)
         assert docs[0][0] == 5 and docs[0][1] == 9
+
+
+class TestPlanSlots:
+    def test_chunk_cap_never_exceeded(self):
+        # non-power-of-two cap rounds DOWN (callers size flat-array slack
+        # to the cap; a bigger bucket would overrun it)
+        rows = [[(0, 3000, 1.0, 0)]]
+        plan = sparse.plan_slots(rows, [1], chunk_cap=3000, lane=128)
+        assert plan.max_len <= 3000
+        assert plan.max_len == 2048
+        assert plan.window == 1  # one term, chunks don't widen the window
+
+    def test_window_counts_terms_not_chunks(self):
+        rows = [[(0, 100, 1.0, 0), (100, 50, 1.0, 1)]]
+        plan = sparse.plan_slots(rows, [1], chunk_cap=16, lane=8)
+        assert plan.t_slots >= 8  # many chunks
+        assert plan.window == 2   # but only 2 terms
