@@ -1,0 +1,174 @@
+"""Node — the composition root + HTTP server.
+
+Reference: `node/Node` + `http/` (SURVEY.md §2.1#2/9, §3.1): constructs
+every service, wires the REST controller, serves JSON over HTTP. The
+reference's Netty pipeline becomes a stdlib ThreadingHTTPServer — the
+data path's heavy work is on-device, so the host HTTP layer only needs to
+parse/route (SURVEY.md §7.1: host is control plane).
+
+Run: python -m elasticsearch_tpu.node --port 9200 --data-path /tmp/data
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.indices.service import IndexService, IndicesService
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Node:
+    def __init__(self, data_path: str, *,
+                 node_name: str = "node-1",
+                 cluster_name: str = "elasticsearch-tpu",
+                 settings: Optional[Settings] = None):
+        self.settings = settings or Settings.EMPTY
+        self.node_name = node_name
+        self.node_id = uuid.uuid4().hex[:20]
+        self.cluster_name = cluster_name
+        self.cluster_uuid = uuid.uuid4().hex[:20]
+        self.indices = IndicesService(data_path)
+        self.controller = RestController()
+        self._register_actions()
+        self._refresh_interval = self.settings.get_float(
+            "index.refresh_interval_seconds", 1.0)
+        self._refresher: Optional[threading.Timer] = None
+        self._closed = False
+
+    def _register_actions(self) -> None:
+        from elasticsearch_tpu.rest.actions import (admin, cluster, document,
+                                                    search)
+        for module in (document, search, admin, cluster):
+            module.register(self.controller, self)
+
+    # ---------------- index helpers ----------------
+
+    def create_index(self, name: str, settings: Settings,
+                     mappings: Optional[dict]) -> IndexService:
+        return self.indices.create_index(name, settings, mappings)
+
+    def get_or_autocreate_index(self, name: str) -> IndexService:
+        """Reference: auto-create on first doc (action.auto_create_index,
+        default on)."""
+        if not self.indices.has_index(name):
+            if not self.settings.get_bool("action.auto_create_index", True):
+                from elasticsearch_tpu.common.errors import IndexNotFoundException
+                raise IndexNotFoundException(f"no such index [{name}] and "
+                                             f"auto-create is disabled")
+            return self.indices.create_index(name)
+        return self.indices.index(name)
+
+    # ---------------- background refresh (NRT cycle) ----------------
+
+    def start_refresher(self) -> None:
+        """The 1s refresh cycle (reference: IndexService#refreshTask §3.2)."""
+        def tick():
+            if self._closed:
+                return
+            for svc in list(self.indices.indices.values()):
+                try:
+                    svc.refresh()
+                except Exception:  # noqa: BLE001 — background task
+                    pass
+            self._refresher = threading.Timer(self._refresh_interval, tick)
+            self._refresher.daemon = True
+            self._refresher.start()
+        self._refresher = threading.Timer(self._refresh_interval, tick)
+        self._refresher.daemon = True
+        self._refresher.start()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._refresher:
+            self._refresher.cancel()
+        self.indices.close()
+
+    # ---------------- in-process dispatch (tests + http) ----------------
+
+    def handle(self, method: str, path: str,
+               params: Optional[Dict[str, str]] = None,
+               body: Any = None, raw_body: bytes = b""):
+        if body is None and raw_body:
+            text = raw_body.decode("utf-8", errors="replace")
+            if path.endswith("/_bulk"):
+                body = text
+            elif text.strip():
+                from elasticsearch_tpu.common.errors import ParsingException
+                try:
+                    body = json.loads(text)
+                except json.JSONDecodeError as e:
+                    return 400, {"error": {"type": "parsing_exception",
+                                           "reason": str(e)}, "status": 400}
+        return self.controller.dispatch(method, path, params, body, raw_body)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    node: Node = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def _do(self):
+        parsed = urlparse(self.path)
+        params = {k: v[0] if v else "" for k, v in
+                  parse_qs(parsed.query, keep_blank_values=True).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        status, payload = self.node.handle(self.command, parsed.path, params,
+                                           None, raw)
+        if isinstance(payload, dict) and "_cat" in payload and len(payload) == 1:
+            data = payload["_cat"].encode("utf-8")
+            ctype = "text/plain; charset=UTF-8"
+        else:
+            data = json.dumps(payload).encode("utf-8")
+            ctype = "application/json; charset=UTF-8"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("X-elastic-product", "Elasticsearch-TPU")
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _do
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+def serve(node: Node, host: str = "127.0.0.1", port: int = 9200
+          ) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (_Handler,), {"node": node})
+    server = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="elasticsearch-tpu node")
+    parser.add_argument("--port", type=int, default=9200)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--data-path", default="./data")
+    parser.add_argument("--node-name", default="node-1")
+    args = parser.parse_args()
+    node = Node(args.data_path, node_name=args.node_name)
+    node.start_refresher()
+    server = serve(node, args.host, args.port)
+    print(f"[{args.node_name}] listening on http://{args.host}:{args.port}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        node.close()
+
+
+if __name__ == "__main__":
+    main()

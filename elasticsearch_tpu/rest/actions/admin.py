@@ -1,0 +1,143 @@
+"""Index administration REST actions: create/delete/get index, mappings,
+settings, refresh/flush/forcemerge, open/close stubs (reference:
+`action/admin/indices/**` + `RestCreateIndexAction` etc., SURVEY.md
+§2.1#49)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from elasticsearch_tpu.common.errors import IndexNotFoundException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.search.coordinator import resolve_indices
+
+
+def register(controller: RestController, node) -> None:
+    indices = node.indices
+
+    def create_index(req: RestRequest):
+        body = req.body or {}
+        settings = Settings.of(body.get("settings") or {})
+        mappings = body.get("mappings")
+        name = req.param("index")
+        node.create_index(name, settings, mappings)
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "index": name}
+
+    def delete_index(req: RestRequest):
+        for name in resolve_indices(indices, req.param("index")):
+            indices.delete_index(name)
+        return 200, {"acknowledged": True}
+
+    def get_index(req: RestRequest):
+        out = {}
+        for name in resolve_indices(indices, req.param("index")):
+            svc = indices.index(name)
+            out[name] = {
+                "aliases": {},
+                "mappings": svc.mapper.to_mapping(),
+                "settings": {"index": {
+                    "number_of_shards": str(svc.num_shards),
+                    "number_of_replicas": str(svc.num_replicas),
+                    "uuid": svc.index_uuid,
+                    **{k[len("index."):]: v for k, v in
+                       svc.settings.get_as_dict().items()
+                       if k.startswith("index.") and k not in
+                       ("index.number_of_shards", "index.number_of_replicas")},
+                }},
+            }
+        if not out:
+            raise IndexNotFoundException(
+                f"no such index [{req.param('index')}]")
+        return 200, out
+
+    def head_index(req: RestRequest):
+        names = resolve_indices(indices, req.param("index"))
+        return (200, {}) if names else (404, {})
+
+    def put_mapping(req: RestRequest):
+        for name in resolve_indices(indices, req.param("index")):
+            indices.index(name).mapper.merge(req.body or {})
+        return 200, {"acknowledged": True}
+
+    def get_mapping(req: RestRequest):
+        out = {}
+        for name in resolve_indices(indices, req.param("index")):
+            out[name] = {"mappings": indices.index(name).mapper.to_mapping()}
+        return 200, out
+
+    def get_settings(req: RestRequest):
+        out = {}
+        for name in resolve_indices(indices, req.param("index")):
+            svc = indices.index(name)
+            out[name] = {"settings": {"index": {
+                "number_of_shards": str(svc.num_shards),
+                "number_of_replicas": str(svc.num_replicas),
+                "uuid": svc.index_uuid}}}
+        return 200, out
+
+    def refresh(req: RestRequest):
+        n = 0
+        for name in resolve_indices(indices, req.param("index")):
+            indices.index(name).refresh()
+            n += indices.index(name).num_shards
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def flush(req: RestRequest):
+        n = 0
+        for name in resolve_indices(indices, req.param("index")):
+            indices.index(name).flush()
+            n += indices.index(name).num_shards
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def forcemerge(req: RestRequest):
+        n = 0
+        for name in resolve_indices(indices, req.param("index")):
+            svc = indices.index(name)
+            for shard in svc.shards.values():
+                shard.engine.force_merge()
+                n += 1
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def index_stats(req: RestRequest):
+        names = resolve_indices(indices, req.param("index"))
+        out_indices = {}
+        total_docs = 0
+        total_segments = 0
+        for name in names:
+            svc = indices.index(name)
+            st = svc.stats()
+            total_docs += st["docs"]["count"]
+            segs = sum(p["segments"] for p in st["per_shard"])
+            total_segments += segs
+            out_indices[name] = {
+                "primaries": {"docs": {"count": st["docs"]["count"]},
+                              "segments": {"count": segs}},
+                "total": {"docs": {"count": st["docs"]["count"]},
+                          "segments": {"count": segs}},
+            }
+        return 200, {
+            "_shards": {"total": sum(indices.index(n).num_shards for n in names)},
+            "_all": {"primaries": {"docs": {"count": total_docs},
+                                   "segments": {"count": total_segments}}},
+            "indices": out_indices,
+        }
+
+    controller.register("PUT", "/{index}", create_index)
+    controller.register("DELETE", "/{index}", delete_index)
+    controller.register("GET", "/{index}", get_index)
+    controller.register("HEAD", "/{index}", head_index)
+    controller.register("PUT", "/{index}/_mapping", put_mapping)
+    controller.register("GET", "/{index}/_mapping", get_mapping)
+    controller.register("GET", "/_mapping", get_mapping)
+    controller.register("GET", "/{index}/_settings", get_settings)
+    controller.register("GET", "/_settings", get_settings)
+    controller.register("POST", "/{index}/_refresh", refresh)
+    controller.register("POST", "/_refresh", refresh)
+    controller.register("GET", "/{index}/_refresh", refresh)
+    controller.register("POST", "/{index}/_flush", flush)
+    controller.register("POST", "/_flush", flush)
+    controller.register("POST", "/{index}/_forcemerge", forcemerge)
+    controller.register("GET", "/{index}/_stats", index_stats)
+    controller.register("GET", "/_stats", index_stats)

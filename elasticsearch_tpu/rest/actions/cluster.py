@@ -1,0 +1,134 @@
+"""Cluster-level + _cat REST actions (reference: RestClusterHealthAction,
+rest/action/cat/* — SURVEY.md §2.1#47/56). Single-node health semantics:
+green when every shard is assigned (they always are locally), yellow
+reserved for unassigned replicas once the cluster layer lands."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.search.coordinator import resolve_indices
+from elasticsearch_tpu.version import __version__ as VERSION
+
+
+def register(controller: RestController, node) -> None:
+    indices = node.indices
+
+    def root(req: RestRequest):
+        return 200, {
+            "name": node.node_name,
+            "cluster_name": node.cluster_name,
+            "cluster_uuid": node.cluster_uuid,
+            "version": {"number": VERSION,
+                        "build_flavor": "tpu",
+                        "lucene_version": "n/a (XLA/Pallas kernels)"},
+            "tagline": "You Know, for Search — on TPUs",
+        }
+
+    def health(req: RestRequest):
+        n_shards = sum(svc.num_shards for svc in indices.indices.values())
+        return 200, {
+            "cluster_name": node.cluster_name,
+            "status": "green",
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": n_shards,
+            "active_shards": n_shards,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": 0,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
+        }
+
+    def cluster_stats(req: RestRequest):
+        total_docs = sum(svc.stats()["docs"]["count"]
+                         for svc in indices.indices.values())
+        return 200, {
+            "cluster_name": node.cluster_name,
+            "status": "green",
+            "indices": {"count": len(indices.indices),
+                        "docs": {"count": total_docs}},
+            "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+        }
+
+    def nodes_stats(req: RestRequest):
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return 200, {"_nodes": {"total": 1, "successful": 1},
+                     "cluster_name": node.cluster_name,
+                     "nodes": {node.node_id: {
+                         "name": node.node_name,
+                         "indices": indices.stats(),
+                         "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
+                         "jvm": None,
+                     }}}
+
+    # ---------------- _cat ----------------
+
+    def _maybe_table(req, headers: List[str], rows: List[List[Any]]):
+        if req.param_bool("v"):
+            all_rows = [headers] + [[str(c) for c in r] for r in rows]
+        else:
+            all_rows = [[str(c) for c in r] for r in rows]
+        widths = [max((len(r[i]) for r in all_rows), default=0)
+                  for i in range(len(headers))]
+        lines = [" ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                 for r in all_rows]
+        return 200, {"_cat": "\n".join(lines) + "\n"}
+
+    def cat_indices(req: RestRequest):
+        rows = []
+        for name in resolve_indices(indices, req.param("index")):
+            svc = indices.index(name)
+            st = svc.stats()
+            rows.append(["green", "open", name, svc.index_uuid,
+                         svc.num_shards, svc.num_replicas,
+                         st["docs"]["count"], 0])
+        return _maybe_table(req, ["health", "status", "index", "uuid", "pri",
+                                  "rep", "docs.count", "docs.deleted"], rows)
+
+    def cat_health(req: RestRequest):
+        return _maybe_table(req, ["epoch", "timestamp", "cluster", "status",
+                                  "node.total", "shards"],
+                            [[int(time.time()),
+                              time.strftime("%H:%M:%S"),
+                              node.cluster_name, "green", 1,
+                              sum(s.num_shards
+                                  for s in indices.indices.values())]])
+
+    def cat_count(req: RestRequest):
+        from elasticsearch_tpu.search import coordinator
+        c = coordinator.count(indices, req.param("index"), None)
+        return _maybe_table(req, ["epoch", "timestamp", "count"],
+                            [[int(time.time()), time.strftime("%H:%M:%S"),
+                              c["count"]]])
+
+    def cat_shards(req: RestRequest):
+        rows = []
+        for name in resolve_indices(indices, req.param("index")):
+            svc = indices.index(name)
+            for num, shard in sorted(svc.shards.items()):
+                rows.append([name, num, "p" if shard.primary else "r",
+                             "STARTED", shard.engine.num_docs(),
+                             node.node_name])
+        return _maybe_table(req, ["index", "shard", "prirep", "state",
+                                  "docs", "node"], rows)
+
+    controller.register("GET", "/", root)
+    controller.register("GET", "/_cluster/health", health)
+    controller.register("GET", "/_cluster/stats", cluster_stats)
+    controller.register("GET", "/_nodes/stats", nodes_stats)
+    controller.register("GET", "/_cat/indices", cat_indices)
+    controller.register("GET", "/_cat/indices/{index}", cat_indices)
+    controller.register("GET", "/_cat/health", cat_health)
+    controller.register("GET", "/_cat/count", cat_count)
+    controller.register("GET", "/_cat/count/{index}", cat_count)
+    controller.register("GET", "/_cat/shards", cat_shards)
+    controller.register("GET", "/_cat/shards/{index}", cat_shards)

@@ -1,0 +1,252 @@
+"""Document CRUD + bulk REST actions.
+
+Reference: `RestIndexAction`, `RestGetAction`, `RestDeleteAction`,
+`RestBulkAction`, `RestMultiGetAction` (SURVEY.md §2.1#10, §3.2). The
+bulk body is NDJSON action/metadata lines exactly like the reference."""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Dict, Tuple
+
+from elasticsearch_tpu.common.errors import (DocumentMissingException,
+                                             IllegalArgumentException,
+                                             EsException)
+from elasticsearch_tpu.rest.controller import (RestController, RestRequest,
+                                               error_status)
+
+
+def _auto_id() -> str:
+    return uuid.uuid4().hex[:20]
+
+
+def register(controller: RestController, node) -> None:
+    indices = node.indices
+
+    def _index_doc(index: str, doc_id, body, params) -> Tuple[int, Dict]:
+        if not isinstance(body, dict):
+            raise IllegalArgumentException("request body is required")
+        svc = node.get_or_autocreate_index(index)
+        created_id = doc_id or _auto_id()
+        shard = svc.shard(svc.shard_for_id(created_id,
+                                           params.get("routing")))
+        kwargs = {}
+        if params.get("if_seq_no") is not None:
+            kwargs["if_seq_no"] = int(params["if_seq_no"])
+        if params.get("if_primary_term") is not None:
+            kwargs["if_primary_term"] = int(params["if_primary_term"])
+        if params.get("version") is not None:
+            kwargs["version"] = int(params["version"])
+            kwargs["version_type"] = params.get("version_type", "internal")
+        result = shard.apply_index_on_primary(created_id, body, **kwargs)
+        if params.get("refresh") in ("", "true", "wait_for"):
+            shard.refresh()
+        status = 201 if result.created else 200
+        return status, {
+            "_index": index, "_id": result.doc_id,
+            "_version": result.version, "result": result.result,
+            "_seq_no": result.seq_no, "_primary_term": result.primary_term,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+        }
+
+    def put_doc(req: RestRequest):
+        return _index_doc(req.param("index"), req.param("id"), req.body,
+                          req.params)
+
+    def post_doc(req: RestRequest):
+        return _index_doc(req.param("index"), None, req.body, req.params)
+
+    def get_doc(req: RestRequest):
+        svc = indices.index(req.param("index"))
+        doc_id = req.param("id")
+        shard = svc.shard(svc.shard_for_id(doc_id, req.param("routing")))
+        got = shard.get(doc_id)
+        if got is None:
+            return 404, {"_index": req.param("index"), "_id": doc_id,
+                         "found": False}
+        got["_index"] = req.param("index")
+        return 200, got
+
+    def delete_doc(req: RestRequest):
+        svc = indices.index(req.param("index"))
+        doc_id = req.param("id")
+        shard = svc.shard(svc.shard_for_id(doc_id, req.param("routing")))
+        result = shard.apply_delete_on_primary(doc_id)
+        if req.param("refresh") in ("", "true", "wait_for"):
+            shard.refresh()
+        if not result.found:
+            return 404, {"_index": req.param("index"), "_id": doc_id,
+                         "result": "not_found", "_version": result.version,
+                         "_seq_no": result.seq_no,
+                         "_primary_term": result.primary_term}
+        return 200, {"_index": req.param("index"), "_id": doc_id,
+                     "result": "deleted", "_version": result.version,
+                     "_seq_no": result.seq_no,
+                     "_primary_term": result.primary_term,
+                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def update_doc(req: RestRequest):
+        """_update: doc merge or scripted update is reference behavior;
+        doc-merge and doc_as_upsert are supported here."""
+        svc = indices.index(req.param("index"))
+        doc_id = req.param("id")
+        shard = svc.shard(svc.shard_for_id(doc_id, req.param("routing")))
+        body = req.body or {}
+        partial = body.get("doc")
+        if partial is None:
+            raise IllegalArgumentException(
+                "[_update] requires a [doc] (scripted updates need the "
+                "script module)")
+        existing = shard.get(doc_id)
+        if existing is None:
+            if body.get("doc_as_upsert") or "upsert" in body:
+                base = body.get("upsert", {})
+            else:
+                raise DocumentMissingException(f"[{doc_id}]: document missing")
+        else:
+            base = dict(existing["_source"] or {})
+        merged = _deep_merge(base, partial)
+        result = shard.apply_index_on_primary(doc_id, merged)
+        if req.param("refresh") in ("", "true", "wait_for"):
+            shard.refresh()
+        return 200, {"_index": req.param("index"), "_id": doc_id,
+                     "_version": result.version, "result": result.result,
+                     "_seq_no": result.seq_no,
+                     "_primary_term": result.primary_term}
+
+    def mget(req: RestRequest):
+        body = req.body or {}
+        docs_spec = body.get("docs")
+        default_index = req.param("index")
+        if docs_spec is None and "ids" in body:
+            docs_spec = [{"_id": i} for i in body["ids"]]
+        if docs_spec is None:
+            raise IllegalArgumentException("[_mget] requires docs or ids")
+        out = []
+        for spec in docs_spec:
+            index = spec.get("_index", default_index)
+            doc_id = spec["_id"]
+            try:
+                svc = indices.index(index)
+                shard = svc.shard(svc.shard_for_id(doc_id))
+                got = shard.get(doc_id)
+            except EsException:
+                got = None
+            if got is None:
+                out.append({"_index": index, "_id": doc_id, "found": False})
+            else:
+                got["_index"] = index
+                out.append(got)
+        return 200, {"docs": out}
+
+    def bulk(req: RestRequest):
+        t0 = time.perf_counter()
+        raw = req.raw_body.decode("utf-8") if req.raw_body else (
+            req.body if isinstance(req.body, str) else "")
+        default_index = req.param("index")
+        lines = [ln for ln in raw.split("\n") if ln.strip()]
+        items = []
+        errors = False
+        i = 0
+        refresh_shards = set()
+        while i < len(lines):
+            try:
+                action_line = json.loads(lines[i])
+            except json.JSONDecodeError as e:
+                raise IllegalArgumentException(
+                    f"Malformed action/metadata line [{i + 1}]: {e}")
+            if len(action_line) != 1:
+                raise IllegalArgumentException(
+                    f"Malformed action/metadata line [{i + 1}]")
+            op, meta = next(iter(action_line.items()))
+            if op not in ("index", "create", "delete", "update"):
+                raise IllegalArgumentException(f"Unknown bulk action [{op}]")
+            index = meta.get("_index", default_index)
+            doc_id = meta.get("_id")
+            i += 1
+            source = None
+            if op != "delete":
+                if i >= len(lines):
+                    raise IllegalArgumentException(
+                        "Validation Failed: bulk source line missing")
+                source = json.loads(lines[i])
+                i += 1
+            try:
+                if index is None:
+                    raise IllegalArgumentException("_index is missing")
+                svc = node.get_or_autocreate_index(index)
+                the_id = doc_id or _auto_id()
+                shard = svc.shard(svc.shard_for_id(
+                    the_id, meta.get("routing")))
+                if op == "delete":
+                    r = shard.apply_delete_on_primary(the_id)
+                    status = 200 if r.found else 404
+                    items.append({"delete": {
+                        "_index": index, "_id": the_id, "_version": r.version,
+                        "result": "deleted" if r.found else "not_found",
+                        "_seq_no": r.seq_no, "_primary_term": r.primary_term,
+                        "status": status}})
+                    if not r.found:
+                        pass  # not an "error" per reference semantics
+                elif op == "update":
+                    partial = (source or {}).get("doc")
+                    existing = shard.get(the_id)
+                    if existing is None and not (source or {}).get("doc_as_upsert"):
+                        raise DocumentMissingException(
+                            f"[{the_id}]: document missing")
+                    base = dict((existing or {}).get("_source") or {})
+                    r = shard.apply_index_on_primary(
+                        the_id, _deep_merge(base, partial or {}))
+                    items.append({"update": {
+                        "_index": index, "_id": the_id, "_version": r.version,
+                        "result": r.result, "_seq_no": r.seq_no,
+                        "_primary_term": r.primary_term, "status": 200}})
+                else:
+                    if op == "create" and shard.get(the_id) is not None:
+                        raise EsException(
+                            f"[{the_id}]: version conflict, document already "
+                            f"exists")
+                    r = shard.apply_index_on_primary(the_id, source)
+                    status = 201 if r.created else 200
+                    items.append({op: {
+                        "_index": index, "_id": the_id, "_version": r.version,
+                        "result": r.result, "_seq_no": r.seq_no,
+                        "_primary_term": r.primary_term, "status": status}})
+                refresh_shards.add(shard)
+            except EsException as exc:
+                errors = True
+                items.append({op: {
+                    "_index": index, "_id": doc_id, "status": error_status(exc),
+                    "error": {"type": type(exc).__name__, "reason": str(exc)}}})
+        if req.param("refresh") in ("", "true", "wait_for"):
+            for shard in refresh_shards:
+                shard.refresh()
+        return 200, {"took": int((time.perf_counter() - t0) * 1000),
+                     "errors": errors, "items": items}
+
+    controller.register("PUT", "/{index}/_doc/{id}", put_doc)
+    controller.register("POST", "/{index}/_doc/{id}", put_doc)
+    controller.register("PUT", "/{index}/_create/{id}", put_doc)
+    controller.register("POST", "/{index}/_doc", post_doc)
+    controller.register("GET", "/{index}/_doc/{id}", get_doc)
+    controller.register("DELETE", "/{index}/_doc/{id}", delete_doc)
+    controller.register("POST", "/{index}/_update/{id}", update_doc)
+    controller.register("POST", "/_bulk", bulk)
+    controller.register("PUT", "/_bulk", bulk)
+    controller.register("POST", "/{index}/_bulk", bulk)
+    controller.register("GET", "/_mget", mget)
+    controller.register("POST", "/_mget", mget)
+    controller.register("GET", "/{index}/_mget", mget)
+    controller.register("POST", "/{index}/_mget", mget)
+
+
+def _deep_merge(base: dict, update: dict) -> dict:
+    out = dict(base)
+    for k, v in update.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

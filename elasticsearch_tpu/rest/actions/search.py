@@ -1,0 +1,62 @@
+"""Search/count/analyze REST actions (reference: RestSearchAction,
+RestCountAction, RestAnalyzeAction — SURVEY.md §2.1#10, §3.3)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.search import coordinator
+
+
+def register(controller: RestController, node) -> None:
+    indices = node.indices
+
+    def do_search(req: RestRequest):
+        return 200, coordinator.search(indices, req.param("index"),
+                                       req.body or {}, req.params)
+
+    def do_count(req: RestRequest):
+        return 200, coordinator.count(indices, req.param("index"),
+                                      req.body or {})
+
+    def do_analyze(req: RestRequest):
+        body = req.body or {}
+        text = body.get("text")
+        if text is None:
+            raise IllegalArgumentException("[_analyze] requires text")
+        texts = text if isinstance(text, list) else [text]
+        index = req.param("index")
+        analyzer_name = body.get("analyzer", "standard")
+        if index and body.get("field"):
+            svc = indices.index(index)
+            ft = svc.mapper.field_type(body["field"])
+            analyzer = getattr(ft, "analyzer", None)
+        else:
+            from elasticsearch_tpu.analysis import AnalysisRegistry
+            from elasticsearch_tpu.common.settings import Settings
+            registry = AnalysisRegistry().build(Settings.EMPTY)
+            analyzer = registry.get(analyzer_name)
+        if analyzer is None:
+            raise IllegalArgumentException(
+                f"failed to find analyzer [{analyzer_name}]")
+        tokens = []
+        for t in texts:
+            for pos, term in enumerate(analyzer.terms(str(t))):
+                tokens.append({"token": term, "position": pos,
+                               "type": "<ALPHANUM>"})
+        return 200, {"tokens": tokens}
+
+    controller.register("GET", "/_search", do_search)
+    controller.register("POST", "/_search", do_search)
+    controller.register("GET", "/{index}/_search", do_search)
+    controller.register("POST", "/{index}/_search", do_search)
+    controller.register("GET", "/_count", do_count)
+    controller.register("POST", "/_count", do_count)
+    controller.register("GET", "/{index}/_count", do_count)
+    controller.register("POST", "/{index}/_count", do_count)
+    controller.register("GET", "/_analyze", do_analyze)
+    controller.register("POST", "/_analyze", do_analyze)
+    controller.register("GET", "/{index}/_analyze", do_analyze)
+    controller.register("POST", "/{index}/_analyze", do_analyze)

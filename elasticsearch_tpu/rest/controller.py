@@ -1,0 +1,146 @@
+"""RestController — path-trie routing of REST requests to handlers.
+
+Reference: `rest/RestController#dispatchRequest` (SURVEY.md §2.1#10): a
+path trie with literal and `{param}` wildcard nodes; handlers parse the
+request into transport actions. Error shape follows the reference's
+`ElasticsearchException` REST serialization: {"error": {"type", "reason",
+"root_cause": [...]}, "status": N}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common import errors as es_errors
+
+
+@dataclasses.dataclass
+class RestRequest:
+    method: str
+    path: str
+    params: Dict[str, str]          # query-string + path params
+    body: Any                        # parsed JSON (dict) | raw str for NDJSON
+    raw_body: bytes = b""
+
+    def param(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self.params.get(key, default)
+
+    def param_int(self, key: str, default: int = 0) -> int:
+        v = self.params.get(key)
+        return default if v is None else int(v)
+
+    def param_bool(self, key: str, default: bool = False) -> bool:
+        v = self.params.get(key)
+        if v is None:
+            return default
+        return v in ("", "true", "1")
+
+
+Handler = Callable[[RestRequest], Tuple[int, Dict[str, Any]]]
+
+
+class _TrieNode:
+    __slots__ = ("children", "wildcard", "wildcard_name", "handlers")
+
+    def __init__(self):
+        self.children: Dict[str, "_TrieNode"] = {}
+        self.wildcard: Optional["_TrieNode"] = None
+        self.wildcard_name: Optional[str] = None
+        self.handlers: Dict[str, Handler] = {}
+
+
+STATUS_BY_EXC = [
+    (es_errors.ResourceNotFoundException, 404),
+    (es_errors.DocumentMissingException, 404),
+    (es_errors.ResourceAlreadyExistsException, 400),
+    (es_errors.VersionConflictEngineException, 409),
+    (es_errors.IllegalArgumentException, 400),
+    (es_errors.ParsingException, 400),
+    (es_errors.CircuitBreakingException, 429),
+    (es_errors.EsRejectedExecutionException, 429),
+    (es_errors.ClusterBlockException, 503),
+]
+
+
+def error_status(exc: Exception) -> int:
+    for klass, status in STATUS_BY_EXC:
+        if isinstance(exc, klass):
+            return status
+    return 500
+
+
+def error_body(exc: Exception, status: int) -> Dict[str, Any]:
+    t = type(exc).__name__
+    # CamelCase → snake_case exception type names like the reference
+    snake = re.sub(r"(?<!^)(?=[A-Z])", "_", t).lower()
+    snake = snake.replace("_exception", "_exception")
+    cause = {"type": snake, "reason": str(exc)}
+    return {"error": {"root_cause": [cause], **cause}, "status": status}
+
+
+class RestController:
+    def __init__(self):
+        self._root = _TrieNode()
+
+    def register(self, method: str, template: str, handler: Handler) -> None:
+        node = self._root
+        for part in template.strip("/").split("/"):
+            if not part:
+                continue
+            if part.startswith("{") and part.endswith("}"):
+                if node.wildcard is None:
+                    node.wildcard = _TrieNode()
+                    node.wildcard_name = part[1:-1]
+                node = node.wildcard
+            else:
+                node = node.children.setdefault(part, _TrieNode())
+        node.handlers[method.upper()] = handler
+
+    def _resolve(self, path: str) -> Tuple[Optional[_TrieNode], Dict[str, str]]:
+        node = self._root
+        params: Dict[str, str] = {}
+        for part in path.strip("/").split("/"):
+            if not part:
+                continue
+            nxt = node.children.get(part)
+            if nxt is None and node.wildcard is not None:
+                params[node.wildcard_name] = part
+                nxt = node.wildcard
+            if nxt is None:
+                return None, {}
+            node = nxt
+        return node, params
+
+    def dispatch(self, method: str, path: str,
+                 query_params: Optional[Dict[str, str]] = None,
+                 body: Any = None,
+                 raw_body: bytes = b"") -> Tuple[int, Dict[str, Any]]:
+        node, path_params = self._resolve(path)
+        if node is None or not node.handlers:
+            return 400, error_body(
+                es_errors.IllegalArgumentException(
+                    f"no handler found for uri [{path}] and method [{method}]"),
+                400)
+        handler = node.handlers.get(method.upper())
+        if handler is None:
+            if method.upper() == "HEAD" and "GET" in node.handlers:
+                handler = node.handlers["GET"]
+            else:
+                return 405, error_body(
+                    es_errors.IllegalArgumentException(
+                        f"incorrect HTTP method for uri [{path}]: allowed "
+                        f"{sorted(node.handlers)}"), 405)
+        params = dict(query_params or {})
+        params.update(path_params)
+        req = RestRequest(method.upper(), path, params, body, raw_body)
+        try:
+            return handler(req)
+        except Exception as exc:  # noqa: BLE001 — REST boundary
+            status = error_status(exc)
+            if status == 500:
+                traceback.print_exc()
+            return status, error_body(exc, status)

@@ -1,0 +1,14 @@
+"""Aggregations (reference: search/aggregations/**, SURVEY.md §2.1#38).
+
+Import order matters: metrics/bucket modules self-register parsers with
+base's registry on import."""
+
+from elasticsearch_tpu.search.aggregations.base import (  # noqa: F401
+    Aggregator,
+    AggregatorFactories,
+    InternalAggregation,
+    SegmentAggContext,
+    parse_aggregations,
+)
+from elasticsearch_tpu.search.aggregations import bucket as _bucket  # noqa: F401,E402
+from elasticsearch_tpu.search.aggregations import metrics as _metrics  # noqa: F401,E402

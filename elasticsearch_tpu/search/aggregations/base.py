@@ -1,0 +1,186 @@
+"""Aggregations framework: parse → per-segment collect → tree reduce.
+
+Reference: `search/aggregations/**` (SURVEY.md §2.1#38), the largest
+subsystem: `AggregatorFactories` parse the JSON tree, per-segment leaf
+collectors fill buckets, per-shard `InternalAggregation`s stream to the
+coordinator and merge via `InternalAggregation#reduce`. Kept contracts:
+the request JSON shape, the response JSON shape, the two-level reduce
+(segment→shard→coordinator), sub-aggregation nesting, and terms ordering
+(doc_count desc, key asc tie-break).
+
+TPU shape: a bucket IS a boolean mask over the segment's padded doc axis,
+and metrics are masked reductions over doc-value columns — the same dense
+mask algebra as the query planner, so filters/sub-aggs compose by mask
+AND. Collection here runs on host numpy over the pack's columns (they are
+the same arrays jax would see; swapping `np` for `jnp` per column is a
+device-offload decision left to the profiler, not a semantic change).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.index.reader import SegmentView, ShardReader
+from elasticsearch_tpu.index.segment import MISSING_I64
+
+
+class SegmentAggContext:
+    """Access to one segment's doc values + query machinery for one
+    collect pass (reference: the LeafReaderContext + doc-value readers a
+    leaf collector sees)."""
+
+    def __init__(self, reader: ShardReader, view_idx: int):
+        self.reader = reader
+        self.view_idx = view_idx
+        self.view: SegmentView = reader.views[view_idx]
+
+    def field_values(self, field: str, mask: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
+        """(values, doc_ords, ord_terms): all values of `field` for docs
+        where mask is True, multi-values expanded. For keyword fields the
+        values are ordinals and ord_terms maps them to strings."""
+        seg = self.view.segment
+        pack = self.view.pack
+        n = seg.num_docs
+        m = np.asarray(mask)[:n]
+        col = seg.doc_values.get(field)
+        if col is None:
+            return np.empty(0), np.empty(0, dtype=np.int64), None
+        if col.kind == "ord":
+            base = col.values[:n]
+            sel = m & (base >= 0)
+            vals = base[sel].astype(np.int64)
+            docs = np.nonzero(sel)[0]
+        elif col.kind == "f64":
+            base = col.values[:n]
+            sel = m & ~np.isnan(base)
+            vals = base[sel]
+            docs = np.nonzero(sel)[0]
+        else:
+            base = col.values[:n]
+            sel = m & (base != MISSING_I64)
+            vals = base[sel]
+            docs = np.nonzero(sel)[0]
+        if col.extra:
+            ev, ed = [], []
+            for d, extra_vals in col.extra.items():
+                if d < n and m[d]:
+                    for v in extra_vals:
+                        ev.append(v)
+                        ed.append(d)
+            if ev:
+                if col.kind == "ord":
+                    # extras for ord columns are stored as ordinals
+                    vals = np.concatenate([vals, np.asarray(ev, dtype=np.int64)])
+                else:
+                    vals = np.concatenate([vals, np.asarray(ev, dtype=vals.dtype)])
+                docs = np.concatenate([docs, np.asarray(ed, dtype=np.int64)])
+        return vals, docs, col.ord_terms
+
+    def query_mask(self, query) -> np.ndarray:
+        """Evaluate a DSL query to a doc mask (filters/filter agg)."""
+        from elasticsearch_tpu.search.planner import SegmentQueryExecutor
+        executor = SegmentQueryExecutor(self.reader, self.view_idx)
+        mask, _ = executor._eval(query, scoring=False)
+        return np.asarray(mask)
+
+    @property
+    def live_mask(self) -> np.ndarray:
+        return np.asarray(self.view.live_mask)
+
+
+class InternalAggregation:
+    """Shard-level partial result; reduce() merges across shards
+    (reference: InternalAggregation#reduce)."""
+
+    def reduce(self, others: Sequence["InternalAggregation"]) -> "InternalAggregation":
+        raise NotImplementedError
+
+    def to_response(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class Aggregator:
+    """One aggregation node: collect(segment ctx, mask) → partial."""
+
+    def __init__(self, name: str, sub: "AggregatorFactories"):
+        self.name = name
+        self.sub = sub
+
+    def collect(self, ctx: SegmentAggContext,
+                mask: np.ndarray) -> InternalAggregation:
+        raise NotImplementedError
+
+    def empty(self) -> InternalAggregation:
+        """Partial for a shard with no matching segment data."""
+        raise NotImplementedError
+
+
+class AggregatorFactories:
+    """A parsed {name: aggregator} level of the tree."""
+
+    def __init__(self, aggregators: Dict[str, Aggregator]):
+        self.aggregators = aggregators
+
+    def __bool__(self) -> bool:
+        return bool(self.aggregators)
+
+    def collect(self, ctx: SegmentAggContext,
+                mask: np.ndarray) -> Dict[str, InternalAggregation]:
+        return {name: agg.collect(ctx, mask)
+                for name, agg in self.aggregators.items()}
+
+    def empty(self) -> Dict[str, InternalAggregation]:
+        return {name: agg.empty() for name, agg in self.aggregators.items()}
+
+    @staticmethod
+    def reduce(parts: Sequence[Dict[str, InternalAggregation]]
+               ) -> Dict[str, InternalAggregation]:
+        """Merge segment- or shard-level partial maps."""
+        if not parts:
+            return {}
+        out: Dict[str, InternalAggregation] = {}
+        for name in parts[0]:
+            first, rest = parts[0][name], [p[name] for p in parts[1:]]
+            out[name] = first.reduce(rest)
+        return out
+
+    @staticmethod
+    def to_response(aggs: Dict[str, InternalAggregation]) -> Dict[str, Any]:
+        return {name: a.to_response() for name, a in aggs.items()}
+
+
+_PARSERS: Dict[str, Any] = {}
+
+
+def register_agg(type_name: str):
+    def deco(fn):
+        _PARSERS[type_name] = fn
+        return fn
+    return deco
+
+
+def parse_aggregations(spec: Dict[str, Any]) -> AggregatorFactories:
+    """Parse the request's "aggs" tree (reference: AggregatorFactories#
+    parseAggregators): {name: {<type>: {...}, "aggs": {...}}}."""
+    aggregators: Dict[str, Aggregator] = {}
+    for name, body in (spec or {}).items():
+        if not isinstance(body, dict):
+            raise IllegalArgumentException(f"invalid agg [{name}]")
+        sub_spec = body.get("aggs") or body.get("aggregations") or {}
+        type_keys = [k for k in body if k not in ("aggs", "aggregations", "meta")]
+        if len(type_keys) != 1:
+            raise IllegalArgumentException(
+                f"expected exactly one aggregation type for [{name}], "
+                f"got {type_keys}")
+        t = type_keys[0]
+        parser = _PARSERS.get(t)
+        if parser is None:
+            raise IllegalArgumentException(f"unknown aggregation type [{t}]")
+        sub = parse_aggregations(sub_spec)
+        aggregators[name] = parser(name, body[t], sub)
+    return AggregatorFactories(aggregators)

@@ -1,0 +1,546 @@
+"""Bucket aggregations: terms, histogram, date_histogram, range, filter(s),
+missing, global (reference: search/aggregations/bucket/**, SURVEY.md
+§2.1#38). A bucket is a doc mask; sub-aggregations collect under
+mask & bucket_mask — the dense-mask composition that makes nesting free
+on the TPU data model."""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.units import TimeValue
+from elasticsearch_tpu.search.aggregations.base import (
+    Aggregator,
+    AggregatorFactories,
+    InternalAggregation,
+    SegmentAggContext,
+    register_agg,
+)
+
+
+@dataclasses.dataclass
+class Bucket:
+    key: Any
+    doc_count: int
+    sub: Dict[str, InternalAggregation]
+    key_as_string: Optional[str] = None
+
+    def to_response(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"key": self.key, "doc_count": self.doc_count}
+        if self.key_as_string is not None:
+            out["key_as_string"] = self.key_as_string
+        for name, agg in self.sub.items():
+            out[name] = agg.to_response()
+        return out
+
+
+def _merge_buckets(parts: Sequence[Dict[Any, Bucket]]) -> Dict[Any, Bucket]:
+    merged: Dict[Any, Bucket] = {}
+    for part in parts:
+        for key, b in part.items():
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = Bucket(b.key, b.doc_count, dict(b.sub),
+                                     b.key_as_string)
+            else:
+                cur.doc_count += b.doc_count
+                cur.sub = AggregatorFactories.reduce([cur.sub, b.sub]) \
+                    if cur.sub or b.sub else {}
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# terms
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InternalTerms(InternalAggregation):
+    size: int
+    min_doc_count: int
+    buckets: Dict[Any, Bucket]
+    order_by: str = "_count"     # "_count" | "_key"
+    order_asc: bool = False
+
+    def reduce(self, others):
+        merged = _merge_buckets([self.buckets] + [o.buckets for o in others])
+        return InternalTerms(self.size, self.min_doc_count, merged,
+                             self.order_by, self.order_asc)
+
+    def _sorted(self) -> List[Bucket]:
+        bs = [b for b in self.buckets.values()
+              if b.doc_count >= self.min_doc_count]
+        if self.order_by == "_key":
+            bs.sort(key=lambda b: b.key, reverse=not self.order_asc)
+        else:
+            # count order; tie-break key asc (the reference's compound order)
+            key_fn = (lambda b: (b.doc_count, _neg_key(b.key))) if not \
+                self.order_asc else (lambda b: (-b.doc_count, _neg_key(b.key)))
+            bs.sort(key=key_fn, reverse=True)
+        return bs[: self.size]
+
+    def to_response(self) -> Dict[str, Any]:
+        ordered = self._sorted()
+        other = sum(b.doc_count for b in self.buckets.values()
+                    if b.doc_count >= self.min_doc_count) - \
+            sum(b.doc_count for b in ordered)
+        return {"doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": int(other),
+                "buckets": [b.to_response() for b in ordered]}
+
+
+def _neg_key(key):
+    """Invert ordering for tie-break key asc inside a reverse sort."""
+    if isinstance(key, (int, float)):
+        return -key
+    return _StrDesc(key)
+
+
+class _StrDesc(str):
+    def __lt__(self, other):
+        return str.__gt__(self, other)
+
+
+class TermsAggregator(Aggregator):
+    DEFAULT_SIZE = 10
+
+    def __init__(self, name, field, size, shard_size, min_doc_count,
+                 order_by, order_asc, sub):
+        super().__init__(name, sub)
+        self.field = field
+        self.size = size
+        self.shard_size = shard_size
+        self.min_doc_count = min_doc_count
+        self.order_by = order_by
+        self.order_asc = order_asc
+
+    def collect(self, ctx: SegmentAggContext, mask) -> InternalTerms:
+        vals, docs, ord_terms = ctx.field_values(self.field, mask)
+        buckets: Dict[Any, Bucket] = {}
+        if len(vals):
+            if ord_terms is not None:
+                ords = np.asarray(vals, dtype=np.int64)
+                counts = np.bincount(ords, minlength=len(ord_terms))
+                hot = np.nonzero(counts)[0]
+                # keep the top shard_size per segment (reference: shard_size
+                # over-fetch bounds coordinator error)
+                if len(hot) > self.shard_size:
+                    top = hot[np.argsort(-counts[hot], kind="stable")]
+                    hot = top[: self.shard_size]
+                for o in hot:
+                    key = ord_terms[int(o)]
+                    sub = self._collect_sub(ctx, mask, docs, ords == o)
+                    buckets[key] = Bucket(key, int(counts[o]), sub)
+            else:
+                uniq, inv = np.unique(vals, return_inverse=True)
+                counts = np.bincount(inv)
+                order = np.argsort(-counts, kind="stable")[: self.shard_size]
+                for i in order:
+                    key = uniq[i]
+                    key = int(key) if float(key).is_integer() and not \
+                        isinstance(key, np.floating) else float(key)
+                    sub = self._collect_sub(ctx, mask, docs, inv == i)
+                    buckets[key] = Bucket(key, int(counts[i]), sub)
+        return InternalTerms(self.size, self.min_doc_count, buckets,
+                             self.order_by, self.order_asc)
+
+    def _collect_sub(self, ctx, mask, docs, val_sel) -> Dict[str, InternalAggregation]:
+        if not self.sub:
+            return {}
+        bucket_mask = np.zeros_like(np.asarray(mask))
+        bucket_mask[docs[val_sel]] = True
+        return self.sub.collect(ctx, np.asarray(mask) & bucket_mask)
+
+    def empty(self) -> InternalTerms:
+        return InternalTerms(self.size, self.min_doc_count, {},
+                             self.order_by, self.order_asc)
+
+
+@register_agg("terms")
+def _parse_terms(name, body, sub):
+    field = body.get("field")
+    if field is None:
+        raise IllegalArgumentException("[terms] requires a field")
+    size = int(body.get("size", TermsAggregator.DEFAULT_SIZE))
+    # reference default: size * 1.5 + 10
+    shard_size = int(body.get("shard_size", size * 3 // 2 + 10))
+    order_by, order_asc = "_count", False
+    order = body.get("order")
+    if isinstance(order, dict) and order:
+        order_by, direction = next(iter(order.items()))
+        order_asc = str(direction).lower() == "asc"
+        if order_by not in ("_count", "_key"):
+            raise IllegalArgumentException(
+                f"[terms] order by [{order_by}] not supported")
+    return TermsAggregator(name, field, size, max(size, shard_size),
+                           int(body.get("min_doc_count", 1)),
+                           order_by, order_asc, sub)
+
+
+# ---------------------------------------------------------------------------
+# histogram / date_histogram
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InternalHistogram(InternalAggregation):
+    buckets: Dict[Any, Bucket]
+    min_doc_count: int = 0
+    interval: Optional[float] = None   # for empty-bucket fill
+    date_format: bool = False
+
+    def reduce(self, others):
+        merged = _merge_buckets([self.buckets] + [o.buckets for o in others])
+        return InternalHistogram(merged, self.min_doc_count, self.interval,
+                                 self.date_format)
+
+    def to_response(self) -> Dict[str, Any]:
+        keys = sorted(self.buckets.keys())
+        out = []
+        if (self.min_doc_count == 0 and self.interval and len(keys) > 1):
+            # fill gaps (reference: histogram empty buckets when
+            # min_doc_count=0)
+            filled = []
+            k = keys[0]
+            while k <= keys[-1] + 1e-9:
+                filled.append(k)
+                k += self.interval
+            keys = [int(k) if self.date_format else k for k in filled]
+        for k in keys:
+            b = self.buckets.get(k)
+            if b is None:
+                b = Bucket(k, 0, {},
+                           _millis_iso(k) if self.date_format else None)
+            if b.doc_count >= self.min_doc_count:
+                out.append(b.to_response())
+        return {"buckets": out}
+
+
+def _millis_iso(ms: float) -> str:
+    dt = datetime.datetime.fromtimestamp(ms / 1000.0, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+class HistogramAggregator(Aggregator):
+    def __init__(self, name, field, interval, offset, min_doc_count, sub,
+                 date: bool = False, calendar: Optional[str] = None):
+        super().__init__(name, sub)
+        self.field = field
+        self.interval = interval
+        self.offset = offset
+        self.min_doc_count = min_doc_count
+        self.date = date
+        self.calendar = calendar
+
+    def collect(self, ctx, mask) -> InternalHistogram:
+        vals, docs, ord_terms = ctx.field_values(self.field, mask)
+        if ord_terms is not None:
+            raise IllegalArgumentException(
+                f"agg [{self.name}]: field [{self.field}] is not numeric")
+        buckets: Dict[Any, Bucket] = {}
+        if len(vals):
+            v = np.asarray(vals, dtype=np.float64)
+            if self.calendar:
+                keys = np.asarray([_calendar_floor(int(x), self.calendar)
+                                   for x in v], dtype=np.int64)
+            else:
+                keys = np.floor((v - self.offset) / self.interval) \
+                    * self.interval + self.offset
+                if self.date:
+                    keys = keys.astype(np.int64)
+            uniq, inv = np.unique(keys, return_inverse=True)
+            counts = np.bincount(inv)
+            for i, k in enumerate(uniq):
+                key = int(k) if self.date else float(k)
+                sub = {}
+                if self.sub:
+                    bucket_mask = np.zeros_like(np.asarray(mask))
+                    bucket_mask[docs[inv == i]] = True
+                    sub = self.sub.collect(ctx, np.asarray(mask) & bucket_mask)
+                buckets[key] = Bucket(key, int(counts[i]), sub,
+                                      _millis_iso(key) if self.date else None)
+        interval = None if self.calendar else self.interval
+        return InternalHistogram(buckets, self.min_doc_count, interval,
+                                 self.date)
+
+    def empty(self) -> InternalHistogram:
+        return InternalHistogram({}, self.min_doc_count,
+                                 None if self.calendar else self.interval,
+                                 self.date)
+
+
+def _calendar_floor(ms: int, unit: str) -> int:
+    dt = datetime.datetime.fromtimestamp(ms / 1000.0, datetime.timezone.utc)
+    if unit in ("month", "1M"):
+        dt = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    elif unit in ("year", "1y"):
+        dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0,
+                        microsecond=0)
+    elif unit in ("quarter", "1q"):
+        month = ((dt.month - 1) // 3) * 3 + 1
+        dt = dt.replace(month=month, day=1, hour=0, minute=0, second=0,
+                        microsecond=0)
+    elif unit in ("week", "1w"):
+        dt = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+        dt -= datetime.timedelta(days=dt.weekday())
+    elif unit in ("day", "1d"):
+        dt = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+    elif unit in ("hour", "1h"):
+        dt = dt.replace(minute=0, second=0, microsecond=0)
+    elif unit in ("minute", "1m"):
+        dt = dt.replace(second=0, microsecond=0)
+    else:
+        raise IllegalArgumentException(f"unknown calendar interval [{unit}]")
+    return int(dt.timestamp() * 1000)
+
+
+@register_agg("histogram")
+def _parse_histogram(name, body, sub):
+    field = body.get("field")
+    interval = body.get("interval")
+    if field is None or interval is None:
+        raise IllegalArgumentException("[histogram] requires field + interval")
+    return HistogramAggregator(name, field, float(interval),
+                               float(body.get("offset", 0.0)),
+                               int(body.get("min_doc_count", 0)), sub)
+
+
+@register_agg("date_histogram")
+def _parse_date_histogram(name, body, sub):
+    field = body.get("field")
+    if field is None:
+        raise IllegalArgumentException("[date_histogram] requires a field")
+    calendar = body.get("calendar_interval")
+    fixed = body.get("fixed_interval", body.get("interval"))
+    if calendar:
+        return HistogramAggregator(name, field, None, 0.0,
+                                   int(body.get("min_doc_count", 0)), sub,
+                                   date=True, calendar=calendar)
+    if not fixed:
+        raise IllegalArgumentException(
+            "[date_histogram] requires calendar_interval or fixed_interval")
+    ms = TimeValue.parse(str(fixed)).millis()
+    return HistogramAggregator(name, field, float(ms), 0.0,
+                               int(body.get("min_doc_count", 0)), sub,
+                               date=True)
+
+
+# ---------------------------------------------------------------------------
+# range
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InternalRange(InternalAggregation):
+    buckets: Dict[Any, Bucket]
+    order: List[Any]
+    bounds: Dict[Any, Tuple[float, float]]
+
+    def reduce(self, others):
+        merged = _merge_buckets([self.buckets] + [o.buckets for o in others])
+        return InternalRange(merged, self.order, self.bounds)
+
+    def to_response(self):
+        out = []
+        for k in self.order:
+            if k not in self.buckets:
+                continue
+            resp = self.buckets[k].to_response()
+            lo, hi = self.bounds[k]
+            if np.isfinite(lo):
+                resp["from"] = lo
+            if np.isfinite(hi):
+                resp["to"] = hi
+            out.append(resp)
+        return {"buckets": out}
+
+
+class RangeAggregator(Aggregator):
+    def __init__(self, name, field, ranges, keyed, sub):
+        super().__init__(name, sub)
+        self.field = field
+        self.ranges = ranges
+
+    def _keys_bounds(self):
+        order, bounds = [], {}
+        for r in self.ranges:
+            lo = float(r.get("from", -np.inf))
+            hi = float(r.get("to", np.inf))
+            key = r.get("key") or _range_key(lo, hi)
+            order.append(key)
+            bounds[key] = (lo, hi)
+        return order, bounds
+
+    def collect(self, ctx, mask) -> InternalRange:
+        vals, docs, ord_terms = ctx.field_values(self.field, mask)
+        if ord_terms is not None:
+            raise IllegalArgumentException(
+                f"agg [{self.name}]: field [{self.field}] is not numeric")
+        order, bounds = self._keys_bounds()
+        buckets: Dict[Any, Bucket] = {}
+        v = np.asarray(vals, dtype=np.float64)
+        for key in order:
+            lo, hi = bounds[key]
+            sel = (v >= lo) & (v < hi) if len(v) else np.zeros(0, dtype=bool)
+            sub = {}
+            if self.sub:
+                bucket_mask = np.zeros_like(np.asarray(mask))
+                if len(v):
+                    bucket_mask[docs[sel]] = True
+                sub = self.sub.collect(ctx, np.asarray(mask) & bucket_mask)
+            buckets[key] = Bucket(key, int(sel.sum()) if len(v) else 0, sub)
+        return InternalRange(buckets, order, bounds)
+
+    def empty(self) -> InternalRange:
+        order, bounds = self._keys_bounds()
+        return InternalRange({k: Bucket(k, 0, {}) for k in order}, order,
+                             bounds)
+
+
+def _range_key(lo, hi) -> str:
+    lo_s = "*" if not np.isfinite(lo) else f"{lo:g}"
+    hi_s = "*" if not np.isfinite(hi) else f"{hi:g}"
+    return f"{lo_s}-{hi_s}"
+
+
+@register_agg("range")
+def _parse_range(name, body, sub):
+    field = body.get("field")
+    ranges = body.get("ranges")
+    if field is None or not ranges:
+        raise IllegalArgumentException("[range] requires field + ranges")
+    return RangeAggregator(name, field, ranges, body.get("keyed", False), sub)
+
+
+# ---------------------------------------------------------------------------
+# filter / filters / missing / global
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InternalSingleBucket(InternalAggregation):
+    doc_count: int
+    sub: Dict[str, InternalAggregation]
+
+    def reduce(self, others):
+        count = self.doc_count + sum(o.doc_count for o in others)
+        sub = AggregatorFactories.reduce(
+            [self.sub] + [o.sub for o in others]) if self.sub else {}
+        return InternalSingleBucket(count, sub)
+
+    def to_response(self):
+        out = {"doc_count": self.doc_count}
+        for name, agg in self.sub.items():
+            out[name] = agg.to_response()
+        return out
+
+
+class FilterAggregator(Aggregator):
+    def __init__(self, name, query_spec, sub):
+        super().__init__(name, sub)
+        from elasticsearch_tpu.search import dsl
+        self.query = dsl.parse_query(query_spec)
+
+    def collect(self, ctx, mask) -> InternalSingleBucket:
+        fmask = np.asarray(mask) & ctx.query_mask(self.query) & ctx.live_mask
+        sub = self.sub.collect(ctx, fmask) if self.sub else {}
+        n = ctx.view.segment.num_docs
+        return InternalSingleBucket(int(fmask[:n].sum()), sub)
+
+    def empty(self) -> InternalSingleBucket:
+        return InternalSingleBucket(0, self.sub.empty() if self.sub else {})
+
+
+@register_agg("filter")
+def _parse_filter(name, body, sub):
+    return FilterAggregator(name, body, sub)
+
+
+@dataclasses.dataclass
+class InternalFilters(InternalAggregation):
+    buckets: Dict[str, InternalSingleBucket]
+    order: List[str]
+
+    def reduce(self, others):
+        merged = {}
+        for key in self.order:
+            merged[key] = self.buckets[key].reduce(
+                [o.buckets[key] for o in others])
+        return InternalFilters(merged, self.order)
+
+    def to_response(self):
+        return {"buckets": {k: self.buckets[k].to_response()
+                            for k in self.order}}
+
+
+class FiltersAggregator(Aggregator):
+    def __init__(self, name, named_filters, sub):
+        super().__init__(name, sub)
+        from elasticsearch_tpu.search import dsl
+        self.filters = {k: dsl.parse_query(v) for k, v in named_filters.items()}
+
+    def collect(self, ctx, mask) -> InternalFilters:
+        buckets = {}
+        n = ctx.view.segment.num_docs
+        for key, q in self.filters.items():
+            fmask = np.asarray(mask) & ctx.query_mask(q) & ctx.live_mask
+            sub = self.sub.collect(ctx, fmask) if self.sub else {}
+            buckets[key] = InternalSingleBucket(int(fmask[:n].sum()), sub)
+        return InternalFilters(buckets, sorted(self.filters.keys()))
+
+    def empty(self) -> InternalFilters:
+        return InternalFilters(
+            {k: InternalSingleBucket(0, self.sub.empty() if self.sub else {})
+             for k in self.filters}, sorted(self.filters.keys()))
+
+
+@register_agg("filters")
+def _parse_filters(name, body, sub):
+    named = body.get("filters")
+    if not isinstance(named, dict) or not named:
+        raise IllegalArgumentException("[filters] requires named filters")
+    return FiltersAggregator(name, named, sub)
+
+
+class MissingAggregator(Aggregator):
+    def __init__(self, name, field, sub):
+        super().__init__(name, sub)
+        self.field = field
+
+    def collect(self, ctx, mask) -> InternalSingleBucket:
+        n = ctx.view.segment.num_docs
+        has = ctx.reader.has_field_mask(ctx.view_idx, self.field)
+        m = np.asarray(mask) & ~np.asarray(has)
+        sub = self.sub.collect(ctx, m) if self.sub else {}
+        return InternalSingleBucket(int(m[:n].sum()), sub)
+
+    def empty(self) -> InternalSingleBucket:
+        return InternalSingleBucket(0, self.sub.empty() if self.sub else {})
+
+
+@register_agg("missing")
+def _parse_missing(name, body, sub):
+    field = body.get("field")
+    if field is None:
+        raise IllegalArgumentException("[missing] requires a field")
+    return MissingAggregator(name, field, sub)
+
+
+class GlobalAggregator(Aggregator):
+    """Ignores the query: collects over ALL live docs (reference:
+    GlobalAggregator)."""
+
+    def collect(self, ctx, mask) -> InternalSingleBucket:
+        n = ctx.view.segment.num_docs
+        m = ctx.live_mask.copy()
+        sub = self.sub.collect(ctx, m) if self.sub else {}
+        return InternalSingleBucket(int(m[:n].sum()), sub)
+
+    def empty(self) -> InternalSingleBucket:
+        return InternalSingleBucket(0, self.sub.empty() if self.sub else {})
+
+
+@register_agg("global")
+def _parse_global(name, body, sub):
+    return GlobalAggregator(name, sub)

@@ -1,0 +1,291 @@
+"""Metric aggregations: min/max/sum/avg/value_count/stats/cardinality/
+percentiles/top_hits (reference: search/aggregations/metrics/**,
+SURVEY.md §2.1#38).
+
+Cardinality uses a real HyperLogLog++-style sketch (murmur3-hashed values,
+2^p registers, reduce = register max — the reference's
+HyperLogLogPlusPlus), with the linear-counting correction for small
+cardinalities. Percentiles collects exact values per shard and merges
+(reference uses TDigest; exact merge is strictly more accurate and the
+response shape is identical — swap for a sketch when shard values exceed
+memory budgets)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.search.aggregations.base import (
+    Aggregator,
+    AggregatorFactories,
+    InternalAggregation,
+    SegmentAggContext,
+    register_agg,
+)
+
+
+# ---------------------------------------------------------------------------
+# simple numeric metrics
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InternalNumericMetric(InternalAggregation):
+    kind: str                    # min|max|sum|avg|value_count
+    total: float = 0.0
+    count: int = 0
+    minv: float = math.inf
+    maxv: float = -math.inf
+
+    def reduce(self, others):
+        out = dataclasses.replace(self)
+        for o in others:
+            out.total += o.total
+            out.count += o.count
+            out.minv = min(out.minv, o.minv)
+            out.maxv = max(out.maxv, o.maxv)
+        return out
+
+    def to_response(self) -> Dict[str, Any]:
+        if self.kind == "value_count":
+            return {"value": self.count}
+        if self.kind == "sum":
+            return {"value": self.total}
+        if self.kind == "avg":
+            return {"value": self.total / self.count if self.count else None}
+        if self.kind == "min":
+            return {"value": self.minv if self.count else None}
+        if self.kind == "max":
+            return {"value": self.maxv if self.count else None}
+        if self.kind == "stats":
+            return {
+                "count": self.count,
+                "min": self.minv if self.count else None,
+                "max": self.maxv if self.count else None,
+                "avg": self.total / self.count if self.count else None,
+                "sum": self.total,
+            }
+        raise AssertionError(self.kind)
+
+
+class NumericMetricAggregator(Aggregator):
+    def __init__(self, name, kind, field, missing=None, sub=None):
+        super().__init__(name, sub or AggregatorFactories({}))
+        self.kind = kind
+        self.field = field
+        self.missing = missing
+
+    def collect(self, ctx: SegmentAggContext, mask) -> InternalNumericMetric:
+        vals, docs, ord_terms = ctx.field_values(self.field, mask)
+        out = InternalNumericMetric(self.kind)
+        if ord_terms is not None and self.kind != "value_count":
+            raise IllegalArgumentException(
+                f"agg [{self.name}]: field [{self.field}] is not numeric")
+        if self.missing is not None:
+            n_mask = int(np.asarray(mask)[:ctx.view.segment.num_docs].sum())
+            missing_docs = n_mask - len(np.unique(docs)) if len(docs) else n_mask
+            if missing_docs > 0:
+                vals = np.concatenate(
+                    [np.asarray(vals, dtype=np.float64),
+                     np.full(missing_docs, float(self.missing))])
+        if len(vals):
+            v = np.asarray(vals, dtype=np.float64)
+            out.total = float(v.sum())
+            out.count = int(len(v))
+            out.minv = float(v.min())
+            out.maxv = float(v.max())
+        return out
+
+    def empty(self) -> InternalNumericMetric:
+        return InternalNumericMetric(self.kind)
+
+
+for _kind in ("min", "max", "sum", "avg", "value_count", "stats"):
+    def _mk(kind):
+        @register_agg(kind)
+        def _parse(name, body, sub, kind=kind):
+            field = body.get("field")
+            if field is None:
+                raise IllegalArgumentException(f"[{kind}] requires a field")
+            return NumericMetricAggregator(name, kind, field,
+                                           body.get("missing"), sub)
+        return _parse
+    _mk(_kind)
+
+
+# ---------------------------------------------------------------------------
+# cardinality (HLL++-style)
+# ---------------------------------------------------------------------------
+
+HLL_P = 12  # 4096 registers ≈ 1.6% relative error (ES default ~precision 3000)
+
+
+@dataclasses.dataclass
+class InternalCardinality(InternalAggregation):
+    registers: np.ndarray  # uint8[2^p]
+
+    def reduce(self, others):
+        regs = self.registers.copy()
+        for o in others:
+            regs = np.maximum(regs, o.registers)
+        return InternalCardinality(regs)
+
+    def to_response(self) -> Dict[str, Any]:
+        return {"value": self.estimate()}
+
+    def estimate(self) -> int:
+        m = len(self.registers)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        est = alpha * m * m / np.sum(np.exp2(-self.registers.astype(np.float64)))
+        zeros = int((self.registers == 0).sum())
+        if est <= 2.5 * m and zeros > 0:
+            est = m * math.log(m / zeros)  # linear counting for small n
+        return int(round(est))
+
+
+class CardinalityAggregator(Aggregator):
+    def __init__(self, name, field, sub=None):
+        super().__init__(name, sub or AggregatorFactories({}))
+        self.field = field
+
+    def collect(self, ctx: SegmentAggContext, mask) -> InternalCardinality:
+        from elasticsearch_tpu.indices.service import murmur3_hash
+        vals, _, ord_terms = ctx.field_values(self.field, mask)
+        regs = np.zeros(1 << HLL_P, dtype=np.uint8)
+        if len(vals):
+            if ord_terms is not None:
+                uniq = np.unique(np.asarray(vals, dtype=np.int64))
+                keys = [ord_terms[int(v)] for v in uniq]
+            else:
+                keys = [repr(v) for v in np.unique(vals)]
+            for k in keys:
+                h = murmur3_hash(k) & 0xFFFFFFFF
+                idx = h >> (32 - HLL_P)
+                w = (h << HLL_P) & 0xFFFFFFFF
+                rank = (32 - HLL_P) + 1 if w == 0 else (32 - w.bit_length()) + 1
+                if rank > regs[idx]:
+                    regs[idx] = rank
+        return InternalCardinality(regs)
+
+    def empty(self) -> InternalCardinality:
+        return InternalCardinality(np.zeros(1 << HLL_P, dtype=np.uint8))
+
+
+@register_agg("cardinality")
+def _parse_cardinality(name, body, sub):
+    field = body.get("field")
+    if field is None:
+        raise IllegalArgumentException("[cardinality] requires a field")
+    return CardinalityAggregator(name, field, sub)
+
+
+# ---------------------------------------------------------------------------
+# percentiles (exact-merge)
+# ---------------------------------------------------------------------------
+
+DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
+
+
+@dataclasses.dataclass
+class InternalPercentiles(InternalAggregation):
+    percents: Sequence[float]
+    values: np.ndarray
+
+    def reduce(self, others):
+        vals = [self.values] + [o.values for o in others]
+        return InternalPercentiles(self.percents,
+                                   np.concatenate(vals) if vals else self.values)
+
+    def to_response(self) -> Dict[str, Any]:
+        out = {}
+        if len(self.values) == 0:
+            return {"values": {f"{p:g}": None for p in self.percents}}
+        v = np.sort(self.values)
+        for p in self.percents:
+            # linear interpolation between closest ranks (TDigest-compatible
+            # at the endpoints: 0 → min, 100 → max)
+            out[f"{p:g}"] = float(np.percentile(v, p))
+        return {"values": out}
+
+
+class PercentilesAggregator(Aggregator):
+    def __init__(self, name, field, percents, sub=None):
+        super().__init__(name, sub or AggregatorFactories({}))
+        self.field = field
+        self.percents = percents
+
+    def collect(self, ctx, mask) -> InternalPercentiles:
+        vals, _, ord_terms = ctx.field_values(self.field, mask)
+        if ord_terms is not None:
+            raise IllegalArgumentException(
+                f"agg [{self.name}]: field [{self.field}] is not numeric")
+        return InternalPercentiles(self.percents,
+                                   np.asarray(vals, dtype=np.float64))
+
+    def empty(self) -> InternalPercentiles:
+        return InternalPercentiles(self.percents, np.empty(0))
+
+
+@register_agg("percentiles")
+def _parse_percentiles(name, body, sub):
+    field = body.get("field")
+    if field is None:
+        raise IllegalArgumentException("[percentiles] requires a field")
+    percents = tuple(body.get("percents", DEFAULT_PERCENTS))
+    return PercentilesAggregator(name, field, percents, sub)
+
+
+# ---------------------------------------------------------------------------
+# top_hits
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InternalTopHits(InternalAggregation):
+    size: int
+    hits: List[Dict[str, Any]]  # {"_id", "_score", "_source"}
+    total: int
+
+    def reduce(self, others):
+        merged = list(self.hits)
+        total = self.total
+        for o in others:
+            merged.extend(o.hits)
+            total += o.total
+        merged.sort(key=lambda h: (-(h["_score"] or 0.0), h["_id"]))
+        return InternalTopHits(self.size, merged[: self.size], total)
+
+    def to_response(self) -> Dict[str, Any]:
+        return {"hits": {
+            "total": {"value": self.total, "relation": "eq"},
+            "hits": self.hits}}
+
+
+class TopHitsAggregator(Aggregator):
+    def __init__(self, name, size, source, sub=None):
+        super().__init__(name, sub or AggregatorFactories({}))
+        self.size = size
+        self.source = source
+
+    def collect(self, ctx, mask) -> InternalTopHits:
+        seg = ctx.view.segment
+        m = np.asarray(mask)[: seg.num_docs]
+        docs = np.nonzero(m)[0][: self.size]  # doc-order hits (no scores here)
+        hits = []
+        for d in docs:
+            h = {"_id": seg.doc_ids[int(d)], "_score": None}
+            if self.source:
+                h["_source"] = seg.stored_source[int(d)]
+            hits.append(h)
+        return InternalTopHits(self.size, hits, int(m.sum()))
+
+    def empty(self) -> InternalTopHits:
+        return InternalTopHits(self.size, [], 0)
+
+
+@register_agg("top_hits")
+def _parse_top_hits(name, body, sub):
+    return TopHitsAggregator(name, int(body.get("size", 3)),
+                             body.get("_source", True), sub)

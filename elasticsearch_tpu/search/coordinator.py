@@ -1,0 +1,152 @@
+"""Search coordinator — query_then_fetch across shards.
+
+Reference: `action/search/TransportSearchAction` +
+`SearchPhaseController` (SURVEY.md §2.1#35, §3.3): resolve indices →
+query phase on every shard → merge top-k (score desc, tie toward lower
+shard ordinal then doc order) → fetch phase only on shards owning
+winners → reduce aggs → one response. This module is the LOCAL-node
+coordinator (all shards in-process); the mesh-distributed BM25 fast path
+lives in parallel/distributed.py and federation over hosts arrives with
+the transport layer.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             IndexNotFoundException)
+from elasticsearch_tpu.indices.service import IndicesService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
+                                                   parse_aggregations)
+from elasticsearch_tpu.search.query_phase import (ShardHit, execute_fetch,
+                                                  execute_query)
+
+
+def resolve_indices(indices: IndicesService,
+                    expression: Optional[str]) -> List[str]:
+    """Wildcard/CSV index resolution (reference:
+    IndexNameExpressionResolver — no date math yet)."""
+    names = sorted(indices.indices.keys())
+    if expression in (None, "", "_all", "*"):
+        return names
+    out: List[str] = []
+    for part in expression.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "*" in part or "?" in part:
+            matched = fnmatch.filter(names, part)
+            out.extend(m for m in matched if m not in out)
+        else:
+            if part not in names:
+                raise IndexNotFoundException(f"no such index [{part}]")
+            if part not in out:
+                out.append(part)
+    return out
+
+
+def parse_search_body(body: Optional[Dict[str, Any]]):
+    body = body or {}
+    unknown = set(body) - {"query", "aggs", "aggregations", "size", "from",
+                           "_source", "min_score", "track_total_hits",
+                           "sort", "search_after", "highlight", "suggest",
+                           "version", "seq_no_primary_term"}
+    if unknown:
+        raise IllegalArgumentException(
+            f"unknown search body keys {sorted(unknown)}")
+    query = dsl.parse_query(body.get("query") or {"match_all": {}})
+    aggs_spec = body.get("aggs") or body.get("aggregations")
+    aggs = parse_aggregations(aggs_spec) if aggs_spec else None
+    return query, aggs, body
+
+
+def search(indices: IndicesService, index_expr: Optional[str],
+           body: Optional[Dict[str, Any]],
+           params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    t0 = time.perf_counter()
+    params = params or {}
+    names = resolve_indices(indices, index_expr)
+    query, aggs, body = parse_search_body(body)
+    size = int(params.get("size", body.get("size", 10)))
+    from_ = int(params.get("from", body.get("from", 0)))
+    min_score = body.get("min_score")
+    source = body.get("_source", True)
+
+    # ---- query phase: every shard of every target index ----
+    shard_results = []   # (index_name, shard_num, QuerySearchResult)
+    total = 0
+    for name in names:
+        svc = indices.index(name)
+        for shard_num, shard in sorted(svc.shards.items()):
+            reader = shard.acquire_searcher()
+            res = execute_query(reader, query, size=size + from_, from_=0,
+                                min_score=min_score, aggs=aggs)
+            shard_results.append((name, shard_num, shard, res))
+            total += res.total_hits
+
+    # ---- merge top-k (score desc, then index/shard order, then rank) ----
+    merged: List[Tuple[float, int, int, ShardHit]] = []
+    for si, (name, shard_num, shard, res) in enumerate(shard_results):
+        for rank, hit in enumerate(res.hits):
+            merged.append((hit.score, si, rank, hit))
+    merged.sort(key=lambda t: (-t[0], t[1], t[2]))
+    window = merged[from_: from_ + size]
+
+    # ---- fetch phase: group winners by shard ----
+    by_shard: Dict[int, List[ShardHit]] = {}
+    for _, si, _, hit in window:
+        by_shard.setdefault(si, []).append(hit)
+    fetched: Dict[Tuple[int, str], Dict[str, Any]] = {}
+    for si, hits in by_shard.items():
+        name, shard_num, shard, _ = shard_results[si]
+        reader = shard.acquire_searcher()
+        for hit, doc in zip(hits, execute_fetch(reader, hits, source)):
+            doc["_index"] = name
+            fetched[(si, hit.doc_id)] = doc
+    hits_json = []
+    for score, si, _, hit in window:
+        doc = fetched.get((si, hit.doc_id), {"_id": hit.doc_id})
+        doc["_score"] = score
+        hits_json.append(doc)
+
+    max_score = merged[0][0] if merged else None
+    out: Dict[str, Any] = {
+        "took": int((time.perf_counter() - t0) * 1000),
+        "timed_out": False,
+        "_shards": {"total": len(shard_results),
+                    "successful": len(shard_results), "skipped": 0,
+                    "failed": 0},
+        "hits": {"total": {"value": total, "relation": "eq"},
+                 "max_score": max_score,
+                 "hits": hits_json},
+    }
+
+    # ---- agg reduce across shards ----
+    if aggs:
+        parts = [res.aggregations for _, _, _, res in shard_results
+                 if res.aggregations is not None]
+        reduced = AggregatorFactories.reduce(parts) if parts else aggs.empty()
+        out["aggregations"] = AggregatorFactories.to_response(reduced)
+    return out
+
+
+def count(indices: IndicesService, index_expr: Optional[str],
+          body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    names = resolve_indices(indices, index_expr)
+    query = dsl.parse_query((body or {}).get("query") or {"match_all": {}})
+    total = 0
+    n_shards = 0
+    for name in names:
+        svc = indices.index(name)
+        for shard_num, shard in sorted(svc.shards.items()):
+            reader = shard.acquire_searcher()
+            res = execute_query(reader, query, size=0)
+            total += res.total_hits
+            n_shards += 1
+    return {"count": total,
+            "_shards": {"total": n_shards, "successful": n_shards,
+                        "skipped": 0, "failed": 0}}

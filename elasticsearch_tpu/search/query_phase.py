@@ -37,17 +37,28 @@ class ShardHit:
 @dataclasses.dataclass
 class QuerySearchResult:
     """Per-shard query-phase result (the QuerySearchResult analog):
-    top-k (doc ref, score) and total hits — no _source yet."""
+    top-k (doc ref, score), total hits, per-shard agg partials — no
+    _source yet."""
     hits: List[ShardHit]
     total_hits: int
     max_score: Optional[float]
+    aggregations: Optional[Dict[str, Any]] = None  # name → InternalAggregation
 
 
 def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
                   size: int = 10, from_: int = 0,
-                  min_score: Optional[float] = None) -> QuerySearchResult:
+                  min_score: Optional[float] = None,
+                  aggs: Optional[Any] = None) -> QuerySearchResult:
+    """aggs: an AggregatorFactories (see search/aggregations) collected
+    under the query's match mask per segment, reduced across segments to
+    one shard-level partial (reference: QueryPhase runs the collector
+    chain once for topk + aggs, SURVEY.md §3.3)."""
+    from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
+                                                       SegmentAggContext)
+
     k = size + from_
     per_segment: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    agg_parts: List[Dict[str, Any]] = []
     total = 0
     for idx, view in enumerate(reader.views):
         executor = SegmentQueryExecutor(reader, idx)
@@ -55,6 +66,10 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
         live = jnp.asarray(view.live_mask)
         final = bm25.mask_scores(score[None, :], mask[None, :], live)[0]
         total += int(jnp.sum(mask & live))
+        if aggs:
+            ctx = SegmentAggContext(reader, idx)
+            agg_parts.append(aggs.collect(
+                ctx, np.asarray(mask & live)))
         if k > 0:
             vals, idxs = bm25.topk(final[None, :], k=min(k, view.pack.d_pad))
             per_segment.append((idx, np.asarray(vals[0]), np.asarray(idxs[0])))
@@ -75,7 +90,12 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
         seg = reader.views[seg_idx].segment
         hits.append(ShardHit(seg.doc_ids[ord_], score, ShardDocRef(seg.name, ord_)))
     max_score = merged[0][0] if merged else None
-    return QuerySearchResult(hits, total, max_score)
+    shard_aggs = None
+    if aggs:
+        from elasticsearch_tpu.search.aggregations import AggregatorFactories
+        shard_aggs = (AggregatorFactories.reduce(agg_parts)
+                      if agg_parts else aggs.empty())
+    return QuerySearchResult(hits, total, max_score, shard_aggs)
 
 
 def execute_fetch(reader: ShardReader, hits: List[ShardHit],

@@ -1,0 +1,225 @@
+"""Aggregation tests — the AggregatorTestCase pattern (SURVEY.md §4.1):
+random/fixed docs → aggregator → compare against plain-python expected
+values; plus cross-shard reduce and sub-aggregation nesting."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.reader import ShardReader
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
+                                                   parse_aggregations)
+from elasticsearch_tpu.search.query_phase import execute_query
+
+MAPPING = {"properties": {
+    "category": {"type": "keyword"},
+    "price": {"type": "double"},
+    "qty": {"type": "long"},
+    "day": {"type": "date"},
+    "desc": {"type": "text"},
+    "tags": {"type": "keyword"},
+}}
+
+DOCS = [
+    {"category": "fruit", "price": 1.5, "qty": 10, "day": "2024-01-01T10:00:00Z", "desc": "red apple", "tags": ["fresh", "cheap"]},
+    {"category": "fruit", "price": 3.0, "qty": 4, "day": "2024-01-02T10:00:00Z", "desc": "green pear", "tags": ["fresh"]},
+    {"category": "veg", "price": 0.5, "qty": 50, "day": "2024-02-01T10:00:00Z", "desc": "orange carrot", "tags": ["cheap"]},
+    {"category": "veg", "price": 2.0, "qty": 8, "day": "2024-02-15T10:00:00Z", "desc": "green pepper", "tags": []},
+    {"category": "meat", "price": 9.0, "qty": 2, "day": "2024-03-01T10:00:00Z", "desc": "red steak", "tags": ["expensive"]},
+    {"category": "fruit", "price": 2.5, "qty": 6, "day": "2024-03-02T10:00:00Z", "desc": "yellow banana", "tags": ["cheap"]},
+]
+
+
+def make_reader(docs=DOCS, n_segments=1):
+    ms = MapperService(Settings.EMPTY, MAPPING)
+    segs = []
+    per = (len(docs) + n_segments - 1) // n_segments
+    for si in range(n_segments):
+        w = SegmentWriter(f"s{si}")
+        for i, doc in enumerate(docs[si * per:(si + 1) * per]):
+            w.add_document(ms.parse_document(f"d{si * per + i}", doc),
+                           ms.dv_kinds())
+        segs.append(w.freeze())
+    return ShardReader([(s, None) for s in segs], ms)
+
+
+def run_aggs(spec, query=None, n_segments=1, docs=DOCS):
+    reader = make_reader(docs, n_segments)
+    aggs = parse_aggregations(spec)
+    res = execute_query(reader, query or dsl.MatchAllQuery(), size=0,
+                        aggs=aggs)
+    return AggregatorFactories.to_response(res.aggregations)
+
+
+class TestMetrics:
+    @pytest.mark.parametrize("n_segments", [1, 3])
+    def test_stats_family(self, n_segments):
+        out = run_aggs({"p_avg": {"avg": {"field": "price"}},
+                        "p_min": {"min": {"field": "price"}},
+                        "p_max": {"max": {"field": "price"}},
+                        "p_sum": {"sum": {"field": "price"}},
+                        "p_cnt": {"value_count": {"field": "price"}},
+                        "p_stats": {"stats": {"field": "price"}}},
+                       n_segments=n_segments)
+        prices = [d["price"] for d in DOCS]
+        assert out["p_avg"]["value"] == pytest.approx(np.mean(prices))
+        assert out["p_min"]["value"] == min(prices)
+        assert out["p_max"]["value"] == max(prices)
+        assert out["p_sum"]["value"] == pytest.approx(sum(prices))
+        assert out["p_cnt"]["value"] == len(prices)
+        assert out["p_stats"]["count"] == len(prices)
+        assert out["p_stats"]["avg"] == pytest.approx(np.mean(prices))
+
+    def test_metrics_under_query(self):
+        out = run_aggs({"s": {"sum": {"field": "qty"}}},
+                       query=dsl.TermQuery(field="category", value="fruit"))
+        assert out["s"]["value"] == 10 + 4 + 6
+
+    def test_cardinality(self):
+        out = run_aggs({"c": {"cardinality": {"field": "category"}}},
+                       n_segments=2)
+        assert out["c"]["value"] == 3
+        out = run_aggs({"c": {"cardinality": {"field": "qty"}}})
+        assert out["c"]["value"] == 6
+
+    def test_percentiles(self):
+        out = run_aggs({"p": {"percentiles": {"field": "price",
+                                              "percents": [50, 100]}}},
+                       n_segments=2)
+        prices = sorted(d["price"] for d in DOCS)
+        assert out["p"]["values"]["100"] == pytest.approx(max(prices))
+        assert out["p"]["values"]["50"] == pytest.approx(np.percentile(prices, 50))
+
+    def test_top_hits(self):
+        out = run_aggs({"cats": {"terms": {"field": "category"},
+                                 "aggs": {"top": {"top_hits": {"size": 2}}}}})
+        fruit = next(b for b in out["cats"]["buckets"] if b["key"] == "fruit")
+        assert fruit["top"]["hits"]["total"]["value"] == 3
+        assert len(fruit["top"]["hits"]["hits"]) == 2
+
+
+class TestTerms:
+    @pytest.mark.parametrize("n_segments", [1, 2, 3])
+    def test_keyword_terms_count_order(self, n_segments):
+        out = run_aggs({"cats": {"terms": {"field": "category"}}},
+                       n_segments=n_segments)
+        buckets = out["cats"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in buckets] == \
+            [("fruit", 3), ("veg", 2), ("meat", 1)]
+        assert out["cats"]["sum_other_doc_count"] == 0
+
+    def test_multi_valued_keyword(self):
+        out = run_aggs({"t": {"terms": {"field": "tags"}}})
+        got = {b["key"]: b["doc_count"] for b in out["t"]["buckets"]}
+        assert got == {"cheap": 3, "fresh": 2, "expensive": 1}
+
+    def test_numeric_terms(self):
+        out = run_aggs({"q": {"terms": {"field": "qty", "size": 3}}})
+        assert len(out["q"]["buckets"]) == 3
+        assert all(b["doc_count"] == 1 for b in out["q"]["buckets"])
+
+    def test_size_and_other_count(self):
+        out = run_aggs({"cats": {"terms": {"field": "category", "size": 1}}})
+        assert len(out["cats"]["buckets"]) == 1
+        assert out["cats"]["buckets"][0]["key"] == "fruit"
+        assert out["cats"]["sum_other_doc_count"] == 3
+
+    def test_key_order(self):
+        out = run_aggs({"cats": {"terms": {"field": "category",
+                                           "order": {"_key": "asc"}}}})
+        assert [b["key"] for b in out["cats"]["buckets"]] == \
+            ["fruit", "meat", "veg"]
+
+    def test_sub_aggregation(self):
+        out = run_aggs({"cats": {"terms": {"field": "category"},
+                                 "aggs": {"avg_p": {"avg": {"field": "price"}}}}},
+                       n_segments=2)
+        by_key = {b["key"]: b for b in out["cats"]["buckets"]}
+        assert by_key["fruit"]["avg_p"]["value"] == pytest.approx((1.5 + 3.0 + 2.5) / 3)
+        assert by_key["meat"]["avg_p"]["value"] == pytest.approx(9.0)
+
+
+class TestHistogram:
+    def test_numeric_histogram(self):
+        # reference default min_doc_count=0: empty buckets fill the range
+        out = run_aggs({"h": {"histogram": {"field": "price", "interval": 2}}})
+        got = {b["key"]: b["doc_count"] for b in out["h"]["buckets"]}
+        assert got == {0.0: 2, 2.0: 3, 4.0: 0, 6.0: 0, 8.0: 1}
+        out = run_aggs({"h": {"histogram": {"field": "price", "interval": 2,
+                                            "min_doc_count": 1}}})
+        got = {b["key"]: b["doc_count"] for b in out["h"]["buckets"]}
+        assert got == {0.0: 2, 2.0: 3, 8.0: 1}
+
+    def test_min_doc_count_zero_fills_gaps(self):
+        out = run_aggs({"h": {"histogram": {"field": "price", "interval": 2,
+                                            "min_doc_count": 0}}})
+        keys = [b["key"] for b in out["h"]["buckets"]]
+        assert keys == [0.0, 2.0, 4.0, 6.0, 8.0]
+
+    def test_date_histogram_calendar_month(self):
+        out = run_aggs({"d": {"date_histogram": {"field": "day",
+                                                 "calendar_interval": "month"}}},
+                       n_segments=2)
+        buckets = out["d"]["buckets"]
+        assert [b["doc_count"] for b in buckets] == [2, 2, 2]
+        assert buckets[0]["key_as_string"].startswith("2024-01-01T00:00:00")
+
+    def test_date_histogram_fixed(self):
+        out = run_aggs({"d": {"date_histogram": {"field": "day",
+                                                 "fixed_interval": "30d"}}})
+        assert sum(b["doc_count"] for b in out["d"]["buckets"]) == 6
+
+
+class TestRangeFiltersMissing:
+    def test_range(self):
+        out = run_aggs({"r": {"range": {"field": "price", "ranges": [
+            {"to": 2.0}, {"from": 2.0, "to": 5.0}, {"from": 5.0}]}}})
+        b = out["r"]["buckets"]
+        assert [x["doc_count"] for x in b] == [2, 3, 1]
+        assert b[0]["to"] == 2.0 and "from" not in b[0]
+        assert b[1]["from"] == 2.0 and b[1]["to"] == 5.0
+
+    def test_filter_and_filters(self):
+        out = run_aggs({
+            "cheap": {"filter": {"range": {"price": {"lt": 2.0}}},
+                      "aggs": {"n": {"value_count": {"field": "price"}}}},
+            "split": {"filters": {"filters": {
+                "red": {"match": {"desc": "red"}},
+                "green": {"match": {"desc": "green"}}}}},
+        })
+        assert out["cheap"]["doc_count"] == 2
+        assert out["cheap"]["n"]["value"] == 2
+        assert out["split"]["buckets"]["red"]["doc_count"] == 2
+        assert out["split"]["buckets"]["green"]["doc_count"] == 2
+
+    def test_missing_and_global(self):
+        out = run_aggs({"no_tags": {"missing": {"field": "tags"}}},
+                       query=dsl.MatchAllQuery())
+        assert out["no_tags"]["doc_count"] == 1
+        out = run_aggs({"all": {"global": {},
+                                "aggs": {"n": {"value_count": {"field": "price"}}}}},
+                       query=dsl.TermQuery(field="category", value="meat"))
+        assert out["all"]["doc_count"] == 6  # ignores the query
+        assert out["all"]["n"]["value"] == 6
+
+
+class TestReduceAcrossShards:
+    def test_shard_level_reduce_matches_single(self):
+        """Sharded collect + reduce == single-shard collect (the two-level
+        reduce contract)."""
+        spec = {"cats": {"terms": {"field": "category"},
+                         "aggs": {"s": {"stats": {"field": "price"}}}},
+                "h": {"histogram": {"field": "qty", "interval": 10}}}
+        single = run_aggs(spec, n_segments=1)
+        # simulate shards: separate readers, reduce partials
+        readers = [make_reader(DOCS[:3]), make_reader(DOCS[3:])]
+        parts = []
+        for r in readers:
+            aggs = parse_aggregations(spec)
+            res = execute_query(r, dsl.MatchAllQuery(), size=0, aggs=aggs)
+            parts.append(res.aggregations)
+        reduced = AggregatorFactories.reduce(parts)
+        assert AggregatorFactories.to_response(reduced) == single

@@ -1,0 +1,256 @@
+"""REST API contract tests — the rest-api-spec YAML-suite shape
+(SURVEY.md §4.1): do request → match response fields, through the
+in-process dispatch path (the HTTP layer is a thin codec over it)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, body=None, raw=None, **params):
+    raw_body = raw.encode() if isinstance(raw, str) else (raw or b"")
+    if body is not None:
+        raw_body = json.dumps(body).encode()
+    return node.handle(method, path,
+                       {k: str(v) for k, v in params.items()},
+                       None, raw_body)
+
+
+class TestRootAndHealth:
+    def test_root(self, node):
+        status, body = do(node, "GET", "/")
+        assert status == 200
+        assert body["tagline"].startswith("You Know, for Search")
+        assert body["version"]["build_flavor"] == "tpu"
+
+    def test_health_green(self, node):
+        status, body = do(node, "GET", "/_cluster/health")
+        assert status == 200 and body["status"] == "green"
+
+
+class TestIndexAdmin:
+    def test_create_get_delete(self, node):
+        status, body = do(node, "PUT", "/books", body={
+            "settings": {"index": {"number_of_shards": 2}},
+            "mappings": {"properties": {"title": {"type": "text"},
+                                        "year": {"type": "integer"}}}})
+        assert status == 200 and body["acknowledged"]
+        status, body = do(node, "GET", "/books")
+        assert status == 200
+        assert body["books"]["settings"]["index"]["number_of_shards"] == "2"
+        assert body["books"]["mappings"]["properties"]["year"]["type"] == "integer"
+        status, _ = do(node, "HEAD", "/books")
+        assert status == 200
+        status, _ = do(node, "DELETE", "/books")
+        assert status == 200
+        status, _ = do(node, "GET", "/books")
+        assert status == 404
+
+    def test_put_mapping_merge(self, node):
+        do(node, "PUT", "/idx", body={})
+        status, _ = do(node, "PUT", "/idx/_mapping", body={
+            "properties": {"brand": {"type": "keyword"}}})
+        assert status == 200
+        _, body = do(node, "GET", "/idx/_mapping")
+        assert body["idx"]["mappings"]["properties"]["brand"]["type"] == "keyword"
+
+    def test_invalid_name_400(self, node):
+        status, body = do(node, "PUT", "/BadName")
+        assert status == 400
+        assert "invalid index name" in body["error"]["reason"]
+
+
+class TestDocumentCrud:
+    def test_index_get_delete_cycle(self, node):
+        status, body = do(node, "PUT", "/idx/_doc/1",
+                          body={"title": "hello"})
+        assert status == 201 and body["result"] == "created"
+        assert body["_seq_no"] == 0 and body["_version"] == 1
+        status, body = do(node, "PUT", "/idx/_doc/1",
+                          body={"title": "hello again"})
+        assert status == 200 and body["result"] == "updated"
+        status, body = do(node, "GET", "/idx/_doc/1")
+        assert status == 200 and body["_source"]["title"] == "hello again"
+        status, body = do(node, "DELETE", "/idx/_doc/1")
+        assert status == 200 and body["result"] == "deleted"
+        status, body = do(node, "GET", "/idx/_doc/1")
+        assert status == 404 and body["found"] is False
+
+    def test_auto_id_and_409_on_conflict(self, node):
+        status, body = do(node, "POST", "/idx/_doc", body={"a": 1})
+        assert status == 201 and len(body["_id"]) > 0
+        do(node, "PUT", "/idx/_doc/x", body={"a": 1})
+        status, body = do(node, "PUT", "/idx/_doc/x", body={"a": 2},
+                          if_seq_no=99, if_primary_term=1)
+        assert status == 409
+        assert body["error"]["type"] == "version_conflict_engine_exception"
+
+    def test_update_doc_merge(self, node):
+        do(node, "PUT", "/idx/_doc/1", body={"a": {"b": 1}, "c": 2})
+        status, body = do(node, "POST", "/idx/_update/1",
+                          body={"doc": {"a": {"d": 3}}})
+        assert status == 200
+        _, body = do(node, "GET", "/idx/_doc/1")
+        assert body["_source"] == {"a": {"b": 1, "d": 3}, "c": 2}
+
+    def test_mget(self, node):
+        do(node, "PUT", "/idx/_doc/1", body={"v": 1})
+        do(node, "PUT", "/idx/_doc/2", body={"v": 2})
+        status, body = do(node, "POST", "/_mget", body={
+            "docs": [{"_index": "idx", "_id": "1"},
+                     {"_index": "idx", "_id": "404"}]})
+        assert status == 200
+        assert body["docs"][0]["_source"]["v"] == 1
+        assert body["docs"][1]["found"] is False
+
+
+class TestBulk:
+    def test_bulk_mixed(self, node):
+        nd = "\n".join([
+            json.dumps({"index": {"_index": "logs", "_id": "1"}}),
+            json.dumps({"msg": "first event"}),
+            json.dumps({"index": {"_index": "logs", "_id": "2"}}),
+            json.dumps({"msg": "second event"}),
+            json.dumps({"delete": {"_index": "logs", "_id": "1"}}),
+            json.dumps({"create": {"_index": "logs", "_id": "3"}}),
+            json.dumps({"msg": "third"}),
+        ]) + "\n"
+        status, body = do(node, "POST", "/_bulk", raw=nd, refresh="true")
+        assert status == 200 and body["errors"] is False
+        kinds = [next(iter(i)) for i in body["items"]]
+        assert kinds == ["index", "index", "delete", "create"]
+        status, body = do(node, "GET", "/logs/_count")
+        assert body["count"] == 2
+
+    def test_bulk_create_conflict_flagged(self, node):
+        do(node, "PUT", "/idx/_doc/1", body={"a": 1})
+        nd = json.dumps({"create": {"_index": "idx", "_id": "1"}}) + "\n" + \
+            json.dumps({"a": 2}) + "\n"
+        status, body = do(node, "POST", "/_bulk", raw=nd)
+        assert status == 200 and body["errors"] is True
+
+
+class TestSearch:
+    @pytest.fixture
+    def seeded(self, node):
+        do(node, "PUT", "/prod", body={
+            "settings": {"index": {"number_of_shards": 3}},
+            "mappings": {"properties": {
+                "name": {"type": "text"},
+                "brand": {"type": "keyword"},
+                "price": {"type": "double"}}}})
+        products = [
+            ("1", "red running shoes", "nike", 90.0),
+            ("2", "blue running shorts", "nike", 30.0),
+            ("3", "red casual shoes", "adidas", 70.0),
+            ("4", "green tennis racket", "wilson", 120.0),
+            ("5", "red tennis balls", "wilson", 8.0),
+        ]
+        for pid, name, brand, price in products:
+            do(node, "PUT", f"/prod/_doc/{pid}",
+               body={"name": name, "brand": brand, "price": price})
+        do(node, "POST", "/prod/_refresh")
+        return node
+
+    def test_match_query_matching(self, seeded):
+        status, body = do(seeded, "POST", "/prod/_search", body={
+            "query": {"match": {"name": "red shoes"}}})
+        assert status == 200
+        ids = [h["_id"] for h in body["hits"]["hits"]]
+        assert set(ids) == {"1", "3", "5"}
+        assert body["hits"]["total"]["value"] == 3
+        assert body["hits"]["hits"][0]["_index"] == "prod"
+
+    def test_match_ranking_single_shard(self, node):
+        # ranking asserted on ONE shard: with several shards, shard-local
+        # idf skews tiny corpora (the reference's query_then_fetch has the
+        # same artifact; dfs_query_then_fetch fixes it)
+        do(node, "PUT", "/r1", body={
+            "settings": {"index": {"number_of_shards": 1}},
+            "mappings": {"properties": {"name": {"type": "text"}}}})
+        for pid, name in [("1", "red running shoes"),
+                          ("3", "red casual shoes"),
+                          ("5", "red tennis balls")]:
+            do(node, "PUT", f"/r1/_doc/{pid}", body={"name": name})
+        do(node, "POST", "/r1/_refresh")
+        _, body = do(node, "POST", "/r1/_search", body={
+            "query": {"match": {"name": "red shoes"}}})
+        ids = [h["_id"] for h in body["hits"]["hits"]]
+        assert set(ids[:2]) == {"1", "3"}  # both terms beat one
+        assert ids[2] == "5"
+
+    def test_bool_filter_and_source_filtering(self, seeded):
+        status, body = do(seeded, "POST", "/prod/_search", body={
+            "query": {"bool": {
+                "must": [{"match": {"name": "red"}}],
+                "filter": [{"range": {"price": {"gte": 50}}}]}},
+            "_source": ["name"]})
+        ids = {h["_id"] for h in body["hits"]["hits"]}
+        assert ids == {"1", "3"}
+        src = body["hits"]["hits"][0]["_source"]
+        assert "name" in src and "price" not in src
+
+    def test_aggs_through_rest(self, seeded):
+        status, body = do(seeded, "POST", "/prod/_search", body={
+            "size": 0,
+            "aggs": {"brands": {"terms": {"field": "brand"},
+                                "aggs": {"avg_price": {"avg": {"field": "price"}}}}}})
+        assert status == 200
+        buckets = {b["key"]: b for b in
+                   body["aggregations"]["brands"]["buckets"]}
+        assert buckets["nike"]["doc_count"] == 2
+        assert buckets["nike"]["avg_price"]["value"] == pytest.approx(60.0)
+        assert buckets["wilson"]["avg_price"]["value"] == pytest.approx(64.0)
+
+    def test_pagination(self, seeded):
+        _, p1 = do(seeded, "POST", "/prod/_search", body={
+            "query": {"match_all": {}}, "size": 2, "from": 0})
+        _, p2 = do(seeded, "POST", "/prod/_search", body={
+            "query": {"match_all": {}}, "size": 2, "from": 2})
+        ids1 = [h["_id"] for h in p1["hits"]["hits"]]
+        ids2 = [h["_id"] for h in p2["hits"]["hits"]]
+        assert len(ids1) == 2 and len(ids2) == 2
+        assert not set(ids1) & set(ids2)
+
+    def test_count_and_cat(self, seeded):
+        _, body = do(seeded, "GET", "/prod/_count")
+        assert body["count"] == 5
+        status, body = do(seeded, "GET", "/_cat/indices", v="")
+        assert status == 200 and "prod" in body["_cat"]
+
+    def test_wildcard_index_resolution(self, seeded):
+        do(seeded, "PUT", "/other", body={})
+        do(seeded, "PUT", "/other/_doc/9", body={"name": "thing"},
+           refresh="true")
+        _, body = do(seeded, "POST", "/prod,other/_search",
+                     body={"query": {"match_all": {}}})
+        assert body["hits"]["total"]["value"] == 6
+        _, body = do(seeded, "POST", "/pro*/_search",
+                     body={"query": {"match_all": {}}})
+        assert body["hits"]["total"]["value"] == 5
+
+    def test_unknown_route_and_bad_query(self, seeded):
+        status, _ = do(seeded, "GET", "/prod/_nosuchapi")
+        assert status == 400
+        status, body = do(seeded, "POST", "/prod/_search", body={
+            "query": {"wibble": {}}})
+        assert status == 400
+
+
+class TestAnalyzeApi:
+    def test_analyze_standard(self, node):
+        status, body = do(node, "POST", "/_analyze",
+                          body={"analyzer": "standard",
+                                "text": "The QUICK brown-Fox!"})
+        assert status == 200
+        tokens = [t["token"] for t in body["tokens"]]
+        assert tokens == ["the", "quick", "brown", "fox"]
