@@ -68,7 +68,10 @@ class Segment:
                  doc_values: Dict[str, DocValuesColumn],
                  stored_source: List[Optional[dict]],
                  positions: Optional[Dict[str, Dict[str, Dict[int, np.ndarray]]]] = None,
-                 exact_lengths: Optional[Dict[str, np.ndarray]] = None):
+                 exact_lengths: Optional[Dict[str, np.ndarray]] = None,
+                 seq_nos: Optional[np.ndarray] = None,
+                 primary_terms: Optional[np.ndarray] = None,
+                 doc_versions: Optional[np.ndarray] = None):
         self.name = name
         self.num_docs = num_docs
         self.doc_ids = doc_ids                    # local doc ord -> external _id
@@ -82,6 +85,15 @@ class Segment:
         # lossy scoring representation; stats (avgdl) must stay EXACT across
         # merges, as Lucene maintains sumTotalTermFreq exactly
         self.exact_lengths = exact_lengths or {}
+        # per-doc write metadata, persisted so CAS/versioning survives a
+        # restart (reference stores _seq_no/_primary_term/_version as doc
+        # values; SURVEY.md §2.1#27 metadata fields)
+        self.seq_nos = seq_nos if seq_nos is not None else \
+            np.full(num_docs, -1, dtype=np.int64)
+        self.primary_terms = primary_terms if primary_terms is not None else \
+            np.zeros(num_docs, dtype=np.int64)
+        self.doc_versions = doc_versions if doc_versions is not None else \
+            np.ones(num_docs, dtype=np.int64)
         self.id_to_ord: Dict[str, int] = {d: i for i, d in enumerate(doc_ids)}
 
     def doc_freq(self, field: str, term: str) -> int:
@@ -120,17 +132,25 @@ class SegmentWriter:
         self._doc_values: Dict[str, Dict[int, Any]] = {}
         self._dv_kinds: Dict[str, str] = {}
         self._stored: List[Optional[dict]] = []
+        self._seq_nos: List[int] = []
+        self._primary_terms: List[int] = []
+        self._versions: List[int] = []
 
     @property
     def num_docs(self) -> int:
         return len(self._doc_ids)
 
-    def add_document(self, doc: ParsedDocument, dv_kinds: Dict[str, str]) -> int:
+    def add_document(self, doc: ParsedDocument, dv_kinds: Dict[str, str],
+                     seq_no: int = -1, primary_term: int = 0,
+                     version: int = 1) -> int:
         """dv_kinds: field → "i64"|"f64"|"ord" from the mapper's field types.
         Returns the local doc ordinal."""
         ord_ = len(self._doc_ids)
         self._doc_ids.append(doc.doc_id)
         self._stored.append(doc.source)
+        self._seq_nos.append(seq_no)
+        self._primary_terms.append(primary_term)
+        self._versions.append(version)
         for field, terms in doc.postings_terms.items():
             field_postings = self._postings.setdefault(field, {})
             tf: Dict[str, int] = {}
@@ -184,7 +204,11 @@ class SegmentWriter:
         }
         return Segment(self.name, n, list(self._doc_ids), postings, norms,
                        dict(self._field_stats), doc_values, list(self._stored),
-                       positions, exact_lengths)
+                       positions, exact_lengths,
+                       seq_nos=np.array(self._seq_nos, dtype=np.int64),
+                       primary_terms=np.array(self._primary_terms,
+                                              dtype=np.int64),
+                       doc_versions=np.array(self._versions, dtype=np.int64))
 
 
 def _build_dv_column(kind: str, per_doc: Dict[int, Any], n: int) -> DocValuesColumn:
@@ -224,6 +248,9 @@ def merge_segments(name: str, segments: List[Segment],
     bool mask over segments[i] docs (None = all live)."""
     doc_ids: List[str] = []
     stored: List[Optional[dict]] = []
+    seq_nos: List[int] = []
+    primary_terms: List[int] = []
+    doc_versions: List[int] = []
     remap: List[np.ndarray] = []  # per segment: old ord -> new ord (-1 dropped)
     for i, seg in enumerate(segments):
         mask = live_docs[i] if live_docs is not None and live_docs[i] is not None \
@@ -235,6 +262,9 @@ def merge_segments(name: str, segments: List[Segment],
         for ord_ in keep:
             doc_ids.append(seg.doc_ids[ord_])
             stored.append(seg.stored_source[ord_])
+            seq_nos.append(int(seg.seq_nos[ord_]))
+            primary_terms.append(int(seg.primary_terms[ord_]))
+            doc_versions.append(int(seg.doc_versions[ord_]))
     n = len(doc_ids)
 
     postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
@@ -326,4 +356,7 @@ def merge_segments(name: str, segments: List[Segment],
         doc_values[field] = _build_dv_column(kind, per_doc, n)
 
     return Segment(name, n, doc_ids, postings, norms, field_stats, doc_values,
-                   stored, positions, exact_lengths)
+                   stored, positions, exact_lengths,
+                   seq_nos=np.array(seq_nos, dtype=np.int64),
+                   primary_terms=np.array(primary_terms, dtype=np.int64),
+                   doc_versions=np.array(doc_versions, dtype=np.int64))

@@ -19,6 +19,7 @@ Every array file carries a CRC in the manifest; load verifies it
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import zlib
@@ -80,15 +81,22 @@ def save_segment(path: str, seg: Segment) -> Dict[str, int]:
         meta["dv"][field] = {
             "kind": col.kind, "ord_terms": col.ord_terms,
             "extra": {str(k): v for k, v in col.extra.items()}}
+    arrays["meta.seq_nos"] = seg.seq_nos
+    arrays["meta.primary_terms"] = seg.primary_terms
+    arrays["meta.doc_versions"] = seg.doc_versions
     npz_path = os.path.join(_segments_dir(path), f"{seg.name}.npz")
     json_path = os.path.join(_segments_dir(path), f"{seg.name}.json")
-    np.savez(npz_path, **arrays)
+    # fsync-before-manifest ordering (Lucene fsyncs segment files before
+    # segments_N): serialize to bytes, then tmp+fsync+rename+dir-fsync, so
+    # a durable commit.json can never reference un-durable segment bytes
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    npz_bytes = buf.getvalue()
+    write_atomic(npz_path, npz_bytes)
     json_bytes = json.dumps(meta).encode("utf-8")
     write_atomic(json_path, json_bytes)
-    crcs = {}
-    with open(npz_path, "rb") as f:
-        crcs[f"{seg.name}.npz"] = zlib.crc32(f.read())
-    crcs[f"{seg.name}.json"] = zlib.crc32(json_bytes)
+    crcs = {f"{seg.name}.npz": zlib.crc32(npz_bytes),
+            f"{seg.name}.json": zlib.crc32(json_bytes)}
     return crcs
 
 
@@ -109,7 +117,6 @@ def load_segment(path: str, name: str,
         if zlib.crc32(json_bytes) != expected_crcs.get(f"{name}.json"):
             raise CorruptIndexException(f"segment [{name}] json checksum mismatch")
     meta = json.loads(json_bytes.decode("utf-8"))
-    import io
     arrays = np.load(io.BytesIO(npz_bytes))
     postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
     for field, names in meta["postings_fields"].items():
@@ -138,9 +145,15 @@ def load_segment(path: str, name: str,
                 for d, p in docs.items()}
             for t, docs in terms.items()}
         for f, terms in meta["positions"].items()}
+    seq_nos = arrays["meta.seq_nos"] if "meta.seq_nos" in arrays.files else None
+    primary_terms = (arrays["meta.primary_terms"]
+                     if "meta.primary_terms" in arrays.files else None)
+    doc_versions = (arrays["meta.doc_versions"]
+                    if "meta.doc_versions" in arrays.files else None)
     return Segment(meta["name"], meta["num_docs"], meta["doc_ids"], postings,
                    norms, field_stats, doc_values, meta["stored"], positions,
-                   exact)
+                   exact, seq_nos=seq_nos, primary_terms=primary_terms,
+                   doc_versions=doc_versions)
 
 
 def write_commit(path: str, *, segments: List[str],

@@ -10,6 +10,7 @@ so external routing behavior is bit-identical.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import uuid
@@ -22,6 +23,7 @@ from elasticsearch_tpu.common.errors import (
 )
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.shard import IndexShard, ShardId
+from elasticsearch_tpu.index.translog import write_atomic
 from elasticsearch_tpu.mapping import MapperService
 
 
@@ -129,12 +131,51 @@ class IndexService:
 
 
 class IndicesService:
-    """Registry of open indices on this node (reference: IndicesService)."""
+    """Registry of open indices on this node (reference: IndicesService).
+
+    Index metadata (name → uuid/settings/mapping) is persisted in
+    `<data_path>/_state/indices.json` and reloaded at startup so a node
+    restart reopens its indices — the node-local slice of the reference's
+    GatewayMetaState/PersistedClusterStateService (SURVEY.md §2.1#20)."""
 
     def __init__(self, data_path: str):
         self.data_path = data_path
         self._lock = threading.Lock()
         self.indices: Dict[str, IndexService] = {}
+        self._load_metadata()
+
+    # -------- gateway metadata (survives restart) --------
+
+    def _state_path(self) -> str:
+        return os.path.join(self.data_path, "_state", "indices.json")
+
+    def _persist_metadata_locked(self) -> None:
+        meta = {name: {"uuid": svc.index_uuid,
+                       "settings": svc.settings.get_as_dict(),
+                       "mapping": svc.mapper.to_mapping()}
+                for name, svc in self.indices.items()}
+        os.makedirs(os.path.dirname(self._state_path()), exist_ok=True)
+        write_atomic(self._state_path(),
+                     json.dumps(meta, sort_keys=True).encode("utf-8"))
+
+    def persist_metadata(self) -> None:
+        """Re-write the metadata manifest (call after mapping updates)."""
+        with self._lock:
+            self._persist_metadata_locked()
+
+    def _load_metadata(self) -> None:
+        p = self._state_path()
+        if not os.path.exists(p):
+            return
+        with open(p, "rb") as f:
+            meta = json.loads(f.read().decode("utf-8"))
+        for name, m in meta.items():
+            svc = IndexService(name, m["uuid"], Settings.of(m["settings"]),
+                               m.get("mapping"),
+                               os.path.join(self.data_path, m["uuid"]))
+            for i in range(svc.num_shards):
+                svc.create_shard(i, primary=True)  # recovers from store
+            self.indices[name] = svc
 
     def create_index(self, name: str, settings: Optional[Settings] = None,
                      mapping: Optional[dict] = None,
@@ -152,6 +193,7 @@ class IndicesService:
                 for i in range(svc.num_shards):
                     svc.create_shard(i, primary=True)
             self.indices[name] = svc
+            self._persist_metadata_locked()
             return svc
 
     def index(self, name: str) -> IndexService:
@@ -169,6 +211,7 @@ class IndicesService:
             if svc is None:
                 raise IndexNotFoundException(f"no such index [{name}]")
             svc.close()
+            self._persist_metadata_locked()
             import shutil
             shutil.rmtree(svc.data_path, ignore_errors=True)
 

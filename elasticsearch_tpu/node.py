@@ -39,7 +39,10 @@ class Node:
         self._register_actions()
         self._refresh_interval = self.settings.get_float(
             "index.refresh_interval_seconds", 1.0)
+        self._sync_interval = self.settings.get_float(
+            "index.translog.sync_interval_seconds", 5.0)
         self._refresher: Optional[threading.Timer] = None
+        self._syncer: Optional[threading.Timer] = None
         self._closed = False
 
     def _register_actions(self) -> None:
@@ -62,7 +65,13 @@ class Node:
                 from elasticsearch_tpu.common.errors import IndexNotFoundException
                 raise IndexNotFoundException(f"no such index [{name}] and "
                                              f"auto-create is disabled")
-            return self.indices.create_index(name)
+            from elasticsearch_tpu.common.errors import \
+                IndexAlreadyExistsException
+            try:
+                return self.indices.create_index(name)
+            except IndexAlreadyExistsException:
+                # concurrent first-writes raced; the other one won
+                return self.indices.index(name)
         return self.indices.index(name)
 
     # ---------------- background refresh (NRT cycle) ----------------
@@ -84,10 +93,33 @@ class Node:
         self._refresher.daemon = True
         self._refresher.start()
 
+        # the async-durability fsync cycle (reference: 5s translog sync
+        # timer) — advances the persisted checkpoint for durability=async
+        # shards and bounds the unpersisted-seqno backlog
+        def sync_tick():
+            if self._closed:
+                return
+            try:
+                for svc in list(self.indices.indices.values()):
+                    for shard in list(svc.shards.values()):
+                        try:
+                            shard.engine.sync_translog()
+                        except Exception:  # noqa: BLE001 — background task
+                            pass
+            finally:  # the cycle must survive any error
+                self._syncer = threading.Timer(self._sync_interval, sync_tick)
+                self._syncer.daemon = True
+                self._syncer.start()
+        self._syncer = threading.Timer(self._sync_interval, sync_tick)
+        self._syncer.daemon = True
+        self._syncer.start()
+
     def close(self) -> None:
         self._closed = True
         if self._refresher:
             self._refresher.cancel()
+        if self._syncer:
+            self._syncer.cancel()
         self.indices.close()
 
     # ---------------- in-process dispatch (tests + http) ----------------

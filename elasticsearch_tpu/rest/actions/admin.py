@@ -59,6 +59,7 @@ def register(controller: RestController, node) -> None:
     def put_mapping(req: RestRequest):
         for name in resolve_indices(indices, req.param("index")):
             indices.index(name).mapper.merge(req.body or {})
+        indices.persist_metadata()  # mapping is part of gateway state
         return 200, {"acknowledged": True}
 
     def get_mapping(req: RestRequest):

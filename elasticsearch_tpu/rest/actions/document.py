@@ -25,14 +25,15 @@ def _auto_id() -> str:
 def register(controller: RestController, node) -> None:
     indices = node.indices
 
-    def _index_doc(index: str, doc_id, body, params) -> Tuple[int, Dict]:
+    def _index_doc(index: str, doc_id, body, params,
+                   op_type: str = "index") -> Tuple[int, Dict]:
         if not isinstance(body, dict):
             raise IllegalArgumentException("request body is required")
         svc = node.get_or_autocreate_index(index)
         created_id = doc_id or _auto_id()
         shard = svc.shard(svc.shard_for_id(created_id,
                                            params.get("routing")))
-        kwargs = {}
+        kwargs = {"op_type": op_type} if op_type != "index" else {}
         if params.get("if_seq_no") is not None:
             kwargs["if_seq_no"] = int(params["if_seq_no"])
         if params.get("if_primary_term") is not None:
@@ -52,8 +53,17 @@ def register(controller: RestController, node) -> None:
         }
 
     def put_doc(req: RestRequest):
+        if req.params.get("op_type") == "create":
+            return create_doc(req)
         return _index_doc(req.param("index"), req.param("id"), req.body,
                           req.params)
+
+    def create_doc(req: RestRequest):
+        """op_type=create: 409 if the doc exists — enforced inside the
+        engine's write lock so concurrent creates serialize (reference:
+        version_conflict_engine_exception on op_type=create)."""
+        return _index_doc(req.param("index"), req.param("id"), req.body,
+                          req.params, op_type="create")
 
     def post_doc(req: RestRequest):
         return _index_doc(req.param("index"), None, req.body, req.params)
@@ -204,11 +214,9 @@ def register(controller: RestController, node) -> None:
                         "result": r.result, "_seq_no": r.seq_no,
                         "_primary_term": r.primary_term, "status": 200}})
                 else:
-                    if op == "create" and shard.get(the_id) is not None:
-                        raise EsException(
-                            f"[{the_id}]: version conflict, document already "
-                            f"exists")
-                    r = shard.apply_index_on_primary(the_id, source)
+                    r = shard.apply_index_on_primary(
+                        the_id, source,
+                        **({"op_type": "create"} if op == "create" else {}))
                     status = 201 if r.created else 200
                     items.append({op: {
                         "_index": index, "_id": the_id, "_version": r.version,
@@ -228,7 +236,8 @@ def register(controller: RestController, node) -> None:
 
     controller.register("PUT", "/{index}/_doc/{id}", put_doc)
     controller.register("POST", "/{index}/_doc/{id}", put_doc)
-    controller.register("PUT", "/{index}/_create/{id}", put_doc)
+    controller.register("PUT", "/{index}/_create/{id}", create_doc)
+    controller.register("POST", "/{index}/_create/{id}", create_doc)
     controller.register("POST", "/{index}/_doc", post_doc)
     controller.register("GET", "/{index}/_doc/{id}", get_doc)
     controller.register("DELETE", "/{index}/_doc/{id}", delete_doc)

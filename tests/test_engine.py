@@ -258,3 +258,145 @@ class TestEngineMerge:
         assert e.maybe_merge() is True
         assert e.segment_count() == 1
         e.close()
+
+
+class TestPersistedDocMetadata:
+    """Per-doc seq_no/version/primary_term survive flush + restart
+    (reference persists _seq_no/_version as doc values; ADVICE r1)."""
+
+    def test_cas_after_flush_and_restart(self, tmp_path):
+        e = make_engine(tmp_path)
+        r1 = e.index("d1", {"title": "hello world"})
+        r2 = e.index("d1", {"title": "hello again"})  # v2
+        e.flush()
+        e.close()
+        e = make_engine(tmp_path)
+        # stale CAS must conflict; current CAS must succeed
+        with pytest.raises(VersionConflictEngineException):
+            e.index("d1", {"title": "x"}, if_seq_no=r1.seq_no,
+                    if_primary_term=r1.primary_term)
+        r3 = e.index("d1", {"title": "y"}, if_seq_no=r2.seq_no,
+                     if_primary_term=r2.primary_term)
+        assert r3.version == 3  # internal versions continue, not restart at 1
+        assert r3.result == "updated"
+        e.close()
+
+    def test_external_version_after_restart(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("d1", {"title": "a"}, version=10, version_type="external")
+        e.flush()
+        e.close()
+        e = make_engine(tmp_path)
+        with pytest.raises(VersionConflictEngineException):
+            e.index("d1", {"title": "b"}, version=5, version_type="external")
+        r = e.index("d1", {"title": "c"}, version=11, version_type="external")
+        assert r.version == 11
+        e.close()
+
+    def test_metadata_survives_merge(self, tmp_path):
+        e = make_engine(tmp_path)
+        r1 = e.index("d1", {"title": "a"})
+        e.refresh()
+        e.index("d2", {"title": "b"})
+        e.refresh()
+        e.force_merge()
+        vv = e._resolve_committed("d1")
+        assert vv.seq_no == r1.seq_no
+        assert vv.version == r1.version
+        e.close()
+
+
+class TestNumDocsPendingDeletes:
+    def test_buffered_update_not_double_counted(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("d1", {"title": "a"})
+        e.refresh()
+        assert e.num_docs() == 1
+        e.index("d1", {"title": "b"})  # buffered update of committed doc
+        assert e.num_docs() == 1  # was 2 before the fix
+        e.refresh()
+        assert e.num_docs() == 1
+        e.close()
+
+
+class TestAsyncDurabilityCheckpoint:
+    def test_persisted_lags_until_sync(self, tmp_path):
+        e = make_engine(tmp_path, durability=Translog.DURABILITY_ASYNC)
+        r = e.index("d1", {"title": "a"})
+        assert e.tracker.processed_checkpoint == r.seq_no
+        assert e.tracker.persisted_checkpoint < r.seq_no  # no fsync yet
+        e.sync_translog()
+        assert e.tracker.persisted_checkpoint == r.seq_no
+        e.close()
+
+    def test_request_durability_immediate(self, tmp_path):
+        e = make_engine(tmp_path)
+        r = e.index("d1", {"title": "a"})
+        assert e.tracker.persisted_checkpoint == r.seq_no
+        e.close()
+
+
+class TestDynamicMappingRecovery:
+    def test_dynamic_fields_survive_flush_restart(self, tmp_path):
+        """Dynamically-mapped fields are restored from the commit's
+        mapping on reopen (code-review r2 finding #1)."""
+        from elasticsearch_tpu.mapping import MapperService
+        ms = MapperService(Settings.EMPTY, None)  # no explicit mapping
+        e = InternalEngine(EngineConfig(path=str(tmp_path), mapper=ms))
+        e.index("1", {"headline": "breaking news today"})
+        e.flush()
+        e.close()
+        ms2 = MapperService(Settings.EMPTY, None)
+        e2 = InternalEngine(EngineConfig(path=str(tmp_path), mapper=ms2))
+        props = ms2.to_mapping().get("properties", {})
+        assert "headline" in props
+        assert search_ids(e2, "breaking") == []  # wrong field; sanity below
+        reader = e2.acquire_reader()
+        res = execute_query(
+            reader, dsl.MatchQuery(field="headline", query="breaking"),
+            size=10)
+        assert [h.doc_id for h in res.hits] == ["1"]
+        e2.close()
+
+
+class TestOpTypeCreate:
+    def test_create_conflicts_inside_engine(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"title": "a"}, op_type="create")
+        with pytest.raises(VersionConflictEngineException):
+            e.index("1", {"title": "b"}, op_type="create")
+        # delete frees the id for re-create (reference semantics)
+        e.delete("1")
+        r = e.index("1", {"title": "c"}, op_type="create")
+        assert r.version == 3
+        e.close()
+
+    def test_concurrent_creates_single_winner(self, tmp_path):
+        import threading as th
+        e = make_engine(tmp_path)
+        results = []
+        def attempt():
+            try:
+                e.index("x", {"title": "racer"}, op_type="create")
+                results.append("ok")
+            except VersionConflictEngineException:
+                results.append("conflict")
+        ts = [th.Thread(target=attempt) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert results.count("ok") == 1
+        assert results.count("conflict") == 7
+        e.close()
+
+
+class TestDeleteVersionContinuity:
+    def test_double_delete_keeps_versions_monotonic(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("d", {"title": "a"})          # v1
+        r2 = e.delete("d")                     # v2
+        assert r2.version == 2
+        r3 = e.delete("d")                     # v3 (not found, still bumps)
+        assert r3.version == 3 and not r3.found
+        r4 = e.index("d", {"title": "b"})      # v4
+        assert r4.version == 4
+        e.close()

@@ -113,8 +113,10 @@ class TestIndicesService:
         shard.apply_index_on_primary("d", {"field": "value"})
         shard.flush()
         svc.close()
+        # gateway metadata reopens the index automatically on restart
         svc2 = IndicesService(str(tmp_path))
-        idx2 = svc2.create_index("persist", index_uuid="fixed-uuid")
+        idx2 = svc2.index("persist")
+        assert idx2.index_uuid == "fixed-uuid"
         assert idx2.shard(0).get("d")["_source"]["field"] == "value"
         svc2.close()
 
@@ -132,3 +134,42 @@ class TestShardPromotion:
         r = replica.apply_index_on_primary("d", {"a": 2})
         assert r.primary_term == 2 and r.seq_no == 1
         svc.close()
+
+
+class TestGatewayMetadataPersistence:
+    """Node restart reopens indices from `_state/indices.json` + shard
+    stores (reference: GatewayMetaState, SURVEY.md §2.1#20)."""
+
+    def test_indices_survive_service_restart(self, tmp_path):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.indices.service import IndicesService
+        svc = IndicesService(str(tmp_path))
+        idx = svc.create_index(
+            "books", Settings.of({"index": {"number_of_shards": 2}}),
+            {"properties": {"title": {"type": "text"}}})
+        shard = idx.shard(idx.shard_for_id("1"))
+        shard.apply_index_on_primary("1", {"title": "the hobbit"})
+        idx.flush()
+        svc.close()
+
+        svc2 = IndicesService(str(tmp_path))
+        assert svc2.has_index("books")
+        idx2 = svc2.index("books")
+        assert idx2.num_shards == 2
+        assert idx2.index_uuid == idx.index_uuid
+        assert idx2.mapper.to_mapping()["properties"]["title"]["type"] == "text"
+        shard2 = idx2.shard(idx2.shard_for_id("1"))
+        assert shard2.get("1")["_source"] == {"title": "the hobbit"}
+        svc2.close()
+
+    def test_deleted_index_stays_deleted(self, tmp_path):
+        from elasticsearch_tpu.indices.service import IndicesService
+        svc = IndicesService(str(tmp_path))
+        svc.create_index("a")
+        svc.create_index("b")
+        svc.delete_index("a")
+        svc.close()
+        svc2 = IndicesService(str(tmp_path))
+        assert not svc2.has_index("a")
+        assert svc2.has_index("b")
+        svc2.close()

@@ -254,3 +254,15 @@ class TestAnalyzeApi:
         assert status == 200
         tokens = [t["token"] for t in body["tokens"]]
         assert tokens == ["the", "quick", "brown", "fox"]
+
+
+class TestCreateOpType:
+    def test_create_conflicts_on_existing(self, node):
+        node.handle("PUT", "/idx/_doc/1", {}, {"title": "a"})
+        status, body = node.handle("PUT", "/idx/_create/1", {}, {"title": "b"})
+        assert status == 409
+        status, body = node.handle("PUT", "/idx/_create/2", {}, {"title": "c"})
+        assert status == 201
+        status, body = node.handle(
+            "PUT", "/idx/_doc/2", {"op_type": "create"}, {"title": "d"})
+        assert status == 409
