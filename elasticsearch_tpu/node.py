@@ -35,6 +35,23 @@ class Node:
         self.cluster_name = cluster_name
         self.cluster_uuid = uuid.uuid4().hex[:20]
         self.indices = IndicesService(data_path)
+        # the TPU serving path: resident packs + micro-batched kernel
+        # (disable with search.tpu_serving.enabled=false — the planner
+        # path then serves everything)
+        self.tpu_search = None
+        if self.settings.get_bool("search.tpu_serving.enabled", True):
+            from elasticsearch_tpu.common.breaker import \
+                HierarchyCircuitBreakerService
+            from elasticsearch_tpu.search.tpu_service import TpuSearchService
+            self.breakers = HierarchyCircuitBreakerService(
+                total_limit_bytes=self.settings.get_int(
+                    "indices.breaker.total.limit_bytes", 8 << 30))
+            self.tpu_search = TpuSearchService(
+                breaker=self.breakers.breakers["hbm"],
+                window_s=self.settings.get_float(
+                    "search.tpu_serving.batch_window_seconds", 0.002),
+                max_batch=self.settings.get_int(
+                    "search.tpu_serving.max_batch", 64))
         self.controller = RestController()
         self._register_actions()
         self._refresh_interval = self.settings.get_float(
@@ -120,6 +137,8 @@ class Node:
             self._refresher.cancel()
         if self._syncer:
             self._syncer.cancel()
+        if self.tpu_search is not None:
+            self.tpu_search.close()
         self.indices.close()
 
     # ---------------- in-process dispatch (tests + http) ----------------

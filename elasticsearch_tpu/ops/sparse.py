@@ -40,7 +40,7 @@ NEG_INF = float("-inf")
 
 
 @partial(jax.jit, static_argnames=("max_len", "d_pad", "k", "t_window",
-                                   "with_counts"))
+                                   "with_counts", "with_totals"))
 def sorted_merge_topk(
     flat_docs: jax.Array,    # int32[P_flat] postings doc ids (pad = d_pad)
     flat_impact: jax.Array,  # f32[P_flat] eager BM25 impacts
@@ -54,9 +54,12 @@ def sorted_merge_topk(
     k: int,                  # static: top-k
     t_window: int,           # static: T (slot count = max same-doc entries)
     with_counts: bool,       # static: evaluate min_count (msm/AND)
-) -> Tuple[jax.Array, jax.Array]:
-    """→ (scores f32[R, k'], doc_ids int32[R, k']); empty lanes are
-    (-inf, d_pad). k' = min(k, T·L_c)."""
+    with_totals: bool = False,  # static: also return matched-doc counts
+) -> Tuple[jax.Array, ...]:
+    """→ (scores f32[R, k'], doc_ids int32[R, k'][, totals int32[R]]);
+    empty lanes are (-inf, d_pad). k' = min(k, T·L_c). totals (when
+    with_totals) is the exact per-row count of matching docs — the
+    TotalHits value of the reference's query phase."""
     r, t_slots = starts.shape
     idx = jnp.arange(max_len, dtype=jnp.int32)
 
@@ -100,6 +103,8 @@ def sorted_merge_topk(
     vals, pos = jax.lax.top_k(score, min(k, length))
     hit_docs = jnp.take_along_axis(sk, pos, axis=1)
     hit_docs = jnp.where(vals > NEG_INF, hit_docs, d_pad)
+    if with_totals:
+        return vals, hit_docs, jnp.sum(ok, axis=1, dtype=jnp.int32)
     return vals, hit_docs
 
 

@@ -76,6 +76,15 @@ class StackedShardPack:
     df: Dict[str, int]
     k1: float = 1.2
     b: float = 0.75
+    # statistics groups: row_group[i] names the stats group of row i (one
+    # group per REAL index shard when rows are its segments). idf/avgdl are
+    # then group-level — the reference's default query_then_fetch mode,
+    # where Lucene stats are per-shard (SURVEY.md §3.3, CollectionStatistics
+    # note). With one group for all rows this degrades to index-level stats
+    # = the dfs_query_then_fetch mode.
+    row_group: Optional[List[int]] = None
+    group_df: Optional[List[Dict[str, int]]] = None
+    group_doc_count: Optional[List[int]] = None
 
     def nbytes_device(self) -> int:
         return (self.flat_docs.nbytes + self.flat_impact.nbytes
@@ -85,9 +94,16 @@ class StackedShardPack:
 def build_stacked_pack(segments: Sequence[Segment], field: str,
                        live_docs: Optional[Sequence[Optional[np.ndarray]]] = None,
                        k1: float = 1.2, b: float = 0.75,
-                       pad_shards_to: Optional[int] = None) -> StackedShardPack:
+                       pad_shards_to: Optional[int] = None,
+                       row_groups: Optional[Sequence[int]] = None
+                       ) -> StackedShardPack:
     """Each segment is one doc-axis shard (SURVEY.md §2.3 P1). Shapes pad to
-    the max across shards + CHUNK_CAP slack so chunk slices never clamp."""
+    the max across shards + CHUNK_CAP slack so chunk slices never clamp.
+
+    row_groups[i] (optional) assigns segment i to a statistics group — one
+    group per real index shard reproduces per-shard idf/avgdl (the
+    reference's query_then_fetch). Omitted → one index-level group
+    (dfs_query_then_fetch)."""
     from elasticsearch_tpu.index.pack import build_field_pack
 
     s_real = len(segments)
@@ -107,11 +123,20 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
     row_starts: List[np.ndarray] = []
     shard_num_docs: List[int] = []
     shard_doc_ids: List[List[str]] = []
+    groups = list(row_groups) if row_groups is not None else [0] * s_real
+    if len(groups) != s_real:
+        raise ValueError(f"row_groups has {len(groups)} entries for "
+                         f"{s_real} segments")
+    n_groups = (max(groups) + 1) if groups else 1
     total_docs = 0
     sum_ttf = 0
     df: Dict[str, int] = {}
+    group_df: List[Dict[str, int]] = [dict() for _ in range(n_groups)]
+    group_doc_count = [0] * n_groups
+    group_sum_ttf = [0] * n_groups
     for i, seg in enumerate(segments):
         fp = packs[i]
+        g = groups[i]
         if fp is not None:
             n = fp.flat_docs.shape[0]
             flat_docs[i, :n] = fp.flat_docs
@@ -120,7 +145,9 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
             vocabs.append(fp.vocab)
             row_starts.append(fp.row_start)
             for term, row in fp.vocab.items():
-                df[term] = df.get(term, 0) + int(fp.doc_freq[row])
+                dfv = int(fp.doc_freq[row])
+                df[term] = df.get(term, 0) + dfv
+                group_df[g][term] = group_df[g].get(term, 0) + dfv
         else:
             vocabs.append({})
             row_starts.append(np.zeros(1, dtype=np.int64))
@@ -133,16 +160,22 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
         if st:
             total_docs += st.doc_count
             sum_ttf += st.sum_total_term_freq
+            group_doc_count[g] += st.doc_count
+            group_sum_ttf[g] += st.sum_total_term_freq
     for _ in range(s_real, s):
         vocabs.append({})
         row_starts.append(np.zeros(1, dtype=np.int64))
         shard_num_docs.append(0)
         shard_doc_ids.append([])
+        groups.append(0)
     avgdl = (sum_ttf / total_docs) if total_docs else 1.0
+    group_avgdl = [(group_sum_ttf[g] / group_doc_count[g])
+                   if group_doc_count[g] else 1.0 for g in range(n_groups)]
     flat_impact = np.zeros((s, p_pad), dtype=np.float32)
     for i in range(s_real):
         flat_impact[i] = sparse.eager_impacts(
-            flat_docs[i], flat_tfs[i], norms[i], k1, b, avgdl)
+            flat_docs[i], flat_tfs[i], norms[i], k1, b,
+            group_avgdl[groups[i]])
         # tombstones bake into impacts: a dead doc's contributions all go
         # to 0, so the kernel's total>0 mask drops it (packs are derived
         # caches — a delete-refresh rebuilds them, SURVEY.md §5.4)
@@ -151,7 +184,8 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
     return StackedShardPack(field, s, d_pad, p_pad, flat_docs, flat_impact,
                             flat_tfs, live, vocabs, row_starts,
                             shard_num_docs, shard_doc_ids, total_docs, avgdl,
-                            df, k1, b)
+                            df, k1, b, row_group=groups, group_df=group_df,
+                            group_doc_count=group_doc_count)
 
 
 @dataclasses.dataclass
@@ -188,12 +222,20 @@ def prepare_query_batch(pack: StackedShardPack,
         # chunk bucket would let dynamic_slice read the next shard's rows
         raise ValueError(f"chunk_cap={chunk_cap} exceeds pack slack {CHUNK_CAP}")
     s = pack.num_shards
-    n_docs = pack.total_doc_count
     rows: List[List[Tuple[int, int, float, int]]] = []
     mins: List[int] = []
     for si in range(s):
         vocab = pack.vocabs[si]
         rstart = pack.row_starts[si]
+        # statistics scope: the row's group (per index shard →
+        # query_then_fetch parity; single group → dfs mode)
+        if pack.row_group is not None and pack.group_df is not None:
+            g = pack.row_group[si]
+            g_df = pack.group_df[g]
+            g_docs = pack.group_doc_count[g]
+        else:
+            g_df = pack.df
+            g_docs = pack.total_doc_count
         for qi in range(b):
             if qi >= b_real:
                 rows.append([])
@@ -203,10 +245,10 @@ def prepare_query_batch(pack: StackedShardPack,
             boost = boosts[qi] if boosts is not None else 1.0
             row = []
             for tid, term in enumerate(terms):
-                dfv = pack.df.get(term, 0)
+                dfv = g_df.get(term, 0)
                 w = 0.0
                 if dfv > 0:
-                    idf = math.log(1.0 + (n_docs - dfv + 0.5) / (dfv + 0.5))
+                    idf = math.log(1.0 + (g_docs - dfv + 0.5) / (dfv + 0.5))
                     w = boost * idf * (pack.k1 + 1.0)
                 r = vocab.get(term, -1)
                 if r >= 0:
@@ -238,27 +280,30 @@ def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
     (vals, global ids) merged over the local shards.
 
     flat_docs/flat_impact: [S_l, P_pad]; starts/lengths/weights:
-    [S_l, B, T] (starts relative to each shard's base); min_count [B]."""
+    [S_l, B, T] (starts relative to each shard's base); min_count [B].
+    Also returns totals int32[B]: exact matched-doc count over the local
+    shards (the per-shard TotalHits partial)."""
     s_l, b, t = starts.shape
     base = jnp.arange(s_l, dtype=jnp.int32) * p_pad
     starts_abs = starts + base[:, None, None]
     r = s_l * b
-    vals, docs = sparse.sorted_merge_topk(
+    vals, docs, totals = sparse.sorted_merge_topk(
         flat_docs.reshape(-1), flat_impact.reshape(-1),
         starts_abs.reshape(r, t), lengths.reshape(r, t),
         weights.reshape(r, t),
         jnp.tile(min_count, s_l),
         max_len=max_len, d_pad=d_pad, k=k, t_window=t_window,
-        with_counts=with_counts)
+        with_counts=with_counts, with_totals=True)
     k_l = vals.shape[1]
     vals = vals.reshape(s_l, b, k_l)
     docs = docs.reshape(s_l, b, k_l)
+    totals_b = jnp.sum(totals.reshape(s_l, b), axis=0)
     shard_ids = shard_offset + jnp.arange(s_l, dtype=jnp.int64)
     gids = docs.astype(jnp.int64) + (shard_ids * (d_pad + 1))[:, None, None]
     # [S_l, B, k_l] -> [B, S_l*k_l]; sentinel doc (=d_pad) keeps -inf score
     vals_b = jnp.transpose(vals, (1, 0, 2)).reshape(b, -1)
     gids_b = jnp.transpose(gids, (1, 0, 2)).reshape(b, -1)
-    return vals_b, gids_b
+    return vals_b, gids_b, totals_b
 
 
 def _merge_topk(vals_b, gids_b, k: int):
@@ -277,12 +322,13 @@ def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
 
     @jax.jit
     def step(flat_docs, flat_impact, starts, lengths, weights, min_count):
-        vals_b, gids_b = _local_body(
+        vals_b, gids_b, totals_b = _local_body(
             flat_docs, flat_impact, starts, lengths, weights, min_count,
             max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
             t_window=t_window, with_counts=with_counts,
             shard_offset=jnp.int64(0))
-        return _merge_topk(vals_b, gids_b, k)
+        top_vals, top_ids = _merge_topk(vals_b, gids_b, k)
+        return top_vals, top_ids, totals_b
 
     return step
 
@@ -300,14 +346,16 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
     def body(flat_docs, flat_impact, starts, lengths, weights, min_count):
         s_l = flat_docs.shape[0]
         my = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int64)
-        vals_b, gids_b = _local_body(
+        vals_b, gids_b, totals_b = _local_body(
             flat_docs, flat_impact, starts, lengths, weights, min_count,
             max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
             t_window=t_window, with_counts=with_counts,
             shard_offset=my * s_l)
         all_vals = jax.lax.all_gather(vals_b, SHARD_AXIS, axis=1, tiled=True)
         all_ids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1, tiled=True)
-        return _merge_topk(all_vals, all_ids, k)
+        totals = jax.lax.psum(totals_b, SHARD_AXIS)  # TotalHits reduce
+        top_vals, top_ids = _merge_topk(all_vals, all_ids, k)
+        return top_vals, top_ids, totals
 
     spec_post = P(SHARD_AXIS, None)
     spec_sbt = P(SHARD_AXIS, DATA_AXIS, None)
@@ -315,7 +363,7 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
         body, mesh=mesh,
         in_specs=(spec_post, spec_post, spec_sbt, spec_sbt, spec_sbt,
                   P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
         check_vma=False)
     return jax.jit(mapped)
 
@@ -334,8 +382,9 @@ def device_put_pack(pack: StackedShardPack, mesh: Optional[Mesh] = None):
 def distributed_search(pack: StackedShardPack, batch: QueryBatch, k: int,
                        mesh: Mesh, device_arrays=None,
                        with_counts: Optional[bool] = None):
-    """Run one distributed query step. Returns (scores [B,k'], refs) where
-    refs[q] = [(score, shard, local_ord), ...] decoded host-side.
+    """Run one distributed query step. Returns (scores [B,k'], refs,
+    totals [B]) where refs[q] = [(score, shard, local_ord), ...] decoded
+    host-side and totals[q] is the exact matched-doc count.
     with_counts defaults to the batch's own need (any min_count > 1)."""
     if device_arrays is None:
         device_arrays = device_put_pack(pack, mesh)
@@ -347,12 +396,13 @@ def distributed_search(pack: StackedShardPack, batch: QueryBatch, k: int,
         k=k, t_window=batch.window, with_counts=with_counts)
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     db = NamedSharding(mesh, P(DATA_AXIS))
-    vals, ids = fn(flat_docs, flat_impact,
-                   jax.device_put(batch.starts, sbt),
-                   jax.device_put(batch.lengths, sbt),
-                   jax.device_put(batch.weights, sbt),
-                   jax.device_put(batch.min_count, db))
-    return decode_refs(pack, np.asarray(vals), np.asarray(ids))
+    vals, ids, totals = fn(flat_docs, flat_impact,
+                           jax.device_put(batch.starts, sbt),
+                           jax.device_put(batch.lengths, sbt),
+                           jax.device_put(batch.weights, sbt),
+                           jax.device_put(batch.min_count, db))
+    vals, refs = decode_refs(pack, np.asarray(vals), np.asarray(ids))
+    return vals, refs, np.asarray(totals)
 
 
 def decode_refs(pack: StackedShardPack, vals: np.ndarray, ids: np.ndarray):

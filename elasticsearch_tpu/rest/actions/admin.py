@@ -28,6 +28,9 @@ def register(controller: RestController, node) -> None:
     def delete_index(req: RestRequest):
         for name in resolve_indices(indices, req.param("index")):
             indices.delete_index(name)
+            tpu = getattr(node, "tpu_search", None)
+            if tpu is not None:  # drop resident packs + HBM accounting
+                tpu.invalidate_index(name)
         return 200, {"acknowledged": True}
 
     def get_index(req: RestRequest):

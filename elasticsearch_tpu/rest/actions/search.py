@@ -14,8 +14,9 @@ def register(controller: RestController, node) -> None:
     indices = node.indices
 
     def do_search(req: RestRequest):
-        return 200, coordinator.search(indices, req.param("index"),
-                                       req.body or {}, req.params)
+        return 200, coordinator.search(
+            indices, req.param("index"), req.body or {}, req.params,
+            tpu_search=getattr(node, "tpu_search", None))
 
     def do_count(req: RestRequest):
         return 200, coordinator.count(indices, req.param("index"),

@@ -1,0 +1,423 @@
+"""TPU serving path for `_search` — resident packs + micro-batched kernel.
+
+This wires the batched kernel pipeline (parallel/distributed.py) into the
+live search path, replacing the per-query/per-segment host loop for the
+queries that dominate serving traffic. Reference seam being replaced:
+`search/query/QueryPhase#executeInternal`'s per-segment BulkScorer loop
+(SURVEY.md §3.3 ⚙⚙) — here a whole micro-batch of queries crosses all
+shards in ONE kernel launch (SURVEY.md §2.3 P4: TPUs want batches, not
+threads).
+
+Three pieces:
+
+  IndexPackCache — per (index, field) StackedShardPack built from the
+    union of every shard's current reader (one pack row per segment, one
+    statistics GROUP per shard so idf/avgdl match the per-shard planner
+    path exactly — the reference's query_then_fetch statistics scope).
+    Packs are derived caches (SURVEY.md §5.4): rebuilt when any shard's
+    reader changes, HBM-accounted via the `hbm` circuit breaker.
+
+  lowering — QueryNode → FlatQuery(terms, boost, min_count) for the query
+    shapes the kernel serves: match (or/and/msm), term/terms on one text
+    field, and single-field bool should-of-term/match. Everything else
+    (phrase, ranges, aggs, multi-field bools...) returns None and falls
+    back to the planner path — same contract split as the reference's
+    `EnginePlugin#getEngineFactory` seam: the fast engine serves what it
+    can, behavior elsewhere is unchanged.
+
+  MicroBatcher — coalesces concurrent queries for ~2ms (or until the
+    batch cap) and executes them as one kernel call; callers block on
+    futures. Batch sizes pad to power-of-two buckets so the jit cache is
+    hit, not re-traced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.mapping.types import TextFieldType
+from elasticsearch_tpu.parallel import distributed as dist
+from elasticsearch_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from elasticsearch_tpu.search import dsl
+
+
+# ---------------------------------------------------------------------------
+# DSL lowering
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FlatQuery:
+    """A query the kernel can serve directly: weighted-OR over one text
+    field's terms with a minimum-match count (1 = OR, len(terms) = AND)."""
+
+    field: str
+    terms: List[str]
+    boost: float
+    min_count: int
+
+
+def lower_query(query: dsl.QueryNode, mapper) -> Optional[FlatQuery]:
+    """QueryNode → FlatQuery, or None when this query needs the planner.
+    `mapper`: the index's MapperService (analysis for match queries)."""
+    if isinstance(query, dsl.MatchQuery):
+        ft = mapper.field_type(query.field)
+        if not isinstance(ft, TextFieldType):
+            return None
+        terms = ft.search_terms(query.query)
+        if not terms:
+            return None
+        msm = len(terms) if query.operator == "and" else 1
+        if query.minimum_should_match is not None and query.operator == "or":
+            # unclamped: msm > len(terms) matches nothing, like the planner
+            msm = query.minimum_should_match
+        return FlatQuery(query.field, terms, query.boost, msm)
+    if isinstance(query, dsl.TermQuery):
+        ft = mapper.field_type(query.field)
+        if not isinstance(ft, TextFieldType):
+            return None  # keyword/numeric terms: norms differ — planner
+        return FlatQuery(query.field, [str(query.value)], query.boost, 1)
+    if isinstance(query, dsl.TermsQuery):
+        ft = mapper.field_type(query.field)
+        if not isinstance(ft, TextFieldType):
+            return None
+        terms = [str(v) for v in query.values]
+        if not terms:
+            return None
+        return FlatQuery(query.field, terms, query.boost, 1)
+    if isinstance(query, dsl.BoolQuery):
+        # single-field should-only bool of term/match clauses = weighted OR
+        if query.must or query.must_not or query.filter:
+            return None
+        subs = [lower_query(q, mapper) for q in query.should]
+        if not subs or any(s is None for s in subs):
+            return None
+        fields = {s.field for s in subs}
+        if len(fields) != 1:
+            return None
+        if any(s.min_count != 1 for s in subs):
+            return None  # nested AND semantics ≠ flat msm
+        boosts = {s.boost for s in subs}
+        if len(boosts) != 1:
+            return None  # per-clause boosts need per-slot weights; planner
+        msm = query.minimum_should_match or 1
+        if msm > 1 and any(len(s.terms) != 1 for s in subs):
+            # msm counts CLAUSES; flat min_count counts TERMS — only
+            # identical when every clause is a single term
+            return None
+        terms: List[str] = []
+        for s in subs:
+            terms.extend(s.terms)
+        return FlatQuery(fields.pop(), terms, query.boost * subs[0].boost,
+                         msm)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pack residency
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ResidentPack:
+    """One (index, field) pack + its device arrays + provenance."""
+
+    pack: dist.StackedShardPack
+    device_arrays: Tuple
+    # row → (shard_num, segment_name): resolves kernel hits back to the
+    # owning IndexShard for the fetch phase
+    row_origin: List[Tuple[int, str]]
+    reader_key: Tuple  # identity of the readers this pack was built from
+    hbm_bytes: int
+    # pinned point-in-time readers per shard (the ReaderContext analog:
+    # the fetch phase resolves _source against the same snapshot the
+    # query phase scored, SURVEY.md §3.3)
+    readers: Dict[int, Any] = dataclasses.field(default_factory=dict)
+
+
+class IndexPackCache:
+    """Builds and caches the StackedShardPack for an (index, field).
+
+    The cache key is the tuple of per-shard reader identities: engine
+    refresh/merge swaps the reader object, so identity equality is exactly
+    "segments or live-docs changed". HBM bytes are charged to the `hbm`
+    breaker before device placement and released on eviction."""
+
+    def __init__(self, mesh=None, breaker=None):
+        self._mesh = mesh
+        self._lock = threading.Lock()
+        self._cache: Dict[Tuple[str, str], ResidentPack] = {}
+        self._breaker = breaker
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = make_mesh(shape=(1, _n_local_devices()))
+        return self._mesh
+
+    def get(self, index_service, field: str) -> Optional[ResidentPack]:
+        readers = []
+        for shard_num, shard in sorted(index_service.shards.items()):
+            readers.append((shard_num, shard.acquire_searcher()))
+        reader_key = tuple(id(r) for _, r in readers)
+        key = (index_service.name, field)
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None and entry.reader_key == reader_key:
+                return entry
+            entry = self._build(readers, field, reader_key)
+            if entry is not None:
+                old = self._cache.get(key)
+                if old is not None and self._breaker is not None:
+                    self._breaker.release(old.hbm_bytes)
+                self._cache[key] = entry
+            return entry
+
+    def _build(self, readers, field: str,
+               reader_key: Tuple) -> Optional[ResidentPack]:
+        segments = []
+        live = []
+        groups = []
+        row_origin: List[Tuple[int, str]] = []
+        for group_idx, (shard_num, reader) in enumerate(readers):
+            for view in reader.views:
+                if field not in view.segment.postings:
+                    continue
+                segments.append(view.segment)
+                n = view.segment.num_docs
+                live.append(view.live_mask[:n].copy())
+                groups.append(group_idx)
+                row_origin.append((shard_num, view.segment.name))
+        if not segments:
+            return None
+        k1 = readers[0][1].k1
+        b = readers[0][1].b
+        # pad rows to a multiple of the mesh's shards axis
+        n_sh = self.mesh.shape[SHARD_AXIS]
+        s_pad = ((len(segments) + n_sh - 1) // n_sh) * n_sh
+        pack = dist.build_stacked_pack(segments, field, live_docs=live,
+                                       k1=k1, b=b, pad_shards_to=s_pad,
+                                       row_groups=groups)
+        hbm = pack.nbytes_device()
+        if self._breaker is not None:
+            self._breaker.add_estimate_bytes_and_maybe_break(
+                hbm, label=f"pack[{field}]")
+        try:
+            arrays = dist.device_put_pack(pack, self.mesh)
+        except Exception:
+            if self._breaker is not None:  # undo the charge on HBM failure
+                self._breaker.release(hbm)
+            raise
+        return ResidentPack(pack, arrays, row_origin, reader_key, hbm,
+                            readers={num: r for num, r in readers})
+
+    def invalidate(self, index_name: str) -> None:
+        with self._lock:
+            for key in [k for k in self._cache if k[0] == index_name]:
+                entry = self._cache.pop(key)
+                if self._breaker is not None:
+                    self._breaker.release(entry.hbm_bytes)
+
+
+# ---------------------------------------------------------------------------
+# micro-batching
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Pending:
+    flat: FlatQuery
+    k: int
+    future: Future
+
+
+def _batch_bucket(n: int, cap: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class MicroBatcher:
+    """Coalesces concurrent queries against ONE resident pack into a single
+    kernel launch (SURVEY.md §2.3 P4). Queries arriving within `window_s`
+    (or until `max_batch`) share a launch; k pads to the max requested."""
+
+    def __init__(self, window_s: float = 0.002, max_batch: int = 64):
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._lock = threading.Condition()
+        self._queue: List[Tuple[ResidentPack, _Pending]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.batches_executed = 0
+        self.queries_executed = 0
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    def submit(self, resident: ResidentPack, flat: FlatQuery,
+               k: int) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("micro-batcher is closed")
+            self._queue.append((resident, _Pending(flat, k, fut)))
+            self._lock.notify_all()
+        self.start()
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._lock.wait()
+                if self._closed and not self._queue:
+                    return
+                # open a window for more arrivals
+                deadline = time.monotonic() + self.window_s
+                while (len(self._queue) < self.max_batch
+                       and time.monotonic() < deadline):
+                    self._lock.wait(timeout=max(
+                        0.0, deadline - time.monotonic()))
+                # one launch serves one pack; group head-of-line pack
+                head_pack = self._queue[0][0]
+                taken, rest = [], []
+                for resident, pending in self._queue:
+                    if resident is head_pack and len(taken) < self.max_batch:
+                        taken.append(pending)
+                    else:
+                        rest.append((resident, pending))
+                self._queue = rest
+            try:
+                self._execute(head_pack, taken)
+            except Exception as exc:  # noqa: BLE001 — propagate per query
+                for p in taken:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+
+    # set by the owning TpuSearchService so batches reuse the mesh the
+    # pack arrays were placed with (no per-batch mesh construction)
+    mesh = None
+
+    def _execute(self, resident: ResidentPack,
+                 pendings: List[_Pending]) -> None:
+        results = execute_flat_batch(
+            resident, [p.flat for p in pendings],
+            k=max(p.k for p in pendings), mesh=self.mesh)
+        self.batches_executed += 1
+        self.queries_executed += len(pendings)
+        for p, res in zip(pendings, results):
+            p.future.set_result(res)
+
+
+@dataclasses.dataclass
+class FlatQueryResult:
+    """Per-query kernel result, resolved to shard-level references."""
+
+    # [(score, shard_num, segment_name, local_ord, doc_id)] best-first
+    hits: List[Tuple[float, int, str, int, str]]
+    total_hits: int
+    max_score: Optional[float]
+    resident: Optional[ResidentPack] = None  # for the fetch phase
+
+
+def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
+                       k: int, mesh=None) -> List[FlatQueryResult]:
+    """Run one batched kernel call over the resident pack. The batch pads
+    to a power-of-two bucket so repeated sizes reuse the jit cache."""
+    pack = resident.pack
+    b_bucket = _batch_bucket(len(flats), 1024)
+    batch = dist.prepare_query_batch(
+        pack, [f.terms for f in flats],
+        boosts=[f.boost for f in flats],
+        min_counts=[f.min_count for f in flats],
+        pad_batch_to=b_bucket)
+    the_mesh = mesh
+    if the_mesh is None:
+        the_mesh = make_mesh(shape=(1, _n_local_devices()))
+    vals, refs, totals = dist.distributed_search(
+        pack, batch, k, the_mesh, device_arrays=resident.device_arrays)
+    out = []
+    for qi in range(len(flats)):
+        hits = []
+        for score, row, ord_ in refs[qi]:
+            if row >= len(resident.row_origin):
+                continue  # padding row
+            shard_num, seg_name = resident.row_origin[row]
+            doc_id = pack.shard_doc_ids[row][ord_]
+            hits.append((score, shard_num, seg_name, ord_, doc_id))
+        out.append(FlatQueryResult(
+            hits, int(totals[qi]), hits[0][0] if hits else None,
+            resident=resident))
+    return out
+
+
+def _n_local_devices() -> int:
+    import jax
+    return len(jax.devices())
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+class TpuSearchService:
+    """Facade the coordinator calls: eligibility check, pack lookup,
+    micro-batched execution. One instance per node."""
+
+    def __init__(self, breaker=None, mesh=None, window_s: float = 0.002,
+                 max_batch: int = 64):
+        self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
+        self.batcher = MicroBatcher(window_s=window_s, max_batch=max_batch)
+        self.batcher.mesh = self.packs.mesh
+        self.served = 0      # queries answered by the kernel path
+        self.fallback = 0    # queries declined to the planner path
+
+    def invalidate_index(self, index_name: str) -> None:
+        """Drop resident packs of a deleted index (releases HBM breaker
+        bytes and pinned readers)."""
+        self.packs.invalidate(index_name)
+
+    def try_search(self, index_service, query: dsl.QueryNode, *,
+                   k: int) -> Optional[FlatQueryResult]:
+        """Returns the kernel result, or None → caller uses the planner.
+        k = from + size (top window the coordinator needs)."""
+        if k <= 0 or k > 10_000:
+            self.fallback += 1
+            return None
+        flat = lower_query(query, index_service.mapper)
+        if flat is None:
+            self.fallback += 1
+            return None
+        resident = self.packs.get(index_service, flat.field)
+        if resident is None:
+            # field has no postings anywhere → zero hits, kernel-free
+            self.served += 1
+            return FlatQueryResult([], 0, None)
+        try:
+            fut = self.batcher.submit(resident, flat, k)
+        except RuntimeError:  # batcher closed (node shutdown race)
+            self.fallback += 1
+            return None
+        result = fut.result(timeout=30.0)
+        self.served += 1
+        return result
+
+    def stats(self) -> Dict[str, Any]:
+        return {"served": self.served, "fallback": self.fallback,
+                "batches": self.batcher.batches_executed,
+                "batched_queries": self.batcher.queries_executed}
+
+    def close(self) -> None:
+        self.batcher.close()

@@ -74,7 +74,7 @@ class TestDistributedSearch:
         # pad batch to the data axis (2) multiple
         batch = dist.prepare_query_batch(pack, queries, pad_batch_to=4)
         k = 12
-        vals, refs = dist.distributed_search(pack, batch, k, mesh)
+        vals, refs, totals = dist.distributed_search(pack, batch, k, mesh)
         expected = oracle_topk(segments, queries, k)
         for qi, exp in enumerate(expected):
             got = refs[qi]
@@ -97,7 +97,7 @@ class TestDistributedSearch:
         segments = make_shards(seeded_np, mesh.shape["shards"], 30)
         pack = dist.build_stacked_pack(segments, "body")
         batch = dist.prepare_query_batch(pack, [["w0"]], pad_batch_to=2)
-        vals, refs = dist.distributed_search(pack, batch, 5, mesh)
+        vals, refs, totals = dist.distributed_search(pack, batch, 5, mesh)
         assert len(refs) == 2
         assert refs[1] == []  # padded query row matches nothing
 
@@ -108,7 +108,7 @@ class TestDistributedSearch:
             None for _ in segments[1:]]
         pack = dist.build_stacked_pack(segments, "body", live_docs=live)
         batch = dist.prepare_query_batch(pack, [["w0"]], pad_batch_to=2)
-        _, refs = dist.distributed_search(pack, batch, 50, mesh)
+        _, refs, _tot = dist.distributed_search(pack, batch, 50, mesh)
         assert all(shard != 0 for _, shard, _ in refs[0])
 
     def test_and_min_counts_default(self, seeded_np, mesh):
@@ -120,7 +120,7 @@ class TestDistributedSearch:
         batch = dist.prepare_query_batch(pack, [q], min_counts=[2],
                                          pad_batch_to=2)
         assert batch.need_counts
-        _, refs = dist.distributed_search(pack, batch, 500, mesh)
+        _, refs, _tot = dist.distributed_search(pack, batch, 500, mesh)
         got = {(s, d) for _, s, d in refs[0]}
         # oracle: docs containing BOTH terms
         expected = set()

@@ -1,0 +1,306 @@
+"""TPU serving path tests: DSL lowering, pack residency, micro-batching,
+and — the load-bearing part — exact equivalence between the kernel fast
+path and the planner path on randomized corpora (the reference's pattern
+of testing a new engine implementation against the existing one)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.indices.service import IndicesService
+from elasticsearch_tpu.search import coordinator, dsl
+from elasticsearch_tpu.search.tpu_service import (TpuSearchService,
+                                                  lower_query)
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+         "theta", "iota", "kappa", "lamda", "mu"]
+
+
+@pytest.fixture
+def svc(tmp_path):
+    s = IndicesService(str(tmp_path))
+    yield s
+    s.close()
+
+
+def make_corpus(svc, seeded_np, *, name="corpus", shards=2, docs=120,
+                flush_some=True):
+    idx = svc.create_index(
+        name, Settings.of({"index": {"number_of_shards": shards}}),
+        {"properties": {"body": {"type": "text"},
+                        "tag": {"type": "keyword"}}})
+    for i in range(docs):
+        n_words = int(seeded_np.integers(3, 12))
+        words = [WORDS[int(w)] for w in
+                 seeded_np.integers(0, len(WORDS), n_words)]
+        doc_id = f"d{i}"
+        shard = idx.shard(idx.shard_for_id(doc_id))
+        shard.apply_index_on_primary(
+            doc_id, {"body": " ".join(words), "tag": f"t{i % 3}"})
+        if flush_some and i == docs // 2:
+            idx.flush()  # split into multiple segments per shard
+    idx.refresh()
+    return idx
+
+
+def both_paths(svc, name, body):
+    """Run the same search through the kernel path and the planner path."""
+    tpu = TpuSearchService(window_s=0.0)
+    try:
+        fast = coordinator.search(svc, name, dict(body), tpu_search=tpu)
+        assert tpu.served > 0, "query did not take the kernel path"
+    finally:
+        tpu.close()
+    slow = coordinator.search(svc, name, dict(body), tpu_search=None)
+    return fast, slow
+
+
+def assert_equivalent(fast, slow):
+    assert fast["hits"]["total"]["value"] == slow["hits"]["total"]["value"]
+    fh, sh = fast["hits"]["hits"], slow["hits"]["hits"]
+    assert [h["_id"] for h in fh] == [h["_id"] for h in sh]
+    for a, b in zip(fh, sh):
+        assert a["_score"] == pytest.approx(b["_score"], rel=1e-5, abs=1e-6)
+        assert a.get("_source") == b.get("_source")
+    if fast["hits"]["max_score"] is None:
+        assert slow["hits"]["max_score"] is None
+    else:
+        assert fast["hits"]["max_score"] == pytest.approx(
+            slow["hits"]["max_score"], rel=1e-5, abs=1e-6)
+
+
+class TestLowering:
+    def setup_method(self):
+        from elasticsearch_tpu.mapping import MapperService
+        self.mapper = MapperService(Settings.EMPTY, {"properties": {
+            "body": {"type": "text"}, "tag": {"type": "keyword"}}})
+
+    def test_match_or(self):
+        f = lower_query(dsl.MatchQuery(field="body", query="Alpha beta"),
+                        self.mapper)
+        assert f.terms == ["alpha", "beta"] and f.min_count == 1
+
+    def test_match_and(self):
+        f = lower_query(dsl.MatchQuery(field="body", query="alpha beta",
+                                       operator="and"), self.mapper)
+        assert f.min_count == 2
+
+    def test_match_msm(self):
+        f = lower_query(dsl.MatchQuery(field="body",
+                                       query="alpha beta gamma",
+                                       minimum_should_match=2), self.mapper)
+        assert f.min_count == 2
+
+    def test_term_on_keyword_falls_back(self):
+        assert lower_query(dsl.TermQuery(field="tag", value="t1"),
+                           self.mapper) is None
+
+    def test_bool_should_same_field(self):
+        f = lower_query(dsl.BoolQuery(should=[
+            dsl.TermQuery(field="body", value="alpha"),
+            dsl.TermQuery(field="body", value="beta")]), self.mapper)
+        assert f.terms == ["alpha", "beta"]
+
+    def test_bool_with_must_falls_back(self):
+        assert lower_query(dsl.BoolQuery(must=[
+            dsl.TermQuery(field="body", value="alpha")]),
+            self.mapper) is None
+
+    def test_phrase_falls_back(self):
+        assert lower_query(dsl.MatchPhraseQuery(field="body",
+                                                query="alpha beta"),
+                           self.mapper) is None
+
+
+class TestEquivalence:
+    """Kernel path == planner path: scores, order, totals, sources."""
+
+    @pytest.mark.parametrize("q", [
+        {"match": {"body": "alpha"}},
+        {"match": {"body": "alpha beta gamma"}},
+        {"match": {"body": {"query": "alpha beta", "operator": "and"}}},
+        {"match": {"body": {"query": "alpha beta gamma delta",
+                            "minimum_should_match": 3}}},
+        {"terms": {"body": ["zeta", "kappa"]}},
+        {"bool": {"should": [{"term": {"body": "mu"}},
+                             {"term": {"body": "iota"}}]}},
+    ])
+    def test_query_shapes(self, svc, seeded_np, q):
+        make_corpus(svc, seeded_np)
+        fast, slow = both_paths(svc, "corpus", {"query": q, "size": 30})
+        assert_equivalent(fast, slow)
+
+    def test_multi_shard_multi_segment(self, svc, seeded_np):
+        make_corpus(svc, seeded_np, shards=3, docs=200)
+        fast, slow = both_paths(
+            svc, "corpus", {"query": {"match": {"body": "alpha beta"}},
+                            "size": 50})
+        assert_equivalent(fast, slow)
+
+    def test_after_deletes(self, svc, seeded_np):
+        idx = make_corpus(svc, seeded_np, docs=80)
+        for i in range(0, 80, 7):
+            shard = idx.shard(idx.shard_for_id(f"d{i}"))
+            shard.apply_delete_on_primary(f"d{i}")
+        idx.refresh()
+        fast, slow = both_paths(
+            svc, "corpus", {"query": {"match": {"body": "alpha"}},
+                            "size": 100})
+        assert_equivalent(fast, slow)
+
+    def test_pagination(self, svc, seeded_np):
+        make_corpus(svc, seeded_np)
+        fast, slow = both_paths(
+            svc, "corpus", {"query": {"match": {"body": "alpha"}},
+                            "from": 5, "size": 7})
+        assert_equivalent(fast, slow)
+
+    def test_min_score(self, svc, seeded_np):
+        make_corpus(svc, seeded_np)
+        fast, slow = both_paths(
+            svc, "corpus", {"query": {"match": {"body": "alpha beta"}},
+                            "min_score": 1.0, "size": 50})
+        assert_equivalent(fast, slow)
+
+    def test_boost(self, svc, seeded_np):
+        make_corpus(svc, seeded_np)
+        fast, slow = both_paths(
+            svc, "corpus",
+            {"query": {"match": {"body": {"query": "alpha", "boost": 2.5}}},
+             "size": 20})
+        assert_equivalent(fast, slow)
+
+
+class TestFallback:
+    def test_unsupported_shapes_use_planner(self, svc, seeded_np):
+        make_corpus(svc, seeded_np)
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            out = coordinator.search(
+                svc, "corpus",
+                {"query": {"match_phrase": {"body": "alpha beta"}}},
+                tpu_search=tpu)
+            assert tpu.served == 0 and tpu.fallback > 0
+            assert "hits" in out
+            # aggs force the planner path
+            out = coordinator.search(
+                svc, "corpus",
+                {"query": {"match": {"body": "alpha"}},
+                 "aggs": {"tags": {"terms": {"field": "tag"}}}},
+                tpu_search=tpu)
+            assert tpu.served == 0
+            assert "aggregations" in out
+        finally:
+            tpu.close()
+
+    def test_pack_rebuilds_after_refresh(self, svc, seeded_np):
+        idx = make_corpus(svc, seeded_np, docs=40)
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            r1 = tpu.packs.get(idx, "body")
+            r2 = tpu.packs.get(idx, "body")
+            assert r1 is r2  # cached while reader unchanged
+            shard = idx.shard(idx.shard_for_id("new-doc"))
+            shard.apply_index_on_primary("new-doc", {"body": "alpha omega"})
+            idx.refresh()
+            r3 = tpu.packs.get(idx, "body")
+            assert r3 is not r1
+        finally:
+            tpu.close()
+
+
+class TestMicroBatching:
+    def test_concurrent_queries_coalesce(self, svc, seeded_np):
+        make_corpus(svc, seeded_np, docs=60)
+        tpu = TpuSearchService(window_s=0.05, max_batch=32)
+        try:
+            idx = svc.index("corpus")
+            # prime the pack (build outside the timed window)
+            tpu.packs.get(idx, "body")
+            results = [None] * 8
+            def run(i):
+                results[i] = tpu.try_search(
+                    idx, dsl.MatchQuery(field="body", query="alpha"), k=10)
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(8)]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            assert all(r is not None for r in results)
+            # all 8 queries ran in fewer launches than queries
+            assert tpu.batcher.queries_executed == 8
+            assert tpu.batcher.batches_executed < 8
+            # identical queries → identical results
+            for r in results[1:]:
+                assert [h[4] for h in r.hits] == [h[4] for h in results[0].hits]
+                assert r.total_hits == results[0].total_hits
+        finally:
+            tpu.close()
+
+
+class TestReviewFindings:
+    """Regression tests for the r2 code-review findings on this path."""
+
+    def test_msm_above_term_count_matches_nothing(self, svc, seeded_np):
+        make_corpus(svc, seeded_np)
+        fast, slow = both_paths(
+            svc, "corpus",
+            {"query": {"match": {"body": {"query": "alpha beta",
+                                          "minimum_should_match": 3}}},
+             "size": 20})
+        assert fast["hits"]["total"]["value"] == 0
+        assert_equivalent(fast, slow)
+
+    def test_bool_msm_multiterm_clause_falls_back(self, svc, seeded_np):
+        """msm counts clauses; a multi-term match clause breaks the
+        clause==term identity, so the planner must serve it."""
+        make_corpus(svc, seeded_np)
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            coordinator.search(
+                svc, "corpus",
+                {"query": {"bool": {
+                    "should": [{"match": {"body": "alpha beta"}},
+                               {"term": {"body": "gamma"}}],
+                    "minimum_should_match": 2}}},
+                tpu_search=tpu)
+            assert tpu.served == 0 and tpu.fallback > 0
+        finally:
+            tpu.close()
+
+    def test_bool_msm_single_term_clauses_equivalent(self, svc, seeded_np):
+        make_corpus(svc, seeded_np)
+        fast, slow = both_paths(
+            svc, "corpus",
+            {"query": {"bool": {
+                "should": [{"term": {"body": "alpha"}},
+                           {"term": {"body": "beta"}},
+                           {"term": {"body": "gamma"}}],
+                "minimum_should_match": 2}}, "size": 50})
+        assert_equivalent(fast, slow)
+
+    def test_delete_index_releases_pack(self, svc, seeded_np):
+        from elasticsearch_tpu.common.breaker import CircuitBreaker
+        idx = make_corpus(svc, seeded_np, name="todelete", docs=30)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = TpuSearchService(window_s=0.0, breaker=breaker)
+        try:
+            tpu.try_search(idx, dsl.MatchQuery(field="body", query="alpha"),
+                           k=5)
+            assert breaker.used > 0
+            svc.delete_index("todelete")
+            tpu.invalidate_index("todelete")
+            assert breaker.used == 0
+        finally:
+            tpu.close()
+
+    def test_submit_after_close_falls_back(self, svc, seeded_np):
+        idx = make_corpus(svc, seeded_np, docs=20)
+        tpu = TpuSearchService(window_s=0.0)
+        tpu.close()
+        import time as _t
+        _t.sleep(0.05)
+        res = tpu.try_search(idx, dsl.MatchQuery(field="body", query="alpha"),
+                             k=5)
+        assert res is None and tpu.fallback > 0
