@@ -1,0 +1,2 @@
+"""Benchmark + quality-harness utilities (corpus generation, rank-eval
+driving). See BASELINE.md for the obligations this package discharges."""

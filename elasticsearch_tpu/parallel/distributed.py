@@ -202,6 +202,90 @@ class QueryBatch:
     t_slots: int
     window: int            # max same-doc entries per row (= max terms/query)
     need_counts: bool      # any query has min_count > 1 (msm/AND)
+    # pruned (block-max) mode only: per (shard,query) upper bound on the
+    # score mass a doc can collect from TRUNCATED postings tails —
+    # β_r = Σ_t w_t · impact_t[prefix_cap] (0 when nothing truncated)
+    tail_bounds: Optional[np.ndarray] = None  # f32[S, B]
+    truncated: bool = False  # any slot shorter than its full postings row
+
+
+def build_impact_sorted(pack: StackedShardPack
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-term impact-DESCENDING copies of the postings arrays — the
+    block-max/WAND layout (SURVEY.md §5.7, §7.3#3): query time takes only
+    each term's highest-impact prefix; everything it skips is bounded by
+    the impact at the truncation point. Ties order by doc id so the
+    layout is deterministic. Returns host (docs, impacts) [S, P_pad]."""
+    s, p_pad = pack.flat_docs.shape
+    imp_docs = pack.flat_docs.copy()
+    imp_impacts = pack.flat_impact.copy()
+    for si in range(s):
+        rstart = pack.row_starts[si]
+        total = int(rstart[-1])
+        if total <= 1:
+            continue
+        # one lexsort per row: term-id primary (keeps row segments),
+        # -impact secondary, doc tertiary (deterministic ties)
+        term_ids = np.repeat(np.arange(len(rstart) - 1, dtype=np.int64),
+                             np.diff(rstart))
+        seg_doc = pack.flat_docs[si, :total]
+        seg_imp = pack.flat_impact[si, :total]
+        order = np.lexsort((seg_doc, -seg_imp, term_ids))
+        imp_docs[si, :total] = seg_doc[order]
+        imp_impacts[si, :total] = seg_imp[order]
+    return imp_docs, imp_impacts
+
+
+def term_weights(pack: StackedShardPack, si: int, terms: Sequence[str],
+                 boost: float = 1.0) -> List[float]:
+    """idf·(k1+1)·boost per term for pack row si, using the row's
+    statistics group (per index shard → query_then_fetch parity;
+    single group → dfs mode)."""
+    if pack.row_group is not None and pack.group_df is not None:
+        g = pack.row_group[si]
+        g_df = pack.group_df[g]
+        g_docs = pack.group_doc_count[g]
+    else:
+        g_df = pack.df
+        g_docs = pack.total_doc_count
+    out = []
+    for term in terms:
+        dfv = g_df.get(term, 0)
+        w = 0.0
+        if dfv > 0:
+            idf = math.log(1.0 + (g_docs - dfv + 0.5) / (dfv + 0.5))
+            w = boost * idf * (pack.k1 + 1.0)
+        out.append(w)
+    return out
+
+
+def exact_rescore(pack: StackedShardPack, candidates, terms: Sequence[str],
+                  boost: float = 1.0):
+    """Exact BM25 scores for candidate docs via the DOC-SORTED host
+    arrays (block-max phase 2): candidates = [(row, ord), ...]. Returns
+    f32 scores aligned with candidates. np.searchsorted per (row, term) —
+    O(C·T·log df) host work for C ≤ a few thousand docs."""
+    scores = np.zeros(len(candidates), dtype=np.float64)
+    by_row: Dict[int, List[int]] = {}
+    for i, (row, _ord) in enumerate(candidates):
+        by_row.setdefault(row, []).append(i)
+    for row, idxs in by_row.items():
+        ords = np.array([candidates[i][1] for i in idxs], dtype=np.int64)
+        vocab = pack.vocabs[row]
+        rstart = pack.row_starts[row]
+        ws = term_weights(pack, row, terms, boost)
+        for t, term in enumerate(terms):
+            r = vocab.get(term, -1)
+            if r < 0 or ws[t] == 0.0:
+                continue
+            a, b_end = int(rstart[r]), int(rstart[r + 1])
+            seg = pack.flat_docs[row, a:b_end]
+            pos = np.searchsorted(seg, ords)
+            safe = np.minimum(pos, len(seg) - 1)
+            hit = (pos < len(seg)) & (seg[safe] == ords)
+            contrib = ws[t] * pack.flat_impact[row, a + safe]
+            scores[idxs] += np.where(hit, contrib, 0.0)
+    return scores.astype(np.float32)
 
 
 def prepare_query_batch(pack: StackedShardPack,
@@ -209,9 +293,20 @@ def prepare_query_batch(pack: StackedShardPack,
                         boosts: Optional[Sequence[float]] = None,
                         min_counts: Optional[Sequence[int]] = None,
                         pad_batch_to: Optional[int] = None,
-                        chunk_cap: int = CHUNK_CAP) -> QueryBatch:
-    """Host-side planning: vocab lookups, index-level idf, chunk splitting.
-    min_counts[i] = required matched clauses (1 = OR, len(terms) = AND)."""
+                        chunk_cap: int = CHUNK_CAP,
+                        prefix_cap: Optional[int] = None,
+                        imp_impacts: Optional[np.ndarray] = None,
+                        pad_t_slots: Optional[int] = None,
+                        pad_max_len: Optional[int] = None) -> QueryBatch:
+    """Host-side planning: vocab lookups, group-level idf, chunk splitting.
+    min_counts[i] = required matched clauses (1 = OR, len(terms) = AND).
+
+    prefix_cap (block-max mode): truncate each term's slots to its top
+    `prefix_cap` impact entries — valid ONLY against the impact-sorted
+    arrays (`build_impact_sorted`), whose host `imp_impacts` must be given
+    to read the tail bound at the truncation point."""
+    if prefix_cap is not None and imp_impacts is None:
+        raise ValueError("prefix_cap requires imp_impacts")
     b_real = len(queries)
     b = pad_batch_to or b_real
     if b < b_real:
@@ -224,18 +319,12 @@ def prepare_query_batch(pack: StackedShardPack,
     s = pack.num_shards
     rows: List[List[Tuple[int, int, float, int]]] = []
     mins: List[int] = []
+    tail_bounds = (np.zeros((s, b), dtype=np.float32)
+                   if prefix_cap is not None else None)
+    truncated = False
     for si in range(s):
         vocab = pack.vocabs[si]
         rstart = pack.row_starts[si]
-        # statistics scope: the row's group (per index shard →
-        # query_then_fetch parity; single group → dfs mode)
-        if pack.row_group is not None and pack.group_df is not None:
-            g = pack.row_group[si]
-            g_df = pack.group_df[g]
-            g_docs = pack.group_doc_count[g]
-        else:
-            g_df = pack.df
-            g_docs = pack.total_doc_count
         for qi in range(b):
             if qi >= b_real:
                 rows.append([])
@@ -243,30 +332,50 @@ def prepare_query_batch(pack: StackedShardPack,
                 continue
             terms = queries[qi]
             boost = boosts[qi] if boosts is not None else 1.0
+            weights_r = term_weights(pack, si, terms, boost)
             row = []
             for tid, term in enumerate(terms):
-                dfv = g_df.get(term, 0)
-                w = 0.0
-                if dfv > 0:
-                    idf = math.log(1.0 + (g_docs - dfv + 0.5) / (dfv + 0.5))
-                    w = boost * idf * (pack.k1 + 1.0)
+                w = weights_r[tid]
                 r = vocab.get(term, -1)
                 if r >= 0:
                     st = int(rstart[r])
                     ln = int(rstart[r + 1] - rstart[r])
                 else:
                     st, ln = 0, 0
+                if prefix_cap is not None and ln > prefix_cap:
+                    # skipped tail entries all have impact ≤ the impact at
+                    # the truncation point (impact-descending layout)
+                    tail_bounds[si, qi] += w * float(
+                        imp_impacts[si, st + prefix_cap])
+                    ln = prefix_cap
+                    truncated = True
                 row.append((st, ln, w, tid))
             rows.append(row)
             mins.append(int(min_counts[qi]) if min_counts is not None else 1)
     plan = sparse.plan_slots(rows, mins, chunk_cap=chunk_cap)
-    shape3 = (s, b, plan.t_slots)
+    t_slots = plan.t_slots
+    starts_a, lengths_a, weights_a = plan.starts, plan.lengths, plan.weights
+    # serving stability: padding T and L_c to fixed values pins the jit
+    # signature so the hot path never re-compiles (zero-length pad slots
+    # cost sort lanes, not correctness)
+    if pad_t_slots is not None and pad_t_slots > t_slots:
+        r = starts_a.shape[0]
+        pad = pad_t_slots - t_slots
+        starts_a = np.pad(starts_a, ((0, 0), (0, pad)))
+        lengths_a = np.pad(lengths_a, ((0, 0), (0, pad)))
+        weights_a = np.pad(weights_a, ((0, 0), (0, pad)))
+        t_slots = pad_t_slots
+    max_len = plan.max_len
+    if pad_max_len is not None and pad_max_len > max_len:
+        max_len = pad_max_len
+    shape3 = (s, b, t_slots)
     mc = plan.min_count.reshape(s, b)[0].copy()
-    return QueryBatch(plan.starts.reshape(shape3),
-                      plan.lengths.reshape(shape3),
-                      plan.weights.reshape(shape3),
-                      mc, plan.max_len, plan.t_slots, plan.window,
-                      bool((mc > 1).any()))
+    return QueryBatch(starts_a.reshape(shape3),
+                      lengths_a.reshape(shape3),
+                      weights_a.reshape(shape3),
+                      mc, max_len, t_slots, plan.window,
+                      bool((mc > 1).any()),
+                      tail_bounds=tail_bounds, truncated=truncated)
 
 
 # ---------------------------------------------------------------------------
@@ -368,6 +477,171 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
     return jax.jit(mapped)
 
 
+def prepare_term_ranges(pack: StackedShardPack,
+                        queries: Sequence[Sequence[str]],
+                        boosts: Optional[Sequence[float]] = None,
+                        pad_batch_to: Optional[int] = None,
+                        pad_terms: int = 8):
+    """Per-TERM (unchunked) postings ranges for the device-side exact
+    re-score: (starts, lengths, weights) int32/f32[S, B, T_terms]."""
+    b_real = len(queries)
+    b = pad_batch_to or b_real
+    s = pack.num_shards
+    starts = np.zeros((s, b, pad_terms), dtype=np.int32)
+    lengths = np.zeros((s, b, pad_terms), dtype=np.int32)
+    weights = np.zeros((s, b, pad_terms), dtype=np.float32)
+    for si in range(s):
+        vocab = pack.vocabs[si]
+        rstart = pack.row_starts[si]
+        for qi in range(b_real):
+            terms = list(queries[qi])[:pad_terms]
+            boost = boosts[qi] if boosts is not None else 1.0
+            ws = term_weights(pack, si, terms, boost)
+            for t, term in enumerate(terms):
+                r = vocab.get(term, -1)
+                if r < 0:
+                    continue
+                starts[si, qi, t] = int(rstart[r])
+                lengths[si, qi, t] = int(rstart[r + 1] - rstart[r])
+                weights[si, qi, t] = ws[t]
+    return starts, lengths, weights
+
+
+@lru_cache(maxsize=32)
+def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
+                       c_cand: int, k_out: int, t_window: int,
+                       t_terms: int, search_iters: Optional[int] = None,
+                       c_local: Optional[int] = None):
+    """Block-max serving step, ONE fused launch (SURVEY.md §5.7/§7.3#3):
+
+      phase A  candidate generation over impact-sorted postings prefixes
+               (the small sorted-merge) → global top-c_cand via
+               all_gather + top_k;
+      phase B  exact re-score of every candidate ON DEVICE: vectorized
+               binary search in the doc-sorted postings (each device
+               scores its local rows, psum over the shards axis), so
+               scores are exact BM25 while only [B, k_out] leaves the
+               device — the device→host link never carries the candidate
+               pool.
+
+    Returns (exact_vals [B,k_out], gids [B,k_out], totals [B],
+    cutoff [B], beta [B]); the caller checks the WAND validity bound
+    `exact_kth ≥ (cutoff if full else 0) + beta` host-side with its
+    actual k and falls back to the exact kernel when it fails."""
+    if search_iters is None:
+        # a postings row is at most d_pad docs long
+        search_iters = max(1, math.ceil(math.log2(d_pad + 1)))
+    if c_local is None:
+        # per-ROW candidate cut: a fraction of the global pool is enough
+        # when docs spread over rows; the row cutoff folds into the
+        # validity bound, so a hot row degrades to a rerun, never to a
+        # wrong result
+        c_local = max(min(c_cand, 512), c_cand // 4)
+
+    def body(fd_imp, fi_imp, fd_ds, fi_ds, starts, lengths, weights,
+             t_starts, t_lengths, t_weights, tail_bound):
+        s_l, b = starts.shape[0], starts.shape[1]
+        my = jax.lax.axis_index(SHARD_AXIS)
+        ones = jnp.ones((b,), dtype=jnp.int32)
+        vals_b, gids_b, totals_b = _local_body(
+            fd_imp, fi_imp, starts, lengths, weights, ones,
+            max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=c_local,
+            t_window=t_window, with_counts=False,
+            shard_offset=(my * s_l).astype(jnp.int64))
+        # per-row approx cutoff (the c_local-th value of each row): docs
+        # cut HERE are bounded by it in the validity check
+        k_l = vals_b.shape[1] // s_l
+        row_cut_local = jnp.max(
+            vals_b.reshape(b, s_l, k_l)[:, :, -1], axis=1)       # [B]
+        row_cut = jax.lax.pmax(row_cut_local, SHARD_AXIS)
+        all_vals = jax.lax.all_gather(vals_b, SHARD_AXIS, axis=1, tiled=True)
+        all_gids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1, tiled=True)
+        totals = jax.lax.psum(totals_b, SHARD_AXIS)
+        c = min(c_cand, all_vals.shape[1])
+        cand_vals, pos = jax.lax.top_k(all_vals, c)
+        cand_gids = jnp.take_along_axis(all_gids, pos, axis=1)  # [B, C]
+
+        # ---- phase B: exact re-score of candidates ----
+        gid32 = cand_gids.astype(jnp.int32)
+        row = gid32 // (d_pad + 1)
+        ord_ = gid32 % (d_pad + 1)
+        local_row = row - (my * s_l).astype(jnp.int32)
+        in_local = (local_row >= 0) & (local_row < s_l)
+        lr = jnp.clip(local_row, 0, s_l - 1)
+        base = lr * p_pad
+        flat_ds = fd_ds.reshape(-1)
+        flat_imp = fi_ds.reshape(-1)
+        qsel = jnp.arange(b, dtype=jnp.int32)[:, None]
+        exact_local = jnp.zeros(cand_vals.shape, dtype=jnp.float32)
+        for t in range(t_terms):  # static unroll, T ≤ 8
+            st = t_starts[lr, qsel, t]
+            ln = t_lengths[lr, qsel, t]
+            w = t_weights[lr, qsel, t]
+            lo = base + st
+            hi = lo + ln
+            for _ in range(search_iters):  # lower_bound binary search
+                mid = (lo + hi) >> 1
+                v = jnp.take(flat_ds, mid, mode="fill", fill_value=d_pad)
+                go = v < ord_
+                lo = jnp.where(go, mid + 1, lo)
+                hi = jnp.where(go, hi, mid)
+            v = jnp.take(flat_ds, lo, mode="fill", fill_value=d_pad)
+            found = (ln > 0) & (v == ord_) & (lo < base + st + ln)
+            imp = jnp.take(flat_imp, lo, mode="fill", fill_value=0.0)
+            exact_local = exact_local + jnp.where(
+                found & in_local, w * imp, 0.0)
+        exact = jax.lax.psum(exact_local, SHARD_AXIS)
+        exact = jnp.where(cand_vals > NEG_INF, exact, NEG_INF)
+
+        # final order: (-exact, gid) — same tie rule as the exact kernel
+        neg = jnp.where(exact > NEG_INF, -exact, jnp.inf)
+        sk, sg = jax.lax.sort([neg, cand_gids], num_keys=2)
+        k_keep = min(k_out, c)
+        out_vals = jnp.where(jnp.isinf(sk[:, :k_keep]), NEG_INF,
+                             -sk[:, :k_keep])
+        out_gids = sg[:, :k_keep]
+
+        # validity ingredients (checked host-side at the caller's k):
+        # a doc outside the candidates was cut either at the global pool
+        # (≤ cand_vals[:, -1]) or at its row's local top-c_local
+        # (≤ row_cut) — the effective cutoff is the max of the two
+        cutoff = jnp.maximum(cand_vals[:, -1], row_cut)
+        beta = jax.lax.pmax(jnp.max(tail_bound, axis=0), SHARD_AXIS)
+        # ONE packed f32 output [B, 2k+3]: every extra output array is a
+        # separate device→host fetch (~100ms through the axon tunnel), so
+        # the whole result crosses in a single transfer
+        gids_f32 = jax.lax.bitcast_convert_type(
+            out_gids.astype(jnp.int32), jnp.float32)
+        packed = jnp.concatenate(
+            [out_vals, gids_f32, totals[:, None].astype(jnp.float32),
+             cutoff[:, None], beta[:, None]], axis=1)
+        return packed
+
+    spec_post = P(SHARD_AXIS, None)
+    spec_sbt = P(SHARD_AXIS, DATA_AXIS, None)
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_post, spec_post, spec_post, spec_post,
+                  spec_sbt, spec_sbt, spec_sbt,
+                  spec_sbt, spec_sbt, spec_sbt,
+                  P(SHARD_AXIS, DATA_AXIS)),
+        out_specs=P(DATA_AXIS, None),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def unpack_pruned(packed: np.ndarray, k_keep: int):
+    """Host-side split of make_pruned_search's packed output →
+    (vals [B,k], gids int32 [B,k], totals [B], cutoff [B], beta [B])."""
+    vals = packed[:, :k_keep]
+    gids = np.ascontiguousarray(packed[:, k_keep:2 * k_keep]
+                                ).view(np.int32)
+    totals = packed[:, 2 * k_keep].astype(np.int64)
+    cutoff = packed[:, 2 * k_keep + 1]
+    beta = packed[:, 2 * k_keep + 2]
+    return vals, gids, totals, cutoff, beta
+
+
 def device_put_pack(pack: StackedShardPack, mesh: Optional[Mesh] = None):
     """Place the postings tensors in HBM (sharded over "shards" when a mesh
     is given) — the resident pack image (SURVEY.md §7.1 table)."""
@@ -381,19 +655,25 @@ def device_put_pack(pack: StackedShardPack, mesh: Optional[Mesh] = None):
 
 def distributed_search(pack: StackedShardPack, batch: QueryBatch, k: int,
                        mesh: Mesh, device_arrays=None,
-                       with_counts: Optional[bool] = None):
+                       with_counts: Optional[bool] = None,
+                       t_window: Optional[int] = None):
     """Run one distributed query step. Returns (scores [B,k'], refs,
     totals [B]) where refs[q] = [(score, shard, local_ord), ...] decoded
     host-side and totals[q] is the exact matched-doc count.
-    with_counts defaults to the batch's own need (any min_count > 1)."""
+    with_counts defaults to the batch's own need (any min_count > 1).
+    t_window (≥ batch.window) can be pinned for jit-signature stability."""
     if device_arrays is None:
         device_arrays = device_put_pack(pack, mesh)
     if with_counts is None:
         with_counts = batch.need_counts
+    if t_window is None:
+        t_window = batch.window
+    elif t_window < batch.window:
+        raise ValueError(f"t_window={t_window} < needed {batch.window}")
     flat_docs, flat_impact = device_arrays
     fn = make_distributed_search(
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
-        k=k, t_window=batch.window, with_counts=with_counts)
+        k=k, t_window=t_window, with_counts=with_counts)
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     db = NamedSharding(mesh, P(DATA_AXIS))
     vals, ids, totals = fn(flat_docs, flat_impact,

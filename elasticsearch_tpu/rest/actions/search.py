@@ -57,6 +57,21 @@ def register(controller: RestController, node) -> None:
     controller.register("POST", "/_count", do_count)
     controller.register("GET", "/{index}/_count", do_count)
     controller.register("POST", "/{index}/_count", do_count)
+    def do_rank_eval(req: RestRequest):
+        from elasticsearch_tpu.search import rank_eval
+        index_expr = req.param("index")
+
+        def run(search_body):
+            return coordinator.search(
+                indices, index_expr, search_body, {},
+                tpu_search=getattr(node, "tpu_search", None))
+
+        return 200, rank_eval.evaluate(run, req.body or {})
+
+    controller.register("GET", "/_rank_eval", do_rank_eval)
+    controller.register("POST", "/_rank_eval", do_rank_eval)
+    controller.register("GET", "/{index}/_rank_eval", do_rank_eval)
+    controller.register("POST", "/{index}/_rank_eval", do_rank_eval)
     controller.register("GET", "/_analyze", do_analyze)
     controller.register("POST", "/_analyze", do_analyze)
     controller.register("GET", "/{index}/_analyze", do_analyze)

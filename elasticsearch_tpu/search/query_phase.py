@@ -32,6 +32,7 @@ class ShardHit:
     doc_id: str
     score: float
     ref: ShardDocRef
+    sort_values: Optional[List] = None  # set when sorting by fields
 
 
 @dataclasses.dataclass
@@ -48,14 +49,23 @@ class QuerySearchResult:
 def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
                   size: int = 10, from_: int = 0,
                   min_score: Optional[float] = None,
-                  aggs: Optional[Any] = None) -> QuerySearchResult:
+                  aggs: Optional[Any] = None,
+                  sort_specs: Optional[List] = None,
+                  search_after: Optional[List] = None) -> QuerySearchResult:
     """aggs: an AggregatorFactories (see search/aggregations) collected
     under the query's match mask per segment, reduced across segments to
     one shard-level partial (reference: QueryPhase runs the collector
-    chain once for topk + aggs, SURVEY.md §3.3)."""
+    chain once for topk + aggs, SURVEY.md §3.3).
+    sort_specs: parsed sort.SortSpec list → field-sorted results with
+    per-hit sort values (reference: FieldSortBuilder, §2.1#50)."""
     from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
                                                        SegmentAggContext)
 
+    if sort_specs:
+        return _execute_sorted_query(reader, query, size=size, from_=from_,
+                                     min_score=min_score, aggs=aggs,
+                                     sort_specs=sort_specs,
+                                     search_after=search_after)
     k = size + from_
     per_segment: List[Tuple[int, np.ndarray, np.ndarray]] = []
     agg_parts: List[Dict[str, Any]] = []
@@ -98,9 +108,114 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
     return QuerySearchResult(hits, total, max_score, shard_aggs)
 
 
+def _execute_sorted_query(reader: ShardReader, query: dsl.QueryNode, *,
+                          size: int, from_: int, min_score, aggs,
+                          sort_specs: List, search_after) -> QuerySearchResult:
+    """Field-sorted query phase: per segment, vectorized lexsort over the
+    matching docs' sort keys (numeric values / keyword ordinals), then a
+    cross-segment merge on python value tuples."""
+    from elasticsearch_tpu.search import sort as sort_mod
+    from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
+                                                       SegmentAggContext)
+
+    k = size + from_
+    agg_parts: List[Dict[str, Any]] = []
+    total = 0
+    merged: List[Tuple[Tuple, int, int, float, List]] = []
+    for idx, view in enumerate(reader.views):
+        executor = SegmentQueryExecutor(reader, idx)
+        mask, score = executor.execute(query)
+        live = jnp.asarray(view.live_mask)
+        final_mask = np.asarray(mask & live)[: view.segment.num_docs]
+        scores_np = np.asarray(
+            bm25.mask_scores(score[None, :], mask[None, :], live)[0]
+        )[: view.segment.num_docs]
+        if min_score is not None:
+            final_mask = final_mask & (scores_np >= min_score)
+        total += int(final_mask.sum())
+        if aggs:
+            ctx = SegmentAggContext(reader, idx)
+            pad = np.zeros(view.pack.d_pad, dtype=bool)
+            pad[: len(final_mask)] = final_mask
+            agg_parts.append(aggs.collect(ctx, pad))
+        value_arrays = sort_mod.segment_sort_values(reader, idx, sort_specs,
+                                                    scores_np)
+        if search_after is not None:
+            final_mask = final_mask & sort_mod.after_mask(
+                sort_specs, value_arrays, search_after)
+        ords = np.nonzero(final_mask)[0]
+        if len(ords) == 0:
+            continue
+        # per-segment vectorized top-k (lexsort; strings via ordinals)
+        keys = _lexsort_keys(view.segment, sort_specs, value_arrays, ords,
+                             scores_np)
+        # np.lexsort: LAST key is primary → (tiebreak ord, ..., spec0)
+        order = np.lexsort((ords,) + tuple(reversed(keys)))
+        top_ords = ords[order[: k]] if k > 0 else ords[:0]
+        for o in top_ords:
+            vals = [va[o] for va in value_arrays]
+            merged.append((sort_mod.sort_key(sort_specs, vals), idx, int(o),
+                           float(scores_np[o]), vals))
+    merged.sort(key=lambda t: (t[0], t[1], t[2]))
+    window = merged[from_: from_ + size] if size > 0 else []
+    hits = []
+    for key, seg_idx, ord_, score_v, vals in window:
+        seg = reader.views[seg_idx].segment
+        hits.append(ShardHit(
+            seg.doc_ids[ord_], score_v, ShardDocRef(seg.name, ord_),
+            sort_values=[sort_mod.plain_value(v) for v in vals]))
+    shard_aggs = None
+    if aggs:
+        shard_aggs = (AggregatorFactories.reduce(agg_parts)
+                      if agg_parts else aggs.empty())
+    # max_score is null under field sort (reference behavior without
+    # track_scores)
+    only_score = all(s.field == "_score" for s in sort_specs)
+    max_score = (max((h.score for h in hits), default=None)
+                 if only_score else None)
+    return QuerySearchResult(hits, total, max_score, shard_aggs)
+
+
+def _lexsort_keys(segment, sort_specs, value_arrays, ords, scores_np):
+    """Per-spec (missing_rank, adjusted_value) numeric key arrays over
+    `ords`, direction-adjusted for np.lexsort (ascending)."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentException
+    keys = []
+    for spec, vals in zip(sort_specs, value_arrays):
+        col = segment.doc_values.get(spec.field)
+        if (col is not None and col.kind == "ord"
+                and spec.field not in ("_score", "_doc")):
+            ord_vals = col.values[ords].astype(np.int64)
+            missing = ord_vals < 0
+            if spec.missing not in ("_last", "_first"):
+                raise IllegalArgumentException(
+                    "[sort] literal [missing] values are not supported "
+                    "on keyword fields")
+            adj = ord_vals if spec.order == "asc" else -ord_vals
+        else:
+            sub = vals[ords].astype(np.float64)
+            missing = np.isnan(sub)
+            if spec.missing == "_first":
+                pass
+            elif spec.missing == "_last":
+                pass
+            else:
+                sub = np.where(missing, float(spec.missing), sub)
+                missing = np.zeros_like(missing)
+            adj = sub if spec.order == "asc" else -sub
+            adj = np.where(missing, 0.0, adj)
+        miss_rank = np.where(missing,
+                             0 if spec.missing == "_first" else 2, 1)
+        keys.append(miss_rank)
+        keys.append(adj)
+    return keys
+
+
 def execute_fetch(reader: ShardReader, hits: List[ShardHit],
-                  source: Any = True) -> List[Dict[str, Any]]:
-    """Fetch phase: resolve _source for winning docs.
+                  source: Any = True, *, version: bool = False,
+                  seq_no_primary_term: bool = False) -> List[Dict[str, Any]]:
+    """Fetch phase: resolve _source (and optionally _version /
+    _seq_no+_primary_term from the per-doc metadata columns) for winners.
 
     `source`: True | False | list of field-name prefixes (the _source
     filtering contract of the reference's fetch sub-phases)."""
@@ -114,6 +229,11 @@ def execute_fetch(reader: ShardReader, hits: List[ShardHit],
             if isinstance(source, (list, tuple)):
                 src = _filter_source(src or {}, list(source))
             doc["_source"] = src
+        if seg is not None and version:
+            doc["_version"] = int(seg.doc_versions[hit.ref.ord])
+        if seg is not None and seq_no_primary_term:
+            doc["_seq_no"] = int(seg.seq_nos[hit.ref.ord])
+            doc["_primary_term"] = int(seg.primary_terms[hit.ref.ord])
         out.append(doc)
     return out
 

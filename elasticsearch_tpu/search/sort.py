@@ -1,0 +1,179 @@
+"""Field sort + search_after — doc-values-backed result ordering.
+
+Reference: `search/sort/FieldSortBuilder`, `ScoreSortBuilder`,
+`SearchAfterBuilder` (SURVEY.md §2.1#50). Semantics kept:
+
+  - sort spec grammar: "field" | {"field": "asc"} |
+    {"field": {"order": ..., "missing": "_last"|"_first"|value}} |
+    "_score" (desc default) | "_doc"
+  - missing values default to _last regardless of direction
+  - search_after is a stateless cursor of the previous page's last sort
+    values; a doc qualifies iff its sort tuple is strictly after the
+    cursor in sort order
+  - hits carry their "sort" values; max_score is null when sorting by
+    anything but _score (the reference's behavior without track_scores)
+
+Keys are built per segment from the pack's doc-value columns (numeric
+i64/f64, keyword ordinals mapped through ord_terms); the cross-segment /
+cross-shard merge compares python value tuples with direction-aware
+comparators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.index.segment import MISSING_I64
+
+
+@dataclasses.dataclass
+class SortSpec:
+    field: str                      # field name | "_score" | "_doc"
+    order: str = "asc"              # "asc" | "desc"
+    missing: Any = "_last"          # "_last" | "_first" | literal value
+
+
+def parse_sort(spec: Any) -> List[SortSpec]:
+    """Reference grammar (FieldSortBuilder#fromXContent)."""
+    if spec is None:
+        return []
+    if not isinstance(spec, list):
+        spec = [spec]
+    out: List[SortSpec] = []
+    for entry in spec:
+        if isinstance(entry, str):
+            default = "desc" if entry == "_score" else "asc"
+            out.append(SortSpec(entry, default))
+        elif isinstance(entry, dict):
+            if len(entry) != 1:
+                raise IllegalArgumentException(
+                    "[sort] entry must name exactly one field")
+            field, opts = next(iter(entry.items()))
+            if isinstance(opts, str):
+                opts = {"order": opts}
+            if not isinstance(opts, dict):
+                raise IllegalArgumentException(
+                    f"[sort] malformed options for [{field}]")
+            order = opts.get("order", "desc" if field == "_score" else "asc")
+            if order not in ("asc", "desc"):
+                raise IllegalArgumentException(
+                    f"[sort] unknown order [{order}]")
+            out.append(SortSpec(field, order, opts.get("missing", "_last")))
+        else:
+            raise IllegalArgumentException("[sort] malformed sort entry")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-segment key extraction
+# ---------------------------------------------------------------------------
+
+def segment_sort_values(reader, view_idx: int,
+                        specs: Sequence[SortSpec],
+                        scores: np.ndarray) -> List[np.ndarray]:
+    """One value array per spec, aligned to segment doc ordinals.
+    Numeric → f64 (NaN = missing), keyword → object array (None =
+    missing), _score → scores, _doc → ordinals."""
+    view = reader.views[view_idx]
+    seg = view.segment
+    n = seg.num_docs
+    out: List[np.ndarray] = []
+    for spec in specs:
+        if spec.field == "_score":
+            out.append(np.asarray(scores[:n], dtype=np.float64))
+            continue
+        if spec.field == "_doc":
+            out.append(np.arange(n, dtype=np.float64))
+            continue
+        col = seg.doc_values.get(spec.field)
+        if col is None:
+            vals = np.full(n, np.nan)
+            out.append(vals)
+            continue
+        if col.kind == "ord":
+            obj = np.empty(n, dtype=object)
+            terms = col.ord_terms or []
+            for i in range(n):
+                o = int(col.values[i])
+                obj[i] = terms[o] if o >= 0 else None
+            out.append(obj)
+        elif col.kind == "f64":
+            out.append(col.values.astype(np.float64, copy=True))
+        else:
+            vals = col.values.astype(np.float64, copy=True)
+            vals[col.values == MISSING_I64] = np.nan
+            out.append(vals)
+    return out
+
+
+def _is_missing(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    return False
+
+
+def _element_key(spec: SortSpec, v: Any) -> Tuple:
+    """Ascending-comparable key for one sort element honoring order +
+    missing placement. Shape: (missing_rank, direction-adjusted value)."""
+    if _is_missing(v):
+        if spec.missing == "_first":
+            return (0, 0)
+        if spec.missing == "_last":
+            return (2, 0)
+        v = spec.missing  # literal replacement value
+    if isinstance(v, str):
+        # strings can't negate: desc uses an inverted-codepoint key
+        key: Any = v if spec.order == "asc" else _invert_str(v)
+    else:
+        key = v if spec.order == "asc" else -float(v)
+    return (1, key)
+
+
+def _invert_str(s: str) -> Tuple:
+    return tuple(-ord(c) for c in s) + (float("inf"),)
+
+
+def sort_key(specs: Sequence[SortSpec], values: Sequence[Any]) -> Tuple:
+    return tuple(_element_key(s, v) for s, v in zip(specs, values))
+
+
+def after_mask(specs: Sequence[SortSpec], value_arrays: List[np.ndarray],
+               cursor: Sequence[Any]) -> np.ndarray:
+    """bool[n]: docs whose sort tuple is STRICTLY after the cursor."""
+    if len(cursor) != len(specs):
+        raise IllegalArgumentException(
+            f"[search_after] expects {len(specs)} values, "
+            f"got {len(cursor)}")
+    n = len(value_arrays[0]) if value_arrays else 0
+    after = np.zeros(n, dtype=bool)
+    equal = np.ones(n, dtype=bool)
+    for spec, vals, cur in zip(specs, value_arrays, cursor):
+        ck = _element_key(spec, cur)
+        gt = np.zeros(n, dtype=bool)
+        eq = np.zeros(n, dtype=bool)
+        for i in range(n):
+            k = _element_key(spec, vals[i])
+            if k > ck:
+                gt[i] = True
+            elif k == ck:
+                eq[i] = True
+        after |= equal & gt
+        equal &= eq
+    return after
+
+
+def plain_value(v: Any) -> Any:
+    """JSON-safe sort value for the response's "sort" array."""
+    if _is_missing(v):
+        return None
+    if isinstance(v, (np.floating, np.integer)):
+        v = v.item()
+    if isinstance(v, float) and v.is_integer():
+        return int(v)
+    return v

@@ -137,6 +137,11 @@ class ResidentPack:
     # the fetch phase resolves _source against the same snapshot the
     # query phase scored, SURVEY.md §3.3)
     readers: Dict[int, Any] = dataclasses.field(default_factory=dict)
+    # block-max layout (SURVEY.md §5.7): impact-descending copies of the
+    # postings, host + device — pruned mode scores only each term's top
+    # PREFIX_CAP entries and bounds what it skipped
+    imp_host: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    imp_device_arrays: Optional[Tuple] = None
 
 
 class IndexPackCache:
@@ -202,18 +207,24 @@ class IndexPackCache:
         pack = dist.build_stacked_pack(segments, field, live_docs=live,
                                        k1=k1, b=b, pad_shards_to=s_pad,
                                        row_groups=groups)
-        hbm = pack.nbytes_device()
+        imp_docs, imp_impacts = dist.build_impact_sorted(pack)
+        hbm = pack.nbytes_device() + imp_docs.nbytes + imp_impacts.nbytes
         if self._breaker is not None:
             self._breaker.add_estimate_bytes_and_maybe_break(
                 hbm, label=f"pack[{field}]")
         try:
             arrays = dist.device_put_pack(pack, self.mesh)
+            imp_arrays = dist.device_put_pack(
+                dataclasses.replace(pack, flat_docs=imp_docs,
+                                    flat_impact=imp_impacts), self.mesh)
         except Exception:
             if self._breaker is not None:  # undo the charge on HBM failure
                 self._breaker.release(hbm)
             raise
         return ResidentPack(pack, arrays, row_origin, reader_key, hbm,
-                            readers={num: r for num, r in readers})
+                            readers={num: r for num, r in readers},
+                            imp_host=(imp_docs, imp_impacts),
+                            imp_device_arrays=imp_arrays)
 
     def invalidate(self, index_name: str) -> None:
         with self._lock:
@@ -330,37 +341,170 @@ class FlatQueryResult:
     total_hits: int
     max_score: Optional[float]
     resident: Optional[ResidentPack] = None  # for the fetch phase
+    total_relation: str = "eq"  # "gte" when block-max pruning stopped
+                                # counting (the reference's WAND behavior)
+
+
+# block-max serving knobs: per-term impact prefix taken on device, and
+# the candidate slack that absorbs approximate-order error before the
+# exact host re-score. The pruned path pins every jit-signature dimension
+# (T slots, window, chunk len, batch bucket, candidate k) to a handful of
+# values so steady-state serving NEVER re-compiles.
+PREFIX_CAP = 4096
+PRUNE_MAX_K = 1000
+PRUNE_MAX_TERMS = 8          # > 8 query terms → exact path
+_PRUNE_T_SLOTS = 8           # = PRUNE_MAX_TERMS × (PREFIX_CAP / chunk 4096)
+_PRUNE_WINDOW = 8
+
+
+def _candidate_k(k: int) -> int:
+    """Static candidate-count buckets (k + slack, few jit signatures)."""
+    return 128 if k <= 64 else 2048
+
+
+def _serving_bucket(n: int, cap: int = 64) -> int:
+    """Two batch buckets in the common range: small (8) and full (64);
+    larger batches (bigger max_batch settings) fall back to pow2."""
+    if n <= 8:
+        return 8
+    if n <= cap:
+        return cap
+    return _batch_bucket(n, 1024)
 
 
 def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
                        k: int, mesh=None) -> List[FlatQueryResult]:
-    """Run one batched kernel call over the resident pack. The batch pads
-    to a power-of-two bucket so repeated sizes reuse the jit cache."""
+    """Run one micro-batch. OR-queries (min_count == 1, k ≤ 1000) go
+    through the block-max pruned pipeline; msm/AND queries and pruned
+    queries whose validity bound fails go through the exact kernel."""
+    if mesh is None:
+        mesh = make_mesh(shape=(1, _n_local_devices()))
+    pruned_idx = [i for i, f in enumerate(flats)
+                  if f.min_count == 1 and k <= PRUNE_MAX_K
+                  and len(f.terms) <= PRUNE_MAX_TERMS
+                  and resident.imp_device_arrays is not None]
+    exact_idx = [i for i in range(len(flats)) if i not in set(pruned_idx)]
+    out: List[Optional[FlatQueryResult]] = [None] * len(flats)
+    if pruned_idx:
+        results, invalid = _execute_pruned(
+            resident, [flats[i] for i in pruned_idx], k, mesh)
+        for j, i in enumerate(pruned_idx):
+            out[i] = results[j]
+        exact_idx.extend(pruned_idx[j] for j in invalid)
+    if exact_idx:
+        results = _execute_exact(resident, [flats[i] for i in exact_idx],
+                                 k, mesh)
+        for j, i in enumerate(exact_idx):
+            out[i] = results[j]
+    return out  # type: ignore[return-value]
+
+
+def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
+                   k: int, mesh) -> List[FlatQueryResult]:
+    """Full-postings kernel: exact scores, exact totals."""
     pack = resident.pack
-    b_bucket = _batch_bucket(len(flats), 1024)
     batch = dist.prepare_query_batch(
         pack, [f.terms for f in flats],
         boosts=[f.boost for f in flats],
         min_counts=[f.min_count for f in flats],
-        pad_batch_to=b_bucket)
-    the_mesh = mesh
-    if the_mesh is None:
-        the_mesh = make_mesh(shape=(1, _n_local_devices()))
+        pad_batch_to=_batch_bucket(len(flats), 1024))
     vals, refs, totals = dist.distributed_search(
-        pack, batch, k, the_mesh, device_arrays=resident.device_arrays)
-    out = []
-    for qi in range(len(flats)):
-        hits = []
-        for score, row, ord_ in refs[qi]:
-            if row >= len(resident.row_origin):
-                continue  # padding row
-            shard_num, seg_name = resident.row_origin[row]
-            doc_id = pack.shard_doc_ids[row][ord_]
-            hits.append((score, shard_num, seg_name, ord_, doc_id))
-        out.append(FlatQueryResult(
-            hits, int(totals[qi]), hits[0][0] if hits else None,
-            resident=resident))
-    return out
+        pack, batch, k, mesh, device_arrays=resident.device_arrays)
+    return [_to_result(resident, refs[qi], int(totals[qi]), "eq")
+            for qi in range(len(flats))]
+
+
+def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
+                    k: int, mesh) -> Tuple[List[FlatQueryResult], List[int]]:
+    """Block-max pipeline (SURVEY.md §5.7/§7.3#3), one fused launch:
+    candidate generation over impact-sorted prefixes + EXACT on-device
+    re-score (binary search in the doc-sorted postings) + final order;
+    only [B, k] results cross the device→host link. The WAND validity
+    bound — any doc outside the candidates scores below (approx cutoff
+    + Σ skipped-tail maxima) — is checked here; failures rerun on the
+    exact kernel. Returns (results, invalid indices)."""
+    import jax
+
+    pack = resident.pack
+    imp_docs, imp_impacts = resident.imp_host
+    k_cand = _candidate_k(k)
+    k_out = 128 if k_cand == 128 else 1024
+    b_bucket = _serving_bucket(len(flats))
+    batch = dist.prepare_query_batch(
+        pack, [f.terms for f in flats],
+        boosts=[f.boost for f in flats],
+        min_counts=[1] * len(flats),
+        pad_batch_to=b_bucket,
+        prefix_cap=PREFIX_CAP, imp_impacts=imp_impacts,
+        pad_t_slots=_PRUNE_T_SLOTS, pad_max_len=dist.CHUNK_CAP)
+    t_starts, t_lengths, t_weights = dist.prepare_term_ranges(
+        pack, [f.terms for f in flats],
+        boosts=[f.boost for f in flats],
+        pad_batch_to=b_bucket, pad_terms=PRUNE_MAX_TERMS)
+    fn = dist.make_pruned_search(
+        mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
+        c_cand=k_cand, k_out=k_out,
+        t_window=max(_PRUNE_WINDOW, batch.window),
+        t_terms=PRUNE_MAX_TERMS)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+    sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
+    sb = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS))
+    put = jax.device_put
+    vals, gids, totals, cutoff, beta = fn(
+        resident.imp_device_arrays[0], resident.imp_device_arrays[1],
+        resident.device_arrays[0], resident.device_arrays[1],
+        put(batch.starts, sbt), put(batch.lengths, sbt),
+        put(batch.weights, sbt),
+        put(t_starts, sbt), put(t_lengths, sbt), put(t_weights, sbt),
+        put(batch.tail_bounds, sb))
+    vals = np.asarray(vals)
+    gids = np.asarray(gids)
+    totals = np.asarray(totals)
+    cutoff = np.asarray(cutoff)
+    beta = np.asarray(beta)
+
+    results: List[FlatQueryResult] = []
+    invalid: List[int] = []
+    for qi, flat in enumerate(flats):
+        b_q = float(beta[qi])
+        row_vals = vals[qi]
+        real = row_vals > dist.NEG_INF
+        n_real = int(real.sum())
+        top = []
+        for j in range(min(n_real, k)):
+            gid = int(gids[qi][j])
+            row, ord_ = divmod(gid, pack.d_pad + 1)
+            if ord_ >= pack.d_pad:
+                continue
+            top.append((float(row_vals[j]), row, ord_))
+        if b_q > 0.0:
+            # validity at the caller's k: docs outside the candidate set
+            # score below cutoff+β (cut candidates) or β (tail-only)
+            kth = top[k - 1][0] if len(top) >= k else float("-inf")
+            c_q = float(cutoff[qi])
+            threshold = (c_q + b_q) if c_q > dist.NEG_INF else b_q
+            if kth < threshold or (n_real < k):
+                results.append(None)  # type: ignore[arg-type]
+                invalid.append(qi)
+                continue
+        results.append(_to_result(resident, top, int(totals[qi]),
+                                  "gte" if b_q > 0.0 else "eq"))
+    return results, invalid
+
+
+def _to_result(resident: ResidentPack, refs, total: int,
+               relation: str) -> FlatQueryResult:
+    pack = resident.pack
+    hits = []
+    for score, row, ord_ in refs:
+        if row >= len(resident.row_origin):
+            continue  # padding row
+        shard_num, seg_name = resident.row_origin[row]
+        doc_id = pack.shard_doc_ids[row][ord_]
+        hits.append((score, shard_num, seg_name, ord_, doc_id))
+    return FlatQueryResult(hits, total, hits[0][0] if hits else None,
+                           resident=resident, total_relation=relation)
 
 
 def _n_local_devices() -> int:
@@ -410,7 +554,9 @@ class TpuSearchService:
         except RuntimeError:  # batcher closed (node shutdown race)
             self.fallback += 1
             return None
-        result = fut.result(timeout=30.0)
+        # generous bound: the FIRST batch on a signature pays XLA compile
+        # (tens of seconds on TPU); steady-state batches are milliseconds
+        result = fut.result(timeout=300.0)
         self.served += 1
         return result
 

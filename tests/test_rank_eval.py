@@ -1,0 +1,140 @@
+"""rank-eval metric math + REST endpoint + synthetic-corpus quality
+harness (reference: modules/rank-eval, SURVEY.md §2.1#50; BASELINE.md
+parity obligations)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.benchmark import corpus as corpus_gen
+from elasticsearch_tpu.search import rank_eval
+
+
+class TestMetricMath:
+    def test_precision(self):
+        assert rank_eval.precision_at_k([1, 0, 1, None, 1], 5) == 3 / 5
+        assert rank_eval.precision_at_k(
+            [1, 0, 1, None, 1], 5, ignore_unlabeled=True) == 3 / 4
+        assert rank_eval.precision_at_k([], 5) == 0.0
+
+    def test_recall(self):
+        assert rank_eval.recall_at_k([1, 0, 1], 3, total_relevant=4) == 0.5
+
+    def test_mrr(self):
+        assert rank_eval.reciprocal_rank([0, 0, 1, 1], 10) == 1 / 3
+        assert rank_eval.reciprocal_rank([None, 2], 10) == 1 / 2
+        assert rank_eval.reciprocal_rank([0, 0], 10) == 0.0
+
+    def test_dcg_reference_formula(self):
+        # (2^3-1)/log2(2) + (2^2-1)/log2(3) + (2^3-1)/log2(4)
+        got = rank_eval.dcg_at_k([3, 2, 3], 10)
+        want = 7 / 1 + 3 / math.log2(3) + 7 / 2
+        assert got == pytest.approx(want)
+
+    def test_ndcg_perfect_is_one(self):
+        assert rank_eval.ndcg_at_k([3, 2, 1], 10) == pytest.approx(1.0)
+        assert rank_eval.ndcg_at_k([1, 2, 3], 10) < 1.0
+
+    def test_ndcg_uses_full_rating_pool(self):
+        # a perfect-looking window is NOT perfect if better docs exist
+        assert rank_eval.ndcg_at_k([2], 10, all_ratings=[2, 3]) < 1.0
+
+    def test_err_monotone_in_rank(self):
+        hi = rank_eval.err_at_k([3, 0, 0], 10)
+        lo = rank_eval.err_at_k([0, 0, 3], 10)
+        assert hi > lo > 0
+
+
+class TestRestRankEval:
+    @pytest.fixture
+    def node(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        n = Node(str(tmp_path))
+        yield n
+        n.close()
+
+    def test_ndcg_through_rest(self, node):
+        docs = {"1": "quick brown fox", "2": "quick fox", "3": "lazy dog",
+                "4": "brown dog", "5": "quick quick quick"}
+        for i, text in docs.items():
+            node.handle("PUT", f"/idx/_doc/{i}", {}, {"body": text})
+        node.handle("POST", "/idx/_refresh", {}, None)
+        status, out = node.handle("POST", "/idx/_rank_eval", {}, {
+            "requests": [{
+                "id": "q1",
+                "request": {"query": {"match": {"body": "quick"}}},
+                "ratings": [{"_id": "1", "rating": 2},
+                            {"_id": "2", "rating": 3},
+                            {"_id": "5", "rating": 1}],
+            }],
+            "metric": {"dcg": {"k": 10, "normalize": True}},
+        })
+        assert status == 200
+        assert 0.0 < out["metric_score"] <= 1.0
+        assert out["details"]["q1"]["unrated_docs"] == 0
+
+    def test_mrr_through_rest(self, node):
+        node.handle("PUT", "/idx/_doc/a", {}, {"body": "x y"})
+        node.handle("PUT", "/idx/_doc/b", {}, {"body": "x x"})
+        node.handle("POST", "/idx/_refresh", {}, None)
+        status, out = node.handle("POST", "/idx/_rank_eval", {}, {
+            "requests": [{"id": "q",
+                          "request": {"query": {"match": {"body": "x"}}},
+                          "ratings": [{"_id": "a", "rating": 1}]}],
+            "metric": {"mean_reciprocal_rank": {"k": 5}},
+        })
+        assert status == 200
+        # doc b (tf=2) outranks a → first relevant at rank 2
+        assert out["metric_score"] == pytest.approx(0.5)
+
+    def test_bad_metric_400(self, node):
+        node.handle("PUT", "/idx/_doc/1", {}, {"body": "x"})
+        status, out = node.handle("POST", "/idx/_rank_eval", {}, {
+            "requests": [{"id": "q", "request": {}, "ratings": []}],
+            "metric": {"nope": {}}})
+        assert status == 400
+
+
+class TestSyntheticCorpus:
+    def test_shapes_and_zipf(self):
+        c = corpus_gen.generate(2000, vocab_size=500, num_queries=8,
+                                seed=7)
+        assert c.num_docs == 2000
+        assert len(c.queries) == 8 and len(c.qrels) == 8
+        # Zipf: the most common token should dominate
+        counts = np.bincount(np.concatenate(c.doc_tokens), minlength=500)
+        assert counts[0] > counts[50] > counts[400]
+        # every judged doc contains every query term
+        for qi, rel in enumerate(c.qrels):
+            for doc_idx in rel:
+                toks = set(int(t) for t in c.doc_tokens[doc_idx])
+                assert all(t in toks for t in c.queries[qi])
+
+    def test_planted_relevance_is_findable_by_bm25(self, tmp_path):
+        """BM25 over the synthetic corpus must rank planted docs highly —
+        the harness is meaningless if the signal is too weak to recover."""
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.indices.service import IndicesService
+        from elasticsearch_tpu.search import coordinator
+
+        c = corpus_gen.generate(1500, vocab_size=800, num_queries=6,
+                                relevant_per_query=3, seed=11)
+        svc = IndicesService(str(tmp_path))
+        idx = svc.create_index("q", Settings.EMPTY,
+                               {"properties": {"body": {"type": "text"}}})
+        for i in range(c.num_docs):
+            shard = idx.shard(idx.shard_for_id(str(i)))
+            shard.apply_index_on_primary(str(i), {"body": c.doc_text(i)})
+        idx.refresh()
+        ndcgs = []
+        for qi in range(len(c.queries)):
+            out = coordinator.search(
+                svc, "q", {"query": {"match": {"body": c.query_text(qi)}},
+                           "size": 10})
+            ranked = [c.qrels[qi].get(int(h["_id"]))
+                      for h in out["hits"]["hits"]]
+            ndcgs.append(rank_eval.ndcg_at_k(
+                ranked, 10, list(c.qrels[qi].values())))
+        assert sum(ndcgs) / len(ndcgs) > 0.5
+        svc.close()

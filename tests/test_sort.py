@@ -1,0 +1,176 @@
+"""Field sort + search_after tests (reference: FieldSortBuilder /
+SearchAfterBuilder semantics, SURVEY.md §2.1#50; VERDICT r1 #6)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.indices.service import IndicesService
+from elasticsearch_tpu.search import coordinator
+
+
+@pytest.fixture
+def svc(tmp_path):
+    s = IndicesService(str(tmp_path))
+    idx = s.create_index(
+        "books", Settings.of({"index": {"number_of_shards": 2}}),
+        {"properties": {"title": {"type": "text"},
+                        "year": {"type": "long"},
+                        "rating": {"type": "double"},
+                        "genre": {"type": "keyword"}}})
+    docs = [
+        ("1", "alpha story", 2001, 4.5, "scifi"),
+        ("2", "beta story", 1999, 3.2, "fantasy"),
+        ("3", "gamma story", 2010, 4.9, "scifi"),
+        ("4", "delta story", 2005, None, "horror"),
+        ("5", "epsilon story", None, 2.1, "fantasy"),
+        ("6", "zeta story", 1999, 4.5, None),
+    ]
+    for doc_id, title, year, rating, genre in docs:
+        body = {"title": title}
+        if year is not None:
+            body["year"] = year
+        if rating is not None:
+            body["rating"] = rating
+        if genre is not None:
+            body["genre"] = genre
+        shard = idx.shard(idx.shard_for_id(doc_id))
+        shard.apply_index_on_primary(doc_id, body)
+    idx.refresh()
+    yield s
+    s.close()
+
+
+def ids(out):
+    return [h["_id"] for h in out["hits"]["hits"]]
+
+
+class TestFieldSort:
+    def test_numeric_asc_missing_last(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "story"}},
+            "sort": [{"year": "asc"}]})
+        assert ids(out) == ["2", "6", "1", "4", "3", "5"]
+        assert out["hits"]["hits"][0]["sort"] == [1999]
+        assert out["hits"]["max_score"] is None
+        assert out["hits"]["hits"][0]["_score"] is None
+
+    def test_numeric_desc_missing_last(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "story"}},
+            "sort": [{"year": {"order": "desc"}}]})
+        assert ids(out) == ["3", "4", "1", "2", "6", "5"]
+
+    def test_missing_first(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "story"}},
+            "sort": [{"year": {"order": "asc", "missing": "_first"}}]})
+        assert ids(out)[0] == "5"
+
+    def test_missing_literal(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "story"}},
+            "sort": [{"year": {"order": "asc", "missing": 2003}}]})
+        # doc 5 slots between 2001 and 2005
+        assert ids(out) == ["2", "6", "1", "5", "4", "3"]
+
+    def test_double_field(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "story"}},
+            "sort": [{"rating": "desc"}]})
+        assert ids(out) == ["3", "1", "6", "2", "5", "4"]
+        assert out["hits"]["hits"][0]["sort"] == [4.9]
+
+    def test_keyword_sort(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "story"}},
+            "sort": [{"genre": "asc"}]})
+        # genre asc; ties (fantasy: 2,5 / scifi: 1,3) break by shard
+        # order, missing (6) last
+        assert ids(out) == ["2", "5", "4", "3", "1", "6"]
+        assert out["hits"]["hits"][0]["sort"] == ["fantasy"]
+
+    def test_multi_key_with_tiebreak(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "story"}},
+            "sort": [{"year": "asc"}, {"rating": "desc"}]})
+        # year 1999 tie: rating 4.5 (6) before 3.2 (2)
+        assert ids(out)[:2] == ["6", "2"]
+        assert out["hits"]["hits"][0]["sort"] == [1999, 4.5]
+
+    def test_score_sort_explicit(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "alpha story"}},
+            "sort": ["_score"]})
+        assert ids(out)[0] == "1"
+        assert out["hits"]["max_score"] is not None
+        assert out["hits"]["hits"][0]["_score"] is not None
+
+    def test_sort_equals_unsorted_for_score(self, svc):
+        a = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "alpha beta story"}},
+            "sort": ["_score"]})
+        b = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "alpha beta story"}}})
+        assert ids(a) == ids(b)
+
+
+class TestSearchAfter:
+    def test_paging_covers_all_without_dups(self, svc):
+        body = {"query": {"match": {"title": "story"}},
+                "sort": [{"year": "asc"}, {"rating": "desc"}], "size": 2}
+        seen = []
+        cursor = None
+        for _ in range(5):
+            b = dict(body)
+            if cursor is not None:
+                b["search_after"] = cursor
+            out = coordinator.search(svc, "books", b)
+            hits = out["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(h["_id"] for h in hits)
+            cursor = hits[-1]["sort"]
+        # year asc, rating desc on the 1999 tie → 6 (4.5) before 2 (3.2)
+        assert seen == ["6", "2", "1", "4", "3", "5"]
+        assert len(set(seen)) == 6
+
+    def test_search_after_requires_sort(self, svc):
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+        with pytest.raises(IllegalArgumentException):
+            coordinator.search(svc, "books", {
+                "query": {"match_all": {}}, "search_after": [1999]})
+
+
+class TestUnsupportedKeysRejected:
+    @pytest.mark.parametrize("key", ["highlight", "suggest", "collapse",
+                                     "rescore"])
+    def test_400_on_unsupported(self, svc, key):
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+        with pytest.raises(IllegalArgumentException):
+            coordinator.search(svc, "books", {
+                "query": {"match_all": {}}, key: {}})
+
+
+class TestVersionSeqNoFlags:
+    def test_version_and_seqno_in_hits(self, svc):
+        out = coordinator.search(svc, "books", {
+            "query": {"match": {"title": "alpha"}},
+            "version": True, "seq_no_primary_term": True})
+        hit = out["hits"]["hits"][0]
+        assert hit["_version"] == 1
+        assert hit["_seq_no"] >= 0
+        assert hit["_primary_term"] == 1
+
+    def test_flags_work_on_fast_path(self, svc):
+        from elasticsearch_tpu.search.tpu_service import TpuSearchService
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            out = coordinator.search(svc, "books", {
+                "query": {"match": {"title": "alpha"}},
+                "version": True, "seq_no_primary_term": True},
+                tpu_search=tpu)
+            assert tpu.served > 0
+            hit = out["hits"]["hits"][0]
+            assert hit["_version"] == 1 and hit["_primary_term"] == 1
+        finally:
+            tpu.close()

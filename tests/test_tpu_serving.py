@@ -304,3 +304,93 @@ class TestReviewFindings:
         res = tpu.try_search(idx, dsl.MatchQuery(field="body", query="alpha"),
                              k=5)
         assert res is None and tpu.fallback > 0
+
+
+class TestBlockMaxPruning:
+    """Block-max/WAND-analog tests: force truncation with a tiny prefix
+    cap and assert the pruned path returns the SAME top-k as the planner
+    (validity bound + exact host re-score), with gte totals."""
+
+    def _dense_corpus(self, svc, seeded_np, docs=400):
+        """Corpus where one term is very common (big postings row)."""
+        from elasticsearch_tpu.common.settings import Settings
+        idx = svc.create_index(
+            "dense", Settings.of({"index": {"number_of_shards": 2}}),
+            {"properties": {"body": {"type": "text"}}})
+        for i in range(docs):
+            words = ["common"] * int(seeded_np.integers(1, 4))
+            if i % 3 == 0:
+                words += ["rare"] * int(seeded_np.integers(1, 3))
+            words += [WORDS[int(w)] for w in
+                      seeded_np.integers(0, 6, 4)]
+            shard = idx.shard(idx.shard_for_id(f"d{i}"))
+            shard.apply_index_on_primary(f"d{i}", {"body": " ".join(words)})
+        idx.refresh()
+        return idx
+
+    @pytest.mark.parametrize("cap", [64, 128])
+    def test_truncated_equivalence(self, svc, seeded_np, cap, monkeypatch):
+        from elasticsearch_tpu.search import tpu_service
+        self._dense_corpus(svc, seeded_np)
+        monkeypatch.setattr(tpu_service, "PREFIX_CAP", cap)
+        body = {"query": {"match": {"body": "common rare"}}, "size": 20}
+        tpu = tpu_service.TpuSearchService(window_s=0.0)
+        try:
+            fast = coordinator.search(svc, "dense", dict(body),
+                                      tpu_search=tpu)
+            assert tpu.served > 0
+        finally:
+            tpu.close()
+        slow = coordinator.search(svc, "dense", dict(body), tpu_search=None)
+        # hits must be identical even though postings were truncated
+        assert ([h["_id"] for h in fast["hits"]["hits"]]
+                == [h["_id"] for h in slow["hits"]["hits"]])
+        for a, b in zip(fast["hits"]["hits"], slow["hits"]["hits"]):
+            assert a["_score"] == pytest.approx(b["_score"], rel=1e-5)
+        # totals: pruned mode reports a lower bound with gte
+        assert fast["hits"]["total"]["relation"] in ("eq", "gte")
+        assert (fast["hits"]["total"]["value"]
+                <= slow["hits"]["total"]["value"])
+
+    def test_validity_failure_falls_back_exact(self, svc, seeded_np,
+                                               monkeypatch):
+        """A cap so small the bound can't hold → exact rerun, correct
+        results, relation eq."""
+        from elasticsearch_tpu.search import tpu_service
+        self._dense_corpus(svc, seeded_np)
+        monkeypatch.setattr(tpu_service, "PREFIX_CAP", 1)
+        body = {"query": {"match": {"body": "common"}}, "size": 300}
+        tpu = tpu_service.TpuSearchService(window_s=0.0)
+        try:
+            fast = coordinator.search(svc, "dense", dict(body),
+                                      tpu_search=tpu)
+        finally:
+            tpu.close()
+        slow = coordinator.search(svc, "dense", dict(body), tpu_search=None)
+        assert ([h["_id"] for h in fast["hits"]["hits"]]
+                == [h["_id"] for h in slow["hits"]["hits"]])
+        assert (fast["hits"]["total"]["value"]
+                == slow["hits"]["total"]["value"])
+
+    def test_impact_sorted_layout(self, svc, seeded_np):
+        from elasticsearch_tpu.parallel import distributed as dist
+        idx = self._dense_corpus(svc, seeded_np, docs=100)
+        from elasticsearch_tpu.search.tpu_service import TpuSearchService
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            resident = tpu.packs.get(idx, "body")
+            pack = resident.pack
+            imp_docs, imp_impacts = resident.imp_host
+            for si in range(pack.num_shards):
+                rstart = pack.row_starts[si]
+                vocab = pack.vocabs[si]
+                for term, r in vocab.items():
+                    a, b = int(rstart[r]), int(rstart[r + 1])
+                    seg = imp_impacts[si, a:b]
+                    assert (np.diff(seg) <= 1e-7).all(), \
+                        f"impacts not descending for {term}"
+                    # same multiset of (doc, impact) as the doc-sorted copy
+                    assert sorted(imp_docs[si, a:b].tolist()) == \
+                        pack.flat_docs[si, a:b].tolist()
+        finally:
+            tpu.close()
