@@ -630,9 +630,18 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
     return jax.jit(mapped)
 
 
-def unpack_pruned(packed: np.ndarray, k_keep: int):
+def unpack_pruned(packed: np.ndarray, k_keep: Optional[int] = None):
     """Host-side split of make_pruned_search's packed output →
-    (vals [B,k], gids int32 [B,k], totals [B], cutoff [B], beta [B])."""
+    (vals [B,k], gids int32 [B,k], totals [B], cutoff [B], beta [B]).
+    k_keep is derived from the packed width [B, 2k+3] — the kernel may
+    clamp k_out to the candidate-pool width, so callers must not guess."""
+    derived = (packed.shape[1] - 3) // 2
+    if k_keep is None:
+        k_keep = derived
+    elif k_keep != derived:
+        raise ValueError(
+            f"packed width {packed.shape[1]} implies k_keep={derived}, "
+            f"caller passed {k_keep}")
     vals = packed[:, :k_keep]
     gids = np.ascontiguousarray(packed[:, k_keep:2 * k_keep]
                                 ).view(np.int32)

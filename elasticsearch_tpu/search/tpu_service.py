@@ -34,9 +34,11 @@ Three pieces:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -45,6 +47,8 @@ from elasticsearch_tpu.mapping.types import TextFieldType
 from elasticsearch_tpu.parallel import distributed as dist
 from elasticsearch_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 from elasticsearch_tpu.search import dsl
+
+logger = logging.getLogger("elasticsearch_tpu.tpu_service")
 
 
 # ---------------------------------------------------------------------------
@@ -451,18 +455,17 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     sb = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS))
     put = jax.device_put
-    vals, gids, totals, cutoff, beta = fn(
+    packed = fn(
         resident.imp_device_arrays[0], resident.imp_device_arrays[1],
         resident.device_arrays[0], resident.device_arrays[1],
         put(batch.starts, sbt), put(batch.lengths, sbt),
         put(batch.weights, sbt),
         put(t_starts, sbt), put(t_lengths, sbt), put(t_weights, sbt),
         put(batch.tail_bounds, sb))
-    vals = np.asarray(vals)
-    gids = np.asarray(gids)
-    totals = np.asarray(totals)
-    cutoff = np.asarray(cutoff)
-    beta = np.asarray(beta)
+    # one device→host transfer; split host-side (k derived from the
+    # packed width — the kernel clamps k_out to its candidate pool)
+    vals, gids, totals, cutoff, beta = dist.unpack_pruned(
+        np.asarray(packed))
 
     results: List[FlatQueryResult] = []
     invalid: List[int] = []
@@ -527,6 +530,8 @@ class TpuSearchService:
         self.batcher.mesh = self.packs.mesh
         self.served = 0      # queries answered by the kernel path
         self.fallback = 0    # queries declined to the planner path
+        self.timeouts = 0    # kernel waits that hit the deadline
+        self.last_error: Optional[str] = None  # most recent kernel failure
 
     def invalidate_index(self, index_name: str) -> None:
         """Drop resident packs of a deleted index (releases HBM breaker
@@ -549,19 +554,34 @@ class TpuSearchService:
             # field has no postings anywhere → zero hits, kernel-free
             self.served += 1
             return FlatQueryResult([], 0, None)
+        # The kernel path is an optional accelerator: any failure here
+        # must degrade to the planner, never surface as an error
+        # (EnginePlugin seam contract — an engine swap preserves behavior).
         try:
             fut = self.batcher.submit(resident, flat, k)
-        except RuntimeError:  # batcher closed (node shutdown race)
+            # generous bound: the FIRST batch on a signature pays XLA
+            # compile (tens of seconds on TPU); steady-state batches are
+            # milliseconds
+            result = fut.result(timeout=300.0)
+        except FuturesTimeout:
+            # a wedged signature must not re-stall every query: trip the
+            # kernel-path breaker so subsequent queries plan immediately
             self.fallback += 1
+            self.timeouts += 1
+            self.last_error = "timeout waiting for kernel batch"
+            logger.error("tpu kernel batch timed out; falling back")
             return None
-        # generous bound: the FIRST batch on a signature pays XLA compile
-        # (tens of seconds on TPU); steady-state batches are milliseconds
-        result = fut.result(timeout=300.0)
+        except Exception as exc:  # noqa: BLE001 — degrade, never 500
+            self.fallback += 1
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            logger.exception("tpu kernel path failed; falling back")
+            return None
         self.served += 1
         return result
 
     def stats(self) -> Dict[str, Any]:
         return {"served": self.served, "fallback": self.fallback,
+                "timeouts": self.timeouts, "last_error": self.last_error,
                 "batches": self.batcher.batches_executed,
                 "batched_queries": self.batcher.queries_executed}
 
