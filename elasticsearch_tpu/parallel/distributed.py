@@ -636,6 +636,9 @@ def unpack_pruned(packed: np.ndarray, k_keep: Optional[int] = None):
     k_keep is derived from the packed width [B, 2k+3] — the kernel may
     clamp k_out to the candidate-pool width, so callers must not guess."""
     derived = (packed.shape[1] - 3) // 2
+    if packed.shape[1] != 2 * derived + 3:
+        raise ValueError(
+            f"packed width {packed.shape[1]} is not of the form 2k+3")
     if k_keep is None:
         k_keep = derived
     elif k_keep != derived:

@@ -532,6 +532,13 @@ class TpuSearchService:
         self.fallback = 0    # queries declined to the planner path
         self.timeouts = 0    # kernel waits that hit the deadline
         self.last_error: Optional[str] = None  # most recent kernel failure
+        # kernel-path breaker: after a batch-wait timeout the batcher
+        # thread may be wedged (stuck XLA compile) — route everything to
+        # the planner immediately, letting one probe through per cooldown
+        # to detect recovery
+        self._tripped = False
+        self._next_probe = 0.0
+        self.probe_cooldown_s = 30.0
 
     def invalidate_index(self, index_name: str) -> None:
         """Drop resident packs of a deleted index (releases HBM breaker
@@ -554,6 +561,12 @@ class TpuSearchService:
             # field has no postings anywhere → zero hits, kernel-free
             self.served += 1
             return FlatQueryResult([], 0, None)
+        if self._tripped:
+            now = time.monotonic()
+            if now < self._next_probe:
+                self.fallback += 1
+                return None
+            self._next_probe = now + self.probe_cooldown_s  # one probe
         # The kernel path is an optional accelerator: any failure here
         # must degrade to the planner, never surface as an error
         # (EnginePlugin seam contract — an engine swap preserves behavior).
@@ -564,24 +577,29 @@ class TpuSearchService:
             # milliseconds
             result = fut.result(timeout=300.0)
         except FuturesTimeout:
-            # a wedged signature must not re-stall every query: trip the
+            # a wedged batcher must not re-stall every query: trip the
             # kernel-path breaker so subsequent queries plan immediately
+            self._tripped = True
+            self._next_probe = time.monotonic() + self.probe_cooldown_s
             self.fallback += 1
             self.timeouts += 1
             self.last_error = "timeout waiting for kernel batch"
-            logger.error("tpu kernel batch timed out; falling back")
+            logger.error("tpu kernel batch timed out; tripping kernel "
+                         "breaker (probe every %.0fs)", self.probe_cooldown_s)
             return None
         except Exception as exc:  # noqa: BLE001 — degrade, never 500
             self.fallback += 1
             self.last_error = f"{type(exc).__name__}: {exc}"
             logger.exception("tpu kernel path failed; falling back")
             return None
+        self._tripped = False  # a completed batch proves the path is live
         self.served += 1
         return result
 
     def stats(self) -> Dict[str, Any]:
         return {"served": self.served, "fallback": self.fallback,
-                "timeouts": self.timeouts, "last_error": self.last_error,
+                "timeouts": self.timeouts, "tripped": self._tripped,
+                "last_error": self.last_error,
                 "batches": self.batcher.batches_executed,
                 "batched_queries": self.batcher.queries_executed}
 

@@ -305,6 +305,62 @@ class TestReviewFindings:
                              k=5)
         assert res is None and tpu.fallback > 0
 
+    def test_kernel_error_falls_back_not_500(self, svc, seeded_np,
+                                             monkeypatch):
+        """An accelerator bug degrades to the planner path, never to an
+        error surfaced at the API (EnginePlugin seam contract)."""
+        from elasticsearch_tpu.search import tpu_service
+        make_corpus(svc, seeded_np, docs=30)
+        monkeypatch.setattr(
+            tpu_service, "execute_flat_batch",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            out = coordinator.search(
+                svc, "corpus", {"query": {"match": {"body": "alpha"}}},
+                tpu_search=tpu)
+            assert tpu.served == 0 and tpu.fallback > 0
+            assert "boom" in (tpu.last_error or "")
+            assert out["hits"]["total"]["value"] >= 0  # planner served it
+        finally:
+            tpu.close()
+
+    def test_timeout_trips_breaker_and_probes(self, svc, seeded_np,
+                                              monkeypatch):
+        """After a batch-wait timeout the kernel breaker routes queries to
+        the planner immediately; one probe per cooldown re-tests the path."""
+        from concurrent.futures import Future
+        idx = make_corpus(svc, seeded_np, docs=20)
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha")
+            hung: Future = Future()  # never resolved → FuturesTimeout
+            monkeypatch.setattr(tpu.batcher, "submit",
+                                lambda *a, **k: hung)
+            monkeypatch.setattr(
+                "elasticsearch_tpu.search.tpu_service.FuturesTimeout",
+                TimeoutError)
+            orig_result = Future.result
+            monkeypatch.setattr(
+                Future, "result",
+                lambda self, timeout=None: (_ for _ in ()).throw(
+                    TimeoutError()) if self is hung
+                else orig_result(self, timeout))
+            assert tpu.try_search(idx, q, k=5) is None
+            assert tpu.timeouts == 1 and tpu.stats()["tripped"]
+            # within cooldown: immediate fallback, no submit
+            calls = []
+            monkeypatch.setattr(tpu.batcher, "submit",
+                                lambda *a, **k: calls.append(1) or hung)
+            assert tpu.try_search(idx, q, k=5) is None
+            assert calls == []  # breaker short-circuited
+            # after cooldown: one probe goes through
+            tpu._next_probe = 0.0
+            assert tpu.try_search(idx, q, k=5) is None
+            assert calls == [1]
+        finally:
+            tpu.close()
+
 
 class TestBlockMaxPruning:
     """Block-max/WAND-analog tests: force truncation with a tiny prefix
