@@ -1,0 +1,169 @@
+"""Shard allocation: decide which node hosts each shard copy.
+
+Reference analog: `cluster/routing/allocation/AllocationService` + the
+decider chain (SURVEY.md §2.1#18, §3.4). Simplified per SURVEY §7.2.7:
+two deciders — SameShardAllocationDecider (a replica never shares a node
+with its primary or another copy) and a balance heuristic (fewest shards
+first, the BalancedShardsAllocator's weight function reduced to shard
+count). The HBM watermark decider hook exists but is node-attr driven.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import (INITIALIZING, STARTED,
+                                             UNASSIGNED, ClusterState,
+                                             ShardRouting)
+
+
+def _fresh_aid() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+class AllocationService:
+    """reroute(state) → state with unassigned copies placed and copies on
+    departed nodes failed over (promote replica / reassign)."""
+
+    def __init__(self, watermark_check=None):
+        # watermark_check(node_id) -> bool (False = don't allocate there);
+        # the HBM-watermark decider seam (SURVEY §7.2.7)
+        self.watermark_check = watermark_check
+
+    def reroute(self, state: ClusterState) -> ClusterState:
+        if not state.indices:
+            return state
+        nodes = list(state.nodes)
+        routing: Dict[str, Dict[int, List[ShardRouting]]] = {
+            idx: {s: list(copies) for s, copies in shards.items()}
+            for idx, shards in state.routing.items()}
+
+        # ensure a routing skeleton exists for every index
+        for name, meta in state.indices.items():
+            shards = routing.setdefault(name, {})
+            for s in range(meta.number_of_shards):
+                copies = shards.setdefault(s, [])
+                if not any(c.primary for c in copies):
+                    copies.insert(0, ShardRouting(name, s, None, True))
+                want_replicas = meta.number_of_replicas
+                have = len([c for c in copies if not c.primary])
+                for _ in range(want_replicas - have):
+                    copies.append(ShardRouting(name, s, None, False))
+                if have > want_replicas:  # replica count lowered: keep
+                    keep = [c for c in copies if c.primary]  # STARTED first
+                    reps = [c for c in copies if not c.primary]
+                    reps.sort(key=lambda c: c.state != STARTED)
+                    keep.extend(reps[:want_replicas])
+                    shards[s] = keep
+        # drop routing for deleted indices
+        for idx in [i for i in routing if i not in state.indices]:
+            del routing[idx]
+
+        # fail copies on departed nodes: promote a started replica to
+        # primary (reference: the in-sync allocation-id promotion path)
+        for idx, shards in routing.items():
+            for s, copies in shards.items():
+                fixed: List[ShardRouting] = []
+                primary_lost = False
+                for c in copies:
+                    if c.node_id is not None and c.node_id not in nodes:
+                        if c.primary:
+                            primary_lost = True
+                        fixed.append(ShardRouting(idx, s, None,
+                                                  c.primary, UNASSIGNED))
+                    else:
+                        fixed.append(c)
+                if primary_lost:
+                    promoted = False
+                    for i, c in enumerate(fixed):
+                        if (not c.primary and c.state == STARTED
+                                and c.node_id in nodes and not promoted):
+                            fixed[i] = ShardRouting(idx, s, c.node_id, True,
+                                                    STARTED, c.allocation_id)
+                            promoted = True
+                    if promoted:
+                        # the old primary slot becomes a plain replica slot
+                        fixed = [ShardRouting(idx, s, None, False, UNASSIGNED)
+                                 if (c.primary and c.node_id is None)
+                                 else c for c in fixed]
+                        # keep exactly one primary
+                        seen_primary = False
+                        dedup: List[ShardRouting] = []
+                        for c in fixed:
+                            if c.primary:
+                                if seen_primary:
+                                    continue
+                                seen_primary = True
+                            dedup.append(c)
+                        fixed = dedup
+                shards[s] = fixed
+
+        # place unassigned copies, fewest-shards-first
+        if nodes:
+            load: Dict[str, int] = {nid: 0 for nid in nodes}
+            for shards in routing.values():
+                for copies in shards.values():
+                    for c in copies:
+                        if c.node_id in load:
+                            load[c.node_id] += 1
+            for idx, shards in sorted(routing.items()):
+                for s, copies in sorted(shards.items()):
+                    taken = {c.node_id for c in copies if c.node_id}
+                    for i, c in enumerate(copies):
+                        if c.node_id is not None:
+                            continue
+                        candidates = [nid for nid in nodes
+                                      if nid not in taken
+                                      and (self.watermark_check is None
+                                           or self.watermark_check(nid))]
+                        if not candidates:
+                            continue  # stays unassigned (yellow/red)
+                        nid = min(candidates, key=lambda n: (load[n], n))
+                        copies[i] = ShardRouting(idx, s, nid, c.primary,
+                                                 INITIALIZING, _fresh_aid())
+                        taken.add(nid)
+                        load[nid] += 1
+
+        return state.with_updates(routing=routing)
+
+    # ---------------- shard state transitions ----------------
+
+    @staticmethod
+    def shard_started(state: ClusterState, index: str, shard: int,
+                      allocation_id: str) -> ClusterState:
+        """reference: ShardStateAction shard-started → routing STARTED."""
+        routing = {idx: {s: list(c) for s, c in sh.items()}
+                   for idx, sh in state.routing.items()}
+        copies = routing.get(index, {}).get(shard)
+        if not copies:
+            return state
+        changed = False
+        for i, c in enumerate(copies):
+            if c.allocation_id == allocation_id and c.state == INITIALIZING:
+                copies[i] = ShardRouting(index, shard, c.node_id, c.primary,
+                                         STARTED, allocation_id)
+                changed = True
+        if not changed:
+            return state
+        return state.with_updates(routing=routing)
+
+    @staticmethod
+    def shard_failed(state: ClusterState, index: str, shard: int,
+                     allocation_id: str) -> ClusterState:
+        """reference: ShardStateAction shard-failed → copy UNASSIGNED
+        (a later reroute re-places it)."""
+        routing = {idx: {s: list(c) for s, c in sh.items()}
+                   for idx, sh in state.routing.items()}
+        copies = routing.get(index, {}).get(shard)
+        if not copies:
+            return state
+        changed = False
+        for i, c in enumerate(copies):
+            if c.allocation_id == allocation_id:
+                copies[i] = ShardRouting(index, shard, None, c.primary,
+                                         UNASSIGNED)
+                changed = True
+        if not changed:
+            return state
+        return state.with_updates(routing=routing)

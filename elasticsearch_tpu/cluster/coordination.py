@@ -1,0 +1,656 @@
+"""Cluster coordination: term-based election + 2-phase state publication.
+
+Reference analog: `cluster/coordination/Coordinator`, `CoordinationState`,
+`JoinHelper`, `FollowersChecker`/`LeadersChecker`, `PublicationTransport
+Handler` (SURVEY.md §2.1#13/#14, §3.4). Per SURVEY §7.2.7 / §7.3#8 the
+full Zen2 reconfiguration machinery is deliberately simplified to a
+single-coordinator quorum design ("don't improvise consensus:
+single-coordinator-with-lease, deterministic-sim tests before any
+multi-host run"):
+
+  - the VOTING CONFIGURATION is fixed at bootstrap (the node *names* in
+    `cluster.initial_master_nodes`) — no dynamic reconfiguration;
+  - elections are Raft-shaped: a candidate bumps its term, votes for
+    itself, and asks every voting node; a vote is granted at most once
+    per term and only to candidates whose accepted state is at least as
+    new (election safety ⇒ state safety, since publication requires the
+    same quorum);
+  - publication is the reference's 2-phase commit: PUBLISH (nodes
+    persist the accepted state) → quorum of voting acks → COMMIT (nodes
+    apply). No quorum ⇒ FailedToCommit ⇒ the leader steps down;
+  - liveness: leader pings followers (FollowersChecker analog); a
+    follower missing `fault_ticks` consecutive rounds is removed from
+    the state. Followers track leader pings (LeadersChecker analog) and
+    re-elect on silence.
+
+Everything is event-driven against injected `transport`/`scheduler`
+seams so tests/sim_cluster.py can run whole clusters deterministically
+(the reference's DeterministicTaskQueue + CoordinatorTests pattern,
+SURVEY §4.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.cluster.state import (ClusterState, DiscoveryNode,
+                                             is_quorum)
+
+logger = logging.getLogger("elasticsearch_tpu.cluster")
+
+# action names (reference: internal:cluster/coordination/*)
+ACTION_DISCOVER = "cluster/coord/discover"
+ACTION_VOTE = "cluster/coord/request_vote"
+ACTION_PUBLISH = "cluster/coord/publish"
+ACTION_COMMIT = "cluster/coord/commit"
+ACTION_JOIN = "cluster/coord/join"
+ACTION_PING = "cluster/coord/ping"
+
+CANDIDATE, LEADER, FOLLOWER = "CANDIDATE", "LEADER", "FOLLOWER"
+
+
+class FailedToCommitException(Exception):
+    """Publication could not reach a voting quorum (reference:
+    FailedToCommitClusterStateException)."""
+
+
+class NotMasterException(Exception):
+    pass
+
+
+class Coordinator:
+    """One node's coordination endpoint.
+
+    Seams (all injectable for the deterministic sim):
+      transport.send(address, action, payload, on_done(ok, result))
+      transport.register(action, handler(payload, from_node) -> payload)
+      scheduler.schedule(delay_s, fn) -> handle with .cancel()
+      persisted.load() -> Optional[dict] / persisted.store(dict)
+    `on_commit(ClusterState)` delivers every committed state to the
+    applier layer (cluster/service.py).
+    """
+
+    def __init__(self, local_node: DiscoveryNode, *, transport, scheduler,
+                 persisted, on_commit: Callable[[ClusterState], None],
+                 seed_addresses: List[Tuple[str, int]],
+                 initial_master_names: List[str],
+                 cluster_uuid: str = "_na_",
+                 election_min_s: float = 0.5, election_max_s: float = 1.0,
+                 heartbeat_s: float = 0.3, publish_timeout_s: float = 5.0,
+                 fault_ticks: int = 3,
+                 rng: Optional[random.Random] = None):
+        self.local = local_node
+        self.transport = transport
+        self.scheduler = scheduler
+        self.persisted = persisted
+        self.on_commit = on_commit
+        self.seed_addresses = [tuple(a) for a in seed_addresses]
+        self.initial_master_names = list(initial_master_names)
+        self.election_min_s = election_min_s
+        self.election_max_s = election_max_s
+        self.heartbeat_s = heartbeat_s
+        self.publish_timeout_s = publish_timeout_s
+        self.fault_ticks = fault_ticks
+        self.rng = rng or random.Random()
+
+        self.lock = threading.RLock()
+        self.mode = CANDIDATE
+        self.current_term = 0
+        self.last_vote_term = 0      # granted at most one vote per term
+        self.accepted: ClusterState = ClusterState.empty(cluster_uuid)
+        self.committed: ClusterState = ClusterState.empty(cluster_uuid)
+        self._restore_persisted()
+        self.leader_id: Optional[str] = None
+        self._election_timer = None
+        self._heartbeat_timer = None
+        self._join_inflight = False
+        self._failure_counts: Dict[str, int] = {}
+        self._stopped = False
+        # master-service task queue (single-threaded semantics: one
+        # publication in flight at a time; reference: MasterService)
+        self._publishing = False
+        self._task_queue: List[Tuple[str, Callable[[ClusterState],
+                                                   ClusterState],
+                                     Callable]] = []
+
+        for action, handler in (
+                (ACTION_DISCOVER, self.handle_discover),
+                (ACTION_VOTE, self.handle_vote),
+                (ACTION_PUBLISH, self.handle_publish),
+                (ACTION_COMMIT, self.handle_commit),
+                (ACTION_JOIN, self.handle_join),
+                (ACTION_PING, self.handle_ping)):
+            transport.register(action, handler)
+
+    # ------------------------------------------------------------------
+    # persistence of the accepted state (reference: GatewayMetaState —
+    # must survive restart for vote/accept safety)
+    # ------------------------------------------------------------------
+
+    def _restore_persisted(self) -> None:
+        data = self.persisted.load()
+        if not data:
+            return
+        self.current_term = int(data.get("current_term", 0))
+        self.last_vote_term = int(data.get("last_vote_term", 0))
+        if data.get("accepted"):
+            self.accepted = ClusterState.from_json(data["accepted"])
+            self.committed = self.accepted  # best effort: replay to last accepted
+
+    def _persist(self) -> None:
+        self.persisted.store({
+            "current_term": self.current_term,
+            "last_vote_term": self.last_vote_term,
+            "accepted": self.accepted.to_json()})
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        with self.lock:
+            self._schedule_election()
+
+    def stop(self) -> None:
+        with self.lock:
+            self._stopped = True
+            for t in (self._election_timer, self._heartbeat_timer):
+                if t is not None:
+                    t.cancel()
+
+    # ------------------------------------------------------------------
+    # candidate: discovery + election
+    # ------------------------------------------------------------------
+
+    def _schedule_election(self) -> None:
+        if self._election_timer is not None:
+            self._election_timer.cancel()
+        delay = self.rng.uniform(self.election_min_s, self.election_max_s)
+        self._election_timer = self.scheduler.schedule(delay,
+                                                       self._election_tick)
+
+    def _election_tick(self) -> None:
+        """Discovery-then-election, fully async (never blocks — the
+        deterministic sim runs single-threaded). Ask every seed who the
+        master is (reference: PeerFinder); join one if found, else run an
+        election after a short discovery window."""
+        with self.lock:
+            if self._stopped or self.mode == LEADER:
+                return
+            if self.mode == FOLLOWER:
+                # LeadersChecker analog: this tick only fires when the
+                # leader went silent past the election timeout
+                self.mode = CANDIDATE
+                self.leader_id = None
+            self._schedule_election()  # retry cadence until settled
+            found_master = [False]
+
+            def on_discover(ok: bool, result: Any) -> None:
+                if not ok or not result or not result.get("master"):
+                    return
+                with self.lock:
+                    if self._stopped or self.mode != CANDIDATE \
+                            or found_master[0]:
+                        return
+                    master = DiscoveryNode.from_json(result["master"])
+                    if master.node_id == self.local.node_id:
+                        return
+                    found_master[0] = True
+                    self._send_join(master)
+
+            targets = [a for a in self.seed_addresses
+                       if a != self.local.address]
+            for addr in targets:
+                self.transport.send(addr, ACTION_DISCOVER, {}, on_discover)
+
+            def decide() -> None:
+                with self.lock:
+                    if (self._stopped or self.mode != CANDIDATE
+                            or found_master[0]):
+                        return
+                self._maybe_run_election()
+
+            self.scheduler.schedule(
+                self.election_min_s / 2 if targets else 0.0, decide)
+
+    def _maybe_run_election(self) -> None:
+        with self.lock:
+            if self._stopped or self.mode != CANDIDATE:
+                return
+            if self.local.name not in self.initial_master_names:
+                return  # not master-eligible for bootstrap
+            self.current_term += 1
+            self.last_vote_term = self.current_term  # vote for self
+            self._persist()
+            term = self.current_term
+            voting = tuple(self.initial_master_names)
+            votes = {self.local.name}
+            # granting voters' identities seed the leader's node list (the
+            # reference gets this from join requests; here votes ARE the
+            # bootstrap joins, so the first publication reaches a quorum)
+            self._voters: Dict[str, DiscoveryNode] = {
+                self.local.node_id: self.local}
+            req = {"term": term,
+                   "last_accepted_term": self.accepted.term,
+                   "last_accepted_version": self.accepted.version,
+                   "candidate": self.local.to_json()}
+
+        def on_vote(ok: bool, result: Any) -> None:
+            if not ok or not result:
+                return
+            with self.lock:
+                if (self._stopped or self.mode != CANDIDATE
+                        or self.current_term != term):
+                    return
+                if result.get("granted"):
+                    votes.add(result["voter_name"])
+                    if result.get("voter"):
+                        voter = DiscoveryNode.from_json(result["voter"])
+                        self._voters[voter.node_id] = voter
+                    if is_quorum(len([v for v in votes if v in voting]),
+                                 voting):
+                        self._become_leader(term)
+                elif result.get("term", 0) > self.current_term:
+                    self.current_term = int(result["term"])
+                    self._persist()
+
+        for addr in self.seed_addresses:
+            if addr == self.local.address:
+                continue
+            self.transport.send(addr, ACTION_VOTE, req, on_vote)
+        # single-node voting config: immediate quorum
+        with self.lock:
+            if (self.mode == CANDIDATE and self.current_term == term
+                    and is_quorum(len([v for v in votes if v in voting]),
+                                  voting)):
+                self._become_leader(term)
+
+    def handle_vote(self, payload: Dict[str, Any],
+                    from_node: Dict[str, Any]) -> Dict[str, Any]:
+        with self.lock:
+            term = int(payload["term"])
+            if term <= self.last_vote_term or term < self.current_term:
+                return {"granted": False, "term": self.current_term,
+                        "voter_name": self.local.name}
+            # election safety: only vote for candidates whose accepted
+            # state is at least as new as ours
+            ours = (self.accepted.term, self.accepted.version)
+            theirs = (int(payload["last_accepted_term"]),
+                      int(payload["last_accepted_version"]))
+            if theirs < ours:
+                return {"granted": False, "term": self.current_term,
+                        "voter_name": self.local.name}
+            self.last_vote_term = term
+            if term > self.current_term:
+                self.current_term = term
+                if self.mode == LEADER:
+                    self._step_down("saw vote request with higher term")
+            self._persist()
+            # granting a vote backs off our own election timer so the
+            # winner gets a quiet window to publish (Raft's timer reset)
+            if self.mode != LEADER:
+                self._schedule_election()
+            return {"granted": True, "term": self.current_term,
+                    "voter_name": self.local.name,
+                    "voter": self.local.to_json()}
+
+    def handle_discover(self, payload: Dict[str, Any],
+                        from_node: Dict[str, Any]) -> Dict[str, Any]:
+        with self.lock:
+            master = None
+            if self.mode == LEADER:
+                master = self.local.to_json()
+            elif self.leader_id and self.leader_id in self.committed.nodes:
+                master = self.committed.nodes[self.leader_id].to_json()
+            return {"master": master, "term": self.current_term}
+
+    # ------------------------------------------------------------------
+    # leader
+    # ------------------------------------------------------------------
+
+    def _become_leader(self, term: int) -> None:
+        # caller holds self.lock
+        self.mode = LEADER
+        self.leader_id = self.local.node_id
+        self._failure_counts = {}
+        logger.info("[%s] elected leader, term %d", self.local.name, term)
+        if self._election_timer is not None:
+            self._election_timer.cancel()
+
+        def initial_update(state: ClusterState) -> ClusterState:
+            nodes = dict(state.nodes)
+            nodes[self.local.node_id] = self.local
+            return state.with_updates(
+                nodes=nodes, master_node_id=self.local.node_id,
+                voting_config=tuple(self.initial_master_names))
+
+        self.submit_state_update(initial_update, source="become-leader")
+        self._schedule_heartbeat()
+
+    def _step_down(self, reason: str) -> None:
+        # caller holds self.lock
+        if self.mode == LEADER:
+            logger.info("[%s] stepping down: %s", self.local.name, reason)
+        self.mode = CANDIDATE
+        self.leader_id = None
+        self._publishing = False
+        if self._heartbeat_timer is not None:
+            self._heartbeat_timer.cancel()
+        self._schedule_election()
+
+    # ---------------- master service (state update queue) --------------
+
+    def submit_state_update(
+            self, update: Callable[[ClusterState], ClusterState],
+            source: str = "",
+            on_done: Optional[Callable[[Optional[Exception]], None]] = None
+    ) -> None:
+        """Queue ClusterState' = f(ClusterState); publications run one at
+        a time in submit order (reference: MasterService single thread)."""
+        with self.lock:
+            if self.mode != LEADER:
+                if on_done:
+                    on_done(NotMasterException(
+                        f"[{self.local.name}] is not the master"))
+                return
+            self._task_queue.append((source, update, on_done))
+            self._drain_tasks()
+
+    def _drain_tasks(self) -> None:
+        # caller holds self.lock
+        if self._publishing or not self._task_queue:
+            return
+        source, update, on_done = self._task_queue.pop(0)
+        base = self.committed
+        try:
+            new_state = update(base)
+        except Exception as e:  # noqa: BLE001 — task error, not fatal
+            logger.warning("state update [%s] failed: %s", source, e)
+            if on_done:
+                on_done(e)
+            self.scheduler.schedule(0.0, self._drain_tasks_locked)
+            return
+        if new_state is base or new_state is None:
+            if on_done:
+                on_done(None)
+            self.scheduler.schedule(0.0, self._drain_tasks_locked)
+            return
+        new_state = new_state.with_updates(
+            term=self.current_term, version=base.version + 1,
+            master_node_id=self.local.node_id)
+        self._publishing = True
+        self._publish(new_state, on_done)
+
+    def _drain_tasks_locked(self) -> None:
+        with self.lock:
+            self._drain_tasks()
+
+    def _publish(self, state: ClusterState,
+                 on_done: Optional[Callable]) -> None:
+        # caller holds self.lock; 2-phase commit over the transport
+        term, version = state.term, state.version
+        voting = state.voting_config or tuple(self.initial_master_names)
+        state_json = state.to_json()
+        acks = {self.local.name}
+        targets = [n for n in state.nodes.values()
+                   if n.node_id != self.local.node_id]
+        committed = [False]
+
+        # leader accepts its own publication first
+        self.accepted = state
+        self._persist()
+
+        def maybe_commit() -> None:
+            # caller holds self.lock; only VOTING nodes' acks count
+            voting_acks = len([a for a in acks if a in voting])
+            if committed[0] or not is_quorum(voting_acks, voting):
+                return
+            committed[0] = True
+            timeout_handle.cancel()
+            self._commit_locally(state)
+            for n in targets:
+                self.transport.send(n.address, ACTION_COMMIT,
+                                    {"term": term, "version": version},
+                                    lambda ok, r: None)
+            self._publishing = False
+            if on_done:
+                on_done(None)
+            self._drain_tasks()
+
+        def on_ack(ok: bool, result: Any) -> None:
+            if not ok or not result:
+                return
+            with self.lock:
+                if self._stopped or self.mode != LEADER:
+                    return
+                if result.get("accepted"):
+                    acks.add(result["node_name"])
+                    maybe_commit()
+
+        def on_timeout() -> None:
+            with self.lock:
+                if committed[0] or self._stopped:
+                    return
+                self._publishing = False
+                logger.warning("[%s] publish (%d,%d) failed to commit: "
+                               "%d/%d acks", self.local.name, term, version,
+                               len(acks), len(voting))
+                self._step_down("failed to commit publication")
+                if on_done:
+                    on_done(FailedToCommitException(
+                        f"publication ({term},{version}) got "
+                        f"{len(acks)} of {len(voting)} voting acks"))
+
+        timeout_handle = self.scheduler.schedule(self.publish_timeout_s,
+                                                 on_timeout)
+        for n in targets:
+            self.transport.send(n.address, ACTION_PUBLISH,
+                                {"state": state_json}, on_ack)
+        maybe_commit()  # single-node cluster: self-ack is a quorum
+
+    def _commit_locally(self, state: ClusterState) -> None:
+        # caller holds self.lock
+        self.committed = state
+        self.leader_id = state.master_node_id
+        try:
+            self.on_commit(state)
+        except Exception:  # noqa: BLE001 — applier bug must not kill coord
+            logger.exception("cluster state applier failed")
+
+    # ---------------- publication, receiver side ----------------
+
+    def handle_publish(self, payload: Dict[str, Any],
+                       from_node: Dict[str, Any]) -> Dict[str, Any]:
+        state = ClusterState.from_json(payload["state"])
+        with self.lock:
+            if state.term < self.current_term:
+                return {"accepted": False, "term": self.current_term,
+                        "node_name": self.local.name}
+            new = (state.term, state.version)
+            ours = (self.accepted.term, self.accepted.version)
+            if new <= ours:
+                return {"accepted": False, "term": self.current_term,
+                        "node_name": self.local.name}
+            if state.term > self.current_term:
+                self.current_term = state.term
+            if self.mode == LEADER and state.master_node_id != \
+                    self.local.node_id:
+                self._step_down("accepted publication from other master")
+            self.accepted = state
+            self._persist()
+            self._on_leader_contact(state.master_node_id)
+            return {"accepted": True, "term": self.current_term,
+                    "node_name": self.local.name}
+
+    def handle_commit(self, payload: Dict[str, Any],
+                      from_node: Dict[str, Any]) -> Dict[str, Any]:
+        with self.lock:
+            term, version = int(payload["term"]), int(payload["version"])
+            if (self.accepted.term, self.accepted.version) == (term, version):
+                self._commit_locally(self.accepted)
+                self._on_leader_contact(self.accepted.master_node_id)
+            return {}
+
+    # ---------------- join ----------------
+
+    def _send_join(self, master: DiscoveryNode) -> None:
+        # caller holds self.lock
+        if self._join_inflight:
+            return
+        self._join_inflight = True
+
+        def on_join(ok: bool, result: Any) -> None:
+            with self.lock:
+                self._join_inflight = False
+                # success is observed via the publication that follows
+
+        self.transport.send(master.address, ACTION_JOIN,
+                            {"node": self.local.to_json()}, on_join)
+
+    def handle_join(self, payload: Dict[str, Any],
+                    from_node: Dict[str, Any]) -> Dict[str, Any]:
+        node = DiscoveryNode.from_json(payload["node"])
+        with self.lock:
+            if self.mode != LEADER:
+                raise NotMasterException(
+                    f"[{self.local.name}] is not the master")
+
+            def add_node(state: ClusterState) -> ClusterState:
+                if state.nodes.get(node.node_id) == node:
+                    return state
+                nodes = dict(state.nodes)
+                nodes[node.node_id] = node
+                return state.with_updates(nodes=nodes)
+
+            self.submit_state_update(add_node, source=f"join[{node.name}]")
+            return {"accepted": True}
+
+    # ---------------- liveness ----------------
+
+    def _schedule_heartbeat(self) -> None:
+        if self._heartbeat_timer is not None:
+            self._heartbeat_timer.cancel()
+        self._heartbeat_timer = self.scheduler.schedule(
+            self.heartbeat_s, self._heartbeat_tick)
+
+    def _heartbeat_tick(self) -> None:
+        with self.lock:
+            if self._stopped or self.mode != LEADER:
+                return
+            targets = [n for n in self.committed.nodes.values()
+                       if n.node_id != self.local.node_id]
+            term = self.current_term
+            reachable_voting = {self.local.name}
+            pending = [len(targets)]
+
+            def finish_round() -> None:
+                # caller holds self.lock
+                if self.mode != LEADER or self._stopped:
+                    return
+                voting = (self.committed.voting_config
+                          or tuple(self.initial_master_names))
+                if not is_quorum(len([v for v in reachable_voting
+                                      if v in voting]), voting):
+                    self._step_down("lost contact with voting quorum")
+                    return
+                removals = [nid for nid, c in self._failure_counts.items()
+                            if c >= self.fault_ticks
+                            and nid in self.committed.nodes]
+                if removals:
+                    self._remove_nodes(removals)
+                self._schedule_heartbeat()
+
+            def on_pong(node: DiscoveryNode):
+                def cb(ok: bool, result: Any) -> None:
+                    with self.lock:
+                        if self._stopped or self.mode != LEADER \
+                                or self.current_term != term:
+                            return
+                        if ok and result:
+                            if result.get("term", 0) > term:
+                                self.current_term = int(result["term"])
+                                self._persist()
+                                self._step_down("pinged node has higher term")
+                                return
+                            self._failure_counts.pop(node.node_id, None)
+                            reachable_voting.add(node.name)
+                        else:
+                            self._failure_counts[node.node_id] = \
+                                self._failure_counts.get(node.node_id, 0) + 1
+                        pending[0] -= 1
+                        if pending[0] <= 0:
+                            finish_round()
+                return cb
+
+            if not targets:
+                finish_round()
+                return
+            for n in targets:
+                self.transport.send(n.address, ACTION_PING,
+                                    {"term": term,
+                                     "master": self.local.to_json()},
+                                    on_pong(n))
+
+    def handle_ping(self, payload: Dict[str, Any],
+                    from_node: Dict[str, Any]) -> Dict[str, Any]:
+        with self.lock:
+            term = int(payload["term"])
+            if term >= self.current_term:
+                master = DiscoveryNode.from_json(payload["master"])
+                if term > self.current_term:
+                    self.current_term = term
+                    self._persist()
+                if self.mode == LEADER and \
+                        master.node_id != self.local.node_id:
+                    self._step_down("pinged by leader with ≥ term")
+                self._on_leader_contact(master.node_id)
+            return {"term": self.current_term}
+
+    def _on_leader_contact(self, leader_id: Optional[str]) -> None:
+        # caller holds self.lock — any pub/ping from the leader resets
+        # the election clock (LeadersChecker analog)
+        if leader_id is None or leader_id == self.local.node_id:
+            return
+        self.leader_id = leader_id
+        if self.mode != LEADER:
+            self.mode = FOLLOWER
+            self._schedule_election()  # re-arm: fires only on silence
+
+    def _remove_nodes(self, node_ids: List[str]) -> None:
+        # caller holds self.lock
+        for nid in node_ids:
+            self._failure_counts.pop(nid, None)
+
+        def update(state: ClusterState) -> ClusterState:
+            nodes = {nid: n for nid, n in state.nodes.items()
+                     if nid not in node_ids}
+            if nodes == state.nodes:
+                return state
+            return state.with_updates(nodes=nodes)
+
+        names = [self.committed.nodes[nid].name for nid in node_ids
+                 if nid in self.committed.nodes]
+        logger.info("[%s] removing unreachable nodes %s",
+                    self.local.name, names)
+        self.submit_state_update(update, source=f"node-left{names}")
+
+    # ---------------- introspection ----------------
+
+    def is_master(self) -> bool:
+        with self.lock:
+            return self.mode == LEADER
+
+    def master_node(self) -> Optional[DiscoveryNode]:
+        with self.lock:
+            if self.leader_id:
+                if self.leader_id == self.local.node_id:
+                    return self.local
+                return self.committed.nodes.get(self.leader_id)
+            return None
+
+    def state(self) -> ClusterState:
+        with self.lock:
+            return self.committed

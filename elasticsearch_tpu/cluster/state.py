@@ -1,0 +1,185 @@
+"""Cluster state model: nodes, index metadata, shard routing.
+
+Reference analog: `cluster/ClusterState`, `cluster/metadata/Metadata` /
+`IndexMetadata`, `cluster/routing/RoutingTable` / `ShardRouting`,
+`cluster/node/DiscoveryNode(s)` (SURVEY.md §2.1#12, §3.4). The state is
+a versioned immutable value published by the elected coordinator and
+applied by every node; it is small (JSON, full-state publication — the
+reference's Diff<ClusterState> optimization is skipped at this scale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+# shard lifecycle (reference: ShardRoutingState)
+UNASSIGNED = "UNASSIGNED"
+INITIALIZING = "INITIALIZING"
+STARTED = "STARTED"
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscoveryNode:
+    """A node identity + its transport address (reference: DiscoveryNode)."""
+
+    node_id: str
+    name: str
+    host: str
+    port: int          # transport port
+    http_port: int = 0
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "DiscoveryNode":
+        return DiscoveryNode(node_id=d["node_id"], name=d["name"],
+                             host=d["host"], port=int(d["port"]),
+                             http_port=int(d.get("http_port", 0)))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardRouting:
+    """One shard copy's assignment (reference: ShardRouting)."""
+
+    index: str
+    shard: int
+    node_id: Optional[str]     # None ⇔ UNASSIGNED
+    primary: bool
+    state: str = UNASSIGNED
+    allocation_id: str = ""    # fresh per (re)assignment
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ShardRouting":
+        return ShardRouting(index=d["index"], shard=int(d["shard"]),
+                            node_id=d.get("node_id"),
+                            primary=bool(d["primary"]),
+                            state=d.get("state", UNASSIGNED),
+                            allocation_id=d.get("allocation_id", ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexMeta:
+    """Reference: IndexMetadata — settings + mapping + shard counts."""
+
+    name: str
+    uuid: str
+    settings: Dict[str, Any]
+    mapping: Optional[Dict[str, Any]]
+    number_of_shards: int
+    number_of_replicas: int
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "IndexMeta":
+        return IndexMeta(name=d["name"], uuid=d["uuid"],
+                         settings=d.get("settings") or {},
+                         mapping=d.get("mapping"),
+                         number_of_shards=int(d["number_of_shards"]),
+                         number_of_replicas=int(d["number_of_replicas"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterState:
+    """The versioned published value (reference: ClusterState).
+
+    `term` is the coordinator's election term; `version` increases by one
+    per committed update within a term. Publication safety: nodes accept
+    (term, version) only if newer than their last-accepted pair."""
+
+    cluster_uuid: str
+    term: int
+    version: int
+    master_node_id: Optional[str]
+    nodes: Dict[str, DiscoveryNode]
+    indices: Dict[str, IndexMeta]
+    # index → shard → [ShardRouting] (primary first by convention)
+    routing: Dict[str, Dict[int, List[ShardRouting]]]
+    # node_ids eligible to vote (reference: VotingConfiguration)
+    voting_config: Tuple[str, ...] = ()
+
+    # -------------- queries --------------
+
+    def shard_copies(self, index: str, shard: int) -> List[ShardRouting]:
+        return self.routing.get(index, {}).get(shard, [])
+
+    def primary(self, index: str, shard: int) -> Optional[ShardRouting]:
+        for r in self.shard_copies(index, shard):
+            if r.primary:
+                return r
+        return None
+
+    def node_shards(self, node_id: str) -> List[ShardRouting]:
+        out = []
+        for shards in self.routing.values():
+            for copies in shards.values():
+                out.extend(r for r in copies if r.node_id == node_id)
+        return out
+
+    def data_nodes(self) -> List[DiscoveryNode]:
+        return sorted(self.nodes.values(), key=lambda n: n.node_id)
+
+    # -------------- evolution --------------
+
+    def with_updates(self, **kwargs) -> "ClusterState":
+        return dataclasses.replace(self, **kwargs)
+
+    def next_version(self) -> "ClusterState":
+        return dataclasses.replace(self, version=self.version + 1)
+
+    # -------------- wire --------------
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "cluster_uuid": self.cluster_uuid,
+            "term": self.term,
+            "version": self.version,
+            "master_node_id": self.master_node_id,
+            "nodes": {nid: n.to_json() for nid, n in self.nodes.items()},
+            "indices": {n: m.to_json() for n, m in self.indices.items()},
+            "routing": {
+                idx: {str(s): [r.to_json() for r in copies]
+                      for s, copies in shards.items()}
+                for idx, shards in self.routing.items()},
+            "voting_config": list(self.voting_config),
+        }
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ClusterState":
+        return ClusterState(
+            cluster_uuid=d["cluster_uuid"],
+            term=int(d["term"]),
+            version=int(d["version"]),
+            master_node_id=d.get("master_node_id"),
+            nodes={nid: DiscoveryNode.from_json(n)
+                   for nid, n in (d.get("nodes") or {}).items()},
+            indices={n: IndexMeta.from_json(m)
+                     for n, m in (d.get("indices") or {}).items()},
+            routing={idx: {int(s): [ShardRouting.from_json(r)
+                                    for r in copies]
+                           for s, copies in shards.items()}
+                     for idx, shards in (d.get("routing") or {}).items()},
+            voting_config=tuple(d.get("voting_config") or ()),
+        )
+
+    @staticmethod
+    def empty(cluster_uuid: str = "_na_") -> "ClusterState":
+        return ClusterState(cluster_uuid=cluster_uuid, term=0, version=0,
+                            master_node_id=None, nodes={}, indices={},
+                            routing={})
+
+
+def is_quorum(votes: int, voting_config: Tuple[str, ...]) -> bool:
+    """Majority of the voting configuration (reference:
+    CoordinationState#isElectionQuorum)."""
+    return votes * 2 > len(voting_config)

@@ -1,0 +1,19 @@
+"""Host-tier RPC (the DCN tier of the two-tier comms design).
+
+Reference analog: `transport/TransportService` + the Netty4 module
+(SURVEY.md §2.1#7/#8, §5.8). The data-plane reduce rides XLA collectives
+over ICI (parallel/distributed.py); this package carries everything
+inherently host-side: cluster coordination, CRUD replication fan-out,
+scatter-gather search between processes, and recovery file/ops shipping.
+
+Kept from the reference: action-name routing, request/response
+correlation, per-request timeouts, typed error propagation. Dropped:
+custom wire framing beyond a length prefix (payloads are JSON; bulk
+recovery chunks embed base64 — SURVEY §7.4 licenses skipping the
+reference's custom framing).
+"""
+
+from elasticsearch_tpu.transport.service import (RemoteTransportException,
+                                                 TransportService)
+
+__all__ = ["TransportService", "RemoteTransportException"]

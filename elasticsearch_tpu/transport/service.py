@@ -1,0 +1,294 @@
+"""TransportService — length-prefixed JSON RPC over TCP.
+
+Reference analog: `transport/TransportService` + `TcpTransport`
+(SURVEY.md §2.1#7, §3.4/§3.5 RPC hops). Same contract, slim wire:
+
+  frame   := 4-byte big-endian length + utf-8 JSON object
+  request := {"t":"q","id":N,"action":S,"payload":obj,"from":node}
+  reply   := {"t":"r","id":N,"ok":true,"payload":obj}
+           | {"t":"r","id":N,"ok":false,"error":{"type":S,"reason":S}}
+
+One pooled connection per target address carries interleaved requests;
+responses correlate by id (the reference's TransportResponseHandler
+registry). Handlers run on a bounded executor (the reference's
+threadpool dispatch, SURVEY §5.8 "backpressure via bounded executors").
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger("elasticsearch_tpu.transport")
+
+Address = Tuple[str, int]
+Handler = Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]]
+
+_MAX_FRAME = 256 << 20  # recovery chunks are ≤1MB base64; hard safety cap
+
+
+class RemoteTransportException(Exception):
+    """A handler on the remote node raised; carries its error type."""
+
+    def __init__(self, error_type: str, reason: str):
+        super().__init__(f"[{error_type}] {reason}")
+        self.error_type = error_type
+        self.reason = reason
+
+
+class ConnectTransportException(Exception):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _read_frame(sock: socket.socket) -> Dict[str, Any]:
+    (length,) = struct.unpack(">I", _read_exact(sock, 4))
+    if length > _MAX_FRAME:
+        raise ConnectionError(f"frame of {length} bytes exceeds cap")
+    return json.loads(_read_exact(sock, length).decode("utf-8"))
+
+
+def _frame(obj: Dict[str, Any]) -> bytes:
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    return struct.pack(">I", len(data)) + data
+
+
+class _Connection:
+    """One outbound socket: serialized writes, a reader thread resolving
+    response futures by correlation id."""
+
+    def __init__(self, address: Address, timeout: float):
+        self.address = address
+        try:
+            self.sock = socket.create_connection(address, timeout=timeout)
+        except OSError as e:
+            raise ConnectTransportException(
+                f"connect to {address} failed: {e}") from e
+        self.sock.settimeout(None)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._write_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def send(self, msg: Dict[str, Any], fut: Future) -> None:
+        with self._pending_lock:
+            if self._closed:
+                raise ConnectionError("connection closed")
+            self._pending[msg["id"]] = fut
+        try:
+            with self._write_lock:
+                self.sock.sendall(_frame(msg))
+        except OSError as e:
+            self._fail_all(e)
+            raise
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = _read_frame(self.sock)
+                fut = None
+                with self._pending_lock:
+                    fut = self._pending.pop(msg.get("id"), None)
+                if fut is None or fut.done():
+                    continue
+                if msg.get("ok"):
+                    fut.set_result(msg.get("payload"))
+                else:
+                    err = msg.get("error") or {}
+                    fut.set_exception(RemoteTransportException(
+                        err.get("type", "unknown"),
+                        err.get("reason", "unknown")))
+        except (ConnectionError, OSError, json.JSONDecodeError) as e:
+            self._fail_all(e)
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._pending_lock:
+            self._closed = True
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError(str(exc)))
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._fail_all(ConnectionError("closed"))
+
+
+class TransportService:
+    """Action-name RPC endpoint: `register_handler` + `send_request`.
+
+    `local_node` is an opaque identity dict included with every request
+    (the reference's DiscoveryNode on the wire) so handlers know the
+    caller without a separate handshake round-trip."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 local_node: Optional[Dict[str, Any]] = None,
+                 handler_threads: int = 8):
+        self.host = host
+        self.port = port
+        self.local_node = local_node or {}
+        self._handlers: Dict[str, Handler] = {}
+        self._server_sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._executor = ThreadPoolExecutor(
+            max_workers=handler_threads,
+            thread_name_prefix="transport-handler")
+        self._conns: Dict[Address, _Connection] = {}
+        self._conns_lock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._closed = False
+        # counters (surface in node stats)
+        self.rx_count = 0
+        self.tx_count = 0
+
+    # ------------- registry -------------
+
+    def register_handler(self, action: str, handler: Handler) -> None:
+        if action in self._handlers:
+            raise ValueError(f"handler for [{action}] already registered")
+        self._handlers[action] = handler
+
+    # ------------- server side -------------
+
+    def start(self) -> None:
+        self._server_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server_sock.bind((self.host, self.port))
+        self.port = self._server_sock.getsockname()[1]
+        self._server_sock.listen(128)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def bound_address(self) -> Address:
+        return (self.host, self.port)
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._server_sock.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        write_lock = threading.Lock()
+        try:
+            while True:
+                msg = _read_frame(sock)
+                if msg.get("t") != "q":
+                    continue
+                self.rx_count += 1
+                self._executor.submit(self._dispatch, sock, write_lock, msg)
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, sock: socket.socket, write_lock: threading.Lock,
+                  msg: Dict[str, Any]) -> None:
+        action = msg.get("action", "")
+        handler = self._handlers.get(action)
+        if handler is None:
+            reply = {"t": "r", "id": msg["id"], "ok": False,
+                     "error": {"type": "action_not_found",
+                               "reason": f"no handler for [{action}]"}}
+        else:
+            try:
+                payload = handler(msg.get("payload") or {},
+                                  msg.get("from") or {})
+                reply = {"t": "r", "id": msg["id"], "ok": True,
+                         "payload": payload}
+            except Exception as e:  # noqa: BLE001 — typed error to caller
+                logger.debug("handler [%s] failed", action, exc_info=True)
+                reply = {"t": "r", "id": msg["id"], "ok": False,
+                         "error": {"type": type(e).__name__, "reason": str(e)}}
+        try:
+            with write_lock:
+                sock.sendall(_frame(reply))
+        except OSError:
+            pass
+
+    # ------------- client side -------------
+
+    def _connection(self, address: Address,
+                    connect_timeout: float) -> _Connection:
+        address = (address[0], int(address[1]))
+        with self._conns_lock:
+            conn = self._conns.get(address)
+            if conn is not None and not conn.closed:
+                return conn
+            conn = _Connection(address, timeout=connect_timeout)
+            self._conns[address] = conn
+            return conn
+
+    def send_request_async(self, address: Address, action: str,
+                           payload: Dict[str, Any],
+                           connect_timeout: float = 5.0) -> Future:
+        """Fire a request; the Future resolves with the response payload
+        or raises RemoteTransportException / ConnectionError."""
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        fut: Future = Future()
+        msg = {"t": "q", "id": rid, "action": action, "payload": payload,
+               "from": self.local_node}
+        try:
+            conn = self._connection(address, connect_timeout)
+            conn.send(msg, fut)
+            self.tx_count += 1
+        except (ConnectionError, OSError, ConnectTransportException) as e:
+            if not fut.done():
+                fut.set_exception(
+                    e if isinstance(e, ConnectTransportException)
+                    else ConnectionError(str(e)))
+        return fut
+
+    def send_request(self, address: Address, action: str,
+                     payload: Dict[str, Any],
+                     timeout: float = 30.0) -> Dict[str, Any]:
+        return self.send_request_async(address, action, payload).result(
+            timeout=timeout)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            conn.close()
+        self._executor.shutdown(wait=False)

@@ -1,0 +1,209 @@
+"""Deterministic cluster simulation harness.
+
+Ports the reference test-framework ideas (SURVEY.md §4.2):
+`DeterministicTaskQueue` — virtual time, seeded ordering, no real
+threads — and the `CoordinatorTests`/`AbstractCoordinatorTestCase`
+pattern: whole clusters of real Coordinator instances wired over an
+in-memory transport with controllable delays, drops, and partitions.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.cluster.coordination import Coordinator
+from elasticsearch_tpu.cluster.state import DiscoveryNode
+
+Address = Tuple[str, int]
+
+
+class _TaskHandle:
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class DeterministicTaskQueue:
+    """Virtual-time scheduler: tasks run in (time, insertion) order."""
+
+    def __init__(self):
+        self._now = 0.0
+        self._seq = itertools.count()
+        self._heap: List[Tuple[float, int, _TaskHandle, Callable]] = []
+
+    def now(self) -> float:
+        return self._now
+
+    def schedule(self, delay_s: float, fn: Callable[[], None]) -> _TaskHandle:
+        handle = _TaskHandle()
+        heapq.heappush(self._heap,
+                       (self._now + max(0.0, delay_s), next(self._seq),
+                        handle, fn))
+        return handle
+
+    def run_until(self, t: float) -> None:
+        while self._heap and self._heap[0][0] <= t:
+            when, _, handle, fn = heapq.heappop(self._heap)
+            self._now = when
+            if not handle.cancelled:
+                fn()
+        self._now = t
+
+    def run_for(self, dt: float) -> None:
+        self.run_until(self._now + dt)
+
+
+class SimTransport:
+    """Per-node transport endpoint over a shared in-memory network."""
+
+    def __init__(self, network: "SimNetwork", address: Address):
+        self.network = network
+        self.address = address
+        self.handlers: Dict[str, Callable] = {}
+
+    def register(self, action: str, handler: Callable) -> None:
+        self.handlers[action] = handler
+
+    def send(self, address: Address, action: str, payload: Dict[str, Any],
+             on_done: Callable[[bool, Any], None]) -> None:
+        self.network.deliver(self.address, tuple(address), action, payload,
+                             on_done)
+
+
+class SimNetwork:
+    """The wire: seeded delays, blackholed links, dead nodes."""
+
+    def __init__(self, queue: DeterministicTaskQueue, rng,
+                 delay_s: float = 0.01, jitter_s: float = 0.02):
+        self.queue = queue
+        self.rng = rng
+        self.delay_s = delay_s
+        self.jitter_s = jitter_s
+        self.endpoints: Dict[Address, SimTransport] = {}
+        self.blocked: Set[Tuple[Address, Address]] = set()
+        self.dead: Set[Address] = set()
+
+    def endpoint(self, address: Address) -> SimTransport:
+        t = SimTransport(self, address)
+        self.endpoints[address] = t
+        return t
+
+    def partition(self, a: Address, b: Address) -> None:
+        self.blocked.add((a, b))
+        self.blocked.add((b, a))
+
+    def heal(self) -> None:
+        self.blocked.clear()
+
+    def kill(self, address: Address) -> None:
+        self.dead.add(address)
+
+    def _lag(self) -> float:
+        return self.delay_s + self.rng.random() * self.jitter_s
+
+    def deliver(self, src: Address, dst: Address, action: str,
+                payload: Dict[str, Any],
+                on_done: Callable[[bool, Any], None]) -> None:
+        def attempt() -> None:
+            if ((src, dst) in self.blocked or dst in self.dead
+                    or src in self.dead or dst not in self.endpoints):
+                self.queue.schedule(self._lag(),
+                                    lambda: on_done(False, None))
+                return
+            handler = self.endpoints[dst].handlers.get(action)
+            if handler is None:
+                self.queue.schedule(self._lag(),
+                                    lambda: on_done(False, None))
+                return
+            try:
+                result = handler(payload, {"address": list(src)})
+                ok = True
+            except Exception as e:  # noqa: BLE001 — remote error
+                result, ok = {"error": str(e)}, False
+            # response also crosses the (possibly now-broken) wire
+            def respond() -> None:
+                if (dst, src) in self.blocked or src in self.dead:
+                    on_done(False, None)
+                else:
+                    on_done(ok, result)
+            self.queue.schedule(self._lag(), respond)
+
+        self.queue.schedule(self._lag(), attempt)
+
+
+class InMemoryPersisted:
+    def __init__(self):
+        self.data: Optional[dict] = None
+
+    def load(self) -> Optional[dict]:
+        return self.data
+
+    def store(self, data: dict) -> None:
+        self.data = data
+
+
+class SimCluster:
+    """N Coordinator instances on a SimNetwork, all master-eligible."""
+
+    def __init__(self, n: int, rng, queue: Optional[DeterministicTaskQueue]
+                 = None):
+        self.queue = queue or DeterministicTaskQueue()
+        self.network = SimNetwork(self.queue, rng)
+        self.rng = rng
+        self.nodes: Dict[str, Coordinator] = {}
+        self.committed_log: Dict[str, List[Tuple[int, int]]] = {}
+        names = [f"node-{i}" for i in range(n)]
+        addresses = {name: ("sim", 9300 + i) for i, name in enumerate(names)}
+        seeds = list(addresses.values())
+        for i, name in enumerate(names):
+            dn = DiscoveryNode(node_id=f"id-{name}", name=name, host="sim",
+                               port=9300 + i)
+            transport = self.network.endpoint(dn.address)
+            log: List[Tuple[int, int]] = []
+            self.committed_log[name] = log
+            coord = Coordinator(
+                dn, transport=transport, scheduler=self.queue,
+                persisted=InMemoryPersisted(),
+                on_commit=(lambda st, _log=log:
+                           _log.append((st.term, st.version))),
+                seed_addresses=seeds, initial_master_names=names,
+                rng=self.rng)
+            self.nodes[name] = coord
+
+    def start(self) -> None:
+        for coord in self.nodes.values():
+            coord.start()
+
+    def leaders(self) -> List[str]:
+        return [n for n, c in self.nodes.items()
+                if c.mode == "LEADER" and c.local.address
+                not in self.network.dead]
+
+    def run_until_stable(self, max_s: float = 30.0,
+                         live: Optional[Set[str]] = None) -> str:
+        """Advance virtual time until exactly one live leader exists and
+        every live node agrees on it; returns the leader name."""
+        live = live or set(self.nodes)
+        step = 0.5
+        elapsed = 0.0
+        while elapsed < max_s:
+            self.queue.run_for(step)
+            elapsed += step
+            leaders = [n for n in self.leaders() if n in live]
+            if len(leaders) == 1:
+                leader = self.nodes[leaders[0]]
+                agreed = all(
+                    self.nodes[n].state().master_node_id
+                    == leader.local.node_id
+                    and self.nodes[n].state().version
+                    == leader.state().version
+                    for n in live)
+                if agreed:
+                    return leaders[0]
+        raise AssertionError(
+            f"no stable leader after {max_s}s of virtual time; "
+            f"leaders={self.leaders()}")

@@ -1,0 +1,151 @@
+"""Deterministic-simulation tests of the coordination layer.
+
+Ports the reference's `CoordinatorTests` idea (SURVEY.md §4.2): whole
+clusters under virtual time with seeded randomness, asserting election
+safety, publication linearizability, and fault recovery — no real
+sockets, no sleeps, fully reproducible via TESTS_SEED.
+"""
+
+import random
+
+import pytest
+
+from elasticsearch_tpu.cluster.coordination import (FailedToCommitException,
+                                                    NotMasterException)
+from elasticsearch_tpu.cluster.state import ClusterState
+from tests.sim_cluster import DeterministicTaskQueue, SimCluster
+
+
+@pytest.fixture
+def rng(seeded_random):
+    return seeded_random
+
+
+def test_bootstrap_elects_exactly_one_leader(rng):
+    cluster = SimCluster(3, rng)
+    cluster.start()
+    leader = cluster.run_until_stable()
+    assert len(cluster.leaders()) == 1
+    state = cluster.nodes[leader].state()
+    assert len(state.nodes) == 3
+    # every node committed the same (term, version)
+    versions = {c.state().version for c in cluster.nodes.values()}
+    terms = {c.state().term for c in cluster.nodes.values()}
+    assert len(versions) == 1 and len(terms) == 1
+
+
+def test_commit_history_is_linear(rng):
+    """No two nodes ever commit different states at the same (term,
+    version) — the LinearizabilityChecker-lite invariant."""
+    cluster = SimCluster(3, rng)
+    cluster.start()
+    leader = cluster.run_until_stable()
+
+    def bump(state: ClusterState) -> ClusterState:
+        return state  # no-op forces version bump? no — identity skips
+    # three real updates
+    for i in range(3):
+        def upd(state, i=i):
+            meta = dict(state.to_json())
+            return state.with_updates(cluster_uuid=state.cluster_uuid)
+        # use node add/remove-free update: change voting_config order is
+        # identity-ish; instead mutate via a trivially different field
+        cluster.nodes[leader].submit_state_update(
+            lambda s, i=i: s.with_updates(
+                voting_config=tuple(sorted(s.voting_config))
+                if i == 0 else s.voting_config + ()),
+            source=f"noop-{i}")
+    cluster.queue.run_for(5.0)
+    logs = cluster.committed_log
+    # collect all committed (term, version) across nodes; each pair must
+    # appear in the same relative order everywhere (prefix property)
+    for name, log in logs.items():
+        assert log == sorted(log), f"{name} committed out of order: {log}"
+
+
+def test_leader_kill_triggers_reelection_and_node_removal(rng):
+    cluster = SimCluster(3, rng)
+    cluster.start()
+    first = cluster.run_until_stable()
+    cluster.network.kill(cluster.nodes[first].local.address)
+    cluster.nodes[first].stop()
+    live = {n for n in cluster.nodes if n != first}
+    second = cluster.run_until_stable(live=live)
+    assert second != first
+    # the dead node was removed from the committed state
+    state = cluster.nodes[second].state()
+    assert cluster.nodes[first].local.node_id not in state.nodes
+    assert len(state.nodes) == 2
+    # terms strictly increased
+    assert state.term > cluster.nodes[first].state().term \
+        or state.version > cluster.nodes[first].state().version
+
+
+def test_partitioned_leader_steps_down_no_split_brain(rng):
+    cluster = SimCluster(3, rng)
+    cluster.start()
+    first = cluster.run_until_stable()
+    others = [n for n in cluster.nodes if n != first]
+    first_addr = cluster.nodes[first].local.address
+    for other in others:
+        cluster.network.partition(first_addr,
+                                  cluster.nodes[other].local.address)
+    second = cluster.run_until_stable(live=set(others))
+    # the old leader must have stepped down (lost quorum)
+    assert cluster.nodes[first].mode != "LEADER"
+    # split-brain check: the isolated node cannot commit anything the
+    # majority didn't — its committed version ≤ majority's
+    assert (cluster.nodes[first].state().version
+            <= cluster.nodes[second].state().version)
+    # heal: the old leader rejoins as follower
+    cluster.network.heal()
+    cluster.run_until_stable()
+    state = cluster.nodes[second].state()
+    assert cluster.nodes[first].local.node_id in state.nodes
+    assert cluster.nodes[first].mode in ("FOLLOWER",)
+
+
+def test_update_on_non_master_rejected(rng):
+    cluster = SimCluster(3, rng)
+    cluster.start()
+    leader = cluster.run_until_stable()
+    follower = next(n for n in cluster.nodes if n != leader)
+    errors = []
+    cluster.nodes[follower].submit_state_update(
+        lambda s: s, source="x", on_done=errors.append)
+    assert isinstance(errors[0], NotMasterException)
+
+
+def test_minority_leader_cannot_commit(rng):
+    """A leader cut off from the quorum gets FailedToCommit on its next
+    real update (reference: FailedToCommitClusterStateException)."""
+    cluster = SimCluster(3, rng)
+    cluster.start()
+    first = cluster.run_until_stable()
+    others = [n for n in cluster.nodes if n != first]
+    first_addr = cluster.nodes[first].local.address
+    for other in others:
+        cluster.network.partition(first_addr,
+                                  cluster.nodes[other].local.address)
+    results = []
+    cluster.nodes[first].submit_state_update(
+        lambda s: s.with_updates(cluster_uuid=s.cluster_uuid),
+        source="doomed", on_done=results.append)
+    cluster.queue.run_for(20.0)
+    assert results and isinstance(results[0],
+                                  (FailedToCommitException,
+                                   NotMasterException))
+
+
+def test_five_node_cluster_survives_two_failures(rng):
+    cluster = SimCluster(5, rng)
+    cluster.start()
+    first = cluster.run_until_stable()
+    victims = [n for n in cluster.nodes if n != first][:2]
+    for v in victims:
+        cluster.network.kill(cluster.nodes[v].local.address)
+        cluster.nodes[v].stop()
+    live = {n for n in cluster.nodes if n not in victims}
+    leader = cluster.run_until_stable(live=live)
+    state = cluster.nodes[leader].state()
+    assert len(state.nodes) == 3
