@@ -109,6 +109,8 @@ class Coordinator:
         self._join_inflight = False
         self._failure_counts: Dict[str, int] = {}
         self._stopped = False
+        self._publish_timeout = None
+        self._publish_on_done: Optional[Callable] = None
         # master-service task queue (single-threaded semantics: one
         # publication in flight at a time; reference: MasterService)
         self._publishing = False
@@ -323,6 +325,10 @@ class Coordinator:
         def initial_update(state: ClusterState) -> ClusterState:
             nodes = dict(state.nodes)
             nodes[self.local.node_id] = self.local
+            # the granting voters ARE the bootstrap joins: without them the
+            # first publication has no targets and can never reach quorum
+            for nid, voter in getattr(self, "_voters", {}).items():
+                nodes.setdefault(nid, voter)
             return state.with_updates(
                 nodes=nodes, master_node_id=self.local.node_id,
                 voting_config=tuple(self.initial_master_names))
@@ -337,6 +343,21 @@ class Coordinator:
         self.mode = CANDIDATE
         self.leader_id = None
         self._publishing = False
+        if self._publish_timeout is not None:
+            self._publish_timeout.cancel()
+            self._publish_timeout = None
+        # fail the in-flight publication (its on_timeout will no longer
+        # fire) and every queued task — callers must not wait forever
+        inflight, self._publish_on_done = self._publish_on_done, None
+        if inflight:
+            inflight(FailedToCommitException(
+                f"[{self.local.name}] stepped down mid-publication: "
+                f"{reason}"))
+        pending, self._task_queue = self._task_queue, []
+        for _source, _update, on_done in pending:
+            if on_done:
+                on_done(NotMasterException(
+                    f"[{self.local.name}] stepped down: {reason}"))
         if self._heartbeat_timer is not None:
             self._heartbeat_timer.cancel()
         self._schedule_election()
@@ -392,6 +413,7 @@ class Coordinator:
                  on_done: Optional[Callable]) -> None:
         # caller holds self.lock; 2-phase commit over the transport
         term, version = state.term, state.version
+        pub_term = self.current_term  # guard against stale callbacks
         voting = state.voting_config or tuple(self.initial_master_names)
         state_json = state.to_json()
         acks = {self.local.name}
@@ -399,9 +421,11 @@ class Coordinator:
                    if n.node_id != self.local.node_id]
         committed = [False]
 
-        # leader accepts its own publication first
+        # leader accepts its own publication first; _step_down owns
+        # failing the in-flight on_done if leadership is lost meanwhile
         self.accepted = state
         self._persist()
+        self._publish_on_done = on_done
 
         def maybe_commit() -> None:
             # caller holds self.lock; only VOTING nodes' acks count
@@ -410,6 +434,8 @@ class Coordinator:
                 return
             committed[0] = True
             timeout_handle.cancel()
+            self._publish_timeout = None
+            self._publish_on_done = None
             self._commit_locally(state)
             for n in targets:
                 self.transport.send(n.address, ACTION_COMMIT,
@@ -424,28 +450,31 @@ class Coordinator:
             if not ok or not result:
                 return
             with self.lock:
-                if self._stopped or self.mode != LEADER:
-                    return
+                if (self._stopped or self.mode != LEADER
+                        or self.current_term != pub_term):
+                    return  # stale ack from an abandoned publication
                 if result.get("accepted"):
                     acks.add(result["node_name"])
                     maybe_commit()
 
         def on_timeout() -> None:
             with self.lock:
-                if committed[0] or self._stopped:
-                    return
+                if (committed[0] or self._stopped or self.mode != LEADER
+                        or self.current_term != pub_term):
+                    return  # publication already abandoned via step-down
                 self._publishing = False
                 logger.warning("[%s] publish (%d,%d) failed to commit: "
                                "%d/%d acks", self.local.name, term, version,
                                len(acks), len(voting))
-                self._step_down("failed to commit publication")
-                if on_done:
-                    on_done(FailedToCommitException(
-                        f"publication ({term},{version}) got "
-                        f"{len(acks)} of {len(voting)} voting acks"))
+                # _step_down delivers FailedToCommitException to the
+                # in-flight on_done (self._publish_on_done)
+                self._step_down(
+                    f"publication ({term},{version}) got "
+                    f"{len(acks)} of {len(voting)} voting acks")
 
         timeout_handle = self.scheduler.schedule(self.publish_timeout_s,
                                                  on_timeout)
+        self._publish_timeout = timeout_handle
         for n in targets:
             self.transport.send(n.address, ACTION_PUBLISH,
                                 {"state": state_json}, on_ack)
@@ -545,11 +574,14 @@ class Coordinator:
             term = self.current_term
             reachable_voting = {self.local.name}
             pending = [len(targets)]
+            finished = [False]
+            answered: set = set()
 
             def finish_round() -> None:
                 # caller holds self.lock
-                if self.mode != LEADER or self._stopped:
+                if finished[0] or self.mode != LEADER or self._stopped:
                     return
+                finished[0] = True
                 voting = (self.committed.voting_config
                           or tuple(self.initial_master_names))
                 if not is_quorum(len([v for v in reachable_voting
@@ -567,8 +599,10 @@ class Coordinator:
                 def cb(ok: bool, result: Any) -> None:
                     with self.lock:
                         if self._stopped or self.mode != LEADER \
-                                or self.current_term != term:
+                                or self.current_term != term \
+                                or finished[0]:
                             return
+                        answered.add(node.node_id)
                         if ok and result:
                             if result.get("term", 0) > term:
                                 self.current_term = int(result["term"])
@@ -588,6 +622,23 @@ class Coordinator:
             if not targets:
                 finish_round()
                 return
+
+            def round_deadline() -> None:
+                # a transport that never invokes on_done (hung TCP peer
+                # with no RST) must not stall failure detection: count
+                # every unanswered ping as a failure and close the round
+                with self.lock:
+                    if (finished[0] or self._stopped or self.mode != LEADER
+                            or self.current_term != term):
+                        return
+                    for n in targets:
+                        if n.node_id not in answered:
+                            self._failure_counts[n.node_id] = \
+                                self._failure_counts.get(n.node_id, 0) + 1
+                    finish_round()
+
+            self.scheduler.schedule(max(self.heartbeat_s * 2.0, 1.0),
+                                    round_deadline)
             for n in targets:
                 self.transport.send(n.address, ACTION_PING,
                                     {"term": term,

@@ -105,7 +105,10 @@ class ClusterState:
     indices: Dict[str, IndexMeta]
     # index → shard → [ShardRouting] (primary first by convention)
     routing: Dict[str, Dict[int, List[ShardRouting]]]
-    # node_ids eligible to vote (reference: VotingConfiguration)
+    # node NAMES eligible to vote (reference: VotingConfiguration uses
+    # ids; here bootstrap config is by name — `cluster.initial_master_
+    # nodes` — and vote/ack counting matches on names, so names are the
+    # canonical voting identity throughout)
     voting_config: Tuple[str, ...] = ()
 
     # -------------- queries --------------

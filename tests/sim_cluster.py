@@ -185,9 +185,13 @@ class SimCluster:
 
     def run_until_stable(self, max_s: float = 30.0,
                          live: Optional[Set[str]] = None) -> str:
-        """Advance virtual time until exactly one live leader exists and
-        every live node agrees on it; returns the leader name."""
+        """Advance virtual time until exactly one live leader exists,
+        every live node agrees on it, and cluster membership has
+        converged to exactly the live nodes (dead nodes removed by the
+        failure detector, rejoined nodes added back); returns the leader
+        name."""
         live = live or set(self.nodes)
+        live_ids = {self.nodes[n].local.node_id for n in live}
         step = 0.5
         elapsed = 0.0
         while elapsed < max_s:
@@ -202,7 +206,7 @@ class SimCluster:
                     and self.nodes[n].state().version
                     == leader.state().version
                     for n in live)
-                if agreed:
+                if agreed and set(leader.state().nodes) == live_ids:
                     return leaders[0]
         raise AssertionError(
             f"no stable leader after {max_s}s of virtual time; "

@@ -41,20 +41,12 @@ def test_commit_history_is_linear(rng):
     cluster.start()
     leader = cluster.run_until_stable()
 
-    def bump(state: ClusterState) -> ClusterState:
-        return state  # no-op forces version bump? no — identity skips
-    # three real updates
+    # three updates; each returns a NEW (non-identical) state object, so
+    # _drain_tasks publishes all three — versions bump v+1, v+2, v+3
     for i in range(3):
-        def upd(state, i=i):
-            meta = dict(state.to_json())
-            return state.with_updates(cluster_uuid=state.cluster_uuid)
-        # use node add/remove-free update: change voting_config order is
-        # identity-ish; instead mutate via a trivially different field
         cluster.nodes[leader].submit_state_update(
-            lambda s, i=i: s.with_updates(
-                voting_config=tuple(sorted(s.voting_config))
-                if i == 0 else s.voting_config + ()),
-            source=f"noop-{i}")
+            lambda s: s.with_updates(voting_config=tuple(s.voting_config)),
+            source=f"bump-{i}")
     cluster.queue.run_for(5.0)
     logs = cluster.committed_log
     # collect all committed (term, version) across nodes; each pair must
