@@ -1,0 +1,988 @@
+"""ClusterService — wires Coordinator + TransportService + allocation
+into a running node: state application, shard lifecycle, and the
+request-routing layer REST actions use in cluster mode.
+
+Reference analogs (SURVEY.md §2.1 #12-18, #32, §3.4/§3.5):
+  - ClusterApplierService: committed states reconcile local shards on a
+    dedicated applier thread (create/remove/promote), then notify the
+    master shard-started (ShardStateAction).
+  - MasterService task batching lives in Coordinator.submit_state_update;
+    this class adds the master-side actions (create/delete index, put
+    mapping, shard-started) and the reroute-on-change loop.
+  - TransportService action handlers for the data plane: doc ops, bulk
+    sub-batches, and the search query/fetch group hop.
+
+Design notes (tpu-first): the node-level data plane stays host-side
+control traffic — JSON over TCP on the DCN tier — while all scoring math
+stays on-device behind the per-node TpuSearchService. A cross-node
+search is: route shards → each node runs its LOCAL query phase (kernel
+fast path when eligible) → coordinator merges small top-k windows. The
+heavy arrays never cross the host network (SURVEY §2.4 two-tier comms).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.cluster.allocation import AllocationService
+from elasticsearch_tpu.cluster.coordination import Coordinator
+from elasticsearch_tpu.cluster.state import (INITIALIZING, STARTED,
+                                             ClusterState, DiscoveryNode,
+                                             IndexMeta, ShardRouting)
+from elasticsearch_tpu.common.errors import (EsException,
+                                             IllegalArgumentException,
+                                             IndexNotFoundException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.translog import write_atomic
+from elasticsearch_tpu.transport.service import (RemoteTransportException,
+                                                 TransportService)
+
+logger = logging.getLogger("elasticsearch_tpu.cluster")
+
+# data-plane actions (reference: indices:data/write/*, indices:data/read/*)
+ACTION_DOC_OP = "indices/data/doc_op"
+ACTION_BULK = "indices/data/bulk_group"
+ACTION_QUERY_GROUP = "indices/data/search_group"
+ACTION_COUNT_GROUP = "indices/data/count_group"
+# master-plane actions (reference: cluster:admin/*, internal:cluster/shard/*)
+ACTION_MAINTENANCE = "indices/data/maintenance"
+ACTION_CREATE_INDEX = "cluster/admin/create_index"
+ACTION_DELETE_INDEX = "cluster/admin/delete_index"
+ACTION_PUT_MAPPING = "cluster/admin/put_mapping"
+ACTION_SHARD_STARTED = "cluster/shard/started"
+
+
+class MasterNotDiscoveredException(EsException):
+    pass
+
+
+class ThreadScheduler:
+    """Single-threaded delayed-task scheduler (Coordinator's scheduler
+    seam for real deployments; tests use DeterministicTaskQueue)."""
+
+    class _Handle:
+        __slots__ = ("cancelled",)
+
+        def __init__(self):
+            self.cancelled = False
+
+        def cancel(self):
+            self.cancelled = True
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, Any, Callable]] = []
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cluster-scheduler")
+        self._thread.start()
+
+    def schedule(self, delay_s: float, fn: Callable[[], None]):
+        handle = self._Handle()
+        with self._cv:
+            heapq.heappush(self._heap,
+                           (time.monotonic() + max(0.0, delay_s),
+                            next(self._seq), handle, fn))
+            self._cv.notify()
+        return handle
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._stopped and (
+                        not self._heap
+                        or self._heap[0][0] > time.monotonic()):
+                    if self._stopped:
+                        return
+                    timeout = (self._heap[0][0] - time.monotonic()
+                               if self._heap else None)
+                    self._cv.wait(timeout=timeout)
+                if self._stopped:
+                    return
+                _, _, handle, fn = heapq.heappop(self._heap)
+            if handle.cancelled:
+                continue
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — scheduled task bug
+                logger.exception("scheduled task failed")
+
+    def close(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+
+class FilePersisted:
+    """Durable coordination state (reference: GatewayMetaState — the
+    term/vote/accepted-state triple must survive restart)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Optional[dict]:
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                return json.loads(f.read().decode("utf-8"))
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def store(self, data: dict) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        write_atomic(self.path,
+                     json.dumps(data, sort_keys=True).encode("utf-8"))
+
+
+class _CoordTransport:
+    """Adapts TransportService's Future API to the Coordinator's
+    callback seam."""
+
+    def __init__(self, ts: TransportService):
+        self.ts = ts
+
+    def register(self, action: str, handler) -> None:
+        self.ts.register_handler(action, handler)
+
+    def send(self, address, action: str, payload: Dict[str, Any],
+             on_done: Callable[[bool, Any], None]) -> None:
+        fut = self.ts.send_request_async(tuple(address), action, payload)
+
+        def cb(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                on_done(False, None)
+            else:
+                on_done(True, f.result())
+
+        fut.add_done_callback(cb)
+
+
+class ClusterService:
+    """The cluster-mode brain of one node."""
+
+    def __init__(self, node, *, host: str = "127.0.0.1",
+                 transport_port: int = 0,
+                 seed_hosts: Optional[List[Tuple[str, int]]] = None,
+                 initial_master_names: Optional[List[str]] = None):
+        self.node = node
+        self.transport = TransportService(host=host, port=transport_port)
+        self.transport.start()
+        self.local_node = DiscoveryNode(
+            node_id=node.node_id, name=node.node_name, host=host,
+            port=self.transport.port, http_port=getattr(node, "http_port", 0))
+        self.transport.local_node = self.local_node.to_json()
+        self.scheduler = ThreadScheduler()
+        seeds = list(seed_hosts or [])
+        if self.local_node.address not in seeds:
+            seeds.append(self.local_node.address)
+        self.allocation = AllocationService()
+        self.coordinator = Coordinator(
+            self.local_node,
+            transport=_CoordTransport(self.transport),
+            scheduler=self.scheduler,
+            persisted=FilePersisted(os.path.join(
+                node.indices.data_path, "_state", "coordination.json")),
+            on_commit=self._on_commit,
+            seed_addresses=seeds,
+            initial_master_names=(initial_master_names
+                                  or [node.node_name]),
+            cluster_uuid=node.cluster_uuid)
+
+        # applier thread: reconcile runs off the coordinator lock
+        self._applied = ClusterState.empty(node.cluster_uuid)
+        self._apply_cv = threading.Condition()
+        self._pending_state: Optional[ClusterState] = None
+        self._applier_stop = False
+        self._applier = threading.Thread(target=self._applier_loop,
+                                         daemon=True,
+                                         name="cluster-applier")
+        # shard copies this node reported started, keyed by allocation_id
+        self._started_sent: Set[str] = set()
+        # index uuids this applier has seen in a committed state; only
+        # those may be deleted when they later disappear from the state.
+        # Pre-existing local data the cluster never knew about (e.g. a
+        # single-node data dir restarted with --transport-port) is left
+        # untouched — the reference's dangling-index safety.
+        self._seen_index_uuids: Set[str] = set()
+
+        for action, handler in (
+                (ACTION_DOC_OP, self._handle_doc_op),
+                (ACTION_BULK, self._handle_bulk_group),
+                (ACTION_QUERY_GROUP, self._handle_query_group),
+                (ACTION_MAINTENANCE, self._handle_maintenance),
+                (ACTION_COUNT_GROUP, self._handle_count_group),
+                (ACTION_CREATE_INDEX, self._handle_create_index),
+                (ACTION_DELETE_INDEX, self._handle_delete_index),
+                (ACTION_PUT_MAPPING, self._handle_put_mapping),
+                (ACTION_SHARD_STARTED, self._handle_shard_started)):
+            self.transport.register_handler(action, handler)
+
+    def start(self) -> None:
+        self._applier.start()
+        self.coordinator.start()
+
+    def close(self) -> None:
+        self.coordinator.stop()
+        with self._apply_cv:
+            self._applier_stop = True
+            self._apply_cv.notify_all()
+        self.scheduler.close()
+        self.transport.close()
+
+    # ------------------------------------------------------------------
+    # state application
+    # ------------------------------------------------------------------
+
+    def _on_commit(self, state: ClusterState) -> None:
+        # called under the coordinator lock — hand off, never block
+        with self._apply_cv:
+            self._pending_state = state
+            self._apply_cv.notify_all()
+
+    def _applier_loop(self) -> None:
+        while True:
+            with self._apply_cv:
+                while self._pending_state is None and not self._applier_stop:
+                    self._apply_cv.wait()
+                if self._applier_stop:
+                    return
+                state, self._pending_state = self._pending_state, None
+            try:
+                self._reconcile(state)
+            except Exception:  # noqa: BLE001 — applier bug must not die
+                logger.exception("[%s] state reconcile failed",
+                                 self.local_node.name)
+            with self._apply_cv:
+                self._applied = state
+                self._apply_cv.notify_all()
+            self._maybe_reroute(state)
+
+    def applied_state(self) -> ClusterState:
+        with self._apply_cv:
+            return self._applied
+
+    def wait_for_applied(self, predicate: Callable[[ClusterState], bool],
+                         timeout: float = 10.0) -> Optional[ClusterState]:
+        deadline = time.monotonic() + timeout
+        with self._apply_cv:
+            while True:
+                if predicate(self._applied):
+                    return self._applied
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._apply_cv.wait(timeout=remaining)
+
+    def _reconcile(self, state: ClusterState) -> None:
+        """Make local shards match the routing table (reference:
+        IndicesClusterStateService#applyClusterState)."""
+        indices = self.node.indices
+        local_id = self.local_node.node_id
+
+        # delete local indices that no longer exist in the state — but
+        # ONLY indices the cluster state once owned (matching uuid seen
+        # in a prior committed state); anything else is dangling local
+        # data that must never be rmtree'd by a state that merely
+        # doesn't know it
+        for meta in state.indices.values():
+            self._seen_index_uuids.add(meta.uuid)
+        for name in [n for n in list(indices.indices)
+                     if n not in state.indices
+                     and indices.index(n).index_uuid
+                     in self._seen_index_uuids]:
+            try:
+                indices.delete_index(name)
+                if self.node.tpu_search is not None:
+                    self.node.tpu_search.invalidate_index(name)
+            except EsException:
+                pass
+
+        for name, meta in state.indices.items():
+            local_copies = [c for c in
+                            (c for sh in state.routing.get(name, {}).values()
+                             for c in sh)
+                            if c.node_id == local_id]
+            if not indices.has_index(name):
+                if not local_copies:
+                    continue
+                indices.create_index(
+                    name, Settings.of(meta.settings), meta.mapping,
+                    index_uuid=meta.uuid, create_shards=False)
+            svc = indices.index(name)
+            if meta.mapping:
+                try:  # idempotent merge keeps local mappers current
+                    svc.mapper.merge(meta.mapping)
+                except EsException:
+                    pass
+            wanted = {c.shard: c for c in local_copies}
+            # remove shards no longer assigned here
+            for shard_num in [s for s in list(svc.shards) if s not in wanted]:
+                shard = svc.shards.pop(shard_num)
+                shard.close()
+            # create/promote assigned copies
+            for shard_num, copy in wanted.items():
+                shard = svc.shards.get(shard_num)
+                if shard is None:
+                    shard = svc.create_shard(shard_num, primary=copy.primary,
+                                             allocation_id=copy.allocation_id)
+                elif copy.primary and not shard.primary:
+                    shard.promote_to_primary(shard.primary_term + 1)
+                if (copy.state == INITIALIZING
+                        and copy.allocation_id not in self._started_sent):
+                    self._started_sent.add(copy.allocation_id)
+                    self._send_to_master(ACTION_SHARD_STARTED, {
+                        "index": name, "shard": shard_num,
+                        "allocation_id": copy.allocation_id})
+
+    def _maybe_reroute(self, state: ClusterState) -> None:
+        """Master-side convergence loop: if a reroute would change the
+        routing table (unassigned copies placeable, dead-node copies to
+        fail over), submit it (reference: the reroute after every
+        join/leave/create)."""
+        if not self.coordinator.is_master():
+            return
+        new = self.allocation.reroute(state)
+        if new.routing == state.routing:
+            return
+
+        def update(base: ClusterState) -> ClusterState:
+            rerouted = self.allocation.reroute(base)
+            if rerouted.routing == base.routing:
+                return base
+            return rerouted
+
+        self.coordinator.submit_state_update(update, source="reroute")
+
+    # ------------------------------------------------------------------
+    # master-side actions
+    # ------------------------------------------------------------------
+
+    def _master_address(self) -> Tuple[str, int]:
+        master = self.coordinator.master_node()
+        if master is None:
+            raise MasterNotDiscoveredException("master not discovered")
+        return master.address
+
+    def _send_to_master(self, action: str, payload: Dict[str, Any]) -> None:
+        """Fire-and-forget with one retry (shard-started etc.)."""
+        try:
+            addr = self._master_address()
+        except MasterNotDiscoveredException:
+            self.scheduler.schedule(
+                1.0, lambda: self._send_to_master(action, payload))
+            return
+        fut = self.transport.send_request_async(addr, action, payload)
+
+        def cb(f: Future) -> None:
+            if f.exception() is not None:
+                self.scheduler.schedule(
+                    1.0, lambda: self._send_to_master(action, payload))
+
+        fut.add_done_callback(cb)
+
+    def _run_master_update(self, update, source: str,
+                           timeout: float = 15.0) -> None:
+        """Submit on the local coordinator (must be master) and wait."""
+        done: "Future[None]" = Future()
+
+        def on_done(err: Optional[Exception]) -> None:
+            if err is not None:
+                done.set_exception(err)
+            else:
+                done.set_result(None)
+
+        self.coordinator.submit_state_update(update, source=source,
+                                             on_done=on_done)
+        done.result(timeout=timeout)
+
+    def _handle_create_index(self, payload, from_node) -> Dict[str, Any]:
+        name = payload["name"]
+        mapping = payload.get("mapping")
+        # normalize nested/flat settings spellings to the flat form so
+        # IndexMeta round-trips through JSON and Settings.of identically
+        flat = Settings.of(payload.get("settings") or {})
+        # REST bodies use bare keys ("number_of_shards"); settings files
+        # use prefixed ("index.number_of_shards") — accept both
+        n_shards = flat.get_int("index.number_of_shards",
+                                flat.get_int("number_of_shards", 1))
+        n_replicas = flat.get_int("index.number_of_replicas",
+                                  flat.get_int("number_of_replicas", 0))
+        norm = {k: v for k, v in flat.get_as_dict().items()
+                if k not in ("number_of_shards", "number_of_replicas")}
+        norm["index.number_of_shards"] = n_shards
+        norm["index.number_of_replicas"] = n_replicas
+        import uuid as uuid_mod
+        meta = IndexMeta(
+            name=name, uuid=uuid_mod.uuid4().hex[:20], settings=norm,
+            mapping=mapping, number_of_shards=n_shards,
+            number_of_replicas=n_replicas)
+        from elasticsearch_tpu.indices.service import _validate_index_name
+        _validate_index_name(name)
+
+        def update(state: ClusterState) -> ClusterState:
+            if name in state.indices:
+                from elasticsearch_tpu.common.errors import \
+                    IndexAlreadyExistsException
+                raise IndexAlreadyExistsException(
+                    f"index [{name}] already exists")
+            new_indices = dict(state.indices)
+            new_indices[name] = meta
+            return self.allocation.reroute(
+                state.with_updates(indices=new_indices))
+
+        self._run_master_update(update, source=f"create-index[{name}]")
+        return {"acknowledged": True, "index": name}
+
+    def _handle_delete_index(self, payload, from_node) -> Dict[str, Any]:
+        name = payload["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.indices:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            new_indices = {k: v for k, v in state.indices.items()
+                           if k != name}
+            return state.with_updates(indices=new_indices)
+
+        self._run_master_update(update, source=f"delete-index[{name}]")
+        return {"acknowledged": True}
+
+    def _handle_put_mapping(self, payload, from_node) -> Dict[str, Any]:
+        name = payload["index"]
+        mapping = payload.get("mapping") or {}
+
+        def update(state: ClusterState) -> ClusterState:
+            meta = state.indices.get(name)
+            if meta is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            import dataclasses
+            merged = _merge_mapping(meta.mapping, mapping)
+            new_meta = dataclasses.replace(meta, mapping=merged)
+            new_indices = dict(state.indices)
+            new_indices[name] = new_meta
+            return state.with_updates(indices=new_indices)
+
+        self._run_master_update(update, source=f"put-mapping[{name}]")
+        return {"acknowledged": True}
+
+    def _handle_shard_started(self, payload, from_node) -> Dict[str, Any]:
+        index, shard = payload["index"], int(payload["shard"])
+        aid = payload["allocation_id"]
+
+        def update(state: ClusterState) -> ClusterState:
+            return AllocationService.shard_started(state, index, shard, aid)
+
+        self._run_master_update(update,
+                                source=f"shard-started[{index}][{shard}]")
+        return {"acknowledged": True}
+
+    # ------------------------------------------------------------------
+    # admin routing (REST → master)
+    # ------------------------------------------------------------------
+
+    def create_index(self, name: str, settings: Dict[str, Any],
+                     mapping: Optional[dict]) -> Dict[str, Any]:
+        result = self._call_master(ACTION_CREATE_INDEX, {
+            "name": name, "settings": settings, "mapping": mapping})
+        # wait until this node has applied a state with started primaries
+        self.wait_for_applied(
+            lambda s: name in s.indices and all(
+                s.primary(name, i) is not None
+                and s.primary(name, i).state == STARTED
+                for i in range(s.indices[name].number_of_shards)),
+            timeout=15.0)
+        return result
+
+    def delete_index(self, name: str) -> Dict[str, Any]:
+        result = self._call_master(ACTION_DELETE_INDEX, {"name": name})
+        self.wait_for_applied(lambda s: name not in s.indices, timeout=10.0)
+        return result
+
+    def put_mapping(self, name: str, mapping: dict) -> Dict[str, Any]:
+        return self._call_master(ACTION_PUT_MAPPING,
+                                 {"index": name, "mapping": mapping})
+
+    def _call_master(self, action: str, payload: Dict[str, Any],
+                     timeout: float = 20.0) -> Dict[str, Any]:
+        addr = self._master_address()
+        if addr == self.local_node.address:
+            handler = {ACTION_CREATE_INDEX: self._handle_create_index,
+                       ACTION_DELETE_INDEX: self._handle_delete_index,
+                       ACTION_PUT_MAPPING: self._handle_put_mapping}[action]
+            return handler(payload, self.local_node.to_json())
+        try:
+            return self.transport.send_request(addr, action, payload,
+                                               timeout=timeout)
+        except RemoteTransportException as e:
+            raise _rehydrate_error(e) from e
+
+    # ------------------------------------------------------------------
+    # document routing (REST → shard owner)
+    # ------------------------------------------------------------------
+
+    def _ensure_index(self, index: str) -> IndexMeta:
+        state = self.applied_state()
+        meta = state.indices.get(index)
+        if meta is not None:
+            return meta
+        if not self.node.settings.get_bool("action.auto_create_index", True):
+            raise IndexNotFoundException(
+                f"no such index [{index}] and auto-create is disabled")
+        from elasticsearch_tpu.common.errors import \
+            IndexAlreadyExistsException
+        try:
+            self.create_index(index, {}, None)
+        except IndexAlreadyExistsException:
+            pass
+        state = self.wait_for_applied(lambda s: index in s.indices,
+                                      timeout=15.0)
+        if state is None:
+            raise MasterNotDiscoveredException(
+                f"timed out waiting for index [{index}] creation to apply")
+        return state.indices[index]
+
+    def _primary_node(self, index: str, shard: int
+                      ) -> Tuple[ShardRouting, DiscoveryNode]:
+        state = self.wait_for_applied(
+            lambda s: (s.primary(index, shard) is not None
+                       and s.primary(index, shard).state == STARTED
+                       and s.primary(index, shard).node_id in s.nodes),
+            timeout=10.0)
+        if state is None:
+            raise EsException(
+                f"primary shard [{index}][{shard}] is not active")
+        primary = state.primary(index, shard)
+        return primary, state.nodes[primary.node_id]
+
+    def route_doc_op(self, op: str, index: str, doc_id: Optional[str],
+                     body, params: Dict[str, str]) -> Tuple[int, Dict]:
+        from elasticsearch_tpu.indices.service import shard_for
+        if op in ("index", "create", "update"):
+            meta = self._ensure_index(index)
+        else:
+            # reads/deletes never auto-create (reference: only write ops
+            # trigger action.auto_create_index)
+            meta = self.applied_state().indices.get(index)
+            if meta is None:
+                raise IndexNotFoundException(f"no such index [{index}]")
+        if doc_id is None:
+            import uuid as uuid_mod
+            doc_id = uuid_mod.uuid4().hex[:20]
+        shard = shard_for(params.get("routing") or doc_id,
+                          meta.number_of_shards)
+        _primary, target = self._primary_node(index, shard)
+        if target.node_id == self.local_node.node_id:
+            return self._exec_doc_op(op, index, doc_id, body, params, shard)
+        try:
+            result = self.transport.send_request(
+                target.address, ACTION_DOC_OP,
+                {"op": op, "index": index, "id": doc_id, "body": body,
+                 "params": params, "shard": shard})
+        except RemoteTransportException as e:
+            raise _rehydrate_error(e) from e
+        return result["status"], result["body"]
+
+    def _exec_doc_op(self, op: str, index: str, doc_id: str, body,
+                     params: Dict[str, str], shard: int) -> Tuple[int, Dict]:
+        from elasticsearch_tpu.rest.actions import document as doc_mod
+        params = dict(params or {})
+        if op in ("index", "create"):
+            return doc_mod.exec_index_doc(self.node, index, doc_id, body,
+                                          params, op_type=op,
+                                          shard_num=shard)
+        if op == "get":
+            return doc_mod.exec_get_doc(self.node, index, doc_id, params,
+                                        shard_num=shard)
+        if op == "delete":
+            return doc_mod.exec_delete_doc(self.node, index, doc_id, params,
+                                           shard_num=shard)
+        if op == "update":
+            return doc_mod.exec_update_doc(self.node, index, doc_id, body,
+                                           params, shard_num=shard)
+        raise IllegalArgumentException(f"unknown doc op [{op}]")
+
+    def _handle_doc_op(self, payload, from_node) -> Dict[str, Any]:
+        status, body = self._exec_doc_op(
+            payload["op"], payload["index"], payload["id"],
+            payload.get("body"), payload.get("params") or {},
+            int(payload["shard"]))
+        return {"status": status, "body": body}
+
+    # ------------------------------------------------------------------
+    # bulk routing
+    # ------------------------------------------------------------------
+
+    def route_bulk(self, ops: List[Dict[str, Any]], *,
+                   refresh: bool = False) -> List[Dict[str, Any]]:
+        from elasticsearch_tpu.indices.service import shard_for
+        from elasticsearch_tpu.rest.actions import document as doc_mod
+        from elasticsearch_tpu.rest.controller import error_status
+
+        # resolve each op's target node; group preserving positions
+        groups: Dict[str, List[Tuple[int, Dict[str, Any]]]] = {}
+        items: List[Optional[Dict[str, Any]]] = [None] * len(ops)
+        addr_of: Dict[str, Tuple[str, int]] = {}
+        for pos, entry in enumerate(ops):
+            try:
+                index = entry["index"]
+                if index is None:
+                    raise IllegalArgumentException("_index is missing")
+                meta = self._ensure_index(index)
+                shard = shard_for(entry.get("routing") or entry["id"],
+                                  meta.number_of_shards)
+                _primary, target = self._primary_node(index, shard)
+                entry = dict(entry, shard=shard)
+                groups.setdefault(target.node_id, []).append((pos, entry))
+                addr_of[target.node_id] = target.address
+            except EsException as exc:
+                items[pos] = {entry["op"]: {
+                    "_index": entry.get("index"), "_id": entry.get("id"),
+                    "status": error_status(exc),
+                    "error": {"type": type(exc).__name__,
+                              "reason": str(exc)}}}
+
+        # dispatch every remote group first so their work overlaps the
+        # local apply, then run the local group in this thread
+        futures: List[Tuple[List[int], Future]] = []
+        local_group: Optional[List[Tuple[int, Dict[str, Any]]]] = None
+        for node_id, group in groups.items():
+            if node_id == self.local_node.node_id:
+                local_group = group
+                continue
+            positions = [pos for pos, _ in group]
+            sub_ops = [entry for _, entry in group]
+            fut = self.transport.send_request_async(
+                addr_of[node_id], ACTION_BULK,
+                {"ops": sub_ops, "refresh": refresh})
+            futures.append((positions, fut))
+        if local_group is not None:
+            positions = [pos for pos, _ in local_group]
+            sub_ops = [entry for _, entry in local_group]
+            fut = Future()
+            try:
+                fut.set_result({"items": doc_mod.apply_bulk_ops(
+                    self.node, sub_ops, refresh=refresh)})
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+            futures.append((positions, fut))
+
+        for positions, fut in futures:
+            try:
+                sub_items = fut.result(timeout=60.0)["items"]
+                for pos, item in zip(positions, sub_items):
+                    items[pos] = item
+            except Exception as exc:  # noqa: BLE001 — node-level failure
+                for pos in positions:
+                    op = ops[pos]["op"]
+                    items[pos] = {op: {
+                        "_index": ops[pos].get("index"),
+                        "_id": ops[pos].get("id"), "status": 503,
+                        "error": {"type": "unavailable_shards_exception",
+                                  "reason": str(exc)}}}
+        return [it for it in items if it is not None]
+
+    def _handle_bulk_group(self, payload, from_node) -> Dict[str, Any]:
+        from elasticsearch_tpu.rest.actions import document as doc_mod
+        return {"items": doc_mod.apply_bulk_ops(
+            self.node, payload["ops"], refresh=bool(payload.get("refresh")))}
+
+    # ------------------------------------------------------------------
+    # search routing (query_then_fetch across nodes)
+    # ------------------------------------------------------------------
+
+    def resolve_indices(self, expression: Optional[str]) -> List[str]:
+        import fnmatch
+        state = self.applied_state()
+        names = sorted(state.indices.keys())
+        if expression in (None, "", "_all", "*"):
+            return names
+        out: List[str] = []
+        for part in expression.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "*" in part or "?" in part:
+                out.extend(m for m in fnmatch.filter(names, part)
+                           if m not in out)
+            else:
+                if part not in names:
+                    raise IndexNotFoundException(f"no such index [{part}]")
+                if part not in out:
+                    out.append(part)
+        return out
+
+    def _route_shards(self, names: List[str]
+                      ) -> Tuple[Dict[str, List[Tuple[str, int]]],
+                                 Dict[str, Tuple[str, int]], int]:
+        """→ (node_id → [(index, shard)], node_id → address,
+        failed_shard_count). Prefers STARTED primaries, falls back to
+        any STARTED copy (replica reads)."""
+        state = self.applied_state()
+        by_node: Dict[str, List[Tuple[str, int]]] = {}
+        addr: Dict[str, Tuple[str, int]] = {}
+        failed = 0
+        for name in names:
+            meta = state.indices.get(name)
+            if meta is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            for shard in range(meta.number_of_shards):
+                copies = [c for c in state.shard_copies(name, shard)
+                          if c.state == STARTED and c.node_id in state.nodes]
+                if not copies:
+                    failed += 1
+                    continue
+                chosen = next((c for c in copies if c.primary), copies[0])
+                by_node.setdefault(chosen.node_id, []).append((name, shard))
+                addr[chosen.node_id] = state.nodes[chosen.node_id].address
+        return by_node, addr, failed
+
+    def route_search(self, index_expr: Optional[str],
+                     body: Optional[Dict[str, Any]],
+                     params: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, Any]:
+        from elasticsearch_tpu.search import coordinator as coord
+        t0 = time.perf_counter()
+        names = self.resolve_indices(index_expr)
+        # validates the body once on the coordinating node (400 before
+        # any fan-out, reference behavior)
+        coord.parse_search_body(body or {})
+        by_node, addr, failed = self._route_shards(names)
+
+        futures: List[Tuple[str, Any]] = []
+        local_targets: Optional[List[Tuple[str, int]]] = None
+        for node_id, targets in sorted(by_node.items()):
+            if node_id == self.local_node.node_id:
+                local_targets = targets
+                continue
+            fut = self.transport.send_request_async(
+                addr[node_id], ACTION_QUERY_GROUP,
+                {"targets": targets, "body": body, "params": params})
+            futures.append((node_id, fut))
+
+        groups: List[Dict[str, Any]] = []
+        if local_targets is not None:
+            groups.append(coord.search_shard_group(
+                self.node.indices, local_targets, body, params,
+                tpu_search=self.node.tpu_search))
+        for node_id, fut in futures:
+            try:
+                groups.append(fut.result(timeout=60.0))
+            except Exception as exc:  # noqa: BLE001 — shard-group failure
+                n = len(by_node.get(node_id, []))
+                failed += n
+                logger.warning("search group on [%s] failed: %s",
+                               node_id, exc)
+        return coord.merge_group_responses(groups, body, params, t0,
+                                           failed_shards=failed)
+
+    def _handle_query_group(self, payload, from_node) -> Dict[str, Any]:
+        from elasticsearch_tpu.search import coordinator as coord
+        targets = [(t[0], int(t[1])) for t in payload["targets"]]
+        return coord.search_shard_group(
+            self.node.indices, targets, payload.get("body"),
+            payload.get("params"), tpu_search=self.node.tpu_search)
+
+    def route_count(self, index_expr: Optional[str],
+                    body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        from elasticsearch_tpu.search import dsl
+        names = self.resolve_indices(index_expr)
+        dsl.parse_query((body or {}).get("query") or {"match_all": {}})
+        by_node, addr, failed = self._route_shards(names)
+        total = 0
+        ok_shards = 0
+        futures = []
+        local_targets = None
+        for node_id, targets in sorted(by_node.items()):
+            if node_id == self.local_node.node_id:
+                local_targets = targets
+                continue
+            futures.append((len(targets), self.transport.send_request_async(
+                addr[node_id], ACTION_COUNT_GROUP,
+                {"targets": targets, "body": body})))
+        if local_targets is not None:
+            res = self._handle_count_group(
+                {"targets": local_targets, "body": body},
+                self.local_node.to_json())
+            total += res["count"]
+            ok_shards += res["shards"]
+        for n_targets, fut in futures:
+            try:
+                res = fut.result(timeout=60.0)
+                total += res["count"]
+                ok_shards += res["shards"]
+            except Exception as exc:  # noqa: BLE001 — partial results
+                failed += n_targets
+                logger.warning("count group failed: %s", exc)
+        return {"count": total,
+                "_shards": {"total": ok_shards + failed,
+                            "successful": ok_shards, "skipped": 0,
+                            "failed": failed}}
+
+    def _handle_count_group(self, payload, from_node) -> Dict[str, Any]:
+        from elasticsearch_tpu.search import dsl
+        from elasticsearch_tpu.search.query_phase import execute_query
+        query = dsl.parse_query(
+            (payload.get("body") or {}).get("query") or {"match_all": {}})
+        total = 0
+        n = 0
+        for name, shard_num in [(t[0], int(t[1]))
+                                for t in payload["targets"]]:
+            shard = self.node.indices.index(name).shard(shard_num)
+            res = execute_query(shard.acquire_searcher(), query, size=0)
+            total += res.total_hits
+            n += 1
+        return {"count": total, "shards": n}
+
+    # ------------------------------------------------------------------
+    # maintenance broadcast (refresh/flush/forcemerge across nodes)
+    # ------------------------------------------------------------------
+
+    def broadcast_maintenance(self, op: str, index_expr: Optional[str]
+                              ) -> Dict[str, Any]:
+        """Reference: the broadcast-by-shard TransportBroadcastAction
+        shape (RestRefreshAction et al) collapsed to one hop per node."""
+        names = self.resolve_indices(index_expr)
+        state = self.applied_state()
+        # every node holding any copy of any target index
+        node_ids: Set[str] = set()
+        n_shards = 0
+        for name in names:
+            for shards in state.routing.get(name, {}).values():
+                for c in shards:
+                    if c.node_id in state.nodes and c.state == STARTED:
+                        node_ids.add(c.node_id)
+                        n_shards += 1
+        futures = []
+        for nid in sorted(node_ids):
+            if nid == self.local_node.node_id:
+                self._handle_maintenance({"op": op, "indices": names},
+                                         self.local_node.to_json())
+            else:
+                futures.append(self.transport.send_request_async(
+                    state.nodes[nid].address, ACTION_MAINTENANCE,
+                    {"op": op, "indices": names}))
+        failed = 0
+        for fut in futures:
+            try:
+                fut.result(timeout=30.0)
+            except Exception:  # noqa: BLE001 — per-node failure counts
+                failed += 1
+        return {"_shards": {"total": n_shards,
+                            "successful": n_shards - failed,
+                            "failed": failed}}
+
+    def _handle_maintenance(self, payload, from_node) -> Dict[str, Any]:
+        op = payload["op"]
+        for name in payload.get("indices") or []:
+            if not self.node.indices.has_index(name):
+                continue
+            svc = self.node.indices.index(name)
+            if op == "refresh":
+                svc.refresh()
+            elif op == "flush":
+                svc.flush()
+            elif op == "forcemerge":
+                for shard in svc.shards.values():
+                    shard.engine.force_merge()
+        return {"acknowledged": True}
+
+    # ------------------------------------------------------------------
+    # replication seam (task: primary→replica fan-out; wired by the
+    # write executors via node.replicate)
+    # ------------------------------------------------------------------
+
+    def replicate_op(self, op: str, index: str, shard: int, doc_id: str,
+                     source: Optional[dict], result) -> None:
+        """Placeholder until the replication fan-out lands: single-copy
+        indices (replicas=0) need nothing; replicated indices are not
+        yet offered (create_index defaults replicas to 0)."""
+
+    # ------------------------------------------------------------------
+    # health / introspection
+    # ------------------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        state = self.applied_state()
+        active_primary = active = initializing = unassigned = 0
+        red = yellow = False
+        for name, meta in state.indices.items():
+            for shard in range(meta.number_of_shards):
+                copies = state.shard_copies(name, shard)
+                primary_ok = False
+                for c in copies:
+                    if c.state == STARTED and c.node_id in state.nodes:
+                        active += 1
+                        if c.primary:
+                            active_primary += 1
+                            primary_ok = True
+                    elif c.state == INITIALIZING:
+                        initializing += 1
+                    else:
+                        unassigned += 1
+                if not primary_ok:
+                    red = True
+                if any(c.state != STARTED for c in copies):
+                    yellow = True
+        status = "red" if red else ("yellow" if yellow else "green")
+        total = active + initializing + unassigned
+        return {
+            "cluster_name": self.node.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": len(state.nodes),
+            "number_of_data_nodes": len(state.nodes),
+            "active_primary_shards": active_primary,
+            "active_shards": active,
+            "relocating_shards": 0,
+            "initializing_shards": initializing,
+            "unassigned_shards": unassigned,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number":
+                (100.0 * active / total) if total else 100.0,
+        }
+
+    def state_json(self) -> Dict[str, Any]:
+        state = self.applied_state()
+        out = state.to_json()
+        out["cluster_name"] = self.node.cluster_name
+        out["master_node"] = state.master_node_id
+        return out
+
+
+def _merge_mapping(base: Optional[dict], update: dict) -> dict:
+    out = dict(base or {})
+    for k, v in (update or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge_mapping(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _rehydrate_error(e: RemoteTransportException) -> EsException:
+    """Map a remote error back to the typed local exception so REST
+    status codes survive the hop (reference: wire exception
+    serialization)."""
+    from elasticsearch_tpu.common import errors as err_mod
+    cls = getattr(err_mod, e.error_type, None)
+    if cls is not None and isinstance(cls, type) \
+            and issubclass(cls, EsException):
+        return cls(e.reason)
+    if e.error_type == "MasterNotDiscoveredException":
+        return MasterNotDiscoveredException(e.reason)
+    if e.error_type in ("NotMasterException", "FailedToCommitException"):
+        return EsException(e.reason)
+    return EsException(f"[{e.error_type}] {e.reason}")

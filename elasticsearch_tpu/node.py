@@ -31,9 +31,13 @@ class Node:
                  settings: Optional[Settings] = None):
         self.settings = settings or Settings.EMPTY
         self.node_name = node_name
-        self.node_id = uuid.uuid4().hex[:20]
+        self.node_id = _load_or_create_node_id(data_path, node_name)
         self.cluster_name = cluster_name
         self.cluster_uuid = uuid.uuid4().hex[:20]
+        self.http_port = 0
+        # cluster mode (multi-node over the transport layer); None ⇒ the
+        # single-node paths in the REST actions
+        self.cluster = None
         self.indices = IndicesService(data_path)
         # the TPU serving path: resident packs + micro-batched kernel
         # (disable with search.tpu_serving.enabled=false — the planner
@@ -61,6 +65,26 @@ class Node:
         self._refresher: Optional[threading.Timer] = None
         self._syncer: Optional[threading.Timer] = None
         self._closed = False
+
+    def start_cluster(self, *, host: str = "127.0.0.1",
+                      transport_port: int = 0,
+                      seed_hosts=None, initial_master_nodes=None) -> None:
+        """Join/bootstrap a multi-node cluster (reference: discovery +
+        coordination startup in Node#start)."""
+        from elasticsearch_tpu.cluster.service import ClusterService
+        self.cluster = ClusterService(
+            self, host=host, transport_port=transport_port,
+            seed_hosts=seed_hosts,
+            initial_master_names=initial_master_nodes)
+        self.cluster.start()
+
+    def replicate(self, op: str, index: str, shard_num: int, doc_id: str,
+                  source, result) -> None:
+        """Primary→replica fan-out seam; no-op single-node (the write
+        executors call this after every primary-phase apply)."""
+        if self.cluster is not None:
+            self.cluster.replicate_op(op, index, shard_num, doc_id,
+                                      source, result)
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, cluster, document,
@@ -137,6 +161,8 @@ class Node:
             self._refresher.cancel()
         if self._syncer:
             self._syncer.cancel()
+        if self.cluster is not None:
+            self.cluster.close()
         if self.tpu_search is not None:
             self.tpu_search.close()
         self.indices.close()
@@ -201,14 +227,68 @@ def serve(node: Node, host: str = "127.0.0.1", port: int = 9200
     return server
 
 
+def _load_or_create_node_id(data_path: str, node_name: str) -> str:
+    """A node's identity must survive restarts (reference: NodeEnvironment
+    node id persistence) so the cluster state keeps referring to it."""
+    import os
+    p = os.path.join(data_path, "_state", "node_id")
+    try:
+        with open(p, "r", encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        pass
+    nid = uuid.uuid4().hex[:20]
+    try:
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(nid)
+    except OSError:
+        pass
+    return nid
+
+
+def _parse_hostport(s: str) -> tuple:
+    s = s.strip()
+    host, sep, port = s.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(
+            f"--seed-hosts entry [{s}] must be host:port (e.g. "
+            f"127.0.0.1:9300)")
+    return (host or "127.0.0.1", int(port))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description="elasticsearch-tpu node")
     parser.add_argument("--port", type=int, default=9200)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--data-path", default="./data")
     parser.add_argument("--node-name", default="node-1")
+    parser.add_argument("--transport-port", type=int, default=None,
+                        help="enable cluster mode on this TCP port "
+                             "(0 = ephemeral)")
+    parser.add_argument("--seed-hosts", default="",
+                        help="comma-separated host:port transport "
+                             "addresses of seed nodes")
+    parser.add_argument("--initial-master-nodes", default="",
+                        help="comma-separated node NAMES forming the "
+                             "bootstrap voting configuration")
+    parser.add_argument("-E", action="append", default=[], metavar="K=V",
+                        dest="settings", help="node setting override")
     args = parser.parse_args()
-    node = Node(args.data_path, node_name=args.node_name)
+    overrides = dict(kv.split("=", 1) for kv in args.settings)
+    node = Node(args.data_path, node_name=args.node_name,
+                settings=Settings.of(overrides))
+    node.http_port = args.port
+    if args.transport_port is not None or args.seed_hosts:
+        seeds = [_parse_hostport(s) for s in args.seed_hosts.split(",")
+                 if s.strip()]
+        masters = [m.strip() for m in args.initial_master_nodes.split(",")
+                   if m.strip()] or [args.node_name]
+        node.start_cluster(host=args.host,
+                           transport_port=args.transport_port or 0,
+                           seed_hosts=seeds, initial_master_nodes=masters)
+        print(f"[{args.node_name}] transport on "
+              f"{args.host}:{node.cluster.transport.port}")
     node.start_refresher()
     server = serve(node, args.host, args.port)
     print(f"[{args.node_name}] listening on http://{args.host}:{args.port}")

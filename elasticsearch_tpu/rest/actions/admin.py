@@ -18,14 +18,22 @@ def register(controller: RestController, node) -> None:
 
     def create_index(req: RestRequest):
         body = req.body or {}
-        settings = Settings.of(body.get("settings") or {})
         mappings = body.get("mappings")
         name = req.param("index")
-        node.create_index(name, settings, mappings)
+        if node.cluster is not None:
+            node.cluster.create_index(name, body.get("settings") or {},
+                                      mappings)
+        else:
+            node.create_index(name, Settings.of(body.get("settings") or {}),
+                              mappings)
         return 200, {"acknowledged": True, "shards_acknowledged": True,
                      "index": name}
 
     def delete_index(req: RestRequest):
+        if node.cluster is not None:
+            for name in node.cluster.resolve_indices(req.param("index")):
+                node.cluster.delete_index(name)
+            return 200, {"acknowledged": True}
         for name in resolve_indices(indices, req.param("index")):
             indices.delete_index(name)
             tpu = getattr(node, "tpu_search", None)
@@ -34,6 +42,23 @@ def register(controller: RestController, node) -> None:
         return 200, {"acknowledged": True}
 
     def get_index(req: RestRequest):
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            out = {}
+            for name in node.cluster.resolve_indices(req.param("index")):
+                meta = state.indices[name]
+                out[name] = {
+                    "aliases": {},
+                    "mappings": meta.mapping or {},
+                    "settings": {"index": {
+                        "number_of_shards": str(meta.number_of_shards),
+                        "number_of_replicas": str(meta.number_of_replicas),
+                        "uuid": meta.uuid}},
+                }
+            if not out:
+                raise IndexNotFoundException(
+                    f"no such index [{req.param('index')}]")
+            return 200, out
         out = {}
         for name in resolve_indices(indices, req.param("index")):
             svc = indices.index(name)
@@ -56,16 +81,29 @@ def register(controller: RestController, node) -> None:
         return 200, out
 
     def head_index(req: RestRequest):
+        if node.cluster is not None:
+            names = node.cluster.resolve_indices(req.param("index"))
+            return (200, {}) if names else (404, {})
         names = resolve_indices(indices, req.param("index"))
         return (200, {}) if names else (404, {})
 
     def put_mapping(req: RestRequest):
+        if node.cluster is not None:
+            for name in node.cluster.resolve_indices(req.param("index")):
+                node.cluster.put_mapping(name, req.body or {})
+            return 200, {"acknowledged": True}
         for name in resolve_indices(indices, req.param("index")):
             indices.index(name).mapper.merge(req.body or {})
         indices.persist_metadata()  # mapping is part of gateway state
         return 200, {"acknowledged": True}
 
     def get_mapping(req: RestRequest):
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            return 200, {
+                name: {"mappings": state.indices[name].mapping or {}}
+                for name in node.cluster.resolve_indices(
+                    req.param("index"))}
         out = {}
         for name in resolve_indices(indices, req.param("index")):
             out[name] = {"mappings": indices.index(name).mapper.to_mapping()}
@@ -82,6 +120,9 @@ def register(controller: RestController, node) -> None:
         return 200, out
 
     def refresh(req: RestRequest):
+        if node.cluster is not None:
+            return 200, node.cluster.broadcast_maintenance(
+                "refresh", req.param("index"))
         n = 0
         for name in resolve_indices(indices, req.param("index")):
             indices.index(name).refresh()
@@ -89,6 +130,9 @@ def register(controller: RestController, node) -> None:
         return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     def flush(req: RestRequest):
+        if node.cluster is not None:
+            return 200, node.cluster.broadcast_maintenance(
+                "flush", req.param("index"))
         n = 0
         for name in resolve_indices(indices, req.param("index")):
             indices.index(name).flush()
@@ -96,6 +140,9 @@ def register(controller: RestController, node) -> None:
         return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
     def forcemerge(req: RestRequest):
+        if node.cluster is not None:
+            return 200, node.cluster.broadcast_maintenance(
+                "forcemerge", req.param("index"))
         n = 0
         for name in resolve_indices(indices, req.param("index")):
             svc = indices.index(name)

@@ -13,6 +13,20 @@ from elasticsearch_tpu.search.coordinator import resolve_indices
 from elasticsearch_tpu.version import __version__ as VERSION
 
 
+def _parse_time_s(value: str) -> float:
+    """Reference TimeValue grammar subset: "500ms" | "30s" | "1m" |
+    bare seconds."""
+    v = value.strip().lower()
+    try:
+        for suffix, scale in (("ms", 0.001), ("s", 1.0), ("m", 60.0),
+                              ("h", 3600.0)):
+            if v.endswith(suffix):
+                return float(v[:-len(suffix)]) * scale
+        return float(v)
+    except ValueError:
+        return 30.0
+
+
 def register(controller: RestController, node) -> None:
     indices = node.indices
 
@@ -28,6 +42,20 @@ def register(controller: RestController, node) -> None:
         }
 
     def health(req: RestRequest):
+        if node.cluster is not None:
+            out = node.cluster.health()
+            want = req.params.get("wait_for_status")
+            if want in ("green", "yellow"):
+                import time as _time
+                rank = {"green": 0, "yellow": 1, "red": 2}
+                deadline = _time.monotonic() + _parse_time_s(
+                    req.params.get("timeout", "30s"))
+                while (rank[out["status"]] > rank[want]
+                       and _time.monotonic() < deadline):
+                    _time.sleep(0.1)
+                    out = node.cluster.health()
+                out["timed_out"] = rank[out["status"]] > rank[want]
+            return 200, out
         n_shards = sum(svc.num_shards for svc in indices.indices.values())
         return 200, {
             "cluster_name": node.cluster_name,
@@ -121,7 +149,29 @@ def register(controller: RestController, node) -> None:
         return _maybe_table(req, ["index", "shard", "prirep", "state",
                                   "docs", "node"], rows)
 
+    def cluster_state(req: RestRequest):
+        if node.cluster is not None:
+            return 200, node.cluster.state_json()
+        return 200, {"cluster_name": node.cluster_name,
+                     "cluster_uuid": node.cluster_uuid,
+                     "master_node": node.node_id,
+                     "nodes": {node.node_id: {"name": node.node_name}}}
+
+    def cat_nodes(req: RestRequest):
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            rows = []
+            for n in state.data_nodes():
+                role = "m" if n.node_id == state.master_node_id else "-"
+                rows.append([n.host, n.port, role, n.name])
+            return _maybe_table(req, ["host", "port", "master", "name"],
+                                rows)
+        return _maybe_table(req, ["host", "port", "master", "name"],
+                            [["127.0.0.1", 9200, "m", node.node_name]])
+
     controller.register("GET", "/", root)
+    controller.register("GET", "/_cluster/state", cluster_state)
+    controller.register("GET", "/_cat/nodes", cat_nodes)
     controller.register("GET", "/_cluster/health", health)
     controller.register("GET", "/_cluster/stats", cluster_stats)
     controller.register("GET", "/_nodes/stats", nodes_stats)

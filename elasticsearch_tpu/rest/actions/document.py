@@ -2,14 +2,19 @@
 
 Reference: `RestIndexAction`, `RestGetAction`, `RestDeleteAction`,
 `RestBulkAction`, `RestMultiGetAction` (SURVEY.md §2.1#10, §3.2). The
-bulk body is NDJSON action/metadata lines exactly like the reference."""
+bulk body is NDJSON action/metadata lines exactly like the reference.
+
+The op executors are module-level functions so the cluster transport
+layer (cluster/service.py) can run the exact same local path when a
+remote node forwards an operation to the shard owner — the reference's
+TransportShardBulkAction / TransportGetAction primary-phase analog."""
 
 from __future__ import annotations
 
 import json
 import time
 import uuid
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common.errors import (DocumentMissingException,
                                              IllegalArgumentException,
@@ -22,109 +27,275 @@ def _auto_id() -> str:
     return uuid.uuid4().hex[:20]
 
 
+# ----------------------------------------------------------------------
+# local op executors — run on the node that owns the target shard
+# ----------------------------------------------------------------------
+
+def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
+                   op_type: str = "index",
+                   shard_num: Optional[int] = None) -> Tuple[int, Dict]:
+    if not isinstance(body, dict):
+        raise IllegalArgumentException("request body is required")
+    # cluster mode: the state applier creates local indices; a missing
+    # index here is a routing error, not an auto-create trigger
+    svc = (node.indices.index(index) if node.cluster is not None
+           else node.get_or_autocreate_index(index))
+    created_id = doc_id or _auto_id()
+    if shard_num is None:
+        shard_num = svc.shard_for_id(created_id, params.get("routing"))
+    shard = svc.shard(shard_num)
+    kwargs = {"op_type": op_type} if op_type != "index" else {}
+    if params.get("if_seq_no") is not None:
+        kwargs["if_seq_no"] = int(params["if_seq_no"])
+    if params.get("if_primary_term") is not None:
+        kwargs["if_primary_term"] = int(params["if_primary_term"])
+    if params.get("version") is not None:
+        kwargs["version"] = int(params["version"])
+        kwargs["version_type"] = params.get("version_type", "internal")
+    result = shard.apply_index_on_primary(created_id, body, **kwargs)
+    node.replicate("index", index, shard_num, created_id, body, result)
+    if params.get("refresh") in ("", "true", "wait_for"):
+        shard.refresh()
+    status = 201 if result.created else 200
+    return status, {
+        "_index": index, "_id": result.doc_id,
+        "_version": result.version, "result": result.result,
+        "_seq_no": result.seq_no, "_primary_term": result.primary_term,
+        "_shards": {"total": 1, "successful": 1, "failed": 0},
+    }
+
+
+def exec_get_doc(node, index: str, doc_id: str, params,
+                 shard_num: Optional[int] = None) -> Tuple[int, Dict]:
+    svc = node.indices.index(index)
+    if shard_num is None:
+        shard_num = svc.shard_for_id(doc_id, params.get("routing"))
+    shard = svc.shard(shard_num)
+    got = shard.get(doc_id)
+    if got is None:
+        return 404, {"_index": index, "_id": doc_id, "found": False}
+    got["_index"] = index
+    return 200, got
+
+
+def exec_delete_doc(node, index: str, doc_id: str, params,
+                    shard_num: Optional[int] = None) -> Tuple[int, Dict]:
+    svc = node.indices.index(index)
+    if shard_num is None:
+        shard_num = svc.shard_for_id(doc_id, params.get("routing"))
+    shard = svc.shard(shard_num)
+    result = shard.apply_delete_on_primary(doc_id)
+    node.replicate("delete", index, shard_num, doc_id, None, result)
+    if params.get("refresh") in ("", "true", "wait_for"):
+        shard.refresh()
+    if not result.found:
+        return 404, {"_index": index, "_id": doc_id,
+                     "result": "not_found", "_version": result.version,
+                     "_seq_no": result.seq_no,
+                     "_primary_term": result.primary_term}
+    return 200, {"_index": index, "_id": doc_id,
+                 "result": "deleted", "_version": result.version,
+                 "_seq_no": result.seq_no,
+                 "_primary_term": result.primary_term,
+                 "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+
+def exec_update_doc(node, index: str, doc_id: str, body, params,
+                    shard_num: Optional[int] = None) -> Tuple[int, Dict]:
+    """_update: doc merge or scripted update is reference behavior;
+    doc-merge and doc_as_upsert are supported here."""
+    svc = node.indices.index(index)
+    if shard_num is None:
+        shard_num = svc.shard_for_id(doc_id, params.get("routing"))
+    shard = svc.shard(shard_num)
+    body = body or {}
+    partial = body.get("doc")
+    if partial is None:
+        raise IllegalArgumentException(
+            "[_update] requires a [doc] (scripted updates need the "
+            "script module)")
+    existing = shard.get(doc_id)
+    if existing is None:
+        if body.get("doc_as_upsert") or "upsert" in body:
+            base = body.get("upsert", {})
+        else:
+            raise DocumentMissingException(f"[{doc_id}]: document missing")
+    else:
+        base = dict(existing["_source"] or {})
+    merged = _deep_merge(base, partial)
+    result = shard.apply_index_on_primary(doc_id, merged)
+    node.replicate("index", index, shard_num, doc_id, merged, result)
+    if params.get("refresh") in ("", "true", "wait_for"):
+        shard.refresh()
+    return 200, {"_index": index, "_id": doc_id,
+                 "_version": result.version, "result": result.result,
+                 "_seq_no": result.seq_no,
+                 "_primary_term": result.primary_term}
+
+
+# ----------------------------------------------------------------------
+# bulk: parse NDJSON → op list; apply list locally; REST reassembles
+# ----------------------------------------------------------------------
+
+def parse_bulk_body(raw: str, default_index: Optional[str]
+                    ) -> List[Dict[str, Any]]:
+    """NDJSON → [{op, index, id, routing, source}] with reference-shaped
+    validation errors."""
+    lines = [ln for ln in raw.split("\n") if ln.strip()]
+    ops: List[Dict[str, Any]] = []
+    i = 0
+    while i < len(lines):
+        try:
+            action_line = json.loads(lines[i])
+        except json.JSONDecodeError as e:
+            raise IllegalArgumentException(
+                f"Malformed action/metadata line [{i + 1}]: {e}")
+        if len(action_line) != 1:
+            raise IllegalArgumentException(
+                f"Malformed action/metadata line [{i + 1}]")
+        op, meta = next(iter(action_line.items()))
+        if op not in ("index", "create", "delete", "update"):
+            raise IllegalArgumentException(f"Unknown bulk action [{op}]")
+        index = meta.get("_index", default_index)
+        doc_id = meta.get("_id")
+        i += 1
+        source = None
+        if op != "delete":
+            if i >= len(lines):
+                raise IllegalArgumentException(
+                    "Validation Failed: bulk source line missing")
+            source = json.loads(lines[i])
+            i += 1
+        ops.append({"op": op, "index": index,
+                    "id": doc_id or _auto_id(),
+                    "routing": meta.get("routing"), "source": source})
+    return ops
+
+
+def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
+                   refresh: bool = False) -> List[Dict[str, Any]]:
+    """Apply parsed bulk ops against LOCAL shards; returns response items
+    in op order. Per-op failures become error items, never exceptions
+    (reference: BulkItemResponse)."""
+    items: List[Dict[str, Any]] = []
+    refresh_shards = set()
+    for entry in ops:
+        op, index, the_id = entry["op"], entry["index"], entry["id"]
+        source = entry.get("source")
+        try:
+            if index is None:
+                raise IllegalArgumentException("_index is missing")
+            svc = (node.indices.index(index) if node.cluster is not None
+                   else node.get_or_autocreate_index(index))
+            shard_num = entry.get("shard")
+            if shard_num is None:
+                shard_num = svc.shard_for_id(the_id, entry.get("routing"))
+            shard = svc.shard(shard_num)
+            if op == "delete":
+                r = shard.apply_delete_on_primary(the_id)
+                node.replicate("delete", index, shard_num, the_id, None, r)
+                status = 200 if r.found else 404
+                items.append({"delete": {
+                    "_index": index, "_id": the_id, "_version": r.version,
+                    "result": "deleted" if r.found else "not_found",
+                    "_seq_no": r.seq_no, "_primary_term": r.primary_term,
+                    "status": status}})
+            elif op == "update":
+                partial = (source or {}).get("doc")
+                existing = shard.get(the_id)
+                if existing is None and not (source or {}).get("doc_as_upsert"):
+                    raise DocumentMissingException(
+                        f"[{the_id}]: document missing")
+                base = dict((existing or {}).get("_source") or {})
+                merged = _deep_merge(base, partial or {})
+                r = shard.apply_index_on_primary(the_id, merged)
+                node.replicate("index", index, shard_num, the_id, merged, r)
+                items.append({"update": {
+                    "_index": index, "_id": the_id, "_version": r.version,
+                    "result": r.result, "_seq_no": r.seq_no,
+                    "_primary_term": r.primary_term, "status": 200}})
+            else:
+                r = shard.apply_index_on_primary(
+                    the_id, source,
+                    **({"op_type": "create"} if op == "create" else {}))
+                node.replicate("index", index, shard_num, the_id, source, r)
+                status = 201 if r.created else 200
+                items.append({op: {
+                    "_index": index, "_id": the_id, "_version": r.version,
+                    "result": r.result, "_seq_no": r.seq_no,
+                    "_primary_term": r.primary_term, "status": status}})
+            refresh_shards.add(shard)
+        except EsException as exc:
+            items.append({op: {
+                "_index": index, "_id": the_id, "status": error_status(exc),
+                "error": {"type": type(exc).__name__, "reason": str(exc)}}})
+    if refresh:
+        for shard in refresh_shards:
+            shard.refresh()
+    return items
+
+
+def bulk_has_errors(items: List[Dict[str, Any]]) -> bool:
+    return any("error" in next(iter(it.values())) for it in items)
+
+
+# ----------------------------------------------------------------------
+# REST registration
+# ----------------------------------------------------------------------
+
 def register(controller: RestController, node) -> None:
     indices = node.indices
 
-    def _index_doc(index: str, doc_id, body, params,
-                   op_type: str = "index") -> Tuple[int, Dict]:
-        if not isinstance(body, dict):
-            raise IllegalArgumentException("request body is required")
-        svc = node.get_or_autocreate_index(index)
-        created_id = doc_id or _auto_id()
-        shard = svc.shard(svc.shard_for_id(created_id,
-                                           params.get("routing")))
-        kwargs = {"op_type": op_type} if op_type != "index" else {}
-        if params.get("if_seq_no") is not None:
-            kwargs["if_seq_no"] = int(params["if_seq_no"])
-        if params.get("if_primary_term") is not None:
-            kwargs["if_primary_term"] = int(params["if_primary_term"])
-        if params.get("version") is not None:
-            kwargs["version"] = int(params["version"])
-            kwargs["version_type"] = params.get("version_type", "internal")
-        result = shard.apply_index_on_primary(created_id, body, **kwargs)
-        if params.get("refresh") in ("", "true", "wait_for"):
-            shard.refresh()
-        status = 201 if result.created else 200
-        return status, {
-            "_index": index, "_id": result.doc_id,
-            "_version": result.version, "result": result.result,
-            "_seq_no": result.seq_no, "_primary_term": result.primary_term,
-            "_shards": {"total": 1, "successful": 1, "failed": 0},
-        }
-
     def put_doc(req: RestRequest):
-        if req.params.get("op_type") == "create":
-            return create_doc(req)
-        return _index_doc(req.param("index"), req.param("id"), req.body,
-                          req.params)
+        op_type = ("create" if req.params.get("op_type") == "create"
+                   else "index")
+        if node.cluster is not None:
+            return node.cluster.route_doc_op(
+                "index" if op_type == "index" else "create",
+                req.param("index"), req.param("id"), req.body, req.params)
+        return exec_index_doc(node, req.param("index"), req.param("id"),
+                              req.body, req.params, op_type=op_type)
 
     def create_doc(req: RestRequest):
         """op_type=create: 409 if the doc exists — enforced inside the
         engine's write lock so concurrent creates serialize (reference:
         version_conflict_engine_exception on op_type=create)."""
-        return _index_doc(req.param("index"), req.param("id"), req.body,
-                          req.params, op_type="create")
+        if node.cluster is not None:
+            return node.cluster.route_doc_op(
+                "create", req.param("index"), req.param("id"), req.body,
+                req.params)
+        return exec_index_doc(node, req.param("index"), req.param("id"),
+                              req.body, req.params, op_type="create")
 
     def post_doc(req: RestRequest):
-        return _index_doc(req.param("index"), None, req.body, req.params)
+        if node.cluster is not None:
+            return node.cluster.route_doc_op(
+                "index", req.param("index"), None, req.body, req.params)
+        return exec_index_doc(node, req.param("index"), None, req.body,
+                              req.params)
 
     def get_doc(req: RestRequest):
-        svc = indices.index(req.param("index"))
-        doc_id = req.param("id")
-        shard = svc.shard(svc.shard_for_id(doc_id, req.param("routing")))
-        got = shard.get(doc_id)
-        if got is None:
-            return 404, {"_index": req.param("index"), "_id": doc_id,
-                         "found": False}
-        got["_index"] = req.param("index")
-        return 200, got
+        if node.cluster is not None:
+            return node.cluster.route_doc_op(
+                "get", req.param("index"), req.param("id"), None, req.params)
+        return exec_get_doc(node, req.param("index"), req.param("id"),
+                            req.params)
 
     def delete_doc(req: RestRequest):
-        svc = indices.index(req.param("index"))
-        doc_id = req.param("id")
-        shard = svc.shard(svc.shard_for_id(doc_id, req.param("routing")))
-        result = shard.apply_delete_on_primary(doc_id)
-        if req.param("refresh") in ("", "true", "wait_for"):
-            shard.refresh()
-        if not result.found:
-            return 404, {"_index": req.param("index"), "_id": doc_id,
-                         "result": "not_found", "_version": result.version,
-                         "_seq_no": result.seq_no,
-                         "_primary_term": result.primary_term}
-        return 200, {"_index": req.param("index"), "_id": doc_id,
-                     "result": "deleted", "_version": result.version,
-                     "_seq_no": result.seq_no,
-                     "_primary_term": result.primary_term,
-                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if node.cluster is not None:
+            return node.cluster.route_doc_op(
+                "delete", req.param("index"), req.param("id"), None,
+                req.params)
+        return exec_delete_doc(node, req.param("index"), req.param("id"),
+                               req.params)
 
     def update_doc(req: RestRequest):
-        """_update: doc merge or scripted update is reference behavior;
-        doc-merge and doc_as_upsert are supported here."""
-        svc = indices.index(req.param("index"))
-        doc_id = req.param("id")
-        shard = svc.shard(svc.shard_for_id(doc_id, req.param("routing")))
-        body = req.body or {}
-        partial = body.get("doc")
-        if partial is None:
-            raise IllegalArgumentException(
-                "[_update] requires a [doc] (scripted updates need the "
-                "script module)")
-        existing = shard.get(doc_id)
-        if existing is None:
-            if body.get("doc_as_upsert") or "upsert" in body:
-                base = body.get("upsert", {})
-            else:
-                raise DocumentMissingException(f"[{doc_id}]: document missing")
-        else:
-            base = dict(existing["_source"] or {})
-        merged = _deep_merge(base, partial)
-        result = shard.apply_index_on_primary(doc_id, merged)
-        if req.param("refresh") in ("", "true", "wait_for"):
-            shard.refresh()
-        return 200, {"_index": req.param("index"), "_id": doc_id,
-                     "_version": result.version, "result": result.result,
-                     "_seq_no": result.seq_no,
-                     "_primary_term": result.primary_term}
+        if node.cluster is not None:
+            return node.cluster.route_doc_op(
+                "update", req.param("index"), req.param("id"), req.body,
+                req.params)
+        return exec_update_doc(node, req.param("index"), req.param("id"),
+                               req.body, req.params)
 
     def mget(req: RestRequest):
         body = req.body or {}
@@ -139,15 +310,22 @@ def register(controller: RestController, node) -> None:
             index = spec.get("_index", default_index)
             doc_id = spec["_id"]
             try:
-                svc = indices.index(index)
-                shard = svc.shard(svc.shard_for_id(doc_id))
-                got = shard.get(doc_id)
+                if node.cluster is not None:
+                    _status, got = node.cluster.route_doc_op(
+                        "get", index, doc_id, None, {})
+                    if not got.get("found", "_source" in got):
+                        got = None
+                else:
+                    svc = indices.index(index)
+                    shard = svc.shard(svc.shard_for_id(doc_id))
+                    got = shard.get(doc_id)
+                    if got is not None:
+                        got["_index"] = index
             except EsException:
                 got = None
             if got is None:
                 out.append({"_index": index, "_id": doc_id, "found": False})
             else:
-                got["_index"] = index
                 out.append(got)
         return 200, {"docs": out}
 
@@ -155,84 +333,14 @@ def register(controller: RestController, node) -> None:
         t0 = time.perf_counter()
         raw = req.raw_body.decode("utf-8") if req.raw_body else (
             req.body if isinstance(req.body, str) else "")
-        default_index = req.param("index")
-        lines = [ln for ln in raw.split("\n") if ln.strip()]
-        items = []
-        errors = False
-        i = 0
-        refresh_shards = set()
-        while i < len(lines):
-            try:
-                action_line = json.loads(lines[i])
-            except json.JSONDecodeError as e:
-                raise IllegalArgumentException(
-                    f"Malformed action/metadata line [{i + 1}]: {e}")
-            if len(action_line) != 1:
-                raise IllegalArgumentException(
-                    f"Malformed action/metadata line [{i + 1}]")
-            op, meta = next(iter(action_line.items()))
-            if op not in ("index", "create", "delete", "update"):
-                raise IllegalArgumentException(f"Unknown bulk action [{op}]")
-            index = meta.get("_index", default_index)
-            doc_id = meta.get("_id")
-            i += 1
-            source = None
-            if op != "delete":
-                if i >= len(lines):
-                    raise IllegalArgumentException(
-                        "Validation Failed: bulk source line missing")
-                source = json.loads(lines[i])
-                i += 1
-            try:
-                if index is None:
-                    raise IllegalArgumentException("_index is missing")
-                svc = node.get_or_autocreate_index(index)
-                the_id = doc_id or _auto_id()
-                shard = svc.shard(svc.shard_for_id(
-                    the_id, meta.get("routing")))
-                if op == "delete":
-                    r = shard.apply_delete_on_primary(the_id)
-                    status = 200 if r.found else 404
-                    items.append({"delete": {
-                        "_index": index, "_id": the_id, "_version": r.version,
-                        "result": "deleted" if r.found else "not_found",
-                        "_seq_no": r.seq_no, "_primary_term": r.primary_term,
-                        "status": status}})
-                    if not r.found:
-                        pass  # not an "error" per reference semantics
-                elif op == "update":
-                    partial = (source or {}).get("doc")
-                    existing = shard.get(the_id)
-                    if existing is None and not (source or {}).get("doc_as_upsert"):
-                        raise DocumentMissingException(
-                            f"[{the_id}]: document missing")
-                    base = dict((existing or {}).get("_source") or {})
-                    r = shard.apply_index_on_primary(
-                        the_id, _deep_merge(base, partial or {}))
-                    items.append({"update": {
-                        "_index": index, "_id": the_id, "_version": r.version,
-                        "result": r.result, "_seq_no": r.seq_no,
-                        "_primary_term": r.primary_term, "status": 200}})
-                else:
-                    r = shard.apply_index_on_primary(
-                        the_id, source,
-                        **({"op_type": "create"} if op == "create" else {}))
-                    status = 201 if r.created else 200
-                    items.append({op: {
-                        "_index": index, "_id": the_id, "_version": r.version,
-                        "result": r.result, "_seq_no": r.seq_no,
-                        "_primary_term": r.primary_term, "status": status}})
-                refresh_shards.add(shard)
-            except EsException as exc:
-                errors = True
-                items.append({op: {
-                    "_index": index, "_id": doc_id, "status": error_status(exc),
-                    "error": {"type": type(exc).__name__, "reason": str(exc)}}})
-        if req.param("refresh") in ("", "true", "wait_for"):
-            for shard in refresh_shards:
-                shard.refresh()
+        ops = parse_bulk_body(raw, req.param("index"))
+        refresh = req.param("refresh") in ("", "true", "wait_for")
+        if node.cluster is not None:
+            items = node.cluster.route_bulk(ops, refresh=refresh)
+        else:
+            items = apply_bulk_ops(node, ops, refresh=refresh)
         return 200, {"took": int((time.perf_counter() - t0) * 1000),
-                     "errors": errors, "items": items}
+                     "errors": bulk_has_errors(items), "items": items}
 
     controller.register("PUT", "/{index}/_doc/{id}", put_doc)
     controller.register("POST", "/{index}/_doc/{id}", put_doc)

@@ -14,11 +14,17 @@ def register(controller: RestController, node) -> None:
     indices = node.indices
 
     def do_search(req: RestRequest):
+        if node.cluster is not None:
+            return 200, node.cluster.route_search(
+                req.param("index"), req.body or {}, req.params)
         return 200, coordinator.search(
             indices, req.param("index"), req.body or {}, req.params,
             tpu_search=getattr(node, "tpu_search", None))
 
     def do_count(req: RestRequest):
+        if node.cluster is not None:
+            return 200, node.cluster.route_count(req.param("index"),
+                                                 req.body or {})
         return 200, coordinator.count(indices, req.param("index"),
                                       req.body or {})
 

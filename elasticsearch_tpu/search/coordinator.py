@@ -266,6 +266,206 @@ def _search_fast(indices: IndicesService, names: List[str],
     }
 
 
+# ----------------------------------------------------------------------
+# cross-node query_then_fetch (reference: the shard-level
+# SearchTransportService hops — query + fetch executed on the node that
+# owns each shard, merged by the coordinating node, SURVEY.md §3.3)
+# ----------------------------------------------------------------------
+
+def search_shard_group(indices: IndicesService,
+                       targets: List[Tuple[str, int]],
+                       body: Optional[Dict[str, Any]],
+                       params: Optional[Dict[str, str]] = None,
+                       tpu_search=None) -> Dict[str, Any]:
+    """Execute the query phase (+ eager fetch of the local window) over
+    an explicit list of LOCAL (index, shard) targets, returning a
+    JSON-serializable partial result the coordinating node merges with
+    `merge_group_responses`. Aggregation partials travel as a pickled
+    blob — inter-node RPC is a trusted channel exactly like the
+    reference's native transport serialization."""
+    params = params or {}
+    query, aggs, body = parse_search_body(body or {})
+    size = int(params.get("size", body.get("size", 10)))
+    from_ = int(params.get("from", body.get("from", 0)))
+    k = size + from_
+    min_score = body.get("min_score")
+    source = body.get("_source", True)
+    from elasticsearch_tpu.search import sort as sort_mod
+    sort_specs = sort_mod.parse_sort(body.get("sort"))
+    search_after = body.get("search_after")
+    want_version = bool(body.get("version"))
+    want_seqno = bool(body.get("seq_no_primary_term"))
+
+    by_index: Dict[str, List[int]] = {}
+    for name, shard_num in targets:
+        by_index.setdefault(name, []).append(shard_num)
+
+    # TPU fast path per index when the group covers every local shard of
+    # that index (cluster allocation puts whole local shard sets in one
+    # group, so this is the common case)
+    shard_results = []
+    agg_parts = []   # one partial per executed shard, hits or not
+    total = 0
+    relation = "eq"
+    for name, shard_nums in sorted(by_index.items()):
+        svc = indices.index(name)
+        used_fast = False
+        if (tpu_search is not None and aggs is None and not sort_specs
+                and search_after is None and k > 0
+                and set(shard_nums) == set(svc.shards.keys())):
+            res = tpu_search.try_search(svc, query, k=k)
+            if res is not None:
+                used_fast = True
+                total += res.total_hits
+                if getattr(res, "total_relation", "eq") == "gte":
+                    relation = "gte"
+                for rank, hit in enumerate(res.hits):
+                    score, shard_num, seg_name, ord_, doc_id = hit
+                    if min_score is not None and score < min_score:
+                        continue
+                    reader = (res.resident.readers.get(shard_num)
+                              if res.resident is not None else None)
+                    if reader is None:
+                        reader = svc.shard(shard_num).acquire_searcher()
+                    from elasticsearch_tpu.search.query_phase import (
+                        ShardDocRef, ShardHit)
+                    sh = ShardHit(doc_id, score, ShardDocRef(seg_name, ord_))
+                    doc = execute_fetch(reader, [sh], source,
+                                        version=want_version,
+                                        seq_no_primary_term=want_seqno)[0]
+                    doc["_index"] = name
+                    doc["_score"] = score
+                    doc["__shard"] = shard_num
+                    shard_results.append(("__fast__", name, shard_num,
+                                          rank, doc))
+        if not used_fast:
+            for shard_num in sorted(shard_nums):
+                shard = svc.shard(shard_num)
+                reader = shard.acquire_searcher()
+                res = execute_query(reader, query, size=k, from_=0,
+                                    min_score=min_score, aggs=aggs,
+                                    sort_specs=sort_specs or None,
+                                    search_after=search_after)
+                total += res.total_hits
+                if aggs is not None and res.aggregations is not None:
+                    agg_parts.append(res.aggregations)
+                fetched = execute_fetch(reader, res.hits, source,
+                                        version=want_version,
+                                        seq_no_primary_term=want_seqno)
+                for rank, (hit, doc) in enumerate(zip(res.hits, fetched)):
+                    doc["_index"] = name
+                    doc["_score"] = hit.score
+                    if hit.sort_values is not None:
+                        doc["sort"] = hit.sort_values
+                    doc["__shard"] = shard_num
+                    shard_results.append((res, name, shard_num, rank, doc))
+
+    # local pre-merge: keep only the node-level top-k (the coordinator
+    # re-merges, so shipping more than k per node is pure waste)
+    entries = []
+    for res, name, shard_num, rank, doc in shard_results:
+        if sort_specs:
+            key = sort_mod.sort_key(sort_specs, doc.get("sort") or [])
+        else:
+            key = -(doc.get("_score") or 0.0)
+        entries.append((key, name, shard_num, rank, doc))
+    entries.sort(key=lambda t: t[:4])
+    hits = []
+    for key, name, shard_num, rank, doc in entries[:k]:
+        hits.append(doc)
+
+    out: Dict[str, Any] = {
+        "hits": hits, "total": total, "relation": relation,
+        "shards": len({(n, s) for n, s in targets}),
+        "max_score": (max((d.get("_score") or float("-inf")
+                           for d in hits), default=None)
+                      if not sort_specs and hits else None),
+    }
+    if aggs:
+        import base64
+        import pickle
+        out["aggs_blob"] = base64.b64encode(
+            pickle.dumps(agg_parts)).decode("ascii")
+    return out
+
+
+def merge_group_responses(groups: List[Dict[str, Any]],
+                          body: Optional[Dict[str, Any]],
+                          params: Optional[Dict[str, str]],
+                          t0: float,
+                          failed_shards: int = 0) -> Dict[str, Any]:
+    """Coordinator-side reduce of `search_shard_group` partials into one
+    reference-shaped _search response."""
+    params = params or {}
+    body = body or {}
+    size = int(params.get("size", body.get("size", 10)))
+    from_ = int(params.get("from", body.get("from", 0)))
+    from elasticsearch_tpu.search import sort as sort_mod
+    sort_specs = sort_mod.parse_sort(body.get("sort"))
+
+    merged = []
+    total = 0
+    relation = "eq"
+    n_shards = failed_shards
+    for gi, g in enumerate(groups):
+        total += g["total"]
+        n_shards += g.get("shards", 0)
+        if g.get("relation") == "gte":
+            relation = "gte"
+        for rank, doc in enumerate(g["hits"]):
+            if sort_specs:
+                key = sort_mod.sort_key(sort_specs, doc.get("sort") or [])
+            else:
+                key = -(doc.get("_score") or 0.0)
+            merged.append((key, doc.get("_index", ""),
+                           doc.pop("__shard", 0), rank, doc))
+    merged.sort(key=lambda t: t[:4])
+    window = [doc for _, _, _, _, doc in merged[from_: from_ + size]]
+
+    if sort_specs:
+        only_score = all(s.field == "_score" for s in sort_specs)
+        max_score = None
+        if only_score and merged:
+            max_score = max((d.get("_score") or float("-inf")
+                             for *_id, d in merged), default=None)
+        if not only_score:
+            for doc in window:
+                doc["_score"] = None
+    else:
+        max_score = max((g.get("max_score") for g in groups
+                         if g.get("max_score") is not None),
+                        default=None)
+
+    out: Dict[str, Any] = {
+        "took": int((time.perf_counter() - t0) * 1000),
+        "timed_out": False,
+        "_shards": {"total": n_shards,
+                    "successful": n_shards - failed_shards, "skipped": 0,
+                    "failed": failed_shards},
+        "hits": {"total": {"value": total, "relation": relation},
+                 "max_score": max_score,
+                 "hits": window},
+    }
+
+    aggs_spec = body.get("aggs") or body.get("aggregations")
+    if aggs_spec:
+        import base64
+        import pickle
+        parts = []
+        for g in groups:
+            blob = g.get("aggs_blob")
+            if blob:
+                parts.extend(pickle.loads(base64.b64decode(blob)))
+        if parts:
+            reduced = AggregatorFactories.reduce(parts)
+            out["aggregations"] = AggregatorFactories.to_response(reduced)
+        else:
+            aggs = parse_aggregations(aggs_spec)
+            out["aggregations"] = AggregatorFactories.to_response(
+                aggs.empty())
+    return out
+
+
 def count(indices: IndicesService, index_expr: Optional[str],
           body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     names = resolve_indices(indices, index_expr)

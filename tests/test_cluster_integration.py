@@ -1,0 +1,237 @@
+"""Multi-node cluster integration: three real Node instances wired over
+the TCP transport in one process — election, state publication, shard
+allocation across nodes, routed CRUD/bulk, cross-node query_then_fetch,
+aggs/sort merge, broadcast refresh, index delete.
+
+Reference analog: the *IT suites (ClusterHealthIT, SimpleClusterStateIT,
+TransportSearchIT shapes — SURVEY.md §4.3) on an internalCluster."""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _free_ports(n: int):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+NODE_NAMES = ["node-0", "node-1", "node-2"]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    ports = _free_ports(3)
+    seeds = [("127.0.0.1", p) for p in ports]
+    nodes = []
+    for i, name in enumerate(NODE_NAMES):
+        data = tmp_path_factory.mktemp(f"data-{name}")
+        node = Node(str(data), node_name=name,
+                    settings=Settings.of(
+                        {"search.tpu_serving.enabled": "false"}))
+        node.start_cluster(transport_port=ports[i], seed_hosts=seeds,
+                           initial_master_nodes=NODE_NAMES)
+        nodes.append(node)
+    # wait for a master + full membership
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        healths = [n.cluster.health() for n in nodes]
+        if all(h["number_of_nodes"] == 3 for h in healths):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(
+            f"cluster did not form: {[n.cluster.health() for n in nodes]}")
+    yield nodes
+    for node in nodes:
+        node.close()
+
+
+def _handle(node, method, path, params=None, body=None):
+    if isinstance(body, str):
+        return node.handle(method, path, params, None,
+                           body.encode("utf-8"))
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+def test_cluster_forms_and_elects_one_master(cluster):
+    masters = [n.cluster.coordinator.is_master() for n in cluster]
+    assert sum(masters) == 1
+    state = cluster[0].cluster.applied_state()
+    assert len(state.nodes) == 3
+    # every node applied the same state version
+    versions = {n.cluster.applied_state().version for n in cluster}
+    assert len(versions) == 1
+
+
+def test_create_index_allocates_shards_across_nodes(cluster):
+    status, body = _handle(cluster[0], "PUT", "/dist", body={
+        "settings": {"number_of_shards": 3, "number_of_replicas": 0},
+        "mappings": {"properties": {"title": {"type": "text"},
+                                    "rank": {"type": "integer"},
+                                    "tag": {"type": "keyword"}}}})
+    assert status == 200, body
+    # health green on every node once shard-started round-trips land
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        h = cluster[1].cluster.health()
+        if h["status"] == "green" and h["active_primary_shards"] >= 3:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(cluster[1].cluster.health())
+    # fewest-shards-first allocation puts one shard on each node
+    state = cluster[0].cluster.applied_state()
+    owners = {state.primary("dist", s).node_id for s in range(3)}
+    assert len(owners) == 3
+
+
+def test_bulk_routes_to_owners_and_search_merges(cluster):
+    lines = []
+    for i in range(30):
+        lines.append(json.dumps({"index": {"_index": "dist",
+                                           "_id": f"doc-{i}"}}))
+        lines.append(json.dumps({
+            "title": "alpha common" if i % 3 == 0 else "beta common",
+            "rank": i, "tag": f"t{i % 5}"}))
+    status, body = _handle(cluster[1], "POST", "/_bulk",
+                           body="\n".join(lines) + "\n")
+    assert status == 200
+    assert body["errors"] is False
+    assert len(body["items"]) == 30
+    # docs really spread across all three nodes' local shards
+    local_counts = []
+    for node in cluster:
+        svc = node.indices.index("dist")
+        local_counts.append(
+            sum(s.engine.num_docs() for s in svc.shards.values()))
+    assert sum(local_counts) == 30
+    assert all(c > 0 for c in local_counts)
+
+    # broadcast refresh from a node that owns only one shard
+    status, body = _handle(cluster[2], "POST", "/dist/_refresh")
+    assert status == 200
+    assert body["_shards"]["failed"] == 0
+
+    # cross-node search from every node returns the same global result
+    for node in cluster:
+        status, res = _handle(node, "POST", "/dist/_search", body={
+            "query": {"match": {"title": "alpha"}}, "size": 20})
+        assert status == 200, res
+        assert res["hits"]["total"]["value"] == 10
+        assert len(res["hits"]["hits"]) == 10
+        assert res["_shards"]["total"] == 3
+        assert res["_shards"]["failed"] == 0
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {f"doc-{i}" for i in range(0, 30, 3)}
+
+
+def test_get_routes_to_owner(cluster):
+    for node in cluster:
+        status, body = _handle(node, "GET", "/dist/_doc/doc-7")
+        assert status == 200
+        assert body["_source"]["rank"] == 7
+
+
+def test_update_and_delete_route(cluster):
+    status, body = _handle(cluster[2], "POST", "/dist/_update/doc-7",
+                           body={"doc": {"rank": 700}})
+    assert status == 200, body
+    status, body = _handle(cluster[0], "GET", "/dist/_doc/doc-7")
+    assert body["_source"]["rank"] == 700
+    status, body = _handle(cluster[1], "DELETE", "/dist/_doc/doc-7")
+    assert status == 200
+    status, body = _handle(cluster[0], "GET", "/dist/_doc/doc-7")
+    assert status == 404
+
+
+def test_sorted_search_across_nodes(cluster):
+    _handle(cluster[0], "POST", "/dist/_refresh")
+    status, res = _handle(cluster[0], "POST", "/dist/_search", body={
+        "query": {"match_all": {}}, "sort": [{"rank": "desc"}], "size": 5})
+    assert status == 200, res
+    ranks = [h["sort"][0] for h in res["hits"]["hits"]]
+    assert ranks == sorted(ranks, reverse=True)
+    # doc-7 (the one bumped to rank 700) was deleted above; 29 is max
+    assert ranks[0] == 29
+    assert ranks == [29, 28, 27, 26, 25]
+
+
+def test_aggregations_reduce_across_nodes(cluster):
+    status, res = _handle(cluster[1], "POST", "/dist/_search", body={
+        "size": 0,
+        "aggs": {"tags": {"terms": {"field": "tag"}},
+                 "avg_rank": {"avg": {"field": "rank"}}}})
+    assert status == 200, res
+    buckets = res["aggregations"]["tags"]["buckets"]
+    assert sum(b["doc_count"] for b in buckets) == 29  # doc-7 deleted
+    assert {b["key"] for b in buckets} == {f"t{i}" for i in range(5)}
+    assert res["aggregations"]["avg_rank"]["value"] == pytest.approx(
+        (sum(range(30)) - 7 + 700 - 700) / 29)
+
+
+def test_count_across_nodes(cluster):
+    status, res = _handle(cluster[2], "POST", "/dist/_count",
+                          body={"query": {"match_all": {}}})
+    assert status == 200
+    assert res["count"] == 29
+
+
+def test_doc_op_on_missing_index_autocreates(cluster):
+    status, body = _handle(cluster[1], "PUT", "/auto/_doc/1",
+                           body={"x": 1})
+    assert status == 201, body
+    state = cluster[1].cluster.applied_state()
+    assert "auto" in state.indices
+    status, body = _handle(cluster[2], "GET", "/auto/_doc/1")
+    assert status == 200
+
+
+def test_mget_and_version_conflict(cluster):
+    status, body = _handle(cluster[0], "POST", "/_mget", body={
+        "docs": [{"_index": "dist", "_id": "doc-1"},
+                 {"_index": "dist", "_id": "doc-7"}]})
+    assert status == 200
+    assert body["docs"][0]["found"] is True
+    assert body["docs"][1]["found"] is False
+    # op_type=create on an existing doc → 409 across the hop
+    status, body = _handle(cluster[2], "PUT", "/dist/_create/doc-1",
+                           body={"title": "dup"})
+    assert status == 409, body
+
+
+def test_read_of_missing_index_does_not_autocreate(cluster):
+    status, body = _handle(cluster[0], "GET", "/nope/_doc/1")
+    assert status == 404
+    assert "nope" not in cluster[0].cluster.applied_state().indices
+    status, body = _handle(cluster[1], "DELETE", "/nope/_doc/1")
+    assert status == 404
+    assert "nope" not in cluster[1].cluster.applied_state().indices
+
+
+def test_delete_index_everywhere(cluster):
+    status, body = _handle(cluster[1], "DELETE", "/auto")
+    assert status == 200
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if not any(n.indices.has_index("auto") for n in cluster):
+            break
+        time.sleep(0.1)
+    assert not any(n.indices.has_index("auto") for n in cluster)
+    status, _ = _handle(cluster[0], "GET", "/auto/_doc/1")
+    assert status == 404

@@ -1,0 +1,147 @@
+"""Three separate OS processes form a cluster over localhost TCP and
+serve bulk + search with cross-process shard routing — the full
+distributed deployment shape (reference: a real multi-node cluster, not
+the in-process internalCluster of test_cluster_integration).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(port, method, path, body=None, timeout=30):
+    data = None
+    if body is not None:
+        data = (body if isinstance(body, (bytes, str))
+                else json.dumps(body))
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8"))
+
+
+@pytest.fixture(scope="module")
+def procs(tmp_path_factory):
+    http_ports = _free_ports(3)
+    transport_ports = _free_ports(3)
+    seeds = ",".join(f"127.0.0.1:{p}" for p in transport_ports)
+    names = ",".join(f"proc-{i}" for i in range(3))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    running = []
+    for i in range(3):
+        data = tmp_path_factory.mktemp(f"pdata-{i}")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "elasticsearch_tpu.node",
+             "--port", str(http_ports[i]),
+             "--node-name", f"proc-{i}",
+             "--data-path", str(data),
+             "--transport-port", str(transport_ports[i]),
+             "--seed-hosts", seeds,
+             "--initial-master-nodes", names,
+             "-E", "search.tpu_serving.enabled=false"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        running.append(p)
+    # wait for all HTTP endpoints + full membership
+    deadline = time.monotonic() + 90
+    ready = False
+    while time.monotonic() < deadline and not ready:
+        try:
+            oks = []
+            for port in http_ports:
+                _s, h = _req(port, "GET", "/_cluster/health", timeout=5)
+                oks.append(h.get("number_of_nodes") == 3)
+            ready = all(oks)
+        except (OSError, urllib.error.URLError, json.JSONDecodeError):
+            pass
+        if not ready:
+            if any(p.poll() is not None for p in running):
+                out = b"\n---\n".join(
+                    (p.stdout.read() if p.stdout else b"")
+                    for p in running if p.poll() is not None)
+                raise AssertionError(
+                    f"node process died during startup:\n"
+                    f"{out.decode(errors='replace')[-4000:]}")
+            time.sleep(0.5)
+    if not ready:
+        for p in running:
+            p.send_signal(signal.SIGKILL)
+        raise AssertionError("3-process cluster did not form in 90s")
+    yield http_ports
+    for p in running:
+        p.send_signal(signal.SIGTERM)
+    for p in running:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def test_three_process_bulk_and_search(procs):
+    p0, p1, p2 = procs
+    status, body = _req(p0, "PUT", "/books", {
+        "settings": {"number_of_shards": 3, "number_of_replicas": 0},
+        "mappings": {"properties": {"title": {"type": "text"},
+                                    "year": {"type": "integer"}}}})
+    assert status == 200, body
+
+    lines = []
+    for i in range(24):
+        lines.append(json.dumps({"index": {"_index": "books",
+                                           "_id": f"b{i}"}}))
+        lines.append(json.dumps(
+            {"title": f"search {'engines' if i % 2 else 'systems'}",
+             "year": 2000 + i}))
+    status, body = _req(p1, "POST", "/_bulk", "\n".join(lines) + "\n")
+    assert status == 200, body
+    assert body["errors"] is False
+
+    status, body = _req(p2, "POST", "/books/_refresh")
+    assert status == 200 and body["_shards"]["failed"] == 0
+
+    # search via the third process sees every shard's docs
+    status, res = _req(p2, "POST", "/books/_search", {
+        "query": {"match": {"title": "engines"}}, "size": 20})
+    assert status == 200, res
+    assert res["hits"]["total"]["value"] == 12
+    assert res["_shards"]["total"] == 3 and res["_shards"]["failed"] == 0
+
+    # get routed across processes
+    status, doc = _req(p0, "GET", "/books/_doc/b13")
+    assert status == 200 and doc["_source"]["year"] == 2013
+
+    # sorted search merges across processes
+    status, res = _req(p1, "POST", "/books/_search", {
+        "query": {"match_all": {}}, "sort": [{"year": "desc"}], "size": 3})
+    assert status == 200, res
+    assert [h["sort"][0] for h in res["hits"]["hits"]] == [2023, 2022, 2021]
