@@ -108,11 +108,21 @@ class AllocationService:
                         if c.node_id in load:
                             load[c.node_id] += 1
             for idx, shards in sorted(routing.items()):
+                meta = state.indices.get(idx)
                 for s, copies in sorted(shards.items()):
                     taken = {c.node_id for c in copies if c.node_id}
                     for i, c in enumerate(copies):
                         if c.node_id is not None:
                             continue
+                        # primary safety: once a shard has in-sync copies,
+                        # a fresh (empty) primary may never be allocated —
+                        # only promotion of a started in-sync replica is
+                        # allowed (reference: PrimaryShardAllocator +
+                        # inSyncAllocationIds). Otherwise a dead primary
+                        # would silently respawn empty and report green.
+                        if (c.primary and meta is not None
+                                and meta.in_sync.get(str(s))):
+                            continue  # stays unassigned → red
                         candidates = [nid for nid in nodes
                                       if nid not in taken
                                       and (self.watermark_check is None
@@ -132,7 +142,9 @@ class AllocationService:
     @staticmethod
     def shard_started(state: ClusterState, index: str, shard: int,
                       allocation_id: str) -> ClusterState:
-        """reference: ShardStateAction shard-started → routing STARTED."""
+        """reference: ShardStateAction shard-started → routing STARTED +
+        the allocation id joins the in-sync set (it holds a complete,
+        recovered copy from this point on)."""
         routing = {idx: {s: list(c) for s, c in sh.items()}
                    for idx, sh in state.routing.items()}
         copies = routing.get(index, {}).get(shard)
@@ -146,7 +158,21 @@ class AllocationService:
                 changed = True
         if not changed:
             return state
-        return state.with_updates(routing=routing)
+        import dataclasses as _dc
+        meta = state.indices.get(index)
+        new_indices = dict(state.indices)
+        if meta is not None:
+            in_sync = {k: list(v) for k, v in meta.in_sync.items()}
+            # the in-sync set tracks only currently-assigned copies: stale
+            # ids of long-gone allocations would block nothing useful and
+            # grow without bound
+            active = {c.allocation_id for c in copies}
+            cur = [a for a in in_sync.get(str(shard), []) if a in active]
+            if allocation_id not in cur:
+                cur.append(allocation_id)
+            in_sync[str(shard)] = cur
+            new_indices[index] = _dc.replace(meta, in_sync=in_sync)
+        return state.with_updates(routing=routing, indices=new_indices)
 
     @staticmethod
     def shard_failed(state: ClusterState, index: str, shard: int,

@@ -22,6 +22,7 @@ heavy arrays never cross the host network (SURVEY §2.4 two-tier comms).
 
 from __future__ import annotations
 
+import base64
 import heapq
 import itertools
 import json
@@ -42,13 +43,21 @@ from elasticsearch_tpu.common.errors import (EsException,
                                              IndexNotFoundException)
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.translog import write_atomic
-from elasticsearch_tpu.transport.service import (RemoteTransportException,
+from elasticsearch_tpu.transport.service import (ConnectTransportException,
+                                                 RemoteTransportException,
                                                  TransportService)
 
 logger = logging.getLogger("elasticsearch_tpu.cluster")
 
 # data-plane actions (reference: indices:data/write/*, indices:data/read/*)
 ACTION_DOC_OP = "indices/data/doc_op"
+ACTION_REPLICA_OP = "indices/data/replica_op"
+# peer recovery (reference: internal:index/shard/recovery/*)
+ACTION_RECOVERY_START = "indices/recovery/start"
+ACTION_RECOVERY_FILE = "indices/recovery/file_chunk"
+ACTION_RECOVERY_OPS = "indices/recovery/translog_ops"
+ACTION_RECOVERY_FINISH = "indices/recovery/finish"
+ACTION_STORE_FOUND = "cluster/shard/store_found"
 ACTION_BULK = "indices/data/bulk_group"
 ACTION_QUERY_GROUP = "indices/data/search_group"
 ACTION_COUNT_GROUP = "indices/data/count_group"
@@ -58,6 +67,9 @@ ACTION_CREATE_INDEX = "cluster/admin/create_index"
 ACTION_DELETE_INDEX = "cluster/admin/delete_index"
 ACTION_PUT_MAPPING = "cluster/admin/put_mapping"
 ACTION_SHARD_STARTED = "cluster/shard/started"
+ACTION_SHARD_FAILED = "cluster/shard/failed"
+
+_RECOVERY_CHUNK = 1 << 20  # 1MB file-copy chunks
 
 
 class MasterNotDiscoveredException(EsException):
@@ -225,12 +237,36 @@ class ClusterService:
                 (ACTION_CREATE_INDEX, self._handle_create_index),
                 (ACTION_DELETE_INDEX, self._handle_delete_index),
                 (ACTION_PUT_MAPPING, self._handle_put_mapping),
-                (ACTION_SHARD_STARTED, self._handle_shard_started)):
+                (ACTION_SHARD_STARTED, self._handle_shard_started),
+                (ACTION_SHARD_FAILED, self._handle_shard_failed),
+                (ACTION_REPLICA_OP, self._handle_replica_op),
+                (ACTION_RECOVERY_START, self._handle_recovery_start),
+                (ACTION_RECOVERY_FILE, self._handle_recovery_file),
+                (ACTION_RECOVERY_OPS, self._handle_recovery_ops),
+                (ACTION_RECOVERY_FINISH, self._handle_recovery_finish),
+                (ACTION_STORE_FOUND, self._handle_store_found)):
             self.transport.register_handler(action, handler)
+        # replica recoveries in flight on this node, keyed (index, shard)
+        self._recovering: Set[Tuple[str, int]] = set()
+        self._recovering_lock = threading.Lock()
+        # recoveries this node is SOURCING, keyed (index, shard, aid):
+        # {release (translog retention), address, expires}. The primary
+        # fans live ops out to these targets from registration onward —
+        # the reference's replication-group tracking during recovery —
+        # and holds their translog ops against trim.
+        self._recovery_sources: Dict[Tuple[str, int, str],
+                                     Dict[str, Any]] = {}
+        self._recovery_sources_lock = threading.Lock()
 
     def start(self) -> None:
         self._applier.start()
         self.coordinator.start()
+
+        def sweep():
+            self._expire_recovery_sources()
+            self.scheduler.schedule(60.0, sweep)
+
+        self.scheduler.schedule(60.0, sweep)
 
     def close(self) -> None:
         self.coordinator.stop()
@@ -260,6 +296,8 @@ class ClusterService:
                 state, self._pending_state = self._pending_state, None
             try:
                 self._reconcile(state)
+                self._prune_recovery_sources(state)
+                self._report_local_stores(state)
             except Exception:  # noqa: BLE001 — applier bug must not die
                 logger.exception("[%s] state reconcile failed",
                                  self.local_node.name)
@@ -330,20 +368,138 @@ class ClusterService:
             for shard_num in [s for s in list(svc.shards) if s not in wanted]:
                 shard = svc.shards.pop(shard_num)
                 shard.close()
-            # create/promote assigned copies
+            # create/promote assigned copies. Primaries open from the
+            # local store immediately; replicas run peer recovery from
+            # their primary (file sync + translog replay) BEFORE they
+            # report started (reference: IndexShard#startRecovery →
+            # PeerRecoveryTargetService).
             for shard_num, copy in wanted.items():
                 shard = svc.shards.get(shard_num)
-                if shard is None:
-                    shard = svc.create_shard(shard_num, primary=copy.primary,
-                                             allocation_id=copy.allocation_id)
-                elif copy.primary and not shard.primary:
+                if shard is not None and copy.primary and not shard.primary:
                     shard.promote_to_primary(shard.primary_term + 1)
-                if (copy.state == INITIALIZING
-                        and copy.allocation_id not in self._started_sent):
+                    self._write_shard_state(svc, shard_num,
+                                            copy.allocation_id,
+                                            primary=True)
+                if copy.state == STARTED and shard is None:
+                    # node bounced fast enough to keep its assignment:
+                    # reopen from the local store (primary) or catch up
+                    # from the primary (replica; idempotent replay)
+                    if copy.primary:
+                        svc.create_shard(shard_num, primary=True,
+                                         allocation_id=copy.allocation_id)
+                        self._write_shard_state(svc, shard_num,
+                                                copy.allocation_id,
+                                                primary=True)
+                    else:
+                        self._start_replica_recovery(name, shard_num,
+                                                     copy, state)
+                    continue
+                if copy.state != INITIALIZING \
+                        or copy.allocation_id in self._started_sent:
+                    continue
+                if copy.primary:
+                    if shard is None:
+                        svc.create_shard(shard_num, primary=True,
+                                         allocation_id=copy.allocation_id)
+                    self._write_shard_state(svc, shard_num,
+                                            copy.allocation_id,
+                                            primary=True)
                     self._started_sent.add(copy.allocation_id)
                     self._send_to_master(ACTION_SHARD_STARTED, {
                         "index": name, "shard": shard_num,
                         "allocation_id": copy.allocation_id})
+                else:
+                    self._start_replica_recovery(name, shard_num, copy,
+                                                 state)
+
+    @staticmethod
+    def _write_shard_state(svc, shard_num: int, allocation_id: str,
+                           primary: bool) -> None:
+        """Persist the shard copy's identity next to its store so a
+        restarted node can prove it holds an in-sync copy (reference:
+        ShardStateMetadata on disk)."""
+        p = os.path.join(svc.data_path, str(shard_num), "_shard_state.json")
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        write_atomic(p, json.dumps(
+            {"allocation_id": allocation_id,
+             "primary": primary}).encode("utf-8"))
+
+    @staticmethod
+    def _read_shard_state(svc, shard_num: int) -> Optional[Dict[str, Any]]:
+        p = os.path.join(svc.data_path, str(shard_num), "_shard_state.json")
+        try:
+            with open(p, "rb") as f:
+                return json.loads(f.read().decode("utf-8"))
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _prune_recovery_sources(self, state: ClusterState) -> None:
+        """Release source-side recovery registrations once the target
+        copy is STARTED in the routing table (live fan-out now reaches
+        it via the normal replica path) or gone from it entirely."""
+        done = []
+        with self._recovery_sources_lock:
+            for (index, shard_num, aid), entry in \
+                    list(self._recovery_sources.items()):
+                copies = state.shard_copies(index, shard_num)
+                match = next((c for c in copies
+                              if c.allocation_id == aid), None)
+                if match is None or match.state == STARTED:
+                    done.append(self._recovery_sources.pop(
+                        (index, shard_num, aid)))
+        for entry in done:
+            entry["release"]()
+
+    def _report_local_stores(self, state: ClusterState) -> None:
+        """Red-primary repair path: if this node's disk holds an in-sync
+        copy of a shard whose primary is unassigned, offer it to the
+        master (reference: the PrimaryShardAllocator's store fetch —
+        TransportNodesListGatewayStartedShards — inverted to a push)."""
+        indices = self.node.indices
+        for name, meta in state.indices.items():
+            if not indices.has_index(name):
+                continue
+            svc = indices.index(name)
+            if svc.index_uuid != meta.uuid:
+                continue  # a different incarnation of the name
+            for shard_num in range(meta.number_of_shards):
+                primary = state.primary(name, shard_num)
+                if primary is None or primary.node_id is not None:
+                    continue
+                in_sync = meta.in_sync.get(str(shard_num)) or []
+                disk = self._read_shard_state(svc, shard_num)
+                if disk and disk.get("allocation_id") in in_sync:
+                    self._send_to_master(ACTION_STORE_FOUND, {
+                        "index": name, "shard": shard_num,
+                        "allocation_id": disk["allocation_id"],
+                        "node": self.local_node.to_json()})
+
+    def _handle_store_found(self, payload, from_node) -> Dict[str, Any]:
+        index, shard_num = payload["index"], int(payload["shard"])
+        aid = payload["allocation_id"]
+        node = DiscoveryNode.from_json(payload["node"])
+
+        def update(state: ClusterState) -> ClusterState:
+            meta = state.indices.get(index)
+            primary = state.primary(index, shard_num)
+            if (meta is None or primary is None
+                    or primary.node_id is not None
+                    or node.node_id not in state.nodes
+                    or aid not in (meta.in_sync.get(str(shard_num)) or [])):
+                return state  # raced another assignment — ignore
+            routing = {idx: {s: list(c) for s, c in sh.items()}
+                       for idx, sh in state.routing.items()}
+            copies = routing[index][shard_num]
+            for i, c in enumerate(copies):
+                if c.primary:
+                    copies[i] = ShardRouting(index, shard_num,
+                                             node.node_id, True,
+                                             INITIALIZING, aid)
+            return state.with_updates(routing=routing)
+
+        self._run_master_update(
+            update, source=f"store-found[{index}][{shard_num}]")
+        return {"acknowledged": True}
 
     def _maybe_reroute(self, state: ClusterState) -> None:
         """Master-side convergence loop: if a reroute would change the
@@ -580,17 +736,51 @@ class ClusterService:
             doc_id = uuid_mod.uuid4().hex[:20]
         shard = shard_for(params.get("routing") or doc_id,
                           meta.number_of_shards)
-        _primary, target = self._primary_node(index, shard)
-        if target.node_id == self.local_node.node_id:
-            return self._exec_doc_op(op, index, doc_id, body, params, shard)
-        try:
-            result = self.transport.send_request(
-                target.address, ACTION_DOC_OP,
-                {"op": op, "index": index, "id": doc_id, "body": body,
-                 "params": params, "shard": shard})
-        except RemoteTransportException as e:
-            raise _rehydrate_error(e) from e
-        return result["status"], result["body"]
+        # retry loop: a dead primary is not a request failure — the
+        # coordinating node waits for the routing table to fail over and
+        # re-dispatches (reference: TransportReplicationAction's
+        # cluster-state-observer retry)
+        deadline = time.monotonic() + 30.0
+        last_exc: Optional[Exception] = None
+        while True:
+            _primary, target = self._primary_node(index, shard)
+            if target.node_id == self.local_node.node_id:
+                return self._exec_doc_op(op, index, doc_id, body, params,
+                                         shard)
+            try:
+                result = self.transport.send_request(
+                    target.address, ACTION_DOC_OP,
+                    {"op": op, "index": index, "id": doc_id, "body": body,
+                     "params": params, "shard": shard})
+                return result["status"], result["body"]
+            except RemoteTransportException as e:
+                if e.error_type != "ShardNotFoundException":
+                    raise _rehydrate_error(e) from e
+                last_exc = e  # routing raced a relocation — retry
+            except ConnectTransportException as e:
+                last_exc = e  # connect failed: nothing was sent — retry
+            except (ConnectionError, OSError) as e:
+                # AMBIGUOUS: the op may have applied before the link
+                # died. index/update/delete re-dispatch is last-write-
+                # wins with identical payload (at-least-once, reference
+                # bulk retry semantics); a re-sent create could 409 a
+                # write that actually succeeded, so surface the error
+                if op == "create":
+                    raise EsException(
+                        f"connection to primary for [{index}][{shard}] "
+                        f"failed mid-request; create not retried "
+                        f"(result unknown): {e}") from e
+                last_exc = e
+            if time.monotonic() >= deadline:
+                raise EsException(
+                    f"primary for [{index}][{shard}] unreachable and no "
+                    f"failover within timeout: {last_exc}")
+            observed = target.node_id
+            self.wait_for_applied(
+                lambda s: (s.primary(index, shard) is None
+                           or s.primary(index, shard).node_id != observed
+                           or observed not in s.nodes),
+                timeout=min(2.0, max(0.1, deadline - time.monotonic())))
 
     def _exec_doc_op(self, op: str, index: str, doc_id: str, body,
                      params: Dict[str, str], shard: int) -> Tuple[int, Dict]:
@@ -844,6 +1034,286 @@ class ClusterService:
         return {"count": total, "shards": n}
 
     # ------------------------------------------------------------------
+    # peer recovery (reference: RecoverySourceHandler#recoverToTarget /
+    # PeerRecoveryTargetService, SURVEY.md §2.1#34, §3.5: phase 1 file
+    # sync by manifest diff, phase 2 translog-tail replay)
+    # ------------------------------------------------------------------
+
+    def _start_replica_recovery(self, index: str, shard_num: int,
+                                copy: ShardRouting,
+                                state: ClusterState) -> None:
+        key = (index, shard_num)
+        with self._recovering_lock:
+            if key in self._recovering:
+                return
+            self._recovering.add(key)
+        threading.Thread(
+            target=self._recover_replica,
+            args=(index, shard_num, copy),
+            daemon=True,
+            name=f"recovery-{index}-{shard_num}").start()
+
+    def _recover_replica(self, index: str, shard_num: int,
+                         copy: ShardRouting) -> None:
+        key = (index, shard_num)
+        try:
+            primary_state = self.wait_for_applied(
+                lambda s: (s.primary(index, shard_num) is not None
+                           and s.primary(index, shard_num).state == STARTED
+                           and s.primary(index, shard_num).node_id
+                           in s.nodes),
+                timeout=30.0)
+            if primary_state is None:
+                return  # no live primary; a later reroute retries
+            primary = primary_state.primary(index, shard_num)
+            src = primary_state.nodes[primary.node_id].address
+            svc = self.node.indices.index(index)
+            shard_path = os.path.join(svc.data_path, str(shard_num))
+            os.makedirs(shard_path, exist_ok=True)
+
+            # ---- phase 1: file sync (manifest diff by size+sha256) ----
+            # a remote ShardNotFound here is transient (the primary node
+            # may not have reconciled its shard object yet, e.g. at
+            # whole-cluster restart) — wait and retry, don't fail the copy
+            start = None
+            start_deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    start = self.transport.send_request(
+                        src, ACTION_RECOVERY_START,
+                        {"index": index, "shard": shard_num,
+                         "allocation_id": copy.allocation_id,
+                         "target_node": self.local_node.to_json()},
+                        timeout=60.0)
+                    break
+                except RemoteTransportException as e:
+                    if (e.error_type != "ShardNotFoundException"
+                            or time.monotonic() >= start_deadline):
+                        raise
+                    time.sleep(0.5)
+            import hashlib
+            for rel, info in start["files"].items():
+                dst = os.path.join(shard_path, rel)
+                if os.path.exists(dst):
+                    with open(dst, "rb") as f:
+                        local = f.read()
+                    if (len(local) == info["size"]
+                            and hashlib.sha256(local).hexdigest()
+                            == info["sha256"]):
+                        continue
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                chunks = []
+                offset = 0
+                while offset < info["size"]:
+                    part = self.transport.send_request(
+                        src, ACTION_RECOVERY_FILE,
+                        {"index": index, "shard": shard_num, "path": rel,
+                         "offset": offset, "length": _RECOVERY_CHUNK},
+                        timeout=60.0)
+                    data = base64.b64decode(part["data"])
+                    if not data:
+                        break
+                    chunks.append(data)
+                    offset += len(data)
+                blob = b"".join(chunks)
+                if hashlib.sha256(blob).hexdigest() != info["sha256"]:
+                    raise IOError(f"recovery checksum mismatch on {rel}")
+                write_atomic(dst, blob)
+            # the commit manifest goes last: the engine opens from it,
+            # so it must only ever reference files already on disk
+            write_atomic(os.path.join(shard_path, "commit.json"),
+                         base64.b64decode(start["commit"]))
+
+            # ---- open the engine from the synced store ----
+            shard = self.node.indices.index(index).shards.get(shard_num)
+            if shard is not None:
+                shard.close()
+                self.node.indices.index(index).shards.pop(shard_num, None)
+            shard = svc.create_shard(shard_num, primary=False,
+                                     allocation_id=copy.allocation_id)
+
+            # ---- phase 2: translog-tail replay until caught up ----
+            # live replica ops are already flowing (the source registered
+            # this target for fan-out at RECOVERY_START) and the engine's
+            # per-doc seqno check makes duplicate/stale delivery a no-op.
+            # The copy may ONLY report started once a replay round comes
+            # back empty — an incomplete copy in the in-sync set would
+            # lose acked writes on promotion.
+            converged = False
+            for _round in range(100):
+                from_seq = shard.local_checkpoint + 1
+                ops = self.transport.send_request(
+                    src, ACTION_RECOVERY_OPS,
+                    {"index": index, "shard": shard_num,
+                     "from_seq_no": from_seq}, timeout=60.0)["ops"]
+                for op in ops:
+                    self._apply_replica_op_dict(shard, op)
+                if not ops:
+                    converged = True
+                    break
+                if shard.local_checkpoint + 1 == from_seq:
+                    raise IOError(
+                        f"replay made no progress at seq {from_seq}")
+            if not converged:
+                raise IOError("translog replay did not converge")
+
+            self._write_shard_state(svc, shard_num, copy.allocation_id,
+                                    primary=False)
+            self._started_sent.add(copy.allocation_id)
+            self._send_to_master(ACTION_SHARD_STARTED, {
+                "index": index, "shard": shard_num,
+                "allocation_id": copy.allocation_id})
+            logger.info("[%s] recovered replica %s[%d] from %s",
+                        self.local_node.name, index, shard_num,
+                        primary.node_id)
+            # NOTE: the source's fan-out registration stays live until it
+            # sees this copy STARTED in a committed state (pruned in
+            # _reconcile) — releasing it now would open a window where
+            # writes land between the last replay round and the routing
+            # update without reaching this copy.
+        except Exception:  # noqa: BLE001 — recovery retries via reroute
+            logger.exception("[%s] replica recovery %s[%d] failed",
+                             self.local_node.name, index, shard_num)
+            self._send_to_master(ACTION_SHARD_FAILED, {
+                "index": index, "shard": shard_num,
+                "allocation_id": copy.allocation_id})
+            # tell the source to drop its retention lock + registration
+            try:
+                primary_state = self.applied_state()
+                primary = primary_state.primary(index, shard_num)
+                if primary is not None and primary.node_id \
+                        in primary_state.nodes:
+                    self.transport.send_request_async(
+                        primary_state.nodes[primary.node_id].address,
+                        ACTION_RECOVERY_FINISH,
+                        {"index": index, "shard": shard_num,
+                         "allocation_id": copy.allocation_id})
+            except Exception:  # noqa: BLE001 — TTL expiry is the backstop
+                pass
+        finally:
+            with self._recovering_lock:
+                self._recovering.discard(key)
+
+    @staticmethod
+    def _apply_replica_op_dict(shard, op: Dict[str, Any]) -> None:
+        kind = op.get("kind", "index")
+        if kind == "index":
+            shard.apply_index_on_replica(
+                op["id"], op.get("source") or {}, seq_no=int(op["seq_no"]),
+                primary_term=int(op["primary_term"]),
+                version=int(op.get("version") or 1))
+        elif kind == "delete":
+            shard.apply_delete_on_replica(
+                op["id"], seq_no=int(op["seq_no"]),
+                primary_term=int(op["primary_term"]))
+        # no_op entries only advance checkpoints
+        elif kind == "no_op":
+            shard.engine.no_op(int(op["seq_no"]), int(op["primary_term"]),
+                               op.get("reason") or "replay")
+
+    # ---- source side ----
+
+    def _local_shard(self, index: str, shard_num: int):
+        from elasticsearch_tpu.common.errors import ShardNotFoundException
+        svc = self.node.indices.index(index)
+        shard = svc.shards.get(shard_num)
+        if shard is None:
+            raise ShardNotFoundException(
+                f"shard [{index}][{shard_num}] not on this node")
+        return svc, shard
+
+    def _handle_recovery_start(self, payload, from_node) -> Dict[str, Any]:
+        import hashlib
+        index, shard_num = payload["index"], int(payload["shard"])
+        svc, shard = self._local_shard(index, shard_num)
+        # register the target BEFORE the flush: from here on (a) live
+        # writes fan out to it and (b) its translog ops are pinned
+        # against trim, so no op can fall between file copy and replay
+        aid = payload.get("allocation_id", "")
+        target = payload.get("target_node")
+        if aid and target:
+            release = shard.engine.translog.acquire_retention_lock()
+            with self._recovery_sources_lock:
+                old = self._recovery_sources.pop((index, shard_num, aid),
+                                                 None)
+                self._recovery_sources[(index, shard_num, aid)] = {
+                    "release": release,
+                    "address": tuple(DiscoveryNode.from_json(target)
+                                     .address),
+                    "expires": time.monotonic() + 600.0}
+            if old is not None:
+                old["release"]()
+        shard.flush()  # commit the current state; ops after this stay in
+        # the translog and are shipped in phase 2
+        shard_path = os.path.join(svc.data_path, str(shard_num))
+        commit_path = os.path.join(shard_path, "commit.json")
+        with open(commit_path, "rb") as f:
+            commit_bytes = f.read()
+        commit = json.loads(commit_bytes.decode("utf-8"))
+        files: Dict[str, Dict[str, Any]] = {}
+        seg_dir = os.path.join(shard_path, "segments")
+        for seg_name in commit.get("segments", []):
+            for ext in (".npz", ".json"):
+                rel = os.path.join("segments", seg_name + ext)
+                p = os.path.join(shard_path, rel)
+                if os.path.exists(p):
+                    with open(p, "rb") as f:
+                        blob = f.read()
+                    files[rel] = {
+                        "size": len(blob),
+                        "sha256": hashlib.sha256(blob).hexdigest()}
+        return {"files": files,
+                "commit": base64.b64encode(commit_bytes).decode("ascii"),
+                "max_seq_no": commit.get("max_seq_no", -1)}
+
+    def _handle_recovery_file(self, payload, from_node) -> Dict[str, Any]:
+        index, shard_num = payload["index"], int(payload["shard"])
+        svc, _shard = self._local_shard(index, shard_num)
+        rel = payload["path"]
+        if os.path.isabs(rel) or ".." in rel.split(os.sep):
+            raise IllegalArgumentException(f"illegal recovery path [{rel}]")
+        p = os.path.join(svc.data_path, str(shard_num), rel)
+        with open(p, "rb") as f:
+            f.seek(int(payload["offset"]))
+            data = f.read(int(payload["length"]))
+        return {"data": base64.b64encode(data).decode("ascii")}
+
+    def _handle_recovery_finish(self, payload, from_node) -> Dict[str, Any]:
+        key = (payload["index"], int(payload["shard"]),
+               payload.get("allocation_id", ""))
+        with self._recovery_sources_lock:
+            entry = self._recovery_sources.pop(key, None)
+        if entry is not None:
+            entry["release"]()
+        return {"acknowledged": True}
+
+    def _expire_recovery_sources(self) -> None:
+        """Drop abandoned source registrations (target died mid-recovery
+        and never sent finish) so retention locks can't leak forever."""
+        now = time.monotonic()
+        expired = []
+        with self._recovery_sources_lock:
+            for key, entry in list(self._recovery_sources.items()):
+                if entry["expires"] < now:
+                    expired.append(self._recovery_sources.pop(key))
+        for entry in expired:
+            entry["release"]()
+
+    def _handle_recovery_ops(self, payload, from_node) -> Dict[str, Any]:
+        index, shard_num = payload["index"], int(payload["shard"])
+        _svc, shard = self._local_shard(index, shard_num)
+        from_seq = int(payload["from_seq_no"])
+        ops = []
+        for op in shard.engine.translog.snapshot(from_seq_no=from_seq):
+            ops.append({"kind": op.op_type, "seq_no": op.seq_no,
+                        "primary_term": op.primary_term, "id": op.doc_id,
+                        "source": op.source, "version": op.version,
+                        "reason": op.reason})
+            if len(ops) >= 5000:
+                break
+        return {"ops": ops}
+
+    # ------------------------------------------------------------------
     # maintenance broadcast (refresh/flush/forcemerge across nodes)
     # ------------------------------------------------------------------
 
@@ -903,9 +1373,113 @@ class ClusterService:
 
     def replicate_op(self, op: str, index: str, shard: int, doc_id: str,
                      source: Optional[dict], result) -> None:
-        """Placeholder until the replication fan-out lands: single-copy
-        indices (replicas=0) need nothing; replicated indices are not
-        yet offered (create_index defaults replicas to 0)."""
+        """Primary→replica fan-out, called synchronously after every
+        primary-phase apply (reference: ReplicationOperation#execute —
+        the client ack means every in-sync copy has the op). Fans out to
+        STARTED and INITIALIZING copies: a recovering replica that
+        already opened its engine applies live ops directly (the per-doc
+        seqno check drops duplicates vs the translog replay); one that
+        hasn't yet raises ShardNotFound remotely, which is fine — the op
+        is in the primary translog the replay will ship."""
+        state = self.applied_state()
+        copies = [c for c in state.shard_copies(index, shard)
+                  if not c.primary and c.node_id
+                  and c.node_id != self.local_node.node_id
+                  and c.node_id in state.nodes
+                  and c.state in (STARTED, INITIALIZING)]
+        targets: List[Tuple[Optional[ShardRouting], Tuple[str, int]]] = [
+            (c, state.nodes[c.node_id].address) for c in copies]
+        # plus recovery targets registered at RECOVERY_START — they may
+        # not be in this node's applied routing view yet (the reference
+        # tracks them in the primary's ReplicationGroup)
+        seen_addrs = {addr for _, addr in targets}
+        with self._recovery_sources_lock:
+            for (r_index, r_shard, aid), entry in \
+                    self._recovery_sources.items():
+                if (r_index, r_shard) == (index, shard) \
+                        and entry["address"] not in seen_addrs:
+                    targets.append((None, entry["address"]))
+                    seen_addrs.add(entry["address"])
+        if not targets:
+            return
+        payload = {"index": index, "shard": shard, "op": op, "id": doc_id,
+                   "source": source, "seq_no": result.seq_no,
+                   "primary_term": result.primary_term,
+                   "version": result.version}
+        futures = []
+        for c, addr in targets:
+            futures.append((c, self.transport.send_request_async(
+                addr, ACTION_REPLICA_OP, payload)))
+        for c, fut in futures:
+            try:
+                fut.result(timeout=30.0)
+            except RemoteTransportException as e:
+                if e.error_type == "ShardNotFoundException":
+                    continue  # recovery will replay from the translog
+                if c is not None:
+                    self._fail_replica(index, shard, c, e)
+            except Exception as e:  # noqa: BLE001 — replica unreachable
+                if c is not None:
+                    self._fail_replica(index, shard, c, e)
+                # a pure recovery target failing is the recovery's
+                # problem (its replay/restart covers it), not the ack's
+
+    def _fail_replica(self, index: str, shard: int, copy: ShardRouting,
+                      exc: Exception) -> None:
+        """An unreachable/broken replica must leave the replication
+        group BEFORE the write is acked — this blocks until the master
+        commits the shard-failed update (reference: the primary fails
+        the shard via the master and only then responds). If the master
+        can't be reached the write must not be acked either."""
+        logger.warning("[%s] failing replica %s[%d] on %s: %s",
+                       self.local_node.name, index, shard, copy.node_id,
+                       exc)
+        payload = {"index": index, "shard": shard,
+                   "allocation_id": copy.allocation_id}
+        deadline = time.monotonic() + 30.0
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                addr = self._master_address()
+                if addr == self.local_node.address:
+                    self._handle_shard_failed(payload,
+                                              self.local_node.to_json())
+                else:
+                    self.transport.send_request(addr, ACTION_SHARD_FAILED,
+                                                payload, timeout=10.0)
+                return
+            except Exception as e:  # noqa: BLE001 — retry until deadline
+                last = e
+                time.sleep(0.5)
+        raise EsException(
+            f"could not fail replica {index}[{shard}] on master: {last}")
+
+    def _handle_replica_op(self, payload, from_node) -> Dict[str, Any]:
+        from elasticsearch_tpu.common.errors import ShardNotFoundException
+        index, shard_num = payload["index"], int(payload["shard"])
+        svc = self.node.indices.index(index)
+        shard = svc.shards.get(shard_num)
+        if shard is None:
+            raise ShardNotFoundException(
+                f"shard [{index}][{shard_num}] not on this node")
+        op = {"kind": "delete" if payload["op"] == "delete" else "index",
+              "seq_no": payload["seq_no"],
+              "primary_term": payload["primary_term"],
+              "id": payload["id"], "source": payload.get("source"),
+              "version": payload.get("version")}
+        self._apply_replica_op_dict(shard, op)
+        return {"acknowledged": True}
+
+    def _handle_shard_failed(self, payload, from_node) -> Dict[str, Any]:
+        index, shard = payload["index"], int(payload["shard"])
+        aid = payload["allocation_id"]
+
+        def update(state: ClusterState) -> ClusterState:
+            return AllocationService.shard_failed(state, index, shard, aid)
+
+        self._run_master_update(update,
+                                source=f"shard-failed[{index}][{shard}]")
+        return {"acknowledged": True}
 
     # ------------------------------------------------------------------
     # health / introspection

@@ -68,7 +68,9 @@ class ShardRouting:
 
 @dataclasses.dataclass(frozen=True)
 class IndexMeta:
-    """Reference: IndexMetadata — settings + mapping + shard counts."""
+    """Reference: IndexMetadata — settings + mapping + shard counts +
+    in-sync allocation ids (the copies that may safely become primary;
+    reference: IndexMetadata#inSyncAllocationIds)."""
 
     name: str
     uuid: str
@@ -76,6 +78,8 @@ class IndexMeta:
     mapping: Optional[Dict[str, Any]]
     number_of_shards: int
     number_of_replicas: int
+    # shard (as str for JSON) → allocation ids that completed recovery
+    in_sync: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -86,7 +90,9 @@ class IndexMeta:
                          settings=d.get("settings") or {},
                          mapping=d.get("mapping"),
                          number_of_shards=int(d["number_of_shards"]),
-                         number_of_replicas=int(d["number_of_replicas"]))
+                         number_of_replicas=int(d["number_of_replicas"]),
+                         in_sync={k: list(v) for k, v in
+                                  (d.get("in_sync") or {}).items()})
 
 
 @dataclasses.dataclass(frozen=True)

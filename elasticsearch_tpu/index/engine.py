@@ -264,6 +264,19 @@ class InternalEngine:
             else:
                 new_version = version if version is not None else 1
                 self.tracker.advance_max_seq_no(seq_no)
+                # replica/replay idempotency: an op at or below the doc's
+                # current seq_no is a duplicate or arrived out of order —
+                # drop it, but record a translog no-op so this copy's
+                # history stays gapless for future recoveries it sources
+                # (reference: compareOpToLuceneDocBasedOnSeqNo + NoOp)
+                if existing is not None and existing.seq_no >= seq_no:
+                    self.translog.add(TranslogOp(
+                        "no_op", seq_no, primary_term, reason="stale op"))
+                    self.tracker.mark_processed(seq_no)
+                    self._mark_durable(seq_no)
+                    return IndexResult(doc_id, seq_no, primary_term,
+                                       existing.version, created=False,
+                                       result="noop")
 
             self._apply_index(doc_id, source, seq_no=seq_no,
                               primary_term=primary_term, version=new_version,
@@ -309,6 +322,14 @@ class InternalEngine:
                 primary_term = self.config.primary_term
             else:
                 self.tracker.advance_max_seq_no(seq_no)
+                # same replica-path staleness rule as index()
+                if existing is not None and existing.seq_no >= seq_no:
+                    self.translog.add(TranslogOp(
+                        "no_op", seq_no, primary_term, reason="stale op"))
+                    self.tracker.mark_processed(seq_no)
+                    self._mark_durable(seq_no)
+                    return DeleteResult(doc_id, seq_no, primary_term,
+                                        existing.version, found=False)
             # version stays monotonic across repeated deletes while the
             # tombstone is retained (same continuity rule as index())
             version = (existing.version + 1) if existing is not None else 1

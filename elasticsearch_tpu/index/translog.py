@@ -114,6 +114,11 @@ class Translog:
         self.checkpoint = ckp
         self._open_writer(ckp.generation)
         self._unsynced = 0
+        # retention locks pin generations against trim while a peer
+        # recovery streams ops from them (reference:
+        # TranslogDeletionPolicy#acquireTranslogGen / retention locks)
+        self._retention_locks: dict = {}
+        self._retention_seq = 0
 
     # ---------------- paths ----------------
 
@@ -180,11 +185,34 @@ class Translog:
             self._open_writer(self.checkpoint.generation)
             return self.checkpoint.generation
 
+    def acquire_retention_lock(self):
+        """Pin every currently-retained generation: trim() will not
+        delete them until the returned release() runs. Used by recovery
+        sources so a concurrent flush can't drop ops a replica still
+        needs to replay."""
+        with self._lock:
+            self._retention_seq += 1
+            lock_id = self._retention_seq
+            self._retention_locks[lock_id] = \
+                self.checkpoint.min_translog_generation
+
+        def release() -> None:
+            with self._lock:
+                self._retention_locks.pop(lock_id, None)
+
+        return release
+
     def trim(self, min_required_gen: int) -> None:
         """Delete generations < min_required_gen (reference:
-        TranslogDeletionPolicy after a safe commit)."""
+        TranslogDeletionPolicy after a safe commit), bounded by any
+        retention locks held by in-flight recoveries."""
         with self._lock:
+            if self._retention_locks:
+                min_required_gen = min(
+                    min_required_gen, *self._retention_locks.values())
             min_gen = max(self.checkpoint.min_translog_generation, 1)
+            if min_required_gen <= min_gen:
+                return
             for gen in range(min_gen, min_required_gen):
                 p = self._gen_path(gen)
                 if os.path.exists(p):

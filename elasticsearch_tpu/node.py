@@ -72,6 +72,14 @@ class Node:
         """Join/bootstrap a multi-node cluster (reference: discovery +
         coordination startup in Node#start)."""
         from elasticsearch_tpu.cluster.service import ClusterService
+        # the gateway eagerly reopened every local shard as a primary;
+        # in cluster mode the routing table decides which copies live
+        # here and with which role — drop the objects (files stay) and
+        # let the state applier recreate the right ones
+        for svc in self.indices.indices.values():
+            for shard in list(svc.shards.values()):
+                shard.close()
+            svc.shards.clear()
         self.cluster = ClusterService(
             self, host=host, transport_port=transport_port,
             seed_hosts=seed_hosts,

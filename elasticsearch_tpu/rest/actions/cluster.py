@@ -139,6 +139,19 @@ def register(controller: RestController, node) -> None:
                               c["count"]]])
 
     def cat_shards(req: RestRequest):
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            rows = []
+            for name in node.cluster.resolve_indices(req.param("index")):
+                for s, copies in sorted(
+                        state.routing.get(name, {}).items()):
+                    for c in copies:
+                        node_name = (state.nodes[c.node_id].name
+                                     if c.node_id in state.nodes else "-")
+                        rows.append([name, s, "p" if c.primary else "r",
+                                     c.state, "-", node_name])
+            return _maybe_table(req, ["index", "shard", "prirep", "state",
+                                      "docs", "node"], rows)
         rows = []
         for name in resolve_indices(indices, req.param("index")):
             svc = indices.index(name)

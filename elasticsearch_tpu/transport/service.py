@@ -202,13 +202,17 @@ class TransportService:
     def _serve_conn(self, sock: socket.socket) -> None:
         write_lock = threading.Lock()
         try:
-            while True:
+            while not self._closed:
                 msg = _read_frame(sock)
                 if msg.get("t") != "q":
                     continue
                 self.rx_count += 1
                 self._executor.submit(self._dispatch, sock, write_lock, msg)
         except (ConnectionError, OSError, json.JSONDecodeError):
+            pass
+        except RuntimeError:
+            pass  # executor shut down mid-accept — node is closing
+        finally:
             try:
                 sock.close()
             except OSError:
