@@ -246,6 +246,8 @@ class ClusterService:
                 (ACTION_RECOVERY_FINISH, self._handle_recovery_finish),
                 (ACTION_STORE_FOUND, self._handle_store_found)):
             self.transport.register_handler(action, handler)
+        from elasticsearch_tpu.tasks import register_transport_handlers
+        register_transport_handlers(node, self.transport)
         # replica recoveries in flight on this node, keyed (index, shard)
         self._recovering: Set[Tuple[str, int]] = set()
         self._recovering_lock = threading.Lock()
@@ -938,8 +940,8 @@ class ClusterService:
 
     def route_search(self, index_expr: Optional[str],
                      body: Optional[Dict[str, Any]],
-                     params: Optional[Dict[str, str]] = None
-                     ) -> Dict[str, Any]:
+                     params: Optional[Dict[str, str]] = None,
+                     task=None) -> Dict[str, Any]:
         from elasticsearch_tpu.search import coordinator as coord
         t0 = time.perf_counter()
         names = self.resolve_indices(index_expr)
@@ -965,6 +967,8 @@ class ClusterService:
                 self.node.indices, local_targets, body, params,
                 tpu_search=self.node.tpu_search))
         for node_id, fut in futures:
+            if task is not None:
+                task.ensure_not_cancelled()
             try:
                 groups.append(fut.result(timeout=60.0))
             except Exception as exc:  # noqa: BLE001 — shard-group failure

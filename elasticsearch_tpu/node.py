@@ -39,6 +39,8 @@ class Node:
         # single-node paths in the REST actions
         self.cluster = None
         self.indices = IndicesService(data_path)
+        from elasticsearch_tpu.tasks import TaskManager
+        self.task_manager = TaskManager(self.node_id)
         # the TPU serving path: resident packs + micro-batched kernel
         # (disable with search.tpu_serving.enabled=false — the planner
         # path then serves everything)
@@ -55,7 +57,9 @@ class Node:
                 window_s=self.settings.get_float(
                     "search.tpu_serving.batch_window_seconds", 0.002),
                 max_batch=self.settings.get_int(
-                    "search.tpu_serving.max_batch", 64))
+                    "search.tpu_serving.max_batch", 64),
+                batch_timeout_s=self.settings.get_float(
+                    "search.tpu_serving.batch_timeout_seconds", 30.0))
         self.controller = RestController()
         self._register_actions()
         self._refresh_interval = self.settings.get_float(
@@ -96,8 +100,8 @@ class Node:
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, cluster, document,
-                                                    search)
-        for module in (document, search, admin, cluster):
+                                                    search, tasks)
+        for module in (document, search, admin, cluster, tasks):
             module.register(self.controller, self)
 
     # ---------------- index helpers ----------------

@@ -14,12 +14,19 @@ def register(controller: RestController, node) -> None:
     indices = node.indices
 
     def do_search(req: RestRequest):
-        if node.cluster is not None:
-            return 200, node.cluster.route_search(
-                req.param("index"), req.body or {}, req.params)
-        return 200, coordinator.search(
-            indices, req.param("index"), req.body or {}, req.params,
-            tpu_search=getattr(node, "tpu_search", None))
+        task = node.task_manager.register(
+            "indices:data/read/search",
+            description=f"indices[{req.param('index') or '_all'}]")
+        try:
+            if node.cluster is not None:
+                return 200, node.cluster.route_search(
+                    req.param("index"), req.body or {}, req.params,
+                    task=task)
+            return 200, coordinator.search(
+                indices, req.param("index"), req.body or {}, req.params,
+                tpu_search=getattr(node, "tpu_search", None), task=task)
+        finally:
+            node.task_manager.unregister(task)
 
     def do_count(req: RestRequest):
         if node.cluster is not None:

@@ -1,0 +1,85 @@
+"""Tasks REST actions: list running tasks, cancel one.
+
+Reference: `RestListTasksAction`, `RestCancelTasksAction`
+(SURVEY.md §2.1#46). Response shape: {"nodes": {node_id: {"name": ...,
+"tasks": {"node:id": {...}}}}}. In cluster mode the listing fans out to
+every node and a cancel routes to the task's owning node by id prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             ResourceNotFoundException)
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.tasks import ACTION_TASKS_CANCEL, ACTION_TASKS_LIST
+
+
+def _local_tasks_json(node, actions=None) -> Dict[str, Any]:
+    return {t.full_id: t.to_json()
+            for t in node.task_manager.list(actions)}
+
+
+def register(controller: RestController, node) -> None:
+    # the cross-node transport handlers live in tasks.register_transport_
+    # handlers, wired by ClusterService at cluster start
+
+    def list_tasks(req: RestRequest):
+        actions = req.params.get("actions")
+        nodes_out: Dict[str, Any] = {
+            node.node_id: {"name": node.node_name,
+                           "tasks": _local_tasks_json(node, actions)}}
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            futures = []
+            for n in state.data_nodes():
+                if n.node_id == node.node_id:
+                    continue
+                futures.append((n, node.cluster.transport.send_request_async(
+                    n.address, ACTION_TASKS_LIST, {"actions": actions})))
+            for n, fut in futures:
+                try:
+                    nodes_out[n.node_id] = {
+                        "name": n.name,
+                        "tasks": fut.result(timeout=10.0)["tasks"]}
+                except Exception:  # noqa: BLE001 — node unreachable
+                    pass
+        return 200, {"nodes": nodes_out}
+
+    def cancel_task(req: RestRequest):
+        full_id = req.param("task_id")
+        if not full_id or ":" not in full_id:
+            raise IllegalArgumentException(
+                f"malformed task id [{full_id}], expected nodeId:taskId")
+        owner_id, _, seq = full_id.rpartition(":")
+        if not seq.isdigit():
+            raise IllegalArgumentException(
+                f"malformed task id [{full_id}]")
+        if owner_id == node.node_id:
+            task = node.task_manager.cancel(int(seq))
+            return 200, {"nodes": {node.node_id: {
+                "name": node.node_name,
+                "tasks": {task.full_id: task.to_json()}}}}
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            owner = state.nodes.get(owner_id)
+            if owner is not None:
+                from elasticsearch_tpu.transport.service import \
+                    RemoteTransportException
+                try:
+                    result = node.cluster.transport.send_request(
+                        owner.address, ACTION_TASKS_CANCEL,
+                        {"task_id": int(seq)}, timeout=10.0)
+                except RemoteTransportException as e:
+                    from elasticsearch_tpu.cluster.service import \
+                        _rehydrate_error
+                    raise _rehydrate_error(e) from e
+                return 200, {"nodes": {owner_id: {
+                    "name": owner.name,
+                    "tasks": {full_id: result["task"]}}}}
+        raise ResourceNotFoundException(
+            f"task [{full_id}] belongs to an unknown node")
+
+    controller.register("GET", "/_tasks", list_tasks)
+    controller.register("POST", "/_tasks/{task_id}/_cancel", cancel_task)

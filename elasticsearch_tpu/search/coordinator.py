@@ -62,7 +62,7 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
             f"yet by this engine")
     unknown = set(body) - {"query", "aggs", "aggregations", "size", "from",
                            "_source", "min_score", "track_total_hits",
-                           "sort", "search_after",
+                           "sort", "search_after", "timeout",
                            "version", "seq_no_primary_term"}
     if unknown:
         raise IllegalArgumentException(
@@ -73,14 +73,31 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
     return query, aggs, body
 
 
+def parse_timeout_s(body: Dict[str, Any],
+                    params: Dict[str, str]) -> Optional[float]:
+    """`timeout` body key / query param → seconds (reference: TimeValue
+    grammar; a search past its timeout returns partial results with
+    "timed_out": true)."""
+    raw = params.get("timeout", body.get("timeout"))
+    if raw is None:
+        return None
+    from elasticsearch_tpu.common.units import TimeValue
+    seconds = TimeValue.parse(raw).seconds
+    if seconds < 0:
+        return None  # -1 is the reference's "no timeout" sentinel
+    return seconds
+
+
 def search(indices: IndicesService, index_expr: Optional[str],
            body: Optional[Dict[str, Any]],
            params: Optional[Dict[str, str]] = None,
-           tpu_search=None) -> Dict[str, Any]:
+           tpu_search=None, task=None) -> Dict[str, Any]:
+    from elasticsearch_tpu.search.query_phase import SearchContext
     t0 = time.perf_counter()
     params = params or {}
     names = resolve_indices(indices, index_expr)
     query, aggs, body = parse_search_body(body)
+    ctx = SearchContext(parse_timeout_s(body, params), task)
     size = int(params.get("size", body.get("size", 10)))
     from_ = int(params.get("from", body.get("from", 0)))
     min_score = body.get("min_score")
@@ -104,23 +121,32 @@ def search(indices: IndicesService, index_expr: Optional[str],
                             source=source, t0=t0,
                             version=bool(body.get("version")),
                             seq_no_primary_term=bool(
-                                body.get("seq_no_primary_term")))
+                                body.get("seq_no_primary_term")),
+                            ctx=ctx)
         if fast is not None:
             return fast
 
     # ---- query phase: every shard of every target index ----
     shard_results = []   # (index_name, shard_num, QuerySearchResult)
     total = 0
+    timed_out = False
+    n_shards_expected = sum(len(indices.index(n).shards) for n in names)
     for name in names:
         svc = indices.index(name)
         for shard_num, shard in sorted(svc.shards.items()):
+            if ctx.should_stop():
+                timed_out = True
+                break
             reader = shard.acquire_searcher()
             res = execute_query(reader, query, size=size + from_, from_=0,
                                 min_score=min_score, aggs=aggs,
                                 sort_specs=sort_specs or None,
-                                search_after=search_after)
+                                search_after=search_after, ctx=ctx)
+            timed_out = timed_out or res.timed_out
             shard_results.append((name, shard_num, shard, res))
             total += res.total_hits
+        if timed_out:
+            break
 
     # ---- merge top-k: by sort key when sorting, else score desc; ties
     # toward lower index/shard order then rank (reference merge order) ----
@@ -170,11 +196,14 @@ def search(indices: IndicesService, index_expr: Optional[str],
         max_score = -merged[0][0] if merged else None
     out: Dict[str, Any] = {
         "took": int((time.perf_counter() - t0) * 1000),
-        "timed_out": False,
-        "_shards": {"total": len(shard_results),
+        "timed_out": timed_out,
+        # total reflects every targeted shard even when the deadline
+        # stopped the scan early (successful = actually visited)
+        "_shards": {"total": n_shards_expected,
                     "successful": len(shard_results), "skipped": 0,
                     "failed": 0},
-        "hits": {"total": {"value": total, "relation": "eq"},
+        "hits": {"total": {"value": total,
+                           "relation": "gte" if timed_out else "eq"},
                  "max_score": max_score,
                  "hits": hits_json},
     }
@@ -192,8 +221,8 @@ def _search_fast(indices: IndicesService, names: List[str],
                  query: dsl.QueryNode, tpu_search, *, size: int, from_: int,
                  min_score, source, t0: float,
                  version: bool = False,
-                 seq_no_primary_term: bool = False
-                 ) -> Optional[Dict[str, Any]]:
+                 seq_no_primary_term: bool = False,
+                 ctx=None) -> Optional[Dict[str, Any]]:
     """Kernel-path query phase + host fetch phase. Returns None when any
     target index's query can't lower (the whole request then runs on the
     planner so merge semantics stay uniform)."""
@@ -207,7 +236,9 @@ def _search_fast(indices: IndicesService, names: List[str],
     for name in names:
         svc = indices.index(name)
         n_shards_total += len(svc.shards)
-        res = tpu_search.try_search(svc, query, k=k)
+        res = tpu_search.try_search(
+            svc, query, k=k,
+            timeout_s=ctx.remaining_s() if ctx is not None else None)
         if res is None:
             return None
         per_index.append((name, svc, res))
@@ -283,8 +314,12 @@ def search_shard_group(indices: IndicesService,
     `merge_group_responses`. Aggregation partials travel as a pickled
     blob — inter-node RPC is a trusted channel exactly like the
     reference's native transport serialization."""
+    from elasticsearch_tpu.search.query_phase import SearchContext
     params = params or {}
     query, aggs, body = parse_search_body(body or {})
+    # the timeout travels with the body; each node enforces it locally
+    # (coordinator-side cancellation bans are not propagated yet)
+    ctx = SearchContext(parse_timeout_s(body, params))
     size = int(params.get("size", body.get("size", 10)))
     from_ = int(params.get("from", body.get("from", 0)))
     k = size + from_
@@ -313,7 +348,8 @@ def search_shard_group(indices: IndicesService,
         if (tpu_search is not None and aggs is None and not sort_specs
                 and search_after is None and k > 0
                 and set(shard_nums) == set(svc.shards.keys())):
-            res = tpu_search.try_search(svc, query, k=k)
+            res = tpu_search.try_search(svc, query, k=k,
+                                        timeout_s=ctx.remaining_s())
             if res is not None:
                 used_fast = True
                 total += res.total_hits
@@ -345,7 +381,7 @@ def search_shard_group(indices: IndicesService,
                 res = execute_query(reader, query, size=k, from_=0,
                                     min_score=min_score, aggs=aggs,
                                     sort_specs=sort_specs or None,
-                                    search_after=search_after)
+                                    search_after=search_after, ctx=ctx)
                 total += res.total_hits
                 if aggs is not None and res.aggregations is not None:
                     agg_parts.append(res.aggregations)
@@ -376,6 +412,7 @@ def search_shard_group(indices: IndicesService,
 
     out: Dict[str, Any] = {
         "hits": hits, "total": total, "relation": relation,
+        "timed_out": ctx.timed_out,
         "shards": len({(n, s) for n, s in targets}),
         "max_score": (max((d.get("_score") or float("-inf")
                            for d in hits), default=None)
@@ -407,9 +444,12 @@ def merge_group_responses(groups: List[Dict[str, Any]],
     total = 0
     relation = "eq"
     n_shards = failed_shards
+    timed_out = False
     for gi, g in enumerate(groups):
         total += g["total"]
         n_shards += g.get("shards", 0)
+        if g.get("timed_out"):
+            timed_out = True
         if g.get("relation") == "gte":
             relation = "gte"
         for rank, doc in enumerate(g["hits"]):
@@ -438,7 +478,7 @@ def merge_group_responses(groups: List[Dict[str, Any]],
 
     out: Dict[str, Any] = {
         "took": int((time.perf_counter() - t0) * 1000),
-        "timed_out": False,
+        "timed_out": timed_out,
         "_shards": {"total": n_shards,
                     "successful": n_shards - failed_shards, "skipped": 0,
                     "failed": failed_shards},

@@ -44,6 +44,42 @@ class QuerySearchResult:
     total_hits: int
     max_score: Optional[float]
     aggregations: Optional[Dict[str, Any]] = None  # name → InternalAggregation
+    timed_out: bool = False
+
+
+class SearchContext:
+    """Deadline + cancellation carrier for one search request
+    (reference: ContextIndexSearcher's timeout/cancellation runnables +
+    CancellableTask, SURVEY.md §2.1#37). Checked cooperatively between
+    per-segment kernel launches — the unit of work the engine schedules.
+
+    Semantics match the reference: a passed DEADLINE degrades to partial
+    results with "timed_out": true; a CANCELLED task raises
+    TaskCancelledException out of the request."""
+
+    def __init__(self, timeout_s: Optional[float] = None, task=None):
+        import time as _time
+        self.deadline = (_time.monotonic() + timeout_s
+                         if timeout_s is not None else None)
+        self.task = task
+        self.timed_out = False
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        import time as _time
+        return max(0.0, self.deadline - _time.monotonic())
+
+    def should_stop(self) -> bool:
+        """True ⇒ stop collecting and return partial results."""
+        if self.task is not None:
+            self.task.ensure_not_cancelled()  # raises when cancelled
+        if self.deadline is not None:
+            import time as _time
+            if _time.monotonic() >= self.deadline:
+                self.timed_out = True
+                return True
+        return False
 
 
 def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
@@ -51,13 +87,16 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
                   min_score: Optional[float] = None,
                   aggs: Optional[Any] = None,
                   sort_specs: Optional[List] = None,
-                  search_after: Optional[List] = None) -> QuerySearchResult:
+                  search_after: Optional[List] = None,
+                  ctx: Optional[SearchContext] = None) -> QuerySearchResult:
     """aggs: an AggregatorFactories (see search/aggregations) collected
     under the query's match mask per segment, reduced across segments to
     one shard-level partial (reference: QueryPhase runs the collector
     chain once for topk + aggs, SURVEY.md §3.3).
     sort_specs: parsed sort.SortSpec list → field-sorted results with
-    per-hit sort values (reference: FieldSortBuilder, §2.1#50)."""
+    per-hit sort values (reference: FieldSortBuilder, §2.1#50).
+    ctx: deadline/cancellation checked between segments — a timeout
+    returns the partial result with timed_out=True."""
     from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
                                                        SegmentAggContext)
 
@@ -65,21 +104,25 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
         return _execute_sorted_query(reader, query, size=size, from_=from_,
                                      min_score=min_score, aggs=aggs,
                                      sort_specs=sort_specs,
-                                     search_after=search_after)
+                                     search_after=search_after, ctx=ctx)
     k = size + from_
     per_segment: List[Tuple[int, np.ndarray, np.ndarray]] = []
     agg_parts: List[Dict[str, Any]] = []
     total = 0
+    timed_out = False
     for idx, view in enumerate(reader.views):
+        if ctx is not None and ctx.should_stop():
+            timed_out = True
+            break
         executor = SegmentQueryExecutor(reader, idx)
         mask, score = executor.execute(query)
         live = jnp.asarray(view.live_mask)
         final = bm25.mask_scores(score[None, :], mask[None, :], live)[0]
         total += int(jnp.sum(mask & live))
         if aggs:
-            ctx = SegmentAggContext(reader, idx)
+            agg_ctx = SegmentAggContext(reader, idx)
             agg_parts.append(aggs.collect(
-                ctx, np.asarray(mask & live)))
+                agg_ctx, np.asarray(mask & live)))
         if k > 0:
             vals, idxs = bm25.topk(final[None, :], k=min(k, view.pack.d_pad))
             per_segment.append((idx, np.asarray(vals[0]), np.asarray(idxs[0])))
@@ -105,12 +148,15 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
         from elasticsearch_tpu.search.aggregations import AggregatorFactories
         shard_aggs = (AggregatorFactories.reduce(agg_parts)
                       if agg_parts else aggs.empty())
-    return QuerySearchResult(hits, total, max_score, shard_aggs)
+    return QuerySearchResult(hits, total, max_score, shard_aggs,
+                             timed_out=timed_out)
 
 
 def _execute_sorted_query(reader: ShardReader, query: dsl.QueryNode, *,
                           size: int, from_: int, min_score, aggs,
-                          sort_specs: List, search_after) -> QuerySearchResult:
+                          sort_specs: List, search_after,
+                          ctx: Optional[SearchContext] = None
+                          ) -> QuerySearchResult:
     """Field-sorted query phase: per segment, vectorized lexsort over the
     matching docs' sort keys (numeric values / keyword ordinals), then a
     cross-segment merge on python value tuples."""
@@ -121,8 +167,12 @@ def _execute_sorted_query(reader: ShardReader, query: dsl.QueryNode, *,
     k = size + from_
     agg_parts: List[Dict[str, Any]] = []
     total = 0
+    timed_out = False
     merged: List[Tuple[Tuple, int, int, float, List]] = []
     for idx, view in enumerate(reader.views):
+        if ctx is not None and ctx.should_stop():
+            timed_out = True
+            break
         executor = SegmentQueryExecutor(reader, idx)
         mask, score = executor.execute(query)
         live = jnp.asarray(view.live_mask)
@@ -134,10 +184,10 @@ def _execute_sorted_query(reader: ShardReader, query: dsl.QueryNode, *,
             final_mask = final_mask & (scores_np >= min_score)
         total += int(final_mask.sum())
         if aggs:
-            ctx = SegmentAggContext(reader, idx)
+            agg_ctx = SegmentAggContext(reader, idx)
             pad = np.zeros(view.pack.d_pad, dtype=bool)
             pad[: len(final_mask)] = final_mask
-            agg_parts.append(aggs.collect(ctx, pad))
+            agg_parts.append(aggs.collect(agg_ctx, pad))
         value_arrays = sort_mod.segment_sort_values(reader, idx, sort_specs,
                                                     scores_np)
         if search_after is not None:
@@ -173,7 +223,8 @@ def _execute_sorted_query(reader: ShardReader, query: dsl.QueryNode, *,
     only_score = all(s.field == "_score" for s in sort_specs)
     max_score = (max((h.score for h in hits), default=None)
                  if only_score else None)
-    return QuerySearchResult(hits, total, max_score, shard_aggs)
+    return QuerySearchResult(hits, total, max_score, shard_aggs,
+                             timed_out=timed_out)
 
 
 def _lexsort_keys(segment, sort_specs, value_arrays, ords, scores_np):

@@ -1,0 +1,135 @@
+"""Task management: every running request is a registered, listable,
+cancellable task.
+
+Reference: `tasks/TaskManager`, `Task`/`CancellableTask`,
+`RestListTasksAction`, `RestCancelTasksAction` (SURVEY.md §2.1#46).
+Kept contracts: node-scoped incrementing ids rendered `nodeId:seq`, the
+`_tasks` listing shape, cooperative cancellation (the task flag flips
+immediately; the running action observes it at its next check point and
+raises TaskCancelledException).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (ResourceNotFoundException,
+                                             TaskCancelledException)
+
+
+ACTION_TASKS_LIST = "cluster/tasks/list"
+ACTION_TASKS_CANCEL = "cluster/tasks/cancel"
+
+
+def register_transport_handlers(node, transport) -> None:
+    """Cross-node task listing/cancel endpoints — registered at cluster
+    start like every other transport action (a lazily-registered handler
+    would be missing on nodes that never served a local /_tasks call)."""
+    transport.register_handler(
+        ACTION_TASKS_LIST,
+        lambda payload, frm: {"tasks": {
+            t.full_id: t.to_json()
+            for t in node.task_manager.list(payload.get("actions"))}})
+
+    def cancel_handler(payload, frm):
+        task = node.task_manager.cancel(
+            int(payload["task_id"]),
+            payload.get("reason", "by user request"))
+        return {"task": task.to_json()}
+
+    transport.register_handler(ACTION_TASKS_CANCEL, cancel_handler)
+
+
+class Task:
+    def __init__(self, task_id: int, node_id: str, action: str,
+                 description: str, cancellable: bool = True):
+        self.id = task_id
+        self.node_id = node_id
+        self.action = action
+        self.description = description
+        self.cancellable = cancellable
+        self.start_time_millis = int(time.time() * 1000)
+        self._start = time.monotonic()
+        self._cancelled = threading.Event()
+        self.cancel_reason: Optional[str] = None
+
+    @property
+    def full_id(self) -> str:
+        return f"{self.node_id}:{self.id}"
+
+    def cancel(self, reason: str = "by user request") -> None:
+        self.cancel_reason = reason
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def ensure_not_cancelled(self) -> None:
+        """Cooperative check point (reference: CancellableTask#
+        ensureNotCancelled) — call between units of work."""
+        if self._cancelled.is_set():
+            raise TaskCancelledException(
+                f"task [{self.full_id}] was cancelled "
+                f"[{self.cancel_reason}]")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "node": self.node_id, "id": self.id,
+            "type": "transport", "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": self.start_time_millis,
+            "running_time_in_nanos": int(
+                (time.monotonic() - self._start) * 1e9),
+            "cancellable": self.cancellable,
+            "cancelled": self.cancelled,
+        }
+
+
+class TaskManager:
+    """Node-level registry of running tasks."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tasks: Dict[int, Task] = {}
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = True) -> Task:
+        with self._lock:
+            self._seq += 1
+            task = Task(self._seq, self.node_id, action, description,
+                        cancellable)
+            self._tasks[task.id] = task
+            return task
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.id, None)
+
+    def list(self, actions: Optional[str] = None) -> List[Task]:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            import fnmatch
+            patterns = [p.strip() for p in actions.split(",") if p.strip()]
+            tasks = [t for t in tasks
+                     if any(fnmatch.fnmatch(t.action, p)
+                            for p in patterns)]
+        return tasks
+
+    def cancel(self, task_id: int,
+               reason: str = "by user request") -> Task:
+        with self._lock:
+            task = self._tasks.get(task_id)
+        if task is None:
+            raise ResourceNotFoundException(
+                f"task [{self.node_id}:{task_id}] is not found")
+        if not task.cancellable:
+            raise TaskCancelledException(
+                f"task [{self.node_id}:{task_id}] is not cancellable")
+        task.cancel(reason)
+        return task

@@ -224,6 +224,24 @@ def test_read_of_missing_index_does_not_autocreate(cluster):
     assert "nope" not in cluster[1].cluster.applied_state().indices
 
 
+def test_tasks_list_and_cancel_across_nodes(cluster):
+    """A task on node A is listable and cancellable via node B's REST —
+    the transport handlers must exist on every node from cluster start."""
+    owner, other = cluster[0], cluster[1]
+    task = owner.task_manager.register("indices:data/read/search",
+                                       "indices[dist]")
+    try:
+        status, listing = _handle(other, "GET", "/_tasks")
+        assert status == 200
+        assert task.full_id in listing["nodes"][owner.node_id]["tasks"]
+        status, res = _handle(other, "POST",
+                              f"/_tasks/{task.full_id}/_cancel")
+        assert status == 200, res
+        assert task.cancelled
+    finally:
+        owner.task_manager.unregister(task)
+
+
 def test_delete_index_everywhere(cluster):
     status, body = _handle(cluster[1], "DELETE", "/auto")
     assert status == 200

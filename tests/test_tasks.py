@@ -1,0 +1,157 @@
+"""Tasks API + search timeout/cancellation.
+
+Reference: TaskManager/CancellableTask + the search `timeout` contract —
+a request past its deadline returns partial results with
+"timed_out": true instead of pinning a thread (SURVEY.md §2.1#37/#46).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (ResourceNotFoundException,
+                                             TaskCancelledException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.tasks import TaskManager
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+class TestTaskManager:
+    def test_register_list_unregister(self):
+        tm = TaskManager("n1")
+        t = tm.register("indices:data/read/search", "test")
+        assert tm.list()[0].full_id == f"n1:{t.id}"
+        assert tm.list(actions="indices:data/read/*")
+        assert not tm.list(actions="cluster:*")
+        tm.unregister(t)
+        assert tm.list() == []
+
+    def test_cancel_flips_flag_and_checkpoint_raises(self):
+        tm = TaskManager("n1")
+        t = tm.register("indices:data/read/search", "test")
+        t.ensure_not_cancelled()  # no-op while live
+        tm.cancel(t.id)
+        assert t.cancelled
+        with pytest.raises(TaskCancelledException):
+            t.ensure_not_cancelled()
+
+    def test_cancel_unknown_task_404(self):
+        tm = TaskManager("n1")
+        with pytest.raises(ResourceNotFoundException):
+            tm.cancel(999)
+
+
+class TestSearchTimeout:
+    def _index_docs(self, node, n=20):
+        for i in range(n):
+            _handle(node, "PUT", f"/t/_doc/{i}",
+                    params={"refresh": "true"},
+                    body={"msg": f"hello world {i}", "n": i})
+
+    def test_expired_timeout_returns_partial_with_timed_out(self, node):
+        self._index_docs(node)
+        status, res = _handle(node, "POST", "/t/_search", body={
+            "query": {"match": {"msg": "hello"}}, "timeout": "0ms"})
+        assert status == 200
+        assert res["timed_out"] is True
+        # totals become a lower bound when collection stopped early
+        assert res["hits"]["total"]["relation"] == "gte"
+
+    def test_generous_timeout_unaffected(self, node):
+        self._index_docs(node)
+        status, res = _handle(node, "POST", "/t/_search", body={
+            "query": {"match": {"msg": "hello"}}, "timeout": "30s"})
+        assert status == 200
+        assert res["timed_out"] is False
+        assert res["hits"]["total"]["value"] == 20
+
+    def test_sorted_search_honors_timeout(self, node):
+        self._index_docs(node)
+        status, res = _handle(node, "POST", "/t/_search", body={
+            "query": {"match_all": {}}, "sort": [{"n": "desc"}],
+            "timeout": "0ms"})
+        assert status == 200
+        assert res["timed_out"] is True
+
+    def test_minus_one_means_no_timeout(self, node):
+        self._index_docs(node)
+        status, res = _handle(node, "POST", "/t/_search", body={
+            "query": {"match": {"msg": "hello"}}, "timeout": -1})
+        assert status == 200
+        assert res["timed_out"] is False
+        assert res["hits"]["total"]["value"] == 20
+
+    def test_timed_out_shard_counts_cover_all_targets(self, node):
+        self._index_docs(node)
+        status, res = _handle(node, "POST", "/t/_search", body={
+            "query": {"match": {"msg": "hello"}}, "timeout": "0ms"})
+        assert status == 200
+        n_shards = len(node.indices.index("t").shards)
+        assert res["_shards"]["total"] == n_shards
+        assert res["_shards"]["successful"] < n_shards or n_shards == 0 \
+            or res["_shards"]["successful"] == 0
+
+    def test_bad_timeout_grammar_400(self, node):
+        self._index_docs(node, 1)
+        status, res = _handle(node, "POST", "/t/_search", body={
+            "query": {"match_all": {}}, "timeout": "banana"})
+        assert status == 400
+
+
+class TestCancellation:
+    def test_cancelled_task_aborts_search(self, node):
+        for i in range(5):
+            _handle(node, "PUT", f"/c/_doc/{i}",
+                    params={"refresh": "true"}, body={"m": "x y z"})
+        from elasticsearch_tpu.search import coordinator
+        task = node.task_manager.register("indices:data/read/search", "t")
+        task.cancel("test")
+        with pytest.raises(TaskCancelledException):
+            coordinator.search(node.indices, "c",
+                               {"query": {"match": {"m": "x"}}}, {},
+                               task=task)
+
+    def test_rest_list_and_cancel_roundtrip(self, node):
+        # a long-running search shows up in /_tasks and can be cancelled
+        for i in range(5):
+            _handle(node, "PUT", f"/r/_doc/{i}",
+                    params={"refresh": "true"}, body={"m": "a b"})
+        task = node.task_manager.register("indices:data/read/search",
+                                          "indices[r]")
+        try:
+            status, listing = _handle(node, "GET", "/_tasks")
+            tasks = listing["nodes"][node.node_id]["tasks"]
+            assert task.full_id in tasks
+            assert tasks[task.full_id]["action"] == \
+                "indices:data/read/search"
+
+            status, res = _handle(node, "POST",
+                                  f"/_tasks/{task.full_id}/_cancel")
+            assert status == 200
+            assert res["nodes"][node.node_id]["tasks"][task.full_id][
+                "cancelled"] is True
+            assert task.cancelled
+        finally:
+            node.task_manager.unregister(task)
+
+    def test_cancel_missing_task_404(self, node):
+        status, res = _handle(node, "POST",
+                              f"/_tasks/{node.node_id}:424242/_cancel")
+        assert status == 404
+        status, res = _handle(node, "POST", "/_tasks/garbage/_cancel")
+        assert status == 400
