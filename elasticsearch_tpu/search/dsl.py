@@ -111,6 +111,86 @@ class IdsQuery(QueryNode):
 
 
 @dataclasses.dataclass
+class MultiMatchQuery(QueryNode):
+    """Reference: MultiMatchQueryBuilder — one text query over several
+    fields with per-field boosts ("title^2")."""
+
+    fields: List = dataclasses.field(default_factory=list)  # [(name, boost)]
+    query: str = ""
+    type: str = "best_fields"     # "best_fields" | "most_fields"
+    operator: str = "or"
+    minimum_should_match: Optional[int] = None
+    tie_breaker: float = 0.0
+
+    def query_name(self) -> str:
+        return "multi_match"
+
+
+@dataclasses.dataclass
+class PrefixQuery(QueryNode):
+    """Reference: PrefixQueryBuilder (constant-score rewrite)."""
+
+    field: str = ""
+    value: str = ""
+
+    def query_name(self) -> str:
+        return "prefix"
+
+
+@dataclasses.dataclass
+class WildcardQuery(QueryNode):
+    """Reference: WildcardQueryBuilder — `*` any run, `?` one char
+    (constant-score rewrite)."""
+
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+    def query_name(self) -> str:
+        return "wildcard"
+
+
+@dataclasses.dataclass
+class FuzzyQuery(QueryNode):
+    """Reference: FuzzyQueryBuilder — terms within edit distance
+    (Damerau-Levenshtein, transpositions count 1) of the value."""
+
+    field: str = ""
+    value: str = ""
+    fuzziness: Any = "AUTO"       # "AUTO" | 0 | 1 | 2
+    prefix_length: int = 0
+    max_expansions: int = 50
+
+    def query_name(self) -> str:
+        return "fuzzy"
+
+
+@dataclasses.dataclass
+class ScoreFunction:
+    """One entry of function_score.functions (reference:
+    ScoreFunctionBuilder): optional filter + one scoring primitive."""
+
+    filter_query: Optional[QueryNode] = None
+    weight: Optional[float] = None
+    field_value_factor: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass
+class FunctionScoreQuery(QueryNode):
+    """Reference: FunctionScoreQueryBuilder — combine the base query's
+    score with per-doc function values."""
+
+    query: QueryNode = None  # type: ignore[assignment]
+    functions: List[ScoreFunction] = dataclasses.field(default_factory=list)
+    score_mode: str = "multiply"  # multiply|sum|avg|max|min
+    boost_mode: str = "multiply"  # multiply|sum|replace|avg|max|min
+    max_boost: Optional[float] = None
+
+    def query_name(self) -> str:
+        return "function_score"
+
+
+@dataclasses.dataclass
 class BoolQuery(QueryNode):
     must: List[QueryNode] = dataclasses.field(default_factory=list)
     should: List[QueryNode] = dataclasses.field(default_factory=list)
@@ -253,6 +333,159 @@ def _parse_constant_score(body) -> ConstantScoreQuery:
                               boost=float(body.get("boost", 1.0)))
 
 
+def _parse_multi_match(body) -> MultiMatchQuery:
+    if not isinstance(body, dict) or "query" not in body:
+        raise ParsingException("[multi_match] requires [query]")
+    raw_fields = body.get("fields")
+    if not raw_fields or not isinstance(raw_fields, list):
+        raise ParsingException("[multi_match] requires [fields]")
+    fields = []
+    for f in raw_fields:
+        name, _, boost = str(f).partition("^")
+        try:
+            fields.append((name, float(boost) if boost else 1.0))
+        except ValueError:
+            raise ParsingException(
+                f"[multi_match] bad field boost in [{f}]") from None
+    mm_type = str(body.get("type", "best_fields"))
+    if mm_type not in ("best_fields", "most_fields"):
+        raise ParsingException(
+            f"[multi_match] unsupported type [{mm_type}] (best_fields and "
+            f"most_fields are available)")
+    op = str(body.get("operator", "or")).lower()
+    if op not in ("or", "and"):
+        raise ParsingException(f"[multi_match] unknown operator [{op}]")
+    msm = body.get("minimum_should_match")
+    known = {"query", "fields", "type", "operator", "minimum_should_match",
+             "tie_breaker", "boost"}
+    unknown = set(body) - known
+    if unknown:
+        raise ParsingException(
+            f"[multi_match] unknown parameter {sorted(unknown)}")
+    return MultiMatchQuery(
+        fields=fields, query=str(body["query"]), type=mm_type, operator=op,
+        minimum_should_match=None if msm is None else int(msm),
+        tie_breaker=float(body.get("tie_breaker", 0.0)),
+        boost=float(body.get("boost", 1.0)))
+
+
+def _parse_prefix(body) -> PrefixQuery:
+    field, spec = _field_and_params("prefix", body, "value")
+    return PrefixQuery(field=field, value=str(spec["value"]),
+                       boost=float(spec.get("boost", 1.0)))
+
+
+def _parse_wildcard(body) -> WildcardQuery:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingException("[wildcard] expects a single field")
+    field, spec = next(iter(body.items()))
+    if not isinstance(spec, dict):
+        spec = {"value": spec}
+    value = spec.get("value", spec.get("wildcard"))
+    if value is None:
+        raise ParsingException(f"[wildcard] on [{field}] requires [value]")
+    return WildcardQuery(field=field, value=str(value),
+                         case_insensitive=bool(
+                             spec.get("case_insensitive", False)),
+                         boost=float(spec.get("boost", 1.0)))
+
+
+def _parse_fuzzy(body) -> FuzzyQuery:
+    field, spec = _field_and_params("fuzzy", body, "value")
+    fuzziness = spec.get("fuzziness", "AUTO")
+    if isinstance(fuzziness, str) and fuzziness.upper() != "AUTO":
+        try:
+            fuzziness = int(fuzziness)
+        except ValueError:
+            raise ParsingException(
+                f"[fuzzy] bad fuzziness [{fuzziness}]") from None
+    if isinstance(fuzziness, int) and fuzziness not in (0, 1, 2):
+        raise ParsingException("[fuzzy] fuzziness must be AUTO, 0, 1 or 2")
+    return FuzzyQuery(field=field, value=str(spec["value"]),
+                      fuzziness=fuzziness,
+                      prefix_length=int(spec.get("prefix_length", 0)),
+                      max_expansions=int(spec.get("max_expansions", 50)),
+                      boost=float(spec.get("boost", 1.0)))
+
+
+def _parse_function_score(body) -> FunctionScoreQuery:
+    if not isinstance(body, dict):
+        raise ParsingException("[function_score] expects an object")
+    base = parse_query(body["query"]) if "query" in body \
+        else MatchAllQuery()
+
+    def parse_fn(obj) -> ScoreFunction:
+        known = {"filter", "weight", "field_value_factor"}
+        unknown = set(obj) - known
+        if unknown:
+            raise ParsingException(
+                f"[function_score] unsupported function parameter "
+                f"{sorted(unknown)} (filter/weight/field_value_factor "
+                f"are available)")
+        fvf = obj.get("field_value_factor")
+        if fvf is not None:
+            if "field" not in fvf:
+                raise ParsingException(
+                    "[field_value_factor] requires [field]")
+            mod = str(fvf.get("modifier", "none"))
+            if mod not in ("none", "log", "log1p", "log2p", "ln", "ln1p",
+                           "ln2p", "square", "sqrt", "reciprocal"):
+                raise ParsingException(
+                    f"[field_value_factor] unknown modifier [{mod}]")
+            for num_key in ("factor", "missing"):
+                if fvf.get(num_key) is not None:
+                    try:
+                        float(fvf[num_key])
+                    except (TypeError, ValueError):
+                        raise ParsingException(
+                            f"[field_value_factor] [{num_key}] must be "
+                            f"numeric, got [{fvf[num_key]}]") from None
+        if obj.get("weight") is None and fvf is None:
+            raise ParsingException(
+                "[function_score] function needs [weight] or "
+                "[field_value_factor]")
+        return ScoreFunction(
+            filter_query=(parse_query(obj["filter"])
+                          if "filter" in obj else None),
+            weight=(None if obj.get("weight") is None
+                    else float(obj["weight"])),
+            field_value_factor=fvf)
+
+    functions: List[ScoreFunction] = []
+    if "functions" in body:
+        if not isinstance(body["functions"], list):
+            raise ParsingException("[function_score] [functions] must be "
+                                   "an array")
+        functions = [parse_fn(f) for f in body["functions"]]
+    else:
+        shorthand = {k: body[k] for k in ("weight", "field_value_factor")
+                     if k in body}
+        if shorthand:
+            functions = [parse_fn(shorthand)]
+    for mode_key, default in (("score_mode", "multiply"),
+                              ("boost_mode", "multiply")):
+        mode = str(body.get(mode_key, default))
+        allowed = {"multiply", "sum", "avg", "max", "min"}
+        if mode_key == "boost_mode":
+            allowed = allowed | {"replace"}
+        if mode not in allowed:
+            raise ParsingException(
+                f"[function_score] unknown {mode_key} [{mode}]")
+    known = {"query", "functions", "weight", "field_value_factor",
+             "score_mode", "boost_mode", "max_boost", "boost"}
+    unknown = set(body) - known
+    if unknown:
+        raise ParsingException(
+            f"[function_score] unknown parameter {sorted(unknown)}")
+    return FunctionScoreQuery(
+        query=base, functions=functions,
+        score_mode=str(body.get("score_mode", "multiply")),
+        boost_mode=str(body.get("boost_mode", "multiply")),
+        max_boost=(None if body.get("max_boost") is None
+                   else float(body["max_boost"])),
+        boost=float(body.get("boost", 1.0)))
+
+
 _PARSERS = {
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
@@ -264,4 +497,9 @@ _PARSERS = {
     "exists": _parse_exists,
     "ids": _parse_ids,
     "constant_score": _parse_constant_score,
+    "multi_match": _parse_multi_match,
+    "prefix": _parse_prefix,
+    "wildcard": _parse_wildcard,
+    "fuzzy": _parse_fuzzy,
+    "function_score": _parse_function_score,
 }

@@ -43,6 +43,33 @@ from elasticsearch_tpu.search import dsl
 MAX_SLOTS_PER_PASS = 32
 
 
+def _edit_distance_lte(a: str, b: str, k: int) -> bool:
+    """Damerau-Levenshtein (adjacent transposition = 1) ≤ k, banded with
+    early exit (reference: Lucene's LevenshteinAutomata accept set for
+    fuzziness ≤ 2)."""
+    if k == 0:
+        return a == b
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev2: Optional[List[int]] = None
+    prev = list(range(len(b) + 1))
+    for i in range(1, len(a) + 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j in range(1, len(b) + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (prev2 is not None and i > 1 and j > 1
+                    and a[i - 1] == b[j - 2] and a[i - 2] == b[j - 1]):
+                d = min(d, prev2[j - 2] + 1)
+            cur[j] = d
+            row_min = min(row_min, d)
+        if row_min > k:
+            return False
+        prev2, prev = prev, cur
+    return prev[len(b)] <= k
+
+
 def _bucket(n: int, minimum: int = 1) -> int:
     """Round up to a power of two (jit-cache bounding, SURVEY.md §7.3#1)."""
     b = minimum
@@ -97,7 +124,228 @@ class SegmentQueryExecutor:
             return mask, jnp.where(mask, node.boost if scoring else 0.0, 0.0).astype(jnp.float32)
         if isinstance(node, dsl.BoolQuery):
             return self._eval_bool(node, scoring)
+        if isinstance(node, dsl.MultiMatchQuery):
+            return self._eval_multi_match(node, scoring)
+        if isinstance(node, dsl.PrefixQuery):
+            return self._eval_expanded_terms(
+                node.field, self._expand_prefix(node.field, node.value),
+                node.boost, scoring, constant=True)
+        if isinstance(node, dsl.WildcardQuery):
+            return self._eval_expanded_terms(
+                node.field, self._expand_wildcard(node), node.boost,
+                scoring, constant=True)
+        if isinstance(node, dsl.FuzzyQuery):
+            return self._eval_expanded_terms(
+                node.field, self._expand_fuzzy(node), node.boost,
+                scoring, constant=False)
+        if isinstance(node, dsl.FunctionScoreQuery):
+            return self._eval_function_score(node, scoring)
         raise QueryShardException(f"unsupported query [{node.query_name()}]")
+
+    def _eval_multi_match(self, node: dsl.MultiMatchQuery, scoring: bool):
+        """best_fields: per doc, the best field's score (+ tie_breaker ×
+        the rest); most_fields: sum. Mask is the OR of the field masks
+        (reference: DisjunctionMaxQuery vs a should-bool)."""
+        per_field = []
+        for field, fboost in node.fields:
+            sub = dsl.MatchQuery(
+                field=field, query=node.query, operator=node.operator,
+                minimum_should_match=node.minimum_should_match,
+                boost=fboost)
+            per_field.append(self._eval_match(sub, scoring))
+        if not per_field:
+            return self._none()
+        mask = per_field[0][0]
+        for m, _ in per_field[1:]:
+            mask = mask | m
+        scores = jnp.stack([s for _, s in per_field])
+        if node.type == "most_fields":
+            score = jnp.sum(scores, axis=0)
+        else:  # best_fields
+            best = jnp.max(scores, axis=0)
+            score = best + node.tie_breaker * (jnp.sum(scores, axis=0)
+                                               - best)
+        score = jnp.where(mask, score * node.boost, 0.0)
+        return mask, score
+
+    # ---- multi-term expansion (reference: MultiTermQuery rewrites) ----
+
+    _MAX_EXPANSIONS = 1024  # reference: indices.query.bool.max_clause_count
+
+    def _field_vocab(self, field: str):
+        fp = self.view.pack.fields.get(field)
+        return fp.vocab if fp is not None else {}
+
+    def _expand_prefix(self, field: str, prefix: str) -> List[str]:
+        terms = [t for t in self._field_vocab(field)
+                 if t.startswith(prefix)]
+        self._check_expansion(terms, "prefix")
+        return terms
+
+    def _expand_wildcard(self, node: dsl.WildcardQuery) -> List[str]:
+        import fnmatch
+        pattern = node.value.lower() if node.case_insensitive \
+            else node.value
+        # fnmatchcase: only * and ? are wildcards in the reference
+        # grammar; [] must match literally
+        pattern = pattern.replace("[", "[[]")
+        out = []
+        for t in self._field_vocab(node.field):
+            probe = t.lower() if node.case_insensitive else t
+            if fnmatch.fnmatchcase(probe, pattern):
+                out.append(t)
+        self._check_expansion(out, "wildcard")
+        return out
+
+    def _expand_fuzzy(self, node: dsl.FuzzyQuery) -> List[str]:
+        value = node.value
+        if node.fuzziness == "AUTO" or (
+                isinstance(node.fuzziness, str)):
+            n = len(value)
+            max_d = 0 if n < 3 else (1 if n < 6 else 2)
+        else:
+            max_d = int(node.fuzziness)
+        pl = node.prefix_length
+        prefix = value[:pl]
+        out = []
+        for t in self._field_vocab(node.field):
+            if abs(len(t) - len(value)) > max_d:
+                continue
+            if pl and not t.startswith(prefix):
+                continue
+            if _edit_distance_lte(value, t, max_d):
+                out.append(t)
+            if len(out) >= node.max_expansions:
+                break
+        return out
+
+    def _check_expansion(self, terms: List[str], kind: str) -> None:
+        if len(terms) > self._MAX_EXPANSIONS:
+            raise QueryShardException(
+                f"[{kind}] query expands to {len(terms)} terms, more "
+                f"than the {self._MAX_EXPANSIONS} clause limit")
+
+    def _eval_expanded_terms(self, field: str, terms: List[str],
+                             boost: float, scoring: bool, *,
+                             constant: bool):
+        """OR over an expanded term set. constant=True → the reference's
+        constant-score rewrite (prefix/wildcard score = boost); else
+        BM25-scored like a terms disjunction (fuzzy)."""
+        if not terms:
+            return self._none()
+        mask, score = self._eval_terms(field, terms, boost,
+                                       scoring and not constant, "or", 1,
+                                       pre_analyzed=True)
+        if constant and scoring:
+            score = jnp.where(mask, boost, 0.0).astype(jnp.float32)
+        return mask, score
+
+    def _eval_function_score(self, node: dsl.FunctionScoreQuery,
+                             scoring: bool):
+        mask, score = self._eval(node.query, scoring)
+        if not scoring:
+            return mask, score
+        if not node.functions:
+            # max_boost only caps function output; with no functions the
+            # query-level boost still applies
+            return mask, jnp.where(mask, score * node.boost, 0.0)
+        factors = []
+        applies = []   # per function: which docs its filter matches
+        for fn in node.functions:
+            factor = jnp.ones(self.d_pad, dtype=jnp.float32)
+            if fn.field_value_factor is not None:
+                factor = factor * self._field_value_factor(
+                    fn.field_value_factor)
+            if fn.weight is not None:
+                factor = factor * fn.weight
+            if fn.filter_query is not None:
+                fmask, _ = self._eval(fn.filter_query, scoring=False)
+            else:
+                fmask = jnp.ones(self.d_pad, dtype=bool)
+            factors.append(factor)
+            applies.append(fmask)
+        stacked = jnp.stack(factors)
+        applied = jnp.stack(applies)
+        n_applied = jnp.sum(applied, axis=0)
+        # only MATCHING functions combine (reference:
+        # FunctionScoreQuery#score — non-matching functions are absent
+        # from the combination, and a doc matching none scores neutral 1)
+        if node.score_mode == "multiply":
+            combined = jnp.prod(jnp.where(applied, stacked, 1.0), axis=0)
+        elif node.score_mode == "sum":
+            combined = jnp.sum(jnp.where(applied, stacked, 0.0), axis=0)
+        elif node.score_mode == "avg":
+            combined = (jnp.sum(jnp.where(applied, stacked, 0.0), axis=0)
+                        / jnp.maximum(n_applied, 1))
+        elif node.score_mode == "max":
+            combined = jnp.max(
+                jnp.where(applied, stacked, -jnp.inf), axis=0)
+        else:  # min
+            combined = jnp.min(
+                jnp.where(applied, stacked, jnp.inf), axis=0)
+        combined = jnp.where(n_applied > 0, combined, 1.0)
+        if node.max_boost is not None:
+            combined = jnp.minimum(combined, node.max_boost)
+        if node.boost_mode == "multiply":
+            final = score * combined
+        elif node.boost_mode == "sum":
+            final = score + combined
+        elif node.boost_mode == "replace":
+            final = combined
+        elif node.boost_mode == "avg":
+            final = (score + combined) / 2.0
+        elif node.boost_mode == "max":
+            final = jnp.maximum(score, combined)
+        else:  # min
+            final = jnp.minimum(score, combined)
+        return mask, jnp.where(mask, final * node.boost, 0.0)
+
+    def _field_value_factor(self, fvf: dict) -> jnp.ndarray:
+        """Per-doc factor from a doc-values column (reference:
+        FieldValueFactorFunction)."""
+        field = fvf["field"]
+        factor = float(fvf.get("factor", 1.0))
+        missing = fvf.get("missing")
+        pack = self.view.pack
+        if field in pack.dv_f64:
+            vals = jnp.asarray(pack.dv_f64[field], dtype=jnp.float32)
+            present = ~jnp.isnan(vals)
+        elif field in pack.dv_i64:
+            raw = pack.dv_i64[field]
+            present = jnp.asarray(raw != MISSING_I64)
+            vals = jnp.asarray(raw, dtype=jnp.float32)
+        else:
+            present = jnp.zeros(self.d_pad, dtype=bool)
+            vals = jnp.zeros(self.d_pad, dtype=jnp.float32)
+        if missing is None:
+            # the reference errors on missing values without [missing];
+            # a dense kernel can't throw per-doc, so treat as 0
+            fill = 0.0
+        else:
+            fill = float(missing)
+        vals = jnp.where(present, vals, fill) * factor
+        mod = fvf.get("modifier", "none")
+        if mod == "log":
+            vals = jnp.where(vals > 0, jnp.log10(jnp.maximum(vals, 1e-9)),
+                             0.0)
+        elif mod == "log1p":
+            vals = jnp.log10(jnp.maximum(vals, 0.0) + 1.0)
+        elif mod == "log2p":
+            vals = jnp.log10(jnp.maximum(vals, 0.0) + 2.0)
+        elif mod == "ln":
+            vals = jnp.where(vals > 0, jnp.log(jnp.maximum(vals, 1e-9)),
+                             0.0)
+        elif mod == "ln1p":
+            vals = jnp.log(jnp.maximum(vals, 0.0) + 1.0)
+        elif mod == "ln2p":
+            vals = jnp.log(jnp.maximum(vals, 0.0) + 2.0)
+        elif mod == "square":
+            vals = vals * vals
+        elif mod == "sqrt":
+            vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+        elif mod == "reciprocal":
+            vals = jnp.where(vals != 0, 1.0 / vals, 0.0)
+        return vals.astype(jnp.float32)
 
     def _eval_bool(self, node: dsl.BoolQuery, scoring: bool):
         mask = jnp.ones(self.d_pad, dtype=bool)

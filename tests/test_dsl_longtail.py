@@ -1,0 +1,320 @@
+"""Query DSL long tail: multi_match, prefix, wildcard, fuzzy,
+function_score — JSON → AST → execution round-trips with hand-computed
+oracle expectations (reference: MultiMatchQueryBuilder,
+PrefixQueryBuilder, WildcardQueryBuilder, FuzzyQueryBuilder,
+FunctionScoreQueryBuilder — SURVEY.md §2.1#29)."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.planner import _edit_distance_lte
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def books(node):
+    docs = [
+        {"title": "searching fast", "body": "quick brown fox", "rank": 10},
+        {"title": "quick results", "body": "searching the web", "rank": 5},
+        {"title": "slow snail", "body": "nothing here", "rank": 2},
+        {"title": "quick quick quick", "body": "fox fox", "rank": 0},
+        {"title": "searcher manual", "body": "grep and find", "rank": 7},
+    ]
+    for i, d in enumerate(docs):
+        _handle(node, "PUT", f"/books/_doc/{i}",
+                params={"refresh": "true"}, body=d)
+    return node
+
+
+def _search(node, query, extra=None):
+    body = {"query": query, "size": 20}
+    body.update(extra or {})
+    status, res = _handle(node, "POST", "/books/_search", body=body)
+    assert status == 200, res
+    return res
+
+
+def _ids(res):
+    return [h["_id"] for h in res["hits"]["hits"]]
+
+
+class TestMultiMatch:
+    def test_or_across_fields(self, books):
+        res = _search(books, {"multi_match": {
+            "query": "quick", "fields": ["title", "body"]}})
+        # quick in title: 1, 3; in body: 0
+        assert set(_ids(res)) == {"0", "1", "3"}
+
+    def test_best_fields_takes_max(self, books):
+        res = _search(books, {"multi_match": {
+            "query": "quick", "fields": ["title", "body"],
+            "type": "best_fields"}})
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        # per-field score must equal the plain match score of its best field
+        title_only = {h["_id"]: h["_score"] for h in _search(
+            books, {"match": {"title": "quick"}})["hits"]["hits"]}
+        body_only = {h["_id"]: h["_score"] for h in _search(
+            books, {"match": {"body": "quick"}})["hits"]["hits"]}
+        for doc_id, score in by_id.items():
+            expect = max(title_only.get(doc_id, 0.0),
+                         body_only.get(doc_id, 0.0))
+            assert score == pytest.approx(expect, rel=1e-5)
+
+    def test_most_fields_sums(self, books):
+        res = _search(books, {"multi_match": {
+            "query": "searching", "fields": ["title", "body"],
+            "type": "most_fields"}})
+        title_only = {h["_id"]: h["_score"] for h in _search(
+            books, {"match": {"title": "searching"}})["hits"]["hits"]}
+        body_only = {h["_id"]: h["_score"] for h in _search(
+            books, {"match": {"body": "searching"}})["hits"]["hits"]}
+        for h in res["hits"]["hits"]:
+            expect = (title_only.get(h["_id"], 0.0)
+                      + body_only.get(h["_id"], 0.0))
+            assert h["_score"] == pytest.approx(expect, rel=1e-5)
+
+    def test_field_boost_caret(self, books):
+        plain = _search(books, {"multi_match": {
+            "query": "quick", "fields": ["title", "body"]}})
+        boosted = _search(books, {"multi_match": {
+            "query": "quick", "fields": ["title^3", "body"]}})
+        p = {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}
+        b = {h["_id"]: h["_score"] for h in boosted["hits"]["hits"]}
+        # doc 3 matches only in title → exactly 3× the unboosted score
+        assert b["3"] == pytest.approx(3 * p["3"], rel=1e-5)
+
+    def test_tie_breaker(self, books):
+        res = _search(books, {"multi_match": {
+            "query": "searching", "fields": ["title", "body"],
+            "tie_breaker": 0.5}})
+        title_only = {h["_id"]: h["_score"] for h in _search(
+            books, {"match": {"title": "searching"}})["hits"]["hits"]}
+        body_only = {h["_id"]: h["_score"] for h in _search(
+            books, {"match": {"body": "searching"}})["hits"]["hits"]}
+        for h in res["hits"]["hits"]:
+            t = title_only.get(h["_id"], 0.0)
+            bo = body_only.get(h["_id"], 0.0)
+            expect = max(t, bo) + 0.5 * min(t, bo)
+            assert h["_score"] == pytest.approx(expect, rel=1e-5)
+
+    def test_unknown_type_rejected(self, books):
+        status, res = _handle(books, "POST", "/books/_search", body={
+            "query": {"multi_match": {"query": "x", "fields": ["title"],
+                                      "type": "cross_fields"}}})
+        assert status == 400
+
+
+class TestPrefixWildcard:
+    def test_prefix_expands_term_dict(self, books):
+        res = _search(books, {"prefix": {"title": {"value": "search"}}})
+        # matches "searching" (doc 0) and "searcher" (doc 4)
+        assert set(_ids(res)) == {"0", "4"}
+        # constant score = boost
+        assert all(h["_score"] == 1.0 for h in res["hits"]["hits"])
+
+    def test_prefix_boost(self, books):
+        res = _search(books, {"prefix": {"title": {"value": "search",
+                                                   "boost": 2.5}}})
+        assert all(h["_score"] == 2.5 for h in res["hits"]["hits"])
+
+    def test_wildcard_star_and_question(self, books):
+        res = _search(books, {"wildcard": {"title": {"value": "s*ing"}}})
+        assert set(_ids(res)) == {"0"}   # searching
+        res = _search(books, {"wildcard": {"body": {"value": "f?x"}}})
+        assert set(_ids(res)) == {"0", "3"}   # fox
+
+    def test_wildcard_no_match(self, books):
+        res = _search(books, {"wildcard": {"title": {"value": "zz*"}}})
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_prefix_on_keyword_field(self, node):
+        for i, tag in enumerate(["alpha", "alphabet", "beta"]):
+            _handle(node, "PUT", f"/k/_doc/{i}",
+                    params={"refresh": "true"},
+                    body={"tag": tag})
+        # dynamic mapping gives text+keyword? our mapper maps strings to
+        # text by default; index with explicit keyword mapping
+        status, res = _handle(node, "POST", "/k/_search", body={
+            "query": {"prefix": {"tag": {"value": "alpha"}}}})
+        assert status == 200
+        assert res["hits"]["total"]["value"] == 2
+
+
+class TestFuzzy:
+    def test_edit_distance_helper(self):
+        assert _edit_distance_lte("quick", "quick", 0)
+        assert _edit_distance_lte("quick", "quik", 1)      # deletion
+        assert _edit_distance_lte("quick", "quickk", 1)    # insertion
+        assert _edit_distance_lte("quick", "qiuck", 1)     # transposition
+        assert not _edit_distance_lte("quick", "slow", 2)
+        assert not _edit_distance_lte("quick", "quc", 1)
+
+    def test_fuzzy_matches_close_terms(self, books):
+        res = _search(books, {"fuzzy": {"title": {"value": "quikc"}}})
+        # AUTO for len 5 → distance 1; "quick" is a transposition away
+        assert set(_ids(res)) == {"1", "3"}
+
+    def test_fuzzy_zero_is_exact(self, books):
+        res = _search(books, {"fuzzy": {"title": {"value": "quikc",
+                                                  "fuzziness": 0}}})
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_fuzzy_prefix_length_filters(self, books):
+        res = _search(books, {"fuzzy": {"title": {
+            "value": "suick", "prefix_length": 1}}})
+        # quick is distance 1 but shares no 1-char prefix with "suick"
+        assert res["hits"]["total"]["value"] == 0
+
+
+class TestFunctionScore:
+    def test_weight_multiplies(self, books):
+        base = _search(books, {"match": {"title": "quick"}})
+        fs = _search(books, {"function_score": {
+            "query": {"match": {"title": "quick"}},
+            "functions": [{"weight": 4.0}]}})
+        b = {h["_id"]: h["_score"] for h in base["hits"]["hits"]}
+        for h in fs["hits"]["hits"]:
+            assert h["_score"] == pytest.approx(4.0 * b[h["_id"]],
+                                                rel=1e-5)
+
+    def test_field_value_factor_replace(self, books):
+        fs = _search(books, {"function_score": {
+            "query": {"match_all": {}},
+            "field_value_factor": {"field": "rank", "factor": 2.0,
+                                   "missing": 0},
+            "boost_mode": "replace"}})
+        scores = {h["_id"]: h["_score"] for h in fs["hits"]["hits"]}
+        assert scores["0"] == pytest.approx(20.0)
+        assert scores["1"] == pytest.approx(10.0)
+        assert _ids(fs)[0] == "0"  # rank 10 doc first
+
+    def test_field_value_factor_log1p(self, books):
+        fs = _search(books, {"function_score": {
+            "query": {"match_all": {}},
+            "field_value_factor": {"field": "rank", "modifier": "log1p",
+                                   "missing": 0},
+            "boost_mode": "replace"}})
+        scores = {h["_id"]: h["_score"] for h in fs["hits"]["hits"]}
+        assert scores["0"] == pytest.approx(math.log10(11.0), rel=1e-5)
+
+    def test_filtered_function_applies_selectively(self, books):
+        fs = _search(books, {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"filter": {"range": {"rank": {"gte": 7}}},
+                           "weight": 10.0}],
+            "boost_mode": "replace"}})
+        scores = {h["_id"]: h["_score"] for h in fs["hits"]["hits"]}
+        assert scores["0"] == pytest.approx(10.0)   # rank 10
+        assert scores["4"] == pytest.approx(10.0)   # rank 7
+        assert scores["2"] == pytest.approx(1.0)    # rank 2: neutral
+
+    def test_score_mode_sum(self, books):
+        fs = _search(books, {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"weight": 2.0}, {"weight": 3.0}],
+            "score_mode": "sum", "boost_mode": "replace"}})
+        assert all(h["_score"] == pytest.approx(5.0)
+                   for h in fs["hits"]["hits"])
+
+    def test_max_boost_caps(self, books):
+        fs = _search(books, {"function_score": {
+            "query": {"match_all": {}},
+            "field_value_factor": {"field": "rank", "missing": 0},
+            "max_boost": 3.0, "boost_mode": "replace"}})
+        assert all(h["_score"] <= 3.0 for h in fs["hits"]["hits"])
+
+    def test_avg_combines_only_matching_functions(self, books):
+        fs = _search(books, {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [
+                {"filter": {"range": {"rank": {"gte": 7}}}, "weight": 10.0},
+                {"filter": {"range": {"rank": {"gte": 100}}}, "weight": 4.0}],
+            "score_mode": "avg", "boost_mode": "replace"}})
+        scores = {h["_id"]: h["_score"] for h in fs["hits"]["hits"]}
+        # rank-10 doc matches only the first function → avg of {10} = 10,
+        # not mean(10, neutral)
+        assert scores["0"] == pytest.approx(10.0)
+        # a doc matching no function scores neutral 1
+        assert scores["2"] == pytest.approx(1.0)
+
+    def test_boost_applies_without_functions_even_with_max_boost(self,
+                                                                 books):
+        plain = _search(books, {"match": {"title": "quick"}})
+        fs = _search(books, {"function_score": {
+            "query": {"match": {"title": "quick"}},
+            "boost": 2.0, "max_boost": 5.0}})
+        p = {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}
+        for h in fs["hits"]["hits"]:
+            assert h["_score"] == pytest.approx(2.0 * p[h["_id"]],
+                                                rel=1e-5)
+
+    def test_unknown_function_score_key_400(self, books):
+        status, _ = _handle(books, "POST", "/books/_search", body={
+            "query": {"function_score": {
+                "query": {"match_all": {}}, "script_score": {}}}})
+        assert status == 400
+
+    def test_bad_caret_boost_400(self, books):
+        status, _ = _handle(books, "POST", "/books/_search", body={
+            "query": {"multi_match": {"query": "x",
+                                      "fields": ["title^fast"]}}})
+        assert status == 400
+
+    def test_bad_fvf_factor_400(self, books):
+        status, _ = _handle(books, "POST", "/books/_search", body={
+            "query": {"function_score": {
+                "query": {"match_all": {}},
+                "field_value_factor": {"field": "rank",
+                                       "factor": "fast"}}}})
+        assert status == 400
+
+    def test_function_needs_primitive(self, books):
+        status, _ = _handle(books, "POST", "/books/_search", body={
+            "query": {"function_score": {
+                "query": {"match_all": {}},
+                "functions": [{"filter": {"match_all": {}}}]}}})
+        assert status == 400
+
+
+class TestParsing:
+    def test_ast_shapes(self):
+        q = dsl.parse_query({"multi_match": {
+            "query": "x", "fields": ["a^2", "b"]}})
+        assert isinstance(q, dsl.MultiMatchQuery)
+        assert q.fields == [("a", 2.0), ("b", 1.0)]
+        q = dsl.parse_query({"fuzzy": {"f": "val"}})
+        assert isinstance(q, dsl.FuzzyQuery) and q.fuzziness == "AUTO"
+        q = dsl.parse_query({"wildcard": {"f": "a*b"}})
+        assert isinstance(q, dsl.WildcardQuery)
+        q = dsl.parse_query({"prefix": {"f": "ab"}})
+        assert isinstance(q, dsl.PrefixQuery)
+
+    def test_parse_errors(self):
+        with pytest.raises(ParsingException):
+            dsl.parse_query({"multi_match": {"query": "x"}})  # no fields
+        with pytest.raises(ParsingException):
+            dsl.parse_query({"fuzzy": {"f": {"value": "v",
+                                             "fuzziness": 3}}})
+        with pytest.raises(ParsingException):
+            dsl.parse_query({"function_score": {
+                "query": {"match_all": {}}, "score_mode": "bogus"}})
