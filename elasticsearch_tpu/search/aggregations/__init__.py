@@ -12,3 +12,6 @@ from elasticsearch_tpu.search.aggregations.base import (  # noqa: F401
 )
 from elasticsearch_tpu.search.aggregations import bucket as _bucket  # noqa: F401,E402
 from elasticsearch_tpu.search.aggregations import metrics as _metrics  # noqa: F401,E402
+from elasticsearch_tpu.search.aggregations.pipeline import (  # noqa: F401,E402
+    build_response,
+)

@@ -121,13 +121,17 @@ class Aggregator:
 
 
 class AggregatorFactories:
-    """A parsed {name: aggregator} level of the tree."""
+    """A parsed {name: aggregator} level of the tree. Pipelines at this
+    level run at response-build time on the reduced results (reference:
+    PipelineAggregator#reduce over InternalAggregations)."""
 
-    def __init__(self, aggregators: Dict[str, Aggregator]):
+    def __init__(self, aggregators: Dict[str, Aggregator],
+                 pipelines: Optional[Dict[str, Any]] = None):
         self.aggregators = aggregators
+        self.pipelines = pipelines or {}
 
     def __bool__(self) -> bool:
-        return bool(self.aggregators)
+        return bool(self.aggregators) or bool(self.pipelines)
 
     def collect(self, ctx: SegmentAggContext,
                 mask: np.ndarray) -> Dict[str, InternalAggregation]:
@@ -155,6 +159,7 @@ class AggregatorFactories:
 
 
 _PARSERS: Dict[str, Any] = {}
+_PIPELINE_PARSERS: Dict[str, Any] = {}
 
 
 def register_agg(type_name: str):
@@ -164,10 +169,18 @@ def register_agg(type_name: str):
     return deco
 
 
+def register_pipeline(type_name: str):
+    def deco(fn):
+        _PIPELINE_PARSERS[type_name] = fn
+        return fn
+    return deco
+
+
 def parse_aggregations(spec: Dict[str, Any]) -> AggregatorFactories:
     """Parse the request's "aggs" tree (reference: AggregatorFactories#
     parseAggregators): {name: {<type>: {...}, "aggs": {...}}}."""
     aggregators: Dict[str, Aggregator] = {}
+    pipelines: Dict[str, Any] = {}
     for name, body in (spec or {}).items():
         if not isinstance(body, dict):
             raise IllegalArgumentException(f"invalid agg [{name}]")
@@ -178,9 +191,16 @@ def parse_aggregations(spec: Dict[str, Any]) -> AggregatorFactories:
                 f"expected exactly one aggregation type for [{name}], "
                 f"got {type_keys}")
         t = type_keys[0]
+        if t in _PIPELINE_PARSERS:
+            if sub_spec:
+                raise IllegalArgumentException(
+                    f"pipeline aggregation [{name}] cannot hold sub-"
+                    f"aggregations")
+            pipelines[name] = _PIPELINE_PARSERS[t](name, body[t])
+            continue
         parser = _PARSERS.get(t)
         if parser is None:
             raise IllegalArgumentException(f"unknown aggregation type [{t}]")
         sub = parse_aggregations(sub_spec)
         aggregators[name] = parser(name, body[t], sub)
-    return AggregatorFactories(aggregators)
+    return AggregatorFactories(aggregators, pipelines)

@@ -544,3 +544,316 @@ class GlobalAggregator(Aggregator):
 @register_agg("global")
 def _parse_global(name, body, sub):
     return GlobalAggregator(name, sub)
+
+
+# ---------------------------------------------------------------------------
+# composite (after-key paging over a multi-source key space; reference:
+# search/aggregations/bucket/composite/CompositeAggregator)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _CompositeSource:
+    name: str
+    kind: str                 # "terms" | "histogram" | "date_histogram"
+    field: str
+    interval: Optional[float] = None
+    calendar: Optional[str] = None
+
+
+@dataclasses.dataclass
+class InternalComposite(InternalAggregation):
+    size: int
+    source_names: List[str]
+    buckets: Dict[tuple, Bucket]
+
+    def reduce(self, others):
+        merged = _merge_buckets([self.buckets]
+                                + [o.buckets for o in others])
+        return InternalComposite(self.size, self.source_names, merged)
+
+    def to_response(self) -> Dict[str, Any]:
+        ordered = sorted(self.buckets.values(),
+                         key=lambda b: b.key)[: self.size]
+        out_buckets = []
+        for b in ordered:
+            entry: Dict[str, Any] = {
+                "key": dict(zip(self.source_names, b.key)),
+                "doc_count": b.doc_count}
+            for sname, agg in b.sub.items():
+                entry[sname] = agg.to_response()
+            out_buckets.append(entry)
+        out: Dict[str, Any] = {"buckets": out_buckets}
+        if out_buckets:
+            out["after_key"] = out_buckets[-1]["key"]
+        return out
+
+
+class CompositeAggregator(Aggregator):
+    def __init__(self, name, sources: List[_CompositeSource], size: int,
+                 after: Optional[tuple], sub):
+        super().__init__(name, sub)
+        self.sources = sources
+        self.size = size
+        self.after = after
+
+    def _source_values(self, ctx, mask, src: _CompositeSource):
+        """doc ordinal → single value for this source (first value wins
+        on multi-valued fields)."""
+        vals, docs, ord_terms = ctx.field_values(src.field, mask)
+        if src.kind == "terms":
+            if ord_terms is not None:
+                resolved = [ord_terms[int(v)] for v in vals]
+            else:
+                resolved = [float(v) if not float(v).is_integer()
+                            else int(v) for v in vals]
+        else:
+            if ord_terms is not None:
+                raise IllegalArgumentException(
+                    f"composite source [{src.name}]: field [{src.field}] "
+                    f"is not numeric")
+            v = np.asarray(vals, dtype=np.float64)
+            if src.calendar:
+                resolved = [_calendar_floor(int(x), src.calendar)
+                            for x in v]
+            else:
+                keys = np.floor(v / src.interval) * src.interval
+                resolved = [int(k) if src.kind == "date_histogram"
+                            else float(k) for k in keys]
+        first: Dict[int, Any] = {}
+        for d, val in zip(docs, resolved):
+            first.setdefault(int(d), val)
+        return first
+
+    def collect(self, ctx, mask) -> InternalComposite:
+        per_source = [self._source_values(ctx, mask, s)
+                      for s in self.sources]
+        if not per_source:
+            return self.empty()
+        common = set(per_source[0])
+        for m in per_source[1:]:
+            common &= set(m)
+        by_key: Dict[tuple, List[int]] = {}
+        for d in common:
+            key = tuple(m[d] for m in per_source)
+            if self.after is not None:
+                try:
+                    if key <= self.after:
+                        continue  # paging: strictly after the cursor
+                except TypeError:
+                    raise IllegalArgumentException(
+                        f"[composite] [after] values {list(self.after)} "
+                        f"do not match the source key types") from None
+            by_key.setdefault(key, []).append(d)
+        # keep only the shard-level first `size` keys in key order — the
+        # reduce re-sorts and trims identically, so this loses nothing
+        buckets: Dict[tuple, Bucket] = {}
+        for key in sorted(by_key)[: self.size]:
+            doc_list = by_key[key]
+            sub = {}
+            if self.sub:
+                bucket_mask = np.zeros_like(np.asarray(mask))
+                bucket_mask[np.asarray(doc_list, dtype=np.int64)] = True
+                sub = self.sub.collect(ctx,
+                                       np.asarray(mask) & bucket_mask)
+            buckets[key] = Bucket(key, len(doc_list), sub)
+        return InternalComposite(self.size,
+                                 [s.name for s in self.sources], buckets)
+
+    def empty(self) -> InternalComposite:
+        return InternalComposite(self.size,
+                                 [s.name for s in self.sources], {})
+
+
+@register_agg("composite")
+def _parse_composite(name, body, sub):
+    raw_sources = body.get("sources")
+    if not isinstance(raw_sources, list) or not raw_sources:
+        raise IllegalArgumentException("[composite] requires [sources]")
+    sources: List[_CompositeSource] = []
+    for entry in raw_sources:
+        if not isinstance(entry, dict) or len(entry) != 1:
+            raise IllegalArgumentException(
+                "[composite] each source is {name: {type: {...}}}")
+        sname, spec = next(iter(entry.items()))
+        if not isinstance(spec, dict) or len(spec) != 1:
+            raise IllegalArgumentException(
+                f"[composite] source [{sname}] needs exactly one type")
+        kind, opts = next(iter(spec.items()))
+        if kind not in ("terms", "histogram", "date_histogram"):
+            raise IllegalArgumentException(
+                f"[composite] unsupported source type [{kind}]")
+        field = (opts or {}).get("field")
+        if field is None:
+            raise IllegalArgumentException(
+                f"[composite] source [{sname}] requires [field]")
+        interval = None
+        calendar = None
+        if kind == "histogram":
+            if opts.get("interval") is None:
+                raise IllegalArgumentException(
+                    f"[composite] histogram source [{sname}] requires "
+                    f"[interval]")
+            interval = float(opts["interval"])
+        elif kind == "date_histogram":
+            calendar = opts.get("calendar_interval")
+            fixed = opts.get("fixed_interval")
+            if calendar is None and fixed is None:
+                raise IllegalArgumentException(
+                    f"[composite] date_histogram source [{sname}] needs "
+                    f"calendar_interval or fixed_interval")
+            if fixed is not None:
+                interval = float(TimeValue.parse(str(fixed)).millis())
+                calendar = None
+        sources.append(_CompositeSource(sname, kind, field, interval,
+                                        calendar))
+    after_raw = body.get("after")
+    after = None
+    if after_raw is not None:
+        if not isinstance(after_raw, dict):
+            raise IllegalArgumentException("[composite] [after] must be "
+                                           "an object")
+        missing = [s.name for s in sources if s.name not in after_raw]
+        if missing:
+            raise IllegalArgumentException(
+                f"[composite] [after] missing keys {missing}")
+        after = tuple(after_raw[s.name] for s in sources)
+    return CompositeAggregator(name, sources,
+                               int(body.get("size", 10)), after, sub)
+
+
+# ---------------------------------------------------------------------------
+# significant_terms (JLH heuristic; reference: search/aggregations/
+# bucket/terms/SignificantTermsAggregatorFactory + JLHScore)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InternalSignificantTerms(InternalAggregation):
+    size: int
+    min_doc_count: int
+    subset_size: int
+    superset_size: int
+    # key → [subset_df, superset_df, sub]
+    stats: Dict[Any, List]
+
+    def reduce(self, others):
+        subset = self.subset_size
+        superset = self.superset_size
+        merged = {k: [v[0], v[1], dict(v[2])]
+                  for k, v in self.stats.items()}
+        for o in others:
+            subset += o.subset_size
+            superset += o.superset_size
+            for k, (s_df, b_df, sub) in o.stats.items():
+                cur = merged.get(k)
+                if cur is None:
+                    merged[k] = [s_df, b_df, dict(sub)]
+                else:
+                    cur[0] += s_df
+                    cur[1] += b_df
+                    cur[2] = AggregatorFactories.reduce([cur[2], sub]) \
+                        if cur[2] or sub else {}
+        return InternalSignificantTerms(self.size, self.min_doc_count,
+                                        subset, superset, merged)
+
+    @staticmethod
+    def _jlh(s_df, s_size, b_df, b_size) -> float:
+        if s_size == 0 or b_size == 0 or s_df == 0:
+            return 0.0
+        fg = s_df / s_size
+        bg = b_df / b_size
+        if fg <= bg or bg == 0:
+            return 0.0
+        return (fg - bg) * (fg / bg)
+
+    def to_response(self) -> Dict[str, Any]:
+        scored = []
+        for key, (s_df, b_df, sub) in self.stats.items():
+            if s_df < self.min_doc_count:
+                continue
+            score = self._jlh(s_df, self.subset_size, b_df,
+                              self.superset_size)
+            if score <= 0:
+                continue
+            scored.append((score, key, s_df, b_df, sub))
+        scored.sort(key=lambda t: (-t[0], t[1]))
+        buckets = []
+        for score, key, s_df, b_df, sub in scored[: self.size]:
+            entry = {"key": key, "doc_count": int(s_df),
+                     "score": float(score), "bg_count": int(b_df)}
+            for sname, agg in sub.items():
+                entry[sname] = agg.to_response()
+            buckets.append(entry)
+        return {"doc_count": int(self.subset_size),
+                "bg_count": int(self.superset_size),
+                "buckets": buckets}
+
+
+class SignificantTermsAggregator(Aggregator):
+    def __init__(self, name, field, size, shard_size, min_doc_count, sub):
+        super().__init__(name, sub)
+        self.field = field
+        self.size = size
+        self.shard_size = shard_size
+        self.min_doc_count = min_doc_count
+
+    def collect(self, ctx, mask) -> InternalSignificantTerms:
+        n = ctx.view.segment.num_docs
+        fg_mask = np.asarray(mask)
+        bg_mask = ctx.live_mask
+        subset_size = int(fg_mask[:n].sum())
+        superset_size = int(np.asarray(bg_mask)[:n].sum())
+        fg_vals, fg_docs, ord_terms = ctx.field_values(self.field, fg_mask)
+        bg_vals, _, _ = ctx.field_values(self.field, bg_mask)
+
+        def count(vals):
+            if ord_terms is not None:
+                ords = np.asarray(vals, dtype=np.int64)
+                c = np.bincount(ords, minlength=len(ord_terms))
+                return {ord_terms[i]: int(c[i])
+                        for i in np.nonzero(c)[0]}
+            uniq, counts = np.unique(vals, return_counts=True)
+            return {(int(u) if float(u).is_integer() else float(u)): int(c)
+                    for u, c in zip(uniq, counts)}
+
+        fg_counts = count(fg_vals) if len(fg_vals) else {}
+        bg_counts = count(bg_vals) if len(bg_vals) else {}
+        # shard-side trim by local JLH score bounds coordinator work
+        scored = sorted(
+            fg_counts.items(),
+            key=lambda kv: -InternalSignificantTerms._jlh(
+                kv[1], subset_size, bg_counts.get(kv[0], kv[1]),
+                superset_size))[: self.shard_size]
+        stats: Dict[Any, List] = {}
+        if self.sub and ord_terms is not None:
+            fg_ords = np.asarray(fg_vals, dtype=np.int64)
+            term_ord = {t: i for i, t in enumerate(ord_terms)}
+        for key, s_df in scored:
+            sub = {}
+            if self.sub:
+                if ord_terms is not None:
+                    sel = fg_ords == term_ord[key]
+                else:
+                    sel = np.asarray(fg_vals) == key
+                bucket_mask = np.zeros_like(fg_mask)
+                bucket_mask[fg_docs[sel]] = True
+                sub = self.sub.collect(ctx, fg_mask & bucket_mask)
+            stats[key] = [s_df, bg_counts.get(key, s_df), sub]
+        return InternalSignificantTerms(self.size, self.min_doc_count,
+                                        subset_size, superset_size, stats)
+
+    def empty(self) -> InternalSignificantTerms:
+        return InternalSignificantTerms(self.size, self.min_doc_count,
+                                        0, 0, {})
+
+
+@register_agg("significant_terms")
+def _parse_significant_terms(name, body, sub):
+    field = body.get("field")
+    if field is None:
+        raise IllegalArgumentException("[significant_terms] requires a "
+                                       "field")
+    size = int(body.get("size", 10))
+    shard_size = int(body.get("shard_size", size * 3 // 2 + 10))
+    return SignificantTermsAggregator(
+        name, field, size, max(size, shard_size),
+        int(body.get("min_doc_count", 3)), sub)

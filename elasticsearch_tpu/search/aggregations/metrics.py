@@ -5,10 +5,9 @@ SURVEY.md §2.1#38).
 Cardinality uses a real HyperLogLog++-style sketch (murmur3-hashed values,
 2^p registers, reduce = register max — the reference's
 HyperLogLogPlusPlus), with the linear-counting correction for small
-cardinalities. Percentiles collects exact values per shard and merges
-(reference uses TDigest; exact merge is strictly more accurate and the
-response shape is identical — swap for a sketch when shard values exceed
-memory budgets)."""
+cardinalities. Percentiles uses a merging t-digest (the reference's
+TDigestState): per-shard partials and the cross-shard reduce are both
+O(compression) centroids, never O(values)."""
 
 from __future__ import annotations
 
@@ -183,50 +182,139 @@ def _parse_cardinality(name, body, sub):
 
 
 # ---------------------------------------------------------------------------
-# percentiles (exact-merge)
+# percentiles (merging t-digest — reduce memory is O(compression), not
+# O(values); reference: TDigestState / AbstractTDigestPercentilesAggregator)
 # ---------------------------------------------------------------------------
 
 DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 
 
+class TDigest:
+    """Merging t-digest (Dunning's MergingDigest essentials): centroids
+    kept sorted by mean; compression bounds their number via the k1
+    scale-function size limit, giving tighter bins at the tails."""
+
+    __slots__ = ("compression", "means", "weights", "_min", "_max")
+
+    def __init__(self, compression: float = 100.0,
+                 means: Optional[np.ndarray] = None,
+                 weights: Optional[np.ndarray] = None,
+                 vmin: float = math.inf, vmax: float = -math.inf):
+        self.compression = compression
+        self.means = means if means is not None else np.empty(0)
+        self.weights = weights if weights is not None else np.empty(0)
+        self._min = vmin
+        self._max = vmax
+
+    @property
+    def total_weight(self) -> float:
+        return float(self.weights.sum()) if len(self.weights) else 0.0
+
+    def add_values(self, values: np.ndarray) -> "TDigest":
+        if len(values) == 0:
+            return self
+        return self._merged(np.concatenate([self.means, values]),
+                            np.concatenate([self.weights,
+                                            np.ones(len(values))]),
+                            min(self._min, float(values.min())),
+                            max(self._max, float(values.max())))
+
+    def merge(self, other: "TDigest") -> "TDigest":
+        if len(other.means) == 0:
+            return self
+        if len(self.means) == 0:
+            return other
+        return self._merged(
+            np.concatenate([self.means, other.means]),
+            np.concatenate([self.weights, other.weights]),
+            min(self._min, other._min), max(self._max, other._max))
+
+    def _merged(self, means: np.ndarray, weights: np.ndarray,
+                vmin: float, vmax: float) -> "TDigest":
+        order = np.argsort(means, kind="stable")
+        means, weights = means[order], weights[order]
+        total = weights.sum()
+        out_m: List[float] = []
+        out_w: List[float] = []
+        acc_m, acc_w, q0 = means[0], weights[0], 0.0
+        for m, w in zip(means[1:], weights[1:]):
+            q = q0 + (acc_w + w) / total
+            # k1 scale function size bound: centroids may hold at most
+            # 4·total·q(1−q)/compression weight — small near the tails
+            k_size = max(1.0,
+                         4.0 * total * q * (1.0 - q) / self.compression)
+            if acc_w + w <= k_size:
+                acc_m = (acc_m * acc_w + m * w) / (acc_w + w)
+                acc_w += w
+            else:
+                out_m.append(acc_m)
+                out_w.append(acc_w)
+                q0 += acc_w / total
+                acc_m, acc_w = m, w
+        out_m.append(acc_m)
+        out_w.append(acc_w)
+        return TDigest(self.compression, np.asarray(out_m),
+                       np.asarray(out_w), vmin, vmax)
+
+    def quantile(self, q: float) -> Optional[float]:
+        if len(self.means) == 0:
+            return None
+        if len(self.means) == 1:
+            return float(self.means[0])
+        total = self.weights.sum()
+        target = q / 100.0 * total
+        # centroid i covers cumulative weight centered at its midpoint
+        cum = np.cumsum(self.weights) - self.weights / 2.0
+        if target <= cum[0]:
+            return self._min if q <= 0 else float(
+                self._min + (self.means[0] - self._min)
+                * max(0.0, target) / max(cum[0], 1e-12))
+        if target >= cum[-1]:
+            return self._max if q >= 100 else float(
+                self.means[-1] + (self._max - self.means[-1])
+                * (target - cum[-1]) / max(total - cum[-1], 1e-12))
+        i = int(np.searchsorted(cum, target)) - 1
+        span = cum[i + 1] - cum[i]
+        frac = (target - cum[i]) / max(span, 1e-12)
+        return float(self.means[i] + frac * (self.means[i + 1]
+                                             - self.means[i]))
+
+
 @dataclasses.dataclass
 class InternalPercentiles(InternalAggregation):
     percents: Sequence[float]
-    values: np.ndarray
+    digest: TDigest
 
     def reduce(self, others):
-        vals = [self.values] + [o.values for o in others]
-        return InternalPercentiles(self.percents,
-                                   np.concatenate(vals) if vals else self.values)
+        d = self.digest
+        for o in others:
+            d = d.merge(o.digest)
+        return InternalPercentiles(self.percents, d)
 
     def to_response(self) -> Dict[str, Any]:
-        out = {}
-        if len(self.values) == 0:
-            return {"values": {f"{p:g}": None for p in self.percents}}
-        v = np.sort(self.values)
-        for p in self.percents:
-            # linear interpolation between closest ranks (TDigest-compatible
-            # at the endpoints: 0 → min, 100 → max)
-            out[f"{p:g}"] = float(np.percentile(v, p))
-        return {"values": out}
+        return {"values": {f"{p:g}": self.digest.quantile(p)
+                           for p in self.percents}}
 
 
 class PercentilesAggregator(Aggregator):
-    def __init__(self, name, field, percents, sub=None):
+    def __init__(self, name, field, percents, compression=100.0, sub=None):
         super().__init__(name, sub or AggregatorFactories({}))
         self.field = field
         self.percents = percents
+        self.compression = compression
 
     def collect(self, ctx, mask) -> InternalPercentiles:
         vals, _, ord_terms = ctx.field_values(self.field, mask)
         if ord_terms is not None:
             raise IllegalArgumentException(
                 f"agg [{self.name}]: field [{self.field}] is not numeric")
-        return InternalPercentiles(self.percents,
-                                   np.asarray(vals, dtype=np.float64))
+        digest = TDigest(self.compression).add_values(
+            np.asarray(vals, dtype=np.float64))
+        return InternalPercentiles(self.percents, digest)
 
     def empty(self) -> InternalPercentiles:
-        return InternalPercentiles(self.percents, np.empty(0))
+        return InternalPercentiles(self.percents,
+                                   TDigest(self.compression))
 
 
 @register_agg("percentiles")
@@ -235,7 +323,9 @@ def _parse_percentiles(name, body, sub):
     if field is None:
         raise IllegalArgumentException("[percentiles] requires a field")
     percents = tuple(body.get("percents", DEFAULT_PERCENTS))
-    return PercentilesAggregator(name, field, percents, sub)
+    compression = float((body.get("tdigest") or {}).get(
+        "compression", 100.0))
+    return PercentilesAggregator(name, field, percents, compression, sub)
 
 
 # ---------------------------------------------------------------------------

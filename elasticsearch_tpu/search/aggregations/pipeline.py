@@ -1,0 +1,206 @@
+"""Pipeline aggregations — computed over REDUCED results at
+response-build time, never per shard.
+
+Reference: `search/aggregations/pipeline/**` (SURVEY.md §2.1#38):
+sibling pipelines (avg_bucket, sum_bucket, min_bucket, max_bucket,
+stats_bucket) read a metric across a sibling multi-bucket agg via
+`buckets_path` ("histo>metric" / "histo>_count"); parent pipelines
+(derivative, cumulative_sum) run inside a histogram and add a value to
+each bucket. `build_response` is the reduce-phase entry point the
+coordinator calls instead of the raw `to_response`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.search.aggregations.base import (AggregatorFactories,
+                                                        register_pipeline)
+
+SIBLING = "sibling"
+PARENT = "parent"
+
+
+@dataclasses.dataclass
+class Pipeline:
+    name: str
+    kind: str           # "avg_bucket" | ... | "derivative" | ...
+    mode: str           # SIBLING | PARENT
+    buckets_path: str
+    gap_policy: str = "skip"      # "skip" | "insert_zeros"
+
+    # ---------------- path resolution ----------------
+
+    def _metric_from_bucket(self, bucket: Dict[str, Any],
+                            segments: List[str]) -> Optional[float]:
+        if segments == ["_count"]:
+            return float(bucket.get("doc_count", 0))
+        node: Any = bucket
+        for seg in segments:
+            if not isinstance(node, dict) or seg not in node:
+                return None
+            node = node[seg]
+        if isinstance(node, dict):
+            node = node.get("value")
+        return None if node is None else float(node)
+
+    def _bucket_values(self, host: Dict[str, Any]
+                       ) -> List[Optional[float]]:
+        """Sibling mode: resolve `agg>metric` against `host` (the dict
+        holding the sibling agg's response)."""
+        first, _, rest = self.buckets_path.partition(">")
+        sibling = host.get(first)
+        if not isinstance(sibling, dict) or "buckets" not in sibling:
+            raise IllegalArgumentException(
+                f"[{self.name}] buckets_path [{self.buckets_path}] must "
+                f"point at a multi-bucket aggregation")
+        segments = rest.split(">") if rest else ["_count"]
+        buckets = sibling["buckets"]
+        if isinstance(buckets, dict):  # keyed filters
+            buckets = list(buckets.values())
+        return [self._metric_from_bucket(b, segments) for b in buckets]
+
+    def _values(self, host: Dict[str, Any]) -> List[float]:
+        vals = self._bucket_values(host)
+        if self.gap_policy == "insert_zeros":
+            return [0.0 if v is None else v for v in vals]
+        return [v for v in vals if v is not None]
+
+    # ---------------- sibling computation ----------------
+
+    def _bucket_keys(self, host: Dict[str, Any]) -> List[Any]:
+        first, _, _ = self.buckets_path.partition(">")
+        buckets = host.get(first, {}).get("buckets", [])
+        if isinstance(buckets, dict):
+            return list(buckets.keys())
+        return [b.get("key") for b in buckets]
+
+    def compute_sibling(self, host: Dict[str, Any]) -> Dict[str, Any]:
+        vals = self._values(host)
+        if self.kind == "avg_bucket":
+            return {"value": sum(vals) / len(vals) if vals else None}
+        if self.kind == "sum_bucket":
+            return {"value": sum(vals) if vals else 0.0}
+        if self.kind in ("min_bucket", "max_bucket"):
+            # the response carries WHICH bucket(s) won (reference:
+            # InternalBucketMetricValue#keys)
+            if not vals:
+                return {"value": None, "keys": []}
+            best = min(vals) if self.kind == "min_bucket" else max(vals)
+            all_vals = self._bucket_values(host)
+            if self.gap_policy == "insert_zeros":
+                all_vals = [0.0 if v is None else v for v in all_vals]
+            keys = [str(k) for k, v in zip(self._bucket_keys(host),
+                                           all_vals) if v == best]
+            return {"value": best, "keys": keys}
+        if self.kind == "stats_bucket":
+            if not vals:
+                return {"count": 0, "min": None, "max": None,
+                        "avg": None, "sum": 0.0}
+            return {"count": len(vals), "min": min(vals),
+                    "max": max(vals), "avg": sum(vals) / len(vals),
+                    "sum": sum(vals)}
+        raise IllegalArgumentException(
+            f"unknown sibling pipeline [{self.kind}]")
+
+    # ---------------- parent computation ----------------
+
+    def compute_parent(self, buckets: List[Dict[str, Any]]) -> None:
+        segments = (self.buckets_path.split(">")
+                    if self.buckets_path != "_count" else ["_count"])
+        prev: Optional[float] = None
+        running = 0.0
+        for b in buckets:
+            v = self._metric_from_bucket(b, segments)
+            if v is None and self.gap_policy == "insert_zeros":
+                v = 0.0
+            if self.kind == "cumulative_sum":
+                running += 0.0 if v is None else v
+                b[self.name] = {"value": running}
+            elif self.kind == "derivative":
+                # first bucket (prev None) has no derivative; under
+                # gap_policy=skip a gap bucket emits none and doesn't
+                # advance prev (the next derivative spans the gap)
+                if v is not None and prev is not None:
+                    b[self.name] = {"value": v - prev}
+                if v is not None:
+                    prev = v
+
+
+def apply_pipelines(factories: AggregatorFactories,
+                    node: Dict[str, Any]) -> None:
+    """Walk the response tree alongside the parsed agg tree, recursing
+    into buckets, then materialize this level's pipelines."""
+    for name, agg in factories.aggregators.items():
+        sub = getattr(agg, "sub", None)
+        if sub is None or not sub:
+            continue
+        entry = node.get(name)
+        if not isinstance(entry, dict):
+            continue
+        buckets = entry.get("buckets")
+        if isinstance(buckets, list):
+            for b in buckets:
+                apply_pipelines(sub, b)
+            for pname, pipe in sub.pipelines.items():
+                if pipe.mode == PARENT:
+                    pipe.compute_parent(buckets)
+        else:
+            # keyed filters (dict buckets) and single-bucket parents
+            # cannot host a sequential parent pipeline — reject, never
+            # silently drop (reference: 400 on invalid placement)
+            for pname, pipe in sub.pipelines.items():
+                if pipe.mode == PARENT:
+                    raise IllegalArgumentException(
+                        f"[{pipe.kind}] aggregation [{pname}] must be "
+                        f"declared inside an ordered multi-bucket "
+                        f"aggregation (histogram)")
+            if isinstance(buckets, dict):
+                for b in buckets.values():
+                    apply_pipelines(sub, b)
+            else:
+                # single-bucket agg: sub responses flattened in place
+                apply_pipelines(sub, entry)
+    for pname, pipe in factories.pipelines.items():
+        # PARENT pipelines at this level were computed by the enclosing
+        # multi-bucket agg above (build_response rejects top-level ones)
+        if pipe.mode == SIBLING:
+            node[pname] = pipe.compute_sibling(node)
+
+
+def build_response(factories: AggregatorFactories,
+                   reduced: Dict[str, Any]) -> Dict[str, Any]:
+    """Reduced internal aggs → response JSON with pipelines applied
+    (the coordinator's final-reduce hook)."""
+    out = AggregatorFactories.to_response(reduced)
+    # top-level parent pipelines are invalid (no enclosing buckets)
+    for pname, pipe in factories.pipelines.items():
+        if pipe.mode == PARENT:
+            raise IllegalArgumentException(
+                f"[{pipe.kind}] aggregation [{pname}] must be declared "
+                f"inside a multi-bucket aggregation")
+    apply_pipelines(factories, out)
+    return out
+
+
+def _parse(kind: str, mode: str):
+    def parser(name, body) -> Pipeline:
+        path = (body or {}).get("buckets_path")
+        if not path:
+            raise IllegalArgumentException(
+                f"[{kind}] requires [buckets_path]")
+        gap = str((body or {}).get("gap_policy", "skip"))
+        if gap not in ("skip", "insert_zeros"):
+            raise IllegalArgumentException(
+                f"[{kind}] unknown gap_policy [{gap}]")
+        return Pipeline(name, kind, mode, str(path), gap)
+    return parser
+
+
+for _kind in ("avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
+              "stats_bucket"):
+    register_pipeline(_kind)(_parse(_kind, SIBLING))
+for _kind in ("derivative", "cumulative_sum"):
+    register_pipeline(_kind)(_parse(_kind, PARENT))

@@ -208,12 +208,14 @@ def search(indices: IndicesService, index_expr: Optional[str],
                  "hits": hits_json},
     }
 
-    # ---- agg reduce across shards ----
+    # ---- agg reduce across shards (+ pipeline aggs on the final
+    # reduced tree) ----
     if aggs:
+        from elasticsearch_tpu.search.aggregations import build_response
         parts = [res.aggregations for _, _, _, res in shard_results
                  if res.aggregations is not None]
         reduced = AggregatorFactories.reduce(parts) if parts else aggs.empty()
-        out["aggregations"] = AggregatorFactories.to_response(reduced)
+        out["aggregations"] = build_response(aggs, reduced)
     return out
 
 
@@ -491,18 +493,17 @@ def merge_group_responses(groups: List[Dict[str, Any]],
     if aggs_spec:
         import base64
         import pickle
+
+        from elasticsearch_tpu.search.aggregations import build_response
+        aggs = parse_aggregations(aggs_spec)
         parts = []
         for g in groups:
             blob = g.get("aggs_blob")
             if blob:
                 parts.extend(pickle.loads(base64.b64decode(blob)))
-        if parts:
-            reduced = AggregatorFactories.reduce(parts)
-            out["aggregations"] = AggregatorFactories.to_response(reduced)
-        else:
-            aggs = parse_aggregations(aggs_spec)
-            out["aggregations"] = AggregatorFactories.to_response(
-                aggs.empty())
+        reduced = (AggregatorFactories.reduce(parts) if parts
+                   else aggs.empty())
+        out["aggregations"] = build_response(aggs, reduced)
     return out
 
 

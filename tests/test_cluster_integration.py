@@ -185,6 +185,30 @@ def test_aggregations_reduce_across_nodes(cluster):
         (sum(range(30)) - 7 + 700 - 700) / 29)
 
 
+def test_composite_and_pipeline_aggs_across_nodes(cluster):
+    """The new agg types reduce correctly across node boundaries (their
+    partials ride the pickled blob in the search group response)."""
+    status, res = _handle(cluster[2], "POST", "/dist/_search", body={
+        "size": 0,
+        "aggs": {
+            "pages": {"composite": {
+                "size": 10,
+                "sources": [{"t": {"terms": {"field": "tag"}}}]}},
+            "ranks": {"histogram": {"field": "rank", "interval": 10},
+                      "aggs": {"m": {"max": {"field": "rank"}}}},
+            "best": {"max_bucket": {"buckets_path": "ranks>m"}},
+            "p50": {"percentiles": {"field": "rank",
+                                    "percents": [50.0]}}}})
+    assert status == 200, res
+    aggs = res["aggregations"]
+    comp = aggs["pages"]["buckets"]
+    # docs 0..29 minus deleted doc-7 → tags t0..t4; shards span 3 nodes
+    assert sum(b["doc_count"] for b in comp) == 29
+    assert [b["key"]["t"] for b in comp] == [f"t{i}" for i in range(5)]
+    assert aggs["best"]["value"] == 29.0
+    assert aggs["p50"]["values"]["50"] is not None
+
+
 def test_count_across_nodes(cluster):
     status, res = _handle(cluster[2], "POST", "/dist/_count",
                           body={"query": {"match_all": {}}})
