@@ -41,6 +41,8 @@ class Node:
         self.indices = IndicesService(data_path)
         from elasticsearch_tpu.tasks import TaskManager
         self.task_manager = TaskManager(self.node_id)
+        from elasticsearch_tpu.search.contexts import SearchContextManager
+        self.search_contexts = SearchContextManager()
         # the TPU serving path: resident packs + micro-batched kernel
         # (disable with search.tpu_serving.enabled=false — the planner
         # path then serves everything)
@@ -139,6 +141,10 @@ class Node:
                     svc.refresh()
                 except Exception:  # noqa: BLE001 — background task
                     pass
+            try:  # expire scroll/PIT contexts so idle nodes don't pin
+                self.search_contexts.reap()
+            except Exception:  # noqa: BLE001 — background task
+                pass
             self._refresher = threading.Timer(self._refresh_interval, tick)
             self._refresher.daemon = True
             self._refresher.start()

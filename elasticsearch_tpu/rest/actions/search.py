@@ -14,19 +14,64 @@ def register(controller: RestController, node) -> None:
     indices = node.indices
 
     def do_search(req: RestRequest):
+        from elasticsearch_tpu.search import scroll as scroll_mod
         task = node.task_manager.register(
             "indices:data/read/search",
             description=f"indices[{req.param('index') or '_all'}]")
         try:
+            body = req.body or {}
+            if req.params.get("scroll"):
+                return 200, scroll_mod.start_scroll(
+                    node, req.param("index"), body, req.params, task=task)
+            if "pit" in body:
+                if not isinstance(body["pit"], dict):
+                    raise IllegalArgumentException(
+                        "[pit] must be an object with an [id]")
+                return 200, scroll_mod.search_pit(node, body, req.params,
+                                                  task=task)
             if node.cluster is not None:
                 return 200, node.cluster.route_search(
-                    req.param("index"), req.body or {}, req.params,
-                    task=task)
+                    req.param("index"), body, req.params, task=task)
             return 200, coordinator.search(
-                indices, req.param("index"), req.body or {}, req.params,
+                indices, req.param("index"), body, req.params,
                 tpu_search=getattr(node, "tpu_search", None), task=task)
         finally:
             node.task_manager.unregister(task)
+
+    def scroll_page(req: RestRequest):
+        from elasticsearch_tpu.search import scroll as scroll_mod
+        body = req.body or {}
+        scroll_id = (req.param("scroll_id") or body.get("scroll_id")
+                     or req.params.get("scroll_id"))
+        if not scroll_id:
+            raise IllegalArgumentException("[scroll_id] is required")
+        keep = body.get("scroll") or req.params.get("scroll")
+        return 200, scroll_mod.next_page(node, scroll_id, keep)
+
+    def clear_scroll(req: RestRequest):
+        from elasticsearch_tpu.search import scroll as scroll_mod
+        body = req.body or {}
+        ids = req.param("scroll_id") or body.get("scroll_id")
+        if isinstance(ids, str):
+            ids = [ids]
+        return 200, scroll_mod.clear(node, ids)
+
+    def open_pit(req: RestRequest):
+        from elasticsearch_tpu.search import scroll as scroll_mod
+        keep = req.params.get("keep_alive")
+        if not keep:
+            raise IllegalArgumentException(
+                "[open_point_in_time] requires [keep_alive]")
+        return 200, scroll_mod.open_pit(node, req.param("index"), keep)
+
+    def close_pit(req: RestRequest):
+        from elasticsearch_tpu.search import scroll as scroll_mod
+        body = req.body or {}
+        pit_id = body.get("id")
+        if not pit_id:
+            raise IllegalArgumentException(
+                "[close_point_in_time] requires [id]")
+        return 200, scroll_mod.close_pit(node, pit_id)
 
     def do_count(req: RestRequest):
         if node.cluster is not None:
@@ -66,6 +111,15 @@ def register(controller: RestController, node) -> None:
     controller.register("POST", "/_search", do_search)
     controller.register("GET", "/{index}/_search", do_search)
     controller.register("POST", "/{index}/_search", do_search)
+    controller.register("GET", "/_search/scroll", scroll_page)
+    controller.register("POST", "/_search/scroll", scroll_page)
+    controller.register("GET", "/_search/scroll/{scroll_id}", scroll_page)
+    controller.register("POST", "/_search/scroll/{scroll_id}", scroll_page)
+    controller.register("DELETE", "/_search/scroll", clear_scroll)
+    controller.register("DELETE", "/_search/scroll/{scroll_id}",
+                        clear_scroll)
+    controller.register("POST", "/{index}/_pit", open_pit)
+    controller.register("DELETE", "/_pit", close_pit)
     controller.register("GET", "/_count", do_count)
     controller.register("POST", "/_count", do_count)
     controller.register("GET", "/{index}/_count", do_count)

@@ -70,6 +70,10 @@ def error_status(exc: Exception) -> int:
     for klass, status in STATUS_BY_EXC:
         if isinstance(exc, klass):
             return status
+    # any other EsException carries its own status (reference:
+    # ElasticsearchException#status)
+    if isinstance(exc, es_errors.EsException):
+        return int(getattr(exc, "status", 500))
     return 500
 
 

@@ -1,0 +1,159 @@
+"""Scroll + point-in-time search orchestration over pinned contexts.
+
+Reference: `RestSearchScrollAction` / `RestClearScrollAction` /
+`RestOpenPointInTimeAction`, `SearchService#executeQueryPhase` against a
+ReaderContext (SURVEY.md §2.1#36). Kept contracts: `_scroll_id` in every
+scroll response, pages end with an empty hits array, cleared scrolls
+return num_freed, PIT search bodies name the context (`"pit": {"id"}`)
+and responses echo `pit_id`, and a context is a STABLE snapshot —
+deletes/writes after creation never change what it returns.
+
+Contexts are node-local (like the reference). In cluster mode the
+coordinating node serves them only when every target shard is local;
+distributed contexts are not offered yet — callers get a clear 400
+instead of wrong pages."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.search import coordinator
+from elasticsearch_tpu.search.contexts import parse_keep_alive
+
+
+def _resolve_and_check(node, index_expr: Optional[str]) -> List[str]:
+    """Resolve target indices against the CLUSTER view (never just the
+    local registry — a wildcard must see remote-hosted indices too) and
+    reject any target whose shards aren't all local."""
+    if node.cluster is None:
+        return coordinator.resolve_indices(node.indices, index_expr)
+    names = node.cluster.resolve_indices(index_expr)
+    state = node.cluster.applied_state()
+    local = node.node_id
+    for name in names:
+        meta = state.indices.get(name)
+        if meta is None:
+            continue
+        for shard in range(meta.number_of_shards):
+            primary = state.primary(name, shard)
+            if primary is None or primary.node_id != local:
+                raise IllegalArgumentException(
+                    "scroll/point-in-time contexts require every target "
+                    "shard on the coordinating node; distributed "
+                    "contexts are not supported yet")
+    return names
+
+
+# ----------------------------------------------------------------------
+# scroll
+# ----------------------------------------------------------------------
+
+def start_scroll(node, index_expr: Optional[str], body: Dict[str, Any],
+                 params: Dict[str, str], task=None) -> Dict[str, Any]:
+    keep_alive = parse_keep_alive(params["scroll"], "scroll")
+    names = _resolve_and_check(node, index_expr)
+    size = int(params.get("size", (body or {}).get("size", 10)))
+    ctx = node.search_contexts.create(
+        node.indices, index_expr, keep_alive, names=names,
+        scroll_state={"body": dict(body or {}), "params": dict(params),
+                      "offset": 0, "size": size, "cursor": None})
+    return _scroll_execute(node, ctx, task=task)
+
+
+def next_page(node, scroll_id: str,
+              keep_alive: Optional[str] = None) -> Dict[str, Any]:
+    ctx = node.search_contexts.get(scroll_id)
+    if ctx.scroll_state is None:
+        raise IllegalArgumentException(
+            f"context [{scroll_id}] is a point-in-time, not a scroll")
+    ctx.touch(parse_keep_alive(keep_alive, "scroll")
+              if keep_alive else None)
+    return _scroll_execute(node, ctx)
+
+
+def _scroll_execute(node, ctx, task=None) -> Dict[str, Any]:
+    state = ctx.scroll_state
+    body = dict(state["body"])
+    size = state["size"]
+    body["size"] = size
+    sorted_scroll = bool(body.get("sort"))
+    if sorted_scroll:
+        # sorted scrolls page via an internal search_after cursor over
+        # the pinned snapshot: each page is O(size) per shard, not
+        # O(offset+size) — sort by _doc for the cheapest deep scroll,
+        # exactly the reference's guidance
+        body["from"] = 0
+        if state.get("cursor") is not None:
+            body["search_after"] = state["cursor"]
+    else:
+        # score-ordered scroll (no sort): from/size re-pagination over
+        # the snapshot — correct, but deep scrolls re-collect the
+        # consumed prefix; sort by _doc to avoid that
+        body["from"] = state["offset"]
+    params = {k: v for k, v in state["params"].items()
+              if k not in ("scroll", "size", "from")}
+    out = coordinator.search(node.indices, None, body, params,
+                             task=task, pinned=ctx.readers,
+                             names_override=ctx.names)
+    hits = out["hits"]["hits"]
+    if out.get("timed_out"):
+        # a partial page must not consume the cursor: the client retries
+        # the same window instead of silently skipping unvisited shards
+        pass
+    elif sorted_scroll:
+        if hits:
+            state["cursor"] = hits[-1].get("sort")
+    else:
+        state["offset"] = state["offset"] + len(hits)
+    out["_scroll_id"] = ctx.id
+    return out
+
+
+def clear(node, ids: Optional[List[str]]) -> Dict[str, Any]:
+    if not ids or ids == ["_all"]:
+        freed = node.search_contexts.free_all(scroll_only=True)
+    else:
+        freed = sum(1 for i in ids
+                    if node.search_contexts.free(i, kind="scroll"))
+    return {"succeeded": True, "num_freed": freed}
+
+
+# ----------------------------------------------------------------------
+# point-in-time
+# ----------------------------------------------------------------------
+
+def open_pit(node, index_expr: Optional[str],
+             keep_alive: str) -> Dict[str, Any]:
+    seconds = parse_keep_alive(keep_alive, "open_point_in_time")
+    names = _resolve_and_check(node, index_expr)
+    ctx = node.search_contexts.create(node.indices, index_expr, seconds,
+                                      names=names)
+    return {"id": ctx.id}
+
+
+def search_pit(node, body: Dict[str, Any], params: Dict[str, str],
+               task=None) -> Dict[str, Any]:
+    pit = body.get("pit") or {}
+    pit_id = pit.get("id")
+    if not pit_id:
+        raise IllegalArgumentException("[pit] requires [id]")
+    ctx = node.search_contexts.get(pit_id)
+    if ctx.scroll_state is not None:
+        raise IllegalArgumentException(
+            f"context [{pit_id}] is a scroll, not a point-in-time")
+    if pit.get("keep_alive"):
+        ctx.touch(parse_keep_alive(pit["keep_alive"], "pit"))
+    else:
+        ctx.touch()
+    body = {k: v for k, v in body.items() if k != "pit"}
+    out = coordinator.search(node.indices, None, body, params,
+                             task=task, pinned=ctx.readers,
+                             names_override=ctx.names)
+    out["pit_id"] = ctx.id
+    return out
+
+
+def close_pit(node, pit_id: str) -> Dict[str, Any]:
+    freed = node.search_contexts.free(pit_id, kind="pit")
+    return {"succeeded": freed, "num_freed": 1 if freed else 0}

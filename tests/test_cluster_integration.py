@@ -248,6 +248,23 @@ def test_read_of_missing_index_does_not_autocreate(cluster):
     assert "nope" not in cluster[1].cluster.applied_state().indices
 
 
+def test_scroll_rejected_when_shards_remote(cluster):
+    """Scroll/PIT contexts are node-local; a cluster-mode request whose
+    target shards live elsewhere must 400, never silently serve a local
+    subset — including via wildcards resolved against the cluster view."""
+    status, body = _handle(cluster[0], "POST", "/dist/_search",
+                           params={"scroll": "1m"},
+                           body={"query": {"match_all": {}}})
+    assert status == 400, body
+    status, body = _handle(cluster[0], "POST", "/_search",
+                           params={"scroll": "1m"},
+                           body={"query": {"match_all": {}}})
+    assert status == 400, body  # _all resolves against the cluster state
+    status, body = _handle(cluster[1], "POST", "/dist/_pit",
+                           params={"keep_alive": "1m"})
+    assert status == 400, body
+
+
 def test_tasks_list_and_cancel_across_nodes(cluster):
     """A task on node A is listable and cancellable via node B's REST —
     the transport handlers must exist on every node from cluster start."""

@@ -1,0 +1,211 @@
+"""Scroll + point-in-time round-trips (reference shapes:
+RestSearchScrollAction / RestOpenPointInTimeAction, ReaderContext
+snapshot semantics — SURVEY.md §2.1#36)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def corpus(node):
+    for i in range(25):
+        _handle(node, "PUT", f"/c/_doc/d{i}",
+                params={"refresh": "true"},
+                body={"msg": "common text", "n": i})
+    return node
+
+
+class TestScroll:
+    def test_scroll_pages_cover_everything_once(self, corpus):
+        status, page = _handle(corpus, "POST", "/c/_search",
+                               params={"scroll": "1m"},
+                               body={"query": {"match": {"msg": "common"}},
+                                     "size": 10})
+        assert status == 200, page
+        sid = page["_scroll_id"]
+        assert page["hits"]["total"]["value"] == 25
+        seen = [h["_id"] for h in page["hits"]["hits"]]
+        assert len(seen) == 10
+        while True:
+            status, page = _handle(corpus, "POST", "/_search/scroll",
+                                   body={"scroll": "1m",
+                                         "scroll_id": sid})
+            assert status == 200, page
+            hits = page["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(h["_id"] for h in hits)
+        assert sorted(seen) == sorted(f"d{i}" for i in range(25))
+        assert len(seen) == len(set(seen))
+
+    def test_scroll_snapshot_survives_deletes(self, corpus):
+        status, page = _handle(corpus, "POST", "/c/_search",
+                               params={"scroll": "1m"},
+                               body={"query": {"match_all": {}},
+                                     "size": 5,
+                                     "sort": [{"n": "asc"}]})
+        sid = page["_scroll_id"]
+        first_ids = [h["_id"] for h in page["hits"]["hits"]]
+        assert first_ids == [f"d{i}" for i in range(5)]
+        # delete a doc that would appear on page 2, then refresh
+        _handle(corpus, "DELETE", "/c/_doc/d7", params={"refresh": "true"})
+        status, check = _handle(corpus, "POST", "/c/_search",
+                                body={"query": {"match_all": {}}})
+        assert check["hits"]["total"]["value"] == 24  # live view shrank
+        status, page2 = _handle(corpus, "POST", "/_search/scroll",
+                                body={"scroll": "1m", "scroll_id": sid})
+        ids2 = [h["_id"] for h in page2["hits"]["hits"]]
+        assert "d7" in ids2  # the pinned snapshot still holds it
+        assert page2["hits"]["total"]["value"] == 25
+
+    def test_scroll_with_sort_orders_pages(self, corpus):
+        status, page = _handle(corpus, "POST", "/c/_search",
+                               params={"scroll": "1m"},
+                               body={"query": {"match_all": {}},
+                                     "sort": [{"n": "desc"}], "size": 9})
+        sid = page["_scroll_id"]
+        values = [h["sort"][0] for h in page["hits"]["hits"]]
+        while True:
+            _s, page = _handle(corpus, "POST", "/_search/scroll",
+                               body={"scroll_id": sid})
+            if not page["hits"]["hits"]:
+                break
+            values.extend(h["sort"][0] for h in page["hits"]["hits"])
+        assert values == sorted(values, reverse=True)
+        assert len(values) == 25
+
+    def test_clear_scroll_frees_context(self, corpus):
+        _s, page = _handle(corpus, "POST", "/c/_search",
+                           params={"scroll": "1m"},
+                           body={"query": {"match_all": {}}, "size": 5})
+        sid = page["_scroll_id"]
+        status, res = _handle(corpus, "DELETE", "/_search/scroll",
+                              body={"scroll_id": sid})
+        assert status == 200 and res["num_freed"] == 1
+        status, res = _handle(corpus, "POST", "/_search/scroll",
+                              body={"scroll_id": sid})
+        assert status == 404
+
+    def test_keepalive_expiry(self, corpus):
+        _s, page = _handle(corpus, "POST", "/c/_search",
+                           params={"scroll": "50ms"},
+                           body={"query": {"match_all": {}}, "size": 5})
+        sid = page["_scroll_id"]
+        time.sleep(0.2)
+        status, res = _handle(corpus, "POST", "/_search/scroll",
+                              body={"scroll_id": sid})
+        assert status == 404
+
+    def test_bad_keepalive_rejected(self, corpus):
+        status, _ = _handle(corpus, "POST", "/c/_search",
+                            params={"scroll": "48h"},
+                            body={"query": {"match_all": {}}})
+        assert status == 400
+
+
+class TestPit:
+    def test_pit_roundtrip_with_search_after(self, corpus):
+        status, res = _handle(corpus, "POST", "/c/_pit",
+                              params={"keep_alive": "1m"})
+        assert status == 200, res
+        pid = res["id"]
+        seen = []
+        after = None
+        while True:
+            body = {"query": {"match_all": {}}, "size": 10,
+                    "sort": [{"n": "asc"}], "pit": {"id": pid}}
+            if after is not None:
+                body["search_after"] = after
+            status, page = _handle(corpus, "POST", "/_search", body=body)
+            assert status == 200, page
+            assert page["pit_id"] == pid
+            hits = page["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(h["_id"] for h in hits)
+            after = hits[-1]["sort"]
+        assert sorted(seen) == sorted(f"d{i}" for i in range(25))
+        status, res = _handle(corpus, "DELETE", "/_pit", body={"id": pid})
+        assert status == 200 and res["num_freed"] == 1
+
+    def test_pit_is_a_stable_snapshot(self, corpus):
+        _s, res = _handle(corpus, "POST", "/c/_pit",
+                          params={"keep_alive": "1m"})
+        pid = res["id"]
+        _handle(corpus, "PUT", "/c/_doc/new", params={"refresh": "true"},
+                body={"msg": "common text", "n": 999})
+        _handle(corpus, "DELETE", "/c/_doc/d0", params={"refresh": "true"})
+        status, page = _handle(corpus, "POST", "/_search", body={
+            "query": {"match_all": {}}, "size": 50, "pit": {"id": pid}})
+        ids = {h["_id"] for h in page["hits"]["hits"]}
+        assert "new" not in ids and "d0" in ids
+        assert page["hits"]["total"]["value"] == 25
+
+    def test_closed_pit_404(self, corpus):
+        _s, res = _handle(corpus, "POST", "/c/_pit",
+                          params={"keep_alive": "1m"})
+        pid = res["id"]
+        _handle(corpus, "DELETE", "/_pit", body={"id": pid})
+        status, _ = _handle(corpus, "POST", "/_search", body={
+            "query": {"match_all": {}}, "pit": {"id": pid}})
+        assert status == 404
+
+    def test_pit_requires_keep_alive(self, corpus):
+        status, _ = _handle(corpus, "POST", "/c/_pit")
+        assert status == 400
+
+    def test_non_dict_pit_body_rejected(self, corpus):
+        status, _ = _handle(corpus, "POST", "/_search", body={
+            "query": {"match_all": {}}, "pit": "bare-string-id"})
+        assert status == 400
+
+    def test_clear_scroll_ignores_pit_ids_and_vice_versa(self, corpus):
+        _s, res = _handle(corpus, "POST", "/c/_pit",
+                          params={"keep_alive": "1m"})
+        pid = res["id"]
+        _s, page = _handle(corpus, "POST", "/c/_search",
+                           params={"scroll": "1m"},
+                           body={"query": {"match_all": {}}})
+        sid = page["_scroll_id"]
+        # clearing a PIT id via the scroll API must not free the PIT
+        _s, res = _handle(corpus, "DELETE", "/_search/scroll",
+                          body={"scroll_id": pid})
+        assert res["num_freed"] == 0
+        status, _ = _handle(corpus, "POST", "/_search", body={
+            "query": {"match_all": {}}, "pit": {"id": pid}})
+        assert status == 200  # still alive
+        # closing a scroll id via the PIT API must not free the scroll
+        _s, res = _handle(corpus, "DELETE", "/_pit", body={"id": sid})
+        assert res["num_freed"] == 0
+        status, _ = _handle(corpus, "POST", "/_search/scroll",
+                            body={"scroll_id": sid})
+        assert status == 200
+
+    def test_scroll_id_rejected_as_pit(self, corpus):
+        _s, page = _handle(corpus, "POST", "/c/_search",
+                           params={"scroll": "1m"},
+                           body={"query": {"match_all": {}}})
+        status, _ = _handle(corpus, "POST", "/_search", body={
+            "query": {"match_all": {}},
+            "pit": {"id": page["_scroll_id"]}})
+        assert status == 400
