@@ -245,6 +245,11 @@ def _search_fast(indices: IndicesService, names: List[str],
     k = from_ + size
     if k <= 0:
         return None
+    if min_score is not None:
+        # the kernel path counts totals before min_score filtering; the
+        # planner applies it to the match set — decline so hits.total is
+        # consistent across paths (ADVICE r2 low #3)
+        return None
     per_index = []
     n_shards_total = 0
     for name in names:
@@ -360,7 +365,7 @@ def search_shard_group(indices: IndicesService,
         svc = indices.index(name)
         used_fast = False
         if (tpu_search is not None and aggs is None and not sort_specs
-                and search_after is None and k > 0
+                and search_after is None and k > 0 and min_score is None
                 and set(shard_nums) == set(svc.shards.keys())):
             res = tpu_search.try_search(svc, query, k=k,
                                         timeout_s=ctx.remaining_s())

@@ -118,11 +118,16 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
         mask, score = executor.execute(query)
         live = jnp.asarray(view.live_mask)
         final = bm25.mask_scores(score[None, :], mask[None, :], live)[0]
-        total += int(jnp.sum(mask & live))
+        match = mask & live
+        if min_score is not None:
+            # min_score filters the MATCH SET — totals and aggs must
+            # agree with the sorted path (the reference applies it
+            # before counting; ADVICE r2: all paths report one total)
+            match = match & (final >= min_score)
+        total += int(jnp.sum(match))
         if aggs:
             agg_ctx = SegmentAggContext(reader, idx)
-            agg_parts.append(aggs.collect(
-                agg_ctx, np.asarray(mask & live)))
+            agg_parts.append(aggs.collect(agg_ctx, np.asarray(match)))
         if k > 0:
             vals, idxs = bm25.topk(final[None, :], k=min(k, view.pack.d_pad))
             per_segment.append((idx, np.asarray(vals[0]), np.asarray(idxs[0])))
@@ -188,22 +193,26 @@ def _execute_sorted_query(reader: ShardReader, query: dsl.QueryNode, *,
             pad = np.zeros(view.pack.d_pad, dtype=bool)
             pad[: len(final_mask)] = final_mask
             agg_parts.append(aggs.collect(agg_ctx, pad))
-        value_arrays = sort_mod.segment_sort_values(reader, idx, sort_specs,
-                                                    scores_np)
+        columns = sort_mod.segment_sort_values(reader, idx, sort_specs,
+                                               scores_np)
+        # one O(n) rank/adjust pass per column, shared by the cursor
+        # mask and the lexsort keys
+        ranks = [sort_mod.column_ranks(spec, col)
+                 for spec, col in zip(sort_specs, columns)]
         if search_after is not None:
             final_mask = final_mask & sort_mod.after_mask(
-                sort_specs, value_arrays, search_after)
+                sort_specs, columns, search_after, ranks=ranks)
         ords = np.nonzero(final_mask)[0]
         if len(ords) == 0:
             continue
         # per-segment vectorized top-k (lexsort; strings via ordinals)
-        keys = _lexsort_keys(view.segment, sort_specs, value_arrays, ords,
-                             scores_np)
+        keys = _lexsort_keys(ranks, ords)
         # np.lexsort: LAST key is primary → (tiebreak ord, ..., spec0)
         order = np.lexsort((ords,) + tuple(reversed(keys)))
         top_ords = ords[order[: k]] if k > 0 else ords[:0]
+        # resolve values (keyword ordinals → terms) only for the winners
         for o in top_ords:
-            vals = [va[o] for va in value_arrays]
+            vals = [col.resolve(int(o)) for col in columns]
             merged.append((sort_mod.sort_key(sort_specs, vals), idx, int(o),
                            float(scores_np[o]), vals))
     merged.sort(key=lambda t: (t[0], t[1], t[2]))
@@ -227,38 +236,14 @@ def _execute_sorted_query(reader: ShardReader, query: dsl.QueryNode, *,
                              timed_out=timed_out)
 
 
-def _lexsort_keys(segment, sort_specs, value_arrays, ords, scores_np):
+def _lexsort_keys(ranks, ords):
     """Per-spec (missing_rank, adjusted_value) numeric key arrays over
-    `ords`, direction-adjusted for np.lexsort (ascending)."""
-    from elasticsearch_tpu.common.errors import IllegalArgumentException
+    `ords`, direction-adjusted for np.lexsort (ascending) — sliced from
+    the precomputed column_ranks arrays."""
     keys = []
-    for spec, vals in zip(sort_specs, value_arrays):
-        col = segment.doc_values.get(spec.field)
-        if (col is not None and col.kind == "ord"
-                and spec.field not in ("_score", "_doc")):
-            ord_vals = col.values[ords].astype(np.int64)
-            missing = ord_vals < 0
-            if spec.missing not in ("_last", "_first"):
-                raise IllegalArgumentException(
-                    "[sort] literal [missing] values are not supported "
-                    "on keyword fields")
-            adj = ord_vals if spec.order == "asc" else -ord_vals
-        else:
-            sub = vals[ords].astype(np.float64)
-            missing = np.isnan(sub)
-            if spec.missing == "_first":
-                pass
-            elif spec.missing == "_last":
-                pass
-            else:
-                sub = np.where(missing, float(spec.missing), sub)
-                missing = np.zeros_like(missing)
-            adj = sub if spec.order == "asc" else -sub
-            adj = np.where(missing, 0.0, adj)
-        miss_rank = np.where(missing,
-                             0 if spec.missing == "_first" else 2, 1)
-        keys.append(miss_rank)
-        keys.append(adj)
+    for rank, adj in ranks:
+        keys.append(rank[ords])
+        keys.append(adj[ords])
     return keys
 
 

@@ -78,11 +78,26 @@ def _scroll_execute(node, ctx, task=None) -> Dict[str, Any]:
     size = state["size"]
     body["size"] = size
     sorted_scroll = bool(body.get("sort"))
+    appended_tiebreak = False
     if sorted_scroll:
         # sorted scrolls page via an internal search_after cursor over
         # the pinned snapshot: each page is O(size) per shard, not
         # O(offset+size) — sort by _doc for the cheapest deep scroll,
-        # exactly the reference's guidance
+        # exactly the reference's guidance.
+        # The cursor needs a per-doc tiebreaker or boundary TIES would
+        # be skipped (strictly-after semantics): append an internal
+        # _doc spec (shard-unique global ordinal) unless one is present,
+        # and strip its value from the response hits.
+        sort_spec = body["sort"]
+        if not isinstance(sort_spec, list):
+            sort_spec = [sort_spec]
+        def _field_of(entry):
+            return entry if isinstance(entry, str) \
+                else next(iter(entry), None)
+        if all(_field_of(e) != "_doc" for e in sort_spec):
+            sort_spec = list(sort_spec) + ["_doc"]
+            appended_tiebreak = True
+        body["sort"] = sort_spec
         body["from"] = 0
         if state.get("cursor") is not None:
             body["search_after"] = state["cursor"]
@@ -106,6 +121,12 @@ def _scroll_execute(node, ctx, task=None) -> Dict[str, Any]:
             state["cursor"] = hits[-1].get("sort")
     else:
         state["offset"] = state["offset"] + len(hits)
+    if appended_tiebreak:
+        # the internal tiebreaker is not part of the user's sort — keep
+        # the response shape reference-faithful
+        for h in hits:
+            if isinstance(h.get("sort"), list) and h["sort"]:
+                h["sort"] = h["sort"][:-1]
     out["_scroll_id"] = ctx.id
     return out
 

@@ -161,6 +161,10 @@ class IndexPackCache:
         self._lock = threading.Lock()
         self._cache: Dict[Tuple[str, str], ResidentPack] = {}
         self._breaker = breaker
+        # per-key build serialization: a refresh-triggered rebuild of one
+        # (index, field) pack must not block fast-path lookups of every
+        # other key on the node (ADVICE r2 low #4)
+        self._build_locks: Dict[Tuple[str, str], threading.Lock] = {}
 
     @property
     def mesh(self):
@@ -178,12 +182,22 @@ class IndexPackCache:
             entry = self._cache.get(key)
             if entry is not None and entry.reader_key == reader_key:
                 return entry
+            build_lock = self._build_locks.setdefault(key,
+                                                      threading.Lock())
+        # build OUTSIDE the cache lock: only same-key callers serialize
+        # (they'd rebuild the same pack); other keys look up freely
+        with build_lock:
+            with self._lock:
+                entry = self._cache.get(key)
+                if entry is not None and entry.reader_key == reader_key:
+                    return entry
             entry = self._build(readers, field, reader_key)
-            if entry is not None:
-                old = self._cache.get(key)
-                if old is not None and self._breaker is not None:
-                    self._breaker.release(old.hbm_bytes)
-                self._cache[key] = entry
+            with self._lock:
+                if entry is not None:
+                    old = self._cache.get(key)
+                    if old is not None and self._breaker is not None:
+                        self._breaker.release(old.hbm_bytes)
+                    self._cache[key] = entry
             return entry
 
     def _build(self, readers, field: str,

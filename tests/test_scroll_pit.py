@@ -94,6 +94,51 @@ class TestScroll:
         assert values == sorted(values, reverse=True)
         assert len(values) == 25
 
+    def test_sorted_scroll_with_tied_keys_covers_all_docs(self, node):
+        """Boundary ties must not be skipped: the internal _doc
+        tiebreaker makes the cursor strictly-after-able even when every
+        doc shares the same sort value."""
+        for i in range(25):
+            _handle(node, "PUT", f"/ties/_doc/t{i}",
+                    params={"refresh": "true"},
+                    body={"g": 7, "msg": "x"})
+        status, page = _handle(node, "POST", "/ties/_search",
+                               params={"scroll": "1m"},
+                               body={"query": {"match_all": {}},
+                                     "sort": [{"g": "asc"}], "size": 10})
+        assert status == 200, page
+        sid = page["_scroll_id"]
+        # the response sort array stays the user's shape (1 value)
+        assert all(len(h["sort"]) == 1 for h in page["hits"]["hits"])
+        seen = [h["_id"] for h in page["hits"]["hits"]]
+        while True:
+            _s, page = _handle(node, "POST", "/_search/scroll",
+                               body={"scroll_id": sid})
+            if not page["hits"]["hits"]:
+                break
+            seen.extend(h["_id"] for h in page["hits"]["hits"])
+        assert sorted(seen) == sorted(f"t{i}" for i in range(25))
+        assert len(seen) == len(set(seen))
+
+    def test_search_after_string_cursor_on_fieldless_segment(self, node):
+        """A segment without the keyword sort field yields an all-missing
+        numeric column; a string cursor must compare by missing-rank,
+        not crash with a float() 500."""
+        _handle(node, "PUT", "/mix", body={"mappings": {"properties": {
+            "k": {"type": "keyword"}}}})
+        _handle(node, "PUT", "/mix/_doc/a", params={"refresh": "true"},
+                body={"k": "t0"})
+        _handle(node, "POST", "/mix/_flush")
+        _handle(node, "PUT", "/mix/_doc/b", params={"refresh": "true"},
+                body={"other": 1})   # second segment: no k at all
+        status, res = _handle(node, "POST", "/mix/_search", body={
+            "query": {"match_all": {}},
+            "sort": [{"k": {"order": "asc", "missing": "_last"}}],
+            "search_after": ["t0"]})
+        assert status == 200, res
+        # only the missing-k doc sorts after the "t0" cursor
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["b"]
+
     def test_clear_scroll_frees_context(self, corpus):
         _s, page = _handle(corpus, "POST", "/c/_search",
                            params={"scroll": "1m"},

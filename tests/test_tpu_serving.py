@@ -157,12 +157,31 @@ class TestEquivalence:
                             "from": 5, "size": 7})
         assert_equivalent(fast, slow)
 
-    def test_min_score(self, svc, seeded_np):
+    def test_min_score_falls_back_with_consistent_totals(self, svc,
+                                                         seeded_np):
+        """min_score queries decline the kernel path (its totals count
+        pre-filter) and the planner applies min_score to the MATCH SET,
+        so totals agree with the sorted path (ADVICE r2 low #3)."""
         make_corpus(svc, seeded_np)
-        fast, slow = both_paths(
-            svc, "corpus", {"query": {"match": {"body": "alpha beta"}},
-                            "min_score": 1.0, "size": 50})
-        assert_equivalent(fast, slow)
+        body = {"query": {"match": {"body": "alpha beta"}},
+                "min_score": 1.0, "size": 50}
+        tpu = TpuSearchService(window_s=0.0)
+        try:
+            res = coordinator.search(svc, "corpus", dict(body),
+                                     tpu_search=tpu)
+            assert tpu.served == 0  # declined before any kernel submit
+        finally:
+            tpu.close()
+        # every reported hit honors the floor...
+        assert all(h["_score"] >= 1.0 for h in res["hits"]["hits"])
+        # ...and the total equals the filtered hit count (size=50 covers
+        # the full match set here) and matches the sorted path's total
+        assert res["hits"]["total"]["value"] == len(res["hits"]["hits"])
+        sorted_res = coordinator.search(
+            svc, "corpus", dict(body, sort=[{"_score": "desc"}]),
+            tpu_search=None)
+        assert sorted_res["hits"]["total"]["value"] == \
+            res["hits"]["total"]["value"]
 
     def test_boost(self, svc, seeded_np):
         make_corpus(svc, seeded_np)
