@@ -74,7 +74,13 @@ def main() -> None:
     t0 = time.perf_counter()
     node = Node(tempfile.mkdtemp(prefix="es_tpu_bench_"),
                 settings=Settings.of({
-                    "index": {"translog": {"durability": "async"}}}))
+                    "index": {"translog": {"durability": "async"}},
+                    # the serving default caps kernel batch waits at 30s
+                    # (degrade to planner rather than stall); the bench
+                    # NEEDS to sit out the first XLA compile so the
+                    # measured window runs on the kernel path
+                    "search": {"tpu_serving": {
+                        "batch_timeout_seconds": 300}}}))
     idx = node.create_index(
         "bench", Settings.of({"index": {
             "number_of_shards": n_shards,

@@ -1,0 +1,116 @@
+"""Logging infrastructure: node-wide configuration + the slow-log
+channels.
+
+Reference: `common/logging/**` (LogConfigurator) + `index/Search
+SlowLog` / `IndexingSlowLog` (SURVEY.md §2.1#48, §5.1). Kept contracts:
+one process-wide configuration from node settings (`logger.<name>:
+LEVEL` overrides), dedicated `index.search.slowlog` /
+`index.indexing.slowlog` channels, and threshold-tiered slow-log
+records (warn/info/debug/trace picked by elapsed time).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any, Dict, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.units import TimeValue
+
+ROOT = "elasticsearch_tpu"
+SEARCH_SLOWLOG = "elasticsearch_tpu.index.search.slowlog"
+INDEXING_SLOWLOG = "elasticsearch_tpu.index.indexing.slowlog"
+
+_FORMAT = "[%(asctime)s][%(levelname)-5s][%(name)s] %(message)s"
+
+
+def configure(settings=None) -> None:
+    """Install the node's logging config (reference: LogConfigurator).
+    `logger.<name>` settings override per-logger levels, e.g.
+    -E logger.elasticsearch_tpu.cluster=DEBUG."""
+    root = logging.getLogger(ROOT)
+    if not any(isinstance(h, logging.StreamHandler)
+               for h in root.handlers):
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    if settings is not None:
+        for key, value in settings.get_as_dict().items():
+            if key.startswith("logger."):
+                logging.getLogger(key[len("logger."):]).setLevel(
+                    _level(value))
+
+
+def _level(value: Any) -> int:
+    """ES-style level names → python levels (TRACE has no python
+    equivalent; it maps to DEBUG like log4j-to-python bridges do)."""
+    name = str(value).upper()
+    mapping = {"TRACE": logging.DEBUG, "DEBUG": logging.DEBUG,
+               "INFO": logging.INFO, "WARN": logging.WARNING,
+               "WARNING": logging.WARNING, "ERROR": logging.ERROR,
+               "FATAL": logging.CRITICAL, "CRITICAL": logging.CRITICAL}
+    level = mapping.get(name)
+    if level is None:
+        raise IllegalArgumentException(
+            f"unknown log level [{value}] (use trace|debug|info|warn|"
+            f"error|fatal)")
+    return level
+
+
+class SlowLog:
+    """Threshold-tiered slow logging for one index (reference:
+    SearchSlowLog — thresholds are per-index settings; -1 disables)."""
+
+    LEVELS = ("warn", "info", "debug", "trace")
+    _LOG_FN = {"warn": "warning", "info": "info", "debug": "debug",
+               "trace": "debug"}
+
+    def __init__(self, index_name: str, settings,
+                 phase: str = "query",
+                 prefix: str = "index.search.slowlog.threshold",
+                 channel: str = SEARCH_SLOWLOG):
+        self.index_name = index_name
+        self.logger = logging.getLogger(channel)
+        self.phase = phase
+        self.thresholds: Dict[str, float] = {}
+        for level in self.LEVELS:
+            raw = settings.get(f"{prefix}.{self.phase}.{level}")
+            if raw is None:
+                continue
+            seconds = TimeValue.parse(raw).seconds
+            if seconds >= 0:
+                self.thresholds[level] = seconds
+        # a configured debug/trace tier must actually emit: the channel
+        # inherits the package INFO level unless opened up here (an
+        # explicit logger.* setting still overrides afterwards)
+        if any(lvl in self.thresholds for lvl in ("debug", "trace")) \
+                and self.logger.level == logging.NOTSET:
+            self.logger.setLevel(logging.DEBUG)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.thresholds)
+
+    def maybe_log(self, took_s: float, shard: int,
+                  source: Optional[Dict[str, Any]] = None,
+                  total_hits: Optional[int] = None) -> Optional[str]:
+        """Log at the most severe tier whose threshold `took_s` crosses;
+        returns the level used (for tests) or None."""
+        hit_level = None
+        for level in self.LEVELS:  # warn first = most severe
+            t = self.thresholds.get(level)
+            if t is not None and took_s >= t:
+                hit_level = level
+                break
+        if hit_level is None:
+            return None
+        import json
+        msg = (f"[{self.index_name}][{shard}] took[{took_s * 1000:.1f}ms]"
+               f", took_millis[{int(took_s * 1000)}]"
+               f", total_hits[{total_hits if total_hits is not None else '-'}]"
+               f", search_type[QUERY_THEN_FETCH]"
+               f", source[{json.dumps(source or {}, sort_keys=True)[:1000]}]")
+        getattr(self.logger, self._LOG_FN[hit_level])(msg)
+        return hit_level

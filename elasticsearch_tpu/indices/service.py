@@ -87,6 +87,8 @@ class IndexService:
         self._k1 = settings.get_float("index.similarity.default.k1", 1.2)
         self._b = settings.get_float("index.similarity.default.b", 0.75)
         self._durability = settings.get("index.translog.durability", "request")
+        from elasticsearch_tpu.common.logging import SlowLog
+        self.search_slowlog = SlowLog(name, settings)
 
     def create_shard(self, shard_num: int, *, primary: bool = True,
                      allocation_id: Optional[str] = None) -> IndexShard:

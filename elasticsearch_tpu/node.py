@@ -30,6 +30,10 @@ class Node:
                  cluster_name: str = "elasticsearch-tpu",
                  settings: Optional[Settings] = None):
         self.settings = settings or Settings.EMPTY
+        # logging is part of node construction, not the CLI: embedded
+        # users (bench, tests, Python API) get the same handlers/levels
+        from elasticsearch_tpu.common.logging import configure
+        configure(self.settings)
         self.node_name = node_name
         self.node_id = _load_or_create_node_id(data_path, node_name)
         self.cluster_name = cluster_name
