@@ -66,6 +66,13 @@ ACTION_MAINTENANCE = "indices/data/maintenance"
 ACTION_CREATE_INDEX = "cluster/admin/create_index"
 ACTION_DELETE_INDEX = "cluster/admin/delete_index"
 ACTION_PUT_MAPPING = "cluster/admin/put_mapping"
+ACTION_UPDATE_INDEX_SETTINGS = "cluster/admin/update_index_settings"
+ACTION_UPDATE_CLUSTER_SETTINGS = "cluster/admin/update_cluster_settings"
+
+# cluster-wide settings this build can apply at runtime (reference:
+# ClusterSettings registry of Dynamic-flagged settings)
+DYNAMIC_CLUSTER_SETTINGS = ("action.auto_create_index",)
+DYNAMIC_CLUSTER_PREFIXES = ("logger.",)
 ACTION_SHARD_STARTED = "cluster/shard/started"
 ACTION_SHARD_FAILED = "cluster/shard/failed"
 
@@ -237,6 +244,10 @@ class ClusterService:
                 (ACTION_CREATE_INDEX, self._handle_create_index),
                 (ACTION_DELETE_INDEX, self._handle_delete_index),
                 (ACTION_PUT_MAPPING, self._handle_put_mapping),
+                (ACTION_UPDATE_INDEX_SETTINGS,
+                 self._handle_update_index_settings),
+                (ACTION_UPDATE_CLUSTER_SETTINGS,
+                 self._handle_update_cluster_settings),
                 (ACTION_SHARD_STARTED, self._handle_shard_started),
                 (ACTION_SHARD_FAILED, self._handle_shard_failed),
                 (ACTION_REPLICA_OP, self._handle_replica_op),
@@ -298,6 +309,7 @@ class ClusterService:
                 state, self._pending_state = self._pending_state, None
             try:
                 self._reconcile(state)
+                self._apply_cluster_settings(state)
                 self._prune_recovery_sources(state)
                 self._report_local_stores(state)
             except Exception:  # noqa: BLE001 — applier bug must not die
@@ -365,6 +377,21 @@ class ClusterService:
                     svc.mapper.merge(meta.mapping)
                 except EsException:
                     pass
+            # sync dynamic index settings from the cluster metadata —
+            # including REMOVALS (a key cleared on the master must clear
+            # here too), and only when something actually changed (this
+            # runs on every state publish)
+            def _is_dyn(k):
+                return k in svc.DYNAMIC_KEYS or any(
+                    k.startswith(p) for p in svc.DYNAMIC_PREFIXES)
+            dyn = {k: None for k in svc.settings.get_as_dict()
+                   if _is_dyn(k) and k not in meta.settings}
+            dyn.update({k: v for k, v in meta.settings.items()
+                        if _is_dyn(k)})
+            dyn["index.number_of_replicas"] = meta.number_of_replicas
+            current = {k: svc.settings.get(k) for k in dyn}
+            if any(current[k] != v for k, v in dyn.items()):
+                svc.apply_dynamic_settings(dyn)
             wanted = {c.shard: c for c in local_copies}
             # remove shards no longer assigned here
             for shard_num in [s for s in list(svc.shards) if s not in wanted]:
@@ -503,6 +530,18 @@ class ClusterService:
             update, source=f"store-found[{index}][{shard_num}]")
         return {"acknowledged": True}
 
+    def _apply_cluster_settings(self, state: ClusterState) -> None:
+        """Every node recomputes base config + published persistent +
+        transient (reference precedence) — removals revert to the base
+        node config, never to a stale live value."""
+        pair = (dict(state.persistent_settings),
+                dict(state.transient_settings))
+        if pair == getattr(self, "_last_applied_settings", None):
+            return  # hot applier path: skip the no-op recompute
+        self._last_applied_settings = pair
+        self.node.recompute_settings(state.persistent_settings,
+                                     state.transient_settings)
+
     def _maybe_reroute(self, state: ClusterState) -> None:
         """Master-side convergence loop: if a reroute would change the
         routing table (unassigned copies placeable, dead-node copies to
@@ -633,6 +672,82 @@ class ClusterService:
         self._run_master_update(update, source=f"put-mapping[{name}]")
         return {"acknowledged": True}
 
+    def _handle_update_index_settings(self, payload, from_node
+                                      ) -> Dict[str, Any]:
+        name = payload["index"]
+        changes = Settings._flatten(payload.get("settings") or {})
+        from elasticsearch_tpu.indices.service import IndexService
+        IndexService.validate_dynamic_settings(changes)
+
+        def update(state: ClusterState) -> ClusterState:
+            meta = state.indices.get(name)
+            if meta is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            import dataclasses as _dc
+            new_settings = dict(meta.settings)
+            for k, v in changes.items():
+                if v is None:
+                    new_settings.pop(k, None)
+                else:
+                    new_settings[k] = v
+            replicas = int(new_settings.get("index.number_of_replicas",
+                                            meta.number_of_replicas))
+            new_meta = _dc.replace(meta, settings=new_settings,
+                                   number_of_replicas=replicas)
+            new_indices = dict(state.indices)
+            new_indices[name] = new_meta
+            # replica-count changes re-place copies immediately
+            return self.allocation.reroute(
+                state.with_updates(indices=new_indices))
+
+        self._run_master_update(update,
+                                source=f"update-settings[{name}]")
+        return {"acknowledged": True}
+
+    def _handle_update_cluster_settings(self, payload, from_node
+                                        ) -> Dict[str, Any]:
+        persistent = Settings._flatten(payload.get("persistent") or {})
+        transient = Settings._flatten(payload.get("transient") or {})
+        for key in list(persistent) + list(transient):
+            if key in DYNAMIC_CLUSTER_SETTINGS or any(
+                    key.startswith(p) for p in DYNAMIC_CLUSTER_PREFIXES):
+                continue
+            raise IllegalArgumentException(
+                f"setting [{key}] is not dynamically updateable")
+
+        def update(state: ClusterState) -> ClusterState:
+            def merged(base, changes):
+                out = dict(base)
+                for k, v in changes.items():
+                    if v is None:
+                        out.pop(k, None)
+                    else:
+                        out[k] = v
+                return out
+            return state.with_updates(
+                persistent_settings=merged(state.persistent_settings,
+                                           persistent),
+                transient_settings=merged(state.transient_settings,
+                                          transient))
+
+        self._run_master_update(update, source="cluster-settings")
+        state = self.coordinator.state()
+        return {"acknowledged": True,
+                "persistent": state.persistent_settings,
+                "transient": state.transient_settings}
+
+    def update_index_settings(self, name: str,
+                              settings: Dict[str, Any]) -> Dict[str, Any]:
+        return self._call_master(ACTION_UPDATE_INDEX_SETTINGS,
+                                 {"index": name, "settings": settings})
+
+    def update_cluster_settings(self, persistent: Dict[str, Any],
+                                transient: Dict[str, Any]
+                                ) -> Dict[str, Any]:
+        return self._call_master(ACTION_UPDATE_CLUSTER_SETTINGS,
+                                 {"persistent": persistent,
+                                  "transient": transient})
+
     def _handle_shard_started(self, payload, from_node) -> Dict[str, Any]:
         index, shard = payload["index"], int(payload["shard"])
         aid = payload["allocation_id"]
@@ -676,7 +791,11 @@ class ClusterService:
         if addr == self.local_node.address:
             handler = {ACTION_CREATE_INDEX: self._handle_create_index,
                        ACTION_DELETE_INDEX: self._handle_delete_index,
-                       ACTION_PUT_MAPPING: self._handle_put_mapping}[action]
+                       ACTION_PUT_MAPPING: self._handle_put_mapping,
+                       ACTION_UPDATE_INDEX_SETTINGS:
+                           self._handle_update_index_settings,
+                       ACTION_UPDATE_CLUSTER_SETTINGS:
+                           self._handle_update_cluster_settings}[action]
             return handler(payload, self.local_node.to_json())
         try:
             return self.transport.send_request(addr, action, payload,

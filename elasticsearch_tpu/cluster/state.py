@@ -116,6 +116,13 @@ class ClusterState:
     # nodes` — and vote/ack counting matches on names, so names are the
     # canonical voting identity throughout)
     voting_config: Tuple[str, ...] = ()
+    # cluster-wide dynamic settings (reference: Metadata persistent +
+    # transient settings; transient die with a full-cluster restart
+    # because they are only ever in the published state)
+    persistent_settings: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    transient_settings: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
 
     # -------------- queries --------------
 
@@ -161,6 +168,8 @@ class ClusterState:
                       for s, copies in shards.items()}
                 for idx, shards in self.routing.items()},
             "voting_config": list(self.voting_config),
+            "persistent_settings": dict(self.persistent_settings),
+            "transient_settings": dict(self.transient_settings),
         }
 
     @staticmethod
@@ -179,6 +188,8 @@ class ClusterState:
                            for s, copies in shards.items()}
                      for idx, shards in (d.get("routing") or {}).items()},
             voting_config=tuple(d.get("voting_config") or ()),
+            persistent_settings=dict(d.get("persistent_settings") or {}),
+            transient_settings=dict(d.get("transient_settings") or {}),
         )
 
     @staticmethod

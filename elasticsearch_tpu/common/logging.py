@@ -25,22 +25,38 @@ INDEXING_SLOWLOG = "elasticsearch_tpu.index.indexing.slowlog"
 _FORMAT = "[%(asctime)s][%(levelname)-5s][%(name)s] %(message)s"
 
 
+_configured_loggers: set = set()
+
+
 def configure(settings=None) -> None:
     """Install the node's logging config (reference: LogConfigurator).
     `logger.<name>` settings override per-logger levels, e.g.
-    -E logger.elasticsearch_tpu.cluster=DEBUG."""
+    -E logger.elasticsearch_tpu.cluster=DEBUG. Re-configuration (the
+    dynamic-settings path) resets overrides that were removed and never
+    clobbers a level some other live override still claims."""
     root = logging.getLogger(ROOT)
     if not any(isinstance(h, logging.StreamHandler)
                for h in root.handlers):
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter(_FORMAT))
         root.addHandler(handler)
-    root.setLevel(logging.INFO)
+    if root.level == logging.NOTSET:
+        root.setLevel(logging.INFO)
+    wanted: Dict[str, int] = {}
     if settings is not None:
         for key, value in settings.get_as_dict().items():
             if key.startswith("logger."):
-                logging.getLogger(key[len("logger."):]).setLevel(
-                    _level(value))
+                wanted[key[len("logger."):]] = _level(value)
+    for name, level in wanted.items():
+        logging.getLogger(name).setLevel(level)
+        _configured_loggers.add(name)
+    # overrides removed since the last configure revert to inheritance
+    for name in list(_configured_loggers - set(wanted)):
+        if name == ROOT:
+            logging.getLogger(name).setLevel(logging.INFO)
+        else:
+            logging.getLogger(name).setLevel(logging.NOTSET)
+        _configured_loggers.discard(name)
 
 
 def _level(value: Any) -> int:
@@ -93,9 +109,11 @@ class SlowLog:
     def enabled(self) -> bool:
         return bool(self.thresholds)
 
-    def maybe_log(self, took_s: float, shard: int,
+    def maybe_log(self, took_s: float, shard: Any,
                   source: Optional[Dict[str, Any]] = None,
                   total_hits: Optional[int] = None) -> Optional[str]:
+        """`shard` is the shard number, or "kernel" for the TPU fast
+        path (one launch covers every shard of the index)."""
         """Log at the most severe tier whose threshold `took_s` crosses;
         returns the level used (for tests) or None."""
         hit_level = None

@@ -166,6 +166,23 @@ class Settings:
         merged.update(kwargs)
         return cls(cls._flatten(merged))
 
+    def replace_all(self, flat: Dict[str, Any]) -> None:
+        """Swap the full map in place (dynamic-settings recompute: base
+        node config + persistent + transient). In-place so every holder
+        of this Settings object observes the change."""
+        self._map.clear()
+        self._map.update(flat)
+
+    def update_dynamic(self, changes: Dict[str, Any]) -> None:
+        """Apply runtime setting changes in place — the one sanctioned
+        mutation hook for the dynamic-settings API (reference:
+        ClusterSettings#applySettings). A None value clears the key."""
+        for key, value in Settings._flatten(changes).items():
+            if value is None:
+                self._map.pop(key, None)
+            else:
+                self._map[key] = value
+
     def raw_get(self, key: str) -> Any:
         return self._map.get(key)
 

@@ -17,6 +17,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
     IndexAlreadyExistsException,
     IndexNotFoundException,
     ShardNotFoundException,
@@ -78,7 +79,9 @@ class IndexService:
                  mapping: Optional[dict], data_path: str):
         self.name = name
         self.index_uuid = index_uuid
-        self.settings = settings
+        # private copy: dynamic updates mutate per-index state and must
+        # never leak into a caller's Settings (or the EMPTY singleton)
+        self.settings = Settings(settings.get_as_dict())
         self.num_shards = settings.get_int("index.number_of_shards", 1)
         self.num_replicas = settings.get_int("index.number_of_replicas", 0)
         self.mapper = MapperService(settings, mapping)
@@ -112,6 +115,37 @@ class IndexService:
 
     def shard_for_id(self, doc_id: str, routing: Optional[str] = None) -> int:
         return shard_for(routing or doc_id, self.num_shards)
+
+    # -------- dynamic settings (reference: IndexScopedSettings) --------
+
+    DYNAMIC_PREFIXES = ("index.search.slowlog.threshold.",)
+    DYNAMIC_KEYS = ("index.number_of_replicas",)
+
+    @classmethod
+    def validate_dynamic_settings(cls, changes: Dict[str, Any]) -> None:
+        for key, value in changes.items():
+            if not (key in cls.DYNAMIC_KEYS or any(
+                    key.startswith(p) for p in cls.DYNAMIC_PREFIXES)):
+                raise IllegalArgumentException(
+                    f"setting [{key}] is not dynamically updateable" if
+                    key.startswith("index.") else
+                    f"unknown index setting [{key}]")
+            if key == "index.number_of_replicas" and value is not None:
+                try:
+                    if int(value) < 0:
+                        raise ValueError
+                except (TypeError, ValueError):
+                    raise IllegalArgumentException(
+                        f"[index.number_of_replicas] must be a "
+                        f"non-negative integer, got [{value}]") from None
+
+    def apply_dynamic_settings(self, changes: Dict[str, Any]) -> None:
+        """Apply validated dynamic changes to this open index."""
+        self.settings.update_dynamic(changes)
+        self.num_replicas = self.settings.get_int(
+            "index.number_of_replicas", self.num_replicas)
+        from elasticsearch_tpu.common.logging import SlowLog
+        self.search_slowlog = SlowLog(self.name, self.settings)
 
     def refresh(self) -> None:
         for s in self.shards.values():

@@ -29,7 +29,15 @@ class Node:
                  node_name: str = "node-1",
                  cluster_name: str = "elasticsearch-tpu",
                  settings: Optional[Settings] = None):
-        self.settings = settings or Settings.EMPTY
+        # private copy — dynamic cluster settings mutate node.settings
+        # and must never write through to the caller's object or the
+        # shared EMPTY singleton
+        self.settings = Settings((settings or Settings.EMPTY)
+                                 .get_as_dict())
+        # the node-config baseline dynamic settings recompute against:
+        # clearing a cluster setting (null) reverts to this, not to
+        # whatever value happened to be live
+        self._base_settings = dict(self.settings.get_as_dict())
         # logging is part of node construction, not the CLI: embedded
         # users (bench, tests, Python API) get the same handlers/levels
         from elasticsearch_tpu.common.logging import configure
@@ -47,6 +55,14 @@ class Node:
         self.task_manager = TaskManager(self.node_id)
         from elasticsearch_tpu.search.contexts import SearchContextManager
         self.search_contexts = SearchContextManager()
+        # single-node dynamic cluster settings (cluster mode keeps them
+        # in the published ClusterState instead); persistent ones
+        # survive restart via the gateway file
+        self.transient_settings: Dict[str, Any] = {}
+        self.persistent_settings: Dict[str, Any] = \
+            self._load_persistent_settings(data_path)
+        if self.persistent_settings:
+            self.settings.update_dynamic(self.persistent_settings)
         # the TPU serving path: resident packs + micro-batched kernel
         # (disable with search.tpu_serving.enabled=false — the planner
         # path then serves everything)
@@ -75,6 +91,67 @@ class Node:
         self._refresher: Optional[threading.Timer] = None
         self._syncer: Optional[threading.Timer] = None
         self._closed = False
+
+    @staticmethod
+    def _load_persistent_settings(data_path: str) -> Dict[str, Any]:
+        import os
+        p = os.path.join(data_path, "_state", "cluster_settings.json")
+        try:
+            with open(p, "rb") as f:
+                return json.loads(f.read().decode("utf-8"))
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def recompute_settings(self, persistent: Optional[dict] = None,
+                           transient: Optional[dict] = None) -> None:
+        """node.settings := base config + persistent + transient
+        (reference precedence). Called on every dynamic change —
+        including removals, which thereby revert to the base value."""
+        if persistent is None:
+            persistent = self.persistent_settings
+        if transient is None:
+            transient = self.transient_settings
+        target = dict(self._base_settings)
+        target.update(persistent)
+        target.update(transient)
+        self.settings.replace_all(target)
+        from elasticsearch_tpu.common.logging import configure
+        configure(self.settings)
+
+    def update_cluster_settings_local(self, persistent: dict,
+                                      transient: dict) -> dict:
+        """Single-node _cluster/settings PUT (reference semantics:
+        validate against the dynamic registry, transient wins)."""
+        import os
+
+        from elasticsearch_tpu.cluster.service import (
+            DYNAMIC_CLUSTER_PREFIXES, DYNAMIC_CLUSTER_SETTINGS)
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+        from elasticsearch_tpu.index.translog import write_atomic
+        flat_p = Settings._flatten(persistent)
+        flat_t = Settings._flatten(transient)
+        for key in list(flat_p) + list(flat_t):
+            if key in DYNAMIC_CLUSTER_SETTINGS or any(
+                    key.startswith(p) for p in DYNAMIC_CLUSTER_PREFIXES):
+                continue
+            raise IllegalArgumentException(
+                f"setting [{key}] is not dynamically updateable")
+        for store, changes in ((self.persistent_settings, flat_p),
+                               (self.transient_settings, flat_t)):
+            for k, v in changes.items():
+                if v is None:
+                    store.pop(k, None)
+                else:
+                    store[k] = v
+        self.recompute_settings()
+        p = os.path.join(self.indices.data_path, "_state",
+                         "cluster_settings.json")
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        write_atomic(p, json.dumps(self.persistent_settings,
+                                   sort_keys=True).encode("utf-8"))
+        return {"acknowledged": True,
+                "persistent": dict(self.persistent_settings),
+                "transient": dict(self.transient_settings)}
 
     def start_cluster(self, *, host: str = "127.0.0.1",
                       transport_port: int = 0,

@@ -109,6 +109,25 @@ def register(controller: RestController, node) -> None:
             out[name] = {"mappings": indices.index(name).mapper.to_mapping()}
         return 200, out
 
+    def put_settings(req: RestRequest):
+        body = req.body or {}
+        # accepted spellings (all reference forms): {"index": {...}},
+        # {"settings": {...}}, flat dotted keys ("index.x" / "x")
+        spec = body.get("settings", body)
+        changes = {}
+        for k, v in Settings._flatten(spec).items():
+            changes[k if k.startswith("index.") else f"index.{k}"] = v
+        if node.cluster is not None:
+            for name in node.cluster.resolve_indices(req.param("index")):
+                node.cluster.update_index_settings(name, changes)
+            return 200, {"acknowledged": True}
+        from elasticsearch_tpu.indices.service import IndexService
+        IndexService.validate_dynamic_settings(changes)
+        for name in resolve_indices(indices, req.param("index")):
+            indices.index(name).apply_dynamic_settings(changes)
+        indices.persist_metadata()
+        return 200, {"acknowledged": True}
+
     def get_settings(req: RestRequest):
         out = {}
         for name in resolve_indices(indices, req.param("index")):
@@ -184,6 +203,7 @@ def register(controller: RestController, node) -> None:
     controller.register("GET", "/_mapping", get_mapping)
     controller.register("GET", "/{index}/_settings", get_settings)
     controller.register("GET", "/_settings", get_settings)
+    controller.register("PUT", "/{index}/_settings", put_settings)
     controller.register("POST", "/{index}/_refresh", refresh)
     controller.register("POST", "/_refresh", refresh)
     controller.register("GET", "/{index}/_refresh", refresh)

@@ -162,6 +162,30 @@ def register(controller: RestController, node) -> None:
         return _maybe_table(req, ["index", "shard", "prirep", "state",
                                   "docs", "node"], rows)
 
+    def get_cluster_settings(req: RestRequest):
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            return 200, {"persistent": dict(state.persistent_settings),
+                         "transient": dict(state.transient_settings)}
+        return 200, {"persistent": dict(node.persistent_settings),
+                     "transient": dict(node.transient_settings)}
+
+    def put_cluster_settings(req: RestRequest):
+        body = req.body or {}
+        persistent = body.get("persistent") or {}
+        transient = body.get("transient") or {}
+        if not persistent and not transient:
+            from elasticsearch_tpu.common.errors import \
+                IllegalArgumentException
+            raise IllegalArgumentException(
+                "no settings to update: provide [persistent] and/or "
+                "[transient]")
+        if node.cluster is not None:
+            return 200, node.cluster.update_cluster_settings(persistent,
+                                                             transient)
+        return 200, node.update_cluster_settings_local(persistent,
+                                                       transient)
+
     def cluster_state(req: RestRequest):
         if node.cluster is not None:
             return 200, node.cluster.state_json()
@@ -183,6 +207,8 @@ def register(controller: RestController, node) -> None:
                             [["127.0.0.1", 9200, "m", node.node_name]])
 
     controller.register("GET", "/", root)
+    controller.register("GET", "/_cluster/settings", get_cluster_settings)
+    controller.register("PUT", "/_cluster/settings", put_cluster_settings)
     controller.register("GET", "/_cluster/state", cluster_state)
     controller.register("GET", "/_cat/nodes", cat_nodes)
     controller.register("GET", "/_cluster/health", health)

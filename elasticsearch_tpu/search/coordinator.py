@@ -312,11 +312,17 @@ def _search_fast(indices: IndicesService, names: List[str],
     for name in names:
         svc = indices.index(name)
         n_shards_total += len(svc.shards)
+        q0 = time.perf_counter()
         res = tpu_search.try_search(
             svc, query, k=k,
             timeout_s=ctx.remaining_s() if ctx is not None else None)
         if res is None:
             return None
+        if svc.search_slowlog.enabled:
+            svc.search_slowlog.maybe_log(
+                time.perf_counter() - q0, "kernel",
+                source={"query": query.query_name()},
+                total_hits=res.total_hits)
         per_index.append((name, svc, res))
 
     # merge across indices: (score desc, index order, kernel rank) — the

@@ -1,0 +1,187 @@
+"""Dynamic settings updates: per-index _settings PUT and
+_cluster/settings (reference: MetadataUpdateSettingsService +
+ClusterUpdateSettingsAction — SURVEY.md §5.6, VERDICT r2 missing #9)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.logging import SEARCH_SLOWLOG
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from tests.test_replication import _make_cluster, _wait_green
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+class TestIndexSettings:
+    def test_slowlog_threshold_applies_at_runtime(self, node, caplog):
+        _handle(node, "PUT", "/d/_doc/1", params={"refresh": "true"},
+                body={"m": "x"})
+        status, _ = _handle(node, "PUT", "/d/_settings", body={
+            "index": {"search": {"slowlog": {"threshold": {"query": {
+                "warn": "0ms"}}}}}})
+        assert status == 200
+        with caplog.at_level(logging.WARNING, logger=SEARCH_SLOWLOG):
+            _handle(node, "POST", "/d/_search",
+                    body={"query": {"match": {"m": "x"}}})
+        assert [r for r in caplog.records if r.name == SEARCH_SLOWLOG]
+
+    def test_flat_dotted_key_body_accepted(self, node):
+        _handle(node, "PUT", "/flat/_doc/1", body={"m": "x"})
+        status, _ = _handle(node, "PUT", "/flat/_settings", body={
+            "index.number_of_replicas": 1})
+        assert status == 200
+        assert node.indices.index("flat").num_replicas == 1
+        status, _ = _handle(node, "PUT", "/flat/_settings", body={
+            "number_of_replicas": 2})
+        assert status == 200
+        assert node.indices.index("flat").num_replicas == 2
+
+    def test_bad_replica_value_400(self, node):
+        _handle(node, "PUT", "/bad/_doc/1", body={"m": "x"})
+        for v in ("two", -1):
+            status, _ = _handle(node, "PUT", "/bad/_settings", body={
+                "index": {"number_of_replicas": v}})
+            assert status == 400, v
+
+    def test_static_setting_rejected(self, node):
+        _handle(node, "PUT", "/d2/_doc/1", body={"m": "x"})
+        status, res = _handle(node, "PUT", "/d2/_settings", body={
+            "index": {"number_of_shards": 5}})
+        assert status == 400
+        status, res = _handle(node, "PUT", "/d2/_settings", body={
+            "index": {"bogus_key": 1}})
+        assert status == 400
+
+    def test_replica_count_updates_metadata(self, node):
+        _handle(node, "PUT", "/d3/_doc/1", body={"m": "x"})
+        status, _ = _handle(node, "PUT", "/d3/_settings", body={
+            "index": {"number_of_replicas": 2}})
+        assert status == 200
+        assert node.indices.index("d3").num_replicas == 2
+        _s, res = _handle(node, "GET", "/d3/_settings")
+        assert res["d3"]["settings"]["index"]["number_of_replicas"] == "2"
+
+
+class TestClusterSettings:
+    def test_auto_create_toggle(self, node):
+        status, res = _handle(node, "PUT", "/_cluster/settings", body={
+            "persistent": {"action": {"auto_create_index": "false"}}})
+        assert status == 200
+        assert res["persistent"]["action.auto_create_index"] == "false"
+        status, res = _handle(node, "PUT", "/nope/_doc/1", body={"x": 1})
+        assert status == 404, res
+        # flip back (transient wins over persistent)
+        status, _ = _handle(node, "PUT", "/_cluster/settings", body={
+            "transient": {"action": {"auto_create_index": "true"}}})
+        status, res = _handle(node, "PUT", "/nope/_doc/1", body={"x": 1})
+        assert status == 201
+
+    def test_null_clears_and_reverts_to_base(self, node):
+        """Clearing a setting (null) must revert live behavior to the
+        node-config baseline, not freeze the stale value."""
+        _s, _ = _handle(node, "PUT", "/_cluster/settings", body={
+            "persistent": {"action.auto_create_index": "false"}})
+        status, _ = _handle(node, "PUT", "/gone/_doc/1", body={"x": 1})
+        assert status == 404
+        _s, res = _handle(node, "PUT", "/_cluster/settings", body={
+            "persistent": {"action.auto_create_index": None}})
+        assert "action.auto_create_index" not in res["persistent"]
+        status, _ = _handle(node, "PUT", "/gone/_doc/1", body={"x": 1})
+        assert status == 201  # default (true) is live again
+
+    def test_unknown_setting_rejected(self, node):
+        status, _ = _handle(node, "PUT", "/_cluster/settings", body={
+            "persistent": {"cluster.routing.allocation.enable": "none"}})
+        assert status == 400
+
+    def test_get_shape(self, node):
+        status, res = _handle(node, "GET", "/_cluster/settings")
+        assert status == 200
+        assert set(res) == {"persistent", "transient"}
+
+    def test_persistent_survives_restart(self, tmp_data_path):
+        n1 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        _handle(n1, "PUT", "/_cluster/settings", body={
+            "persistent": {"action.auto_create_index": "false"}})
+        n1.close()
+        n2 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            status, _ = _handle(n2, "PUT", "/later/_doc/1", body={"x": 1})
+            assert status == 404
+            _s, res = _handle(n2, "GET", "/_cluster/settings")
+            assert res["persistent"]["action.auto_create_index"] == "false"
+        finally:
+            n2.close()
+
+
+class TestClusterModeReplicaScaling:
+    def test_scale_replicas_up_and_down(self, tmp_path):
+        nodes = _make_cluster(tmp_path)
+        try:
+            status, _ = _handle(nodes[0], "PUT", "/scale", body={
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0}})
+            assert status == 200
+            _wait_green(nodes[0])
+            for i in range(8):
+                _handle(nodes[0], "PUT", f"/scale/_doc/s{i}",
+                        body={"n": i})
+            # 0 → 1 replica: a copy recovers on another node
+            status, _ = _handle(nodes[1], "PUT", "/scale/_settings",
+                                body={"index": {"number_of_replicas": 1}})
+            assert status == 200
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                state = nodes[0].cluster.applied_state()
+                copies = state.shard_copies("scale", 0)
+                started = [c for c in copies if c.state == "STARTED"]
+                if len(started) == 2:
+                    break
+                time.sleep(0.1)
+            state = nodes[0].cluster.applied_state()
+            copies = state.shard_copies("scale", 0)
+            assert len([c for c in copies if c.state == "STARTED"]) == 2
+            # the recovered replica physically holds the docs
+            replica = next(c for c in copies
+                           if not c.primary and c.state == "STARTED")
+            holder = next(n for n in nodes
+                          if n.node_id == replica.node_id)
+            shard = holder.indices.index("scale").shards[0]
+            assert shard.get("s3") is not None
+            # 1 → 0: the replica is removed everywhere
+            status, _ = _handle(nodes[2], "PUT", "/scale/_settings",
+                                body={"index": {"number_of_replicas": 0}})
+            assert status == 200
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                state = nodes[0].cluster.applied_state()
+                if len(state.shard_copies("scale", 0)) == 1:
+                    break
+                time.sleep(0.1)
+            assert len(nodes[0].cluster.applied_state()
+                       .shard_copies("scale", 0)) == 1
+        finally:
+            for n in nodes:
+                try:
+                    n.close()
+                except Exception:
+                    pass
