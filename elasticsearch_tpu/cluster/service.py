@@ -68,6 +68,8 @@ ACTION_DELETE_INDEX = "cluster/admin/delete_index"
 ACTION_PUT_MAPPING = "cluster/admin/put_mapping"
 ACTION_UPDATE_INDEX_SETTINGS = "cluster/admin/update_index_settings"
 ACTION_UPDATE_CLUSTER_SETTINGS = "cluster/admin/update_cluster_settings"
+ACTION_PUT_PIPELINE = "cluster/admin/put_pipeline"
+ACTION_DELETE_PIPELINE = "cluster/admin/delete_pipeline"
 
 # cluster-wide settings this build can apply at runtime (reference:
 # ClusterSettings registry of Dynamic-flagged settings)
@@ -248,6 +250,8 @@ class ClusterService:
                  self._handle_update_index_settings),
                 (ACTION_UPDATE_CLUSTER_SETTINGS,
                  self._handle_update_cluster_settings),
+                (ACTION_PUT_PIPELINE, self._handle_put_pipeline),
+                (ACTION_DELETE_PIPELINE, self._handle_delete_pipeline),
                 (ACTION_SHARD_STARTED, self._handle_shard_started),
                 (ACTION_SHARD_FAILED, self._handle_shard_failed),
                 (ACTION_REPLICA_OP, self._handle_replica_op),
@@ -536,11 +540,17 @@ class ClusterService:
         node config, never to a stale live value."""
         pair = (dict(state.persistent_settings),
                 dict(state.transient_settings))
-        if pair == getattr(self, "_last_applied_settings", None):
-            return  # hot applier path: skip the no-op recompute
-        self._last_applied_settings = pair
-        self.node.recompute_settings(state.persistent_settings,
-                                     state.transient_settings)
+        if pair != getattr(self, "_last_applied_settings", None):
+            self._last_applied_settings = pair
+            self.node.recompute_settings(state.persistent_settings,
+                                         state.transient_settings)
+        if state.ingest_pipelines != getattr(
+                self, "_last_applied_pipelines", None):
+            self._last_applied_pipelines = dict(state.ingest_pipelines)
+            try:
+                self.node.ingest.sync(state.ingest_pipelines)
+            except Exception:  # noqa: BLE001 — a bad pipeline body in
+                logger.exception("pipeline sync failed")  # state
 
     def _maybe_reroute(self, state: ClusterState) -> None:
         """Master-side convergence loop: if a reroute would change the
@@ -736,6 +746,47 @@ class ClusterService:
                 "persistent": state.persistent_settings,
                 "transient": state.transient_settings}
 
+    def _handle_put_pipeline(self, payload, from_node) -> Dict[str, Any]:
+        pipeline_id = payload["id"]
+        body = payload["body"]
+        from elasticsearch_tpu.ingest import Pipeline
+        Pipeline(pipeline_id, body)  # validate before publishing
+
+        def update(state: ClusterState) -> ClusterState:
+            pipelines = dict(state.ingest_pipelines)
+            pipelines[pipeline_id] = body
+            return state.with_updates(ingest_pipelines=pipelines)
+
+        self._run_master_update(update,
+                                source=f"put-pipeline[{pipeline_id}]")
+        return {"acknowledged": True}
+
+    def _handle_delete_pipeline(self, payload, from_node
+                                ) -> Dict[str, Any]:
+        pipeline_id = payload["id"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if pipeline_id not in state.ingest_pipelines:
+                from elasticsearch_tpu.common.errors import \
+                    ResourceNotFoundException
+                raise ResourceNotFoundException(
+                    f"pipeline [{pipeline_id}] does not exist")
+            pipelines = {k: v for k, v in state.ingest_pipelines.items()
+                         if k != pipeline_id}
+            return state.with_updates(ingest_pipelines=pipelines)
+
+        self._run_master_update(update,
+                                source=f"delete-pipeline[{pipeline_id}]")
+        return {"acknowledged": True}
+
+    def put_pipeline(self, pipeline_id: str, body: dict) -> dict:
+        return self._call_master(ACTION_PUT_PIPELINE,
+                                 {"id": pipeline_id, "body": body})
+
+    def delete_pipeline(self, pipeline_id: str) -> dict:
+        return self._call_master(ACTION_DELETE_PIPELINE,
+                                 {"id": pipeline_id})
+
     def update_index_settings(self, name: str,
                               settings: Dict[str, Any]) -> Dict[str, Any]:
         return self._call_master(ACTION_UPDATE_INDEX_SETTINGS,
@@ -795,7 +846,10 @@ class ClusterService:
                        ACTION_UPDATE_INDEX_SETTINGS:
                            self._handle_update_index_settings,
                        ACTION_UPDATE_CLUSTER_SETTINGS:
-                           self._handle_update_cluster_settings}[action]
+                           self._handle_update_cluster_settings,
+                       ACTION_PUT_PIPELINE: self._handle_put_pipeline,
+                       ACTION_DELETE_PIPELINE:
+                           self._handle_delete_pipeline}[action]
             return handler(payload, self.local_node.to_json())
         try:
             return self.transport.send_request(addr, action, payload,

@@ -123,6 +123,9 @@ class ClusterState:
         default_factory=dict)
     transient_settings: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # ingest pipeline bodies, id → definition (reference: IngestMetadata)
+    ingest_pipelines: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
 
     # -------------- queries --------------
 
@@ -170,6 +173,7 @@ class ClusterState:
             "voting_config": list(self.voting_config),
             "persistent_settings": dict(self.persistent_settings),
             "transient_settings": dict(self.transient_settings),
+            "ingest_pipelines": dict(self.ingest_pipelines),
         }
 
     @staticmethod
@@ -190,6 +194,7 @@ class ClusterState:
             voting_config=tuple(d.get("voting_config") or ()),
             persistent_settings=dict(d.get("persistent_settings") or {}),
             transient_settings=dict(d.get("transient_settings") or {}),
+            ingest_pipelines=dict(d.get("ingest_pipelines") or {}),
         )
 
     @staticmethod

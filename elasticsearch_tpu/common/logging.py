@@ -25,15 +25,18 @@ INDEXING_SLOWLOG = "elasticsearch_tpu.index.indexing.slowlog"
 _FORMAT = "[%(asctime)s][%(levelname)-5s][%(name)s] %(message)s"
 
 
-_configured_loggers: set = set()
+# logger name → owner token of the configure() call that set it; resets
+# only apply to the same owner so two embedded nodes in one process
+# can't clobber each other's overrides
+_configured_loggers: Dict[str, Any] = {}
 
 
-def configure(settings=None) -> None:
+def configure(settings=None, owner: Any = None) -> None:
     """Install the node's logging config (reference: LogConfigurator).
     `logger.<name>` settings override per-logger levels, e.g.
-    -E logger.elasticsearch_tpu.cluster=DEBUG. Re-configuration (the
-    dynamic-settings path) resets overrides that were removed and never
-    clobbers a level some other live override still claims."""
+    -E logger.elasticsearch_tpu.cluster=DEBUG. Re-configuration with
+    the same `owner` (the dynamic-settings path) resets overrides that
+    owner removed; other owners' overrides are left alone."""
     root = logging.getLogger(ROOT)
     if not any(isinstance(h, logging.StreamHandler)
                for h in root.handlers):
@@ -49,14 +52,15 @@ def configure(settings=None) -> None:
                 wanted[key[len("logger."):]] = _level(value)
     for name, level in wanted.items():
         logging.getLogger(name).setLevel(level)
-        _configured_loggers.add(name)
-    # overrides removed since the last configure revert to inheritance
-    for name in list(_configured_loggers - set(wanted)):
-        if name == ROOT:
-            logging.getLogger(name).setLevel(logging.INFO)
-        else:
-            logging.getLogger(name).setLevel(logging.NOTSET)
-        _configured_loggers.discard(name)
+        _configured_loggers[name] = owner
+    if owner is None:
+        return  # ad-hoc call: never resets anything
+    # this owner's removed overrides revert to inheritance
+    for name, owned_by in list(_configured_loggers.items()):
+        if owned_by == owner and name not in wanted:
+            logging.getLogger(name).setLevel(
+                logging.INFO if name == ROOT else logging.NOTSET)
+            del _configured_loggers[name]
 
 
 def _level(value: Any) -> int:
@@ -112,10 +116,10 @@ class SlowLog:
     def maybe_log(self, took_s: float, shard: Any,
                   source: Optional[Dict[str, Any]] = None,
                   total_hits: Optional[int] = None) -> Optional[str]:
-        """`shard` is the shard number, or "kernel" for the TPU fast
-        path (one launch covers every shard of the index)."""
         """Log at the most severe tier whose threshold `took_s` crosses;
-        returns the level used (for tests) or None."""
+        returns the level used (for tests) or None. `shard` is the shard
+        number, or "kernel" for the TPU fast path (one launch covers
+        every shard of the index)."""
         hit_level = None
         for level in self.LEVELS:  # warn first = most severe
             t = self.thresholds.get(level)

@@ -119,7 +119,7 @@ class IndexService:
     # -------- dynamic settings (reference: IndexScopedSettings) --------
 
     DYNAMIC_PREFIXES = ("index.search.slowlog.threshold.",)
-    DYNAMIC_KEYS = ("index.number_of_replicas",)
+    DYNAMIC_KEYS = ("index.number_of_replicas", "index.default_pipeline")
 
     @classmethod
     def validate_dynamic_settings(cls, changes: Dict[str, Any]) -> None:

@@ -1,0 +1,493 @@
+"""Ingest pipelines: pre-index document transformation.
+
+Reference: `ingest/IngestService`, `Pipeline`, `CompoundProcessor`, the
+`ingest-common` processor module, `RestPutPipelineAction` /
+`RestSimulatePipelineAction` (SURVEY.md §2.1#41). Kept contracts: the
+pipeline JSON grammar ({description, processors: [{type: {...}}]}),
+dotted field paths, per-processor `ignore_failure` + `on_failure`
+handlers, `ignore_missing`, simple `{{field}}` templates in set/fail,
+the `?pipeline=` request param and the `index.default_pipeline`
+setting, and the _simulate API shape.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (EsException,
+                                             IllegalArgumentException,
+                                             ResourceNotFoundException)
+
+
+class IngestProcessorException(EsException):
+    status = 400
+
+
+class DropDocument(Exception):
+    """Raised by the drop processor: the doc is silently not indexed."""
+
+
+# ----------------------------------------------------------------------
+# field-path helpers (dotted paths into nested dicts)
+# ----------------------------------------------------------------------
+
+def _resolve(doc: Dict[str, Any], path: str, *, create: bool = False):
+    """→ (container, leaf_key). create=True builds missing objects."""
+    parts = path.split(".")
+    node = doc
+    for p in parts[:-1]:
+        nxt = node.get(p)
+        if not isinstance(nxt, dict):
+            if not create:
+                return None, parts[-1]
+            nxt = {}
+            node[p] = nxt
+        node = nxt
+    return node, parts[-1]
+
+
+def get_field(doc: Dict[str, Any], path: str, default=None):
+    node, leaf = _resolve(doc, path)
+    if node is None:
+        return default
+    return node.get(leaf, default)
+
+
+def has_field(doc: Dict[str, Any], path: str) -> bool:
+    node, leaf = _resolve(doc, path)
+    return node is not None and leaf in node
+
+
+def set_field(doc: Dict[str, Any], path: str, value: Any) -> None:
+    node, leaf = _resolve(doc, path, create=True)
+    node[leaf] = value
+
+
+def remove_field(doc: Dict[str, Any], path: str) -> bool:
+    node, leaf = _resolve(doc, path)
+    if node is not None and leaf in node:
+        del node[leaf]
+        return True
+    return False
+
+
+_TEMPLATE = re.compile(r"\{\{\s*([\w.]+)\s*\}\}")
+
+
+def render(template: Any, doc: Dict[str, Any]) -> Any:
+    """Simple {{field}} substitution (the mustache subset the common
+    processors actually use)."""
+    if not isinstance(template, str) or "{{" not in template:
+        return template
+    return _TEMPLATE.sub(
+        lambda m: str(get_field(doc, m.group(1), "")), template)
+
+
+# ----------------------------------------------------------------------
+# processors
+# ----------------------------------------------------------------------
+
+class Processor:
+    type_name = "?"
+
+    def __init__(self, config: Dict[str, Any]):
+        self.ignore_failure = bool(config.pop("ignore_failure", False))
+        self.on_failure_spec = config.pop("on_failure", None)
+        self.on_failure: List["Processor"] = []
+        self.tag = config.pop("tag", None)
+        self.description = config.pop("description", None)
+
+    def _req(self, config: Dict[str, Any], key: str):
+        if key not in config:
+            raise IllegalArgumentException(
+                f"[{self.type_name}] required property [{key}] is missing")
+        return config[key]
+
+    def process(self, doc: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+_PROCESSORS: Dict[str, Callable[[Dict[str, Any]], Processor]] = {}
+
+
+def register_processor(cls):
+    _PROCESSORS[cls.type_name] = cls
+    return cls
+
+
+@register_processor
+class SetProcessor(Processor):
+    type_name = "set"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        self.value = self._req(config, "value")
+        self.override = bool(config.get("override", True))
+
+    def process(self, doc):
+        if not self.override and has_field(doc, self.field):
+            return
+        set_field(doc, self.field, render(self.value, doc))
+
+
+@register_processor
+class RemoveProcessor(Processor):
+    type_name = "remove"
+
+    def __init__(self, config):
+        super().__init__(config)
+        field = self._req(config, "field")
+        self.fields = field if isinstance(field, list) else [field]
+        self.ignore_missing = bool(config.get("ignore_missing", False))
+
+    def process(self, doc):
+        for f in self.fields:
+            if not remove_field(doc, f) and not self.ignore_missing:
+                raise IngestProcessorException(
+                    f"field [{f}] not present as part of path [{f}]")
+
+
+@register_processor
+class RenameProcessor(Processor):
+    type_name = "rename"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        self.target = self._req(config, "target_field")
+        self.ignore_missing = bool(config.get("ignore_missing", False))
+
+    def process(self, doc):
+        if not has_field(doc, self.field):
+            if self.ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{self.field}] doesn't exist")
+        if has_field(doc, self.target):
+            raise IngestProcessorException(
+                f"field [{self.target}] already exists")
+        value = get_field(doc, self.field)
+        remove_field(doc, self.field)
+        set_field(doc, self.target, value)
+
+
+class _StringFieldProcessor(Processor):
+    """Common shape: transform one string field in place."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        self.target = config.get("target_field", self.field)
+        self.ignore_missing = bool(config.get("ignore_missing", False))
+
+    def transform(self, value: str) -> Any:
+        raise NotImplementedError
+
+    def process(self, doc):
+        value = get_field(doc, self.field)
+        if value is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{self.field}] is null or missing")
+        if not isinstance(value, str):
+            raise IngestProcessorException(
+                f"field [{self.field}] of type "
+                f"[{type(value).__name__}] cannot be cast to string")
+        set_field(doc, self.target, self.transform(value))
+
+
+@register_processor
+class LowercaseProcessor(_StringFieldProcessor):
+    type_name = "lowercase"
+
+    def transform(self, value):
+        return value.lower()
+
+
+@register_processor
+class UppercaseProcessor(_StringFieldProcessor):
+    type_name = "uppercase"
+
+    def transform(self, value):
+        return value.upper()
+
+
+@register_processor
+class TrimProcessor(_StringFieldProcessor):
+    type_name = "trim"
+
+    def transform(self, value):
+        return value.strip()
+
+
+@register_processor
+class SplitProcessor(_StringFieldProcessor):
+    type_name = "split"
+
+    def __init__(self, config):
+        self.separator = config.get("separator")
+        super().__init__(config)
+        if self.separator is None:
+            raise IllegalArgumentException(
+                "[split] required property [separator] is missing")
+
+    def transform(self, value):
+        return re.split(self.separator, value)
+
+
+@register_processor
+class GsubProcessor(_StringFieldProcessor):
+    type_name = "gsub"
+
+    def __init__(self, config):
+        self.pattern = config.get("pattern")
+        self.replacement = config.get("replacement")
+        super().__init__(config)
+        if self.pattern is None or self.replacement is None:
+            raise IllegalArgumentException(
+                "[gsub] requires [pattern] and [replacement]")
+
+    def transform(self, value):
+        return re.sub(self.pattern, self.replacement, value)
+
+
+@register_processor
+class JoinProcessor(Processor):
+    type_name = "join"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        self.separator = self._req(config, "separator")
+        self.target = config.get("target_field", self.field)
+
+    def process(self, doc):
+        value = get_field(doc, self.field)
+        if not isinstance(value, list):
+            raise IngestProcessorException(
+                f"field [{self.field}] of type "
+                f"[{type(value).__name__}] cannot be joined")
+        set_field(doc, self.target,
+                  self.separator.join(str(v) for v in value))
+
+
+@register_processor
+class AppendProcessor(Processor):
+    type_name = "append"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        value = self._req(config, "value")
+        self.values = value if isinstance(value, list) else [value]
+        self.allow_duplicates = bool(config.get("allow_duplicates", True))
+
+    def process(self, doc):
+        existing = get_field(doc, self.field)
+        if existing is None:
+            existing = []
+        elif not isinstance(existing, list):
+            existing = [existing]
+        else:
+            existing = list(existing)
+        for v in self.values:
+            v = render(v, doc)
+            if self.allow_duplicates or v not in existing:
+                existing.append(v)
+        set_field(doc, self.field, existing)
+
+
+@register_processor
+class ConvertProcessor(Processor):
+    type_name = "convert"
+
+    TYPES = ("integer", "long", "float", "double", "string", "boolean",
+             "auto")
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        self.type = self._req(config, "type")
+        self.target = config.get("target_field", self.field)
+        self.ignore_missing = bool(config.get("ignore_missing", False))
+        if self.type not in self.TYPES:
+            raise IllegalArgumentException(
+                f"[convert] type [{self.type}] not supported")
+
+    def _one(self, v):
+        try:
+            if self.type in ("integer", "long"):
+                return int(v)
+            if self.type in ("float", "double"):
+                return float(v)
+            if self.type == "string":
+                return str(v)
+            if self.type == "boolean":
+                s = str(v).lower()
+                if s in ("true", "false"):
+                    return s == "true"
+                raise ValueError(v)
+            # auto
+            s = str(v)
+            for cast in (int, float):
+                try:
+                    return cast(s)
+                except ValueError:
+                    pass
+            if s.lower() in ("true", "false"):
+                return s.lower() == "true"
+            return s
+        except (TypeError, ValueError):
+            raise IngestProcessorException(
+                f"[convert] unable to convert [{v}] to {self.type}"
+            ) from None
+
+    def process(self, doc):
+        value = get_field(doc, self.field)
+        if value is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{self.field}] is null or missing")
+        out = [self._one(v) for v in value] if isinstance(value, list) \
+            else self._one(value)
+        set_field(doc, self.target, out)
+
+
+@register_processor
+class FailProcessor(Processor):
+    type_name = "fail"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.message = self._req(config, "message")
+
+    def process(self, doc):
+        raise IngestProcessorException(str(render(self.message, doc)))
+
+
+@register_processor
+class DropProcessor(Processor):
+    type_name = "drop"
+
+    def process(self, doc):
+        raise DropDocument()
+
+
+# ----------------------------------------------------------------------
+# pipeline + service
+# ----------------------------------------------------------------------
+
+def _parse_processors(specs: List[Dict[str, Any]]) -> List[Processor]:
+    out: List[Processor] = []
+    for spec in specs or []:
+        if not isinstance(spec, dict) or len(spec) != 1:
+            raise IllegalArgumentException(
+                "each processor is one {type: {config}} object")
+        type_name, config = next(iter(spec.items()))
+        factory = _PROCESSORS.get(type_name)
+        if factory is None:
+            raise IllegalArgumentException(
+                f"No processor type exists with name [{type_name}]")
+        config = dict(config or {})
+        proc = factory(config)
+        if proc.on_failure_spec is not None:
+            proc.on_failure = _parse_processors(proc.on_failure_spec)
+        out.append(proc)
+    return out
+
+
+class Pipeline:
+    def __init__(self, pipeline_id: str, body: Dict[str, Any]):
+        self.id = pipeline_id
+        self.description = body.get("description")
+        known = {"description", "processors", "on_failure", "version",
+                 "_meta"}
+        unknown = set(body) - known
+        if unknown:
+            raise IllegalArgumentException(
+                f"pipeline [{pipeline_id}] unknown field "
+                f"{sorted(unknown)}")
+        if "processors" not in body:
+            raise IllegalArgumentException(
+                f"pipeline [{pipeline_id}] requires [processors]")
+        self.processors = _parse_processors(body["processors"])
+        self.on_failure = _parse_processors(body.get("on_failure") or [])
+        self.body = body
+
+    def execute(self, doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """→ transformed source, or None when a drop processor fired.
+        The input dict is never mutated."""
+        import copy
+        work = copy.deepcopy(doc)
+        try:
+            self._run(self.processors, work)
+        except DropDocument:
+            return None
+        except IngestProcessorException:
+            if not self.on_failure:
+                raise
+            self._run(self.on_failure, work)
+        return work
+
+    @staticmethod
+    def _run(processors: List[Processor], doc: Dict[str, Any]) -> None:
+        for proc in processors:
+            try:
+                proc.process(doc)
+            except DropDocument:
+                raise
+            except IngestProcessorException:
+                if proc.ignore_failure:
+                    continue
+                if proc.on_failure:
+                    Pipeline._run(proc.on_failure, doc)
+                    continue
+                raise
+
+
+class IngestService:
+    """Node-level pipeline registry (cluster mode syncs it from the
+    published state; single-node persists to the gateway)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pipelines: Dict[str, Pipeline] = {}
+
+    def put(self, pipeline_id: str, body: Dict[str, Any]) -> None:
+        pipeline = Pipeline(pipeline_id, body)  # validates
+        with self._lock:
+            self._pipelines[pipeline_id] = pipeline
+
+    def get(self, pipeline_id: str) -> Pipeline:
+        with self._lock:
+            p = self._pipelines.get(pipeline_id)
+        if p is None:
+            raise ResourceNotFoundException(
+                f"pipeline [{pipeline_id}] does not exist")
+        return p
+
+    def delete(self, pipeline_id: str) -> None:
+        with self._lock:
+            if self._pipelines.pop(pipeline_id, None) is None:
+                raise ResourceNotFoundException(
+                    f"pipeline [{pipeline_id}] does not exist")
+
+    def list_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._pipelines)
+
+    def bodies(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {pid: p.body for pid, p in self._pipelines.items()}
+
+    def sync(self, bodies: Dict[str, Dict[str, Any]]) -> None:
+        """Replace the registry wholesale (cluster state application)."""
+        parsed = {pid: Pipeline(pid, body)
+                  for pid, body in bodies.items()}
+        with self._lock:
+            self._pipelines = parsed

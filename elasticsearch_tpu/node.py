@@ -39,9 +39,10 @@ class Node:
         # whatever value happened to be live
         self._base_settings = dict(self.settings.get_as_dict())
         # logging is part of node construction, not the CLI: embedded
-        # users (bench, tests, Python API) get the same handlers/levels
+        # users (bench, tests, Python API) get the same handlers/levels.
+        # Owner-scoped so two embedded nodes don't reset each other.
         from elasticsearch_tpu.common.logging import configure
-        configure(self.settings)
+        configure(self.settings, owner=id(self))
         self.node_name = node_name
         self.node_id = _load_or_create_node_id(data_path, node_name)
         self.cluster_name = cluster_name
@@ -55,6 +56,9 @@ class Node:
         self.task_manager = TaskManager(self.node_id)
         from elasticsearch_tpu.search.contexts import SearchContextManager
         self.search_contexts = SearchContextManager()
+        from elasticsearch_tpu.ingest import IngestService
+        self.ingest = IngestService()
+        self._load_ingest_pipelines(data_path)
         # single-node dynamic cluster settings (cluster mode keeps them
         # in the published ClusterState instead); persistent ones
         # survive restart via the gateway file
@@ -62,7 +66,9 @@ class Node:
         self.persistent_settings: Dict[str, Any] = \
             self._load_persistent_settings(data_path)
         if self.persistent_settings:
-            self.settings.update_dynamic(self.persistent_settings)
+            # full recompute so persisted logger.* overrides are applied
+            # to the logging config too, not just the settings map
+            self.recompute_settings()
         # the TPU serving path: resident packs + micro-batched kernel
         # (disable with search.tpu_serving.enabled=false — the planner
         # path then serves everything)
@@ -92,12 +98,38 @@ class Node:
         self._syncer: Optional[threading.Timer] = None
         self._closed = False
 
-    @staticmethod
-    def _load_persistent_settings(data_path: str) -> Dict[str, Any]:
+    def _ingest_state_path(self) -> str:
         import os
-        p = os.path.join(data_path, "_state", "cluster_settings.json")
+        return os.path.join(self.indices.data_path, "_state",
+                            "ingest_pipelines.json")
+
+    def _cluster_settings_path(self) -> str:
+        import os
+        return os.path.join(self.indices.data_path, "_state",
+                            "cluster_settings.json")
+
+    def _load_ingest_pipelines(self, data_path: str) -> None:
         try:
-            with open(p, "rb") as f:
+            with open(self._ingest_state_path(), "rb") as f:
+                self.ingest.sync(json.loads(f.read().decode("utf-8")))
+        except (OSError, json.JSONDecodeError):
+            pass
+        except Exception:  # noqa: BLE001 — a bad pipeline must not
+            pass           # prevent node startup
+
+    def persist_ingest_pipelines(self) -> None:
+        import os
+
+        from elasticsearch_tpu.index.translog import write_atomic
+        p = self._ingest_state_path()
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        write_atomic(p, json.dumps(self.ingest.bodies(),
+                                   sort_keys=True).encode("utf-8"))
+
+    def _load_persistent_settings(self, data_path: str
+                                  ) -> Dict[str, Any]:
+        try:
+            with open(self._cluster_settings_path(), "rb") as f:
                 return json.loads(f.read().decode("utf-8"))
         except (OSError, json.JSONDecodeError):
             return {}
@@ -116,7 +148,7 @@ class Node:
         target.update(transient)
         self.settings.replace_all(target)
         from elasticsearch_tpu.common.logging import configure
-        configure(self.settings)
+        configure(self.settings, owner=id(self))
 
     def update_cluster_settings_local(self, persistent: dict,
                                       transient: dict) -> dict:
@@ -144,8 +176,7 @@ class Node:
                 else:
                     store[k] = v
         self.recompute_settings()
-        p = os.path.join(self.indices.data_path, "_state",
-                         "cluster_settings.json")
+        p = self._cluster_settings_path()
         os.makedirs(os.path.dirname(p), exist_ok=True)
         write_atomic(p, json.dumps(self.persistent_settings,
                                    sort_keys=True).encode("utf-8"))
@@ -183,8 +214,8 @@ class Node:
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, cluster, document,
-                                                    search, tasks)
-        for module in (document, search, admin, cluster, tasks):
+                                                    ingest, search, tasks)
+        for module in (document, search, admin, cluster, tasks, ingest):
             module.register(self.controller, self)
 
     # ---------------- index helpers ----------------

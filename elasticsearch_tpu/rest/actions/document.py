@@ -31,6 +31,19 @@ def _auto_id() -> str:
 # local op executors — run on the node that owns the target shard
 # ----------------------------------------------------------------------
 
+def run_ingest_pipeline(node, svc, body: dict, params
+                        ) -> Tuple[Optional[dict], Optional[str]]:
+    """→ (transformed source | None when dropped, pipeline id | None).
+    Resolution order: ?pipeline= param, then index.default_pipeline
+    ("_none" disables). Reference: IngestService#resolvePipelines."""
+    pid = params.get("pipeline") or svc.settings.get(
+        "index.default_pipeline")
+    if not pid or pid == "_none":
+        return body, None
+    pipeline = node.ingest.get(pid)
+    return pipeline.execute(body), pid
+
+
 def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
                    op_type: str = "index",
                    shard_num: Optional[int] = None) -> Tuple[int, Dict]:
@@ -41,6 +54,12 @@ def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
     svc = (node.indices.index(index) if node.cluster is not None
            else node.get_or_autocreate_index(index))
     created_id = doc_id or _auto_id()
+    body, _pid = run_ingest_pipeline(node, svc, body, params)
+    if body is None:  # a drop processor fired: acknowledged, not indexed
+        return 200, {"_index": index, "_id": created_id,
+                     "_version": -1, "result": "noop",
+                     "_shards": {"total": 0, "successful": 0,
+                                 "failed": 0}}
     if shard_num is None:
         shard_num = svc.shard_for_id(created_id, params.get("routing"))
     shard = svc.shard(shard_num)
@@ -168,7 +187,8 @@ def parse_bulk_body(raw: str, default_index: Optional[str]
             i += 1
         ops.append({"op": op, "index": index,
                     "id": doc_id or _auto_id(),
-                    "routing": meta.get("routing"), "source": source})
+                    "routing": meta.get("routing"), "source": source,
+                    "pipeline": meta.get("pipeline")})
     return ops
 
 
@@ -215,6 +235,14 @@ def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
                     "result": r.result, "_seq_no": r.seq_no,
                     "_primary_term": r.primary_term, "status": 200}})
             else:
+                source, _pid = run_ingest_pipeline(
+                    node, svc, source,
+                    {"pipeline": entry.get("pipeline")})
+                if source is None:  # drop processor
+                    items.append({op: {
+                        "_index": index, "_id": the_id, "_version": -1,
+                        "result": "noop", "status": 200}})
+                    continue
                 r = shard.apply_index_on_primary(
                     the_id, source,
                     **({"op_type": "create"} if op == "create" else {}))
@@ -334,6 +362,11 @@ def register(controller: RestController, node) -> None:
         raw = req.raw_body.decode("utf-8") if req.raw_body else (
             req.body if isinstance(req.body, str) else "")
         ops = parse_bulk_body(raw, req.param("index"))
+        url_pipeline = req.params.get("pipeline")
+        if url_pipeline:
+            for entry in ops:
+                if not entry.get("pipeline"):
+                    entry["pipeline"] = url_pipeline
         refresh = req.param("refresh") in ("", "true", "wait_for")
         if node.cluster is not None:
             items = node.cluster.route_bulk(ops, refresh=refresh)

@@ -1,0 +1,87 @@
+"""Ingest pipeline REST actions (reference: RestPutPipelineAction,
+RestGetPipelineAction, RestDeletePipelineAction,
+RestSimulatePipelineAction — SURVEY.md §2.1#41)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.ingest import IngestProcessorException, Pipeline
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+
+
+def register(controller: RestController, node) -> None:
+
+    def put_pipeline(req: RestRequest):
+        body = req.body
+        if not isinstance(body, dict):
+            raise IllegalArgumentException("pipeline body is required")
+        pid = req.param("id")
+        if node.cluster is not None:
+            node.cluster.put_pipeline(pid, body)
+        else:
+            node.ingest.put(pid, body)
+            node.persist_ingest_pipelines()
+        return 200, {"acknowledged": True}
+
+    def get_pipeline(req: RestRequest):
+        pid = req.param("id")
+        if pid:
+            return 200, {pid: node.ingest.get(pid).body}
+        return 200, node.ingest.bodies()
+
+    def delete_pipeline(req: RestRequest):
+        pid = req.param("id")
+        if node.cluster is not None:
+            node.cluster.delete_pipeline(pid)
+        else:
+            node.ingest.delete(pid)
+            node.persist_ingest_pipelines()
+        return 200, {"acknowledged": True}
+
+    def simulate(req: RestRequest):
+        body = req.body or {}
+        docs = body.get("docs")
+        if not isinstance(docs, list) or not docs:
+            raise IllegalArgumentException("[_simulate] requires [docs]")
+        pid = req.param("id")
+        if pid:
+            pipeline = node.ingest.get(pid)
+        else:
+            if "pipeline" not in body:
+                raise IllegalArgumentException(
+                    "[_simulate] requires a [pipeline] definition or an "
+                    "id in the path")
+            pipeline = Pipeline("_simulate_pipeline", body["pipeline"])
+        out = []
+        for doc in docs:
+            source = (doc or {}).get("_source")
+            if not isinstance(source, dict):
+                raise IllegalArgumentException(
+                    "[_simulate] each doc requires [_source]")
+            try:
+                result = pipeline.execute(source)
+                if result is None:
+                    out.append({"doc": None, "dropped": True})
+                else:
+                    out.append({"doc": {
+                        "_index": (doc or {}).get("_index", "_index"),
+                        "_id": (doc or {}).get("_id", "_id"),
+                        "_source": result}})
+            except IngestProcessorException as e:
+                out.append({"error": {
+                    "type": "ingest_processor_exception",
+                    "reason": str(e)}})
+        return 200, {"docs": out}
+
+    controller.register("PUT", "/_ingest/pipeline/{id}", put_pipeline)
+    controller.register("GET", "/_ingest/pipeline/{id}", get_pipeline)
+    controller.register("GET", "/_ingest/pipeline", get_pipeline)
+    controller.register("DELETE", "/_ingest/pipeline/{id}",
+                        delete_pipeline)
+    controller.register("POST", "/_ingest/pipeline/{id}/_simulate",
+                        simulate)
+    controller.register("GET", "/_ingest/pipeline/{id}/_simulate",
+                        simulate)
+    controller.register("POST", "/_ingest/pipeline/_simulate", simulate)

@@ -265,6 +265,27 @@ def test_scroll_rejected_when_shards_remote(cluster):
     assert status == 400, body
 
 
+def test_ingest_pipeline_propagates_across_nodes(cluster):
+    """A pipeline PUT via one node rides the cluster state to every
+    node and applies on whichever primary owner indexes the doc."""
+    status, _ = _handle(cluster[0], "PUT", "/_ingest/pipeline/cup",
+                        body={"processors": [
+                            {"uppercase": {"field": "w"}}]})
+    assert status == 200
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all("cup" in n.ingest.bodies() for n in cluster):
+            break
+        time.sleep(0.1)
+    assert all("cup" in n.ingest.bodies() for n in cluster)
+    status, _ = _handle(cluster[1], "PUT", "/dist/_doc/pipe-1",
+                        params={"pipeline": "cup"}, body={"w": "low"})
+    assert status == 201
+    status, got = _handle(cluster[2], "GET", "/dist/_doc/pipe-1")
+    assert got["_source"]["w"] == "LOW"
+    _handle(cluster[0], "DELETE", "/dist/_doc/pipe-1")
+
+
 def test_tasks_list_and_cancel_across_nodes(cluster):
     """A task on node A is listable and cancellable via node B's REST —
     the transport handlers must exist on every node from cluster start."""

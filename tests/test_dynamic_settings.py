@@ -116,6 +116,22 @@ class TestClusterSettings:
         assert status == 200
         assert set(res) == {"persistent", "transient"}
 
+    def test_persistent_logger_level_applies_after_restart(
+            self, tmp_data_path):
+        import logging as _logging
+        n1 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        _handle(n1, "PUT", "/_cluster/settings", body={
+            "persistent": {"logger.elasticsearch_tpu.restarted": "debug"}})
+        n1.close()
+        n2 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            assert _logging.getLogger(
+                "elasticsearch_tpu.restarted").level == _logging.DEBUG
+        finally:
+            n2.close()
+
     def test_persistent_survives_restart(self, tmp_data_path):
         n1 = Node(str(tmp_data_path), settings=Settings.of(
             {"search.tpu_serving.enabled": "false"}))

@@ -1,0 +1,207 @@
+"""Ingest pipelines (reference: ingest/IngestService + ingest-common
+processors — SURVEY.md §2.1#41)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.ingest import (IngestProcessorException, Pipeline,
+                                      get_field)
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    if isinstance(body, str):
+        return node.handle(method, path, params, None, body.encode())
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+class TestProcessors:
+    def _run(self, processors, doc):
+        return Pipeline("t", {"processors": processors}).execute(doc)
+
+    def test_set_with_template_and_override(self):
+        out = self._run([{"set": {"field": "greeting",
+                                  "value": "hi {{user.name}}"}}],
+                        {"user": {"name": "ada"}})
+        assert out["greeting"] == "hi ada"
+        out = self._run([{"set": {"field": "a", "value": 2,
+                                  "override": False}}], {"a": 1})
+        assert out["a"] == 1
+
+    def test_remove_rename_nested(self):
+        out = self._run([{"rename": {"field": "a.b",
+                                     "target_field": "c"}},
+                         {"remove": {"field": "a"}}],
+                        {"a": {"b": 7}})
+        assert out == {"c": 7}
+
+    def test_string_processors(self):
+        out = self._run([
+            {"lowercase": {"field": "x"}},
+            {"trim": {"field": "y"}},
+            {"split": {"field": "z", "separator": ","}},
+            {"gsub": {"field": "g", "pattern": "\\d+",
+                      "replacement": "#"}}],
+            {"x": "ABC", "y": "  pad  ", "z": "a,b,c", "g": "v1 v22"})
+        assert out["x"] == "abc" and out["y"] == "pad"
+        assert out["z"] == ["a", "b", "c"] and out["g"] == "v# v#"
+
+    def test_convert_and_append_join(self):
+        out = self._run([
+            {"convert": {"field": "n", "type": "integer"}},
+            {"append": {"field": "tags", "value": ["b", "a"],
+                        "allow_duplicates": False}},
+            {"join": {"field": "parts", "separator": "-"}}],
+            {"n": "42", "tags": ["a"], "parts": ["x", "y"]})
+        assert out["n"] == 42
+        assert out["tags"] == ["a", "b"]
+        assert out["parts"] == "x-y"
+
+    def test_convert_failure_and_ignore(self):
+        with pytest.raises(IngestProcessorException):
+            self._run([{"convert": {"field": "n", "type": "integer"}}],
+                      {"n": "NaNope"})
+        out = self._run([{"convert": {"field": "missing",
+                                      "type": "integer",
+                                      "ignore_missing": True}}], {"a": 1})
+        assert out == {"a": 1}
+
+    def test_fail_and_on_failure(self):
+        with pytest.raises(IngestProcessorException, match="boom x"):
+            self._run([{"fail": {"message": "boom {{why}}"}}],
+                      {"why": "x"})
+        out = self._run([{"fail": {"message": "boom",
+                                   "on_failure": [{"set": {
+                                       "field": "err",
+                                       "value": "handled"}}]}}], {})
+        assert out["err"] == "handled"
+
+    def test_drop(self):
+        assert self._run([{"drop": {}}], {"a": 1}) is None
+
+    def test_input_not_mutated(self):
+        src = {"a": "X"}
+        self._run([{"lowercase": {"field": "a"}}], src)
+        assert src == {"a": "X"}
+
+    def test_unknown_processor_rejected(self):
+        with pytest.raises(Exception):
+            Pipeline("t", {"processors": [{"teleport": {}}]})
+
+
+class TestPipelineRest:
+    def test_crud_and_simulate(self, node):
+        status, _ = _handle(node, "PUT", "/_ingest/pipeline/clean", body={
+            "description": "cleanup",
+            "processors": [{"lowercase": {"field": "tag"}},
+                           {"set": {"field": "seen", "value": True}}]})
+        assert status == 200
+        status, res = _handle(node, "GET", "/_ingest/pipeline/clean")
+        assert res["clean"]["description"] == "cleanup"
+        status, res = _handle(node, "POST",
+                              "/_ingest/pipeline/clean/_simulate",
+                              body={"docs": [{"_source": {"tag": "HOT"}}]})
+        assert res["docs"][0]["doc"]["_source"] == {"tag": "hot",
+                                                   "seen": True}
+        status, _ = _handle(node, "DELETE", "/_ingest/pipeline/clean")
+        assert status == 200
+        status, _ = _handle(node, "GET", "/_ingest/pipeline/clean")
+        assert status == 404
+
+    def test_simulate_inline(self, node):
+        status, res = _handle(node, "POST", "/_ingest/pipeline/_simulate",
+                              body={
+                                  "pipeline": {"processors": [
+                                      {"uppercase": {"field": "x"}}]},
+                                  "docs": [{"_source": {"x": "low"}}]})
+        assert res["docs"][0]["doc"]["_source"]["x"] == "LOW"
+
+    def test_index_with_pipeline_param(self, node):
+        _handle(node, "PUT", "/_ingest/pipeline/up", body={
+            "processors": [{"uppercase": {"field": "name"}}]})
+        status, res = _handle(node, "PUT", "/docs/_doc/1",
+                              params={"pipeline": "up",
+                                      "refresh": "true"},
+                              body={"name": "bob"})
+        assert status == 201
+        _s, got = _handle(node, "GET", "/docs/_doc/1")
+        assert got["_source"]["name"] == "BOB"
+
+    def test_default_pipeline_setting(self, node):
+        _handle(node, "PUT", "/_ingest/pipeline/stamp", body={
+            "processors": [{"set": {"field": "stamped", "value": "yes"}}]})
+        _handle(node, "PUT", "/auto2", body={"settings": {
+            "index": {"default_pipeline": "stamp"}}})
+        _handle(node, "PUT", "/auto2/_doc/1", params={"refresh": "true"},
+                body={"x": 1})
+        _s, got = _handle(node, "GET", "/auto2/_doc/1")
+        assert got["_source"]["stamped"] == "yes"
+        # pipeline=_none disables the default
+        _handle(node, "PUT", "/auto2/_doc/2",
+                params={"pipeline": "_none", "refresh": "true"},
+                body={"x": 2})
+        _s, got = _handle(node, "GET", "/auto2/_doc/2")
+        assert "stamped" not in got["_source"]
+
+    def test_bulk_with_pipeline(self, node):
+        _handle(node, "PUT", "/_ingest/pipeline/low", body={
+            "processors": [{"lowercase": {"field": "t"}}]})
+        lines = [json.dumps({"index": {"_index": "bk", "_id": "1"}}),
+                 json.dumps({"t": "AA"}),
+                 json.dumps({"index": {"_index": "bk", "_id": "2",
+                                       "pipeline": "_none"}}),
+                 json.dumps({"t": "BB"})]
+        status, res = _handle(node, "POST", "/_bulk",
+                              params={"pipeline": "low",
+                                      "refresh": "true"},
+                              body="\n".join(lines) + "\n")
+        assert status == 200 and res["errors"] is False
+        _s, got = _handle(node, "GET", "/bk/_doc/1")
+        assert got["_source"]["t"] == "aa"
+        _s, got = _handle(node, "GET", "/bk/_doc/2")
+        assert got["_source"]["t"] == "BB"
+
+    def test_drop_in_index_path(self, node):
+        _handle(node, "PUT", "/_ingest/pipeline/dropper", body={
+            "processors": [{"drop": {}}]})
+        status, res = _handle(node, "PUT", "/dr/_doc/1",
+                              params={"pipeline": "dropper"},
+                              body={"x": 1})
+        assert status == 200 and res["result"] == "noop"
+        status, _ = _handle(node, "GET", "/dr/_doc/1")
+        assert status == 404
+
+    def test_failing_pipeline_400(self, node):
+        _handle(node, "PUT", "/_ingest/pipeline/angry", body={
+            "processors": [{"fail": {"message": "no entry"}}]})
+        status, res = _handle(node, "PUT", "/f/_doc/1",
+                              params={"pipeline": "angry"},
+                              body={"x": 1})
+        assert status == 400
+
+    def test_pipelines_survive_restart(self, tmp_data_path):
+        n1 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        _handle(n1, "PUT", "/_ingest/pipeline/keep", body={
+            "processors": [{"set": {"field": "k", "value": 1}}]})
+        n1.close()
+        n2 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            status, res = _handle(n2, "GET", "/_ingest/pipeline/keep")
+            assert status == 200
+        finally:
+            n2.close()
