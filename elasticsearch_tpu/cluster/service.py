@@ -780,12 +780,22 @@ class ClusterService:
         return {"acknowledged": True}
 
     def put_pipeline(self, pipeline_id: str, body: dict) -> dict:
-        return self._call_master(ACTION_PUT_PIPELINE,
-                                 {"id": pipeline_id, "body": body})
+        result = self._call_master(ACTION_PUT_PIPELINE,
+                                   {"id": pipeline_id, "body": body})
+        # read-your-writes: wait until THIS node's applier installed it,
+        # so an immediate GET / ?pipeline= use succeeds
+        self.wait_for_applied(
+            lambda s: s.ingest_pipelines.get(pipeline_id) == body,
+            timeout=10.0)
+        return result
 
     def delete_pipeline(self, pipeline_id: str) -> dict:
-        return self._call_master(ACTION_DELETE_PIPELINE,
-                                 {"id": pipeline_id})
+        result = self._call_master(ACTION_DELETE_PIPELINE,
+                                   {"id": pipeline_id})
+        self.wait_for_applied(
+            lambda s: pipeline_id not in s.ingest_pipelines,
+            timeout=10.0)
+        return result
 
     def update_index_settings(self, name: str,
                               settings: Dict[str, Any]) -> Dict[str, Any]:

@@ -229,14 +229,19 @@ class SplitProcessor(_StringFieldProcessor):
     type_name = "split"
 
     def __init__(self, config):
-        self.separator = config.get("separator")
+        separator = config.get("separator")
         super().__init__(config)
-        if self.separator is None:
+        if separator is None:
             raise IllegalArgumentException(
                 "[split] required property [separator] is missing")
+        try:  # compile at PUT time: a bad pattern is a 400, not a
+            self.separator = re.compile(separator)  # per-doc 500
+        except re.error as e:
+            raise IllegalArgumentException(
+                f"[split] invalid separator pattern: {e}") from None
 
     def transform(self, value):
-        return re.split(self.separator, value)
+        return self.separator.split(value)
 
 
 @register_processor
@@ -244,15 +249,20 @@ class GsubProcessor(_StringFieldProcessor):
     type_name = "gsub"
 
     def __init__(self, config):
-        self.pattern = config.get("pattern")
+        pattern = config.get("pattern")
         self.replacement = config.get("replacement")
         super().__init__(config)
-        if self.pattern is None or self.replacement is None:
+        if pattern is None or self.replacement is None:
             raise IllegalArgumentException(
                 "[gsub] requires [pattern] and [replacement]")
+        try:
+            self.pattern = re.compile(pattern)
+        except re.error as e:
+            raise IllegalArgumentException(
+                f"[gsub] invalid pattern: {e}") from None
 
     def transform(self, value):
-        return re.sub(self.pattern, self.replacement, value)
+        return self.pattern.sub(self.replacement, value)
 
 
 @register_processor
@@ -431,7 +441,10 @@ class Pipeline:
         except IngestProcessorException:
             if not self.on_failure:
                 raise
-            self._run(self.on_failure, work)
+            try:
+                self._run(self.on_failure, work)
+            except DropDocument:
+                return None  # a drop in on_failure drops the doc too
         return work
 
     @staticmethod

@@ -109,13 +109,26 @@ class Node:
                             "cluster_settings.json")
 
     def _load_ingest_pipelines(self, data_path: str) -> None:
+        import logging
         try:
             with open(self._ingest_state_path(), "rb") as f:
-                self.ingest.sync(json.loads(f.read().decode("utf-8")))
-        except (OSError, json.JSONDecodeError):
-            pass
-        except Exception:  # noqa: BLE001 — a bad pipeline must not
-            pass           # prevent node startup
+                bodies = json.loads(f.read().decode("utf-8"))
+        except FileNotFoundError:
+            return
+        except (OSError, json.JSONDecodeError) as e:
+            logging.getLogger("elasticsearch_tpu.ingest").error(
+                "could not read persisted ingest pipelines: %s", e)
+            return
+        # load individually: one bad pipeline must neither prevent
+        # startup nor silently drop its siblings (which the next
+        # persist would then permanently destroy)
+        for pid, body in bodies.items():
+            try:
+                self.ingest.put(pid, body)
+            except Exception:  # noqa: BLE001 — keep the rest
+                logging.getLogger("elasticsearch_tpu.ingest").exception(
+                    "persisted ingest pipeline [%s] failed to load; "
+                    "skipping it", pid)
 
     def persist_ingest_pipelines(self) -> None:
         import os

@@ -1,0 +1,208 @@
+"""_reindex, _update_by_query, _delete_by_query.
+
+Reference: the `reindex` module (`Reindexer`, `TransportUpdateByQuery
+Action`, `TransportDeleteByQueryAction` — SURVEY.md §2.1#51). Shape
+kept: scroll the source under a point-in-time snapshot (sort _doc),
+apply batched bulk writes, report {took, total, created/updated/
+deleted, batches, version_conflicts, failures}. Update/delete-by-query
+stamp each op with the snapshot's seq_no, so a write that lands after
+the snapshot is a version_conflict (counted under conflicts=proceed,
+aborting otherwise) — stale snapshot data never silently overwrites a
+newer document. conflicts=proceed forgives ONLY version conflicts;
+any other bulk error aborts regardless.
+
+Like scroll itself, these run where every target shard is local (the
+cluster-remote case 400s rather than silently misbehaving). Documents
+indexed under CUSTOM ?routing= are out of scope: _routing is not
+persisted per doc, so by-query ops target shards by _id."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.search import scroll as scroll_mod
+
+BATCH_SIZE = 500
+SCROLL_KEEPALIVE = "5m"
+
+
+class _Abort(Exception):
+    pass
+
+
+def _scroll_source(node, index: str, query: Optional[dict],
+                   batch_size: int, seq_no_primary_term: bool):
+    """Yield scroll pages (lists of hits) over a pinned snapshot."""
+    body: Dict[str, Any] = {"query": query or {"match_all": {}},
+                            "sort": ["_doc"], "size": batch_size}
+    if seq_no_primary_term:
+        body["seq_no_primary_term"] = True
+    page = scroll_mod.start_scroll(node, index, body,
+                                   {"scroll": SCROLL_KEEPALIVE,
+                                    "size": str(batch_size)})
+    sid = page["_scroll_id"]
+    try:
+        while True:
+            hits = page["hits"]["hits"]
+            if not hits:
+                return
+            yield hits
+            page = scroll_mod.next_page(node, sid, SCROLL_KEEPALIVE)
+    finally:
+        scroll_mod.clear(node, [sid])
+
+
+def _apply_ops(node, ops: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    from elasticsearch_tpu.rest.actions import document as doc_mod
+    if node.cluster is not None:
+        return node.cluster.route_bulk(ops, refresh=False)
+    return doc_mod.apply_bulk_ops(node, ops, refresh=False)
+
+
+def _summarize(items: List[Dict[str, Any]], out: Dict[str, Any],
+               conflicts_proceed: bool) -> None:
+    for item in items:
+        body = next(iter(item.values()))
+        err = body.get("error")
+        if err is not None:
+            if body.get("status") == 409:
+                # only VERSION CONFLICTS are forgivable
+                out["version_conflicts"] += 1
+                if conflicts_proceed:
+                    continue
+            out["failures"].append(err)
+            raise _Abort()
+        result = body.get("result")
+        if result == "created":
+            out["created"] += 1
+        elif result == "updated":
+            out["updated"] += 1
+        elif result == "deleted":
+            out["deleted"] += 1
+        elif result == "not_found":
+            out["version_conflicts"] += 1
+            if not conflicts_proceed:
+                raise _Abort()
+
+
+def _run_by_query(node, index: str, query: Optional[dict], *,
+                  make_op: Callable[[Dict[str, Any]], Dict[str, Any]],
+                  batch_size: int, conflicts_proceed: bool,
+                  max_docs: Optional[int],
+                  seq_no_primary_term: bool) -> Dict[str, Any]:
+    """The shared scroll → build ops → bulk → summarize loop all three
+    APIs wrap (reference: AbstractAsyncBulkByScrollAction)."""
+    t0 = time.perf_counter()
+    out: Dict[str, Any] = {
+        "total": 0, "created": 0, "updated": 0, "deleted": 0,
+        "batches": 0, "version_conflicts": 0, "noops": 0,
+        "retries": {"bulk": 0, "search": 0}, "failures": []}
+    try:
+        for hits in _scroll_source(node, index, query, batch_size,
+                                   seq_no_primary_term):
+            ops = []
+            for h in hits:
+                if max_docs is not None and out["total"] >= max_docs:
+                    break
+                out["total"] += 1
+                ops.append(make_op(h))
+            if not ops:
+                break
+            out["batches"] += 1
+            _summarize(_apply_ops(node, ops), out, conflicts_proceed)
+            if max_docs is not None and out["total"] >= max_docs:
+                break
+    except _Abort:
+        pass
+    out["took"] = int((time.perf_counter() - t0) * 1000)
+    out["timed_out"] = False
+    return out
+
+
+def _conflicts_proceed(params: Dict[str, str],
+                       body: Dict[str, Any]) -> bool:
+    return params.get("conflicts", body.get("conflicts",
+                                            "abort")) == "proceed"
+
+
+def reindex(node, body: Dict[str, Any]) -> Dict[str, Any]:
+    source = body.get("source") or {}
+    dest = body.get("dest") or {}
+    src_index = source.get("index")
+    dst_index = dest.get("index")
+    if not src_index or not dst_index:
+        raise IllegalArgumentException(
+            "[reindex] requires [source.index] and [dest.index]")
+    if src_index == dst_index:
+        raise IllegalArgumentException(
+            "reindex cannot write into an index its reading from "
+            f"[{dst_index}]")
+    op_type = dest.get("op_type", "index")
+    if op_type not in ("index", "create"):
+        raise IllegalArgumentException(
+            f"[reindex] unsupported dest.op_type [{op_type}]")
+    pipeline = dest.get("pipeline")
+
+    def make_op(h):
+        return {"op": op_type, "index": dst_index, "id": h["_id"],
+                "routing": None, "source": h.get("_source") or {},
+                "pipeline": pipeline}
+
+    return _run_by_query(
+        node, src_index, source.get("query"), make_op=make_op,
+        batch_size=int(source.get("size", BATCH_SIZE)),
+        conflicts_proceed=_conflicts_proceed({}, body),
+        max_docs=body.get("max_docs"), seq_no_primary_term=False)
+
+
+def update_by_query(node, index: str,
+                    body: Optional[Dict[str, Any]],
+                    params: Dict[str, str]) -> Dict[str, Any]:
+    """Re-indexes each matching doc's snapshot source in place (bumping
+    its version; through ?pipeline= when given) — the reference's
+    scriptless update-by-query. The snapshot seq_no guards every write."""
+    body = body or {}
+    if "script" in body:
+        raise IllegalArgumentException(
+            "[update_by_query] scripted updates are not supported "
+            "(scripting module not present)")
+    pipeline = params.get("pipeline")
+
+    def make_op(h):
+        return {"op": "index", "index": h["_index"], "id": h["_id"],
+                "routing": None, "source": h.get("_source") or {},
+                "pipeline": pipeline,
+                "if_seq_no": h.get("_seq_no"),
+                "if_primary_term": h.get("_primary_term")}
+
+    out = _run_by_query(
+        node, index, body.get("query"), make_op=make_op,
+        batch_size=BATCH_SIZE,
+        conflicts_proceed=_conflicts_proceed(params, body),
+        max_docs=body.get("max_docs"), seq_no_primary_term=True)
+    out["updated"] += out.pop("created", 0)
+    out["created"] = 0
+    return out
+
+
+def delete_by_query(node, index: str,
+                    body: Optional[Dict[str, Any]],
+                    params: Dict[str, str]) -> Dict[str, Any]:
+    body = body or {}
+    if "query" not in body:
+        raise IllegalArgumentException(
+            "[delete_by_query] requires a [query]")
+
+    def make_op(h):
+        return {"op": "delete", "index": h["_index"], "id": h["_id"],
+                "routing": None, "source": None,
+                "if_seq_no": h.get("_seq_no"),
+                "if_primary_term": h.get("_primary_term")}
+
+    return _run_by_query(
+        node, index, body["query"], make_op=make_op,
+        batch_size=BATCH_SIZE,
+        conflicts_proceed=_conflicts_proceed(params, body),
+        max_docs=body.get("max_docs"), seq_no_primary_term=True)

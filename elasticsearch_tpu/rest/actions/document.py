@@ -211,8 +211,13 @@ def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
             if shard_num is None:
                 shard_num = svc.shard_for_id(the_id, entry.get("routing"))
             shard = svc.shard(shard_num)
+            seqno_kwargs = {}
+            if entry.get("if_seq_no") is not None:
+                seqno_kwargs = {
+                    "if_seq_no": int(entry["if_seq_no"]),
+                    "if_primary_term": int(entry["if_primary_term"])}
             if op == "delete":
-                r = shard.apply_delete_on_primary(the_id)
+                r = shard.apply_delete_on_primary(the_id, **seqno_kwargs)
                 node.replicate("delete", index, shard_num, the_id, None, r)
                 status = 200 if r.found else 404
                 items.append({"delete": {
@@ -244,7 +249,7 @@ def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
                         "result": "noop", "status": 200}})
                     continue
                 r = shard.apply_index_on_primary(
-                    the_id, source,
+                    the_id, source, **seqno_kwargs,
                     **({"op_type": "create"} if op == "create" else {}))
                 node.replicate("index", index, shard_num, the_id, source, r)
                 status = 201 if r.created else 200
@@ -375,6 +380,25 @@ def register(controller: RestController, node) -> None:
         return 200, {"took": int((time.perf_counter() - t0) * 1000),
                      "errors": bulk_has_errors(items), "items": items}
 
+    def do_reindex(req: RestRequest):
+        from elasticsearch_tpu import reindex as reindex_mod
+        return 200, reindex_mod.reindex(node, req.body or {})
+
+    def do_update_by_query(req: RestRequest):
+        from elasticsearch_tpu import reindex as reindex_mod
+        return 200, reindex_mod.update_by_query(
+            node, req.param("index"), req.body, req.params)
+
+    def do_delete_by_query(req: RestRequest):
+        from elasticsearch_tpu import reindex as reindex_mod
+        return 200, reindex_mod.delete_by_query(
+            node, req.param("index"), req.body, req.params)
+
+    controller.register("POST", "/_reindex", do_reindex)
+    controller.register("POST", "/{index}/_update_by_query",
+                        do_update_by_query)
+    controller.register("POST", "/{index}/_delete_by_query",
+                        do_delete_by_query)
     controller.register("PUT", "/{index}/_doc/{id}", put_doc)
     controller.register("POST", "/{index}/_doc/{id}", put_doc)
     controller.register("PUT", "/{index}/_create/{id}", create_doc)

@@ -1,0 +1,181 @@
+"""_reindex / _update_by_query / _delete_by_query round-trips
+(reference: the reindex module — SURVEY.md §2.1#51)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def src(node):
+    for i in range(30):
+        _handle(node, "PUT", f"/src/_doc/{i}",
+                params={"refresh": "true"},
+                body={"kind": "even" if i % 2 == 0 else "odd", "n": i})
+    return node
+
+
+class TestReindex:
+    def test_full_copy(self, src):
+        status, res = _handle(src, "POST", "/_reindex", body={
+            "source": {"index": "src", "size": 7},
+            "dest": {"index": "dst"}})
+        assert status == 200, res
+        assert res["total"] == 30 and res["created"] == 30
+        assert res["batches"] == 5  # ceil(30/7)
+        assert res["failures"] == []
+        _handle(src, "POST", "/dst/_refresh")
+        _s, c = _handle(src, "POST", "/dst/_count",
+                        body={"query": {"match_all": {}}})
+        assert c["count"] == 30
+        _s, got = _handle(src, "GET", "/dst/_doc/7")
+        assert got["_source"]["n"] == 7
+
+    def test_query_filtered_copy(self, src):
+        status, res = _handle(src, "POST", "/_reindex", body={
+            "source": {"index": "src",
+                       "query": {"term": {"kind": "even"}}},
+            "dest": {"index": "evens"}})
+        assert res["total"] == 15 and res["created"] == 15
+
+    def test_op_type_create_skips_existing(self, src):
+        _handle(src, "PUT", "/dst2/_doc/3", params={"refresh": "true"},
+                body={"already": True})
+        status, res = _handle(src, "POST", "/_reindex", body={
+            "conflicts": "proceed",
+            "source": {"index": "src"},
+            "dest": {"index": "dst2", "op_type": "create"}})
+        assert res["created"] == 29
+        assert res["version_conflicts"] == 1
+        _s, got = _handle(src, "GET", "/dst2/_doc/3")
+        assert got["_source"] == {"already": True}  # not clobbered
+
+    def test_with_dest_pipeline(self, src):
+        _handle(src, "PUT", "/_ingest/pipeline/stamp", body={
+            "processors": [{"set": {"field": "via", "value": "reindex"}}]})
+        _handle(src, "POST", "/_reindex", body={
+            "source": {"index": "src", "query": {"term": {"n": 1}}},
+            "dest": {"index": "dst3", "pipeline": "stamp"}})
+        _s, got = _handle(src, "GET", "/dst3/_doc/1")
+        assert got["_source"]["via"] == "reindex"
+
+    def test_max_docs(self, src):
+        status, res = _handle(src, "POST", "/_reindex", body={
+            "max_docs": 5,
+            "source": {"index": "src"}, "dest": {"index": "dst4"}})
+        assert res["total"] == 5 and res["created"] == 5
+
+    def test_same_index_rejected(self, src):
+        status, _ = _handle(src, "POST", "/_reindex", body={
+            "source": {"index": "src"}, "dest": {"index": "src"}})
+        assert status == 400
+
+
+class TestUpdateByQuery:
+    def test_bumps_versions(self, src):
+        _s, before = _handle(src, "GET", "/src/_doc/4")
+        status, res = _handle(src, "POST", "/src/_update_by_query",
+                              body={"query": {"term": {"kind": "even"}}})
+        assert status == 200, res
+        assert res["total"] == 15 and res["updated"] == 15
+        _s, after = _handle(src, "GET", "/src/_doc/4")
+        assert after["_version"] == before["_version"] + 1
+        assert after["_source"] == before["_source"]
+
+    def test_with_pipeline_transforms(self, src):
+        _handle(src, "PUT", "/_ingest/pipeline/tag", body={
+            "processors": [{"set": {"field": "touched", "value": 1}}]})
+        _handle(src, "POST", "/src/_update_by_query",
+                params={"pipeline": "tag"},
+                body={"query": {"term": {"n": 9}}})
+        _s, got = _handle(src, "GET", "/src/_doc/9")
+        assert got["_source"]["touched"] == 1
+
+    def test_script_rejected(self, src):
+        status, _ = _handle(src, "POST", "/src/_update_by_query", body={
+            "script": {"source": "ctx._source.x = 1"}})
+        assert status == 400
+
+
+class TestConflictDetection:
+    def test_bulk_honors_if_seq_no(self, node):
+        _handle(node, "PUT", "/cf/_doc/1", params={"refresh": "true"},
+                body={"v": 1})
+        _handle(node, "PUT", "/cf/_doc/1", params={"refresh": "true"},
+                body={"v": 2})  # seq_no now 1
+        from elasticsearch_tpu.rest.actions.document import apply_bulk_ops
+        items = apply_bulk_ops(node, [
+            {"op": "index", "index": "cf", "id": "1", "routing": None,
+             "source": {"v": 99}, "if_seq_no": 0, "if_primary_term": 1},
+            {"op": "delete", "index": "cf", "id": "1", "routing": None,
+             "source": None, "if_seq_no": 0, "if_primary_term": 1}])
+        assert all(next(iter(i.values()))["status"] == 409
+                   for i in items)
+        _s, got = _handle(node, "GET", "/cf/_doc/1")
+        assert got["_source"] == {"v": 2}  # stale writes rejected
+
+    def test_ubq_stamps_snapshot_seqnos(self, src, monkeypatch):
+        """A write landing between the snapshot and the bulk apply is a
+        version conflict — stale data never overwrites it."""
+        from elasticsearch_tpu import reindex as reindex_mod
+        real_apply = reindex_mod._apply_ops
+
+        def racing_apply(node, ops):
+            # simulate a concurrent writer beating the UBQ to doc 0
+            _handle(node, "PUT", "/src/_doc/0",
+                    params={"refresh": "true"}, body={"winner": True})
+            monkeypatch.setattr(reindex_mod, "_apply_ops", real_apply)
+            return real_apply(node, ops)
+
+        monkeypatch.setattr(reindex_mod, "_apply_ops", racing_apply)
+        status, res = _handle(src, "POST", "/src/_update_by_query",
+                              params={"conflicts": "proceed"},
+                              body={"query": {"match_all": {}}})
+        assert status == 200, res
+        assert res["version_conflicts"] == 1
+        assert res["updated"] == 29
+        _s, got = _handle(src, "GET", "/src/_doc/0")
+        assert got["_source"] == {"winner": True}  # not clobbered
+
+
+class TestDeleteByQuery:
+    def test_deletes_matching(self, src):
+        status, res = _handle(src, "POST", "/src/_delete_by_query",
+                              body={"query": {"term": {"kind": "odd"}}})
+        assert status == 200, res
+        assert res["total"] == 15 and res["deleted"] == 15
+        _handle(src, "POST", "/src/_refresh")
+        _s, c = _handle(src, "POST", "/src/_count",
+                        body={"query": {"match_all": {}}})
+        assert c["count"] == 15
+        _s, got = _handle(src, "GET", "/src/_doc/1")
+        assert got.get("found", True) is False or got == {}
+
+    def test_requires_query(self, src):
+        status, _ = _handle(src, "POST", "/src/_delete_by_query",
+                            body={})
+        assert status == 400
+
+    def test_no_contexts_leak(self, src):
+        before = src.search_contexts.active_count()
+        _handle(src, "POST", "/src/_delete_by_query",
+                body={"query": {"term": {"n": 2}}})
+        assert src.search_contexts.active_count() == before
