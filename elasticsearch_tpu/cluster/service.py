@@ -616,17 +616,13 @@ class ClusterService:
     def _handle_create_index(self, payload, from_node) -> Dict[str, Any]:
         name = payload["name"]
         mapping = payload.get("mapping")
-        # normalize nested/flat settings spellings to the flat form so
-        # IndexMeta round-trips through JSON and Settings.of identically
-        flat = Settings.of(payload.get("settings") or {})
-        # REST bodies use bare keys ("number_of_shards"); settings files
-        # use prefixed ("index.number_of_shards") — accept both
-        n_shards = flat.get_int("index.number_of_shards",
-                                flat.get_int("number_of_shards", 1))
-        n_replicas = flat.get_int("index.number_of_replicas",
-                                  flat.get_int("number_of_replicas", 0))
-        norm = {k: v for k, v in flat.get_as_dict().items()
-                if k not in ("number_of_shards", "number_of_replicas")}
+        # shared normalization: EVERY bare key gets the index. prefix so
+        # IndexMeta round-trips identically to the single-node path
+        norm = Settings.normalize_index_settings(
+            payload.get("settings") or {})
+        flat = Settings(norm)
+        n_shards = flat.get_int("index.number_of_shards", 1)
+        n_replicas = flat.get_int("index.number_of_replicas", 0)
         norm["index.number_of_shards"] = n_shards
         norm["index.number_of_replicas"] = n_replicas
         import uuid as uuid_mod

@@ -166,6 +166,19 @@ class Settings:
         merged.update(kwargs)
         return cls(cls._flatten(merged))
 
+    @staticmethod
+    def normalize_index_settings(d: Optional[Dict[str, Any]]
+                                 ) -> Dict[str, Any]:
+        """Flatten an index-settings body accepting BOTH reference
+        spellings — bare keys ("number_of_shards") and prefixed
+        ("index.number_of_shards") — into the canonical index.-prefixed
+        flat form. Shared by every create/update path so single-node and
+        cluster mode treat identical bodies identically."""
+        out: Dict[str, Any] = {}
+        for k, v in Settings._flatten(d or {}).items():
+            out[k if k.startswith("index.") else f"index.{k}"] = v
+        return out
+
     def replace_all(self, flat: Dict[str, Any]) -> None:
         """Swap the full map in place (dynamic-settings recompute: base
         node config + persistent + transient). In-place so every holder

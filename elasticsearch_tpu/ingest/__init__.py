@@ -470,11 +470,15 @@ class IngestService:
     def __init__(self):
         self._lock = threading.Lock()
         self._pipelines: Dict[str, Pipeline] = {}
+        # bodies that failed to parse (e.g. written by an older build):
+        # unusable, but preserved so persistence never destroys them
+        self._quarantined: Dict[str, Dict[str, Any]] = {}
 
     def put(self, pipeline_id: str, body: Dict[str, Any]) -> None:
         pipeline = Pipeline(pipeline_id, body)  # validates
         with self._lock:
             self._pipelines[pipeline_id] = pipeline
+            self._quarantined.pop(pipeline_id, None)
 
     def get(self, pipeline_id: str) -> Pipeline:
         with self._lock:
@@ -486,7 +490,10 @@ class IngestService:
 
     def delete(self, pipeline_id: str) -> None:
         with self._lock:
-            if self._pipelines.pop(pipeline_id, None) is None:
+            found = self._pipelines.pop(pipeline_id, None) is not None
+            found = self._quarantined.pop(pipeline_id,
+                                          None) is not None or found
+            if not found:
                 raise ResourceNotFoundException(
                     f"pipeline [{pipeline_id}] does not exist")
 
@@ -495,12 +502,28 @@ class IngestService:
             return sorted(self._pipelines)
 
     def bodies(self) -> Dict[str, Dict[str, Any]]:
+        """Every known body INCLUDING quarantined ones — persisting this
+        never destroys a pipeline just because this build can't parse
+        it."""
         with self._lock:
-            return {pid: p.body for pid, p in self._pipelines.items()}
+            out = {pid: p.body for pid, p in self._pipelines.items()}
+            out.update(self._quarantined)
+            return out
 
     def sync(self, bodies: Dict[str, Dict[str, Any]]) -> None:
-        """Replace the registry wholesale (cluster state application)."""
-        parsed = {pid: Pipeline(pid, body)
-                  for pid, body in bodies.items()}
+        """Replace the registry wholesale (cluster state application).
+        LENIENT per pipeline: one unparsable body (e.g. published by a
+        different build) quarantines itself, never its siblings."""
+        import logging
+        parsed: Dict[str, Pipeline] = {}
+        quarantined: Dict[str, Dict[str, Any]] = {}
+        for pid, body in bodies.items():
+            try:
+                parsed[pid] = Pipeline(pid, body)
+            except Exception:  # noqa: BLE001 — keep the rest working
+                logging.getLogger("elasticsearch_tpu.ingest").exception(
+                    "pipeline [%s] failed to load; quarantining it", pid)
+                quarantined[pid] = body
         with self._lock:
             self._pipelines = parsed
+            self._quarantined = quarantined

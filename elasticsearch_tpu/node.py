@@ -59,6 +59,11 @@ class Node:
         from elasticsearch_tpu.ingest import IngestService
         self.ingest = IngestService()
         self._load_ingest_pipelines(data_path)
+        import os as _os
+
+        from elasticsearch_tpu.snapshots import RepositoriesService
+        self.repositories = RepositoriesService(
+            _os.path.join(data_path, "_state", "repositories.json"))
         # single-node dynamic cluster settings (cluster mode keeps them
         # in the published ClusterState instead); persistent ones
         # survive restart via the gateway file
@@ -119,16 +124,14 @@ class Node:
             logging.getLogger("elasticsearch_tpu.ingest").error(
                 "could not read persisted ingest pipelines: %s", e)
             return
-        # load individually: one bad pipeline must neither prevent
-        # startup nor silently drop its siblings (which the next
-        # persist would then permanently destroy)
-        for pid, body in bodies.items():
-            try:
-                self.ingest.put(pid, body)
-            except Exception:  # noqa: BLE001 — keep the rest
-                logging.getLogger("elasticsearch_tpu.ingest").exception(
-                    "persisted ingest pipeline [%s] failed to load; "
-                    "skipping it", pid)
+        if not isinstance(bodies, dict):
+            logging.getLogger("elasticsearch_tpu.ingest").error(
+                "persisted ingest pipelines file is not an object; "
+                "ignoring it")
+            return
+        # lenient per pipeline: a bad entry quarantines itself (persist
+        # keeps its body), never prevents startup or drops siblings
+        self.ingest.sync(bodies)
 
     def persist_ingest_pipelines(self) -> None:
         import os
@@ -227,8 +230,10 @@ class Node:
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, cluster, document,
-                                                    ingest, search, tasks)
-        for module in (document, search, admin, cluster, tasks, ingest):
+                                                    ingest, search,
+                                                    snapshots, tasks)
+        for module in (document, search, admin, cluster, tasks, ingest,
+                       snapshots):
             module.register(self.controller, self)
 
     # ---------------- index helpers ----------------

@@ -81,6 +81,8 @@ def _summarize(items: List[Dict[str, Any]], out: Dict[str, Any],
             out["updated"] += 1
         elif result == "deleted":
             out["deleted"] += 1
+        elif result == "noop":
+            out["noops"] += 1  # e.g. a drop processor in the pipeline
         elif result == "not_found":
             out["version_conflicts"] += 1
             if not conflicts_proceed:

@@ -24,8 +24,9 @@ def register(controller: RestController, node) -> None:
             node.cluster.create_index(name, body.get("settings") or {},
                                       mappings)
         else:
-            node.create_index(name, Settings.of(body.get("settings") or {}),
-                              mappings)
+            node.create_index(name, Settings(
+                Settings.normalize_index_settings(
+                    body.get("settings"))), mappings)
         return 200, {"acknowledged": True, "shards_acknowledged": True,
                      "index": name}
 
@@ -113,10 +114,8 @@ def register(controller: RestController, node) -> None:
         body = req.body or {}
         # accepted spellings (all reference forms): {"index": {...}},
         # {"settings": {...}}, flat dotted keys ("index.x" / "x")
-        spec = body.get("settings", body)
-        changes = {}
-        for k, v in Settings._flatten(spec).items():
-            changes[k if k.startswith("index.") else f"index.{k}"] = v
+        changes = Settings.normalize_index_settings(
+            body.get("settings", body))
         if node.cluster is not None:
             for name in node.cluster.resolve_indices(req.param("index")):
                 node.cluster.update_index_settings(name, changes)

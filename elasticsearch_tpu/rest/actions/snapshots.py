@@ -1,0 +1,61 @@
+"""Snapshot/repository REST actions (reference: RestPutRepository
+Action, RestCreateSnapshotAction, RestRestoreSnapshotAction et al —
+SURVEY.md §2.1#43)."""
+
+from __future__ import annotations
+
+from elasticsearch_tpu import snapshots as snap_mod
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+
+
+def register(controller: RestController, node) -> None:
+
+    def put_repo(req: RestRequest):
+        node.repositories.put(req.param("repo"), req.body or {})
+        return 200, {"acknowledged": True}
+
+    def get_repo(req: RestRequest):
+        name = req.param("repo")
+        if name and name not in ("_all", "*"):
+            return 200, {name: node.repositories.get(name)}
+        return 200, node.repositories.all()
+
+    def delete_repo(req: RestRequest):
+        node.repositories.delete(req.param("repo"))
+        return 200, {"acknowledged": True}
+
+    def put_snapshot(req: RestRequest):
+        return 200, snap_mod.create_snapshot(
+            node, req.param("repo"), req.param("snapshot"), req.body)
+
+    def get_snapshot(req: RestRequest):
+        return 200, snap_mod.get_snapshots(
+            node, req.param("repo"), req.param("snapshot") or "_all")
+
+    def snapshot_status(req: RestRequest):
+        return 200, snap_mod.snapshot_status(
+            node, req.param("repo"), req.param("snapshot"))
+
+    def delete_snapshot(req: RestRequest):
+        return 200, snap_mod.delete_snapshot(
+            node, req.param("repo"), req.param("snapshot"))
+
+    def restore(req: RestRequest):
+        return 200, snap_mod.restore_snapshot(
+            node, req.param("repo"), req.param("snapshot"), req.body)
+
+    controller.register("PUT", "/_snapshot/{repo}", put_repo)
+    controller.register("POST", "/_snapshot/{repo}", put_repo)
+    controller.register("GET", "/_snapshot/{repo}", get_repo)
+    controller.register("GET", "/_snapshot", get_repo)
+    controller.register("DELETE", "/_snapshot/{repo}", delete_repo)
+    controller.register("PUT", "/_snapshot/{repo}/{snapshot}",
+                        put_snapshot)
+    controller.register("GET", "/_snapshot/{repo}/{snapshot}",
+                        get_snapshot)
+    controller.register("GET", "/_snapshot/{repo}/{snapshot}/_status",
+                        snapshot_status)
+    controller.register("DELETE", "/_snapshot/{repo}/{snapshot}",
+                        delete_snapshot)
+    controller.register("POST", "/_snapshot/{repo}/{snapshot}/_restore",
+                        restore)

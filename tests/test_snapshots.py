@@ -1,0 +1,171 @@
+"""Snapshot/restore round-trips over fs repositories (reference:
+SnapshotsService + fs blobstore — SURVEY.md §2.1#43, §5.4)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def repo(node, tmp_path):
+    loc = str(tmp_path / "backups")
+    status, _ = _handle(node, "PUT", "/_snapshot/backup", body={
+        "type": "fs", "settings": {"location": loc}})
+    assert status == 200
+    return loc
+
+
+class TestRepositories:
+    def test_crud(self, node, repo):
+        status, res = _handle(node, "GET", "/_snapshot/backup")
+        assert res["backup"]["type"] == "fs"
+        status, _ = _handle(node, "DELETE", "/_snapshot/backup")
+        assert status == 200
+        status, _ = _handle(node, "GET", "/_snapshot/backup")
+        assert status == 404
+
+    def test_non_fs_rejected(self, node):
+        status, _ = _handle(node, "PUT", "/_snapshot/s3repo", body={
+            "type": "s3", "settings": {"bucket": "x"}})
+        assert status == 400
+
+    def test_location_required(self, node):
+        status, _ = _handle(node, "PUT", "/_snapshot/bad", body={
+            "type": "fs"})
+        assert status == 400
+
+    def test_repos_survive_restart(self, tmp_data_path, tmp_path):
+        loc = str(tmp_path / "b2")
+        n1 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        _handle(n1, "PUT", "/_snapshot/keep", body={
+            "type": "fs", "settings": {"location": loc}})
+        n1.close()
+        n2 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            status, res = _handle(n2, "GET", "/_snapshot/keep")
+            assert status == 200
+        finally:
+            n2.close()
+
+
+class TestSnapshotRestore:
+    def _seed(self, node, index="data", n=20):
+        _handle(node, "PUT", f"/{index}", body={
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"tag": {"type": "keyword"},
+                                        "n": {"type": "integer"}}}})
+        for i in range(n):
+            _handle(node, "PUT", f"/{index}/_doc/{i}",
+                    params={"refresh": "true"},
+                    body={"tag": f"t{i % 3}", "n": i})
+
+    def test_snapshot_and_restore_roundtrip(self, node, repo):
+        self._seed(node)
+        status, res = _handle(node, "PUT", "/_snapshot/backup/snap1")
+        assert status == 200, res
+        assert res["snapshot"]["state"] == "SUCCESS"
+        assert res["snapshot"]["indices"] == ["data"]
+        assert res["snapshot"]["shards"]["total"] == 2
+
+        # mutate after the snapshot, then restore under a new name
+        _handle(node, "DELETE", "/data/_doc/0", params={"refresh": "true"})
+        status, res = _handle(node, "POST",
+                              "/_snapshot/backup/snap1/_restore",
+                              body={"rename_pattern": "data",
+                                    "rename_replacement": "restored"})
+        assert status == 200, res
+        assert res["snapshot"]["indices"] == ["restored"]
+        _s, c = _handle(node, "POST", "/restored/_count",
+                        body={"query": {"match_all": {}}})
+        assert c["count"] == 20  # the snapshot still holds doc 0
+        _s, got = _handle(node, "GET", "/restored/_doc/0")
+        assert got["_source"]["n"] == 0
+        # mappings + settings came back
+        _s, idx = _handle(node, "GET", "/restored")
+        assert idx["restored"]["settings"]["index"][
+            "number_of_shards"] == "2"
+        # searches work on the restored index
+        _s, r = _handle(node, "POST", "/restored/_search",
+                        body={"query": {"term": {"tag": "t1"}}})
+        assert r["hits"]["total"]["value"] == 7
+
+    def test_restore_into_existing_name_rejected(self, node, repo):
+        self._seed(node, "busy", 3)
+        _handle(node, "PUT", "/_snapshot/backup/s2")
+        status, _ = _handle(node, "POST",
+                            "/_snapshot/backup/s2/_restore")
+        assert status == 400  # "busy" still exists
+
+    def test_restore_survives_node_restart(self, tmp_data_path,
+                                           tmp_path):
+        loc = str(tmp_path / "b3")
+        n1 = Node(str(tmp_data_path / "n1"), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        _handle(n1, "PUT", "/_snapshot/b", body={
+            "type": "fs", "settings": {"location": loc}})
+        for i in range(5):
+            _handle(n1, "PUT", f"/keep/_doc/{i}",
+                    params={"refresh": "true"}, body={"n": i})
+        _handle(n1, "PUT", "/_snapshot/b/s")
+        n1.close()
+        # a brand-new node restores from the repository alone
+        n2 = Node(str(tmp_data_path / "n2"), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            _handle(n2, "PUT", "/_snapshot/b", body={
+                "type": "fs", "settings": {"location": loc}})
+            status, _ = _handle(n2, "POST", "/_snapshot/b/s/_restore")
+            assert status == 200
+            _s, c = _handle(n2, "POST", "/keep/_count",
+                            body={"query": {"match_all": {}}})
+            assert c["count"] == 5
+        finally:
+            n2.close()
+
+    def test_get_status_delete(self, node, repo):
+        self._seed(node, "x", 2)
+        _handle(node, "PUT", "/_snapshot/backup/gs")
+        status, res = _handle(node, "GET", "/_snapshot/backup/gs")
+        assert res["snapshots"][0]["snapshot"] == "gs"
+        status, res = _handle(node, "GET", "/_snapshot/backup/_all")
+        assert [s["snapshot"] for s in res["snapshots"]] == ["gs"]
+        status, res = _handle(node, "GET",
+                              "/_snapshot/backup/gs/_status")
+        assert res["snapshots"][0]["state"] == "SUCCESS"
+        status, _ = _handle(node, "DELETE", "/_snapshot/backup/gs")
+        assert status == 200
+        status, _ = _handle(node, "GET", "/_snapshot/backup/gs")
+        assert status == 404
+
+    def test_duplicate_snapshot_name_rejected(self, node, repo):
+        self._seed(node, "y", 2)
+        _handle(node, "PUT", "/_snapshot/backup/dup")
+        status, _ = _handle(node, "PUT", "/_snapshot/backup/dup")
+        assert status == 400
+
+    def test_selective_index_snapshot(self, node, repo):
+        self._seed(node, "a1", 2)
+        self._seed(node, "a2", 2)
+        status, res = _handle(node, "PUT", "/_snapshot/backup/partial",
+                              body={"indices": "a1"})
+        assert res["snapshot"]["indices"] == ["a1"]
