@@ -44,9 +44,14 @@ class IndexShard:
         self.primary = primary
         self.primary_term = primary_term
         self._lock = threading.Lock()
-        self.engine = InternalEngine(EngineConfig(
+        config = EngineConfig(
             path=path, mapper=mapper, primary_term=primary_term,
-            durability=durability, k1=k1, b=b))
+            durability=durability, k1=k1, b=b)
+        # EnginePlugin seam: a registered factory may supply the engine;
+        # None (or factory failure) means the default InternalEngine
+        from elasticsearch_tpu.plugins import REGISTRY
+        self.engine = REGISTRY.create_engine(config) \
+            or InternalEngine(config)
         self.tracker: Optional[ReplicationTracker] = (
             ReplicationTracker(allocation_id) if primary else None)
         if self.tracker is not None:

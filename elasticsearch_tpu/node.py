@@ -43,6 +43,11 @@ class Node:
         # Owner-scoped so two embedded nodes don't reset each other.
         from elasticsearch_tpu.common.logging import configure
         configure(self.settings, owner=id(self))
+        # plugins load BEFORE any service that consults their
+        # registries (queries, processors, analyzers, engine factory)
+        from elasticsearch_tpu.plugins import REGISTRY as _plugins
+        _plugins.load_from_settings(self.settings)
+        self.plugins = _plugins
         self.node_name = node_name
         self.node_id = _load_or_create_node_id(data_path, node_name)
         self.cluster_name = cluster_name
@@ -235,6 +240,7 @@ class Node:
         for module in (document, search, admin, cluster, tasks, ingest,
                        snapshots):
             module.register(self.controller, self)
+        self.plugins.install_rest_handlers(self.controller, self)
 
     # ---------------- index helpers ----------------
 

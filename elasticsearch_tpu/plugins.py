@@ -1,0 +1,141 @@
+"""Plugin/extension seam.
+
+Reference: `plugins/Plugin` + the typed plugin interfaces —
+`SearchPlugin#getQueries`/`#getAggregations`, `IngestPlugin#
+getProcessors`, `AnalysisPlugin#getAnalyzers`, `ActionPlugin#
+getRestHandlers`, `EnginePlugin#getEngineFactory` (SURVEY.md §2.1#3,
+L9). Kept contract: a plugin is discovered from node settings
+(`plugins.modules` — a comma-separated list of importable python
+modules, the loadable-module analog of the reference's plugin
+directory), exposes one `setup(registry)` entry point, and registers
+extensions through typed methods; registration happens once at node
+construction, before any request is served.
+
+Custom QUERY types plug into the dense-mask executor by implementing
+`evaluate(executor, scoring) -> (mask, score)` on their AST node —
+the planner calls it for any node class it doesn't own (the
+QueryShardContext#toQuery seam, tpu-shaped).
+
+The ENGINE factory is the reference's defining extension point: when
+registered, every newly created shard asks it for an engine
+(`factory(config) -> engine | None`, None ⇒ the default
+InternalEngine) — an engine swap must preserve behavior, never error
+(the r2 verdict's EnginePlugin contract).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("elasticsearch_tpu.plugins")
+
+
+class PluginRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.loaded_modules: List[str] = []
+        self.engine_factory: Optional[Callable] = None
+        # (method, path, handler(req, node) -> (status, body))
+        self.rest_handlers: List[Tuple[str, str, Callable]] = []
+
+    # ---------------- typed registration (plugin-facing) -------------
+
+    def register_query(self, name: str, parser: Callable) -> None:
+        """parser(body) -> QueryNode; the node class implements
+        evaluate(executor, scoring) (SearchPlugin#getQueries)."""
+        from elasticsearch_tpu.search import dsl
+        if name in dsl._PARSERS:
+            raise ValueError(f"query [{name}] is already registered")
+        dsl._PARSERS[name] = parser
+
+    def register_aggregation(self, name: str, parser: Callable) -> None:
+        """parser(name, body, sub) -> Aggregator
+        (SearchPlugin#getAggregations)."""
+        from elasticsearch_tpu.search.aggregations import base
+        if name in base._PARSERS or name in base._PIPELINE_PARSERS:
+            raise ValueError(
+                f"aggregation [{name}] is already registered")
+        base._PARSERS[name] = parser
+
+    def register_processor(self, cls) -> None:
+        """cls: an ingest.Processor subclass with `type_name`
+        (IngestPlugin#getProcessors)."""
+        from elasticsearch_tpu import ingest
+        if cls.type_name in ingest._PROCESSORS:
+            raise ValueError(
+                f"processor [{cls.type_name}] is already registered")
+        ingest._PROCESSORS[cls.type_name] = cls
+
+    def register_analyzer(self, name: str, analyzer_cls) -> None:
+        """analyzer_cls() -> Analyzer (AnalysisPlugin#getAnalyzers)."""
+        from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+        if name in AnalysisRegistry.BUILTIN:
+            raise ValueError(f"analyzer [{name}] is already registered")
+        AnalysisRegistry.BUILTIN[name] = analyzer_cls
+
+    def register_rest_handler(self, method: str, path: str,
+                              handler: Callable) -> None:
+        """handler(req, node) -> (status, body)
+        (ActionPlugin#getRestHandlers)."""
+        self.rest_handlers.append((method, path, handler))
+
+    def register_engine_factory(self, factory: Callable) -> None:
+        """factory(EngineConfig) -> engine | None
+        (EnginePlugin#getEngineFactory); at most one may register."""
+        if self.engine_factory is not None:
+            raise ValueError("an engine factory is already registered")
+        self.engine_factory = factory
+
+    # ---------------- node-facing ----------------
+
+    def load_from_settings(self, settings) -> None:
+        modules = [m.strip() for m in
+                   str(settings.get("plugins.modules", "")).split(",")
+                   if m.strip()]
+        for mod_name in modules:
+            with self._lock:
+                if mod_name in self.loaded_modules:
+                    continue  # process-global registries: load once
+            module = importlib.import_module(mod_name)
+            setup = getattr(module, "setup", None)
+            if setup is None:
+                raise ValueError(
+                    f"plugin module [{mod_name}] has no setup(registry)")
+            setup(self)
+            # marked loaded only AFTER a successful setup: a failed load
+            # must raise again on the next attempt, never silently skip
+            with self._lock:
+                self.loaded_modules.append(mod_name)
+            logger.info("loaded plugin [%s]", mod_name)
+
+    def install_rest_handlers(self, controller, node) -> None:
+        for method, path, handler in self.rest_handlers:
+            def bound(req, _h=handler):
+                return _h(req, node)
+            try:
+                controller.register(method, path, bound)
+            except Exception:  # noqa: BLE001 — collisions with builtins
+                logger.exception(
+                    "plugin REST handler %s %s could not register",
+                    method, path)
+
+    def create_engine(self, config):
+        """→ the plugin engine for this shard, or None for the default
+        InternalEngine. A factory error degrades to the default engine —
+        an extension must never take indexing down."""
+        if self.engine_factory is None:
+            return None
+        try:
+            return self.engine_factory(config)
+        except Exception:  # noqa: BLE001 — EnginePlugin contract
+            logger.exception("plugin engine factory failed; using the "
+                             "default engine")
+            return None
+
+
+# process-global, like the reference's plugin service (plugins install
+# parsers/processors into process-wide registries)
+REGISTRY = PluginRegistry()

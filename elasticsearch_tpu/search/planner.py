@@ -140,6 +140,10 @@ class SegmentQueryExecutor:
                 scoring, constant=False)
         if isinstance(node, dsl.FunctionScoreQuery):
             return self._eval_function_score(node, scoring)
+        if hasattr(node, "evaluate"):
+            # plugin-registered query types evaluate themselves against
+            # the executor (SearchPlugin#getQueries seam)
+            return node.evaluate(self, scoring)
         raise QueryShardException(f"unsupported query [{node.query_name()}]")
 
     def _eval_multi_match(self, node: dsl.MultiMatchQuery, scoring: bool):

@@ -139,6 +139,45 @@ def list_snapshots(location: str) -> List[str]:
                   if os.path.exists(_manifest_path(location, n)))
 
 
+def _copy_shard_commit(src: str, dst: str, retries: int = 5) -> None:
+    """Copy one shard's committed store into the repository from a
+    STABLE commit: read the manifest bytes once, copy exactly the files
+    it names, and write those same bytes last. If a concurrent flush +
+    merge deletes a referenced file mid-copy, retry against the fresh
+    commit — a snapshot must never be marked SUCCESS with files its own
+    manifest can't resolve."""
+    commit_path = os.path.join(src, "commit.json")
+    last_err: Optional[Exception] = None
+    for _attempt in range(retries):
+        if not os.path.exists(commit_path):
+            return  # empty shard: nothing committed yet
+        with open(commit_path, "rb") as f:
+            commit_bytes = f.read()
+        commit = json.loads(commit_bytes.decode("utf-8"))
+        seg_dir = os.path.join(src, "segments")
+        os.makedirs(os.path.join(dst, "segments"), exist_ok=True)
+        try:
+            for seg_name in commit.get("segments", []):
+                for ext in (".npz", ".json"):
+                    p = os.path.join(seg_dir, seg_name + ext)
+                    if os.path.exists(p):
+                        shutil.copy2(p, os.path.join(
+                            dst, "segments", seg_name + ext))
+                    elif ext == ".npz":
+                        # the manifest references it: it was merged away
+                        # underneath us — retry with the new commit
+                        raise FileNotFoundError(p)
+        except FileNotFoundError as e:
+            last_err = e
+            continue
+        # the saved bytes (not the live file, which may have moved on)
+        write_atomic(os.path.join(dst, "commit.json"), commit_bytes)
+        return
+    raise EsException(
+        f"shard store at [{src}] kept changing during snapshot "
+        f"({retries} attempts): {last_err}")
+
+
 def create_snapshot(node, repo_name: str, snapshot: str,
                     body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     from elasticsearch_tpu.search import scroll as scroll_mod
@@ -168,21 +207,7 @@ def create_snapshot(node, repo_name: str, snapshot: str,
             src = os.path.join(svc.data_path, str(shard_num))
             dst = os.path.join(idx_dir, str(shard_num))
             os.makedirs(dst, exist_ok=True)
-            commit_path = os.path.join(src, "commit.json")
-            if os.path.exists(commit_path):
-                with open(commit_path, "rb") as f:
-                    commit = json.loads(f.read().decode("utf-8"))
-                seg_dir = os.path.join(src, "segments")
-                os.makedirs(os.path.join(dst, "segments"), exist_ok=True)
-                for seg_name in commit.get("segments", []):
-                    for ext in (".npz", ".json"):
-                        p = os.path.join(seg_dir, seg_name + ext)
-                        if os.path.exists(p):
-                            shutil.copy2(p, os.path.join(
-                                dst, "segments", seg_name + ext))
-                # the manifest goes last — it names only copied files
-                shutil.copy2(commit_path,
-                             os.path.join(dst, "commit.json"))
+            _copy_shard_commit(src, dst)
             total_shards += 1
         indices_meta[name] = {
             "settings": svc.settings.get_as_dict(),
@@ -277,7 +302,9 @@ def restore_snapshot(node, repo_name: str, snapshot: str,
 
     pattern = body.get("rename_pattern")
     replacement = body.get("rename_replacement")
-    restored = []
+    # validate EVERY target before creating anything: a mid-loop
+    # failure must not leave a half-restored set behind
+    targets: Dict[str, str] = {}
     for name in names:
         target = (re.sub(pattern, replacement, name)
                   if pattern is not None and replacement is not None
@@ -286,6 +313,13 @@ def restore_snapshot(node, repo_name: str, snapshot: str,
             raise IndexAlreadyExistsException(
                 f"cannot restore index [{target}]: an open index with "
                 f"the same name already exists")
+        if target in targets.values():
+            raise IllegalArgumentException(
+                f"rename maps two snapshot indices onto [{target}]")
+        targets[name] = target
+    restored = []
+    for name in names:
+        target = targets[name]
         meta = indices_meta[name]
         svc = node.indices.create_index(
             target, Settings.of(meta["settings"]), meta["mapping"],

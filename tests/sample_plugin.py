@@ -1,0 +1,76 @@
+"""A full-surface sample plugin used by tests/test_plugins.py — the
+shape a third-party extension ships: one module, one setup(registry)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ingest import Processor, get_field, set_field
+from elasticsearch_tpu.search import dsl
+
+
+@dataclasses.dataclass
+class EvenDocsQuery(dsl.QueryNode):
+    """Matches docs whose integer `field` value is even — exercises the
+    plugin-query evaluate() seam against the dense-mask executor."""
+
+    field: str = ""
+
+    def query_name(self) -> str:
+        return "even_docs"
+
+    def evaluate(self, executor, scoring):
+        pack = executor.view.pack
+        if self.field in pack.dv_i64:
+            vals = jnp.asarray(pack.dv_i64[self.field])
+            mask = (vals % 2) == 0
+        else:
+            mask = jnp.zeros(executor.d_pad, dtype=bool)
+        score = jnp.where(mask, self.boost if scoring else 0.0,
+                          0.0).astype(jnp.float32)
+        return mask, score
+
+
+def _parse_even_docs(body):
+    return EvenDocsQuery(field=str(body["field"]),
+                         boost=float(body.get("boost", 1.0)))
+
+
+class ReverseProcessor(Processor):
+    type_name = "reverse"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+
+    def process(self, doc):
+        value = get_field(doc, self.field)
+        if isinstance(value, str):
+            set_field(doc, self.field, value[::-1])
+
+
+def _hello_handler(req, node):
+    return 200, {"hello": node.node_name,
+                 "plugin": "sample_plugin"}
+
+
+class MarkedEngine:
+    """Engine factory marker: wraps the default engine untouched so the
+    test can observe the seam fired without changing behavior."""
+
+
+def _engine_factory(config):
+    from elasticsearch_tpu.index.engine import InternalEngine
+    engine = InternalEngine(config)
+    engine.created_by_plugin = True
+    return engine
+
+
+def setup(registry):
+    registry.register_query("even_docs", _parse_even_docs)
+    registry.register_processor(ReverseProcessor)
+    registry.register_rest_handler("GET", "/_sample/hello",
+                                   _hello_handler)
+    registry.register_engine_factory(_engine_factory)

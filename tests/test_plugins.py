@@ -1,0 +1,125 @@
+"""Plugin seam: queries, processors, REST handlers, engine factory
+loaded from plugins.modules (reference: Plugin + SearchPlugin/
+IngestPlugin/ActionPlugin/EnginePlugin — SURVEY.md §2.1#3, L9)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registries():
+    """Plugins install into process-global registries; snapshot and
+    restore them so this module can't leak registrations (especially
+    the engine factory) into the rest of the suite."""
+    from elasticsearch_tpu import ingest
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+    from elasticsearch_tpu.plugins import REGISTRY
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.aggregations import base
+    saved = (dict(dsl._PARSERS), dict(base._PARSERS),
+             dict(base._PIPELINE_PARSERS), dict(ingest._PROCESSORS),
+             dict(AnalysisRegistry.BUILTIN),
+             REGISTRY.engine_factory, list(REGISTRY.rest_handlers),
+             list(REGISTRY.loaded_modules))
+    try:
+        yield
+    finally:
+        (dsl_p, base_p, pipe_p, proc, builtin, eng, rest,
+         loaded) = saved
+        dsl._PARSERS.clear(); dsl._PARSERS.update(dsl_p)
+        base._PARSERS.clear(); base._PARSERS.update(base_p)
+        base._PIPELINE_PARSERS.clear()
+        base._PIPELINE_PARSERS.update(pipe_p)
+        ingest._PROCESSORS.clear(); ingest._PROCESSORS.update(proc)
+        AnalysisRegistry.BUILTIN.clear()
+        AnalysisRegistry.BUILTIN.update(builtin)
+        REGISTRY.engine_factory = eng
+        REGISTRY.rest_handlers = rest
+        REGISTRY.loaded_modules = loaded
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({
+                 "search.tpu_serving.enabled": "false",
+                 "plugins.modules": "tests.sample_plugin"}))
+    yield n
+    n.close()
+
+
+def test_plugin_query_executes(node):
+    _handle(node, "PUT", "/p", body={"mappings": {"properties": {
+        "n": {"type": "integer"}}}})
+    for i in range(10):
+        _handle(node, "PUT", f"/p/_doc/{i}", params={"refresh": "true"},
+                body={"n": i})
+    status, res = _handle(node, "POST", "/p/_search", body={
+        "query": {"even_docs": {"field": "n"}}, "size": 20})
+    assert status == 200, res
+    assert res["hits"]["total"]["value"] == 5
+    assert {h["_source"]["n"] % 2 for h in res["hits"]["hits"]} == {0}
+    # composes inside bool like any built-in query
+    status, res = _handle(node, "POST", "/p/_search", body={
+        "query": {"bool": {"filter": [{"even_docs": {"field": "n"}},
+                                      {"range": {"n": {"gte": 4}}}]}}})
+    assert res["hits"]["total"]["value"] == 3  # 4, 6, 8
+
+
+def test_plugin_processor(node):
+    _handle(node, "PUT", "/_ingest/pipeline/rev", body={
+        "processors": [{"reverse": {"field": "w"}}]})
+    _handle(node, "PUT", "/r/_doc/1",
+            params={"pipeline": "rev", "refresh": "true"},
+            body={"w": "abc"})
+    _s, got = _handle(node, "GET", "/r/_doc/1")
+    assert got["_source"]["w"] == "cba"
+
+
+def test_plugin_rest_handler(node):
+    status, res = _handle(node, "GET", "/_sample/hello")
+    assert status == 200
+    assert res["plugin"] == "sample_plugin"
+
+
+def test_plugin_engine_factory(node):
+    _handle(node, "PUT", "/e/_doc/1", params={"refresh": "true"},
+            body={"x": 1})
+    shard = node.indices.index("e").shards[0]
+    assert getattr(shard.engine, "created_by_plugin", False)
+    # behavior preserved: normal search works on the plugin engine
+    status, res = _handle(node, "POST", "/e/_search",
+                          body={"query": {"match_all": {}}})
+    assert res["hits"]["total"]["value"] == 1
+
+
+def test_unknown_plugin_module_fails_startup(tmp_data_path):
+    with pytest.raises(ModuleNotFoundError):
+        Node(str(tmp_data_path), settings=Settings.of({
+            "plugins.modules": "no.such.plugin_module"}))
+
+
+def test_pluginless_node_unaffected(tmp_data_path):
+    n = Node(str(tmp_data_path), settings=Settings.of(
+        {"search.tpu_serving.enabled": "false"}))
+    try:
+        # the sample plugin's registrations are process-global by design
+        # (like the reference); a plugin-less node still serves normally
+        _handle(n, "PUT", "/q/_doc/1", params={"refresh": "true"},
+                body={"m": "hi"})
+        status, res = _handle(n, "POST", "/q/_search",
+                              body={"query": {"match": {"m": "hi"}}})
+        assert res["hits"]["total"]["value"] == 1
+    finally:
+        n.close()

@@ -47,7 +47,7 @@ def make_corpus(svc, seeded_np, *, name="corpus", shards=2, docs=120,
 
 def both_paths(svc, name, body):
     """Run the same search through the kernel path and the planner path."""
-    tpu = TpuSearchService(window_s=0.0)
+    tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
     try:
         fast = coordinator.search(svc, name, dict(body), tpu_search=tpu)
         assert tpu.served > 0, "query did not take the kernel path"
@@ -165,7 +165,7 @@ class TestEquivalence:
         make_corpus(svc, seeded_np)
         body = {"query": {"match": {"body": "alpha beta"}},
                 "min_score": 1.0, "size": 50}
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             res = coordinator.search(svc, "corpus", dict(body),
                                      tpu_search=tpu)
@@ -195,7 +195,7 @@ class TestEquivalence:
 class TestFallback:
     def test_unsupported_shapes_use_planner(self, svc, seeded_np):
         make_corpus(svc, seeded_np)
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             out = coordinator.search(
                 svc, "corpus",
@@ -216,7 +216,7 @@ class TestFallback:
 
     def test_pack_rebuilds_after_refresh(self, svc, seeded_np):
         idx = make_corpus(svc, seeded_np, docs=40)
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             r1 = tpu.packs.get(idx, "body")
             r2 = tpu.packs.get(idx, "body")
@@ -275,7 +275,7 @@ class TestReviewFindings:
         """msm counts clauses; a multi-term match clause breaks the
         clause==term identity, so the planner must serve it."""
         make_corpus(svc, seeded_np)
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             coordinator.search(
                 svc, "corpus",
@@ -316,7 +316,7 @@ class TestReviewFindings:
 
     def test_submit_after_close_falls_back(self, svc, seeded_np):
         idx = make_corpus(svc, seeded_np, docs=20)
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         tpu.close()
         import time as _t
         _t.sleep(0.05)
@@ -333,7 +333,7 @@ class TestReviewFindings:
         monkeypatch.setattr(
             tpu_service, "execute_flat_batch",
             lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             out = coordinator.search(
                 svc, "corpus", {"query": {"match": {"body": "alpha"}}},
@@ -350,7 +350,7 @@ class TestReviewFindings:
         the planner immediately; one probe per cooldown re-tests the path."""
         from concurrent.futures import Future
         idx = make_corpus(svc, seeded_np, docs=20)
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             q = dsl.MatchQuery(field="body", query="alpha")
             hung: Future = Future()  # never resolved → FuturesTimeout
@@ -409,7 +409,7 @@ class TestBlockMaxPruning:
         self._dense_corpus(svc, seeded_np)
         monkeypatch.setattr(tpu_service, "PREFIX_CAP", cap)
         body = {"query": {"match": {"body": "common rare"}}, "size": 20}
-        tpu = tpu_service.TpuSearchService(window_s=0.0)
+        tpu = tpu_service.TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             fast = coordinator.search(svc, "dense", dict(body),
                                       tpu_search=tpu)
@@ -435,7 +435,7 @@ class TestBlockMaxPruning:
         self._dense_corpus(svc, seeded_np)
         monkeypatch.setattr(tpu_service, "PREFIX_CAP", 1)
         body = {"query": {"match": {"body": "common"}}, "size": 300}
-        tpu = tpu_service.TpuSearchService(window_s=0.0)
+        tpu = tpu_service.TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             fast = coordinator.search(svc, "dense", dict(body),
                                       tpu_search=tpu)
@@ -451,7 +451,7 @@ class TestBlockMaxPruning:
         from elasticsearch_tpu.parallel import distributed as dist
         idx = self._dense_corpus(svc, seeded_np, docs=100)
         from elasticsearch_tpu.search.tpu_service import TpuSearchService
-        tpu = TpuSearchService(window_s=0.0)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             resident = tpu.packs.get(idx, "body")
             pack = resident.pack
