@@ -68,6 +68,7 @@ ACTION_DELETE_INDEX = "cluster/admin/delete_index"
 ACTION_PUT_MAPPING = "cluster/admin/put_mapping"
 ACTION_UPDATE_INDEX_SETTINGS = "cluster/admin/update_index_settings"
 ACTION_UPDATE_CLUSTER_SETTINGS = "cluster/admin/update_cluster_settings"
+ACTION_UPDATE_ALIASES = "cluster/admin/update_aliases"
 ACTION_PUT_PIPELINE = "cluster/admin/put_pipeline"
 ACTION_DELETE_PIPELINE = "cluster/admin/delete_pipeline"
 
@@ -250,6 +251,7 @@ class ClusterService:
                  self._handle_update_index_settings),
                 (ACTION_UPDATE_CLUSTER_SETTINGS,
                  self._handle_update_cluster_settings),
+                (ACTION_UPDATE_ALIASES, self._handle_update_aliases),
                 (ACTION_PUT_PIPELINE, self._handle_put_pipeline),
                 (ACTION_DELETE_PIPELINE, self._handle_delete_pipeline),
                 (ACTION_SHARD_STARTED, self._handle_shard_started),
@@ -742,6 +744,72 @@ class ClusterService:
                 "persistent": state.persistent_settings,
                 "transient": state.transient_settings}
 
+    def _handle_update_aliases(self, payload, from_node
+                               ) -> Dict[str, Any]:
+        from elasticsearch_tpu.indices.service import parse_alias_action
+        parsed = [parse_alias_action(a)
+                  for a in (payload.get("actions") or [])]
+
+        def update(state: ClusterState) -> ClusterState:
+            import dataclasses as _dc
+            import fnmatch as _fn
+            new_indices = dict(state.indices)
+            for kind, idx_expr, alias, props in parsed:
+                matched = ([n for n in new_indices
+                            if _fn.fnmatchcase(n, idx_expr)]
+                           if ("*" in idx_expr or "?" in idx_expr)
+                           else [idx_expr])
+                for name in matched:
+                    meta = new_indices.get(name)
+                    if meta is None:
+                        raise IndexNotFoundException(
+                            f"no such index [{name}]")
+                    aliases = dict(meta.aliases)
+                    if kind == "add":
+                        if alias in new_indices:
+                            raise IllegalArgumentException(
+                                f"alias [{alias}] clashes with an "
+                                f"index name")
+                        aliases[alias] = dict(props)
+                    else:  # remove
+                        if alias not in aliases:
+                            from elasticsearch_tpu.common.errors import \
+                                ResourceNotFoundException
+                            raise ResourceNotFoundException(
+                                f"aliases [{alias}] missing on "
+                                f"[{name}]")
+                        del aliases[alias]
+                    new_indices[name] = _dc.replace(meta,
+                                                    aliases=aliases)
+            return state.with_updates(indices=new_indices)
+
+        self._run_master_update(update, source="update-aliases")
+        return {"acknowledged": True}
+
+    def update_aliases(self, actions: List[dict]) -> dict:
+        from elasticsearch_tpu.indices.service import parse_alias_action
+        parsed = [parse_alias_action(a) for a in actions]
+        result = self._call_master(ACTION_UPDATE_ALIASES,
+                                   {"actions": actions})
+
+        def applied(state: ClusterState) -> bool:
+            # semantic read-your-writes: each exact-name action is
+            # observable in the applied metadata (wildcards pass — the
+            # master already validated and committed them)
+            view = self._StateView(state)
+            for kind, idx_expr, alias, _props in parsed:
+                if "*" in idx_expr or "?" in idx_expr:
+                    continue
+                targets = view.aliases.get(alias, {})
+                if kind == "add" and idx_expr not in targets:
+                    return False
+                if kind == "remove" and idx_expr in targets:
+                    return False
+            return True
+
+        self.wait_for_applied(applied, timeout=10.0)
+        return result
+
     def _handle_put_pipeline(self, payload, from_node) -> Dict[str, Any]:
         pipeline_id = payload["id"]
         body = payload["body"]
@@ -855,7 +923,9 @@ class ClusterService:
                            self._handle_update_cluster_settings,
                        ACTION_PUT_PIPELINE: self._handle_put_pipeline,
                        ACTION_DELETE_PIPELINE:
-                           self._handle_delete_pipeline}[action]
+                           self._handle_delete_pipeline,
+                       ACTION_UPDATE_ALIASES:
+                           self._handle_update_aliases}[action]
             return handler(payload, self.local_node.to_json())
         try:
             return self.transport.send_request(addr, action, payload,
@@ -904,6 +974,7 @@ class ClusterService:
     def route_doc_op(self, op: str, index: str, doc_id: Optional[str],
                      body, params: Dict[str, str]) -> Tuple[int, Dict]:
         from elasticsearch_tpu.indices.service import shard_for
+        index = self.resolve_write_index(index)
         if op in ("index", "create", "update"):
             meta = self._ensure_index(index)
         else:
@@ -1003,11 +1074,14 @@ class ClusterService:
         groups: Dict[str, List[Tuple[int, Dict[str, Any]]]] = {}
         items: List[Optional[Dict[str, Any]]] = [None] * len(ops)
         addr_of: Dict[str, Tuple[str, int]] = {}
+        alias_view = self._StateView(self.applied_state())
         for pos, entry in enumerate(ops):
             try:
                 index = entry["index"]
                 if index is None:
                     raise IllegalArgumentException("_index is missing")
+                index = self.resolve_write_index(index, alias_view)
+                entry = dict(entry, index=index)
                 meta = self._ensure_index(index)
                 shard = shard_for(entry.get("routing") or entry["id"],
                                   meta.number_of_shards)
@@ -1071,26 +1145,36 @@ class ClusterService:
     # search routing (query_then_fetch across nodes)
     # ------------------------------------------------------------------
 
+    class _StateView:
+        """Duck-typed shim so coordinator.resolve_targets works over the
+        CLUSTER metadata exactly as it does over a local registry."""
+
+        def __init__(self, state: ClusterState):
+            self.indices = state.indices
+            self.aliases: Dict[str, Dict[str, Dict[str, Any]]] = {}
+            for name, meta in state.indices.items():
+                for alias, props in (meta.aliases or {}).items():
+                    self.aliases.setdefault(alias, {})[name] = props
+
+    def resolve_targets(self, expression: Optional[str]
+                        ) -> Tuple[List[str], Dict[str, List[dict]]]:
+        from elasticsearch_tpu.search.coordinator import resolve_targets
+        return resolve_targets(self._StateView(self.applied_state()),
+                               expression)
+
     def resolve_indices(self, expression: Optional[str]) -> List[str]:
-        import fnmatch
-        state = self.applied_state()
-        names = sorted(state.indices.keys())
-        if expression in (None, "", "_all", "*"):
-            return names
-        out: List[str] = []
-        for part in expression.split(","):
-            part = part.strip()
-            if not part:
-                continue
-            if "*" in part or "?" in part:
-                out.extend(m for m in fnmatch.filter(names, part)
-                           if m not in out)
-            else:
-                if part not in names:
-                    raise IndexNotFoundException(f"no such index [{part}]")
-                if part not in out:
-                    out.append(part)
-        return out
+        return self.resolve_targets(expression)[0]
+
+    def resolve_write_index(self, name: str, view=None) -> str:
+        """Pass a prebuilt _StateView on hot loops (bulk) so the alias
+        inversion is built once per request, not per op."""
+        from elasticsearch_tpu.indices.service import select_write_index
+        if view is None:
+            view = self._StateView(self.applied_state())
+        entry = view.aliases.get(name)
+        if entry is None:
+            return name
+        return select_write_index(entry, name)
 
     def _route_shards(self, names: List[str]
                       ) -> Tuple[Dict[str, List[Tuple[str, int]]],
@@ -1123,7 +1207,7 @@ class ClusterService:
                      task=None) -> Dict[str, Any]:
         from elasticsearch_tpu.search import coordinator as coord
         t0 = time.perf_counter()
-        names = self.resolve_indices(index_expr)
+        names, alias_filters = self.resolve_targets(index_expr)
         # validates the body once on the coordinating node (400 before
         # any fan-out, reference behavior)
         coord.parse_search_body(body or {})
@@ -1137,14 +1221,16 @@ class ClusterService:
                 continue
             fut = self.transport.send_request_async(
                 addr[node_id], ACTION_QUERY_GROUP,
-                {"targets": targets, "body": body, "params": params})
+                {"targets": targets, "body": body, "params": params,
+                 "index_filters": alias_filters})
             futures.append((node_id, fut))
 
         groups: List[Dict[str, Any]] = []
         if local_targets is not None:
             groups.append(coord.search_shard_group(
                 self.node.indices, local_targets, body, params,
-                tpu_search=self.node.tpu_search))
+                tpu_search=self.node.tpu_search,
+                index_filters=alias_filters))
         for node_id, fut in futures:
             if task is not None:
                 task.ensure_not_cancelled()
@@ -1163,12 +1249,13 @@ class ClusterService:
         targets = [(t[0], int(t[1])) for t in payload["targets"]]
         return coord.search_shard_group(
             self.node.indices, targets, payload.get("body"),
-            payload.get("params"), tpu_search=self.node.tpu_search)
+            payload.get("params"), tpu_search=self.node.tpu_search,
+            index_filters=payload.get("index_filters"))
 
     def route_count(self, index_expr: Optional[str],
                     body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         from elasticsearch_tpu.search import dsl
-        names = self.resolve_indices(index_expr)
+        names, alias_filters = self.resolve_targets(index_expr)
         dsl.parse_query((body or {}).get("query") or {"match_all": {}})
         by_node, addr, failed = self._route_shards(names)
         total = 0
@@ -1181,10 +1268,12 @@ class ClusterService:
                 continue
             futures.append((len(targets), self.transport.send_request_async(
                 addr[node_id], ACTION_COUNT_GROUP,
-                {"targets": targets, "body": body})))
+                {"targets": targets, "body": body,
+                 "index_filters": alias_filters})))
         if local_targets is not None:
             res = self._handle_count_group(
-                {"targets": local_targets, "body": body},
+                {"targets": local_targets, "body": body,
+                 "index_filters": alias_filters},
                 self.local_node.to_json())
             total += res["count"]
             ok_shards += res["shards"]
@@ -1203,15 +1292,19 @@ class ClusterService:
 
     def _handle_count_group(self, payload, from_node) -> Dict[str, Any]:
         from elasticsearch_tpu.search import dsl
+        from elasticsearch_tpu.search.coordinator import \
+            with_alias_filters
         from elasticsearch_tpu.search.query_phase import execute_query
         query = dsl.parse_query(
             (payload.get("body") or {}).get("query") or {"match_all": {}})
+        index_filters = payload.get("index_filters") or {}
         total = 0
         n = 0
         for name, shard_num in [(t[0], int(t[1]))
                                 for t in payload["targets"]]:
             shard = self.node.indices.index(name).shard(shard_num)
-            res = execute_query(shard.acquire_searcher(), query, size=0)
+            eff = with_alias_filters(query, index_filters.get(name))
+            res = execute_query(shard.acquire_searcher(), eff, size=0)
             total += res.total_hits
             n += 1
         return {"count": total, "shards": n}

@@ -80,6 +80,10 @@ class IndexMeta:
     number_of_replicas: int
     # shard (as str for JSON) → allocation ids that completed recovery
     in_sync: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    # alias name → props ({"filter": ..., "is_write_index": ...});
+    # reference: IndexMetadata#getAliases
+    aliases: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -92,7 +96,8 @@ class IndexMeta:
                          number_of_shards=int(d["number_of_shards"]),
                          number_of_replicas=int(d["number_of_replicas"]),
                          in_sync={k: list(v) for k, v in
-                                  (d.get("in_sync") or {}).items()})
+                                  (d.get("in_sync") or {}).items()},
+                         aliases=dict(d.get("aliases") or {}))
 
 
 @dataclasses.dataclass(frozen=True)

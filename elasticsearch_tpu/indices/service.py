@@ -72,6 +72,52 @@ def shard_for(routing: str, num_shards: int) -> int:
     return murmur3_hash(routing) % num_shards
 
 
+def select_write_index(targets: Dict[str, Dict[str, Any]],
+                       alias: str) -> str:
+    """The index a WRITE through this alias lands on (reference:
+    AliasOrIndex#getWriteIndex): the single is_write_index target, or
+    the sole target of a single-index alias. Shared by the single-node
+    registry and the cluster metadata view."""
+    writers = [i for i, p in targets.items()
+               if (p or {}).get("is_write_index")]
+    if len(writers) == 1:
+        return writers[0]
+    if len(targets) == 1 and not writers:
+        return next(iter(targets))
+    raise IllegalArgumentException(
+        f"no write index is defined for alias [{alias}]: an alias "
+        f"over multiple indices needs exactly one is_write_index")
+
+
+def parse_alias_action(action: Dict[str, Any]
+                       ) -> tuple:
+    """Validate one _aliases action → (kind, index_expr, alias, props).
+    Shared by the single-node path and the cluster master handler so
+    grammar and validation can't drift."""
+    if not isinstance(action, dict) or len(action) != 1:
+        raise IllegalArgumentException(
+            "[aliases] each action is one {add|remove: {...}} object")
+    kind, spec = next(iter(action.items()))
+    if kind not in ("add", "remove"):
+        raise IllegalArgumentException(
+            f"[aliases] unknown action [{kind}]")
+    idx_expr = spec.get("index")
+    alias = spec.get("alias")
+    if not idx_expr or not alias:
+        raise IllegalArgumentException(
+            f"[aliases] {kind} requires [index] and [alias]")
+    props: Dict[str, Any] = {}
+    if kind == "add":
+        _validate_index_name(alias)
+        if spec.get("filter") is not None:
+            from elasticsearch_tpu.search import dsl
+            dsl.parse_query(spec["filter"])  # validate at request time
+            props["filter"] = spec["filter"]
+        if spec.get("is_write_index"):
+            props["is_write_index"] = True
+    return kind, idx_expr, alias, props
+
+
 class IndexService:
     """One open index on this node: settings, mapper, local shards."""
 
@@ -178,6 +224,9 @@ class IndicesService:
         self.data_path = data_path
         self._lock = threading.Lock()
         self.indices: Dict[str, IndexService] = {}
+        # alias → index → props ({"filter": query-json,
+        # "is_write_index": bool}); reference: AliasMetadata
+        self.aliases: Dict[str, Dict[str, Dict[str, Any]]] = {}
         self._load_metadata()
 
     # -------- gateway metadata (survives restart) --------
@@ -186,10 +235,13 @@ class IndicesService:
         return os.path.join(self.data_path, "_state", "indices.json")
 
     def _persist_metadata_locked(self) -> None:
-        meta = {name: {"uuid": svc.index_uuid,
-                       "settings": svc.settings.get_as_dict(),
-                       "mapping": svc.mapper.to_mapping()}
-                for name, svc in self.indices.items()}
+        meta = {
+            "indices": {name: {"uuid": svc.index_uuid,
+                               "settings": svc.settings.get_as_dict(),
+                               "mapping": svc.mapper.to_mapping()}
+                        for name, svc in self.indices.items()},
+            "aliases": self.aliases,
+        }
         os.makedirs(os.path.dirname(self._state_path()), exist_ok=True)
         write_atomic(self._state_path(),
                      json.dumps(meta, sort_keys=True).encode("utf-8"))
@@ -205,6 +257,10 @@ class IndicesService:
             return
         with open(p, "rb") as f:
             meta = json.loads(f.read().decode("utf-8"))
+        if "indices" in meta and isinstance(meta.get("indices"), dict):
+            self.aliases = meta.get("aliases") or {}
+            meta = meta["indices"]
+        # else: pre-alias flat manifest ({name: {...}}) — read as-is
         for name, m in meta.items():
             svc = IndexService(name, m["uuid"], Settings.of(m["settings"]),
                                m.get("mapping"),
@@ -241,11 +297,57 @@ class IndicesService:
     def has_index(self, name: str) -> bool:
         return name in self.indices
 
+    # -------- aliases (reference: MetadataIndexAliasesService) --------
+
+    def put_alias(self, index: str, alias: str,
+                  props: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            if index not in self.indices:
+                raise IndexNotFoundException(f"no such index [{index}]")
+            if alias in self.indices:
+                raise IllegalArgumentException(
+                    f"alias [{alias}] clashes with an index name")
+            _validate_index_name(alias)
+            self.aliases.setdefault(alias, {})[index] = dict(props or {})
+            self._persist_metadata_locked()
+
+    def delete_alias(self, index: str, alias: str) -> None:
+        with self._lock:
+            entry = self.aliases.get(alias)
+            if not entry or index not in entry:
+                from elasticsearch_tpu.common.errors import \
+                    ResourceNotFoundException
+                raise ResourceNotFoundException(
+                    f"aliases [{alias}] missing on index [{index}]")
+            del entry[index]
+            if not entry:
+                del self.aliases[alias]
+            self._persist_metadata_locked()
+
+    def alias_targets(self, alias: str) -> Optional[Dict[str, Dict]]:
+        return self.aliases.get(alias)
+
+    def resolve_write_index(self, name: str) -> str:
+        """Writes through an alias land on its write index; a plain
+        index name passes through."""
+        if name in self.aliases:
+            return self.write_index_for(name)
+        return name
+
+    def write_index_for(self, alias: str) -> str:
+        return select_write_index(self.aliases.get(alias) or {}, alias)
+
     def delete_index(self, name: str) -> None:
         with self._lock:
             svc = self.indices.pop(name, None)
             if svc is None:
                 raise IndexNotFoundException(f"no such index [{name}]")
+            # aliases pointing at a deleted index go with it
+            for alias in [a for a, tgts in self.aliases.items()
+                          if name in tgts]:
+                del self.aliases[alias][name]
+                if not self.aliases[alias]:
+                    del self.aliases[alias]
             svc.close()
             self._persist_metadata_locked()
             import shutil

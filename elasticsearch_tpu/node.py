@@ -234,11 +234,11 @@ class Node:
                                       source, result)
 
     def _register_actions(self) -> None:
-        from elasticsearch_tpu.rest.actions import (admin, cluster, document,
-                                                    ingest, search,
+        from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
+                                                    document, ingest, search,
                                                     snapshots, tasks)
         for module in (document, search, admin, cluster, tasks, ingest,
-                       snapshots):
+                       snapshots, aliases):
             module.register(self.controller, self)
         self.plugins.install_rest_handlers(self.controller, self)
 

@@ -96,18 +96,21 @@ class PluginRegistry:
                    str(settings.get("plugins.modules", "")).split(",")
                    if m.strip()]
         for mod_name in modules:
+            # the whole check-import-setup sequence runs under the lock:
+            # two nodes constructed concurrently must not race setup()
+            # into double registration. A failed load leaves the module
+            # unmarked so the next attempt raises again, never silently
+            # skips.
             with self._lock:
                 if mod_name in self.loaded_modules:
                     continue  # process-global registries: load once
-            module = importlib.import_module(mod_name)
-            setup = getattr(module, "setup", None)
-            if setup is None:
-                raise ValueError(
-                    f"plugin module [{mod_name}] has no setup(registry)")
-            setup(self)
-            # marked loaded only AFTER a successful setup: a failed load
-            # must raise again on the next attempt, never silently skip
-            with self._lock:
+                module = importlib.import_module(mod_name)
+                setup = getattr(module, "setup", None)
+                if setup is None:
+                    raise ValueError(
+                        f"plugin module [{mod_name}] has no "
+                        f"setup(registry)")
+                setup(self)
                 self.loaded_modules.append(mod_name)
             logger.info("loaded plugin [%s]", mod_name)
 

@@ -31,11 +31,16 @@ def register(controller: RestController, node) -> None:
                      "index": name}
 
     def delete_index(req: RestRequest):
+        from elasticsearch_tpu.search.coordinator import \
+            resolve_concrete_indices
         if node.cluster is not None:
-            for name in node.cluster.resolve_indices(req.param("index")):
+            view = node.cluster._StateView(node.cluster.applied_state())
+            for name in resolve_concrete_indices(view,
+                                                 req.param("index")):
                 node.cluster.delete_index(name)
             return 200, {"acknowledged": True}
-        for name in resolve_indices(indices, req.param("index")):
+        for name in resolve_concrete_indices(indices,
+                                             req.param("index")):
             indices.delete_index(name)
             tpu = getattr(node, "tpu_search", None)
             if tpu is not None:  # drop resident packs + HBM accounting
@@ -49,7 +54,7 @@ def register(controller: RestController, node) -> None:
             for name in node.cluster.resolve_indices(req.param("index")):
                 meta = state.indices[name]
                 out[name] = {
-                    "aliases": {},
+                    "aliases": dict(meta.aliases),
                     "mappings": meta.mapping or {},
                     "settings": {"index": {
                         "number_of_shards": str(meta.number_of_shards),
@@ -64,7 +69,8 @@ def register(controller: RestController, node) -> None:
         for name in resolve_indices(indices, req.param("index")):
             svc = indices.index(name)
             out[name] = {
-                "aliases": {},
+                "aliases": {a: p for a, tgts in indices.aliases.items()
+                            for i, p in tgts.items() if i == name},
                 "mappings": svc.mapper.to_mapping(),
                 "settings": {"index": {
                     "number_of_shards": str(svc.num_shards),

@@ -206,6 +206,87 @@ def register(controller: RestController, node) -> None:
         return _maybe_table(req, ["host", "port", "master", "name"],
                             [["127.0.0.1", 9200, "m", node.node_name]])
 
+    def cat_root(req: RestRequest):
+        paths = ["/_cat/aliases", "/_cat/allocation", "/_cat/count",
+                 "/_cat/health", "/_cat/indices", "/_cat/master",
+                 "/_cat/nodes", "/_cat/plugins", "/_cat/recovery",
+                 "/_cat/shards", "/_cat/tasks"]
+        return 200, {"_cat": "=^.^=\n" + "\n".join(paths) + "\n"}
+
+    def cat_aliases(req: RestRequest):
+        from elasticsearch_tpu.rest.actions.aliases import _alias_map
+        rows = []
+        for alias, targets in sorted(_alias_map(node).items()):
+            for index, props in sorted(targets.items()):
+                rows.append([alias, index,
+                             "*" if props.get("filter") else "-",
+                             "true" if props.get("is_write_index")
+                             else "-"])
+        return _maybe_table(req, ["alias", "index", "filter",
+                                  "is_write_index"], rows)
+
+    def cat_master(req: RestRequest):
+        if node.cluster is not None:
+            master = node.cluster.coordinator.master_node()
+            if master is None:
+                return _maybe_table(req, ["id", "host", "node"], [])
+            return _maybe_table(req, ["id", "host", "node"],
+                                [[master.node_id, master.host,
+                                  master.name]])
+        return _maybe_table(req, ["id", "host", "node"],
+                            [[node.node_id, "127.0.0.1",
+                              node.node_name]])
+
+    def cat_allocation(req: RestRequest):
+        rows = []
+        if node.cluster is not None:
+            state = node.cluster.applied_state()
+            per_node = {nid: 0 for nid in state.nodes}
+            for shards in state.routing.values():
+                for copies in shards.values():
+                    for c in copies:
+                        if c.node_id in per_node:
+                            per_node[c.node_id] += 1
+            for nid, count in sorted(per_node.items()):
+                n = state.nodes[nid]
+                rows.append([count, n.host, n.name])
+        else:
+            total = sum(len(svc.shards)
+                        for svc in indices.indices.values())
+            rows.append([total, "127.0.0.1", node.node_name])
+        return _maybe_table(req, ["shards", "host", "node"], rows)
+
+    def cat_recovery(req: RestRequest):
+        rows = []
+        for name in resolve_indices(indices, req.param("index")):
+            svc = indices.index(name)
+            for num, shard in sorted(svc.shards.items()):
+                rows.append([name, num, "done",
+                             "existing_store" if shard.primary
+                             else "peer", node.node_name])
+        return _maybe_table(req, ["index", "shard", "stage", "type",
+                                  "node"], rows)
+
+    def cat_plugins(req: RestRequest):
+        rows = [[node.node_name, mod, "-"]
+                for mod in node.plugins.loaded_modules]
+        return _maybe_table(req, ["name", "component", "version"], rows)
+
+    def cat_tasks(req: RestRequest):
+        rows = [[t.action, t.full_id, "transport",
+                 t.start_time_millis, t.description]
+                for t in node.task_manager.list()]
+        return _maybe_table(req, ["action", "task_id", "type",
+                                  "start_time", "description"], rows)
+
+    controller.register("GET", "/_cat", cat_root)
+    controller.register("GET", "/_cat/aliases", cat_aliases)
+    controller.register("GET", "/_cat/master", cat_master)
+    controller.register("GET", "/_cat/allocation", cat_allocation)
+    controller.register("GET", "/_cat/recovery", cat_recovery)
+    controller.register("GET", "/_cat/recovery/{index}", cat_recovery)
+    controller.register("GET", "/_cat/plugins", cat_plugins)
+    controller.register("GET", "/_cat/tasks", cat_tasks)
     controller.register("GET", "/", root)
     controller.register("GET", "/_cluster/settings", get_cluster_settings)
     controller.register("PUT", "/_cluster/settings", put_cluster_settings)

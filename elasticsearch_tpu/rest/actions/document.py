@@ -49,6 +49,7 @@ def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
                    shard_num: Optional[int] = None) -> Tuple[int, Dict]:
     if not isinstance(body, dict):
         raise IllegalArgumentException("request body is required")
+    index = node.indices.resolve_write_index(index)
     # cluster mode: the state applier creates local indices; a missing
     # index here is a routing error, not an auto-create trigger
     svc = (node.indices.index(index) if node.cluster is not None
@@ -86,6 +87,7 @@ def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
 
 def exec_get_doc(node, index: str, doc_id: str, params,
                  shard_num: Optional[int] = None) -> Tuple[int, Dict]:
+    index = node.indices.resolve_write_index(index)
     svc = node.indices.index(index)
     if shard_num is None:
         shard_num = svc.shard_for_id(doc_id, params.get("routing"))
@@ -99,6 +101,7 @@ def exec_get_doc(node, index: str, doc_id: str, params,
 
 def exec_delete_doc(node, index: str, doc_id: str, params,
                     shard_num: Optional[int] = None) -> Tuple[int, Dict]:
+    index = node.indices.resolve_write_index(index)
     svc = node.indices.index(index)
     if shard_num is None:
         shard_num = svc.shard_for_id(doc_id, params.get("routing"))
@@ -123,6 +126,7 @@ def exec_update_doc(node, index: str, doc_id: str, body, params,
                     shard_num: Optional[int] = None) -> Tuple[int, Dict]:
     """_update: doc merge or scripted update is reference behavior;
     doc-merge and doc_as_upsert are supported here."""
+    index = node.indices.resolve_write_index(index)
     svc = node.indices.index(index)
     if shard_num is None:
         shard_num = svc.shard_for_id(doc_id, params.get("routing"))
@@ -205,6 +209,7 @@ def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
         try:
             if index is None:
                 raise IllegalArgumentException("_index is missing")
+            index = node.indices.resolve_write_index(index)
             svc = (node.indices.index(index) if node.cluster is not None
                    else node.get_or_autocreate_index(index))
             shard_num = entry.get("shard")

@@ -26,10 +26,79 @@ from elasticsearch_tpu.search.query_phase import (ShardHit, execute_fetch,
                                                   execute_query)
 
 
+def resolve_targets(indices: IndicesService, expression: Optional[str]
+                    ) -> Tuple[List[str], Dict[str, List[dict]]]:
+    """Wildcard/CSV resolution over index AND alias names (reference:
+    IndexNameExpressionResolver — no date math yet).
+
+    → (index names, {index: [alias filter json, ...]}). An index reached
+    directly (or through an unfiltered alias) in the same expression is
+    unfiltered; multiple filtered aliases OR together."""
+    idx_names = sorted(indices.indices.keys())
+    alias_map = getattr(indices, "aliases", {})
+    alias_names = sorted(alias_map.keys())
+    out: List[str] = []
+    filters: Dict[str, List[dict]] = {}
+    unfiltered: set = set()
+
+    def add_index(name: str, filt: Optional[dict]) -> None:
+        if name not in out:
+            out.append(name)
+        if filt is None:
+            unfiltered.add(name)
+            filters.pop(name, None)
+        elif name not in unfiltered:
+            filters.setdefault(name, []).append(filt)
+
+    def add_part(part: str) -> None:
+        if part in idx_names:
+            add_index(part, None)
+            return
+        if part in alias_names:
+            for idx, props in sorted(alias_map[part].items()):
+                if idx in indices.indices:
+                    add_index(idx, props.get("filter"))
+            return
+        raise IndexNotFoundException(f"no such index [{part}]")
+
+    if expression in (None, "", "_all", "*"):
+        for n in idx_names:
+            add_index(n, None)
+        return out, filters
+    for part in expression.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "*" in part or "?" in part:
+            for m in fnmatch.filter(idx_names, part):
+                add_index(m, None)
+            for m in fnmatch.filter(alias_names, part):
+                add_part(m)
+        else:
+            add_part(part)
+    return out, filters
+
+
 def resolve_indices(indices: IndicesService,
                     expression: Optional[str]) -> List[str]:
-    """Wildcard/CSV index resolution (reference:
-    IndexNameExpressionResolver — no date math yet)."""
+    """Index-name resolution ignoring alias filters (admin APIs)."""
+    return resolve_targets(indices, expression)[0]
+
+
+def resolve_concrete_indices(indices: IndicesService,
+                             expression: Optional[str]) -> List[str]:
+    """Destructive admin APIs (delete index) must name CONCRETE indices
+    — addressing one through an alias is rejected, never silently
+    expanded onto the backing index (reference: DestructiveOperations +
+    IndexNameExpressionResolver concrete-only resolution)."""
+    alias_map = getattr(indices, "aliases", {})
+    if expression:
+        for part in expression.split(","):
+            part = part.strip()
+            if part in alias_map:
+                raise IllegalArgumentException(
+                    f"The provided expression [{part}] matches an alias; "
+                    f"this operation requires concrete index names")
     names = sorted(indices.indices.keys())
     if expression in (None, "", "_all", "*"):
         return names
@@ -39,14 +108,28 @@ def resolve_indices(indices: IndicesService,
         if not part:
             continue
         if "*" in part or "?" in part:
-            matched = fnmatch.filter(names, part)
-            out.extend(m for m in matched if m not in out)
-        else:
-            if part not in names:
-                raise IndexNotFoundException(f"no such index [{part}]")
-            if part not in out:
-                out.append(part)
+            out.extend(m for m in fnmatch.filter(names, part)
+                       if m not in out)
+        elif part not in names:
+            raise IndexNotFoundException(f"no such index [{part}]")
+        elif part not in out:
+            out.append(part)
     return out
+
+
+def with_alias_filters(query: dsl.QueryNode,
+                       filts: Optional[List[dict]]) -> dsl.QueryNode:
+    """Wrap the request query with the matched aliases' filters
+    (reference: the alias filter joins the shard-level query as a
+    FILTER clause; several filtered aliases OR together)."""
+    if not filts:
+        return query
+    parsed = [dsl.parse_query(f) for f in filts]
+    if len(parsed) == 1:
+        filt: dsl.QueryNode = parsed[0]
+    else:
+        filt = dsl.BoolQuery(should=parsed, minimum_should_match=1)
+    return dsl.BoolQuery(must=[query], filter=[filt])
 
 
 def parse_search_body(body: Optional[Dict[str, Any]]):
@@ -54,7 +137,7 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
     # unimplemented keys get a 400, never silently ignored (VERDICT r1
     # weak #1): a sorted/highlighted query must not return wrong results
     # with a 200
-    unsupported = set(body) & {"highlight", "suggest", "collapse",
+    unsupported = set(body) & {"suggest", "collapse",
                                "rescore", "script_fields"}
     if unsupported:
         raise IllegalArgumentException(
@@ -63,7 +146,8 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
     unknown = set(body) - {"query", "aggs", "aggregations", "size", "from",
                            "_source", "min_score", "track_total_hits",
                            "sort", "search_after", "timeout", "pit",
-                           "profile", "version", "seq_no_primary_term"}
+                           "profile", "highlight",
+                           "version", "seq_no_primary_term"}
     if unknown:
         raise IllegalArgumentException(
             f"unknown search body keys {sorted(unknown)}")
@@ -100,8 +184,10 @@ def search(indices: IndicesService, index_expr: Optional[str],
     from elasticsearch_tpu.search.query_phase import SearchContext
     t0 = time.perf_counter()
     params = params or {}
-    names = (list(names_override) if names_override is not None
-             else resolve_indices(indices, index_expr))
+    if names_override is not None:
+        names, alias_filters = list(names_override), {}
+    else:
+        names, alias_filters = resolve_targets(indices, index_expr)
     query, aggs, body = parse_search_body(body)
     ctx = SearchContext(parse_timeout_s(body, params), task)
     size = int(params.get("size", body.get("size", 10)))
@@ -114,6 +200,14 @@ def search(indices: IndicesService, index_expr: Optional[str],
     if search_after is not None and not sort_specs:
         raise IllegalArgumentException(
             "[search_after] requires a [sort] specification")
+    highlight_spec = None
+    fetch_source = source
+    if body.get("highlight") is not None:
+        from elasticsearch_tpu.search.highlight import HighlightSpec
+        highlight_spec = HighlightSpec(body["highlight"])
+        # the highlighter reads stored fields even when the response
+        # suppresses _source
+        fetch_source = True if source is False else source
 
     # ---- TPU fast path: micro-batched kernel over resident packs ----
     # (VERDICT r1 #1: the batched pipeline IS the serving path for the
@@ -122,6 +216,7 @@ def search(indices: IndicesService, index_expr: Optional[str],
     profile = bool(body.get("profile"))
     if (tpu_search is not None and aggs is None and pinned is None
             and not profile  # profiling instruments the planner path
+            and not alias_filters  # filtered aliases run the planner
             and not any(k in body for k in ("sort", "search_after",
                                             "highlight", "suggest"))):
         fast = _search_fast(indices, names, query, tpu_search,
@@ -142,6 +237,7 @@ def search(indices: IndicesService, index_expr: Optional[str],
     query_nanos: Dict[Tuple[str, int], int] = {}
     for name in names:
         svc = indices.index(name)
+        eff_query = with_alias_filters(query, alias_filters.get(name))
         for shard_num, shard in sorted(svc.shards.items()):
             if ctx.should_stop():
                 timed_out = True
@@ -153,7 +249,8 @@ def search(indices: IndicesService, index_expr: Optional[str],
             else:
                 reader = shard.acquire_searcher()
             q0 = time.perf_counter()
-            res = execute_query(reader, query, size=size + from_, from_=0,
+            res = execute_query(reader, eff_query, size=size + from_,
+                                from_=0,
                                 min_score=min_score, aggs=aggs,
                                 sort_specs=sort_specs or None,
                                 search_after=search_after, ctx=ctx)
@@ -196,9 +293,20 @@ def search(indices: IndicesService, index_expr: Optional[str],
         name, shard_num, reader, _ = shard_results[si]
         f0 = time.perf_counter()
         for hit, doc in zip(hits, execute_fetch(
-                reader, hits, source, version=want_version,
+                reader, hits, fetch_source, version=want_version,
                 seq_no_primary_term=want_seqno)):
             doc["_index"] = name
+            if highlight_spec is not None:
+                from elasticsearch_tpu.search.highlight import \
+                    build_highlights
+                # highlight the REQUEST query only — alias filters
+                # select docs, they are not something the user searched
+                hl = build_highlights(query, doc.get("_source"),
+                                      highlight_spec)
+                if hl:
+                    doc["highlight"] = hl
+                if source is False:
+                    doc.pop("_source", None)
             fetched[(si, hit.doc_id)] = doc
         fetch_nanos[(name, shard_num)] = int(
             (time.perf_counter() - f0) * 1e9)
@@ -389,7 +497,9 @@ def search_shard_group(indices: IndicesService,
                        targets: List[Tuple[str, int]],
                        body: Optional[Dict[str, Any]],
                        params: Optional[Dict[str, str]] = None,
-                       tpu_search=None) -> Dict[str, Any]:
+                       tpu_search=None,
+                       index_filters: Optional[Dict[str, List[dict]]]
+                       = None) -> Dict[str, Any]:
     """Execute the query phase (+ eager fetch of the local window) over
     an explicit list of LOCAL (index, shard) targets, returning a
     JSON-serializable partial result the coordinating node merges with
@@ -412,6 +522,12 @@ def search_shard_group(indices: IndicesService,
     search_after = body.get("search_after")
     want_version = bool(body.get("version"))
     want_seqno = bool(body.get("seq_no_primary_term"))
+    highlight_spec = None
+    fetch_source = source
+    if body.get("highlight") is not None:
+        from elasticsearch_tpu.search.highlight import HighlightSpec
+        highlight_spec = HighlightSpec(body["highlight"])
+        fetch_source = True if source is False else source
 
     by_index: Dict[str, List[int]] = {}
     for name, shard_num in targets:
@@ -429,10 +545,13 @@ def search_shard_group(indices: IndicesService,
     relation = "eq"
     for name, shard_nums in sorted(by_index.items()):
         svc = indices.index(name)
+        eff_query = with_alias_filters(
+            query, (index_filters or {}).get(name))
         used_fast = False
         if (tpu_search is not None and aggs is None and not sort_specs
                 and search_after is None and k > 0 and min_score is None
                 and not body.get("profile")
+                and not (index_filters or {}).get(name)
                 and set(shard_nums) == set(svc.shards.keys())):
             res = tpu_search.try_search(svc, query, k=k,
                                         timeout_s=ctx.remaining_s())
@@ -465,7 +584,7 @@ def search_shard_group(indices: IndicesService,
                 shard = svc.shard(shard_num)
                 reader = shard.acquire_searcher()
                 q0 = time.perf_counter()
-                res = execute_query(reader, query, size=k, from_=0,
+                res = execute_query(reader, eff_query, size=k, from_=0,
                                     min_score=min_score, aggs=aggs,
                                     sort_specs=sort_specs or None,
                                     search_after=search_after, ctx=ctx)
@@ -480,7 +599,7 @@ def search_shard_group(indices: IndicesService,
                 if aggs is not None and res.aggregations is not None:
                     agg_parts.append(res.aggregations)
                 f0 = time.perf_counter()
-                fetched = execute_fetch(reader, res.hits, source,
+                fetched = execute_fetch(reader, res.hits, fetch_source,
                                         version=want_version,
                                         seq_no_primary_term=want_seqno)
                 group_fetch_nanos[(name, shard_num)] = int(
@@ -490,6 +609,16 @@ def search_shard_group(indices: IndicesService,
                     doc["_score"] = hit.score
                     if hit.sort_values is not None:
                         doc["sort"] = hit.sort_values
+                    if highlight_spec is not None:
+                        from elasticsearch_tpu.search.highlight import \
+                            build_highlights
+                        hl = build_highlights(query,
+                                              doc.get("_source"),
+                                              highlight_spec)
+                        if hl:
+                            doc["highlight"] = hl
+                        if source is False:
+                            doc.pop("_source", None)
                     doc["__shard"] = shard_num
                     shard_results.append((res, name, shard_num, rank, doc))
 
@@ -611,15 +740,16 @@ def merge_group_responses(groups: List[Dict[str, Any]],
 
 def count(indices: IndicesService, index_expr: Optional[str],
           body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
-    names = resolve_indices(indices, index_expr)
+    names, alias_filters = resolve_targets(indices, index_expr)
     query = dsl.parse_query((body or {}).get("query") or {"match_all": {}})
     total = 0
     n_shards = 0
     for name in names:
         svc = indices.index(name)
+        eff_query = with_alias_filters(query, alias_filters.get(name))
         for shard_num, shard in sorted(svc.shards.items()):
             reader = shard.acquire_searcher()
-            res = execute_query(reader, query, size=0)
+            res = execute_query(reader, eff_query, size=0)
             total += res.total_hits
             n_shards += 1
     return {"count": total,

@@ -1,0 +1,207 @@
+"""Index aliases: CRUD, search/write resolution, filtered aliases,
+write indices (reference: MetadataIndexAliasesService + RestGetAliases
+Action — SURVEY.md §2.1#49/50)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    if isinstance(body, str):
+        return node.handle(method, path, params, None, body.encode())
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def logs(node):
+    for month, count in (("logs-01", 3), ("logs-02", 5)):
+        _handle(node, "PUT", f"/{month}", body={"mappings": {
+            "properties": {"level": {"type": "keyword"},
+                           "n": {"type": "integer"}}}})
+        for i in range(count):
+            _handle(node, "PUT", f"/{month}/_doc/{i}",
+                    params={"refresh": "true"},
+                    body={"level": "error" if i % 2 == 0 else "info",
+                          "n": i})
+    return node
+
+
+class TestCrud:
+    def test_put_get_delete(self, logs):
+        status, _ = _handle(logs, "PUT", "/logs-01/_alias/logs")
+        assert status == 200
+        status, res = _handle(logs, "GET", "/_alias/logs")
+        assert res == {"logs-01": {"aliases": {"logs": {}}}}
+        status, _ = _handle(logs, "HEAD", "/_alias/logs")
+        assert status == 200
+        status, _ = _handle(logs, "DELETE", "/logs-01/_alias/logs")
+        assert status == 200
+        status, _ = _handle(logs, "HEAD", "/_alias/logs")
+        assert status == 404
+
+    def test_actions_bulk_update(self, logs):
+        status, _ = _handle(logs, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "logs-*", "alias": "all-logs"}}]})
+        assert status == 200
+        _s, res = _handle(logs, "GET", "/_alias/all-logs")
+        assert set(res) == {"logs-01", "logs-02"}
+        status, _ = _handle(logs, "POST", "/_aliases", body={"actions": [
+            {"remove": {"index": "logs-01", "alias": "all-logs"}}]})
+        _s, res = _handle(logs, "GET", "/_alias/all-logs")
+        assert set(res) == {"logs-02"}
+
+    def test_alias_clashing_with_index_rejected(self, logs):
+        status, _ = _handle(logs, "PUT", "/logs-01/_alias/logs-02")
+        assert status == 400
+
+    def test_missing_index_rejected(self, logs):
+        status, _ = _handle(logs, "PUT", "/nope/_alias/a")
+        assert status == 404
+
+    def test_alias_dies_with_index(self, logs):
+        _handle(logs, "PUT", "/logs-01/_alias/doomed")
+        _handle(logs, "DELETE", "/logs-01")
+        status, _ = _handle(logs, "HEAD", "/_alias/doomed")
+        assert status == 404
+
+    def test_delete_via_alias_rejected(self, logs):
+        """Destructive index APIs must not expand aliases: DELETE on an
+        alias name is a 400, never a silent delete of the backing
+        index."""
+        _handle(logs, "PUT", "/logs-01/_alias/precious")
+        status, res = _handle(logs, "DELETE", "/precious")
+        assert status == 400, res
+        status, _ = _handle(logs, "GET", "/logs-01")
+        assert status == 200  # still there
+
+    def test_filtered_alias_count_matches_search(self, logs):
+        _handle(logs, "PUT", "/logs-02/_alias/cnt", body={
+            "filter": {"term": {"level": "error"}}})
+        _s, c = _handle(logs, "POST", "/cnt/_count",
+                        body={"query": {"match_all": {}}})
+        _s, r = _handle(logs, "POST", "/cnt/_search",
+                        body={"query": {"match_all": {}}})
+        assert c["count"] == r["hits"]["total"]["value"] == 3
+
+    def test_alias_filter_not_highlighted(self, logs):
+        _handle(logs, "PUT", "/logs-02/_alias/hlf", body={
+            "filter": {"term": {"level": "error"}}})
+        # docs have level error/info; the alias filter term "error" must
+        # not produce highlights — only the request query does
+        _s, res = _handle(logs, "POST", "/hlf/_search", body={
+            "query": {"range": {"n": {"gte": 0}}},
+            "highlight": {"require_field_match": False,
+                          "fields": {"level": {}}}})
+        assert all("highlight" not in h for h in res["hits"]["hits"])
+
+    def test_get_index_shows_aliases(self, logs):
+        _handle(logs, "PUT", "/logs-01/_alias/shown")
+        _s, res = _handle(logs, "GET", "/logs-01")
+        assert "shown" in res["logs-01"]["aliases"]
+
+
+class TestCat:
+    def test_cat_endpoints(self, logs):
+        _handle(logs, "PUT", "/logs-01/_alias/cat-me", body={
+            "filter": {"term": {"level": "error"}}})
+        status, res = _handle(logs, "GET", "/_cat/aliases",
+                              params={"v": "true"})
+        assert status == 200
+        assert "cat-me" in res["_cat"] and "logs-01" in res["_cat"]
+        for path in ("/_cat", "/_cat/master", "/_cat/allocation",
+                     "/_cat/recovery", "/_cat/plugins", "/_cat/tasks"):
+            status, res = _handle(logs, "GET", path)
+            assert status == 200, path
+            assert "_cat" in res
+
+
+class TestResolution:
+    def test_search_through_alias_spans_indices(self, logs):
+        _handle(logs, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "logs-*", "alias": "logs"}}]})
+        status, res = _handle(logs, "POST", "/logs/_search",
+                              body={"query": {"match_all": {}},
+                                    "size": 20})
+        assert status == 200
+        assert res["hits"]["total"]["value"] == 8
+        indices = {h["_index"] for h in res["hits"]["hits"]}
+        assert indices == {"logs-01", "logs-02"}
+        _s, c = _handle(logs, "POST", "/logs/_count",
+                        body={"query": {"match_all": {}}})
+        assert c["count"] == 8
+
+    def test_filtered_alias(self, logs):
+        _handle(logs, "PUT", "/logs-02/_alias/errors-only", body={
+            "filter": {"term": {"level": "error"}}})
+        status, res = _handle(logs, "POST", "/errors-only/_search",
+                              body={"query": {"match_all": {}},
+                                    "size": 20})
+        assert status == 200, res
+        assert res["hits"]["total"]["value"] == 3  # errors in logs-02
+        assert all(h["_source"]["level"] == "error"
+                   for h in res["hits"]["hits"])
+        # the filter composes with the request query
+        _s, res = _handle(logs, "POST", "/errors-only/_search", body={
+            "query": {"range": {"n": {"gte": 2}}}})
+        assert res["hits"]["total"]["value"] == 2  # n in {2, 4}
+
+    def test_direct_access_stays_unfiltered(self, logs):
+        _handle(logs, "PUT", "/logs-02/_alias/errs", body={
+            "filter": {"term": {"level": "error"}}})
+        # naming the index AND the filtered alias: direct access wins
+        _s, res = _handle(logs, "POST", "/logs-02,errs/_search",
+                          body={"query": {"match_all": {}}, "size": 20})
+        assert res["hits"]["total"]["value"] == 5
+
+    def test_write_through_single_index_alias(self, logs):
+        _handle(logs, "PUT", "/logs-01/_alias/w")
+        status, res = _handle(logs, "PUT", "/w/_doc/new",
+                              params={"refresh": "true"}, body={"n": 99})
+        assert status == 201
+        assert res["_index"] == "logs-01"
+        _s, got = _handle(logs, "GET", "/logs-01/_doc/new")
+        assert got["_source"]["n"] == 99
+        # and reads/deletes resolve too
+        _s, got = _handle(logs, "GET", "/w/_doc/new")
+        assert got["found"] is True
+        status, _ = _handle(logs, "DELETE", "/w/_doc/new")
+        assert status == 200
+
+    def test_write_through_multi_index_alias_needs_write_index(self,
+                                                               logs):
+        _handle(logs, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "logs-*", "alias": "multi"}}]})
+        status, _ = _handle(logs, "PUT", "/multi/_doc/x", body={"n": 1})
+        assert status == 400
+        # designate a write index → writes land there
+        _handle(logs, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "logs-02", "alias": "multi",
+                     "is_write_index": True}}]})
+        status, res = _handle(logs, "PUT", "/multi/_doc/x",
+                              params={"refresh": "true"}, body={"n": 1})
+        assert status == 201 and res["_index"] == "logs-02"
+
+    def test_bulk_through_alias(self, logs):
+        _handle(logs, "PUT", "/logs-01/_alias/bw")
+        lines = [json.dumps({"index": {"_index": "bw", "_id": "b1"}}),
+                 json.dumps({"n": 7})]
+        status, res = _handle(logs, "POST", "/_bulk",
+                              params={"refresh": "true"},
+                              body="\n".join(lines) + "\n")
+        assert status == 200 and res["errors"] is False
+        assert res["items"][0]["index"]["_index"] == "logs-01"

@@ -265,6 +265,29 @@ def test_scroll_rejected_when_shards_remote(cluster):
     assert status == 400, body
 
 
+def test_aliases_across_nodes(cluster):
+    """Aliases live in the cluster state: defined via one node, they
+    resolve searches and writes on every node."""
+    status, body = _handle(cluster[0], "PUT", "/al-idx", body={
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"title": {"type": "text"}}}})
+    assert status == 200, body
+    _handle(cluster[0], "PUT", "/al-idx/_doc/seed",
+            params={"refresh": "true"}, body={"title": "seeded"})
+    status, body = _handle(cluster[0], "POST", "/_aliases", body={
+        "actions": [{"add": {"index": "al-idx", "alias": "d-alias"}}]})
+    assert status == 200, body
+    status, res = _handle(cluster[1], "POST", "/d-alias/_search",
+                          body={"query": {"match_all": {}}, "size": 1})
+    assert status == 200, res
+    assert res["hits"]["total"]["value"] > 0
+    status, res = _handle(cluster[2], "PUT", "/d-alias/_doc/via-alias",
+                          body={"title": "aliased"})
+    assert status == 201, res
+    assert res["_index"] == "al-idx"
+    _handle(cluster[0], "DELETE", "/al-idx")
+
+
 def test_ingest_pipeline_propagates_across_nodes(cluster):
     """A pipeline PUT via one node rides the cluster state to every
     node and applies on whichever primary owner indexes the doc."""
