@@ -89,14 +89,23 @@ def register(controller: RestController, node) -> None:
     def nodes_stats(req: RestRequest):
         import resource
         ru = resource.getrusage(resource.RUSAGE_SELF)
-        return 200, {"_nodes": {"total": 1, "successful": 1},
-                     "cluster_name": node.cluster_name,
-                     "nodes": {node.node_id: {
-                         "name": node.node_name,
-                         "indices": indices.stats(),
-                         "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
-                         "jvm": None,
-                     }}}
+        out = {"_nodes": {"total": 1, "successful": 1},
+               "cluster_name": node.cluster_name,
+               "nodes": {node.node_id: {
+                   "name": node.node_name,
+                   "indices": indices.stats(),
+                   "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
+                   "jvm": None,
+               }}}
+        if node.tpu_search is not None:
+            out["nodes"][node.node_id]["tpu_search"] = \
+                node.tpu_search.stats()
+        if getattr(node, "breakers", None) is not None:
+            # the service's own stats() — includes the PARENT breaker,
+            # the signal the hierarchy exists for
+            out["nodes"][node.node_id]["breakers"] = \
+                node.breakers.stats()
+        return 200, out
 
     # ---------------- _cat ----------------
 

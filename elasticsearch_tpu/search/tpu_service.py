@@ -165,6 +165,10 @@ class IndexPackCache:
         # (index, field) pack must not block fast-path lookups of every
         # other key on the node (ADVICE r2 low #4)
         self._build_locks: Dict[Tuple[str, str], threading.Lock] = {}
+        # on_evict(old_resident): set by TpuSearchService so eviction
+        # also retires the pack's micro-batch queue (its strong ref
+        # would otherwise pin the freed device arrays)
+        self.on_evict = None
 
     @property
     def mesh(self):
@@ -192,12 +196,15 @@ class IndexPackCache:
                 if entry is not None and entry.reader_key == reader_key:
                     return entry
             entry = self._build(readers, field, reader_key)
+            old = None
             with self._lock:
                 if entry is not None:
                     old = self._cache.get(key)
                     if old is not None and self._breaker is not None:
                         self._breaker.release(old.hbm_bytes)
                     self._cache[key] = entry
+            if old is not None and self.on_evict is not None:
+                self.on_evict(old)
             return entry
 
     def _build(self, readers, field: str,
@@ -245,11 +252,16 @@ class IndexPackCache:
                             imp_device_arrays=imp_arrays)
 
     def invalidate(self, index_name: str) -> None:
+        evicted = []
         with self._lock:
             for key in [k for k in self._cache if k[0] == index_name]:
                 entry = self._cache.pop(key)
                 if self._breaker is not None:
                     self._breaker.release(entry.hbm_bytes)
+                evicted.append(entry)
+        if self.on_evict is not None:
+            for entry in evicted:
+                self.on_evict(entry)
 
 
 # ---------------------------------------------------------------------------
@@ -270,70 +282,133 @@ def _batch_bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
-class MicroBatcher:
-    """Coalesces concurrent queries against ONE resident pack into a single
-    kernel launch (SURVEY.md §2.3 P4). Queries arriving within `window_s`
-    (or until `max_batch`) share a launch; k pads to the max requested."""
+class _PackQueue:
+    """One pack's pending queries + its dedicated worker thread. Packs
+    batch independently, so pack A's kernel launch (including a first-
+    compile stall) never delays pack B's queries (VERDICT r2 weak #10:
+    no head-of-line coupling across (index, field) packs)."""
 
-    def __init__(self, window_s: float = 0.002, max_batch: int = 64):
-        self.window_s = window_s
-        self.max_batch = max_batch
-        self._lock = threading.Condition()
-        self._queue: List[Tuple[ResidentPack, _Pending]] = []
-        self._thread: Optional[threading.Thread] = None
-        self._closed = False
-        self.batches_executed = 0
-        self.queries_executed = 0
+    IDLE_EXIT_S = 60.0
 
-    def start(self) -> None:
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._run, daemon=True)
-            self._thread.start()
+    def __init__(self, batcher: "MicroBatcher", resident: ResidentPack):
+        self.batcher = batcher
+        self.resident = resident
+        self.cv = threading.Condition()
+        self.pendings: List[_Pending] = []
+        self.closed = False
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name="micro-batcher-pack")
+        self.thread.start()
+
+    def submit(self, pending: _Pending) -> bool:
+        with self.cv:
+            if self.closed:
+                return False
+            self.pendings.append(pending)
+            self.cv.notify_all()
+            return True
 
     def close(self) -> None:
-        with self._lock:
-            self._closed = True
-            self._lock.notify_all()
-
-    def submit(self, resident: ResidentPack, flat: FlatQuery,
-               k: int) -> Future:
-        fut: Future = Future()
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("micro-batcher is closed")
-            self._queue.append((resident, _Pending(flat, k, fut)))
-            self._lock.notify_all()
-        self.start()
-        return fut
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
 
     def _run(self) -> None:
+        batcher = self.batcher
         while True:
-            with self._lock:
-                while not self._queue and not self._closed:
-                    self._lock.wait()
-                if self._closed and not self._queue:
-                    return
-                # open a window for more arrivals
-                deadline = time.monotonic() + self.window_s
-                while (len(self._queue) < self.max_batch
-                       and time.monotonic() < deadline):
-                    self._lock.wait(timeout=max(
-                        0.0, deadline - time.monotonic()))
-                # one launch serves one pack; group head-of-line pack
-                head_pack = self._queue[0][0]
-                taken, rest = [], []
-                for resident, pending in self._queue:
-                    if resident is head_pack and len(taken) < self.max_batch:
-                        taken.append(pending)
-                    else:
-                        rest.append((resident, pending))
-                self._queue = rest
+            retire = False
+            taken: List[_Pending] = []
+            with self.cv:
+                idle_deadline = time.monotonic() + self.IDLE_EXIT_S
+                while not self.pendings and not self.closed:
+                    remaining = idle_deadline - time.monotonic()
+                    if remaining <= 0:
+                        # idle: retire this queue (a fresh one spawns on
+                        # the next query; stale-pack queues don't leak)
+                        self.closed = True
+                        retire = True
+                        break
+                    self.cv.wait(timeout=remaining)
+                if not retire:
+                    if self.closed and not self.pendings:
+                        return
+                    # open a window for more arrivals to share the launch
+                    deadline = time.monotonic() + batcher.window_s
+                    while (len(self.pendings) < batcher.max_batch
+                           and time.monotonic() < deadline):
+                        self.cv.wait(timeout=max(
+                            0.0, deadline - time.monotonic()))
+                    taken = self.pendings[: batcher.max_batch]
+                    self.pendings = self.pendings[batcher.max_batch:]
+            if retire:
+                # NEVER hold cv while taking the batcher lock (submit's
+                # get/create path holds it before calling into us)
+                batcher._retire(self)
+                return
+            if not taken:
+                continue
             try:
-                self._execute(head_pack, taken)
+                batcher._execute(self.resident, taken)
             except Exception as exc:  # noqa: BLE001 — propagate per query
                 for p in taken:
                     if not p.future.done():
                         p.future.set_exception(exc)
+
+
+class MicroBatcher:
+    """Coalesces concurrent queries per resident pack into single kernel
+    launches (SURVEY.md §2.3 P4). Queries arriving within `window_s` (or
+    until `max_batch`) share a launch; k pads to the max requested.
+    Each pack has its own queue + worker, so launches for different
+    packs overlap."""
+
+    def __init__(self, window_s: float = 0.002, max_batch: int = 64):
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._queues: Dict[int, _PackQueue] = {}
+        self._closed = False
+        self.batches_executed = 0
+        self.queries_executed = 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            queues = list(self._queues.values())
+            self._queues.clear()
+        for q in queues:
+            q.close()
+
+    def _retire(self, queue: _PackQueue) -> None:
+        with self._lock:
+            if self._queues.get(id(queue.resident)) is queue:
+                del self._queues[id(queue.resident)]
+
+    def retire_pack(self, resident: ResidentPack) -> None:
+        """Called when the pack cache evicts/replaces a pack: drop its
+        queue NOW so the queue's strong reference can't keep the evicted
+        device arrays alive past the breaker release (the worker drains
+        any in-flight pendings, then exits)."""
+        with self._lock:
+            queue = self._queues.pop(id(resident), None)
+        if queue is not None:
+            queue.close()
+
+    def submit(self, resident: ResidentPack, flat: FlatQuery,
+               k: int) -> Future:
+        fut: Future = Future()
+        pending = _Pending(flat, k, fut)
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("micro-batcher is closed")
+                queue = self._queues.get(id(resident))
+                if queue is None:
+                    queue = _PackQueue(self, resident)
+                    self._queues[id(resident)] = queue
+            if queue.submit(pending):
+                return fut
+            # raced the queue's idle retirement — loop and respawn
 
     # set by the owning TpuSearchService so batches reuse the mesh the
     # pack arrays were placed with (no per-batch mesh construction)
@@ -344,8 +419,9 @@ class MicroBatcher:
         results = execute_flat_batch(
             resident, [p.flat for p in pendings],
             k=max(p.k for p in pendings), mesh=self.mesh)
-        self.batches_executed += 1
-        self.queries_executed += len(pendings)
+        with self._lock:
+            self.batches_executed += 1
+            self.queries_executed += len(pendings)
         for p, res in zip(pendings, results):
             p.future.set_result(res)
 
@@ -542,6 +618,8 @@ class TpuSearchService:
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.batch_timeout_s = batch_timeout_s
         self.batcher = MicroBatcher(window_s=window_s, max_batch=max_batch)
+        # pack eviction retires the pack's batch queue immediately
+        self.packs.on_evict = self.batcher.retire_pack
         self.batcher.mesh = self.packs.mesh
         self.served = 0      # queries answered by the kernel path
         self.fallback = 0    # queries declined to the planner path

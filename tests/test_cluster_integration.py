@@ -277,8 +277,17 @@ def test_aliases_across_nodes(cluster):
     status, body = _handle(cluster[0], "POST", "/_aliases", body={
         "actions": [{"add": {"index": "al-idx", "alias": "d-alias"}}]})
     assert status == 200, body
-    status, res = _handle(cluster[1], "POST", "/d-alias/_search",
-                          body={"query": {"match_all": {}}, "size": 1})
+    # alias updates propagate to OTHER nodes asynchronously (the write
+    # only waits for the coordinating node's applier, like the
+    # reference) — wait for node 1 to observe it
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        status, res = _handle(cluster[1], "POST", "/d-alias/_search",
+                              body={"query": {"match_all": {}},
+                                    "size": 1})
+        if status == 200:
+            break
+        time.sleep(0.1)
     assert status == 200, res
     assert res["hits"]["total"]["value"] > 0
     status, res = _handle(cluster[2], "PUT", "/d-alias/_doc/via-alias",

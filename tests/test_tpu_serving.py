@@ -164,7 +164,7 @@ class TestEquivalence:
         so totals agree with the sorted path (ADVICE r2 low #3)."""
         make_corpus(svc, seeded_np)
         body = {"query": {"match": {"body": "alpha beta"}},
-                "min_score": 1.0, "size": 50}
+                "min_score": 1.0, "size": 10_000}
         tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
             res = coordinator.search(svc, "corpus", dict(body),
@@ -174,7 +174,7 @@ class TestEquivalence:
             tpu.close()
         # every reported hit honors the floor...
         assert all(h["_score"] >= 1.0 for h in res["hits"]["hits"])
-        # ...and the total equals the filtered hit count (size=50 covers
+        # ...and the total equals the filtered hit count (size covers
         # the full match set here) and matches the sorted path's total
         assert res["hits"]["total"]["value"] == len(res["hits"]["hits"])
         sorted_res = coordinator.search(
@@ -190,6 +190,86 @@ class TestEquivalence:
             {"query": {"match": {"body": {"query": "alpha", "boost": 2.5}}},
              "size": 20})
         assert_equivalent(fast, slow)
+
+
+class TestPerPackQueues:
+    def test_slow_pack_does_not_block_other_packs(self, monkeypatch):
+        """VERDICT r2 weak #10: pack A's kernel launch (e.g. a compile
+        stall) must not delay pack B's queries — each pack batches on
+        its own worker."""
+        import threading
+        import time as _time
+
+        from elasticsearch_tpu.search import tpu_service as svc_mod
+
+        batcher = svc_mod.MicroBatcher(window_s=0.0, max_batch=8)
+        slow_started = threading.Event()
+        release_slow = threading.Event()
+
+        class _FakePack:
+            pass
+
+        pack_a, pack_b = _FakePack(), _FakePack()
+
+        def fake_execute(resident, flats, k, mesh=None):
+            if resident is pack_a:
+                slow_started.set()
+                assert release_slow.wait(timeout=10.0)
+            return [f"res-{id(resident)}" for _ in flats]
+
+        monkeypatch.setattr(svc_mod, "execute_flat_batch", fake_execute)
+        try:
+            fut_a = batcher.submit(pack_a, flat=None, k=1)
+            assert slow_started.wait(timeout=5.0)
+            # pack A's launch is in flight and blocked; pack B must
+            # still complete
+            fut_b = batcher.submit(pack_b, flat=None, k=1)
+            assert fut_b.result(timeout=5.0) == f"res-{id(pack_b)}"
+            assert not fut_a.done()
+            release_slow.set()
+            assert fut_a.result(timeout=5.0) == f"res-{id(pack_a)}"
+            assert batcher.batches_executed == 2
+        finally:
+            release_slow.set()
+            batcher.close()
+
+    def test_same_pack_queries_coalesce(self, monkeypatch):
+        """Deterministic (no wall-clock reliance): the first launch is
+        held open until all four queries are queued, so the remainder
+        MUST share the second launch."""
+        import threading
+
+        from elasticsearch_tpu.search import tpu_service as svc_mod
+
+        batcher = svc_mod.MicroBatcher(window_s=0.0, max_batch=8)
+        calls = []
+        release = threading.Event()
+        all_submitted = threading.Event()
+
+        def fake_execute(resident, flats, k, mesh=None):
+            if not calls:  # hold the FIRST launch open
+                calls.append(len(flats))
+                assert release.wait(timeout=10.0)
+            else:
+                assert all_submitted.is_set()
+                calls.append(len(flats))
+            return ["r"] * len(flats)
+
+        monkeypatch.setattr(svc_mod, "execute_flat_batch", fake_execute)
+        pack = object()
+        try:
+            futs = [batcher.submit(pack, flat=i, k=1) for i in range(4)]
+            all_submitted.set()
+            release.set()
+            for f in futs:
+                f.result(timeout=5.0)
+            assert sum(calls) == 4
+            assert batcher.queries_executed == 4
+            # whatever didn't make launch 1 coalesced into launch 2
+            assert batcher.batches_executed == len(calls) <= 2
+        finally:
+            release.set()
+            batcher.close()
 
 
 class TestFallback:
