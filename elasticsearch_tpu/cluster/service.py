@@ -69,6 +69,8 @@ ACTION_PUT_MAPPING = "cluster/admin/put_mapping"
 ACTION_UPDATE_INDEX_SETTINGS = "cluster/admin/update_index_settings"
 ACTION_UPDATE_CLUSTER_SETTINGS = "cluster/admin/update_cluster_settings"
 ACTION_UPDATE_ALIASES = "cluster/admin/update_aliases"
+ACTION_PUT_TEMPLATE = "cluster/admin/put_template"
+ACTION_DELETE_TEMPLATE = "cluster/admin/delete_template"
 ACTION_PUT_PIPELINE = "cluster/admin/put_pipeline"
 ACTION_DELETE_PIPELINE = "cluster/admin/delete_pipeline"
 
@@ -252,6 +254,8 @@ class ClusterService:
                 (ACTION_UPDATE_CLUSTER_SETTINGS,
                  self._handle_update_cluster_settings),
                 (ACTION_UPDATE_ALIASES, self._handle_update_aliases),
+                (ACTION_PUT_TEMPLATE, self._handle_put_template),
+                (ACTION_DELETE_TEMPLATE, self._handle_delete_template),
                 (ACTION_PUT_PIPELINE, self._handle_put_pipeline),
                 (ACTION_DELETE_PIPELINE, self._handle_delete_pipeline),
                 (ACTION_SHARD_STARTED, self._handle_shard_started),
@@ -553,6 +557,10 @@ class ClusterService:
                 self.node.ingest.sync(state.ingest_pipelines)
             except Exception:  # noqa: BLE001 — a bad pipeline body in
                 logger.exception("pipeline sync failed")  # state
+        if state.index_templates != getattr(
+                self, "_last_applied_templates", None):
+            self._last_applied_templates = dict(state.index_templates)
+            self.node.templates.sync(state.index_templates)
 
     def _maybe_reroute(self, state: ClusterState) -> None:
         """Master-side convergence loop: if a reroute would change the
@@ -617,23 +625,10 @@ class ClusterService:
 
     def _handle_create_index(self, payload, from_node) -> Dict[str, Any]:
         name = payload["name"]
-        mapping = payload.get("mapping")
-        # shared normalization: EVERY bare key gets the index. prefix so
-        # IndexMeta round-trips identically to the single-node path
-        norm = Settings.normalize_index_settings(
-            payload.get("settings") or {})
-        flat = Settings(norm)
-        n_shards = flat.get_int("index.number_of_shards", 1)
-        n_replicas = flat.get_int("index.number_of_replicas", 0)
-        norm["index.number_of_shards"] = n_shards
-        norm["index.number_of_replicas"] = n_replicas
-        import uuid as uuid_mod
-        meta = IndexMeta(
-            name=name, uuid=uuid_mod.uuid4().hex[:20], settings=norm,
-            mapping=mapping, number_of_shards=n_shards,
-            number_of_replicas=n_replicas)
         from elasticsearch_tpu.indices.service import _validate_index_name
         _validate_index_name(name)
+        import uuid as uuid_mod
+        index_uuid = uuid_mod.uuid4().hex[:20]
 
         def update(state: ClusterState) -> ClusterState:
             if name in state.indices:
@@ -641,6 +636,27 @@ class ClusterService:
                     IndexAlreadyExistsException
                 raise IndexAlreadyExistsException(
                     f"index [{name}] already exists")
+            # template defaults compose UNDER the request, read from the
+            # authoritative state inside the update (so template puts
+            # racing this create serialize through the master queue)
+            from elasticsearch_tpu.templates import compose_creation
+            norm, mapping, aliases = compose_creation(
+                state.index_templates, name,
+                payload.get("settings") or {}, payload.get("mapping"))
+            for alias in aliases:
+                if alias in state.indices and alias != name:
+                    raise IllegalArgumentException(
+                        f"alias [{alias}] (from the matching index "
+                        f"template) clashes with an index name")
+            flat = Settings(norm)
+            n_shards = flat.get_int("index.number_of_shards", 1)
+            n_replicas = flat.get_int("index.number_of_replicas", 0)
+            norm["index.number_of_shards"] = n_shards
+            norm["index.number_of_replicas"] = n_replicas
+            meta = IndexMeta(
+                name=name, uuid=index_uuid, settings=norm,
+                mapping=mapping, number_of_shards=n_shards,
+                number_of_replicas=n_replicas, aliases=aliases)
             new_indices = dict(state.indices)
             new_indices[name] = meta
             return self.allocation.reroute(
@@ -810,6 +826,56 @@ class ClusterService:
         self.wait_for_applied(applied, timeout=10.0)
         return result
 
+    def _handle_put_template(self, payload, from_node) -> Dict[str, Any]:
+        from elasticsearch_tpu.templates import validate_template
+        name = payload["name"]
+        validated = validate_template(name, payload["body"])
+
+        def update(state: ClusterState) -> ClusterState:
+            templates = dict(state.index_templates)
+            templates[name] = validated
+            return state.with_updates(index_templates=templates)
+
+        self._run_master_update(update, source=f"put-template[{name}]")
+        return {"acknowledged": True}
+
+    def _handle_delete_template(self, payload, from_node
+                                ) -> Dict[str, Any]:
+        name = payload["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.index_templates:
+                from elasticsearch_tpu.common.errors import \
+                    ResourceNotFoundException
+                raise ResourceNotFoundException(
+                    f"index template matching [{name}] not found")
+            templates = {k: v for k, v in state.index_templates.items()
+                         if k != name}
+            return state.with_updates(index_templates=templates)
+
+        self._run_master_update(update,
+                                source=f"delete-template[{name}]")
+        return {"acknowledged": True}
+
+    def put_template(self, name: str, body: dict) -> dict:
+        from elasticsearch_tpu.templates import validate_template
+        validated = validate_template(name, body)
+        result = self._call_master(ACTION_PUT_TEMPLATE,
+                                   {"name": name, "body": body})
+        # value equality, not mere presence: an UPDATE must wait for the
+        # new body to be the one visible locally
+        self.wait_for_applied(
+            lambda s: s.index_templates.get(name) == validated,
+            timeout=10.0)
+        return result
+
+    def delete_template(self, name: str) -> dict:
+        result = self._call_master(ACTION_DELETE_TEMPLATE,
+                                   {"name": name})
+        self.wait_for_applied(
+            lambda s: name not in s.index_templates, timeout=10.0)
+        return result
+
     def _handle_put_pipeline(self, payload, from_node) -> Dict[str, Any]:
         pipeline_id = payload["id"]
         body = payload["body"]
@@ -912,6 +978,34 @@ class ClusterService:
 
     def _call_master(self, action: str, payload: Dict[str, Any],
                      timeout: float = 20.0) -> Dict[str, Any]:
+        """Master-channel request with handoff tolerance: during an
+        election window (no master yet / the old master just died) the
+        request WAITS and retries instead of failing — the reference's
+        MasterNodeRequest + cluster-state-observer retry."""
+        from elasticsearch_tpu.cluster.coordination import (
+            FailedToCommitException, NotMasterException)
+        deadline = time.monotonic() + timeout
+        last: Optional[Exception] = None
+        while True:
+            try:
+                return self._call_master_once(action, payload, timeout)
+            except (MasterNotDiscoveredException, ConnectionError,
+                    OSError, ConnectTransportException,
+                    NotMasterException, FailedToCommitException) as e:
+                last = e  # handoff window: wait for the new master
+            except RemoteTransportException as e:
+                if e.error_type not in ("NotMasterException",
+                                        "FailedToCommitException"):
+                    raise _rehydrate_error(e) from e
+                last = e  # stale master view: wait for the new one
+            if time.monotonic() >= deadline:
+                raise MasterNotDiscoveredException(
+                    f"master not discovered within {timeout}s "
+                    f"for [{action}]: {last}")
+            time.sleep(0.2)
+
+    def _call_master_once(self, action: str, payload: Dict[str, Any],
+                          timeout: float = 20.0) -> Dict[str, Any]:
         addr = self._master_address()
         if addr == self.local_node.address:
             handler = {ACTION_CREATE_INDEX: self._handle_create_index,
@@ -925,13 +1019,15 @@ class ClusterService:
                        ACTION_DELETE_PIPELINE:
                            self._handle_delete_pipeline,
                        ACTION_UPDATE_ALIASES:
-                           self._handle_update_aliases}[action]
+                           self._handle_update_aliases,
+                       ACTION_PUT_TEMPLATE: self._handle_put_template,
+                       ACTION_DELETE_TEMPLATE:
+                           self._handle_delete_template}[action]
             return handler(payload, self.local_node.to_json())
-        try:
-            return self.transport.send_request(addr, action, payload,
-                                               timeout=timeout)
-        except RemoteTransportException as e:
-            raise _rehydrate_error(e) from e
+        # raw RemoteTransportException surfaces to _call_master, which
+        # retries master-handoff errors and rehydrates the rest
+        return self.transport.send_request(addr, action, payload,
+                                           timeout=timeout)
 
     # ------------------------------------------------------------------
     # document routing (REST → shard owner)

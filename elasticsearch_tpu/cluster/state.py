@@ -131,6 +131,10 @@ class ClusterState:
     # ingest pipeline bodies, id → definition (reference: IngestMetadata)
     ingest_pipelines: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # composable index templates, name → validated body (reference:
+    # Metadata#templatesV2)
+    index_templates: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
 
     # -------------- queries --------------
 
@@ -179,6 +183,7 @@ class ClusterState:
             "persistent_settings": dict(self.persistent_settings),
             "transient_settings": dict(self.transient_settings),
             "ingest_pipelines": dict(self.ingest_pipelines),
+            "index_templates": dict(self.index_templates),
         }
 
     @staticmethod
@@ -200,6 +205,7 @@ class ClusterState:
             persistent_settings=dict(d.get("persistent_settings") or {}),
             transient_settings=dict(d.get("transient_settings") or {}),
             ingest_pipelines=dict(d.get("ingest_pipelines") or {}),
+            index_templates=dict(d.get("index_templates") or {}),
         )
 
     @staticmethod

@@ -69,6 +69,9 @@ class Node:
         from elasticsearch_tpu.snapshots import RepositoriesService
         self.repositories = RepositoriesService(
             _os.path.join(data_path, "_state", "repositories.json"))
+        from elasticsearch_tpu.templates import TemplateService
+        self.templates = TemplateService(
+            _os.path.join(data_path, "_state", "index_templates.json"))
         # single-node dynamic cluster settings (cluster mode keeps them
         # in the published ClusterState instead); persistent ones
         # survive restart via the gateway file
@@ -236,9 +239,10 @@ class Node:
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
                                                     document, ingest, search,
-                                                    snapshots, tasks)
+                                                    snapshots, tasks,
+                                                    templates)
         for module in (document, search, admin, cluster, tasks, ingest,
-                       snapshots, aliases):
+                       snapshots, aliases, templates):
             module.register(self.controller, self)
         self.plugins.install_rest_handlers(self.controller, self)
 
@@ -246,11 +250,30 @@ class Node:
 
     def create_index(self, name: str, settings: Settings,
                      mappings: Optional[dict]) -> IndexService:
-        return self.indices.create_index(name, settings, mappings)
+        """Index creation applies the best-matching index template's
+        defaults underneath the request (reference:
+        MetadataCreateIndexService template application)."""
+        from elasticsearch_tpu.templates import compose_creation
+        flat, merged_mappings, aliases = compose_creation(
+            self.templates.templates, name, settings.get_as_dict(),
+            mappings)
+        # validate template aliases BEFORE creating: a clash must fail
+        # the whole request, not leave a half-created index behind
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+        for alias in aliases:
+            if alias in self.indices.indices and alias != name:
+                raise IllegalArgumentException(
+                    f"alias [{alias}] (from the matching index template) "
+                    f"clashes with an index name")
+        svc = self.indices.create_index(name, Settings(flat),
+                                        merged_mappings)
+        for alias, props in aliases.items():
+            self.indices.put_alias(name, alias, props)
+        return svc
 
     def get_or_autocreate_index(self, name: str) -> IndexService:
         """Reference: auto-create on first doc (action.auto_create_index,
-        default on)."""
+        default on) — templates apply to auto-created indices too."""
         if not self.indices.has_index(name):
             if not self.settings.get_bool("action.auto_create_index", True):
                 from elasticsearch_tpu.common.errors import IndexNotFoundException
@@ -259,7 +282,7 @@ class Node:
             from elasticsearch_tpu.common.errors import \
                 IndexAlreadyExistsException
             try:
-                return self.indices.create_index(name)
+                return self.create_index(name, Settings.EMPTY, None)
             except IndexAlreadyExistsException:
                 # concurrent first-writes raced; the other one won
                 return self.indices.index(name)

@@ -27,6 +27,19 @@ def _parse_time_s(value: str) -> float:
         return 30.0
 
 
+def _cat_table(req, headers: List[str], rows: List[List[Any]]):
+    """The _cat text-table renderer shared by every cat endpoint."""
+    if req.param_bool("v"):
+        all_rows = [headers] + [[str(c) for c in r] for r in rows]
+    else:
+        all_rows = [[str(c) for c in r] for r in rows]
+    widths = [max((len(r[i]) for r in all_rows), default=0)
+              for i in range(len(headers))]
+    lines = [" ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in all_rows]
+    return 200, {"_cat": "\n".join(lines) + "\n"}
+
+
 def register(controller: RestController, node) -> None:
     indices = node.indices
 
@@ -109,16 +122,7 @@ def register(controller: RestController, node) -> None:
 
     # ---------------- _cat ----------------
 
-    def _maybe_table(req, headers: List[str], rows: List[List[Any]]):
-        if req.param_bool("v"):
-            all_rows = [headers] + [[str(c) for c in r] for r in rows]
-        else:
-            all_rows = [[str(c) for c in r] for r in rows]
-        widths = [max((len(r[i]) for r in all_rows), default=0)
-                  for i in range(len(headers))]
-        lines = [" ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
-                 for r in all_rows]
-        return 200, {"_cat": "\n".join(lines) + "\n"}
+    _maybe_table = _cat_table
 
     def cat_indices(req: RestRequest):
         rows = []

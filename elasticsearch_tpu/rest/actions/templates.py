@@ -1,0 +1,71 @@
+"""Index template REST actions (reference: RestPutComposableIndex
+TemplateAction et al — SURVEY.md §2.1#49)."""
+
+from __future__ import annotations
+
+import fnmatch
+
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+
+
+def _registry(node):
+    if node.cluster is not None:
+        return node.cluster.applied_state().index_templates
+    return node.templates.templates
+
+
+def register(controller: RestController, node) -> None:
+
+    def put_template(req: RestRequest):
+        name = req.param("name")
+        if node.cluster is not None:
+            node.cluster.put_template(name, req.body or {})
+        else:
+            node.templates.put(name, req.body or {})
+        return 200, {"acknowledged": True}
+
+    def get_template(req: RestRequest):
+        name = req.param("name")
+        registry = _registry(node)
+        if name and "*" not in name:
+            if name not in registry:
+                from elasticsearch_tpu.common.errors import \
+                    ResourceNotFoundException
+                raise ResourceNotFoundException(
+                    f"index template matching [{name}] not found")
+            names = [name]
+        elif name:
+            names = sorted(fnmatch.filter(registry, name))
+        else:
+            names = sorted(registry)
+        return 200, {"index_templates": [
+            {"name": n, "index_template": registry[n]} for n in names]}
+
+    def head_template(req: RestRequest):
+        return (200, {}) if req.param("name") in _registry(node) \
+            else (404, {})
+
+    def delete_template(req: RestRequest):
+        name = req.param("name")
+        if node.cluster is not None:
+            node.cluster.delete_template(name)
+        else:
+            node.templates.delete(name)
+        return 200, {"acknowledged": True}
+
+    def cat_templates(req: RestRequest):
+        from elasticsearch_tpu.rest.actions.cluster import _cat_table
+        rows = [[n, "[" + ", ".join(t["index_patterns"]) + "]",
+                 t.get("priority", 0), t.get("version") or "-"]
+                for n, t in sorted(_registry(node).items())]
+        return _cat_table(req, ["name", "index_patterns", "order",
+                                "version"], rows)
+
+    controller.register("PUT", "/_index_template/{name}", put_template)
+    controller.register("POST", "/_index_template/{name}", put_template)
+    controller.register("GET", "/_index_template/{name}", get_template)
+    controller.register("GET", "/_index_template", get_template)
+    controller.register("HEAD", "/_index_template/{name}", head_template)
+    controller.register("DELETE", "/_index_template/{name}",
+                        delete_template)
+    controller.register("GET", "/_cat/templates", cat_templates)

@@ -1,0 +1,158 @@
+"""Composable index templates.
+
+Reference: `cluster/metadata/ComposableIndexTemplate` +
+`MetadataIndexTemplateService` (SURVEY.md §2.1#49). Kept contracts: the
+modern _index_template API shapes (index_patterns, template.{settings,
+mappings, aliases}, priority), highest-priority match applies at index
+creation (explicit AND auto-create), and the creation request's own
+settings/mappings win over the template's on conflict.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             ResourceNotFoundException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.translog import write_atomic
+
+
+def validate_template(name: str, body: Dict[str, Any]) -> Dict[str, Any]:
+    if not isinstance(body, dict):
+        raise IllegalArgumentException("template body is required")
+    patterns = body.get("index_patterns")
+    if isinstance(patterns, str):
+        patterns = [patterns]
+    if not isinstance(patterns, list) or not patterns \
+            or not all(isinstance(p, str) and p for p in patterns):
+        raise IllegalArgumentException(
+            f"index template [{name}] requires [index_patterns] as a "
+            f"non-empty list of strings")
+    if body.get("composed_of"):
+        raise IllegalArgumentException(
+            "[composed_of] component templates are not supported")
+    template = body.get("template") or {}
+    unknown = set(template) - {"settings", "mappings", "aliases"}
+    if unknown:
+        raise IllegalArgumentException(
+            f"index template [{name}] unknown template keys "
+            f"{sorted(unknown)}")
+    for alias, props in (template.get("aliases") or {}).items():
+        from elasticsearch_tpu.indices.service import _validate_index_name
+        _validate_index_name(alias)
+        if (props or {}).get("filter") is not None:
+            from elasticsearch_tpu.search import dsl
+            dsl.parse_query(props["filter"])  # reject bad filters at PUT
+    try:
+        priority = int(body.get("priority") or 0)
+    except (TypeError, ValueError):
+        raise IllegalArgumentException(
+            f"index template [{name}] [priority] must be an integer, "
+            f"got [{body.get('priority')}]") from None
+    return {"index_patterns": list(patterns),
+            "template": template,
+            "priority": priority,
+            "version": body.get("version"),
+            "_meta": body.get("_meta")}
+
+
+def best_match(templates: Dict[str, Dict[str, Any]],
+               index_name: str) -> Optional[Dict[str, Any]]:
+    """Highest-priority template whose patterns match (name asc
+    tie-break, reference behavior)."""
+    candidates: List[Tuple[int, str, Dict[str, Any]]] = []
+    for name, tpl in templates.items():
+        if any(fnmatch.fnmatchcase(index_name, p)
+               for p in tpl["index_patterns"]):
+            candidates.append((tpl.get("priority", 0), name, tpl))
+    if not candidates:
+        return None
+    candidates.sort(key=lambda t: (-t[0], t[1]))
+    return candidates[0][2]
+
+
+def compose_creation(templates: Dict[str, Dict[str, Any]],
+                     index_name: str,
+                     request_settings: Dict[str, Any],
+                     request_mappings: Optional[dict]
+                     ) -> Tuple[Dict[str, Any], Optional[dict],
+                                Dict[str, Dict[str, Any]]]:
+    """→ (flat settings, mappings, aliases) for a new index: template
+    defaults underneath, the explicit request on top."""
+    tpl = best_match(templates, index_name)
+    req_flat = Settings.normalize_index_settings(request_settings)
+    if tpl is None:
+        return req_flat, request_mappings, {}
+    body = tpl.get("template") or {}
+    settings = Settings.normalize_index_settings(
+        body.get("settings") or {})
+    settings.update(req_flat)  # the request wins
+    mappings = _merge_mappings(body.get("mappings"), request_mappings)
+    aliases = {a: dict(p or {})
+               for a, p in (body.get("aliases") or {}).items()}
+    return settings, mappings, aliases
+
+
+def _merge_mappings(base: Optional[dict],
+                    override: Optional[dict]) -> Optional[dict]:
+    if not base:
+        return override
+    if not override:
+        return dict(base)
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge_mappings(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class TemplateService:
+    """Node-local template registry (single-node: gateway-persisted;
+    cluster mode keeps templates in the published state and syncs)."""
+
+    def __init__(self, state_path: str):
+        self._state_path = state_path
+        self.templates: Dict[str, Dict[str, Any]] = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self._state_path, "rb") as f:
+                data = json.loads(f.read().decode("utf-8"))
+            if isinstance(data, dict):
+                self.templates = data
+        except (OSError, json.JSONDecodeError):
+            pass
+
+    def _persist(self) -> None:
+        os.makedirs(os.path.dirname(self._state_path), exist_ok=True)
+        write_atomic(self._state_path,
+                     json.dumps(self.templates,
+                                sort_keys=True).encode("utf-8"))
+
+    def put(self, name: str, body: Dict[str, Any]) -> None:
+        self.templates[name] = validate_template(name, body)
+        self._persist()
+
+    def get(self, name: str) -> Dict[str, Any]:
+        tpl = self.templates.get(name)
+        if tpl is None:
+            raise ResourceNotFoundException(
+                f"index template matching [{name}] not found")
+        return tpl
+
+    def delete(self, name: str) -> None:
+        if name not in self.templates:
+            raise ResourceNotFoundException(
+                f"index template matching [{name}] not found")
+        del self.templates[name]
+        self._persist()
+
+    def sync(self, templates: Dict[str, Dict[str, Any]]) -> None:
+        self.templates = dict(templates)

@@ -297,6 +297,20 @@ def test_aliases_across_nodes(cluster):
     _handle(cluster[0], "DELETE", "/al-idx")
 
 
+def test_index_template_applies_in_cluster(cluster):
+    status, _ = _handle(cluster[0], "PUT", "/_index_template/metrics",
+                        body={"index_patterns": ["metrics-*"],
+                              "template": {"settings": {
+                                  "number_of_shards": 2}}})
+    assert status == 200
+    status, _ = _handle(cluster[1], "PUT", "/metrics-cpu", body={})
+    assert status == 200
+    state = cluster[1].cluster.applied_state()
+    assert state.indices["metrics-cpu"].number_of_shards == 2
+    _handle(cluster[0], "DELETE", "/metrics-cpu")
+    _handle(cluster[0], "DELETE", "/_index_template/metrics")
+
+
 def test_ingest_pipeline_propagates_across_nodes(cluster):
     """A pipeline PUT via one node rides the cluster state to every
     node and applies on whichever primary owner indexes the doc."""

@@ -1,0 +1,151 @@
+"""Composable index templates (reference:
+MetadataIndexTemplateService — SURVEY.md §2.1#49)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+class TestCrud:
+    def test_put_get_head_delete(self, node):
+        status, _ = _handle(node, "PUT", "/_index_template/t1", body={
+            "index_patterns": ["logs-*"], "priority": 10,
+            "template": {"settings": {"number_of_shards": 2}}})
+        assert status == 200
+        status, res = _handle(node, "GET", "/_index_template/t1")
+        assert res["index_templates"][0]["name"] == "t1"
+        assert res["index_templates"][0]["index_template"][
+            "priority"] == 10
+        status, _ = _handle(node, "HEAD", "/_index_template/t1")
+        assert status == 200
+        status, res = _handle(node, "GET", "/_index_template")
+        assert [t["name"] for t in res["index_templates"]] == ["t1"]
+        status, _ = _handle(node, "DELETE", "/_index_template/t1")
+        assert status == 200
+        status, _ = _handle(node, "GET", "/_index_template/t1")
+        assert status == 404
+
+    def test_validation(self, node):
+        status, _ = _handle(node, "PUT", "/_index_template/bad",
+                            body={"template": {}})
+        assert status == 400  # no index_patterns
+        status, _ = _handle(node, "PUT", "/_index_template/bad", body={
+            "index_patterns": ["x"], "composed_of": ["c"]})
+        assert status == 400
+
+    def test_bad_pattern_and_priority_types_400(self, node):
+        status, _ = _handle(node, "PUT", "/_index_template/bp", body={
+            "index_patterns": [123]})
+        assert status == 400
+        status, _ = _handle(node, "PUT", "/_index_template/bp", body={
+            "index_patterns": ["x-*"], "priority": "high"})
+        assert status == 400
+
+    def test_template_alias_clash_fails_whole_create(self, node):
+        _handle(node, "PUT", "/existing/_doc/1", body={"a": 1})
+        _handle(node, "PUT", "/_index_template/clash", body={
+            "index_patterns": ["c-*"],
+            "template": {"aliases": {"existing": {}}}})
+        status, _ = _handle(node, "PUT", "/c-1", body={})
+        assert status == 400
+        # NO half-created index left behind
+        status, _ = _handle(node, "HEAD", "/c-1")
+        assert status == 404
+
+    def test_cat_templates(self, node):
+        _handle(node, "PUT", "/_index_template/ct", body={
+            "index_patterns": ["a-*"], "priority": 3})
+        status, res = _handle(node, "GET", "/_cat/templates",
+                              params={"v": "true"})
+        assert status == 200 and "ct" in res["_cat"]
+
+
+class TestApplication:
+    def test_template_applies_on_explicit_create(self, node):
+        _handle(node, "PUT", "/_index_template/logs", body={
+            "index_patterns": ["logs-*"],
+            "template": {
+                "settings": {"number_of_shards": 3},
+                "mappings": {"properties": {
+                    "level": {"type": "keyword"}}},
+                "aliases": {"all-logs": {}}}})
+        status, _ = _handle(node, "PUT", "/logs-2026", body={})
+        assert status == 200
+        svc = node.indices.index("logs-2026")
+        assert svc.num_shards == 3
+        _s, m = _handle(node, "GET", "/logs-2026/_mapping")
+        assert m["logs-2026"]["mappings"]["properties"]["level"][
+            "type"] == "keyword"
+        # the template's alias was attached
+        status, _ = _handle(node, "HEAD", "/_alias/all-logs")
+        assert status == 200
+
+    def test_request_wins_over_template(self, node):
+        _handle(node, "PUT", "/_index_template/small", body={
+            "index_patterns": ["s-*"],
+            "template": {"settings": {"number_of_shards": 4}}})
+        _handle(node, "PUT", "/s-1", body={
+            "settings": {"number_of_shards": 1}})
+        assert node.indices.index("s-1").num_shards == 1
+
+    def test_priority_picks_highest(self, node):
+        _handle(node, "PUT", "/_index_template/low", body={
+            "index_patterns": ["p-*"], "priority": 1,
+            "template": {"settings": {"number_of_shards": 2}}})
+        _handle(node, "PUT", "/_index_template/high", body={
+            "index_patterns": ["p-*"], "priority": 9,
+            "template": {"settings": {"number_of_shards": 5}}})
+        _handle(node, "PUT", "/p-1", body={})
+        assert node.indices.index("p-1").num_shards == 5
+
+    def test_applies_on_autocreate(self, node):
+        _handle(node, "PUT", "/_index_template/auto", body={
+            "index_patterns": ["evt-*"],
+            "template": {"mappings": {"properties": {
+                "tag": {"type": "keyword"}}}}})
+        _handle(node, "PUT", "/evt-a/_doc/1",
+                params={"refresh": "true"}, body={"tag": "HOT"})
+        # keyword mapping from the template: term query matches raw
+        _s, res = _handle(node, "POST", "/evt-a/_search",
+                          body={"query": {"term": {"tag": "HOT"}}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_no_match_no_template(self, node):
+        _handle(node, "PUT", "/_index_template/scoped", body={
+            "index_patterns": ["only-*"],
+            "template": {"settings": {"number_of_shards": 4}}})
+        _handle(node, "PUT", "/other", body={})
+        assert node.indices.index("other").num_shards == 1
+
+    def test_templates_survive_restart(self, tmp_data_path):
+        n1 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        _handle(n1, "PUT", "/_index_template/keep", body={
+            "index_patterns": ["k-*"],
+            "template": {"settings": {"number_of_shards": 2}}})
+        n1.close()
+        n2 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            _handle(n2, "PUT", "/k-1", body={})
+            assert n2.indices.index("k-1").num_shards == 2
+        finally:
+            n2.close()
