@@ -639,15 +639,12 @@ class ClusterService:
             # template defaults compose UNDER the request, read from the
             # authoritative state inside the update (so template puts
             # racing this create serialize through the master queue)
-            from elasticsearch_tpu.templates import compose_creation
-            norm, mapping, aliases = compose_creation(
+            from elasticsearch_tpu.templates import \
+                compose_and_validate_creation
+            norm, mapping, aliases = compose_and_validate_creation(
                 state.index_templates, name,
-                payload.get("settings") or {}, payload.get("mapping"))
-            for alias in aliases:
-                if alias in state.indices and alias != name:
-                    raise IllegalArgumentException(
-                        f"alias [{alias}] (from the matching index "
-                        f"template) clashes with an index name")
+                payload.get("settings") or {}, payload.get("mapping"),
+                state.indices)
             flat = Settings(norm)
             n_shards = flat.get_int("index.number_of_shards", 1)
             n_replicas = flat.get_int("index.number_of_replicas", 0)
@@ -989,10 +986,23 @@ class ClusterService:
         while True:
             try:
                 return self._call_master_once(action, payload, timeout)
-            except (MasterNotDiscoveredException, ConnectionError,
-                    OSError, ConnectTransportException,
+            except (MasterNotDiscoveredException,
+                    ConnectTransportException,
                     NotMasterException, FailedToCommitException) as e:
-                last = e  # handoff window: wait for the new master
+                # all of these mean the update was definitively NOT
+                # applied (no master yet / connect failed before send /
+                # the publication didn't commit) — safe to retry even
+                # for non-idempotent actions
+                last = e
+            except (ConnectionError, OSError) as e:
+                # AMBIGUOUS: the master may have committed before the
+                # link died; a blind re-send of a non-idempotent action
+                # (create/delete) would report the duplicate's error for
+                # an operation that actually succeeded
+                raise MasterNotDiscoveredException(
+                    f"connection to the master failed mid-request for "
+                    f"[{action}]; the update may or may not have been "
+                    f"applied: {e}") from e
             except RemoteTransportException as e:
                 if e.error_type not in ("NotMasterException",
                                         "FailedToCommitException"):

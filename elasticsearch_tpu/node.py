@@ -253,18 +253,11 @@ class Node:
         """Index creation applies the best-matching index template's
         defaults underneath the request (reference:
         MetadataCreateIndexService template application)."""
-        from elasticsearch_tpu.templates import compose_creation
-        flat, merged_mappings, aliases = compose_creation(
+        from elasticsearch_tpu.templates import \
+            compose_and_validate_creation
+        flat, merged_mappings, aliases = compose_and_validate_creation(
             self.templates.templates, name, settings.get_as_dict(),
-            mappings)
-        # validate template aliases BEFORE creating: a clash must fail
-        # the whole request, not leave a half-created index behind
-        from elasticsearch_tpu.common.errors import IllegalArgumentException
-        for alias in aliases:
-            if alias in self.indices.indices and alias != name:
-                raise IllegalArgumentException(
-                    f"alias [{alias}] (from the matching index template) "
-                    f"clashes with an index name")
+            mappings, self.indices.indices)
         svc = self.indices.create_index(name, Settings(flat),
                                         merged_mappings)
         for alias, props in aliases.items():

@@ -27,7 +27,7 @@ def register(controller: RestController, node) -> None:
     def get_template(req: RestRequest):
         name = req.param("name")
         registry = _registry(node)
-        if name and "*" not in name:
+        if name and not any(c in name for c in "*?["):
             if name not in registry:
                 from elasticsearch_tpu.common.errors import \
                     ResourceNotFoundException

@@ -137,8 +137,7 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
     # unimplemented keys get a 400, never silently ignored (VERDICT r1
     # weak #1): a sorted/highlighted query must not return wrong results
     # with a 200
-    unsupported = set(body) & {"suggest", "collapse",
-                               "rescore", "script_fields"}
+    unsupported = set(body) & {"collapse", "rescore", "script_fields"}
     if unsupported:
         raise IllegalArgumentException(
             f"search body keys {sorted(unsupported)} are not supported "
@@ -146,7 +145,7 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
     unknown = set(body) - {"query", "aggs", "aggregations", "size", "from",
                            "_source", "min_score", "track_total_hits",
                            "sort", "search_after", "timeout", "pit",
-                           "profile", "highlight",
+                           "profile", "highlight", "suggest",
                            "version", "seq_no_primary_term"}
     if unknown:
         raise IllegalArgumentException(
@@ -354,6 +353,9 @@ def search(indices: IndicesService, index_expr: Optional[str],
     if profile:
         out["profile"] = {"shards": build_profile(
             query, shard_results, query_nanos, fetch_nanos)}
+    if body.get("suggest") is not None:
+        from elasticsearch_tpu.search.suggest import run_suggest
+        out["suggest"] = run_suggest(indices, names, body["suggest"])
     return out
 
 
@@ -653,6 +655,13 @@ def search_shard_group(indices: IndicesService,
         out["profile_shards"] = build_profile(
             query, group_profile_entries, group_query_nanos,
             group_fetch_nanos)
+    if body.get("suggest") is not None:
+        from elasticsearch_tpu.search.suggest import run_suggest
+        # restrict to the group's ASSIGNED shards: unselected local
+        # copies must not double-count in the cross-node merge
+        out["suggest"] = run_suggest(
+            indices, sorted(by_index.keys()), body["suggest"],
+            shard_filter=by_index)
     return out
 
 
@@ -716,6 +725,14 @@ def merge_group_responses(groups: List[Dict[str, Any]],
                  "max_score": max_score,
                  "hits": window},
     }
+
+    if body.get("suggest") is not None:
+        from elasticsearch_tpu.search.suggest import (merge_suggest,
+                                                      parse_suggest)
+        specs = parse_suggest(body["suggest"])
+        out["suggest"] = merge_suggest(
+            specs, [g.get("suggest") for g in groups
+                    if g.get("suggest") is not None])
 
     aggs_spec = body.get("aggs") or body.get("aggregations")
     if aggs_spec:

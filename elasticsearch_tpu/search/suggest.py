@@ -1,0 +1,214 @@
+"""Suggesters — the term suggester.
+
+Reference: `search/suggest/term/TermSuggester` + `DirectSpellChecker`
+(SURVEY.md §2.1#50). Kept contracts: the request grammar
+({"suggest": {name: {"text", "term": {"field", ...}}}}), per-token
+response entries with offset/length, candidates scored by edit
+distance then doc frequency, `suggest_mode` (missing | popular |
+always), `max_edits`, `prefix_length`, `min_word_length`, `size`.
+
+Candidate generation scans the shard term dictionaries with the same
+banded Damerau-Levenshtein the fuzzy query uses — one vocabulary pass
+per (token, shard), no per-doc work.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+_TOKEN = re.compile(r"\w+", re.UNICODE)
+
+
+def _bounded_distance(a: str, b: str, k: int):
+    """Damerau-Levenshtein distance if ≤ k, else None — ONE banded DP
+    pass (the candidate loop's hot function)."""
+    if a == b:
+        return 0
+    if abs(len(a) - len(b)) > k:
+        return None
+    prev2 = None
+    prev = list(range(len(b) + 1))
+    for i in range(1, len(a) + 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j in range(1, len(b) + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (prev2 is not None and i > 1 and j > 1
+                    and a[i - 1] == b[j - 2] and a[i - 2] == b[j - 1]):
+                d = min(d, prev2[j - 2] + 1)
+            cur[j] = d
+            row_min = min(row_min, d)
+        if row_min > k:
+            return None
+        prev2, prev = prev, cur
+    return prev[len(b)] if prev[len(b)] <= k else None
+
+
+class TermSuggestSpec:
+    def __init__(self, name: str, body: Dict[str, Any]):
+        self.name = name
+        self.text = body.get("text")
+        term = body.get("term")
+        if self.text is None or not isinstance(term, dict):
+            raise IllegalArgumentException(
+                f"suggester [{name}] requires [text] and [term]")
+        self.field = term.get("field")
+        if not self.field:
+            raise IllegalArgumentException(
+                f"suggester [{name}] requires [term.field]")
+        self.size = int(term.get("size", 5))
+        self.max_edits = int(term.get("max_edits", 2))
+        if self.max_edits not in (1, 2):
+            raise IllegalArgumentException(
+                "[term] max_edits must be 1 or 2")
+        self.prefix_length = int(term.get("prefix_length", 1))
+        self.min_word_length = int(term.get("min_word_length", 4))
+        self.suggest_mode = str(term.get("suggest_mode", "missing"))
+        if self.suggest_mode not in ("missing", "popular", "always"):
+            raise IllegalArgumentException(
+                f"[term] unknown suggest_mode [{self.suggest_mode}]")
+
+
+def parse_suggest(body: Dict[str, Any]) -> List[TermSuggestSpec]:
+    if not isinstance(body, dict):
+        raise IllegalArgumentException("[suggest] must be an object")
+    specs = []
+    global_text = body.get("text")
+    for name, spec in body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise IllegalArgumentException(
+                f"suggester [{name}] must be an object")
+        if "term" not in spec:
+            raise IllegalArgumentException(
+                f"suggester [{name}]: only the [term] suggester is "
+                f"supported")
+        if "text" not in spec and global_text is not None:
+            spec = dict(spec, text=global_text)
+        specs.append(TermSuggestSpec(name, spec))
+    return specs
+
+
+def _field_frequencies(indices, names: List[str], field: str,
+                       shard_filter=None) -> Dict[str, int]:
+    """term → doc frequency across the TARGET shards' term dicts.
+    shard_filter: {index: iterable of shard nums} — required in cluster
+    groups so unassigned local copies aren't double-counted in the
+    cross-node merge."""
+    freqs: Dict[str, int] = {}
+    for name in names:
+        svc = indices.index(name)
+        wanted = (None if shard_filter is None
+                  else set(shard_filter.get(name, ())))
+        for num, shard in sorted(svc.shards.items()):
+            if wanted is not None and num not in wanted:
+                continue
+            reader = shard.acquire_searcher()
+            for view in reader.views:
+                fp = view.pack.fields.get(field)
+                if fp is None:
+                    continue
+                for term, row in fp.vocab.items():
+                    freqs[term] = freqs.get(term, 0) + int(
+                        fp.doc_freq[row])
+    return freqs
+
+
+def run_suggest(indices, names: List[str],
+                body: Dict[str, Any],
+                shard_filter=None) -> Dict[str, Any]:
+    specs = parse_suggest(body)
+    out: Dict[str, Any] = {}
+    freq_cache: Dict[str, Dict[str, int]] = {}
+    for spec in specs:
+        freqs = freq_cache.get(spec.field)
+        if freqs is None:
+            freqs = _field_frequencies(indices, names, spec.field,
+                                       shard_filter)
+            freq_cache[spec.field] = freqs
+        entries = []
+        for m in _TOKEN.finditer(str(spec.text)):
+            token = m.group(0).lower()
+            entry = {"text": token, "offset": m.start(),
+                     "length": m.end() - m.start(), "options": []}
+            exists = freqs.get(token, 0) > 0
+            skip = (
+                len(token) < spec.min_word_length
+                or (spec.suggest_mode == "missing" and exists))
+            if not skip:
+                options = _candidates(token, freqs, spec)
+                entry["options"] = options
+            entries.append(entry)
+        out[spec.name] = entries
+    return out
+
+
+def merge_suggest(specs: List[TermSuggestSpec],
+                  partials: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Cross-node reduce: per token, merge candidate options by text
+    (summing doc freqs, keeping the best score), re-sort, cut to size
+    (reference: the suggest phase's reduce)."""
+    out: Dict[str, Any] = {}
+    by_name = {s.name: s for s in specs}
+    for name in by_name:
+        merged_entries: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        order: List[Tuple[str, int]] = []
+        for part in partials:
+            for entry in part.get(name, []):
+                key = (entry["text"], entry["offset"])
+                cur = merged_entries.get(key)
+                if cur is None:
+                    cur = {"text": entry["text"],
+                           "offset": entry["offset"],
+                           "length": entry["length"], "options": {}}
+                    merged_entries[key] = cur
+                    order.append(key)
+                for opt in entry["options"]:
+                    existing = cur["options"].get(opt["text"])
+                    if existing is None:
+                        cur["options"][opt["text"]] = dict(opt)
+                    else:
+                        existing["freq"] += opt["freq"]
+                        existing["score"] = max(existing["score"],
+                                                opt["score"])
+        size = by_name[name].size
+        out[name] = []
+        for key in order:
+            entry = merged_entries[key]
+            options = sorted(entry["options"].values(),
+                             key=lambda o: (-o["score"], -o["freq"],
+                                            o["text"]))[: size]
+            out[name].append({"text": entry["text"],
+                              "offset": entry["offset"],
+                              "length": entry["length"],
+                              "options": options})
+    return out
+
+
+def _candidates(token: str, freqs: Dict[str, int],
+                spec: TermSuggestSpec) -> List[Dict[str, Any]]:
+    prefix = token[: spec.prefix_length]
+    token_freq = freqs.get(token, 0)
+    scored: List[Tuple[float, int, str]] = []
+    for term, df in freqs.items():
+        if term == token or df <= 0:
+            continue
+        if spec.prefix_length and not term.startswith(prefix):
+            continue
+        if abs(len(term) - len(token)) > spec.max_edits:
+            continue
+        if spec.suggest_mode == "popular" and df <= token_freq:
+            continue
+        dist = _bounded_distance(token, term, spec.max_edits)
+        if dist is not None:
+            # reference scoring shape: closer edits first, then
+            # higher doc frequency
+            scored.append((1.0 - dist / max(len(token), 1), df, term))
+    scored.sort(key=lambda t: (-t[0], -t[1], t[2]))
+    return [{"text": term, "score": round(score, 6), "freq": df}
+            for score, df, term in scored[: spec.size]]

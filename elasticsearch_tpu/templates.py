@@ -97,6 +97,27 @@ def compose_creation(templates: Dict[str, Dict[str, Any]],
     return settings, mappings, aliases
 
 
+def compose_and_validate_creation(templates: Dict[str, Dict[str, Any]],
+                                  index_name: str,
+                                  request_settings: Dict[str, Any],
+                                  request_mappings: Optional[dict],
+                                  existing_names) -> Tuple[
+                                      Dict[str, Any], Optional[dict],
+                                      Dict[str, Dict[str, Any]]]:
+    """compose_creation + the alias-clash validation BOTH creation
+    paths (single-node and cluster master) must perform, shared so they
+    can't drift: a template alias clashing with an existing index fails
+    the whole request before anything is created."""
+    norm, mappings, aliases = compose_creation(
+        templates, index_name, request_settings, request_mappings)
+    for alias in aliases:
+        if alias in existing_names and alias != index_name:
+            raise IllegalArgumentException(
+                f"alias [{alias}] (from the matching index template) "
+                f"clashes with an index name")
+    return norm, mappings, aliases
+
+
 def _merge_mappings(base: Optional[dict],
                     override: Optional[dict]) -> Optional[dict]:
     if not base:

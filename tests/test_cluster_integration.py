@@ -74,9 +74,15 @@ def test_cluster_forms_and_elects_one_master(cluster):
     assert sum(masters) == 1
     state = cluster[0].cluster.applied_state()
     assert len(state.nodes) == 3
-    # every node applied the same state version
-    versions = {n.cluster.applied_state().version for n in cluster}
-    assert len(versions) == 1
+    # every node CONVERGES to the same state version (publication is
+    # async; allow propagation of any in-flight update)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        versions = {n.cluster.applied_state().version for n in cluster}
+        if len(versions) == 1:
+            break
+        time.sleep(0.1)
+    assert len(versions) == 1, versions
 
 
 def test_create_index_allocates_shards_across_nodes(cluster):
@@ -295,6 +301,31 @@ def test_aliases_across_nodes(cluster):
     assert status == 201, res
     assert res["_index"] == "al-idx"
     _handle(cluster[0], "DELETE", "/al-idx")
+
+
+def test_suggest_merges_across_nodes(cluster):
+    """Term-suggest candidates reduce across nodes: frequencies sum and
+    the best correction wins regardless of which shard held the docs."""
+    status, _b = _handle(cluster[0], "PUT", "/sugg", body={
+        "settings": {"number_of_shards": 3, "number_of_replicas": 0}})
+    assert status == 200, _b
+    lines = []
+    for i in range(24):
+        lines.append(json.dumps({"index": {"_index": "sugg",
+                                           "_id": f"g{i}"}}))
+        lines.append(json.dumps({"title": "common words here"}))
+    _handle(cluster[1], "POST", "/_bulk", body="\n".join(lines) + "\n")
+    _handle(cluster[2], "POST", "/sugg/_refresh")
+    status, res = _handle(cluster[0], "POST", "/sugg/_search", body={
+        "size": 0,
+        "suggest": {"fix": {"text": "commn",
+                            "term": {"field": "title"}}}})
+    assert status == 200, res
+    opts = res["suggest"]["fix"][0]["options"]
+    assert opts and opts[0]["text"] == "common"
+    # frequencies summed across the shard groups on all 3 nodes
+    assert opts[0]["freq"] == 24
+    _handle(cluster[0], "DELETE", "/sugg")
 
 
 def test_index_template_applies_in_cluster(cluster):

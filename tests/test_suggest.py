@@ -1,0 +1,97 @@
+"""Term suggester (reference: search/suggest/term/TermSuggester —
+SURVEY.md §2.1#50)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def corpus(node):
+    texts = ["the quick brown fox", "quick silver lining",
+             "a quick response", "slow brown bear", "brown paper bag"]
+    for i, t in enumerate(texts):
+        _handle(node, "PUT", f"/s/_doc/{i}", params={"refresh": "true"},
+                body={"body": t})
+    return node
+
+
+def _suggest(node, body, index="s"):
+    status, res = _handle(node, "POST", f"/{index}/_search",
+                          body={"size": 0, "suggest": body})
+    assert status == 200, res
+    return res["suggest"]
+
+
+class TestTermSuggest:
+    def test_misspelling_corrected(self, corpus):
+        out = _suggest(corpus, {"fix": {
+            "text": "quikc borwn", "term": {"field": "body"}}})
+        entries = out["fix"]
+        assert [e["text"] for e in entries] == ["quikc", "borwn"]
+        assert entries[0]["options"][0]["text"] == "quick"
+        assert entries[0]["options"][0]["freq"] == 3
+        assert entries[1]["options"][0]["text"] == "brown"
+        assert entries[1]["offset"] == 6
+
+    def test_existing_word_skipped_in_missing_mode(self, corpus):
+        out = _suggest(corpus, {"fix": {
+            "text": "quick", "term": {"field": "body"}}})
+        assert out["fix"][0]["options"] == []
+        out = _suggest(corpus, {"fix": {
+            "text": "quick", "term": {"field": "body",
+                                      "suggest_mode": "always",
+                                      "prefix_length": 0}}})
+        # always mode offers alternatives even for known words
+        assert isinstance(out["fix"][0]["options"], list)
+
+    def test_size_and_ranking(self, corpus):
+        out = _suggest(corpus, {"fix": {
+            "text": "browm", "term": {"field": "body", "size": 1}}})
+        opts = out["fix"][0]["options"]
+        assert len(opts) == 1 and opts[0]["text"] == "brown"
+
+    def test_short_tokens_skipped(self, corpus):
+        out = _suggest(corpus, {"fix": {
+            "text": "teh", "term": {"field": "body"}}})
+        assert out["fix"][0]["options"] == []  # below min_word_length
+
+    def test_global_text_and_validation(self, corpus):
+        out = _suggest(corpus, {"text": "quikc",
+                                "fix": {"term": {"field": "body"}}})
+        assert out["fix"][0]["options"][0]["text"] == "quick"
+        status, _ = _handle(corpus, "POST", "/s/_search", body={
+            "suggest": {"fix": {"text": "x",
+                                "phrase": {"field": "body"}}}})
+        assert status == 400  # only term suggester
+        status, _ = _handle(corpus, "POST", "/s/_search", body={
+            "suggest": {"fix": {"text": "x", "term": {
+                "field": "body", "max_edits": 5}}}})
+        assert status == 400
+
+    def test_search_plus_suggest_combined(self, corpus):
+        status, res = _handle(corpus, "POST", "/s/_search", body={
+            "query": {"match": {"body": "brown"}},
+            "suggest": {"fix": {"text": "qiuck",
+                                "term": {"field": "body"}}}})
+        assert status == 200
+        assert res["hits"]["total"]["value"] == 3
+        assert res["suggest"]["fix"][0]["options"][0]["text"] == "quick"
