@@ -344,8 +344,8 @@ class Node:
                body: Any = None, raw_body: bytes = b""):
         if body is None and raw_body:
             text = raw_body.decode("utf-8", errors="replace")
-            if path.endswith("/_bulk"):
-                body = text
+            if path.endswith(("/_bulk", "/_msearch")):
+                body = text  # NDJSON bodies parse per line downstream
             elif text.strip():
                 from elasticsearch_tpu.common.errors import ParsingException
                 try:

@@ -13,6 +13,23 @@ from elasticsearch_tpu.search import coordinator
 def register(controller: RestController, node) -> None:
     indices = node.indices
 
+    def _execute_search(index, body, params, task):
+        """One search request — pit bodies, cluster routing, and the
+        local planner all covered (shared by _search and _msearch so an
+        item body never silently drops a key)."""
+        from elasticsearch_tpu.search import scroll as scroll_mod
+        if "pit" in body:
+            if not isinstance(body["pit"], dict):
+                raise IllegalArgumentException(
+                    "[pit] must be an object with an [id]")
+            return scroll_mod.search_pit(node, body, params, task=task)
+        if node.cluster is not None:
+            return node.cluster.route_search(index, body, params,
+                                             task=task)
+        return coordinator.search(
+            indices, index, body, params,
+            tpu_search=getattr(node, "tpu_search", None), task=task)
+
     def do_search(req: RestRequest):
         from elasticsearch_tpu.search import scroll as scroll_mod
         task = node.task_manager.register(
@@ -23,18 +40,8 @@ def register(controller: RestController, node) -> None:
             if req.params.get("scroll"):
                 return 200, scroll_mod.start_scroll(
                     node, req.param("index"), body, req.params, task=task)
-            if "pit" in body:
-                if not isinstance(body["pit"], dict):
-                    raise IllegalArgumentException(
-                        "[pit] must be an object with an [id]")
-                return 200, scroll_mod.search_pit(node, body, req.params,
-                                                  task=task)
-            if node.cluster is not None:
-                return 200, node.cluster.route_search(
-                    req.param("index"), body, req.params, task=task)
-            return 200, coordinator.search(
-                indices, req.param("index"), body, req.params,
-                tpu_search=getattr(node, "tpu_search", None), task=task)
+            return 200, _execute_search(req.param("index"), body,
+                                        req.params, task)
         finally:
             node.task_manager.unregister(task)
 
@@ -107,6 +114,58 @@ def register(controller: RestController, node) -> None:
                                "type": "<ALPHANUM>"})
         return 200, {"tokens": tokens}
 
+    def do_msearch(req: RestRequest):
+        """_msearch: NDJSON header/body pairs; one response per search,
+        failures reported per item (reference: RestMultiSearchAction)."""
+        import json as _json
+        raw = req.raw_body.decode("utf-8", errors="replace") \
+            if req.raw_body else (
+                req.body if isinstance(req.body, str) else "")
+        lines = [ln for ln in raw.split("\n") if ln.strip()]
+        if not lines:
+            raise IllegalArgumentException(
+                "[_msearch] request body or source parameter is "
+                "required")
+        if len(lines) % 2 != 0:
+            raise IllegalArgumentException(
+                "[_msearch] expects header/body line pairs")
+        task = node.task_manager.register(
+            "indices:data/read/msearch",
+            description=f"[{len(lines) // 2}] searches")
+        responses = []
+        default_index = req.param("index")
+        try:
+            for i in range(0, len(lines), 2):
+                task.ensure_not_cancelled()
+                try:
+                    header = _json.loads(lines[i])
+                    body = _json.loads(lines[i + 1])
+                    index = header.get("index", default_index)
+                    if isinstance(index, list):
+                        index = ",".join(index)
+                    item = _execute_search(index, body, {}, task)
+                    item["status"] = 200
+                    responses.append(item)
+                except Exception as exc:  # noqa: BLE001 — per item
+                    from elasticsearch_tpu.common.errors import \
+                        TaskCancelledException
+                    if isinstance(exc, TaskCancelledException):
+                        raise
+                    from elasticsearch_tpu.rest.controller import (
+                        error_body, error_status)
+                    status = error_status(exc)
+                    item = error_body(exc, status)
+                    item["status"] = status
+                    responses.append(item)
+        finally:
+            node.task_manager.unregister(task)
+        return 200, {"took": sum(r.get("took", 0) for r in responses),
+                     "responses": responses}
+
+    controller.register("POST", "/_msearch", do_msearch)
+    controller.register("GET", "/_msearch", do_msearch)
+    controller.register("POST", "/{index}/_msearch", do_msearch)
+    controller.register("GET", "/{index}/_msearch", do_msearch)
     controller.register("GET", "/_search", do_search)
     controller.register("POST", "/_search", do_search)
     controller.register("GET", "/{index}/_search", do_search)

@@ -12,6 +12,8 @@ from elasticsearch_tpu.node import Node
 
 
 def _handle(node, method, path, params=None, body=None):
+    if isinstance(body, str):
+        return node.handle(method, path, params, None, body.encode())
     raw = json.dumps(body).encode("utf-8") if body is not None else b""
     return node.handle(method, path, params, None, raw)
 
@@ -86,6 +88,37 @@ class TestTermSuggest:
             "suggest": {"fix": {"text": "x", "term": {
                 "field": "body", "max_edits": 5}}}})
         assert status == 400
+
+    def test_msearch(self, corpus):
+        lines = [json.dumps({"index": "s"}),
+                 json.dumps({"query": {"match": {"body": "quick"}},
+                             "size": 1}),
+                 json.dumps({}),
+                 json.dumps({"query": {"match": {"body": "brown"}},
+                             "size": 0}),
+                 json.dumps({"index": "missing-idx"}),
+                 json.dumps({"query": {"match_all": {}}})]
+        status, res = _handle(corpus, "POST", "/s/_msearch",
+                              body="\n".join(lines) + "\n")
+        assert status == 200, res
+        r0, r1, r2 = res["responses"]
+        assert r0["status"] == 200 and r0["hits"]["total"]["value"] == 3
+        assert len(r0["hits"]["hits"]) == 1
+        assert r1["hits"]["total"]["value"] == 3  # {} header → url index
+        assert r2["status"] == 404  # per-item failure, not whole-request
+
+    def test_msearch_rejects_empty_and_honors_pit(self, corpus):
+        status, _ = _handle(corpus, "POST", "/_msearch", body="\n")
+        assert status == 400
+        # an item naming a bogus pit must FAIL that item, never run a
+        # silent live search
+        lines = [json.dumps({}),
+                 json.dumps({"query": {"match_all": {}},
+                             "pit": {"id": "no-such-context"}})]
+        status, res = _handle(corpus, "POST", "/s/_msearch",
+                              body="\n".join(lines) + "\n")
+        assert status == 200
+        assert res["responses"][0]["status"] == 404
 
     def test_search_plus_suggest_combined(self, corpus):
         status, res = _handle(corpus, "POST", "/s/_search", body={
