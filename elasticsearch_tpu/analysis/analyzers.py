@@ -47,9 +47,16 @@ _LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
 
 
 def standard_tokenize(text: str, max_token_length: int = 255) -> List[str]:
+    toks = _WORD_RE.findall(text)
+    # fast path (the overwhelmingly common case for natural text): no
+    # underscores to strip, no overlong tokens to split — findall's list
+    # is the answer (bulk indexing is tokenizer-bound; VERDICT r3 #4)
+    if "_" not in text and (not toks
+                            or max(map(len, toks)) <= max_token_length):
+        return toks
     out = []
-    for m in _WORD_RE.finditer(text):
-        t = m.group(0).replace("_", "")
+    for t in toks:
+        t = t.replace("_", "")
         if not t:
             continue
         # overlong tokens are split at max_token_length, as the reference does
@@ -80,20 +87,24 @@ class Analyzer:
     def filters(self) -> Sequence[Callable[[List[Optional[str]]], List[Optional[str]]]]:
         return ()
 
+    def analyze_slots(self, text: str) -> List[Optional[str]]:
+        """Tokenize + run the filter chain, returning the raw SLOTS (term
+        or None per position). The bulk indexing path consumes slots
+        directly — positions are slot indices, so per-token Token objects
+        never exist on the write path (VERDICT r3 #4)."""
+        slots: List[Optional[str]] = self.tokenize(text)
+        for f in self.filters():
+            slots = f(slots)
+        return slots
+
     def analyze(self, text: str) -> List[Token]:
         """Run the chain. Filters see/emit per-slot terms; a filter marks a
         removed token as None, which leaves a position hole."""
-        slots: List[Optional[str]] = list(self.tokenize(text))
-        for f in self.filters():
-            slots = f(slots)
-        tokens: List[Token] = []
-        for pos, term in enumerate(slots):
-            if term:
-                tokens.append(Token(term, pos))
-        return tokens
+        return [Token(term, pos)
+                for pos, term in enumerate(self.analyze_slots(text)) if term]
 
     def terms(self, text: str) -> List[str]:
-        return [t.term for t in self.analyze(text)]
+        return [t for t in self.analyze_slots(text) if t]
 
 
 def lowercase_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
@@ -132,6 +143,7 @@ class StandardAnalyzer(Analyzer):
 
     def __init__(self, max_token_length: int = 255, stopwords=()):
         self.max_token_length = max_token_length
+        self._has_stop = bool(stopwords)
         self._filters = [lowercase_filter]
         if stopwords:
             self._filters.append(make_stop_filter(stopwords))
@@ -141,6 +153,14 @@ class StandardAnalyzer(Analyzer):
 
     def filters(self):
         return self._filters
+
+    def analyze_slots(self, text: str) -> List[Optional[str]]:
+        # no stop filter (the default) ⇒ tokenize emits no holes and the
+        # chain is exactly one lowercase pass — C-level map, no genexprs
+        if not self._has_stop:
+            return list(map(str.lower,
+                            standard_tokenize(text, self.max_token_length)))
+        return super().analyze_slots(text)
 
 
 class SimpleAnalyzer(Analyzer):

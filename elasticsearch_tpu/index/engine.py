@@ -303,6 +303,61 @@ class InternalEngine:
             self.translog.add(TranslogOp("index", seq_no, primary_term,
                                          doc_id, source, version))
 
+    def bulk_index(self, docs: List[Tuple[str, dict]]) -> List[Any]:
+        """Primary-path bulk upsert (plain index ops — create/CAS/external
+        versioning take the per-op path). Parses documents OUTSIDE the
+        engine lock (analysis is the indexing hot loop), then applies the
+        whole batch under one lock acquisition with one translog append +
+        fsync (reference: TransportShardBulkAction applies a shard bulk as
+        one unit; SURVEY.md §3.2, P6; VERDICT r3 #4)."""
+        mapper = self.config.mapper
+        parsed_docs: List[Any] = []  # ParsedDocument | Exception, per op
+        for d, s in docs:
+            try:
+                parsed_docs.append(mapper.parse_document(d, s))
+            except Exception as exc:  # per-item failure, like _bulk items
+                parsed_docs.append(exc)
+        results: List[Any] = []  # IndexResult | Exception, aligned with docs
+        tl_ops: List[TranslogOp] = []
+        with self._lock:
+            self._ensure_open()
+            dv_kinds = mapper.dv_kinds()
+            dv_mapper = mapper.mapper
+            for parsed in parsed_docs:
+                if isinstance(parsed, Exception):
+                    results.append(parsed)
+                    continue
+                doc_id = parsed.doc_id
+                existing = self._resolve_version(doc_id)
+                is_update = existing is not None and not existing.deleted
+                new_version = (existing.version + 1) \
+                    if existing is not None else 1
+                seq_no = self.tracker.generate_seq_no()
+                primary_term = self.config.primary_term
+                if existing is not None and existing.location is not None:
+                    self._tombstone_location(existing.location)
+                if mapper.mapper is not dv_mapper:  # dynamic field mid-batch
+                    dv_kinds = mapper.dv_kinds()
+                    dv_mapper = mapper.mapper
+                ord_ = self._writer.add_document(
+                    parsed, dv_kinds, seq_no=seq_no,
+                    primary_term=primary_term, version=new_version)
+                self._version_map[doc_id] = VersionValue(
+                    seq_no, primary_term, new_version, False,
+                    ("buffer", ord_))
+                tl_ops.append(TranslogOp("index", seq_no, primary_term,
+                                         doc_id, parsed.source, new_version))
+                results.append(IndexResult(
+                    doc_id, seq_no, primary_term, new_version,
+                    created=not is_update,
+                    result="updated" if is_update else "created"))
+            self.translog.add_batch(tl_ops)
+            for r in results:
+                if isinstance(r, IndexResult):
+                    self.tracker.mark_processed(r.seq_no)
+                    self._mark_durable(r.seq_no)
+        return results
+
     def delete(self, doc_id: str, *,
                seq_no: Optional[int] = None, primary_term: Optional[int] = None,
                if_seq_no: Optional[int] = None,

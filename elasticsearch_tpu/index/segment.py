@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticsearch_tpu.mapping import ParsedDocument
-from elasticsearch_tpu.ops.smallfloat import encode_norm
+from elasticsearch_tpu.ops.smallfloat import encode_norm, encode_norms
 
 MISSING_I64 = -(2**63)
 
@@ -71,7 +71,8 @@ class Segment:
                  exact_lengths: Optional[Dict[str, np.ndarray]] = None,
                  seq_nos: Optional[np.ndarray] = None,
                  primary_terms: Optional[np.ndarray] = None,
-                 doc_versions: Optional[np.ndarray] = None):
+                 doc_versions: Optional[np.ndarray] = None,
+                 token_slots: Optional[Dict[str, Dict[int, List[List[Optional[str]]]]]] = None):
         self.name = name
         self.num_docs = num_docs
         self.doc_ids = doc_ids                    # local doc ord -> external _id
@@ -80,7 +81,12 @@ class Segment:
         self.field_stats = field_stats
         self.doc_values = doc_values
         self.stored_source = stored_source
-        self.positions = positions or {}
+        # positions are LAZY when token_slots is given (the bulk write
+        # path): phrase queries are the only consumer, so the per-term
+        # position maps materialize on first access per field, not at
+        # index time (VERDICT r3 #4)
+        self.token_slots = token_slots or {}
+        self._positions = positions or {}
         # exact token counts per doc (i64, -1 = field absent): norms are the
         # lossy scoring representation; stats (avgdl) must stay EXACT across
         # merges, as Lucene maintains sumTotalTermFreq exactly
@@ -95,6 +101,30 @@ class Segment:
         self.doc_versions = doc_versions if doc_versions is not None else \
             np.ones(num_docs, dtype=np.int64)
         self.id_to_ord: Dict[str, int] = {d: i for i, d in enumerate(doc_ids)}
+
+    @property
+    def positions(self) -> Dict[str, Dict[str, Dict[int, np.ndarray]]]:
+        """{field: {term: {doc ord: positions i32[]}}} — materialized from
+        token_slots on first access for fields indexed through the bulk
+        path. Copy-on-write: _positions is replaced atomically, never
+        mutated in place, so a concurrent save_segment iterating the old
+        dict (flush racing the first phrase query) stays consistent."""
+        missing = [f for f in self.token_slots if f not in self._positions]
+        if missing:
+            from elasticsearch_tpu.mapping.mapper import slots_to_positions
+            new = dict(self._positions)
+            for field in missing:
+                built: Dict[str, Dict[int, List[int]]] = {}
+                for ord_, slot_lists in self.token_slots[field].items():
+                    for term, pos in slots_to_positions(slot_lists):
+                        built.setdefault(term, {}).setdefault(
+                            ord_, []).append(pos)
+                new[field] = {
+                    term: {d: np.asarray(p, dtype=np.int32)
+                           for d, p in docs.items()}
+                    for term, docs in built.items()}
+            self._positions = new
+        return self._positions
 
     def doc_freq(self, field: str, term: str) -> int:
         entry = self.postings.get(field, {}).get(term)
@@ -125,8 +155,12 @@ class SegmentWriter:
     def __init__(self, name: str):
         self.name = name
         self._doc_ids: List[str] = []
-        self._postings: Dict[str, Dict[str, List[Tuple[int, int]]]] = {}
-        self._positions: Dict[str, Dict[str, Dict[int, List[int]]]] = {}
+        # per field: parallel (doc ord, terms list) entries — postings
+        # build is deferred to freeze() where it runs as array ops over
+        # the whole buffer instead of per-token dict updates (the DWPT
+        # analog of "build the inverted index at flush"; VERDICT r3 #4)
+        self._doc_terms: Dict[str, List[Tuple[int, List[str]]]] = {}
+        self._doc_slots: Dict[str, Dict[int, List[List[Optional[str]]]]] = {}
         self._field_lengths: Dict[str, Dict[int, int]] = {}
         self._field_stats: Dict[str, FieldStats] = {}
         self._doc_values: Dict[str, Dict[int, Any]] = {}
@@ -152,16 +186,10 @@ class SegmentWriter:
         self._primary_terms.append(primary_term)
         self._versions.append(version)
         for field, terms in doc.postings_terms.items():
-            field_postings = self._postings.setdefault(field, {})
-            tf: Dict[str, int] = {}
-            for t in terms:
-                tf[t] = tf.get(t, 0) + 1
-            for t, f in tf.items():
-                field_postings.setdefault(t, []).append((ord_, f))
-        for field, toks in doc.positions.items():
-            fp = self._positions.setdefault(field, {})
-            for term, pos in toks:
-                fp.setdefault(term, {}).setdefault(ord_, []).append(pos)
+            if terms:
+                self._doc_terms.setdefault(field, []).append((ord_, terms))
+        for field, slot_lists in doc.term_slots.items():
+            self._doc_slots.setdefault(field, {})[ord_] = slot_lists
         for field, length in doc.field_lengths.items():
             self._field_lengths.setdefault(field, {})[ord_] = length
             stats = self._field_stats.setdefault(field, FieldStats())
@@ -176,39 +204,73 @@ class SegmentWriter:
     def freeze(self) -> Segment:
         n = len(self._doc_ids)
         postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
-        for field, terms in self._postings.items():
-            out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
-            for term, pl in terms.items():
-                docs = np.array([d for d, _ in pl], dtype=np.int32)
-                tfs = np.array([f for _, f in pl], dtype=np.int32)
-                out[term] = (docs, tfs)
-            postings[field] = out
+        for field, entries in self._doc_terms.items():
+            postings[field] = _build_postings(entries, n)
         norms: Dict[str, np.ndarray] = {}
         exact_lengths: Dict[str, np.ndarray] = {}
         for field, lengths in self._field_lengths.items():
             col = np.zeros(n, dtype=np.uint8)
             exact = np.full(n, -1, dtype=np.int64)
-            for ord_, length in lengths.items():
-                col[ord_] = encode_norm(length)
-                exact[ord_] = length
+            ords = np.fromiter(lengths.keys(), dtype=np.int64,
+                               count=len(lengths))
+            vals = np.fromiter(lengths.values(), dtype=np.int64,
+                               count=len(lengths))
+            col[ords] = encode_norms(vals)
+            exact[ords] = vals
             norms[field] = col
             exact_lengths[field] = exact
         doc_values: Dict[str, DocValuesColumn] = {}
         for field, per_doc in self._doc_values.items():
             kind = self._dv_kinds.get(field, "i64")
             doc_values[field] = _build_dv_column(kind, per_doc, n)
-        positions = {
-            field: {term: {d: np.array(p, dtype=np.int32) for d, p in docs.items()}
-                    for term, docs in terms.items()}
-            for field, terms in self._positions.items()
-        }
         return Segment(self.name, n, list(self._doc_ids), postings, norms,
                        dict(self._field_stats), doc_values, list(self._stored),
-                       positions, exact_lengths,
+                       None, exact_lengths,
                        seq_nos=np.array(self._seq_nos, dtype=np.int64),
                        primary_terms=np.array(self._primary_terms,
                                               dtype=np.int64),
-                       doc_versions=np.array(self._versions, dtype=np.int64))
+                       doc_versions=np.array(self._versions, dtype=np.int64),
+                       token_slots={f: dict(d)
+                                    for f, d in self._doc_slots.items()})
+
+
+def _build_postings(entries: List[Tuple[int, List[str]]], n: int
+                    ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """(doc ord, terms) pairs → {term: (docs i32[], tfs i32[])} sorted by
+    doc, built with sort-based array ops: one (term_id · n + doc) key per
+    token, one np.unique pass for (term, doc, tf) triples. O(tokens log
+    tokens) in C instead of per-token dict mutation."""
+    doc_ords = np.repeat(
+        np.fromiter((e[0] for e in entries), dtype=np.int64,
+                    count=len(entries)),
+        np.fromiter((len(e[1]) for e in entries), dtype=np.int64,
+                    count=len(entries)))
+    flat: List[str] = []
+    for _, terms in entries:
+        flat.extend(terms)
+    if not flat:
+        return {}
+    # fixed-width numpy strings sort in C; degenerate overlong terms would
+    # blow the '<U' width up, so fall back to a python vocab dict there
+    if max(map(len, flat)) <= 64:
+        uniq_arr, inv = np.unique(np.asarray(flat, dtype=np.str_),
+                                  return_inverse=True)
+        uniq = uniq_arr.tolist()
+        inv = inv.astype(np.int64)
+    else:
+        vocab: Dict[str, int] = {}
+        inv = np.fromiter((vocab.setdefault(t, len(vocab)) for t in flat),
+                          dtype=np.int64, count=len(flat))
+        uniq = list(vocab.keys())
+    key = inv * n + doc_ords
+    uk, tfs = np.unique(key, return_counts=True)
+    term_idx = uk // n
+    doc_idx = (uk - term_idx * n).astype(np.int32)
+    tfs = tfs.astype(np.int32)
+    bounds = np.searchsorted(term_idx, np.arange(len(uniq) + 1))
+    return {uniq[t]: (doc_idx[bounds[t]:bounds[t + 1]],
+                      tfs[bounds[t]:bounds[t + 1]])
+            for t in range(len(uniq))}
 
 
 def _build_dv_column(kind: str, per_doc: Dict[int, Any], n: int) -> DocValuesColumn:
@@ -269,6 +331,7 @@ def merge_segments(name: str, segments: List[Segment],
 
     postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
     positions: Dict[str, Dict[str, Dict[int, np.ndarray]]] = {}
+    token_slots: Dict[str, Dict[int, List[List[Optional[str]]]]] = {}
     norms: Dict[str, np.ndarray] = {}
     field_stats: Dict[str, FieldStats] = {}
     dv_parts: Dict[str, List[Tuple[int, DocValuesColumn, np.ndarray]]] = {}
@@ -286,6 +349,12 @@ def merge_segments(name: str, segments: List[Segment],
         exact_col = np.full(n, -1, dtype=np.int64)
         has_norms = False
         stats = FieldStats()
+        # positions: carry the compact token_slots through when every
+        # contributor has them (bulk-path segments) — phrase data stays
+        # lazy across merges; otherwise materialize the per-term maps
+        slots_ok = all(field in seg.token_slots
+                       or field not in seg._positions
+                       for seg in segments)
         for i, seg in enumerate(segments):
             m = remap[i]
             for term, (docs, tfs) in seg.postings.get(field, {}).items():
@@ -294,11 +363,17 @@ def merge_segments(name: str, segments: List[Segment],
                 if keep.any():
                     acc.setdefault(term, []).append(
                         (new[keep].astype(np.int32), tfs[keep]))
-            for term, docpos in seg.positions.get(field, {}).items():
-                for d, pos in docpos.items():
+            if slots_ok:
+                for d, slot_lists in seg.token_slots.get(field, {}).items():
                     nd = int(m[d])
                     if nd >= 0:
-                        positions.setdefault(field, {}).setdefault(term, {})[nd] = pos
+                        token_slots.setdefault(field, {})[nd] = slot_lists
+            else:
+                for term, docpos in seg.positions.get(field, {}).items():
+                    for d, pos in docpos.items():
+                        nd = int(m[d])
+                        if nd >= 0:
+                            positions.setdefault(field, {}).setdefault(term, {})[nd] = pos
             if field in seg.norms:
                 has_norms = True
                 src = seg.norms[field]
@@ -359,4 +434,5 @@ def merge_segments(name: str, segments: List[Segment],
                    stored, positions, exact_lengths,
                    seq_nos=np.array(seq_nos, dtype=np.int64),
                    primary_terms=np.array(primary_terms, dtype=np.int64),
-                   doc_versions=np.array(doc_versions, dtype=np.int64))
+                   doc_versions=np.array(doc_versions, dtype=np.int64),
+                   token_slots=token_slots)

@@ -67,6 +67,15 @@ class IndexShard:
         self._update_own_checkpoint()
         return result
 
+    def apply_bulk_index_on_primary(self, docs) -> List[Any]:
+        """Batched primary upsert: [(doc_id, source), ...] → per-op
+        IndexResult | Exception (reference: TransportShardBulkAction's
+        one-unit shard bulk, SURVEY.md §3.2)."""
+        self._ensure_primary()
+        results = self.engine.bulk_index(docs)
+        self._update_own_checkpoint()
+        return results
+
     def apply_delete_on_primary(self, doc_id: str, **version_kwargs) -> DeleteResult:
         self._ensure_primary()
         result = self.engine.delete(doc_id, **version_kwargs)

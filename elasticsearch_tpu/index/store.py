@@ -50,10 +50,18 @@ def save_segment(path: str, seg: Segment) -> Dict[str, int]:
         "stored": seg.stored_source,
         "field_stats": {f: [st.doc_count, st.sum_total_term_freq]
                         for f, st in seg.field_stats.items()},
+        # bulk-path segments persist the compact token_slots (positions
+        # stay lazy across save/load); pre-bulk segments persist the
+        # materialized per-term maps. seg._positions is read directly so
+        # saving never forces materialization.
         "positions": {
             f: {t: {str(d): p.tolist() for d, p in docs.items()}
                 for t, docs in terms.items()}
-            for f, terms in seg.positions.items()},
+            for f, terms in seg._positions.items()
+            if f not in seg.token_slots},
+        "token_slots": {
+            f: {str(d): sl for d, sl in per_doc.items()}
+            for f, per_doc in seg.token_slots.items()},
         "postings_fields": {}, "dv": {},
     }
     for field, terms in seg.postings.items():
@@ -145,6 +153,9 @@ def load_segment(path: str, name: str,
                 for d, p in docs.items()}
             for t, docs in terms.items()}
         for f, terms in meta["positions"].items()}
+    token_slots = {
+        f: {int(d): sl for d, sl in per_doc.items()}
+        for f, per_doc in meta.get("token_slots", {}).items()}
     seq_nos = arrays["meta.seq_nos"] if "meta.seq_nos" in arrays.files else None
     primary_terms = (arrays["meta.primary_terms"]
                      if "meta.primary_terms" in arrays.files else None)
@@ -153,7 +164,7 @@ def load_segment(path: str, name: str,
     return Segment(meta["name"], meta["num_docs"], meta["doc_ids"], postings,
                    norms, field_stats, doc_values, meta["stored"], positions,
                    exact, seq_nos=seq_nos, primary_terms=primary_terms,
-                   doc_versions=doc_versions)
+                   doc_versions=doc_versions, token_slots=token_slots)
 
 
 def write_commit(path: str, *, segments: List[str],

@@ -51,8 +51,37 @@ class ParsedDocument:
     source: Dict[str, Any]
     postings_terms: Dict[str, List[str]]
     field_lengths: Dict[str, int]
-    positions: Dict[str, List[Tuple[str, int]]]
+    # text fields: one slots list (term-or-None per position) PER VALUE of
+    # the field — positions derive from slot indices + the 100-position
+    # array gap, so the write path never builds per-token tuples
+    # (VERDICT r3 #4); `positions` below derives the legacy view
+    term_slots: Dict[str, List[List[Optional[str]]]]
     doc_values: Dict[str, Any]
+
+    @property
+    def positions(self) -> Dict[str, List[Tuple[str, int]]]:
+        """{field: [(term, position), ...]} with Lucene's
+        position_increment_gap=100 between array values."""
+        return {field: slots_to_positions(slot_lists)
+                for field, slot_lists in self.term_slots.items()}
+
+
+def slots_to_positions(slot_lists: List[List[Optional[str]]]
+                       ) -> List[Tuple[str, int]]:
+    """Per-value slot lists → [(term, absolute position)], reproducing the
+    write-path gap rule: value j starts at (tokens so far) + 100·(values
+    so far with tokens before them)."""
+    out: List[Tuple[str, int]] = []
+    base = 0
+    for slots in slot_lists:
+        gap = 100 if base else 0
+        n = 0
+        for si, term in enumerate(slots):
+            if term:
+                out.append((term, si + base + gap))
+                n += 1
+        base = base + gap + n
+    return out
 
 
 class DocumentMapper:
@@ -218,16 +247,16 @@ class MapperService:
         for v in values:
             if ft.is_indexed:
                 if isinstance(ft, TextFieldType):
-                    tokens = ft.index_tokens(v)
-                    terms = [t.term for t in tokens]
+                    # slots carry the positions implicitly (index = slot,
+                    # holes = None); the +100 array-value gap is applied
+                    # lazily by slots_to_positions — no per-token work here
+                    slots = ft.analyzer.analyze_slots(str(v))
+                    terms = [t for t in slots if t] \
+                        if None in slots else slots
                     base = parsed.field_lengths.get(path, 0)
-                    parsed.positions.setdefault(path, []).extend(
-                        # +100 position gap between array values, like Lucene's
-                        # position_increment_gap default on text fields
-                        (t.term, t.position + base + (100 if base else 0))
-                        for t in tokens
-                    )
-                    parsed.field_lengths[path] = base + (100 if base else 0) + len(tokens)
+                    parsed.field_lengths[path] = \
+                        base + (100 if base else 0) + len(terms)
+                    parsed.term_slots.setdefault(path, []).append(slots)
                     parsed.postings_terms.setdefault(path, []).extend(terms)
                 else:
                     terms, length = ft.index_terms(v)

@@ -96,7 +96,7 @@ class Node:
             self.tpu_search = TpuSearchService(
                 breaker=self.breakers.breakers["hbm"],
                 window_s=self.settings.get_float(
-                    "search.tpu_serving.batch_window_seconds", 0.002),
+                    "search.tpu_serving.batch_window_seconds", 0.01),
                 max_batch=self.settings.get_int(
                     "search.tpu_serving.max_batch", 64),
                 batch_timeout_s=self.settings.get_float(

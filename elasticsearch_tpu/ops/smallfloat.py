@@ -53,6 +53,17 @@ def decode_norms(norm_bytes: np.ndarray) -> np.ndarray:
     return LENGTH_TABLE[norm_bytes.astype(np.int64)]
 
 
+def encode_norms(field_lengths: np.ndarray) -> np.ndarray:
+    """Vectorized intToByte4 over an i64 field-length column (the bulk
+    write path's norms build). Exact for lengths < 2^53 — np.frexp's
+    exponent IS the bit length there."""
+    v = np.maximum(field_lengths.astype(np.int64), 0)
+    _, nb = np.frexp(v.astype(np.float64))  # bit length (0 for v == 0)
+    shift = np.maximum(nb - 4, 0).astype(np.int64)
+    enc = np.where(nb < 4, v, ((v >> shift) & 0x07) | ((shift + 1) << 3))
+    return enc.astype(np.uint8)
+
+
 def bm25_norm_cache(k1: float, b: float, avgdl: float) -> np.ndarray:
     """The per-norm-byte BM25 denominator term, as Lucene's BM25Scorer caches:
     cache[n] = k1 * (1 - b + b * LENGTH_TABLE[n] / avgdl); the score is then

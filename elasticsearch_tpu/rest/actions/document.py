@@ -200,77 +200,165 @@ def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
                    refresh: bool = False) -> List[Dict[str, Any]]:
     """Apply parsed bulk ops against LOCAL shards; returns response items
     in op order. Per-op failures become error items, never exceptions
-    (reference: BulkItemResponse)."""
-    items: List[Dict[str, Any]] = []
+    (reference: BulkItemResponse).
+
+    Maximal runs of plain index ops (no CAS) group per shard and apply
+    through the engine's batched path — one lock + one translog fsync per
+    (shard, run), analysis out of the lock (reference:
+    TransportShardBulkAction's shard-level grouping, SURVEY.md §3.2/P6).
+    Runs preserve total op order, so mixed sequences on one _id keep
+    their semantics."""
+    items: List[Optional[Dict[str, Any]]] = [None] * len(ops)
     refresh_shards = set()
-    for entry in ops:
-        op, index, the_id = entry["op"], entry["index"], entry["id"]
-        source = entry.get("source")
-        try:
-            if index is None:
-                raise IllegalArgumentException("_index is missing")
-            index = node.indices.resolve_write_index(index)
-            svc = (node.indices.index(index) if node.cluster is not None
-                   else node.get_or_autocreate_index(index))
-            shard_num = entry.get("shard")
-            if shard_num is None:
-                shard_num = svc.shard_for_id(the_id, entry.get("routing"))
-            shard = svc.shard(shard_num)
-            seqno_kwargs = {}
-            if entry.get("if_seq_no") is not None:
-                seqno_kwargs = {
-                    "if_seq_no": int(entry["if_seq_no"]),
-                    "if_primary_term": int(entry["if_primary_term"])}
-            if op == "delete":
-                r = shard.apply_delete_on_primary(the_id, **seqno_kwargs)
-                node.replicate("delete", index, shard_num, the_id, None, r)
-                status = 200 if r.found else 404
-                items.append({"delete": {
-                    "_index": index, "_id": the_id, "_version": r.version,
-                    "result": "deleted" if r.found else "not_found",
-                    "_seq_no": r.seq_no, "_primary_term": r.primary_term,
-                    "status": status}})
-            elif op == "update":
-                partial = (source or {}).get("doc")
-                existing = shard.get(the_id)
-                if existing is None and not (source or {}).get("doc_as_upsert"):
-                    raise DocumentMissingException(
-                        f"[{the_id}]: document missing")
-                base = dict((existing or {}).get("_source") or {})
-                merged = _deep_merge(base, partial or {})
-                r = shard.apply_index_on_primary(the_id, merged)
-                node.replicate("index", index, shard_num, the_id, merged, r)
-                items.append({"update": {
-                    "_index": index, "_id": the_id, "_version": r.version,
-                    "result": r.result, "_seq_no": r.seq_no,
-                    "_primary_term": r.primary_term, "status": 200}})
-            else:
-                source, _pid = run_ingest_pipeline(
-                    node, svc, source,
-                    {"pipeline": entry.get("pipeline")})
-                if source is None:  # drop processor
-                    items.append({op: {
-                        "_index": index, "_id": the_id, "_version": -1,
-                        "result": "noop", "status": 200}})
-                    continue
-                r = shard.apply_index_on_primary(
-                    the_id, source, **seqno_kwargs,
-                    **({"op_type": "create"} if op == "create" else {}))
-                node.replicate("index", index, shard_num, the_id, source, r)
-                status = 201 if r.created else 200
-                items.append({op: {
-                    "_index": index, "_id": the_id, "_version": r.version,
-                    "result": r.result, "_seq_no": r.seq_no,
-                    "_primary_term": r.primary_term, "status": status}})
-            refresh_shards.add(shard)
-        except EsException as exc:
-            items.append({op: {
-                "_index": index, "_id": the_id, "status": error_status(exc),
-                "error": {"type": type(exc).__name__, "reason": str(exc)}}})
+    i = 0
+    while i < len(ops):
+        if _plain_index_op(ops[i]):
+            j = i
+            while j < len(ops) and _plain_index_op(ops[j]):
+                j += 1
+            _apply_index_run(node, ops, range(i, j), items, refresh_shards)
+            i = j
+        else:
+            items[i] = _apply_one_op(node, ops[i], refresh_shards)
+            i += 1
     if refresh:
         for shard in refresh_shards:
             shard.refresh()
-    return items
+    return items  # type: ignore[return-value]
+
+
+def _plain_index_op(entry: Dict[str, Any]) -> bool:
+    return (entry["op"] == "index"
+            and entry.get("if_seq_no") is None)
+
+
+def _resolve_target(node, entry: Dict[str, Any]):
+    """Shared bulk-op target resolution: (concrete index, IndexService,
+    shard number). Raises EsException on a missing/unresolvable index."""
+    index = entry["index"]
+    if index is None:
+        raise IllegalArgumentException("_index is missing")
+    index = node.indices.resolve_write_index(index)
+    svc = (node.indices.index(index) if node.cluster is not None
+           else node.get_or_autocreate_index(index))
+    shard_num = entry.get("shard")
+    if shard_num is None:
+        shard_num = svc.shard_for_id(entry["id"], entry.get("routing"))
+    return index, svc, shard_num
+
+
+def _apply_index_run(node, ops, positions, items, refresh_shards) -> None:
+    """Apply a run of plain index ops grouped per (index, shard) through
+    the engine bulk path; fill `items` at each op's position."""
+    groups: Dict[Any, List[int]] = {}
+    for pos in positions:
+        entry = ops[pos]
+        try:
+            index, svc, shard_num = _resolve_target(node, entry)
+            source, _pid = run_ingest_pipeline(
+                node, svc, entry.get("source"),
+                {"pipeline": entry.get("pipeline")})
+            if source is None:  # drop processor
+                items[pos] = {"index": {
+                    "_index": index, "_id": entry["id"], "_version": -1,
+                    "result": "noop", "status": 200}}
+                continue
+            entry["_resolved"] = (index, shard_num, source)
+            groups.setdefault((index, shard_num), []).append(pos)
+        except EsException as exc:
+            items[pos] = _bulk_error_item("index", entry["index"],
+                                          entry["id"], exc)
+    for (index, shard_num), poss in groups.items():
+        try:
+            svc = node.indices.index(index)
+            shard = svc.shard(shard_num)
+            docs = [(ops[p]["id"], ops[p]["_resolved"][2]) for p in poss]
+            results = shard.apply_bulk_index_on_primary(docs)
+            refresh_shards.add(shard)
+        except EsException as exc:
+            for p in poss:
+                items[p] = _bulk_error_item("index", index, ops[p]["id"],
+                                            exc)
+            continue
+        for p, r in zip(poss, results):
+            the_id = ops[p]["id"]
+            if isinstance(r, Exception):
+                if not isinstance(r, EsException):
+                    raise r
+                items[p] = _bulk_error_item("index", index, the_id, r)
+                continue
+            node.replicate("index", index, shard_num, the_id,
+                           ops[p]["_resolved"][2], r)
+            items[p] = {"index": {
+                "_index": index, "_id": the_id, "_version": r.version,
+                "result": r.result, "_seq_no": r.seq_no,
+                "_primary_term": r.primary_term,
+                "status": 201 if r.created else 200}}
+
+
+def _bulk_error_item(op, index, the_id, exc) -> Dict[str, Any]:
+    return {op: {
+        "_index": index, "_id": the_id, "status": error_status(exc),
+        "error": {"type": type(exc).__name__, "reason": str(exc)}}}
+
+
+def _apply_one_op(node, entry: Dict[str, Any],
+                  refresh_shards) -> Dict[str, Any]:
+    """Apply one non-batchable bulk op (delete/update/create/CAS)."""
+    op, index, the_id = entry["op"], entry["index"], entry["id"]
+    source = entry.get("source")
+    try:
+        index, svc, shard_num = _resolve_target(node, entry)
+        shard = svc.shard(shard_num)
+        seqno_kwargs = {}
+        if entry.get("if_seq_no") is not None:
+            seqno_kwargs = {
+                "if_seq_no": int(entry["if_seq_no"]),
+                "if_primary_term": int(entry["if_primary_term"])}
+        if op == "delete":
+            r = shard.apply_delete_on_primary(the_id, **seqno_kwargs)
+            node.replicate("delete", index, shard_num, the_id, None, r)
+            refresh_shards.add(shard)
+            status = 200 if r.found else 404
+            return {"delete": {
+                "_index": index, "_id": the_id, "_version": r.version,
+                "result": "deleted" if r.found else "not_found",
+                "_seq_no": r.seq_no, "_primary_term": r.primary_term,
+                "status": status}}
+        if op == "update":
+            partial = (source or {}).get("doc")
+            existing = shard.get(the_id)
+            if existing is None and not (source or {}).get("doc_as_upsert"):
+                raise DocumentMissingException(
+                    f"[{the_id}]: document missing")
+            base = dict((existing or {}).get("_source") or {})
+            merged = _deep_merge(base, partial or {})
+            r = shard.apply_index_on_primary(the_id, merged)
+            node.replicate("index", index, shard_num, the_id, merged, r)
+            refresh_shards.add(shard)
+            return {"update": {
+                "_index": index, "_id": the_id, "_version": r.version,
+                "result": r.result, "_seq_no": r.seq_no,
+                "_primary_term": r.primary_term, "status": 200}}
+        source, _pid = run_ingest_pipeline(
+            node, svc, source,
+            {"pipeline": entry.get("pipeline")})
+        if source is None:  # drop processor
+            return {op: {
+                "_index": index, "_id": the_id, "_version": -1,
+                "result": "noop", "status": 200}}
+        r = shard.apply_index_on_primary(
+            the_id, source, **seqno_kwargs,
+            **({"op_type": "create"} if op == "create" else {}))
+        node.replicate("index", index, shard_num, the_id, source, r)
+        refresh_shards.add(shard)
+        status = 201 if r.created else 200
+        return {op: {
+            "_index": index, "_id": the_id, "_version": r.version,
+            "result": r.result, "_seq_no": r.seq_no,
+            "_primary_term": r.primary_term, "status": status}}
+    except EsException as exc:
+        return _bulk_error_item(op, index, the_id, exc)
 
 
 def bulk_has_errors(items: List[Dict[str, Any]]) -> bool:

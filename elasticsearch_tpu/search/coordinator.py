@@ -404,10 +404,15 @@ def _search_fast(indices: IndicesService, names: List[str],
                  version: bool = False,
                  seq_no_primary_term: bool = False,
                  ctx=None) -> Optional[Dict[str, Any]]:
-    """Kernel-path query phase + host fetch phase. Returns None when any
-    target index's query can't lower (the whole request then runs on the
-    planner so merge semantics stay uniform)."""
-    from elasticsearch_tpu.search.query_phase import execute_fetch
+    """Kernel-path query phase + columnar response assembly. Returns None
+    when any target index's query can't lower (the whole request then
+    runs on the planner so merge semantics stay uniform).
+
+    Hit assembly is vectorized (VERDICT r3 #1b): external ids resolve via
+    one fancy-index over the pack's id table, stored fields read straight
+    off the pinned segments — no per-hit ShardHit/fetch-phase objects on
+    the hot path."""
+    import numpy as np
 
     k = from_ + size
     if k <= 0:
@@ -435,49 +440,53 @@ def _search_fast(indices: IndicesService, names: List[str],
                 total_hits=res.total_hits)
         per_index.append((name, svc, res))
 
-    # merge across indices: (score desc, index order, kernel rank) — the
-    # same tie order as the planner path's (score, shard seq, rank) merge
-    merged: List[Tuple[float, int, int, Tuple]] = []
-    total = 0
-    relation = "eq"
-    for ii, (name, svc, res) in enumerate(per_index):
-        total += res.total_hits
-        if getattr(res, "total_relation", "eq") == "gte":
-            relation = "gte"  # block-max pruning stopped counting
-        for rank, hit in enumerate(res.hits):
-            if min_score is not None and hit[0] < min_score:
-                continue
-            merged.append((hit[0], ii, rank, hit))
-    merged.sort(key=lambda t: (-t[0], t[1], t[2]))
-    window = merged[from_: from_ + size]
-
-    # fetch phase against the pinned readers (same snapshot as scoring)
-    from elasticsearch_tpu.search.query_phase import ShardDocRef, ShardHit
-    by_shard: Dict[Tuple[int, int], List[ShardHit]] = {}
-    for _, ii, _, hit in window:
-        score, shard_num, seg_name, ord_, doc_id = hit
-        by_shard.setdefault((ii, shard_num), []).append(
-            ShardHit(doc_id, score, ShardDocRef(seg_name, ord_)))
-    fetched: Dict[Tuple[int, int, str], Dict[str, Any]] = {}
-    for (ii, shard_num), hits in by_shard.items():
-        name, svc, res = per_index[ii]
-        reader = (res.resident.readers.get(shard_num)
-                  if res.resident is not None else None)
-        if reader is None:
-            reader = svc.shard(shard_num).acquire_searcher()
-        for hit, doc in zip(hits, execute_fetch(
-                reader, hits, source, version=version,
-                seq_no_primary_term=seq_no_primary_term)):
-            doc["_index"] = name
-            # key includes the shard: the same _id can live on two shards
-            # under custom routing
-            fetched[(ii, shard_num, hit.doc_id)] = doc
-    hits_json = []
-    for score, ii, _, hit in window:
-        doc = fetched.get((ii, hit[1], hit[4]), {"_id": hit[4]})
-        doc["_score"] = score
-        hits_json.append(doc)
-    max_score = merged[0][0] if merged else None
+    t_asm = time.perf_counter()
+    total = sum(r.total_hits for _, _, r in per_index)
+    relation = ("gte" if any(r.total_relation == "gte"
+                             for _, _, r in per_index) else "eq")
+    if len(per_index) == 1:
+        # single-index (the dominant case): the kernel result is already
+        # merged best-first — the response window is a pair of array
+        # slices, no merge pass at all
+        name, svc, res = per_index[0]
+        scores = res.scores[from_: from_ + size]
+        rows = res.rows[from_: from_ + size]
+        ords = res.ords[from_: from_ + size]
+        hits_json = _assemble_hits(name, res.resident, scores, rows, ords,
+                                   source, version, seq_no_primary_term)
+        max_score = float(res.scores[0]) if len(res.scores) else None
+    else:
+        # cross-index merge: (score desc, index order, kernel rank) — the
+        # same tie order as the planner path's merge, one lexsort
+        all_scores = np.concatenate([r.scores for _, _, r in per_index]) \
+            if per_index else np.empty(0, dtype=np.float32)
+        tags = np.concatenate([np.full(len(r.scores), ii, dtype=np.int32)
+                               for ii, (_, _, r) in enumerate(per_index)])
+        ranks = np.concatenate([np.arange(len(r.scores), dtype=np.int32)
+                                for _, _, r in per_index])
+        order = np.lexsort((ranks, tags, -all_scores))
+        window = order[from_: from_ + size]
+        # assemble per index in one batched call each, then restore the
+        # merged order (per-hit 1-element assembly re-creates the python
+        # overhead this path removes)
+        win_tags = tags[window]
+        win_ranks = ranks[window]
+        assembled: Dict[int, List[Dict[str, Any]]] = {}
+        for ii, (name, svc, res) in enumerate(per_index):
+            sel = win_ranks[win_tags == ii]
+            if len(sel):
+                assembled[ii] = _assemble_hits(
+                    name, res.resident, res.scores[sel], res.rows[sel],
+                    res.ords[sel], source, version, seq_no_primary_term)
+        cursors = {ii: 0 for ii in assembled}
+        hits_json = []
+        for ii in win_tags.tolist():
+            hits_json.append(assembled[ii][cursors[ii]])
+            cursors[ii] += 1
+        max_score = float(all_scores[order[0]]) if len(order) else None
+    stages = getattr(tpu_search, "stages", None)
+    if stages is not None:
+        stages.add("assemble", time.perf_counter() - t_asm)
     return {
         "took": int((time.perf_counter() - t0) * 1000),
         "timed_out": False,
@@ -487,6 +496,42 @@ def _search_fast(indices: IndicesService, names: List[str],
                  "max_score": max_score,
                  "hits": hits_json},
     }
+
+
+def _assemble_hits(name: str, resident, scores, rows, ords, source,
+                   version: bool, seq_no_primary_term: bool
+                   ) -> List[Dict[str, Any]]:
+    """Columnar window → response hit dicts. ids via one fancy-index;
+    stored fields (when requested) read directly from the pinned
+    segments the pack was scored against (same snapshot contract as the
+    fetch phase)."""
+    if resident is None or len(scores) == 0:
+        return []
+    ids = resident.resolve_ids(rows, ords).tolist()
+    scores_l = scores.tolist()
+    rows_l = rows.tolist()
+    ords_l = ords.tolist()
+    if source is False and not version and not seq_no_primary_term:
+        return [{"_index": name, "_id": i, "_score": s}
+                for i, s in zip(ids, scores_l)]
+    from elasticsearch_tpu.search.query_phase import _filter_source
+    segs = resident.row_segments
+    out = []
+    for i, s, row, o in zip(ids, scores_l, rows_l, ords_l):
+        doc: Dict[str, Any] = {"_index": name, "_id": i, "_score": s}
+        seg = segs[row]
+        if source is not False:
+            src = seg.stored_source[o]
+            if isinstance(source, (list, tuple)):
+                src = _filter_source(src or {}, list(source))
+            doc["_source"] = src
+        if version:
+            doc["_version"] = int(seg.doc_versions[o])
+        if seq_no_primary_term:
+            doc["_seq_no"] = int(seg.seq_nos[o])
+            doc["_primary_term"] = int(seg.primary_terms[o])
+        out.append(doc)
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -562,25 +607,14 @@ def search_shard_group(indices: IndicesService,
                 total += res.total_hits
                 if getattr(res, "total_relation", "eq") == "gte":
                     relation = "gte"
-                for rank, hit in enumerate(res.hits):
-                    score, shard_num, seg_name, ord_, doc_id = hit
-                    if min_score is not None and score < min_score:
-                        continue
-                    reader = (res.resident.readers.get(shard_num)
-                              if res.resident is not None else None)
-                    if reader is None:
-                        reader = svc.shard(shard_num).acquire_searcher()
-                    from elasticsearch_tpu.search.query_phase import (
-                        ShardDocRef, ShardHit)
-                    sh = ShardHit(doc_id, score, ShardDocRef(seg_name, ord_))
-                    doc = execute_fetch(reader, [sh], source,
-                                        version=want_version,
-                                        seq_no_primary_term=want_seqno)[0]
-                    doc["_index"] = name
-                    doc["_score"] = score
-                    doc["__shard"] = shard_num
-                    shard_results.append(("__fast__", name, shard_num,
-                                          rank, doc))
+                docs = _assemble_hits(name, res.resident, res.scores,
+                                      res.rows, res.ords, source,
+                                      want_version, want_seqno)
+                shard_nums = (res.resident.row_shard[res.rows].tolist()
+                              if docs else [])
+                for rank, (doc, sn) in enumerate(zip(docs, shard_nums)):
+                    doc["__shard"] = sn
+                    shard_results.append(("__fast__", name, sn, rank, doc))
         if not used_fast:
             for shard_num in sorted(shard_nums):
                 shard = svc.shard(shard_num)

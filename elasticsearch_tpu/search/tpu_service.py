@@ -51,6 +51,28 @@ from elasticsearch_tpu.search import dsl
 logger = logging.getLogger("elasticsearch_tpu.tpu_service")
 
 
+class StageTimes:
+    """Accumulated per-stage wall time on the serving path (VERDICT r3
+    #1a: measure where the time goes before optimizing it). Reported via
+    TpuSearchService.stats()["stages"] and the profile/_nodes/stats trees."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.seconds: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    def add(self, stage: str, dt: float, n: int = 1) -> None:
+        with self._lock:
+            self.seconds[stage] = self.seconds.get(stage, 0.0) + dt
+            self.counts[stage] = self.counts.get(stage, 0) + n
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {s: {"seconds": round(self.seconds[s], 4),
+                        "count": self.counts[s]}
+                    for s in sorted(self.seconds)}
+
+
 # ---------------------------------------------------------------------------
 # DSL lowering
 # ---------------------------------------------------------------------------
@@ -146,6 +168,19 @@ class ResidentPack:
     # PREFIX_CAP entries and bounds what it skipped
     imp_host: Optional[Tuple[np.ndarray, np.ndarray]] = None
     imp_device_arrays: Optional[Tuple] = None
+    # vectorized hit resolution (VERDICT r3 #1): one fancy-index resolves
+    # a whole [B, k] kernel result to external ids/shards — no per-hit
+    # Python on the serving path
+    row_shard: Optional[np.ndarray] = None    # int32[S_pad], -1 = padding
+    row_offset: Optional[np.ndarray] = None   # int64[S_pad] into id_cat
+    id_cat: Optional[np.ndarray] = None       # object[total_docs] ext ids
+    row_segments: Optional[List[Any]] = None  # row → Segment (pinned)
+
+    def resolve_ids(self, rows: np.ndarray, ords: np.ndarray) -> np.ndarray:
+        """(pack row, local ordinal) → external _id, vectorized."""
+        if len(rows) == 0:
+            return np.empty(0, dtype=object)
+        return self.id_cat[self.row_offset[rows] + ords]
 
 
 class IndexPackCache:
@@ -213,6 +248,7 @@ class IndexPackCache:
         live = []
         groups = []
         row_origin: List[Tuple[int, str]] = []
+        row_segments: List[Any] = []
         for group_idx, (shard_num, reader) in enumerate(readers):
             for view in reader.views:
                 if field not in view.segment.postings:
@@ -222,6 +258,7 @@ class IndexPackCache:
                 live.append(view.live_mask[:n].copy())
                 groups.append(group_idx)
                 row_origin.append((shard_num, view.segment.name))
+                row_segments.append(view.segment)
         if not segments:
             return None
         k1 = readers[0][1].k1
@@ -246,10 +283,26 @@ class IndexPackCache:
             if self._breaker is not None:  # undo the charge on HBM failure
                 self._breaker.release(hbm)
             raise
+        # vectorized-resolution tables: row → owning shard, row → offset
+        # into one concatenated external-id array (object dtype: fancy
+        # indexing is C-speed, the per-hit Python lookup is gone)
+        s_pad = pack.num_shards
+        row_shard = np.full(s_pad, -1, dtype=np.int32)
+        row_shard[: len(row_origin)] = [sn for sn, _ in row_origin]
+        sizes = [len(ids) for ids in pack.shard_doc_ids]
+        row_offset = np.zeros(s_pad, dtype=np.int64)
+        np.cumsum(sizes[:-1], out=row_offset[1:len(sizes)])
+        id_cat = np.empty(int(sum(sizes)), dtype=object)
+        off = 0
+        for ids in pack.shard_doc_ids:
+            id_cat[off: off + len(ids)] = ids
+            off += len(ids)
         return ResidentPack(pack, arrays, row_origin, reader_key, hbm,
                             readers={num: r for num, r in readers},
                             imp_host=(imp_docs, imp_impacts),
-                            imp_device_arrays=imp_arrays)
+                            imp_device_arrays=imp_arrays,
+                            row_shard=row_shard, row_offset=row_offset,
+                            id_cat=id_cat, row_segments=row_segments)
 
     def invalidate(self, index_name: str) -> None:
         evicted = []
@@ -362,7 +415,7 @@ class MicroBatcher:
     Each pack has its own queue + worker, so launches for different
     packs overlap."""
 
-    def __init__(self, window_s: float = 0.002, max_batch: int = 64):
+    def __init__(self, window_s: float = 0.01, max_batch: int = 64):
         self.window_s = window_s
         self.max_batch = max_batch
         self._lock = threading.Lock()
@@ -413,12 +466,14 @@ class MicroBatcher:
     # set by the owning TpuSearchService so batches reuse the mesh the
     # pack arrays were placed with (no per-batch mesh construction)
     mesh = None
+    stages: Optional[StageTimes] = None
 
     def _execute(self, resident: ResidentPack,
                  pendings: List[_Pending]) -> None:
         results = execute_flat_batch(
             resident, [p.flat for p in pendings],
-            k=max(p.k for p in pendings), mesh=self.mesh)
+            k=max(p.k for p in pendings), mesh=self.mesh,
+            stages=self.stages)
         with self._lock:
             self.batches_executed += 1
             self.queries_executed += len(pendings)
@@ -428,15 +483,45 @@ class MicroBatcher:
 
 @dataclasses.dataclass
 class FlatQueryResult:
-    """Per-query kernel result, resolved to shard-level references."""
+    """Per-query kernel result, COLUMNAR: parallel numpy arrays best-first
+    (scores f32[n], pack rows int32[n], local ordinals int32[n]). The
+    serving path consumes the columns directly — external ids resolve via
+    one fancy-index (`resident.resolve_ids`), never per-hit Python
+    (VERDICT r3 #1). `hits` is the legacy tuple view for cold paths."""
 
-    # [(score, shard_num, segment_name, local_ord, doc_id)] best-first
-    hits: List[Tuple[float, int, str, int, str]]
+    scores: np.ndarray
+    rows: np.ndarray
+    ords: np.ndarray
     total_hits: int
     max_score: Optional[float]
     resident: Optional[ResidentPack] = None  # for the fetch phase
     total_relation: str = "eq"  # "gte" when block-max pruning stopped
                                 # counting (the reference's WAND behavior)
+    _hits: Optional[List[Tuple[float, int, str, int, str]]] = None
+
+    @classmethod
+    def empty(cls) -> "FlatQueryResult":
+        z = np.empty(0, dtype=np.int32)
+        return cls(np.empty(0, dtype=np.float32), z, z, 0, None)
+
+    def __len__(self) -> int:
+        return len(self.scores)
+
+    @property
+    def hits(self) -> List[Tuple[float, int, str, int, str]]:
+        """[(score, shard_num, segment_name, local_ord, doc_id)]."""
+        if self._hits is None:
+            r = self.resident
+            if r is None or len(self.rows) == 0:
+                self._hits = []
+            else:
+                ids = r.resolve_ids(self.rows, self.ords)
+                self._hits = [
+                    (float(s), *r.row_origin[row], int(o), i)
+                    for s, row, o, i in zip(
+                        self.scores.tolist(), self.rows.tolist(),
+                        self.ords.tolist(), ids.tolist())]
+        return self._hits
 
 
 # block-max serving knobs: per-term impact prefix taken on device, and
@@ -444,11 +529,23 @@ class FlatQueryResult:
 # exact host re-score. The pruned path pins every jit-signature dimension
 # (T slots, window, chunk len, batch bucket, candidate k) to a handful of
 # values so steady-state serving NEVER re-compiles.
+#
+# Tiered escalation (VERDICT r4 diagnosis: at 262k docs the tier-1
+# validity bound fails for hot-term queries and the full-postings exact
+# kernel is orders slower): tier 1 scores the top-4k impact prefix of
+# each term; queries whose WAND validity bound fails re-run at the 32k
+# prefix (tier 2); only then the exact kernel. Every tier has a pinned
+# jit signature, prewarmed.
 PREFIX_CAP = 4096
+PREFIX_CAP2 = 32768
 PRUNE_MAX_K = 1000
 PRUNE_MAX_TERMS = 8          # > 8 query terms → exact path
-_PRUNE_T_SLOTS = 8           # = PRUNE_MAX_TERMS × (PREFIX_CAP / chunk 4096)
 _PRUNE_WINDOW = 8
+
+
+def _prune_t_slots(prefix_cap: int) -> int:
+    from elasticsearch_tpu.parallel.distributed import CHUNK_CAP
+    return PRUNE_MAX_TERMS * max(1, prefix_cap // CHUNK_CAP)
 
 
 def _candidate_k(k: int) -> int:
@@ -467,7 +564,9 @@ def _serving_bucket(n: int, cap: int = 64) -> int:
 
 
 def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
-                       k: int, mesh=None) -> List[FlatQueryResult]:
+                       k: int, mesh=None,
+                       stages: Optional[StageTimes] = None
+                       ) -> List[FlatQueryResult]:
     """Run one micro-batch. OR-queries (min_count == 1, k ≤ 1000) go
     through the block-max pruned pipeline; msm/AND queries and pruned
     queries whose validity bound fails go through the exact kernel."""
@@ -481,35 +580,108 @@ def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
     out: List[Optional[FlatQueryResult]] = [None] * len(flats)
     if pruned_idx:
         results, invalid = _execute_pruned(
-            resident, [flats[i] for i in pruned_idx], k, mesh)
+            resident, [flats[i] for i in pruned_idx], k, mesh,
+            stages=stages)
         for j, i in enumerate(pruned_idx):
             out[i] = results[j]
-        exact_idx.extend(pruned_idx[j] for j in invalid)
+        if invalid:
+            # tier 2: deeper prefix, pinned signature — still ~free vs
+            # the exact kernel's full-postings sort
+            retry_idx = [pruned_idx[j] for j in invalid]
+            if stages is not None:
+                stages.add("pruned_invalid_t1", 0.0, n=len(retry_idx))
+            results2, invalid2 = _execute_pruned(
+                resident, [flats[i] for i in retry_idx], k, mesh,
+                stages=stages, prefix_cap=PREFIX_CAP2)
+            for j, i in enumerate(retry_idx):
+                out[i] = results2[j]
+            if invalid2 and stages is not None:
+                stages.add("pruned_invalid_t2", 0.0, n=len(invalid2))
+            exact_idx.extend(retry_idx[j] for j in invalid2)
     if exact_idx:
+        t0 = time.perf_counter()
         results = _execute_exact(resident, [flats[i] for i in exact_idx],
                                  k, mesh)
+        if stages is not None:
+            stages.add("exact_batch", time.perf_counter() - t0,
+                       n=len(exact_idx))
         for j, i in enumerate(exact_idx):
             out[i] = results[j]
     return out  # type: ignore[return-value]
 
 
+def _columnar_results(resident: ResidentPack, vals: np.ndarray,
+                      gids: np.ndarray, totals: np.ndarray,
+                      n_queries: int, relation_fn,
+                      k_cap: Optional[int] = None) -> List[FlatQueryResult]:
+    """Decode a whole batch's [B, k'] kernel output into columnar results
+    with vectorized numpy — the only per-query work is slicing views.
+    Sentinel lanes (score -inf / ordinal == d_pad / padding rows) are
+    dropped; they always sort to the tail, so each query's valid hits are
+    a prefix."""
+    pack = resident.pack
+    d1 = pack.d_pad + 1
+    rows = (gids // d1).astype(np.int32)
+    ords = (gids - rows.astype(np.int64) * d1).astype(np.int32)
+    valid = ((vals > dist.NEG_INF) & (ords < pack.d_pad)
+             & (rows < len(resident.row_origin)))
+    # prefix lengths (guard against non-prefix validity: stop at first 0)
+    n_valid = np.where(valid.all(axis=1), valid.shape[1],
+                       valid.argmin(axis=1))
+    out = []
+    for qi in range(n_queries):
+        m = int(n_valid[qi])
+        if k_cap is not None and m > k_cap:
+            m = k_cap
+        sc = vals[qi, :m]
+        out.append(FlatQueryResult(
+            sc, rows[qi, :m], ords[qi, :m], int(totals[qi]),
+            float(sc[0]) if m else None, resident=resident,
+            total_relation=relation_fn(qi)))
+    return out
+
+
 def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
                    k: int, mesh) -> List[FlatQueryResult]:
-    """Full-postings kernel: exact scores, exact totals."""
+    """Full-postings kernel: exact scores, exact totals (tier 3 for OR
+    queries whose validity bounds failed twice; tier 1 for msm/AND).
+    Every jit dimension is BUCKETED — batch (8/64/pow2), kernel k
+    (128/1024/pow2), slot count (pow2 ≥ 8), window (≥ 8), chunk length
+    (pinned CHUNK_CAP) — so steady-state serving re-uses a handful of
+    compiled signatures (cold ones compile once ever, persisted by the
+    compilation cache)."""
+    import dataclasses as _dc
+
     pack = resident.pack
     batch = dist.prepare_query_batch(
         pack, [f.terms for f in flats],
         boosts=[f.boost for f in flats],
         min_counts=[f.min_count for f in flats],
-        pad_batch_to=_batch_bucket(len(flats), 1024))
-    vals, refs, totals = dist.distributed_search(
-        pack, batch, k, mesh, device_arrays=resident.device_arrays)
-    return [_to_result(resident, refs[qi], int(totals[qi]), "eq")
-            for qi in range(len(flats))]
+        pad_batch_to=_serving_bucket(len(flats)),
+        pad_max_len=dist.CHUNK_CAP)
+    t_pin = 8
+    while t_pin < batch.t_slots:
+        t_pin *= 2
+    if t_pin > batch.t_slots:
+        s, b, t = batch.starts.shape
+        pad = ((0, 0), (0, 0), (0, t_pin - t))
+        batch = _dc.replace(
+            batch, starts=np.pad(batch.starts, pad),
+            lengths=np.pad(batch.lengths, pad),
+            weights=np.pad(batch.weights, pad), t_slots=t_pin)
+    k_kernel = 128 if k <= 128 else (1024 if k <= 1024
+                                     else _batch_bucket(k, 16384))
+    vals, gids, totals = dist.distributed_search_raw(
+        pack, batch, k_kernel, mesh, device_arrays=resident.device_arrays,
+        t_window=max(_PRUNE_WINDOW, batch.window))
+    return _columnar_results(resident, vals, gids, totals, len(flats),
+                             lambda qi: "eq", k_cap=k)
 
 
 def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
-                    k: int, mesh) -> Tuple[List[FlatQueryResult], List[int]]:
+                    k: int, mesh, stages: Optional[StageTimes] = None,
+                    prefix_cap: int = PREFIX_CAP
+                    ) -> Tuple[List[FlatQueryResult], List[int]]:
     """Block-max pipeline (SURVEY.md §5.7/§7.3#3), one fused launch:
     candidate generation over impact-sorted prefixes + EXACT on-device
     re-score (binary search in the doc-sorted postings) + final order;
@@ -519,6 +691,7 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     exact kernel. Returns (results, invalid indices)."""
     import jax
 
+    t_prep = time.perf_counter()
     pack = resident.pack
     imp_docs, imp_impacts = resident.imp_host
     k_cand = _candidate_k(k)
@@ -529,8 +702,8 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
         boosts=[f.boost for f in flats],
         min_counts=[1] * len(flats),
         pad_batch_to=b_bucket,
-        prefix_cap=PREFIX_CAP, imp_impacts=imp_impacts,
-        pad_t_slots=_PRUNE_T_SLOTS, pad_max_len=dist.CHUNK_CAP)
+        prefix_cap=prefix_cap, imp_impacts=imp_impacts,
+        pad_t_slots=_prune_t_slots(prefix_cap), pad_max_len=dist.CHUNK_CAP)
     t_starts, t_lengths, t_weights = dist.prepare_term_ranges(
         pack, [f.terms for f in flats],
         boosts=[f.boost for f in flats],
@@ -545,6 +718,7 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     sb = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS))
     put = jax.device_put
+    t_disp = time.perf_counter()
     packed = fn(
         resident.imp_device_arrays[0], resident.imp_device_arrays[1],
         resident.device_arrays[0], resident.device_arrays[1],
@@ -554,50 +728,44 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
         put(batch.tail_bounds, sb))
     # one device→host transfer; split host-side (k derived from the
     # packed width — the kernel clamps k_out to its candidate pool)
+    t_dev = time.perf_counter()
     vals, gids, totals, cutoff, beta = dist.unpack_pruned(
         np.asarray(packed))
+    t_decode = time.perf_counter()
+    if stages is not None:
+        stages.add("batch_prep", t_disp - t_prep)
+        stages.add("batch_dispatch", t_dev - t_disp)
+        stages.add("batch_device_wait", t_decode - t_dev)
 
+    # vectorized batch decode (VERDICT r3 #1): clamp each query to its
+    # first min(n_valid, k) entries, then check the WAND validity bound
+    # with scalar numpy reads — no per-hit Python
+    decoded = _columnar_results(
+        resident, vals, gids.astype(np.int64), totals, len(flats),
+        lambda qi: "gte" if beta[qi] > 0.0 else "eq")
     results: List[FlatQueryResult] = []
     invalid: List[int] = []
-    for qi, flat in enumerate(flats):
+    for qi, res in enumerate(decoded):
         b_q = float(beta[qi])
-        row_vals = vals[qi]
-        real = row_vals > dist.NEG_INF
-        n_real = int(real.sum())
-        top = []
-        for j in range(min(n_real, k)):
-            gid = int(gids[qi][j])
-            row, ord_ = divmod(gid, pack.d_pad + 1)
-            if ord_ >= pack.d_pad:
-                continue
-            top.append((float(row_vals[j]), row, ord_))
+        n = len(res.scores)
+        if n > k:
+            res = dataclasses.replace(res, scores=res.scores[:k],
+                                      rows=res.rows[:k], ords=res.ords[:k])
+            n = k
         if b_q > 0.0:
             # validity at the caller's k: docs outside the candidate set
             # score below cutoff+β (cut candidates) or β (tail-only)
-            kth = top[k - 1][0] if len(top) >= k else float("-inf")
+            kth = float(res.scores[k - 1]) if n >= k else float("-inf")
             c_q = float(cutoff[qi])
             threshold = (c_q + b_q) if c_q > dist.NEG_INF else b_q
-            if kth < threshold or (n_real < k):
+            if kth < threshold or n < k:
                 results.append(None)  # type: ignore[arg-type]
                 invalid.append(qi)
                 continue
-        results.append(_to_result(resident, top, int(totals[qi]),
-                                  "gte" if b_q > 0.0 else "eq"))
+        results.append(res)
+    if stages is not None:
+        stages.add("batch_decode", time.perf_counter() - t_decode)
     return results, invalid
-
-
-def _to_result(resident: ResidentPack, refs, total: int,
-               relation: str) -> FlatQueryResult:
-    pack = resident.pack
-    hits = []
-    for score, row, ord_ in refs:
-        if row >= len(resident.row_origin):
-            continue  # padding row
-        shard_num, seg_name = resident.row_origin[row]
-        doc_id = pack.shard_doc_ids[row][ord_]
-        hits.append((score, shard_num, seg_name, ord_, doc_id))
-    return FlatQueryResult(hits, total, hits[0][0] if hits else None,
-                           resident=resident, total_relation=relation)
 
 
 def _n_local_devices() -> int:
@@ -613,14 +781,17 @@ class TpuSearchService:
     """Facade the coordinator calls: eligibility check, pack lookup,
     micro-batched execution. One instance per node."""
 
-    def __init__(self, breaker=None, mesh=None, window_s: float = 0.002,
+    def __init__(self, breaker=None, mesh=None, window_s: float = 0.01,
                  max_batch: int = 64, batch_timeout_s: float = 30.0):
+        _ensure_compile_cache()
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.batch_timeout_s = batch_timeout_s
         self.batcher = MicroBatcher(window_s=window_s, max_batch=max_batch)
         # pack eviction retires the pack's batch queue immediately
         self.packs.on_evict = self.batcher.retire_pack
         self.batcher.mesh = self.packs.mesh
+        self.stages = StageTimes()
+        self.batcher.stages = self.stages
         self.served = 0      # queries answered by the kernel path
         self.fallback = 0    # queries declined to the planner path
         self.timeouts = 0    # kernel waits that hit the deadline
@@ -649,15 +820,20 @@ class TpuSearchService:
         if k <= 0 or k > 10_000:
             self.fallback += 1
             return None
+        t0 = time.perf_counter()
         flat = lower_query(query, index_service.mapper)
         if flat is None:
             self.fallback += 1
             return None
+        t1 = time.perf_counter()
         resident = self.packs.get(index_service, flat.field)
+        t2 = time.perf_counter()
+        self.stages.add("lower", t1 - t0)
+        self.stages.add("pack_get", t2 - t1)
         if resident is None:
             # field has no postings anywhere → zero hits, kernel-free
             self.served += 1
-            return FlatQueryResult([], 0, None)
+            return FlatQueryResult.empty()
         if self._tripped:
             now = time.monotonic()
             if now < self._next_probe:
@@ -668,6 +844,7 @@ class TpuSearchService:
         # must degrade to the planner, never surface as an error
         # (EnginePlugin seam contract — an engine swap preserves behavior).
         try:
+            t_sub = time.perf_counter()
             fut = self.batcher.submit(resident, flat, k)
             # the batch wait is bounded: the service cap (default 30s —
             # the FIRST batch on a signature pays XLA compile; if it
@@ -706,14 +883,92 @@ class TpuSearchService:
             return None
         self._tripped = False  # a completed batch proves the path is live
         self.served += 1
+        self.stages.add("batch_wait", time.perf_counter() - t_sub)
         return result
+
+    def prewarm(self, index_service, field: str) -> Dict[str, Any]:
+        """Build the (index, field) resident pack and compile every
+        steady-state serving signature NOW, instead of on the first
+        query (the reference's index-warmer seam, `IndicesWarmer` /
+        `index.warmer`; VERDICT r3 #3: first-compile must not stall or
+        degrade production traffic). Returns timing info. With the
+        persistent compilation cache enabled this is fast after the
+        first-ever run on a machine."""
+        t0 = time.perf_counter()
+        resident = self.packs.get(index_service, field)
+        t_pack = time.perf_counter() - t0
+        compiled = []
+        if resident is not None:
+            terms = []
+            for v in resident.pack.vocabs:
+                if v:
+                    terms = [next(iter(v))]
+                    break
+            flat = FlatQuery(field, terms or ["_warm_"], 1.0, 1)
+            for b_bucket, k, cap in (
+                    (8, 10, PREFIX_CAP), (64, 10, PREFIX_CAP),
+                    (8, PRUNE_MAX_K, PREFIX_CAP), (64, PRUNE_MAX_K, PREFIX_CAP),
+                    (8, 10, PREFIX_CAP2), (64, 10, PREFIX_CAP2),
+                    (8, PRUNE_MAX_K, PREFIX_CAP2),
+                    (64, PRUNE_MAX_K, PREFIX_CAP2)):
+                t1 = time.perf_counter()
+                _execute_pruned(resident, [flat] * b_bucket, k,
+                                self.packs.mesh, prefix_cap=cap)
+                compiled.append({"batch": b_bucket, "k": k, "prefix": cap,
+                                 "seconds": round(
+                                     time.perf_counter() - t1, 2)})
+            # exact kernel (msm/AND tier 1, OR tier 3) at its common
+            # bucketed signatures; with_counts=True via min_count=2.
+            # Hot-term slot buckets (t_slots > 8) compile once ever and
+            # persist in the compilation cache.
+            flat_and = FlatQuery(flat.field, flat.terms * 2, 1.0, 2)
+            for b_bucket, k in ((8, 10), (64, PRUNE_MAX_K)):
+                t1 = time.perf_counter()
+                _execute_exact(resident, [flat_and] * b_bucket, k,
+                               self.packs.mesh)
+                compiled.append({"batch": b_bucket, "k": k,
+                                 "exact": True,
+                                 "seconds": round(
+                                     time.perf_counter() - t1, 2)})
+        return {"pack_seconds": round(t_pack, 2), "compiled": compiled,
+                "total_seconds": round(time.perf_counter() - t0, 2)}
 
     def stats(self) -> Dict[str, Any]:
         return {"served": self.served, "fallback": self.fallback,
                 "timeouts": self.timeouts, "tripped": self._tripped,
                 "last_error": self.last_error,
                 "batches": self.batcher.batches_executed,
-                "batched_queries": self.batcher.queries_executed}
+                "batched_queries": self.batcher.queries_executed,
+                "stages": self.stages.snapshot()}
 
     def close(self) -> None:
         self.batcher.close()
+
+
+_cache_configured = False
+
+
+def _ensure_compile_cache() -> None:
+    """Persistent XLA compilation cache (VERDICT r3 #3): keyed on disk so
+    a process restart reuses every serving-kernel compile instead of
+    paying the 30-80s first-compile again. Dir override:
+    ES_TPU_JAX_CACHE_DIR; opt out with ES_TPU_JAX_CACHE_DIR=''."""
+    global _cache_configured
+    if _cache_configured:
+        return
+    _cache_configured = True
+    import os
+    path = os.environ.get("ES_TPU_JAX_CACHE_DIR")
+    if path is None:
+        path = os.path.join(os.path.expanduser("~"), ".cache",
+                            "elasticsearch_tpu", "jax_cache")
+    if not path:
+        return
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as exc:  # cache is an optimization, never fatal
+        logger.warning("persistent compile cache unavailable: %s", exc)

@@ -211,7 +211,7 @@ class TestPerPackQueues:
 
         pack_a, pack_b = _FakePack(), _FakePack()
 
-        def fake_execute(resident, flats, k, mesh=None):
+        def fake_execute(resident, flats, k, mesh=None, stages=None):
             if resident is pack_a:
                 slow_started.set()
                 assert release_slow.wait(timeout=10.0)
@@ -246,7 +246,7 @@ class TestPerPackQueues:
         release = threading.Event()
         all_submitted = threading.Event()
 
-        def fake_execute(resident, flats, k, mesh=None):
+        def fake_execute(resident, flats, k, mesh=None, stages=None):
             if not calls:  # hold the FIRST launch open
                 calls.append(len(flats))
                 assert release.wait(timeout=10.0)
