@@ -233,6 +233,14 @@ class ClusterService:
                                          name="cluster-applier")
         # shard copies this node reported started, keyed by allocation_id
         self._started_sent: Set[str] = set()
+        # ARS-lite (reference: ResponseCollectorService +
+        # OperationRouting#searchShards adaptive replica selection,
+        # SURVEY.md §2.1#19/P2): EWMA of recent search-group latency per
+        # node; _route_shards ranks STARTED copies by it, round-robin
+        # among the unmeasured, so replicas actually serve reads
+        self._ars_lock = threading.Lock()
+        self._node_ewma: Dict[str, float] = {}
+        self._ars_rr = 0
         # index uuids this applier has seen in a committed state; only
         # those may be deleted when they later disappear from the state.
         # Pre-existing local data the cluster never knew about (e.g. a
@@ -1282,16 +1290,31 @@ class ClusterService:
             return name
         return select_write_index(entry, name)
 
+    def record_node_latency(self, node_id: str, seconds: float) -> None:
+        """Feed the ARS EWMA (alpha 0.3, the reference's
+        ExponentiallyWeightedMovingAverage default for response times)."""
+        with self._ars_lock:
+            old = self._node_ewma.get(node_id)
+            self._node_ewma[node_id] = (seconds if old is None
+                                        else 0.7 * old + 0.3 * seconds)
+
     def _route_shards(self, names: List[str]
                       ) -> Tuple[Dict[str, List[Tuple[str, int]]],
                                  Dict[str, Tuple[str, int]], int]:
         """→ (node_id → [(index, shard)], node_id → address,
-        failed_shard_count). Prefers STARTED primaries, falls back to
-        any STARTED copy (replica reads)."""
+        failed_shard_count). Any STARTED copy may serve a read —
+        replicas included — ranked by the node-latency EWMA (ARS-lite:
+        OperationRouting#searchShards + ResponseCollectorService,
+        SURVEY.md §2.1#19); copies on unmeasured nodes rotate
+        round-robin so load spreads until measurements exist."""
         state = self.applied_state()
         by_node: Dict[str, List[Tuple[str, int]]] = {}
         addr: Dict[str, Tuple[str, int]] = {}
         failed = 0
+        with self._ars_lock:
+            ewma = dict(self._node_ewma)
+            self._ars_rr += 1
+            rr = self._ars_rr
         for name in names:
             meta = state.indices.get(name)
             if meta is None:
@@ -1302,7 +1325,16 @@ class ClusterService:
                 if not copies:
                     failed += 1
                     continue
-                chosen = next((c for c in copies if c.primary), copies[0])
+                def ars_rank(ic):
+                    i, c = ic
+                    e = ewma.get(c.node_id)
+                    # 10ms latency buckets: similar nodes rotate (no
+                    # herding onto one fast node); unmeasured nodes rank
+                    # first so they get measured
+                    bucket = -1 if e is None else int(e * 100)
+                    return (bucket, (i + rr) % len(copies))
+
+                chosen = min(enumerate(copies), key=ars_rank)[1]
                 by_node.setdefault(chosen.node_id, []).append((name, shard))
                 addr[chosen.node_id] = state.nodes[chosen.node_id].address
         return by_node, addr, failed
@@ -1333,18 +1365,26 @@ class ClusterService:
 
         groups: List[Dict[str, Any]] = []
         if local_targets is not None:
+            l0 = time.perf_counter()
             groups.append(coord.search_shard_group(
                 self.node.indices, local_targets, body, params,
                 tpu_search=self.node.tpu_search,
                 index_filters=alias_filters))
+            self.record_node_latency(self.local_node.node_id,
+                                     time.perf_counter() - l0)
         for node_id, fut in futures:
             if task is not None:
                 task.ensure_not_cancelled()
+            r0 = time.perf_counter()
             try:
                 groups.append(fut.result(timeout=60.0))
+                self.record_node_latency(node_id,
+                                         time.perf_counter() - r0)
             except Exception as exc:  # noqa: BLE001 — shard-group failure
                 n = len(by_node.get(node_id, []))
                 failed += n
+                # a failed/slow node ranks last until it recovers
+                self.record_node_latency(node_id, 60.0)
                 logger.warning("search group on [%s] failed: %s",
                                node_id, exc)
         return coord.merge_group_responses(groups, body, params, t0,

@@ -320,3 +320,55 @@ def test_lost_primary_without_replica_goes_red_not_empty(cluster):
     state = live[0].cluster.applied_state()
     p = state.primary("frag", 0)
     assert p.node_id is None or p.state != "STARTED"
+
+
+def test_replica_reads_spread_and_fail_over(cluster):
+    """ARS-lite (SURVEY.md §2.1#19/P2): with 1 shard × 2 replicas every
+    copy is STARTED on some node — reads must spread over copies (not
+    pin the primary) and keep succeeding when the chosen replica's node
+    dies."""
+    status, body = _handle(cluster[0], "PUT", "/ars", body={
+        "settings": {"number_of_shards": 1, "number_of_replicas": 2},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    assert status == 200, body
+    _wait_green(cluster[0])
+    for i in range(12):
+        _handle(cluster[0], "PUT", f"/ars/_doc/{i}",
+                body={"body": f"alpha doc {i}"})
+    _handle(cluster[0], "POST", "/ars/_refresh")
+
+    # routing spreads across copies (round-robin over unmeasured nodes,
+    # then EWMA-ranked); collect the chosen owner over repeated routes
+    chosen = set()
+    for _ in range(9):
+        by_node, _addr, failed = cluster[0].cluster._route_shards(["ars"])
+        assert failed == 0
+        chosen.update(by_node.keys())
+        s, resp = _handle(cluster[0], "POST", "/ars/_search",
+                          body={"query": {"match": {"body": "alpha"}},
+                                "size": 20})
+        assert s == 200 and resp["hits"]["total"]["value"] == 12, resp
+    assert len(chosen) >= 2, f"reads pinned to {chosen}"
+
+    # kill a non-coordinating holder; reads keep working off live copies
+    state = cluster[0].cluster.applied_state()
+    victim_id = next(nid for nid in chosen
+                     if nid != cluster[0].node_id)
+    victim = next(n for n in cluster if n.node_id == victim_id)
+    victim.close()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if cluster[0].cluster.health()["number_of_nodes"] == 2:
+            break
+        time.sleep(0.1)
+    # EWMA ranks the dead node out after one failure; route + search
+    ok = 0
+    for _ in range(6):
+        s, resp = _handle(cluster[0], "POST", "/ars/_search",
+                          body={"query": {"match": {"body": "alpha"}},
+                                "size": 20})
+        if s == 200 and resp["hits"]["total"]["value"] == 12:
+            ok += 1
+        by_node, _addr, _f = cluster[0].cluster._route_shards(["ars"])
+        assert victim_id not in by_node
+    assert ok >= 5, f"only {ok}/6 searches succeeded after failover"
