@@ -60,7 +60,7 @@ def main() -> None:
     n_shards = _env("SHARDS", 4 if on_tpu else 2)
     vocab = _env("VOCAB", 30_000 if on_tpu else 2000)
     n_queries = _env("QUERIES", 256 if on_tpu else 16)
-    clients = _env("CLIENTS", 64 if on_tpu else 4)
+    clients = _env("CLIENTS", 128 if on_tpu else 4)
     k = _env("K", 1000 if on_tpu else 32)
     seconds = _env("SECONDS", 20 if on_tpu else 3)
 
@@ -160,21 +160,24 @@ def main() -> None:
         reader = shard.acquire_searcher()
         segments.extend(v.segment for v in reader.views)
     oracle_queries = min(len(query_bodies), 32 if on_tpu else 8)
-    t0 = time.perf_counter()
-    oracle_topk = []
-    for qi in range(oracle_queries):
-        terms = [corpus.vocab[t] for t in corpus.queries[qi]]
-        per_seg = oracle.score_match_query(segments, "body", terms)
-        offsets = np.cumsum([0] + [s.num_docs for s in segments[:-1]])
-        dense = np.concatenate(per_seg)
-        top = oracle.topk_from_scores(dense, k)
-        # map concatenated ordinal back to external _id via segments
-        ids = []
-        for doc, score in top:
-            si = int(np.searchsorted(offsets, doc, side="right") - 1)
-            ids.append(segments[si].doc_ids[doc - int(offsets[si])])
-        oracle_topk.append(ids)
-    oracle_dt = time.perf_counter() - t0
+    oracle_dt = float("inf")
+    # best of 2 passes — run-to-run noise must not flatter the TPU side
+    for _attempt in range(2):
+        t0 = time.perf_counter()
+        oracle_topk = []
+        for qi in range(oracle_queries):
+            terms = [corpus.vocab[t] for t in corpus.queries[qi]]
+            per_seg = oracle.score_match_query(segments, "body", terms)
+            offsets = np.cumsum([0] + [s.num_docs for s in segments[:-1]])
+            dense = np.concatenate(per_seg)
+            top = oracle.topk_from_scores(dense, k)
+            # map concatenated ordinal back to external _id via segments
+            ids = []
+            for doc, score in top:
+                si = int(np.searchsorted(offsets, doc, side="right") - 1)
+                ids.append(segments[si].doc_ids[doc - int(offsets[si])])
+            oracle_topk.append(ids)
+        oracle_dt = min(oracle_dt, time.perf_counter() - t0)
     oracle_qps_1t = oracle_queries / oracle_dt
     ncpu = os.cpu_count() or 1
     cpu_baseline_qps = oracle_qps_1t * ncpu  # perfect-scaling assumption

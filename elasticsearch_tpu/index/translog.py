@@ -246,6 +246,8 @@ class Translog:
 
     def close(self) -> None:
         with self._lock:
+            if self._file.closed:
+                return
             try:
                 self._file.flush()
                 os.fsync(self._file.fileno())

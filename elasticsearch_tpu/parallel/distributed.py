@@ -668,10 +668,13 @@ def device_put_pack(pack: StackedShardPack, mesh: Optional[Mesh] = None):
 def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
                            k: int, mesh: Mesh, device_arrays=None,
                            with_counts: Optional[bool] = None,
-                           t_window: Optional[int] = None):
+                           t_window: Optional[int] = None,
+                           materialize: bool = True):
     """One distributed query step, RAW outputs: numpy (vals [B,k'],
     gids int64 [B,k'], totals [B]) with no per-hit host decoding — the
-    serving path decodes the whole batch vectorized (VERDICT r3 #1)."""
+    serving path decodes the whole batch vectorized (VERDICT r3 #1).
+    materialize=False returns the jax arrays of the ASYNC dispatch
+    without blocking (pipelined serving; np.asarray them to wait)."""
     if device_arrays is None:
         device_arrays = device_put_pack(pack, mesh)
     if with_counts is None:
@@ -691,6 +694,8 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
                            jax.device_put(batch.lengths, sbt),
                            jax.device_put(batch.weights, sbt),
                            jax.device_put(batch.min_count, db))
+    if not materialize:
+        return vals, ids, totals
     return np.asarray(vals), np.asarray(ids), np.asarray(totals)
 
 

@@ -336,19 +336,32 @@ def _batch_bucket(n: int, cap: int) -> int:
 
 
 class _PackQueue:
-    """One pack's pending queries + its dedicated worker thread. Packs
-    batch independently, so pack A's kernel launch (including a first-
-    compile stall) never delays pack B's queries (VERDICT r2 weak #10:
-    no head-of-line coupling across (index, field) packs)."""
+    """One pack's pending queries + a launch worker + a completion
+    thread. Packs batch independently, so pack A's kernel launch
+    (including a first-compile stall) never delays pack B's queries
+    (VERDICT r2 weak #10). Launch and completion are SPLIT so batch N+1
+    is prepped and dispatched while batch N still executes on device —
+    JAX async dispatch double-buffers the kernel (VERDICT r3 #1d); the
+    bounded in-flight queue is the backpressure."""
 
     IDLE_EXIT_S = 60.0
+    PIPELINE_DEPTH = 2
 
     def __init__(self, batcher: "MicroBatcher", resident: ResidentPack):
+        import queue as _queue
         self.batcher = batcher
         self.resident = resident
         self.cv = threading.Condition()
         self.pendings: List[_Pending] = []
         self.closed = False
+        # launched-but-not-finished batches; inflight.qsize() is NOT a
+        # busy signal (the completer dequeues before materializing)
+        self.n_inflight = 0
+        self.inflight: Any = _queue.Queue(maxsize=self.PIPELINE_DEPTH)
+        self.completer = threading.Thread(target=self._complete,
+                                          daemon=True,
+                                          name="micro-batcher-complete")
+        self.completer.start()
         self.thread = threading.Thread(target=self._run, daemon=True,
                                        name="micro-batcher-pack")
         self.thread.start()
@@ -368,44 +381,103 @@ class _PackQueue:
 
     def _run(self) -> None:
         batcher = self.batcher
+        try:
+            while True:
+                retire = False
+                taken: List[_Pending] = []
+                with self.cv:
+                    idle_deadline = time.monotonic() + self.IDLE_EXIT_S
+                    while not self.pendings and not self.closed:
+                        remaining = idle_deadline - time.monotonic()
+                        if remaining <= 0:
+                            # idle: retire this queue (a fresh one spawns
+                            # on the next query; stale queues don't leak)
+                            self.closed = True
+                            retire = True
+                            break
+                        self.cv.wait(timeout=remaining)
+                    if not retire:
+                        if self.closed and not self.pendings:
+                            return
+                        # adaptive window: launch a FULL batch any time,
+                        # but while the device is busy with an in-flight
+                        # batch keep accumulating — per-launch cost is
+                        # ~fixed, so more/smaller launches lose (the
+                        # completer notifies when a batch finishes).
+                        # After having waited on a busy device, hold one
+                        # REFILL window so the just-released cohort
+                        # (still assembling its responses under the GIL)
+                        # makes this train instead of fragmenting into
+                        # the next one. An idle device pays only
+                        # window_s — no refill, no latency floor.
+                        deadline = time.monotonic() + batcher.window_s
+                        waited_busy = False
+                        while (len(self.pendings) < batcher.max_batch
+                               and not self.closed):
+                            now = time.monotonic()
+                            if now >= deadline:
+                                if self.n_inflight > 0:
+                                    waited_busy = True
+                                    self.cv.wait(timeout=0.25)
+                                    continue
+                                if not waited_busy:
+                                    break
+                                waited_busy = False
+                                deadline = now + max(
+                                    0.05, batcher.window_s)
+                                continue
+                            self.cv.wait(timeout=deadline - now)
+                        taken = self.pendings[: batcher.max_batch]
+                        self.pendings = self.pendings[batcher.max_batch:]
+                if retire:
+                    # NEVER hold cv while taking the batcher lock
+                    # (submit's get/create path holds it before us)
+                    batcher._retire(self)
+                    return
+                if not taken:
+                    continue
+                try:
+                    st = launch_flat_batch(
+                        self.resident, [p.flat for p in taken],
+                        k=max(p.k for p in taken), mesh=batcher.mesh,
+                        stages=batcher.stages)
+                except Exception as exc:  # noqa: BLE001 — per query
+                    for p in taken:
+                        if not p.future.done():
+                            p.future.set_exception(exc)
+                else:
+                    with self.cv:
+                        self.n_inflight += 1
+                    # blocks when PIPELINE_DEPTH batches are in flight
+                    self.inflight.put((st, taken))
+        finally:
+            self.inflight.put(None)  # stop the completer
+
+    def _complete(self) -> None:
+        batcher = self.batcher
         while True:
-            retire = False
-            taken: List[_Pending] = []
-            with self.cv:
-                idle_deadline = time.monotonic() + self.IDLE_EXIT_S
-                while not self.pendings and not self.closed:
-                    remaining = idle_deadline - time.monotonic()
-                    if remaining <= 0:
-                        # idle: retire this queue (a fresh one spawns on
-                        # the next query; stale-pack queues don't leak)
-                        self.closed = True
-                        retire = True
-                        break
-                    self.cv.wait(timeout=remaining)
-                if not retire:
-                    if self.closed and not self.pendings:
-                        return
-                    # open a window for more arrivals to share the launch
-                    deadline = time.monotonic() + batcher.window_s
-                    while (len(self.pendings) < batcher.max_batch
-                           and time.monotonic() < deadline):
-                        self.cv.wait(timeout=max(
-                            0.0, deadline - time.monotonic()))
-                    taken = self.pendings[: batcher.max_batch]
-                    self.pendings = self.pendings[batcher.max_batch:]
-            if retire:
-                # NEVER hold cv while taking the batcher lock (submit's
-                # get/create path holds it before calling into us)
-                batcher._retire(self)
+            item = self.inflight.get()
+            if item is None:
                 return
-            if not taken:
-                continue
+            st, taken = item
             try:
-                batcher._execute(self.resident, taken)
-            except Exception as exc:  # noqa: BLE001 — propagate per query
+                results = finish_flat_batch(st)
+            except Exception as exc:  # noqa: BLE001 — per query
                 for p in taken:
                     if not p.future.done():
                         p.future.set_exception(exc)
+                with self.cv:
+                    self.n_inflight -= 1
+                    self.cv.notify_all()
+                continue
+            with batcher._lock:
+                batcher.batches_executed += 1
+                batcher.queries_executed += len(taken)
+            for p, res in zip(taken, results):
+                p.future.set_result(res)
+            with self.cv:  # batch finished — the worker may launch now
+                self.n_inflight -= 1
+                self.cv.notify_all()
 
 
 class MicroBatcher:
@@ -415,7 +487,7 @@ class MicroBatcher:
     Each pack has its own queue + worker, so launches for different
     packs overlap."""
 
-    def __init__(self, window_s: float = 0.01, max_batch: int = 64):
+    def __init__(self, window_s: float = 0.01, max_batch: int = 128):
         self.window_s = window_s
         self.max_batch = max_batch
         self._lock = threading.Lock()
@@ -467,18 +539,6 @@ class MicroBatcher:
     # pack arrays were placed with (no per-batch mesh construction)
     mesh = None
     stages: Optional[StageTimes] = None
-
-    def _execute(self, resident: ResidentPack,
-                 pendings: List[_Pending]) -> None:
-        results = execute_flat_batch(
-            resident, [p.flat for p in pendings],
-            k=max(p.k for p in pendings), mesh=self.mesh,
-            stages=self.stages)
-        with self._lock:
-            self.batches_executed += 1
-            self.queries_executed += len(pendings)
-        for p, res in zip(pendings, results):
-            p.future.set_result(res)
 
 
 @dataclasses.dataclass
@@ -532,11 +592,14 @@ class FlatQueryResult:
 #
 # Tiered escalation (VERDICT r4 diagnosis: at 262k docs the tier-1
 # validity bound fails for hot-term queries and the full-postings exact
-# kernel is orders slower): tier 1 scores the top-4k impact prefix of
-# each term; queries whose WAND validity bound fails re-run at the 32k
-# prefix (tier 2); only then the exact kernel. Every tier has a pinned
-# jit signature, prewarmed.
-PREFIX_CAP = 4096
+# kernel is orders slower): tier 1 scores the top-16k impact prefix of
+# each term (measured on the bench corpus at B=64/k=1000: 405ms/launch at
+# 4k with 5% validity failures vs 441ms at 16k with ~none — per-launch
+# cost is dominated by fixed dispatch/transfer overhead, not sort width,
+# and every retry is another fixed-cost launch); failures re-run at the
+# 32k prefix (tier 2); only then the exact kernel. Every tier has a
+# pinned jit signature, prewarmed.
+PREFIX_CAP = 16384
 PREFIX_CAP2 = 32768
 PRUNE_MAX_K = 1000
 PRUNE_MAX_TERMS = 8          # > 8 query terms → exact path
@@ -563,13 +626,14 @@ def _serving_bucket(n: int, cap: int = 64) -> int:
     return _batch_bucket(n, 1024)
 
 
-def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
-                       k: int, mesh=None,
-                       stages: Optional[StageTimes] = None
-                       ) -> List[FlatQueryResult]:
-    """Run one micro-batch. OR-queries (min_count == 1, k ≤ 1000) go
-    through the block-max pruned pipeline; msm/AND queries and pruned
-    queries whose validity bound fails go through the exact kernel."""
+def launch_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
+                      k: int, mesh=None,
+                      stages: Optional[StageTimes] = None) -> Dict[str, Any]:
+    """Phase 1 of a micro-batch: host prep + ASYNC kernel dispatch for
+    the tier-1 pruned subset and the exact subset (msm/AND, big k, many
+    terms). Returns an opaque launch state for finish_flat_batch. JAX
+    dispatch is asynchronous, so the caller can launch batch N+1 while
+    batch N executes on device (double-buffered serving; VERDICT r3 #1d)."""
     if mesh is None:
         mesh = make_mesh(shape=(1, _n_local_devices()))
     pruned_idx = [i for i, f in enumerate(flats)
@@ -577,11 +641,30 @@ def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
                   and len(f.terms) <= PRUNE_MAX_TERMS
                   and resident.imp_device_arrays is not None]
     exact_idx = [i for i in range(len(flats)) if i not in set(pruned_idx)]
-    out: List[Optional[FlatQueryResult]] = [None] * len(flats)
+    st: Dict[str, Any] = {"resident": resident, "flats": flats, "k": k,
+                          "mesh": mesh, "stages": stages,
+                          "pruned_idx": pruned_idx, "exact_idx": exact_idx}
     if pruned_idx:
-        results, invalid = _execute_pruned(
+        st["pruned_launch"] = _launch_pruned(
             resident, [flats[i] for i in pruned_idx], k, mesh,
-            stages=stages)
+            prefix_cap=PREFIX_CAP, stages=stages)
+    if exact_idx:
+        st["exact_launch"] = _launch_exact(
+            resident, [flats[i] for i in exact_idx], k, mesh)
+    return st
+
+
+def finish_flat_batch(st: Dict[str, Any]) -> List[FlatQueryResult]:
+    """Phase 2: materialize device results, run the tier-2 retry for
+    validity failures (deeper prefix), and the exact tier-3 fallback."""
+    resident, flats, k, mesh, stages = (st["resident"], st["flats"],
+                                        st["k"], st["mesh"], st["stages"])
+    pruned_idx, exact_idx = st["pruned_idx"], list(st["exact_idx"])
+    out: List[Optional[FlatQueryResult]] = [None] * len(flats)
+    tier3_idx: List[int] = []
+    if pruned_idx:
+        results, invalid = _finish_pruned(st["pruned_launch"],
+                                          stages=stages)
         for j, i in enumerate(pruned_idx):
             out[i] = results[j]
         if invalid:
@@ -597,17 +680,33 @@ def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
                 out[i] = results2[j]
             if invalid2 and stages is not None:
                 stages.add("pruned_invalid_t2", 0.0, n=len(invalid2))
-            exact_idx.extend(retry_idx[j] for j in invalid2)
-    if exact_idx:
+            tier3_idx = [retry_idx[j] for j in invalid2]
+    if "exact_launch" in st:
+        results = _finish_exact(st["exact_launch"])
+        for j, i in enumerate(st["exact_idx"]):
+            out[i] = results[j]
+    if tier3_idx:
         t0 = time.perf_counter()
-        results = _execute_exact(resident, [flats[i] for i in exact_idx],
-                                 k, mesh)
+        results = _execute_exact(resident,
+                                 [flats[i] for i in tier3_idx], k, mesh)
         if stages is not None:
             stages.add("exact_batch", time.perf_counter() - t0,
-                       n=len(exact_idx))
-        for j, i in enumerate(exact_idx):
+                       n=len(tier3_idx))
+        for j, i in enumerate(tier3_idx):
             out[i] = results[j]
     return out  # type: ignore[return-value]
+
+
+def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
+                       k: int, mesh=None,
+                       stages: Optional[StageTimes] = None
+                       ) -> List[FlatQueryResult]:
+    """Run one micro-batch synchronously. OR-queries (min_count == 1,
+    k ≤ 1000) go through the block-max pruned pipeline; msm/AND queries
+    and pruned queries whose validity bound fails escalate (32k prefix,
+    then exact kernel)."""
+    return finish_flat_batch(launch_flat_batch(resident, flats, k, mesh,
+                                               stages=stages))
 
 
 def _columnar_results(resident: ResidentPack, vals: np.ndarray,
@@ -641,15 +740,15 @@ def _columnar_results(resident: ResidentPack, vals: np.ndarray,
     return out
 
 
-def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
-                   k: int, mesh) -> List[FlatQueryResult]:
-    """Full-postings kernel: exact scores, exact totals (tier 3 for OR
-    queries whose validity bounds failed twice; tier 1 for msm/AND).
-    Every jit dimension is BUCKETED — batch (8/64/pow2), kernel k
-    (128/1024/pow2), slot count (pow2 ≥ 8), window (≥ 8), chunk length
-    (pinned CHUNK_CAP) — so steady-state serving re-uses a handful of
-    compiled signatures (cold ones compile once ever, persisted by the
-    compilation cache)."""
+def _launch_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
+                  k: int, mesh) -> Dict[str, Any]:
+    """Full-postings kernel, async dispatch: exact scores, exact totals
+    (tier 3 for OR queries whose validity bounds failed twice; tier 1
+    for msm/AND). Every jit dimension is BUCKETED — batch (8/64/pow2),
+    kernel k (128/1024/pow2), slot count (pow2 ≥ 8), window (≥ 8), chunk
+    length (pinned CHUNK_CAP) — so steady-state serving re-uses a
+    handful of compiled signatures (cold ones compile once ever,
+    persisted by the compilation cache)."""
     import dataclasses as _dc
 
     pack = resident.pack
@@ -673,22 +772,32 @@ def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
                                      else _batch_bucket(k, 16384))
     vals, gids, totals = dist.distributed_search_raw(
         pack, batch, k_kernel, mesh, device_arrays=resident.device_arrays,
-        t_window=max(_PRUNE_WINDOW, batch.window))
-    return _columnar_results(resident, vals, gids, totals, len(flats),
-                             lambda qi: "eq", k_cap=k)
+        t_window=max(_PRUNE_WINDOW, batch.window), materialize=False)
+    return {"resident": resident, "n": len(flats), "k": k,
+            "vals": vals, "gids": gids, "totals": totals}
 
 
-def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
-                    k: int, mesh, stages: Optional[StageTimes] = None,
-                    prefix_cap: int = PREFIX_CAP
-                    ) -> Tuple[List[FlatQueryResult], List[int]]:
-    """Block-max pipeline (SURVEY.md §5.7/§7.3#3), one fused launch:
-    candidate generation over impact-sorted prefixes + EXACT on-device
-    re-score (binary search in the doc-sorted postings) + final order;
-    only [B, k] results cross the device→host link. The WAND validity
-    bound — any doc outside the candidates scores below (approx cutoff
-    + Σ skipped-tail maxima) — is checked here; failures rerun on the
-    exact kernel. Returns (results, invalid indices)."""
+def _finish_exact(launch: Dict[str, Any]) -> List[FlatQueryResult]:
+    vals = np.asarray(launch["vals"])
+    gids = np.asarray(launch["gids"])
+    totals = np.asarray(launch["totals"])
+    return _columnar_results(launch["resident"], vals, gids, totals,
+                             launch["n"], lambda qi: "eq",
+                             k_cap=launch["k"])
+
+
+def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
+                   k: int, mesh) -> List[FlatQueryResult]:
+    return _finish_exact(_launch_exact(resident, flats, k, mesh))
+
+
+def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
+                   k: int, mesh, prefix_cap: int = PREFIX_CAP,
+                   stages: Optional[StageTimes] = None) -> Dict[str, Any]:
+    """Block-max pipeline (SURVEY.md §5.7/§7.3#3), one fused ASYNC
+    launch: candidate generation over impact-sorted prefixes + EXACT
+    on-device re-score (binary search in the doc-sorted postings) +
+    final order; only [B, k] results cross the device→host link."""
     import jax
 
     t_prep = time.perf_counter()
@@ -726,15 +835,30 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
         put(batch.weights, sbt),
         put(t_starts, sbt), put(t_lengths, sbt), put(t_weights, sbt),
         put(batch.tail_bounds, sb))
+    t_dev = time.perf_counter()
+    if stages is not None:
+        stages.add("batch_prep", t_disp - t_prep)
+        stages.add("batch_dispatch", t_dev - t_disp)
+    return {"resident": resident, "flats": flats, "k": k,
+            "packed": packed}
+
+
+def _finish_pruned(launch: Dict[str, Any],
+                   stages: Optional[StageTimes] = None
+                   ) -> Tuple[List[FlatQueryResult], List[int]]:
+    """Materialize a pruned launch and check the WAND validity bound —
+    any doc outside the candidates scores below (approx cutoff + Σ
+    skipped-tail maxima); failures escalate. Returns (results, invalid
+    indices)."""
+    resident, flats, k = (launch["resident"], launch["flats"],
+                          launch["k"])
     # one device→host transfer; split host-side (k derived from the
     # packed width — the kernel clamps k_out to its candidate pool)
     t_dev = time.perf_counter()
     vals, gids, totals, cutoff, beta = dist.unpack_pruned(
-        np.asarray(packed))
+        np.asarray(launch["packed"]))
     t_decode = time.perf_counter()
     if stages is not None:
-        stages.add("batch_prep", t_disp - t_prep)
-        stages.add("batch_dispatch", t_dev - t_disp)
         stages.add("batch_device_wait", t_decode - t_dev)
 
     # vectorized batch decode (VERDICT r3 #1): clamp each query to its
@@ -768,6 +892,16 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     return results, invalid
 
 
+def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
+                    k: int, mesh, stages: Optional[StageTimes] = None,
+                    prefix_cap: int = PREFIX_CAP
+                    ) -> Tuple[List[FlatQueryResult], List[int]]:
+    """Synchronous pruned execution (tier-2 retries, prewarm, dryrun)."""
+    return _finish_pruned(
+        _launch_pruned(resident, flats, k, mesh, prefix_cap=prefix_cap,
+                       stages=stages), stages=stages)
+
+
 def _n_local_devices() -> int:
     import jax
     return len(jax.devices())
@@ -782,7 +916,7 @@ class TpuSearchService:
     micro-batched execution. One instance per node."""
 
     def __init__(self, breaker=None, mesh=None, window_s: float = 0.01,
-                 max_batch: int = 64, batch_timeout_s: float = 30.0):
+                 max_batch: int = 128, batch_timeout_s: float = 30.0):
         _ensure_compile_cache()
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.batch_timeout_s = batch_timeout_s
@@ -905,12 +1039,16 @@ class TpuSearchService:
                     terms = [next(iter(v))]
                     break
             flat = FlatQuery(field, terms or ["_warm_"], 1.0, 1)
-            for b_bucket, k, cap in (
-                    (8, 10, PREFIX_CAP), (64, 10, PREFIX_CAP),
-                    (8, PRUNE_MAX_K, PREFIX_CAP), (64, PRUNE_MAX_K, PREFIX_CAP),
-                    (8, 10, PREFIX_CAP2), (64, 10, PREFIX_CAP2),
-                    (8, PRUNE_MAX_K, PREFIX_CAP2),
-                    (64, PRUNE_MAX_K, PREFIX_CAP2)):
+            buckets = [8, 64]
+            full = _serving_bucket(self.batcher.max_batch)
+            if full not in buckets:
+                buckets.append(full)
+            table = []
+            for b_bucket in buckets:
+                for k in (10, PRUNE_MAX_K):
+                    for cap in (PREFIX_CAP, PREFIX_CAP2):
+                        table.append((b_bucket, k, cap))
+            for b_bucket, k, cap in table:
                 t1 = time.perf_counter()
                 _execute_pruned(resident, [flat] * b_bucket, k,
                                 self.packs.mesh, prefix_cap=cap)

@@ -211,13 +211,15 @@ class TestPerPackQueues:
 
         pack_a, pack_b = _FakePack(), _FakePack()
 
-        def fake_execute(resident, flats, k, mesh=None, stages=None):
+        def fake_launch(resident, flats, k, mesh=None, stages=None):
             if resident is pack_a:
                 slow_started.set()
                 assert release_slow.wait(timeout=10.0)
-            return [f"res-{id(resident)}" for _ in flats]
+            return {"results": [f"res-{id(resident)}" for _ in flats]}
 
-        monkeypatch.setattr(svc_mod, "execute_flat_batch", fake_execute)
+        monkeypatch.setattr(svc_mod, "launch_flat_batch", fake_launch)
+        monkeypatch.setattr(svc_mod, "finish_flat_batch",
+                            lambda st: st["results"])
         try:
             fut_a = batcher.submit(pack_a, flat=None, k=1)
             assert slow_started.wait(timeout=5.0)
@@ -246,16 +248,18 @@ class TestPerPackQueues:
         release = threading.Event()
         all_submitted = threading.Event()
 
-        def fake_execute(resident, flats, k, mesh=None, stages=None):
+        def fake_launch(resident, flats, k, mesh=None, stages=None):
             if not calls:  # hold the FIRST launch open
                 calls.append(len(flats))
                 assert release.wait(timeout=10.0)
             else:
                 assert all_submitted.is_set()
                 calls.append(len(flats))
-            return ["r"] * len(flats)
+            return {"results": ["r"] * len(flats)}
 
-        monkeypatch.setattr(svc_mod, "execute_flat_batch", fake_execute)
+        monkeypatch.setattr(svc_mod, "launch_flat_batch", fake_launch)
+        monkeypatch.setattr(svc_mod, "finish_flat_batch",
+                            lambda st: st["results"])
         pack = object()
         try:
             futs = [batcher.submit(pack, flat=i, k=1) for i in range(4)]
@@ -411,7 +415,7 @@ class TestReviewFindings:
         from elasticsearch_tpu.search import tpu_service
         make_corpus(svc, seeded_np, docs=30)
         monkeypatch.setattr(
-            tpu_service, "execute_flat_batch",
+            tpu_service, "launch_flat_batch",
             lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
         tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
         try:
