@@ -41,6 +41,12 @@ class AllocationService:
 
         # ensure a routing skeleton exists for every index
         for name, meta in state.indices.items():
+            if getattr(meta, "state", "open") == "close":
+                # closed indices keep their data node-local but hold no
+                # active routing (reference: closed indices have no
+                # in-sync routing pre-7.2 replicated-closed)
+                routing.pop(name, None)
+                continue
             shards = routing.setdefault(name, {})
             for s in range(meta.number_of_shards):
                 copies = shards.setdefault(s, [])

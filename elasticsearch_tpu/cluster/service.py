@@ -65,6 +65,8 @@ ACTION_COUNT_GROUP = "indices/data/count_group"
 ACTION_MAINTENANCE = "indices/data/maintenance"
 ACTION_CREATE_INDEX = "cluster/admin/create_index"
 ACTION_DELETE_INDEX = "cluster/admin/delete_index"
+ACTION_CLOSE_INDEX = "cluster/admin/close_index"
+ACTION_OPEN_INDEX = "cluster/admin/open_index"
 ACTION_PUT_MAPPING = "cluster/admin/put_mapping"
 ACTION_UPDATE_INDEX_SETTINGS = "cluster/admin/update_index_settings"
 ACTION_UPDATE_CLUSTER_SETTINGS = "cluster/admin/update_cluster_settings"
@@ -256,6 +258,8 @@ class ClusterService:
                 (ACTION_COUNT_GROUP, self._handle_count_group),
                 (ACTION_CREATE_INDEX, self._handle_create_index),
                 (ACTION_DELETE_INDEX, self._handle_delete_index),
+                (ACTION_CLOSE_INDEX, self._handle_close_index),
+                (ACTION_OPEN_INDEX, self._handle_open_index),
                 (ACTION_PUT_MAPPING, self._handle_put_mapping),
                 (ACTION_UPDATE_INDEX_SETTINGS,
                  self._handle_update_index_settings),
@@ -390,6 +394,18 @@ class ClusterService:
                     name, Settings.of(meta.settings), meta.mapping,
                     index_uuid=meta.uuid, create_shards=False)
             svc = indices.index(name)
+            # closed indices: shut local shards via the empty `wanted`
+            # below; the flag makes direct access raise
+            # IndexClosedException, not ShardNotFound
+            was_closed = svc.closed
+            svc.closed = (getattr(meta, "state", "open") == "close")
+            if svc.closed and not was_closed \
+                    and self.node.tpu_search is not None:
+                # release the closed index's resident packs (HBM breaker
+                # bytes + device arrays)
+                self.node.tpu_search.invalidate_index(name)
+            if was_closed and svc.closed:
+                continue  # already reconciled closed; nothing to do
             if meta.mapping:
                 try:  # idempotent merge keeps local mappers current
                     svc.mapper.merge(meta.mapping)
@@ -414,6 +430,10 @@ class ClusterService:
             # remove shards no longer assigned here
             for shard_num in [s for s in list(svc.shards) if s not in wanted]:
                 shard = svc.shards.pop(shard_num)
+                try:  # keep the store current before shutting the copy
+                    shard.flush()
+                except EsException:
+                    pass
                 shard.close()
             # create/promote assigned copies. Primaries open from the
             # local store immediately; replicas run peer recovery from
@@ -658,6 +678,8 @@ class ClusterService:
             n_replicas = flat.get_int("index.number_of_replicas", 0)
             norm["index.number_of_shards"] = n_shards
             norm["index.number_of_replicas"] = n_replicas
+            if "index.creation_date" not in norm:  # rollover max_age
+                norm["index.creation_date"] = int(time.time() * 1000)
             meta = IndexMeta(
                 name=name, uuid=index_uuid, settings=norm,
                 mapping=mapping, number_of_shards=n_shards,
@@ -669,6 +691,64 @@ class ClusterService:
 
         self._run_master_update(update, source=f"create-index[{name}]")
         return {"acknowledged": True, "index": name}
+
+    def _handle_close_index(self, payload, from_node) -> Dict[str, Any]:
+        """Reference: MetadataIndexStateService#closeIndices — the meta
+        flips to CLOSE and the index's routing is dropped; appliers shut
+        local shards (data stays on disk)."""
+        name = payload["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            meta = state.indices.get(name)
+            if meta is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            import dataclasses as _dc
+            new_indices = dict(state.indices)
+            new_indices[name] = _dc.replace(meta, state="close")
+            new_routing = {k: v for k, v in state.routing.items()
+                           if k != name}
+            return state.with_updates(indices=new_indices,
+                                      routing=new_routing)
+
+        self._run_master_update(update, source=f"close-index[{name}]")
+        return {"acknowledged": True, "indices": {name: {"closed": True}}}
+
+    def _handle_open_index(self, payload, from_node) -> Dict[str, Any]:
+        """Reference: MetadataIndexStateService#openIndices — meta back
+        to OPEN; reroute re-allocates primaries onto the nodes holding
+        their stores (the store-found machinery)."""
+        name = payload["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            meta = state.indices.get(name)
+            if meta is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            import dataclasses as _dc
+            new_indices = dict(state.indices)
+            new_indices[name] = _dc.replace(meta, state="open")
+            return self.allocation.reroute(
+                state.with_updates(indices=new_indices))
+
+        self._run_master_update(update, source=f"open-index[{name}]")
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    def close_index_admin(self, name: str) -> Dict[str, Any]:
+        result = self._call_master(ACTION_CLOSE_INDEX, {"name": name})
+        self.wait_for_applied(
+            lambda s: name in s.indices
+            and s.indices[name].state == "close", timeout=10.0)
+        return result
+
+    def open_index_admin(self, name: str) -> Dict[str, Any]:
+        result = self._call_master(ACTION_OPEN_INDEX, {"name": name})
+        self.wait_for_applied(
+            lambda s: name in s.indices
+            and s.indices[name].state == "open"
+            and all(s.primary(name, i) is not None
+                    and s.primary(name, i).state == STARTED
+                    for i in range(s.indices[name].number_of_shards)),
+            timeout=15.0)
+        return result
 
     def _handle_delete_index(self, payload, from_node) -> Dict[str, Any]:
         name = payload["name"]
@@ -1028,6 +1108,8 @@ class ClusterService:
         if addr == self.local_node.address:
             handler = {ACTION_CREATE_INDEX: self._handle_create_index,
                        ACTION_DELETE_INDEX: self._handle_delete_index,
+                       ACTION_CLOSE_INDEX: self._handle_close_index,
+                       ACTION_OPEN_INDEX: self._handle_open_index,
                        ACTION_PUT_MAPPING: self._handle_put_mapping,
                        ACTION_UPDATE_INDEX_SETTINGS:
                            self._handle_update_index_settings,

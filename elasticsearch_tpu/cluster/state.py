@@ -84,6 +84,8 @@ class IndexMeta:
     # reference: IndexMetadata#getAliases
     aliases: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    # "open" | "close" (reference: IndexMetadata.State)
+    state: str = "open"
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -97,7 +99,8 @@ class IndexMeta:
                          number_of_replicas=int(d["number_of_replicas"]),
                          in_sync={k: list(v) for k, v in
                                   (d.get("in_sync") or {}).items()},
-                         aliases=dict(d.get("aliases") or {}))
+                         aliases=dict(d.get("aliases") or {}),
+                         state=d.get("state", "open"))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -169,6 +169,18 @@ class ClusterBlockException(EsException):
     status = 503
 
 
+class IndexClosedException(EsException):
+    """Operation on a closed index (reference: IndexClosedException,
+    surfaced as 400)."""
+    status = 400
+
+
+class IndexBlockException(ClusterBlockException):
+    """A per-index block (e.g. index.blocks.write) rejected the request
+    (reference: ClusterBlockException for index blocks — 403)."""
+    status = 403
+
+
 class RecoveryFailedException(EsException):
     status = 500
 

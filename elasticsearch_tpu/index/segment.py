@@ -72,7 +72,8 @@ class Segment:
                  seq_nos: Optional[np.ndarray] = None,
                  primary_terms: Optional[np.ndarray] = None,
                  doc_versions: Optional[np.ndarray] = None,
-                 token_slots: Optional[Dict[str, Dict[int, List[List[Optional[str]]]]]] = None):
+                 token_slots: Optional[Dict[str, Dict[int, List[List[Optional[str]]]]]] = None,
+                 nested_store: Optional[Dict[str, Dict[int, List[Dict[str, List[Any]]]]]] = None):
         self.name = name
         self.num_docs = num_docs
         self.doc_ids = doc_ids                    # local doc ord -> external _id
@@ -87,6 +88,10 @@ class Segment:
         # index time (VERDICT r3 #4)
         self.token_slots = token_slots or {}
         self._positions = positions or {}
+        # nested root → {doc ord: [per-object {subfield: [raw values]}]}
+        # (reference: nested sub-documents; queried per object by the
+        # planner's nested evaluator)
+        self.nested_store = nested_store or {}
         # exact token counts per doc (i64, -1 = field absent): norms are the
         # lossy scoring representation; stats (avgdl) must stay EXACT across
         # merges, as Lucene maintains sumTotalTermFreq exactly
@@ -169,6 +174,7 @@ class SegmentWriter:
         self._seq_nos: List[int] = []
         self._primary_terms: List[int] = []
         self._versions: List[int] = []
+        self._nested: Dict[str, Dict[int, List[Dict[str, List[Any]]]]] = {}
 
     @property
     def num_docs(self) -> int:
@@ -190,6 +196,9 @@ class SegmentWriter:
                 self._doc_terms.setdefault(field, []).append((ord_, terms))
         for field, slot_lists in doc.term_slots.items():
             self._doc_slots.setdefault(field, {})[ord_] = slot_lists
+        for root, objs in doc.nested.items():
+            if objs:
+                self._nested.setdefault(root, {})[ord_] = objs
         for field, length in doc.field_lengths.items():
             self._field_lengths.setdefault(field, {})[ord_] = length
             stats = self._field_stats.setdefault(field, FieldStats())
@@ -231,7 +240,9 @@ class SegmentWriter:
                                               dtype=np.int64),
                        doc_versions=np.array(self._versions, dtype=np.int64),
                        token_slots={f: dict(d)
-                                    for f, d in self._doc_slots.items()})
+                                    for f, d in self._doc_slots.items()},
+                       nested_store={r: dict(d)
+                                     for r, d in self._nested.items()})
 
 
 def _build_postings(entries: List[Tuple[int, List[str]]], n: int
@@ -332,6 +343,14 @@ def merge_segments(name: str, segments: List[Segment],
     postings: Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
     positions: Dict[str, Dict[str, Dict[int, np.ndarray]]] = {}
     token_slots: Dict[str, Dict[int, List[List[Optional[str]]]]] = {}
+    nested_store: Dict[str, Dict[int, List[Dict[str, List[Any]]]]] = {}
+    for i, seg in enumerate(segments):
+        m = remap[i]
+        for root, per_doc in seg.nested_store.items():
+            for d, objs in per_doc.items():
+                nd = int(m[d])
+                if nd >= 0:
+                    nested_store.setdefault(root, {})[nd] = objs
     norms: Dict[str, np.ndarray] = {}
     field_stats: Dict[str, FieldStats] = {}
     dv_parts: Dict[str, List[Tuple[int, DocValuesColumn, np.ndarray]]] = {}
@@ -435,4 +454,4 @@ def merge_segments(name: str, segments: List[Segment],
                    seq_nos=np.array(seq_nos, dtype=np.int64),
                    primary_terms=np.array(primary_terms, dtype=np.int64),
                    doc_versions=np.array(doc_versions, dtype=np.int64),
-                   token_slots=token_slots)
+                   token_slots=token_slots, nested_store=nested_store)

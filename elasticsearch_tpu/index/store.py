@@ -62,6 +62,9 @@ def save_segment(path: str, seg: Segment) -> Dict[str, int]:
         "token_slots": {
             f: {str(d): sl for d, sl in per_doc.items()}
             for f, per_doc in seg.token_slots.items()},
+        "nested": {
+            r: {str(d): objs for d, objs in per_doc.items()}
+            for r, per_doc in seg.nested_store.items()},
         "postings_fields": {}, "dv": {},
     }
     for field, terms in seg.postings.items():
@@ -156,6 +159,9 @@ def load_segment(path: str, name: str,
     token_slots = {
         f: {int(d): sl for d, sl in per_doc.items()}
         for f, per_doc in meta.get("token_slots", {}).items()}
+    nested_store = {
+        r: {int(d): objs for d, objs in per_doc.items()}
+        for r, per_doc in meta.get("nested", {}).items()}
     seq_nos = arrays["meta.seq_nos"] if "meta.seq_nos" in arrays.files else None
     primary_terms = (arrays["meta.primary_terms"]
                      if "meta.primary_terms" in arrays.files else None)
@@ -164,7 +170,8 @@ def load_segment(path: str, name: str,
     return Segment(meta["name"], meta["num_docs"], meta["doc_ids"], postings,
                    norms, field_stats, doc_values, meta["stored"], positions,
                    exact, seq_nos=seq_nos, primary_terms=primary_terms,
-                   doc_versions=doc_versions, token_slots=token_slots)
+                   doc_versions=doc_versions, token_slots=token_slots,
+                   nested_store=nested_store)
 
 
 def write_commit(path: str, *, segments: List[str],

@@ -133,6 +133,7 @@ class IndexService:
         self.mapper = MapperService(settings, mapping)
         self.data_path = data_path
         self.shards: Dict[int, IndexShard] = {}
+        self.closed = False  # reference: IndexMetadata.State.CLOSE
         self._k1 = settings.get_float("index.similarity.default.k1", 1.2)
         self._b = settings.get_float("index.similarity.default.b", 0.75)
         self._durability = settings.get("index.translog.durability", "request")
@@ -153,11 +154,29 @@ class IndexService:
         return shard
 
     def shard(self, shard_num: int) -> IndexShard:
+        if self.closed:
+            from elasticsearch_tpu.common.errors import \
+                IndexClosedException
+            raise IndexClosedException(f"closed index [{self.name}]")
         s = self.shards.get(shard_num)
         if s is None:
             raise ShardNotFoundException(
                 f"shard [{self.name}][{shard_num}] not found on this node")
         return s
+
+    def check_write_block(self) -> None:
+        """Reject writes when index.blocks.write or index.blocks.read_only
+        is set (reference: IndexMetadata#INDEX_WRITE_BLOCK /
+        INDEX_READ_ONLY_BLOCK — the former is the shrink precondition)."""
+        from elasticsearch_tpu.common.errors import IndexBlockException
+        if self.settings.get_bool("index.blocks.write", False):
+            raise IndexBlockException(
+                f"index [{self.name}] blocked by: "
+                f"[FORBIDDEN/8/index write (api)]")
+        if self.settings.get_bool("index.blocks.read_only", False):
+            raise IndexBlockException(
+                f"index [{self.name}] blocked by: "
+                f"[FORBIDDEN/5/index read-only (api)]")
 
     def shard_for_id(self, doc_id: str, routing: Optional[str] = None) -> int:
         return shard_for(routing or doc_id, self.num_shards)
@@ -165,7 +184,8 @@ class IndexService:
     # -------- dynamic settings (reference: IndexScopedSettings) --------
 
     DYNAMIC_PREFIXES = ("index.search.slowlog.threshold.",)
-    DYNAMIC_KEYS = ("index.number_of_replicas", "index.default_pipeline")
+    DYNAMIC_KEYS = ("index.number_of_replicas", "index.default_pipeline",
+                    "index.blocks.write", "index.blocks.read_only")
 
     @classmethod
     def validate_dynamic_settings(cls, changes: Dict[str, Any]) -> None:
@@ -238,7 +258,9 @@ class IndicesService:
         meta = {
             "indices": {name: {"uuid": svc.index_uuid,
                                "settings": svc.settings.get_as_dict(),
-                               "mapping": svc.mapper.to_mapping()}
+                               "mapping": svc.mapper.to_mapping(),
+                               "state": ("close" if svc.closed
+                                         else "open")}
                         for name, svc in self.indices.items()},
             "aliases": self.aliases,
         }
@@ -265,8 +287,11 @@ class IndicesService:
             svc = IndexService(name, m["uuid"], Settings.of(m["settings"]),
                                m.get("mapping"),
                                os.path.join(self.data_path, m["uuid"]))
-            for i in range(svc.num_shards):
-                svc.create_shard(i, primary=True)  # recovers from store
+            if m.get("state") == "close":
+                svc.closed = True  # data stays on disk, shards stay shut
+            else:
+                for i in range(svc.num_shards):
+                    svc.create_shard(i, primary=True)  # recovers from store
             self.indices[name] = svc
 
     def create_index(self, name: str, settings: Optional[Settings] = None,
@@ -278,6 +303,11 @@ class IndicesService:
                 raise IndexAlreadyExistsException(f"index [{name}] already exists")
             _validate_index_name(name)
             settings = settings or Settings.EMPTY
+            if settings.get("index.creation_date") is None:
+                import time as _time
+                d = settings.get_as_dict()
+                d["index.creation_date"] = int(_time.time() * 1000)
+                settings = Settings(d)
             index_uuid = index_uuid or str(uuid.uuid4())
             svc = IndexService(name, index_uuid, settings, mapping,
                                os.path.join(self.data_path, index_uuid))
@@ -336,6 +366,38 @@ class IndicesService:
 
     def write_index_for(self, alias: str) -> str:
         return select_write_index(self.aliases.get(alias) or {}, alias)
+
+    # -------- lifecycle (reference: MetadataIndexStateService,
+    # TransportRolloverAction, MetadataCreateIndexService#shrink) --------
+
+    def close_index(self, name: str) -> None:
+        """Flush + shut the index's shards; data stays on disk, the index
+        rejects reads/writes until _open (reference:
+        MetadataIndexStateService#closeIndices)."""
+        with self._lock:
+            svc = self.indices.get(name)
+            if svc is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            if not svc.closed:
+                for s in svc.shards.values():
+                    s.flush()
+                    s.close()
+                svc.shards.clear()
+                svc.closed = True
+                self._persist_metadata_locked()
+
+    def open_index(self, name: str) -> None:
+        """Reopen a closed index from its store (reference:
+        MetadataIndexStateService#openIndices)."""
+        with self._lock:
+            svc = self.indices.get(name)
+            if svc is None:
+                raise IndexNotFoundException(f"no such index [{name}]")
+            if svc.closed:
+                svc.closed = False
+                for i in range(svc.num_shards):
+                    svc.create_shard(i, primary=True)
+                self._persist_metadata_locked()
 
     def delete_index(self, name: str) -> None:
         with self._lock:

@@ -1,0 +1,195 @@
+"""Index lifecycle admin: close/open, rollover, shrink.
+
+Reference analogs (SURVEY.md §2.1#49):
+  - close/open: MetadataIndexStateService#closeIndices/#openIndices
+  - rollover:   TransportRolloverAction + MetadataRolloverService
+    (condition evaluation, `<name>-NNNNNN` target naming, write-alias
+    swap)
+  - shrink:     TransportResizeAction + MetadataCreateIndexService
+    (divisibility + write-block preconditions). The reference hard-links
+    Lucene segment files into the target; here the target is rebuilt
+    through the engine's bulk write path (same observable result:
+    all live docs, fewer shards; per-doc versions restart at 1, noted).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             IndexClosedException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.units import ByteSizeValue, TimeValue
+
+_ROLLOVER_RE = re.compile(r"^(.*?)-(\d+)$")
+
+
+def next_rollover_name(source: str) -> str:
+    """`logs-000001` → `logs-000002` (reference:
+    MetadataRolloverService#generateRolloverIndexName)."""
+    m = _ROLLOVER_RE.match(source)
+    if m is None:
+        raise IllegalArgumentException(
+            f"index name [{source}] does not match pattern '^.*-\\d+$'")
+    width = max(6, len(m.group(2)))
+    return f"{m.group(1)}-{int(m.group(2)) + 1:0{width}d}"
+
+
+def evaluate_conditions(conditions: Optional[Dict[str, Any]], *,
+                        docs: int, age_ms: int,
+                        size_bytes: int) -> Dict[str, bool]:
+    """→ {condition key as the reference renders it: met?}."""
+    out: Dict[str, bool] = {}
+    for key, val in (conditions or {}).items():
+        if key == "max_docs":
+            out[f"[max_docs: {int(val)}]"] = docs >= int(val)
+        elif key == "max_age":
+            ms = int(TimeValue.parse(str(val)).seconds * 1000)
+            out[f"[max_age: {val}]"] = age_ms >= ms
+        elif key in ("max_size", "max_primary_shard_size"):
+            limit = ByteSizeValue.parse(str(val)).bytes
+            out[f"[{key}: {val}]"] = size_bytes >= limit
+        else:
+            raise IllegalArgumentException(
+                f"unknown rollover condition [{key}]")
+    return out
+
+
+def _source_stats(node, source: str) -> Tuple[int, int, int]:
+    """(docs, age_ms, size_bytes) of the rollover source index."""
+    if node.cluster is not None:
+        meta = node.cluster.applied_state().indices[source]
+        created = int(meta.settings.get("index.creation_date", 0) or 0)
+        docs = int(node.cluster.route_count(source, None)["count"])
+        size = 0  # cross-node store-size aggregation: not tracked yet
+        svc = (node.indices.indices.get(source))
+        if svc is not None:
+            size = sum(v.segment.ram_bytes_estimate()
+                       for s in svc.shards.values()
+                       for v in s.acquire_searcher().views)
+    else:
+        svc = node.indices.index(source)
+        created = int(svc.settings.get("index.creation_date", 0) or 0)
+        docs = sum(s.engine.num_docs() for s in svc.shards.values())
+        size = sum(v.segment.ram_bytes_estimate()
+                   for s in svc.shards.values()
+                   for v in s.acquire_searcher().views)
+    age_ms = int(time.time() * 1000) - created if created else 0
+    return docs, age_ms, size
+
+
+def rollover(node, alias: str, body: Optional[Dict[str, Any]],
+             new_index: Optional[str] = None,
+             dry_run: bool = False) -> Dict[str, Any]:
+    """POST /<alias>/_rollover[/<new_index>]. If any condition is met
+    (or none are given), create the next index and move the alias's
+    write pointer to it."""
+    from elasticsearch_tpu.indices.service import select_write_index
+    body = body or {}
+    if node.cluster is not None:
+        view = node.cluster._StateView(node.cluster.applied_state())
+        targets = view.aliases.get(alias)
+    else:
+        targets = node.indices.alias_targets(alias)
+    if targets is None:
+        raise IllegalArgumentException(
+            f"rollover target [{alias}] is not an alias")
+    source = select_write_index(targets, alias)
+    docs, age_ms, size = _source_stats(node, source)
+    conds = evaluate_conditions(body.get("conditions"),
+                                docs=docs, age_ms=age_ms, size_bytes=size)
+    rolled = (not conds) or any(conds.values())
+    target = new_index or next_rollover_name(source)
+    out = {"acknowledged": False, "shards_acknowledged": False,
+           "old_index": source, "new_index": target,
+           "rolled_over": False, "dry_run": dry_run, "conditions": conds}
+    if dry_run or not rolled:
+        return out
+
+    settings = body.get("settings") or {}
+    mappings = body.get("mappings")
+    had_write_flag = bool((targets.get(source) or {}).get("is_write_index"))
+    if node.cluster is not None:
+        node.cluster.create_index(target, settings, mappings)
+        actions = [{"add": {"index": target, "alias": alias,
+                            "is_write_index": True}}]
+        if had_write_flag:
+            # the old index stays under the alias, write flag off
+            actions.insert(0, {"add": {"index": source, "alias": alias,
+                                       "is_write_index": False}})
+        else:
+            actions.insert(0, {"remove": {"index": source,
+                                          "alias": alias}})
+        node.cluster.update_aliases(actions)
+    else:
+        node.create_index(target, Settings(
+            Settings.normalize_index_settings(settings)), mappings)
+        if had_write_flag:
+            node.indices.put_alias(source, alias,
+                                   {"is_write_index": False})
+            node.indices.put_alias(target, alias,
+                                   {"is_write_index": True})
+        else:
+            node.indices.delete_alias(source, alias)
+            node.indices.put_alias(target, alias,
+                                   {"is_write_index": True})
+    out["acknowledged"] = True
+    out["shards_acknowledged"] = True
+    out["rolled_over"] = True
+    return out
+
+
+def shrink(node, source: str, target: str,
+           body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """PUT /<source>/_shrink/<target>: rebuild the source's live docs
+    into an index with fewer shards. Preconditions per the reference:
+    the target shard count divides the source's, and the source carries
+    a write block. Custom-routed docs re-route by _id in the target
+    (per-doc _routing is not persisted — divergence noted)."""
+    if node.cluster is not None:
+        raise IllegalArgumentException(
+            "_shrink is supported on single-node deployments only for "
+            "now (cluster resize requires co-located source shards)")
+    indices = node.indices
+    svc = indices.index(source)
+    if svc.closed:
+        raise IndexClosedException(f"closed index [{source}]")
+    if not svc.settings.get_bool("index.blocks.write", False):
+        raise IllegalArgumentException(
+            f"index [{source}] must be read-only to resize it. Set "
+            f"\"index.blocks.write: true\"")
+    body = body or {}
+    settings = Settings.normalize_index_settings(body.get("settings"))
+    n_target = int(settings.get("index.number_of_shards", 1))
+    settings["index.number_of_shards"] = n_target
+    # the shrunken index must not inherit the source's write block
+    settings.setdefault("index.blocks.write", None)
+    settings = {k: v for k, v in settings.items() if v is not None}
+    if n_target <= 0 or svc.num_shards % n_target != 0:
+        raise IllegalArgumentException(
+            f"the number of source shards [{svc.num_shards}] must be a "
+            f"multiple of [{n_target}]")
+    tgt = node.create_index(target, Settings(settings),
+                            svc.mapper.to_mapping())
+    copied = 0
+    buckets: Dict[int, list] = {i: [] for i in range(n_target)}
+    for shard in svc.shards.values():
+        reader = shard.acquire_searcher()
+        for view in reader.views:
+            seg = view.segment
+            for ord_ in range(seg.num_docs):
+                if not view.live_mask[ord_]:
+                    continue
+                doc_id = seg.doc_ids[ord_]
+                buckets[tgt.shard_for_id(doc_id)].append(
+                    (doc_id, seg.stored_source[ord_] or {}))
+                copied += 1
+    for shard_num, docs in buckets.items():
+        if docs:
+            tgt.shard(shard_num).apply_bulk_index_on_primary(docs)
+    tgt.refresh()
+    tgt.flush()
+    return {"acknowledged": True, "shards_acknowledged": True,
+            "index": target, "copied_docs": copied}

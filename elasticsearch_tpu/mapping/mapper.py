@@ -57,6 +57,11 @@ class ParsedDocument:
     # (VERDICT r3 #4); `positions` below derives the legacy view
     term_slots: Dict[str, List[List[Optional[str]]]]
     doc_values: Dict[str, Any]
+    # nested root path → one flat {abs subfield path: [raw values]} dict
+    # PER OBJECT (reference: each nested object is its own hidden
+    # sub-document; per-object matching happens against this store)
+    nested: Dict[str, List[Dict[str, List[Any]]]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def positions(self) -> Dict[str, List[Tuple[str, int]]]:
@@ -88,11 +93,13 @@ class DocumentMapper:
     """An immutable compiled mapping: field path → FieldType."""
 
     def __init__(self, fields: Dict[str, FieldType], meta: Optional[dict] = None,
-                 dynamic: str = "true", source_enabled: bool = True):
+                 dynamic: str = "true", source_enabled: bool = True,
+                 nested_roots: Optional[set] = None):
         self.fields = dict(fields)
         self.meta = meta or {}
         self.dynamic = dynamic  # "true" | "false" | "strict"
         self.source_enabled = source_enabled
+        self.nested_roots = set(nested_roots or ())
 
     def to_mapping(self) -> dict:
         props: Dict[str, Any] = {}
@@ -105,12 +112,43 @@ class DocumentMapper:
             else:
                 node = _walk_props(props, path)
                 node.update(self.fields[path].to_mapping())
+        for root in sorted(self.nested_roots):
+            _walk_props(props, root)["type"] = "nested"
         out: Dict[str, Any] = {"properties": props}
         if self.dynamic != "true":
             out["dynamic"] = self.dynamic
         if self.meta:
             out["_meta"] = self.meta
         return out
+
+
+def _append_dv(parsed: ParsedDocument, path: str, dv: Any) -> None:
+    existing = parsed.doc_values.get(path)
+    if existing is None:
+        parsed.doc_values[path] = dv
+    elif isinstance(existing, list):
+        existing.append(dv)
+    else:
+        parsed.doc_values[path] = [existing, dv]
+
+
+def _flatten_nested_object(obj: Dict[str, Any], prefix: str,
+                           out: Dict[str, List[Any]]) -> None:
+    """One nested object → {absolute subfield path: [raw values]}
+    (inner plain objects flatten with dot-paths, like ObjectMapper)."""
+    for name, value in obj.items():
+        path = f"{prefix}{name}"
+        if isinstance(value, dict):
+            _flatten_nested_object(value, path + ".", out)
+            continue
+        values = value if isinstance(value, list) else [value]
+        flat = [v for v in values if v is not None
+                and not isinstance(v, dict)]
+        for v in values:
+            if isinstance(v, dict):
+                _flatten_nested_object(v, path + ".", out)
+        if flat:
+            out.setdefault(path, []).extend(flat)
 
 
 def _walk_props(props: Dict[str, Any], path: str) -> Dict[str, Any]:
@@ -126,14 +164,28 @@ def _walk_props(props: Dict[str, Any], path: str) -> Dict[str, Any]:
     return node
 
 
-def parse_properties(properties: dict, analyzers, prefix: str = "") -> Dict[str, FieldType]:
+def parse_properties(properties: dict, analyzers, prefix: str = "",
+                     nested_roots: Optional[set] = None
+                     ) -> Dict[str, FieldType]:
+    """nested_roots (out-param): collects paths mapped `"type": "nested"`
+    (reference: NestedObjectMapper) — their subfields get field types for
+    query-side normalization but index through the nested store, not the
+    parent's postings."""
     fields: Dict[str, FieldType] = {}
     for name, spec in properties.items():
         if not isinstance(spec, dict):
             raise MapperParsingException(f"mapping for [{prefix}{name}] must be an object")
         path = f"{prefix}{name}"
+        if spec.get("type") == "nested":
+            if nested_roots is not None:
+                nested_roots.add(path)
+            fields.update(parse_properties(spec.get("properties") or {},
+                                           analyzers, path + ".",
+                                           nested_roots))
+            continue
         if "properties" in spec and "type" not in spec:
-            fields.update(parse_properties(spec["properties"], analyzers, path + "."))
+            fields.update(parse_properties(spec["properties"], analyzers,
+                                           path + ".", nested_roots))
             continue
         fields[path] = field_type_for(path, spec, analyzers)
         for sub, subspec in (spec.get("fields") or {}).items():
@@ -155,17 +207,23 @@ class MapperService:
         fields = {}
         dynamic = "true"
         meta = {}
+        nested_roots: set = set()
         if mapping:
-            fields = parse_properties(mapping.get("properties", {}), self.analyzers)
+            fields = parse_properties(mapping.get("properties", {}),
+                                      self.analyzers,
+                                      nested_roots=nested_roots)
             dynamic = str(mapping.get("dynamic", "true")).lower()
             meta = mapping.get("_meta", {})
-        self.mapper = DocumentMapper(fields, meta, dynamic)
+        self.mapper = DocumentMapper(fields, meta, dynamic,
+                                     nested_roots=nested_roots)
 
     def merge(self, mapping_update: dict) -> None:
         """Merge a mapping fragment (properties tree) into the live mapping."""
         with self._lock:
+            nested_roots = set(self.mapper.nested_roots)
             new_fields = parse_properties(mapping_update.get("properties", {}),
-                                          self.analyzers)
+                                          self.analyzers,
+                                          nested_roots=nested_roots)
             merged = dict(self.mapper.fields)
             for path, ft in new_fields.items():
                 existing = merged.get(path)
@@ -176,15 +234,27 @@ class MapperService:
                     )
                 merged[path] = ft
             dynamic = str(mapping_update.get("dynamic", self.mapper.dynamic)).lower()
-            self.mapper = DocumentMapper(merged, self.mapper.meta, dynamic)
+            self.mapper = DocumentMapper(merged, self.mapper.meta, dynamic,
+                                         nested_roots=nested_roots)
 
     def field_type(self, path: str) -> Optional[FieldType]:
         return self.mapper.fields.get(path)
 
     def dv_kinds(self) -> Dict[str, str]:
-        """field → doc-value column kind, for SegmentWriter.add_document."""
-        return {f: t.dv_kind for f, t in self.mapper.fields.items()
-                if getattr(t, "dv_kind", "none") != "none"}
+        """field → doc-value column kind, for SegmentWriter.add_document.
+        ip/range fields contribute their synthetic bound columns."""
+        from elasticsearch_tpu.mapping.types import (IpFieldType,
+                                                     RangeFieldType)
+        out = {f: t.dv_kind for f, t in self.mapper.fields.items()
+               if getattr(t, "dv_kind", "none") != "none"}
+        for f, t in self.mapper.fields.items():
+            if isinstance(t, IpFieldType):
+                out[f + IpFieldType.HI_SUFFIX] = "i64"
+                out[f + IpFieldType.LO_SUFFIX] = "i64"
+            elif isinstance(t, RangeFieldType):
+                out[f + RangeFieldType.GTE_SUFFIX] = t.bound_kind
+                out[f + RangeFieldType.LTE_SUFFIX] = t.bound_kind
+        return out
 
     def to_mapping(self) -> dict:
         return self.mapper.to_mapping()
@@ -212,14 +282,38 @@ class MapperService:
                     f"field [{name}] is a metadata field and cannot be added inside a document"
                 )
             path = f"{prefix}{name}"
-            if isinstance(value, dict):
-                self._parse_object(value, path + ".", parsed, update_props)
+            if path in self.mapper.nested_roots:
+                objs = value if isinstance(value, list) else [value]
+                out = parsed.nested.setdefault(path, [])
+                for obj in objs:
+                    if obj is None:
+                        continue
+                    if not isinstance(obj, dict):
+                        raise MapperParsingException(
+                            f"object mapping for [{path}] tried to parse "
+                            f"field as object, got [{obj!r}]")
+                    flat: Dict[str, List[Any]] = {}
+                    _flatten_nested_object(obj, path + ".", flat)
+                    out.append(flat)
                 continue
+            if isinstance(value, dict):
+                from elasticsearch_tpu.mapping.types import RangeFieldType
+                if not isinstance(self.mapper.fields.get(path),
+                                  RangeFieldType):
+                    # plain object: descend; range-field values ARE
+                    # {gte/lte} objects and index as intervals below
+                    self._parse_object(value, path + ".", parsed,
+                                       update_props)
+                    continue
+            from elasticsearch_tpu.mapping.types import \
+                RangeFieldType as _RFT
+            is_range_field = isinstance(self.mapper.fields.get(path), _RFT)
             values = value if isinstance(value, list) else [value]
-            # nested objects inside arrays flatten too (object, not nested, semantics)
+            # nested objects inside arrays flatten too (object, not nested,
+            # semantics) — except range-field values, which are intervals
             flat_values = []
             for v in values:
-                if isinstance(v, dict):
+                if isinstance(v, dict) and not is_range_field:
                     self._parse_object(v, path + ".", parsed, update_props)
                 else:
                     flat_values.append(v)
@@ -263,15 +357,23 @@ class MapperService:
                     parsed.postings_terms.setdefault(path, []).extend(terms)
                     if length:
                         parsed.field_lengths[path] = parsed.field_lengths.get(path, 0) + length
+            from elasticsearch_tpu.mapping.types import (IpFieldType,
+                                                         RangeFieldType)
+            if isinstance(ft, IpFieldType):
+                # 128-bit address split into two signed-offset i64
+                # synthetic columns — the vectorized range path then
+                # covers full IPv6 (IpFieldType docstring)
+                hi, lo = IpFieldType.split128(ft.parse_ip(v))
+                _append_dv(parsed, path + IpFieldType.HI_SUFFIX, hi)
+                _append_dv(parsed, path + IpFieldType.LO_SUFFIX, lo)
+                continue
+            if isinstance(ft, RangeFieldType):
+                glo, ghi = ft.parse_range(v)
+                _append_dv(parsed, path + RangeFieldType.GTE_SUFFIX, glo)
+                _append_dv(parsed, path + RangeFieldType.LTE_SUFFIX, ghi)
+                continue
             if ft.has_doc_values:
-                dv = ft.doc_value(v)
-                existing = parsed.doc_values.get(path)
-                if existing is None:
-                    parsed.doc_values[path] = dv
-                elif isinstance(existing, list):
-                    existing.append(dv)
-                else:
-                    parsed.doc_values[path] = [existing, dv]
+                _append_dv(parsed, path, ft.doc_value(v))
 
     def _dynamic_field(self, path: str, sample: Any,
                        update_props: Dict[str, Any]) -> Optional[FieldType]:
@@ -296,7 +398,9 @@ class MapperService:
         with self._lock:
             merged = dict(self.mapper.fields)
             merged.update(fields)
-            self.mapper = DocumentMapper(merged, self.mapper.meta, self.mapper.dynamic)
+            self.mapper = DocumentMapper(
+                merged, self.mapper.meta, self.mapper.dynamic,
+                nested_roots=self.mapper.nested_roots)
         return fields[path]
 
     @staticmethod

@@ -244,6 +244,154 @@ class BooleanFieldType(FieldType):
         return 1 if self._parse(value) else 0
 
 
+class IpFieldType(FieldType):
+    """`ip` — IPv4 + IPv6 (reference: IpFieldMapper, which stores the
+    16-byte canonical form). Exact terms index the canonical compressed
+    string; ranges/CIDR compare on the 128-bit address value, carried in
+    two synthetic signed-offset i64 doc-value columns (`<f>._ip_hi`,
+    `<f>._ip_lo`) so the vectorized column path handles full IPv6."""
+
+    type_name = "ip"
+    dv_kind = "none"
+    has_doc_values = False  # columns are the synthetic pair below
+
+    HI_SUFFIX = "._ip_hi"
+    LO_SUFFIX = "._ip_lo"
+
+    @staticmethod
+    def parse_ip(value: Any) -> int:
+        """→ the 128-bit integer of the address (IPv4 as v4-mapped v6,
+        the reference's canonical 16-byte ordering)."""
+        import ipaddress
+        try:
+            addr = ipaddress.ip_address(str(value))
+        except ValueError as e:
+            raise MapperParsingException(
+                f"failed to parse IP [{value!r}]") from e
+        if addr.version == 4:
+            return 0xFFFF00000000 | int(addr)
+        return int(addr)
+
+    @staticmethod
+    def split128(v128: int) -> Tuple[int, int]:
+        """128-bit value → (hi, lo) signed-offset i64s whose SIGNED
+        lexicographic order equals the unsigned 128-bit order."""
+        return ((v128 >> 64) - 2**63, (v128 & (2**64 - 1)) - 2**63)
+
+    @staticmethod
+    def cidr_bounds(value: str) -> Tuple[int, int]:
+        import ipaddress
+        net = ipaddress.ip_network(str(value), strict=False)
+        lo = int(net.network_address)
+        hi = int(net.broadcast_address)
+        if net.version == 4:
+            lo |= 0xFFFF00000000
+            hi |= 0xFFFF00000000
+        return lo, hi
+
+    @staticmethod
+    def canonical(value: Any) -> str:
+        """Canonical exact-match term: v4-mapped v6 spellings collapse to
+        the dotted-quad, like the reference's 16-byte canonical form
+        (::ffff:1.2.3.4 ≡ 1.2.3.4 for term queries too)."""
+        import ipaddress
+        addr = ipaddress.ip_address(str(value))
+        mapped = getattr(addr, "ipv4_mapped", None)
+        if mapped is not None:
+            return str(mapped)
+        return addr.compressed
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        self.parse_ip(value)  # validate
+        return [self.canonical(value)], 1
+
+    def doc_value(self, value: Any):
+        raise MapperParsingException(
+            f"ip field [{self.name}] doc-values live in synthetic columns")
+
+    def normalize_term(self, value: Any) -> str:
+        return self.canonical(value)
+
+    def normalize_range_bound(self, value: Any) -> int:
+        return self.parse_ip(value)
+
+
+class RangeFieldType(FieldType):
+    """integer_range/long_range/float_range/double_range/date_range —
+    each doc stores an interval {gt|gte, lt|lte}; queries match by
+    interval relation (reference: RangeFieldMapper, default relation
+    INTERSECTS). Bounds live in synthetic `<f>._gte` / `<f>._lte`
+    doc-value columns."""
+
+    RANGE_TYPES = {"integer_range": "i64", "long_range": "i64",
+                   "float_range": "f64", "double_range": "f64",
+                   "date_range": "i64"}
+    GTE_SUFFIX = "._gte"
+    LTE_SUFFIX = "._lte"
+    dv_kind = "none"
+    has_doc_values = False
+    is_indexed = False  # no postings: matching is columnar
+
+    def __init__(self, name: str, range_type: str,
+                 params: Optional[dict] = None):
+        if range_type not in self.RANGE_TYPES:
+            raise IllegalArgumentException(
+                f"unknown range type [{range_type}]")
+        self.type_name = range_type
+        self.bound_kind = self.RANGE_TYPES[range_type]
+        super().__init__(name, params)
+        self.is_indexed = False
+
+    def parse_bound(self, value: Any):
+        if self.type_name == "date_range":
+            return parse_date_millis(value)
+        if self.bound_kind == "i64":
+            return int(value)
+        return float(value)
+
+    def parse_range(self, value: Any) -> Tuple[Any, Any]:
+        """Source {gte/gt/lte/lt} → (gte, lte) closed bounds."""
+        if not isinstance(value, dict):
+            raise MapperParsingException(
+                f"range field [{self.name}] expects an object with "
+                f"gt/gte/lt/lte, got [{value!r}]")
+        unknown = set(value) - {"gt", "gte", "lt", "lte"}
+        if unknown:
+            raise MapperParsingException(
+                f"invalid range keys {sorted(unknown)} on [{self.name}]")
+        step = 1 if self.bound_kind == "i64" else 0.0
+        lo = hi = None
+        if "gte" in value:
+            lo = self.parse_bound(value["gte"])
+        elif "gt" in value:
+            lo = self.parse_bound(value["gt"]) + step
+        if "lte" in value:
+            hi = self.parse_bound(value["lte"])
+        elif "lt" in value:
+            hi = self.parse_bound(value["lt"]) - step
+        if lo is None:
+            lo = -(2**62) if self.bound_kind == "i64" else float("-inf")
+        if hi is None:
+            hi = 2**62 if self.bound_kind == "i64" else float("inf")
+        return lo, hi
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [], 0
+
+    def doc_value(self, value: Any):
+        raise MapperParsingException(
+            f"range field [{self.name}] doc-values live in synthetic "
+            f"columns")
+
+    def normalize_term(self, value: Any) -> str:
+        raise IllegalArgumentException(
+            f"term query value on range field [{self.name}] is matched "
+            f"columnar")
+
+    def normalize_range_bound(self, value: Any):
+        return self.parse_bound(value)
+
+
 def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
     """Build a FieldType from one field's mapping JSON."""
     t = mapping.get("type")
@@ -261,4 +409,8 @@ def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
         return DateFieldType(name, params)
     if t == "boolean":
         return BooleanFieldType(name, params)
+    if t == "ip":
+        return IpFieldType(name, params)
+    if t in RangeFieldType.RANGE_TYPES:
+        return RangeFieldType(name, t, params)
     raise MapperParsingException(f"no handler for type [{t}] declared on field [{name}]")

@@ -47,6 +47,59 @@ def register(controller: RestController, node) -> None:
                 tpu.invalidate_index(name)
         return 200, {"acknowledged": True}
 
+    def close_index(req: RestRequest):
+        from elasticsearch_tpu.search.coordinator import \
+            resolve_concrete_indices
+        if node.cluster is not None:
+            out = None
+            for name in resolve_concrete_indices(
+                    node.cluster._StateView(node.cluster.applied_state()),
+                    req.param("index")):
+                out = node.cluster.close_index_admin(name)
+            return 200, out or {"acknowledged": True}
+        closed = {}
+        for name in resolve_concrete_indices(indices, req.param("index")):
+            indices.close_index(name)
+            closed[name] = {"closed": True}
+            tpu = getattr(node, "tpu_search", None)
+            if tpu is not None:
+                tpu.invalidate_index(name)
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "indices": closed}
+
+    def open_index(req: RestRequest):
+        from elasticsearch_tpu.search.coordinator import \
+            resolve_concrete_indices
+        if node.cluster is not None:
+            out = None
+            for name in resolve_concrete_indices(
+                    node.cluster._StateView(node.cluster.applied_state()),
+                    req.param("index")):
+                out = node.cluster.open_index_admin(name)
+            return 200, out or {"acknowledged": True}
+        for name in resolve_concrete_indices(indices, req.param("index")):
+            indices.open_index(name)
+        return 200, {"acknowledged": True, "shards_acknowledged": True}
+
+    def rollover(req: RestRequest):
+        from elasticsearch_tpu import lifecycle
+        return 200, lifecycle.rollover(
+            node, req.param("index"), req.body,
+            new_index=req.params.get("new_index") or None,
+            dry_run=req.params.get("dry_run") in ("", "true", True))
+
+    def rollover_named(req: RestRequest):
+        from elasticsearch_tpu import lifecycle
+        return 200, lifecycle.rollover(
+            node, req.param("index"), req.body,
+            new_index=req.param("new_index"),
+            dry_run=req.params.get("dry_run") in ("", "true", True))
+
+    def shrink_index(req: RestRequest):
+        from elasticsearch_tpu import lifecycle
+        return 200, lifecycle.shrink(node, req.param("index"),
+                                     req.param("target"), req.body)
+
     def get_index(req: RestRequest):
         if node.cluster is not None:
             state = node.cluster.applied_state()
@@ -201,6 +254,13 @@ def register(controller: RestController, node) -> None:
 
     controller.register("PUT", "/{index}", create_index)
     controller.register("DELETE", "/{index}", delete_index)
+    controller.register("POST", "/{index}/_close", close_index)
+    controller.register("POST", "/{index}/_open", open_index)
+    controller.register("POST", "/{index}/_rollover", rollover)
+    controller.register("POST", "/{index}/_rollover/{new_index}",
+                        rollover_named)
+    controller.register("PUT", "/{index}/_shrink/{target}", shrink_index)
+    controller.register("POST", "/{index}/_shrink/{target}", shrink_index)
     controller.register("GET", "/{index}", get_index)
     controller.register("HEAD", "/{index}", head_index)
     controller.register("PUT", "/{index}/_mapping", put_mapping)

@@ -54,6 +54,7 @@ def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
     # index here is a routing error, not an auto-create trigger
     svc = (node.indices.index(index) if node.cluster is not None
            else node.get_or_autocreate_index(index))
+    svc.check_write_block()
     created_id = doc_id or _auto_id()
     body, _pid = run_ingest_pipeline(node, svc, body, params)
     if body is None:  # a drop processor fired: acknowledged, not indexed
@@ -103,6 +104,7 @@ def exec_delete_doc(node, index: str, doc_id: str, params,
                     shard_num: Optional[int] = None) -> Tuple[int, Dict]:
     index = node.indices.resolve_write_index(index)
     svc = node.indices.index(index)
+    svc.check_write_block()
     if shard_num is None:
         shard_num = svc.shard_for_id(doc_id, params.get("routing"))
     shard = svc.shard(shard_num)
@@ -128,6 +130,7 @@ def exec_update_doc(node, index: str, doc_id: str, body, params,
     doc-merge and doc_as_upsert are supported here."""
     index = node.indices.resolve_write_index(index)
     svc = node.indices.index(index)
+    svc.check_write_block()
     if shard_num is None:
         shard_num = svc.shard_for_id(doc_id, params.get("routing"))
     shard = svc.shard(shard_num)
@@ -241,6 +244,7 @@ def _resolve_target(node, entry: Dict[str, Any]):
     index = node.indices.resolve_write_index(index)
     svc = (node.indices.index(index) if node.cluster is not None
            else node.get_or_autocreate_index(index))
+    svc.check_write_block()
     shard_num = entry.get("shard")
     if shard_num is None:
         shard_num = svc.shard_for_id(entry["id"], entry.get("routing"))

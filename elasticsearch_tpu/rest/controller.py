@@ -62,6 +62,7 @@ STATUS_BY_EXC = [
     (es_errors.ParsingException, 400),
     (es_errors.CircuitBreakingException, 429),
     (es_errors.EsRejectedExecutionException, 429),
+    (es_errors.IndexBlockException, 403),
     (es_errors.ClusterBlockException, 503),
 ]
 

@@ -26,6 +26,13 @@ from elasticsearch_tpu.search.query_phase import (ShardHit, execute_fetch,
                                                   execute_query)
 
 
+def _is_closed(entry) -> bool:
+    """Closed-index check over both registry kinds: a local IndexService
+    (`closed` flag) or cluster IndexMeta (`state` field)."""
+    return (getattr(entry, "closed", False)
+            or getattr(entry, "state", "open") == "close")
+
+
 def resolve_targets(indices: IndicesService, expression: Optional[str]
                     ) -> Tuple[List[str], Dict[str, List[dict]]]:
     """Wildcard/CSV resolution over index AND alias names (reference:
@@ -33,13 +40,20 @@ def resolve_targets(indices: IndicesService, expression: Optional[str]
 
     → (index names, {index: [alias filter json, ...]}). An index reached
     directly (or through an unfiltered alias) in the same expression is
-    unfiltered; multiple filtered aliases OR together."""
+    unfiltered; multiple filtered aliases OR together. Closed indices:
+    wildcard/_all expansion skips them (expand_wildcards=open default);
+    naming one directly raises IndexClosedException (reference:
+    IndicesOptions.strictExpandOpen)."""
+    from elasticsearch_tpu.common.errors import IndexClosedException
     idx_names = sorted(indices.indices.keys())
     alias_map = getattr(indices, "aliases", {})
     alias_names = sorted(alias_map.keys())
     out: List[str] = []
     filters: Dict[str, List[dict]] = {}
     unfiltered: set = set()
+
+    def closed(name: str) -> bool:
+        return _is_closed(indices.indices.get(name))
 
     def add_index(name: str, filt: Optional[dict]) -> None:
         if name not in out:
@@ -52,18 +66,21 @@ def resolve_targets(indices: IndicesService, expression: Optional[str]
 
     def add_part(part: str) -> None:
         if part in idx_names:
+            if closed(part):
+                raise IndexClosedException(f"closed index [{part}]")
             add_index(part, None)
             return
         if part in alias_names:
             for idx, props in sorted(alias_map[part].items()):
-                if idx in indices.indices:
+                if idx in indices.indices and not closed(idx):
                     add_index(idx, props.get("filter"))
             return
         raise IndexNotFoundException(f"no such index [{part}]")
 
     if expression in (None, "", "_all", "*"):
         for n in idx_names:
-            add_index(n, None)
+            if not closed(n):
+                add_index(n, None)
         return out, filters
     for part in expression.split(","):
         part = part.strip()
@@ -71,7 +88,8 @@ def resolve_targets(indices: IndicesService, expression: Optional[str]
             continue
         if "*" in part or "?" in part:
             for m in fnmatch.filter(idx_names, part):
-                add_index(m, None)
+                if not closed(m):
+                    add_index(m, None)
             for m in fnmatch.filter(alias_names, part):
                 add_part(m)
         else:
@@ -232,8 +250,10 @@ def search(indices: IndicesService, index_expr: Optional[str],
     shard_results = []   # (index_name, shard_num, reader, QuerySearchResult)
     total = 0
     timed_out = False
+    skipped = 0
     n_shards_expected = sum(len(indices.index(n).shards) for n in names)
     query_nanos: Dict[Tuple[str, int], int] = {}
+    from elasticsearch_tpu.search.can_match import can_match
     for name in names:
         svc = indices.index(name)
         eff_query = with_alias_filters(query, alias_filters.get(name))
@@ -247,6 +267,9 @@ def search(indices: IndicesService, index_expr: Optional[str],
                     continue  # shard not part of the pinned snapshot
             else:
                 reader = shard.acquire_searcher()
+            if not can_match(reader, eff_query, svc.mapper):
+                skipped += 1  # disjoint range stats: skip the shard
+                continue
             q0 = time.perf_counter()
             res = execute_query(reader, eff_query, size=size + from_,
                                 from_=0,
@@ -331,9 +354,11 @@ def search(indices: IndicesService, index_expr: Optional[str],
         "took": int((time.perf_counter() - t0) * 1000),
         "timed_out": timed_out,
         # total reflects every targeted shard even when the deadline
-        # stopped the scan early (successful = actually visited)
+        # stopped the scan early (successful = actually visited; skipped
+        # shards count as successful, reference can_match semantics)
         "_shards": {"total": n_shards_expected,
-                    "successful": len(shard_results), "skipped": 0,
+                    "successful": len(shard_results) + skipped,
+                    "skipped": skipped,
                     "failed": 0},
         "hits": {"total": {"value": total,
                            "relation": "gte" if timed_out else "eq"},
@@ -585,6 +610,7 @@ def search_shard_group(indices: IndicesService,
     # group, so this is the common case)
     shard_results = []
     agg_parts = []   # one partial per executed shard, hits or not
+    group_skipped = 0
     group_query_nanos: Dict[Tuple[str, int], int] = {}
     group_fetch_nanos: Dict[Tuple[str, int], int] = {}
     group_profile_entries: List[Tuple] = []
@@ -616,9 +642,13 @@ def search_shard_group(indices: IndicesService,
                     doc["__shard"] = sn
                     shard_results.append(("__fast__", name, sn, rank, doc))
         if not used_fast:
+            from elasticsearch_tpu.search.can_match import can_match
             for shard_num in sorted(shard_nums):
                 shard = svc.shard(shard_num)
                 reader = shard.acquire_searcher()
+                if not can_match(reader, eff_query, svc.mapper):
+                    group_skipped += 1
+                    continue
                 q0 = time.perf_counter()
                 res = execute_query(reader, eff_query, size=k, from_=0,
                                     min_score=min_score, aggs=aggs,
@@ -675,6 +705,7 @@ def search_shard_group(indices: IndicesService,
     out: Dict[str, Any] = {
         "hits": hits, "total": total, "relation": relation,
         "timed_out": ctx.timed_out,
+        "skipped": group_skipped,
         "shards": len({(n, s) for n, s in targets}),
         "max_score": (max((d.get("_score") or float("-inf")
                            for d in hits), default=None)
@@ -717,10 +748,12 @@ def merge_group_responses(groups: List[Dict[str, Any]],
     total = 0
     relation = "eq"
     n_shards = failed_shards
+    n_skipped = 0
     timed_out = False
     for gi, g in enumerate(groups):
         total += g["total"]
         n_shards += g.get("shards", 0)
+        n_skipped += g.get("skipped", 0)
         if g.get("timed_out"):
             timed_out = True
         if g.get("relation") == "gte":
@@ -753,7 +786,8 @@ def merge_group_responses(groups: List[Dict[str, Any]],
         "took": int((time.perf_counter() - t0) * 1000),
         "timed_out": timed_out,
         "_shards": {"total": n_shards,
-                    "successful": n_shards - failed_shards, "skipped": 0,
+                    "successful": n_shards - failed_shards,
+                    "skipped": n_skipped,
                     "failed": failed_shards},
         "hits": {"total": {"value": total, "relation": relation},
                  "max_score": max_score,

@@ -89,6 +89,9 @@ class RangeQuery(QueryNode):
     gte: Any = None
     lt: Any = None
     lte: Any = None
+    # interval relation for RANGE FIELDS (reference: RangeFieldMapper);
+    # ignored on plain numeric/date fields
+    relation: Optional[str] = None
 
     def query_name(self) -> str:
         return "range"
@@ -210,6 +213,20 @@ class ConstantScoreQuery(QueryNode):
         return "constant_score"
 
 
+@dataclasses.dataclass
+class NestedQuery(QueryNode):
+    """{"nested": {"path": p, "query": {...}, "score_mode": m}} —
+    per-OBJECT matching against a nested field's objects (reference:
+    NestedQueryBuilder; SURVEY.md §2.1#29)."""
+
+    path: str = ""
+    query: QueryNode = None  # type: ignore[assignment]
+    score_mode: str = "avg"  # avg | sum | min | max | none
+
+    def query_name(self) -> str:
+        return "nested"
+
+
 def parse_query(obj: Dict[str, Any]) -> QueryNode:
     """The parseInnerQueryBuilder analog: one top-level key names the query."""
     if not isinstance(obj, dict):
@@ -278,12 +295,19 @@ def _parse_range(body) -> RangeQuery:
     field, spec = next(iter(body.items()))
     if not isinstance(spec, dict):
         raise ParsingException(f"[range] on [{field}] expects an object")
-    known = {"gt", "gte", "lt", "lte", "boost", "format", "time_zone"}
+    known = {"gt", "gte", "lt", "lte", "boost", "format", "time_zone",
+             "relation"}
     unknown = set(spec) - known
     if unknown:
         raise ParsingException(f"[range] unknown parameter {sorted(unknown)}")
+    relation = spec.get("relation")
+    if relation is not None and str(relation).lower() not in (
+            "intersects", "within", "contains"):
+        raise ParsingException(f"[range] unknown relation [{relation}]")
     return RangeQuery(field=field, gt=spec.get("gt"), gte=spec.get("gte"),
                       lt=spec.get("lt"), lte=spec.get("lte"),
+                      relation=None if relation is None
+                      else str(relation).lower(),
                       boost=float(spec.get("boost", 1.0)))
 
 
@@ -331,6 +355,19 @@ def _parse_constant_score(body) -> ConstantScoreQuery:
         raise ParsingException("[constant_score] requires [filter]")
     return ConstantScoreQuery(filter_query=parse_query(body["filter"]),
                               boost=float(body.get("boost", 1.0)))
+
+
+def _parse_nested(body) -> NestedQuery:
+    if not isinstance(body, dict) or "path" not in body \
+            or "query" not in body:
+        raise ParsingException("[nested] requires [path] and [query]")
+    mode = str(body.get("score_mode", "avg")).lower()
+    if mode not in ("avg", "sum", "min", "max", "none"):
+        raise ParsingException(f"[nested] unknown score_mode [{mode}]")
+    return NestedQuery(path=str(body["path"]),
+                       query=parse_query(body["query"]),
+                       score_mode=mode,
+                       boost=float(body.get("boost", 1.0)))
 
 
 def _parse_multi_match(body) -> MultiMatchQuery:
@@ -496,6 +533,7 @@ _PARSERS = {
     "match_all": _parse_match_all,
     "exists": _parse_exists,
     "ids": _parse_ids,
+    "nested": _parse_nested,
     "constant_score": _parse_constant_score,
     "multi_match": _parse_multi_match,
     "prefix": _parse_prefix,

@@ -22,7 +22,7 @@ is host-side over the candidate docs (postings positions live on host).
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -32,8 +32,10 @@ from elasticsearch_tpu.index.reader import SegmentView, ShardReader
 from elasticsearch_tpu.index.segment import MISSING_I64
 from elasticsearch_tpu.mapping.types import (
     FieldType,
+    IpFieldType,
     KeywordFieldType,
     NumberFieldType,
+    RangeFieldType,
     TextFieldType,
 )
 from elasticsearch_tpu.ops import bm25
@@ -104,6 +106,20 @@ class SegmentQueryExecutor:
         if isinstance(node, dsl.MatchQuery):
             return self._eval_match(node, scoring)
         if isinstance(node, dsl.TermQuery):
+            try:
+                ft = self._field_type(node.field)
+            except _UnmappedField:
+                ft = None
+            if isinstance(ft, IpFieldType) and "/" in str(node.value):
+                # CIDR term → address range (reference: IpFieldMapper
+                # term queries accept networks)
+                lo, hi = IpFieldType.cidr_bounds(node.value)
+                return self._eval_ip_range(node.field, lo, hi, node.boost)
+            if isinstance(ft, RangeFieldType):
+                v = ft.parse_bound(node.value)
+                return self._eval_range_field(
+                    dsl.RangeQuery(field=node.field, gte=v, lte=v,
+                                   boost=node.boost), ft)
             return self._eval_terms(node.field, [node.value], node.boost,
                                     scoring, "or", 1)
         if isinstance(node, dsl.TermsQuery):
@@ -140,6 +156,8 @@ class SegmentQueryExecutor:
                 scoring, constant=False)
         if isinstance(node, dsl.FunctionScoreQuery):
             return self._eval_function_score(node, scoring)
+        if isinstance(node, dsl.NestedQuery):
+            return self._eval_nested(node, scoring)
         if hasattr(node, "evaluate"):
             # plugin-registered query types evaluate themselves against
             # the executor (SearchPlugin#getQueries seam)
@@ -469,11 +487,108 @@ class SegmentQueryExecutor:
         score = jnp.where(mask, total_score, 0.0)
         return mask, score
 
+    def _eval_nested(self, node: dsl.NestedQuery, scoring: bool):
+        """Per-OBJECT matching over the segment's nested store
+        (reference: NestedQueryBuilder joins hidden sub-documents via
+        BitSetProducer; here each object is evaluated directly). Child
+        scores are constant (1·boost per matching object, filter-like);
+        score_mode combines them: sum → count, avg/min/max → 1, none → 0."""
+        store = self.view.segment.nested_store.get(node.path)
+        if not store:
+            return self._none()
+        mapper = self.reader.mapper
+        if hasattr(mapper, "mapper"):  # MapperService → DocumentMapper
+            mapper = mapper.mapper
+        mask = np.zeros(self.d_pad, dtype=bool)
+        score = np.zeros(self.d_pad, dtype=np.float32)
+        for ord_, objs in store.items():
+            n_matched = 0
+            for obj in objs:
+                if _nested_object_matches(node.query, obj, mapper,
+                                          node.path):
+                    n_matched += 1
+            if n_matched:
+                mask[ord_] = True
+                if scoring and node.score_mode != "none":
+                    child = float(node.boost)
+                    score[ord_] = (child * n_matched
+                                   if node.score_mode == "sum" else child)
+        return jnp.asarray(mask), jnp.asarray(score)
+
+    def _eval_ip_range(self, field: str, lo128: int, hi128: int,
+                       boost: float):
+        """[lo128, hi128] inclusive over the ip field's split (hi, lo)
+        signed-offset i64 columns — a 128-bit compare as two 64-bit
+        lexicographic compares (IpFieldType docstring)."""
+        pack = self.view.pack
+        h = pack.dv_i64.get(field + IpFieldType.HI_SUFFIX)
+        l = pack.dv_i64.get(field + IpFieldType.LO_SUFFIX)
+        if h is None or l is None or lo128 > hi128:
+            return self._none()
+        lo_h, lo_l = IpFieldType.split128(lo128)
+        hi_h, hi_l = IpFieldType.split128(hi128)
+        # presence via the exists mask, NOT the i64 sentinel: an
+        # IPv4-mapped address has hi == 0, which collides with MISSING_I64
+        # after the signed offset
+        present = self.reader.has_field_mask(self.view_idx, field)
+        ge = (h > lo_h) | ((h == lo_h) & (l >= lo_l))
+        le = (h < hi_h) | ((h == hi_h) & (l <= hi_l))
+        mask = jnp.asarray(present & ge & le)
+        score = jnp.where(mask, jnp.float32(boost), 0.0).astype(jnp.float32)
+        return mask, score
+
+    def _eval_range_field(self, node: dsl.RangeQuery, ft: RangeFieldType):
+        """Interval-vs-interval matching on a range FIELD (reference:
+        RangeFieldMapper; relation intersects|within|contains, default
+        intersects)."""
+        pack = self.view.pack
+        cols = pack.dv_i64 if ft.bound_kind == "i64" else pack.dv_f64
+        g = cols.get(node.field + RangeFieldType.GTE_SUFFIX)
+        l = cols.get(node.field + RangeFieldType.LTE_SUFFIX)
+        if g is None or l is None:
+            return self._none()
+        q_lo, q_hi = ft.parse_range({k: v for k, v in
+                                     (("gt", node.gt), ("gte", node.gte),
+                                      ("lt", node.lt), ("lte", node.lte))
+                                     if v is not None})
+        if ft.bound_kind == "i64":
+            present = g != MISSING_I64
+        else:
+            present = ~np.isnan(g)
+        relation = (node.relation or "intersects").lower()
+        if relation == "within":
+            hit = (g >= q_lo) & (l <= q_hi)
+        elif relation == "contains":
+            hit = (g <= q_lo) & (l >= q_hi)
+        elif relation == "intersects":
+            hit = (g <= q_hi) & (l >= q_lo)
+        else:
+            raise QueryShardException(
+                f"[range] unknown relation [{relation}]")
+        mask = jnp.asarray(present & hit)
+        score = jnp.where(mask, jnp.float32(node.boost),
+                          0.0).astype(jnp.float32)
+        return mask, score
+
     def _eval_range(self, node: dsl.RangeQuery):
         try:
             ft = self._field_type(node.field)
         except _UnmappedField:
             return self._none()
+        if isinstance(ft, IpFieldType):
+            lo = 0
+            hi = (1 << 128) - 1
+            if node.gte is not None:
+                lo = ft.parse_ip(node.gte)
+            elif node.gt is not None:
+                lo = ft.parse_ip(node.gt) + 1
+            if node.lte is not None:
+                hi = ft.parse_ip(node.lte)
+            elif node.lt is not None:
+                hi = ft.parse_ip(node.lt) - 1
+            return self._eval_ip_range(node.field, lo, hi, node.boost)
+        if isinstance(ft, RangeFieldType):
+            return self._eval_range_field(node, ft)
         if isinstance(ft, (TextFieldType, KeywordFieldType)):
             raise QueryShardException(
                 f"range query on [{ft.type_name}] field [{node.field}] is not supported")
@@ -585,3 +700,98 @@ def _phrase_freq(plists: List[np.ndarray], slop: int) -> int:
         if ok:
             count += 1
     return count
+
+
+def _nested_object_matches(q: dsl.QueryNode, obj: Dict[str, list],
+                           doc_mapper, path: str) -> bool:
+    """Evaluate an inner nested query against ONE object's flat
+    {absolute subfield path: [raw values]} map — the per-sub-document
+    match the reference gets from indexing each nested object as its own
+    Lucene doc. Field types normalize both sides."""
+    if isinstance(q, dsl.MatchAllQuery):
+        return True
+    if isinstance(q, dsl.BoolQuery):
+        for c in list(q.must) + list(q.filter):
+            if not _nested_object_matches(c, obj, doc_mapper, path):
+                return False
+        for c in q.must_not:
+            if _nested_object_matches(c, obj, doc_mapper, path):
+                return False
+        if q.should:
+            msm = q.minimum_should_match
+            if msm is None:
+                msm = 0 if (q.must or q.filter) else 1
+            if msm > 0:
+                n = sum(1 for c in q.should
+                        if _nested_object_matches(c, obj, doc_mapper, path))
+                if n < msm:
+                    return False
+        return True
+    if isinstance(q, dsl.ConstantScoreQuery):
+        return _nested_object_matches(q.filter_query, obj, doc_mapper, path)
+    if isinstance(q, dsl.NestedQuery):
+        raise QueryShardException(
+            "[nested] within [nested] is not supported yet")
+    if isinstance(q, dsl.ExistsQuery):
+        return bool(obj.get(q.field))
+    if isinstance(q, (dsl.TermQuery, dsl.TermsQuery)):
+        ft = doc_mapper.fields.get(q.field)
+        vals = obj.get(q.field)
+        if ft is None or not vals:
+            return False
+        wants = ([q.value] if isinstance(q, dsl.TermQuery)
+                 else list(q.values))
+        try:
+            want_norm = {ft.normalize_term(w) for w in wants}
+            return any(ft.normalize_term(v) in want_norm for v in vals)
+        except Exception:
+            return False
+    if isinstance(q, dsl.MatchQuery):
+        ft = doc_mapper.fields.get(q.field)
+        vals = obj.get(q.field)
+        if ft is None or not vals:
+            return False
+        if isinstance(ft, TextFieldType):
+            q_terms = ft.search_terms(q.query)
+            if not q_terms:
+                return False
+            doc_terms = set()
+            for v in vals:
+                doc_terms.update(ft.analyzer.terms(str(v)))
+            hits = sum(1 for t in q_terms if t in doc_terms)
+            if q.operator == "and":
+                return hits == len(q_terms)
+            need = q.minimum_should_match or 1
+            return hits >= need
+        try:
+            want = ft.normalize_term(q.query)
+            return any(ft.normalize_term(v) == want for v in vals)
+        except Exception:
+            return False
+    if isinstance(q, dsl.RangeQuery):
+        ft = doc_mapper.fields.get(q.field)
+        vals = obj.get(q.field)
+        if ft is None or not vals:
+            return False
+        try:
+            for v in vals:
+                dv = ft.doc_value(v) if ft.has_doc_values \
+                    else ft.normalize_range_bound(v)
+                if q.gt is not None and \
+                        not dv > ft.normalize_range_bound(q.gt):
+                    continue
+                if q.gte is not None and \
+                        not dv >= ft.normalize_range_bound(q.gte):
+                    continue
+                if q.lt is not None and \
+                        not dv < ft.normalize_range_bound(q.lt):
+                    continue
+                if q.lte is not None and \
+                        not dv <= ft.normalize_range_bound(q.lte):
+                    continue
+                return True
+        except Exception:
+            return False
+        return False
+    raise QueryShardException(
+        f"[nested] unsupported inner query [{q.query_name()}]")

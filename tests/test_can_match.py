@@ -1,0 +1,105 @@
+"""can_match prefilter: range-disjoint shards are skipped before the
+query phase and reported in _shards.skipped.
+
+Reference: CanMatchPreFilterSearchPhase + MinAndMax shard skipping
+(SURVEY.md §2.1#35)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+def _h(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture()
+def seeded(node):
+    """4 shards; doc ranks cluster per shard via routing so some shards
+    have rank ranges disjoint with the query."""
+    s, b = _h(node, "PUT", "/m", body={
+        "settings": {"number_of_shards": 4},
+        "mappings": {"properties": {"rank": {"type": "integer"},
+                                    "body": {"type": "text"}}}})
+    assert s == 200, b
+    svc = node.indices.index("m")
+    # place docs by explicit routing: shard i gets ranks [100i, 100i+9]
+    placed = {i: 0 for i in range(4)}
+    doc = 0
+    while min(placed.values()) < 10:
+        target = svc.shard_for_id(str(doc))
+        if placed[target] < 10:
+            rank = 100 * target + placed[target]
+            s, b = _h(node, "PUT", f"/m/_doc/{doc}",
+                      body={"rank": rank, "body": f"doc {doc}"})
+            assert s in (200, 201), b
+            placed[target] += 1
+        doc += 1
+    _h(node, "POST", "/m/_refresh")
+    return node
+
+
+def test_disjoint_range_skips_shards(seeded):
+    node = seeded
+    s, b = _h(node, "POST", "/m/_search", body={
+        "query": {"range": {"rank": {"gte": 300}}}, "size": 20})
+    assert s == 200, b
+    sh = b["_shards"]
+    assert sh["total"] == 4 and sh["skipped"] == 3, sh
+    assert sh["successful"] == 4
+    assert b["hits"]["total"]["value"] == 10
+    assert all(h["_source"]["rank"] >= 300 for h in b["hits"]["hits"])
+
+
+def test_fully_disjoint_skips_everything(seeded):
+    s, b = _h(seeded, "POST", "/m/_search", body={
+        "query": {"range": {"rank": {"gt": 10_000}}}})
+    assert s == 200, b
+    assert b["_shards"]["skipped"] == 4, b["_shards"]
+    assert b["hits"]["total"]["value"] == 0
+
+
+def test_bool_filter_range_skips(seeded):
+    s, b = _h(seeded, "POST", "/m/_search", body={
+        "query": {"bool": {"must": [{"match": {"body": "doc"}}],
+                           "filter": [{"range": {"rank": {"lt": 100}}}]}},
+        "size": 20})
+    assert s == 200, b
+    assert b["_shards"]["skipped"] == 3, b["_shards"]
+    assert b["hits"]["total"]["value"] == 10
+
+
+def test_missing_field_shard_skips_term(seeded):
+    node = seeded
+    s, b = _h(node, "POST", "/m/_search", body={
+        "query": {"term": {"rank": 105}}, "size": 5})
+    assert s == 200, b
+    assert b["_shards"]["skipped"] == 3, b["_shards"]
+    assert b["hits"]["total"]["value"] == 1
+
+
+def test_results_equal_with_and_without_skipping(seeded):
+    node = seeded
+    body = {"query": {"range": {"rank": {"gte": 95, "lte": 205}}},
+            "size": 30, "sort": [{"rank": "asc"}]}
+    s, b = _h(node, "POST", "/m/_search", body=body)
+    assert s == 200, b
+    ranks = [h["_source"]["rank"] for h in b["hits"]["hits"]]
+    # shard ranges: 0-9 / 100-109 / 200-209 / 300-309 → [95, 205] matches
+    # all of shard 1 (10) + 200..205 of shard 2 (6)
+    assert ranks == sorted(ranks) and len(ranks) == 16
+    assert b["_shards"]["skipped"] == 2, b["_shards"]

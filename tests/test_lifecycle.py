@@ -1,0 +1,193 @@
+"""Index lifecycle admin: _close/_open, _rollover, _shrink.
+
+Reference analogs (SURVEY.md §2.1#49): MetadataIndexStateService
+(open/close semantics incl. the closed-index error contract),
+TransportRolloverAction (condition evaluation + write-alias swap),
+TransportResizeAction (shrink preconditions + doc preservation)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+def _h(node, method, path, params=None, body=None):
+    if isinstance(body, str):
+        return node.handle(method, path, params, None, body.encode())
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+def _seed(node, index="logs-000001", n=8, shards=2):
+    s, b = _h(node, "PUT", f"/{index}", body={
+        "settings": {"number_of_shards": shards},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    assert s == 200, b
+    for i in range(n):
+        _h(node, "PUT", f"/{index}/_doc/{i}",
+           body={"body": f"event number {i}"})
+    _h(node, "POST", f"/{index}/_refresh")
+
+
+class TestCloseOpen:
+    def test_close_rejects_reads_and_writes(self, node):
+        _seed(node)
+        s, b = _h(node, "POST", "/logs-000001/_close")
+        assert s == 200 and b["acknowledged"], b
+        # direct search → 400 index_closed_exception
+        s, b = _h(node, "POST", "/logs-000001/_search",
+                  body={"query": {"match_all": {}}})
+        assert s == 400 and "index_closed" in json.dumps(b), b
+        # writes → 400 as well
+        s, b = _h(node, "PUT", "/logs-000001/_doc/99", body={"body": "x"})
+        assert s == 400, b
+        # GET doc → 400
+        s, b = _h(node, "GET", "/logs-000001/_doc/0")
+        assert s == 400, b
+
+    def test_wildcard_search_skips_closed(self, node):
+        _seed(node, "logs-000001")
+        _seed(node, "logs-000002")
+        _h(node, "POST", "/logs-000001/_close")
+        s, b = _h(node, "POST", "/logs-*/_search",
+                  body={"query": {"match_all": {}}, "size": 0})
+        assert s == 200, b
+        assert b["hits"]["total"]["value"] == 8  # only the open index
+
+    def test_open_restores_data(self, node):
+        _seed(node)
+        _h(node, "POST", "/logs-000001/_close")
+        s, b = _h(node, "POST", "/logs-000001/_open")
+        assert s == 200 and b["acknowledged"], b
+        s, b = _h(node, "POST", "/logs-000001/_search",
+                  body={"query": {"match": {"body": "event"}}, "size": 20})
+        assert s == 200 and b["hits"]["total"]["value"] == 8, b
+
+    def test_closed_index_survives_restart_closed(self, node, tmp_path):
+        _seed(node)
+        _h(node, "POST", "/logs-000001/_close")
+        node.close()
+        node2 = Node(str(tmp_path / "data"), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            s, b = _h(node2, "POST", "/logs-000001/_search",
+                      body={"query": {"match_all": {}}})
+            assert s == 400, b
+            s, b = _h(node2, "POST", "/logs-000001/_open")
+            assert s == 200, b
+            s, b = _h(node2, "POST", "/logs-000001/_search",
+                      body={"query": {"match_all": {}}, "size": 20})
+            assert s == 200 and b["hits"]["total"]["value"] == 8, b
+        finally:
+            node2.close()
+
+
+class TestRollover:
+    def test_rollover_unconditional(self, node):
+        _seed(node)
+        _h(node, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "logs-000001", "alias": "logs",
+                     "is_write_index": True}}]})
+        s, b = _h(node, "POST", "/logs/_rollover", body={})
+        assert s == 200, b
+        assert b["rolled_over"] and b["new_index"] == "logs-000002", b
+        # writes through the alias land on the new index
+        s, b = _h(node, "PUT", "/logs/_doc/new1", body={"body": "fresh"})
+        assert s in (200, 201), b
+        s, b = _h(node, "GET", "/logs-000002/_doc/new1")
+        assert s == 200, b
+        # the old index stays under the alias, write flag off
+        s, b = _h(node, "POST", "/logs/_search",
+                  body={"query": {"match_all": {}}, "size": 0})
+        assert s == 200 and b["hits"]["total"]["value"] >= 8, b
+
+    def test_rollover_conditions_not_met(self, node):
+        _seed(node, n=3)
+        _h(node, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "logs-000001", "alias": "logs",
+                     "is_write_index": True}}]})
+        s, b = _h(node, "POST", "/logs/_rollover",
+                  body={"conditions": {"max_docs": 100}})
+        assert s == 200 and not b["rolled_over"], b
+        assert b["conditions"] == {"[max_docs: 100]": False}, b
+
+    def test_rollover_max_docs_met_and_dry_run(self, node):
+        _seed(node, n=8)
+        _h(node, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "logs-000001", "alias": "logs",
+                     "is_write_index": True}}]})
+        s, b = _h(node, "POST", "/logs/_rollover", {"dry_run": "true"},
+                  body={"conditions": {"max_docs": 5}})
+        assert s == 200 and b["dry_run"] and not b["rolled_over"], b
+        assert b["conditions"]["[max_docs: 5]"] is True
+        s, b = _h(node, "POST", "/logs/_rollover",
+                  body={"conditions": {"max_docs": 5}})
+        assert s == 200 and b["rolled_over"], b
+
+    def test_rollover_requires_alias_and_pattern(self, node):
+        _seed(node, "plain")
+        s, b = _h(node, "POST", "/plain/_rollover", body={})
+        assert s == 400, b
+        _h(node, "POST", "/_aliases", body={"actions": [
+            {"add": {"index": "plain", "alias": "p",
+                     "is_write_index": True}}]})
+        s, b = _h(node, "POST", "/p/_rollover", body={})
+        assert s == 400 and "pattern" in json.dumps(b), b
+
+
+class TestShrink:
+    def test_shrink_requires_write_block_and_divisibility(self, node):
+        _seed(node, "big", n=20, shards=4)
+        s, b = _h(node, "PUT", "/big/_shrink/small", body={})
+        assert s == 400 and "read-only" in json.dumps(b), b
+        s, b = _h(node, "PUT", "/big/_settings",
+                  body={"index": {"blocks": {"write": True}}})
+        assert s == 200, b
+        s, b = _h(node, "PUT", "/big/_shrink/bad", body={
+            "settings": {"index": {"number_of_shards": 3}}})
+        assert s == 400 and "multiple" in json.dumps(b), b
+
+    def test_shrink_preserves_docs(self, node):
+        _seed(node, "big", n=20, shards=4)
+        _h(node, "PUT", "/big/_settings",
+           body={"index": {"blocks": {"write": True}}})
+        s, b = _h(node, "PUT", "/big/_shrink/small", body={
+            "settings": {"index": {"number_of_shards": 2}}})
+        assert s == 200, b
+        assert b["copied_docs"] == 20
+        _h(node, "POST", "/small/_refresh")
+        s, b = _h(node, "POST", "/small/_search",
+                  body={"query": {"match": {"body": "event"}}, "size": 30})
+        assert s == 200 and b["hits"]["total"]["value"] == 20, b
+        # every doc resolvable by GET through target routing
+        for i in range(20):
+            s, b = _h(node, "GET", f"/small/_doc/{i}")
+            assert s == 200, (i, b)
+        # the target does not inherit the write block
+        s, b = _h(node, "PUT", "/small/_doc/extra", body={"body": "more"})
+        assert s in (200, 201), b
+
+    def test_write_block_rejects_writes(self, node):
+        _seed(node, "big", n=4, shards=2)
+        _h(node, "PUT", "/big/_settings",
+           body={"index": {"blocks": {"write": True}}})
+        s, b = _h(node, "PUT", "/big/_doc/xx", body={"body": "nope"})
+        assert s == 403, b
+        # clearing the block re-enables writes
+        s, b = _h(node, "PUT", "/big/_settings",
+                  body={"index": {"blocks": {"write": None}}})
+        assert s == 200, b
+        s, b = _h(node, "PUT", "/big/_doc/xx", body={"body": "yes"})
+        assert s in (200, 201), b

@@ -1,0 +1,214 @@
+"""Nested field type + nested query, ip fields, range fields.
+
+Reference analogs (SURVEY.md §2.1#27/#29): NestedObjectMapper /
+NestedQueryBuilder (per-OBJECT matching — the flattened-arrays
+cross-match bug is the whole point), IpFieldMapper (v4/v6 + CIDR),
+RangeFieldMapper (interval relations)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+def _h(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+def _ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+class TestNested:
+    @pytest.fixture()
+    def seeded(self, node):
+        s, b = _h(node, "PUT", "/users", body={
+            "mappings": {"properties": {
+                "name": {"type": "keyword"},
+                "addresses": {"type": "nested", "properties": {
+                    "city": {"type": "keyword"},
+                    "zip": {"type": "integer"},
+                    "note": {"type": "text"}}}}}})
+        assert s == 200, b
+        docs = {
+            "1": {"name": "alice", "addresses": [
+                {"city": "paris", "zip": 75001, "note": "main home"},
+                {"city": "lyon", "zip": 69001}]},
+            "2": {"name": "bob", "addresses": [
+                {"city": "paris", "zip": 69001},   # cross combination!
+                {"city": "lyon", "zip": 75001}]},
+            "3": {"name": "carol", "addresses": {"city": "nice",
+                                                 "zip": 6000}},
+        }
+        for i, src in docs.items():
+            s, b = _h(node, "PUT", f"/users/_doc/{i}", body=src)
+            assert s in (200, 201), b
+        _h(node, "POST", "/users/_refresh")
+        return node
+
+    def test_per_object_matching_not_cross_product(self, seeded):
+        """THE nested semantics: city=paris AND zip=75001 must match only
+        docs where ONE object has both — doc 2 has paris and 75001 in
+        different objects and must NOT match."""
+        s, b = _h(seeded, "POST", "/users/_search", body={
+            "query": {"nested": {"path": "addresses", "query": {
+                "bool": {"must": [
+                    {"term": {"addresses.city": "paris"}},
+                    {"term": {"addresses.zip": 75001}}]}}}}})
+        assert s == 200, b
+        assert _ids(b) == ["1"], b["hits"]
+
+    def test_single_clause_matches_any_object(self, seeded):
+        s, b = _h(seeded, "POST", "/users/_search", body={
+            "query": {"nested": {"path": "addresses", "query": {
+                "term": {"addresses.city": "lyon"}}}}})
+        assert s == 200 and _ids(b) == ["1", "2"], b["hits"]
+
+    def test_nested_range_and_match(self, seeded):
+        s, b = _h(seeded, "POST", "/users/_search", body={
+            "query": {"nested": {"path": "addresses", "query": {
+                "range": {"addresses.zip": {"lt": 10000}}}}}})
+        assert s == 200 and _ids(b) == ["3"], b["hits"]
+        s, b = _h(seeded, "POST", "/users/_search", body={
+            "query": {"nested": {"path": "addresses", "query": {
+                "match": {"addresses.note": "home"}}}}})
+        assert s == 200 and _ids(b) == ["1"], b["hits"]
+
+    def test_direct_query_on_nested_subfield_matches_nothing(self, seeded):
+        """Reference behavior: nested subfields are hidden sub-docs —
+        a non-nested query on them finds nothing."""
+        s, b = _h(seeded, "POST", "/users/_search", body={
+            "query": {"term": {"addresses.city": "paris"}}})
+        assert s == 200 and b["hits"]["total"]["value"] == 0, b["hits"]
+
+    def test_nested_survives_restart(self, seeded, tmp_path):
+        _h(seeded, "POST", "/users/_flush")
+        seeded.close()
+        node2 = Node(str(tmp_path / "data"), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            s, b = _h(node2, "POST", "/users/_search", body={
+                "query": {"nested": {"path": "addresses", "query": {
+                    "bool": {"must": [
+                        {"term": {"addresses.city": "paris"}},
+                        {"term": {"addresses.zip": 75001}}]}}}}})
+            assert s == 200 and _ids(b) == ["1"], b
+            # mapping round-trips with type: nested
+            s, b = _h(node2, "GET", "/users/_mapping")
+            assert b["users"]["mappings"]["properties"]["addresses"][
+                "type"] == "nested", b
+        finally:
+            node2.close()
+
+    def test_nested_in_bool_and_score_modes(self, seeded):
+        s, b = _h(seeded, "POST", "/users/_search", body={
+            "query": {"bool": {
+                "must": [{"term": {"name": "alice"}}],
+                "filter": [{"nested": {
+                    "path": "addresses", "score_mode": "sum",
+                    "query": {"term": {"addresses.city": "paris"}}}}]}}})
+        assert s == 200 and _ids(b) == ["1"], b["hits"]
+
+
+class TestIpField:
+    @pytest.fixture()
+    def seeded(self, node):
+        s, b = _h(node, "PUT", "/hosts", body={
+            "mappings": {"properties": {"addr": {"type": "ip"}}}})
+        assert s == 200, b
+        for i, ip in enumerate(["10.0.0.1", "10.0.5.200", "192.168.1.9",
+                                "2001:db8::1", "2001:db8::ffff"]):
+            s, b = _h(node, "PUT", f"/hosts/_doc/{i}", body={"addr": ip})
+            assert s in (200, 201), b
+        _h(node, "POST", "/hosts/_refresh")
+        return node
+
+    def test_exact_term(self, seeded):
+        s, b = _h(seeded, "POST", "/hosts/_search", body={
+            "query": {"term": {"addr": "10.0.5.200"}}})
+        assert s == 200 and _ids(b) == ["1"], b["hits"]
+        # v6 compressed-form normalization both sides
+        s, b = _h(seeded, "POST", "/hosts/_search", body={
+            "query": {"term": {"addr": "2001:0db8:0000:0000:0000:0000:0000:0001"}}})
+        assert s == 200 and _ids(b) == ["3"], b["hits"]
+
+    def test_cidr_term(self, seeded):
+        s, b = _h(seeded, "POST", "/hosts/_search", body={
+            "query": {"term": {"addr": "10.0.0.0/16"}}})
+        assert s == 200 and _ids(b) == ["0", "1"], b["hits"]
+        s, b = _h(seeded, "POST", "/hosts/_search", body={
+            "query": {"term": {"addr": "2001:db8::/64"}}})
+        assert s == 200 and _ids(b) == ["3", "4"], b["hits"]
+
+    def test_ip_range_query(self, seeded):
+        s, b = _h(seeded, "POST", "/hosts/_search", body={
+            "query": {"range": {"addr": {"gte": "10.0.0.0",
+                                         "lt": "192.168.0.0"}}}})
+        assert s == 200 and _ids(b) == ["0", "1"], b["hits"]
+        s, b = _h(seeded, "POST", "/hosts/_search", body={
+            "query": {"range": {"addr": {"gt": "2001:db8::1"}}}})
+        assert s == 200 and _ids(b) == ["4"], b["hits"]
+
+    def test_bad_ip_rejected(self, seeded):
+        s, b = _h(seeded, "PUT", "/hosts/_doc/x",
+                  body={"addr": "not-an-ip"})
+        assert s == 400, b
+
+
+class TestRangeField:
+    @pytest.fixture()
+    def seeded(self, node):
+        s, b = _h(node, "PUT", "/cal", body={
+            "mappings": {"properties": {
+                "slots": {"type": "integer_range"},
+                "temp": {"type": "double_range"}}}})
+        assert s == 200, b
+        docs = {
+            "1": {"slots": {"gte": 10, "lte": 20},
+                  "temp": {"gte": 1.5, "lt": 2.5}},
+            "2": {"slots": {"gt": 20, "lte": 30}},
+            "3": {"slots": {"gte": 100, "lte": 200}},
+        }
+        for i, src in docs.items():
+            s, b = _h(node, "PUT", f"/cal/_doc/{i}", body=src)
+            assert s in (200, 201), b
+        _h(node, "POST", "/cal/_refresh")
+        return node
+
+    def test_intersects_default(self, seeded):
+        s, b = _h(seeded, "POST", "/cal/_search", body={
+            "query": {"range": {"slots": {"gte": 15, "lte": 25}}}})
+        assert s == 200 and _ids(b) == ["1", "2"], b["hits"]
+
+    def test_within_and_contains(self, seeded):
+        s, b = _h(seeded, "POST", "/cal/_search", body={
+            "query": {"range": {"slots": {"gte": 0, "lte": 50,
+                                          "relation": "within"}}}})
+        assert s == 200 and _ids(b) == ["1", "2"], b["hits"]
+        s, b = _h(seeded, "POST", "/cal/_search", body={
+            "query": {"range": {"slots": {"gte": 12, "lte": 18,
+                                          "relation": "contains"}}}})
+        assert s == 200 and _ids(b) == ["1"], b["hits"]
+
+    def test_term_value_inside_interval(self, seeded):
+        s, b = _h(seeded, "POST", "/cal/_search", body={
+            "query": {"term": {"slots": 25}}})
+        assert s == 200 and _ids(b) == ["2"], b["hits"]
+
+    def test_double_range_open_bound(self, seeded):
+        s, b = _h(seeded, "POST", "/cal/_search", body={
+            "query": {"range": {"temp": {"gte": 2.0}}}})
+        assert s == 200 and _ids(b) == ["1"], b["hits"]
