@@ -403,19 +403,28 @@ class Coordinator:
             term=self.current_term, version=base.version + 1,
             master_node_id=self.local.node_id)
         self._publishing = True
-        self._publish(new_state, on_done)
+        self._publish(new_state, on_done, base=base)
 
     def _drain_tasks_locked(self) -> None:
         with self.lock:
             self._drain_tasks()
 
     def _publish(self, state: ClusterState,
-                 on_done: Optional[Callable]) -> None:
-        # caller holds self.lock; 2-phase commit over the transport
+                 on_done: Optional[Callable],
+                 base: Optional[ClusterState] = None) -> None:
+        # caller holds self.lock; 2-phase commit over the transport.
+        # Publications ship a DIFF against the base the update built on
+        # (reference: PublicationTransportHandler's Diff<ClusterState>);
+        # a receiver whose accepted state doesn't match the base answers
+        # need_full and gets the full state re-sent.
         term, version = state.term, state.version
         pub_term = self.current_term  # guard against stale callbacks
         voting = state.voting_config or tuple(self.initial_master_names)
         state_json = state.to_json()
+        diff_json = None
+        if base is not None and base.version > 0:
+            from elasticsearch_tpu.cluster.state import state_diff
+            diff_json = state_diff(base, state)
         acks = {self.local.name}
         targets = [n for n in state.nodes.values()
                    if n.node_id != self.local.node_id]
@@ -437,10 +446,14 @@ class Coordinator:
             self._publish_timeout = None
             self._publish_on_done = None
             self._commit_locally(state)
+            # commit only to nodes that have ACKED; nodes whose accept
+            # lands later get their commit from the late-ack path in
+            # send_to (no duplicate commits → appliers run once)
             for n in targets:
-                self.transport.send(n.address, ACTION_COMMIT,
-                                    {"term": term, "version": version},
-                                    lambda ok, r: None)
+                if n.name in acks:
+                    self.transport.send(n.address, ACTION_COMMIT,
+                                        {"term": term, "version": version},
+                                        lambda ok, r: None)
             self._publishing = False
             if on_done:
                 on_done(None)
@@ -475,9 +488,37 @@ class Coordinator:
         timeout_handle = self.scheduler.schedule(self.publish_timeout_s,
                                                  on_timeout)
         self._publish_timeout = timeout_handle
+
+        def send_to(n, payload) -> None:
+            def ack(ok: bool, result: Any) -> None:
+                if (ok and result and result.get("need_full")
+                        and "diff" in payload):
+                    # receiver's accepted base didn't match the diff —
+                    # re-send the full state (reference:
+                    # IncompatibleClusterStateVersionException fallback)
+                    send_to(n, {"state": state_json})
+                    return
+                with self.lock:
+                    was_committed = committed[0]
+                on_ack(ok, result)
+                # an accept that lands AFTER the quorum committed (the
+                # need_full round-trip makes this common) still needs
+                # its commit message — maybe_commit only covered nodes
+                # that had acked by commit time (if THIS ack triggered
+                # the commit, maybe_commit included this node already)
+                late = (was_committed and ok and result
+                        and result.get("accepted"))
+                if late:
+                    self.transport.send(
+                        n.address, ACTION_COMMIT,
+                        {"term": term, "version": version},
+                        lambda ok2, r2: None)
+
+            self.transport.send(n.address, ACTION_PUBLISH, payload, ack)
+
         for n in targets:
-            self.transport.send(n.address, ACTION_PUBLISH,
-                                {"state": state_json}, on_ack)
+            send_to(n, {"diff": diff_json} if diff_json is not None
+                    else {"state": state_json})
         maybe_commit()  # single-node cluster: self-ack is a quorum
 
     def _commit_locally(self, state: ClusterState) -> None:
@@ -493,7 +534,18 @@ class Coordinator:
 
     def handle_publish(self, payload: Dict[str, Any],
                        from_node: Dict[str, Any]) -> Dict[str, Any]:
-        state = ClusterState.from_json(payload["state"])
+        if "diff" in payload:
+            from elasticsearch_tpu.cluster.state import apply_diff
+            with self.lock:
+                state = apply_diff(self.accepted, payload["diff"])
+            if state is None:
+                # our accepted state is not the diff's base — ask the
+                # master for the full state
+                return {"accepted": False, "need_full": True,
+                        "term": self.current_term,
+                        "node_name": self.local.name}
+        else:
+            state = ClusterState.from_json(payload["state"])
         with self.lock:
             if state.term < self.current_term:
                 return {"accepted": False, "term": self.current_term,

@@ -222,3 +222,52 @@ def is_quorum(votes: int, voting_config: Tuple[str, ...]) -> bool:
     """Majority of the voting configuration (reference:
     CoordinationState#isElectionQuorum)."""
     return votes * 2 > len(voting_config)
+
+
+# ---------------------------------------------------------------------------
+# diff publication (reference: Diff<ClusterState> via
+# PublishRequest/PublicationTransportHandler — O(changed metadata) per
+# publication instead of O(total); receivers whose accepted base doesn't
+# match ask for the full state, SURVEY.md §3.4)
+# ---------------------------------------------------------------------------
+
+_DIFF_ENTRY_KEYS = ("indices", "routing", "nodes")
+
+
+def state_diff(base: "ClusterState", new: "ClusterState") -> Dict[str, Any]:
+    """JSON diff applying over `base` to produce `new`: per-entry for the
+    big maps (indices, routing, nodes), whole-value for the rest."""
+    bj, nj = base.to_json(), new.to_json()
+    diff: Dict[str, Any] = {
+        "base_term": base.term, "base_version": base.version,
+        "set": {}, "entries": {},
+    }
+    for key, nv in nj.items():
+        if key in _DIFF_ENTRY_KEYS:
+            bv = bj.get(key) or {}
+            removed = [k for k in bv if k not in nv]
+            changed = {k: v for k, v in nv.items() if bv.get(k) != v}
+            if removed or changed:
+                diff["entries"][key] = {"removed": removed, "set": changed}
+        elif bj.get(key) != nv:
+            diff["set"][key] = nv
+    return diff
+
+
+def apply_diff(base: "ClusterState", diff: Dict[str, Any]
+               ) -> Optional["ClusterState"]:
+    """Apply a state_diff; None when `base` isn't the diff's base (the
+    receiver then asks for the full state — the reference's
+    IncompatibleClusterStateVersionException fallback)."""
+    if (base.term, base.version) != (int(diff["base_term"]),
+                                     int(diff["base_version"])):
+        return None
+    j = base.to_json()
+    j.update(diff.get("set") or {})
+    for key, entry in (diff.get("entries") or {}).items():
+        m = dict(j.get(key) or {})
+        for k in entry.get("removed") or []:
+            m.pop(k, None)
+        m.update(entry.get("set") or {})
+        j[key] = m
+    return ClusterState.from_json(j)

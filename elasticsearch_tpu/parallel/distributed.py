@@ -507,6 +507,20 @@ def prepare_term_ranges(pack: StackedShardPack,
     return starts, lengths, weights
 
 
+def pack_pruned_operands(batch: QueryBatch, t_starts: np.ndarray,
+                         t_lengths: np.ndarray, t_weights: np.ndarray
+                         ) -> np.ndarray:
+    """Fuse the 7 per-launch query tensors into ONE [S, B, W] f32 array
+    (ints bitcast): through the axon tunnel every host→device transfer
+    pays ~100ms round-trip latency, so the batch ships as a single
+    operand and the kernel slices/bitcasts it back."""
+    parts = [batch.starts.view(np.float32), batch.lengths.view(np.float32),
+             batch.weights,
+             t_starts.view(np.float32), t_lengths.view(np.float32),
+             t_weights, batch.tail_bounds[:, :, None]]
+    return np.concatenate(parts, axis=2)
+
+
 @lru_cache(maxsize=32)
 def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
                        c_cand: int, k_out: int, t_window: int,
@@ -538,8 +552,21 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
         # wrong result
         c_local = max(min(c_cand, 512), c_cand // 4)
 
-    def body(fd_imp, fi_imp, fd_ds, fi_ds, starts, lengths, weights,
-             t_starts, t_lengths, t_weights, tail_bound):
+    def body(fd_imp, fi_imp, fd_ds, fi_ds, ops):
+        # unpack the fused operand (pack_pruned_operands): one transfer
+        # instead of seven through the high-latency tunnel link
+        t = (ops.shape[2] - 3 * t_terms - 1) // 3
+
+        def bc(a):
+            return jax.lax.bitcast_convert_type(a, jnp.int32)
+
+        starts = bc(ops[:, :, 0:t])
+        lengths = bc(ops[:, :, t:2 * t])
+        weights = ops[:, :, 2 * t:3 * t]
+        t_starts = bc(ops[:, :, 3 * t:3 * t + t_terms])
+        t_lengths = bc(ops[:, :, 3 * t + t_terms:3 * t + 2 * t_terms])
+        t_weights = ops[:, :, 3 * t + 2 * t_terms:3 * t + 3 * t_terms]
+        tail_bound = ops[:, :, 3 * t + 3 * t_terms]
         s_l, b = starts.shape[0], starts.shape[1]
         my = jax.lax.axis_index(SHARD_AXIS)
         ones = jnp.ones((b,), dtype=jnp.int32)
@@ -621,10 +648,7 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
     spec_sbt = P(SHARD_AXIS, DATA_AXIS, None)
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(spec_post, spec_post, spec_post, spec_post,
-                  spec_sbt, spec_sbt, spec_sbt,
-                  spec_sbt, spec_sbt, spec_sbt,
-                  P(SHARD_AXIS, DATA_AXIS)),
+        in_specs=(spec_post, spec_post, spec_post, spec_post, spec_sbt),
         out_specs=P(DATA_AXIS, None),
         check_vma=False)
     return jax.jit(mapped)

@@ -119,6 +119,10 @@ class TermsAggregator(Aggregator):
         self.order_asc = order_asc
 
     def collect(self, ctx: SegmentAggContext, mask) -> InternalTerms:
+        if not self.sub:
+            res = self._collect_device(ctx, mask)
+            if res is not None:
+                return res
         vals, docs, ord_terms = ctx.field_values(self.field, mask)
         buckets: Dict[Any, Bucket] = {}
         if len(vals):
@@ -145,6 +149,32 @@ class TermsAggregator(Aggregator):
                         isinstance(key, np.floating) else float(key)
                     sub = self._collect_sub(ctx, mask, docs, inv == i)
                     buckets[key] = Bucket(key, int(counts[i]), sub)
+        return InternalTerms(self.size, self.min_doc_count, buckets,
+                             self.order_by, self.order_asc)
+
+    def _collect_device(self, ctx: SegmentAggContext,
+                        mask) -> Optional[InternalTerms]:
+        """Keyword terms counts as one device scatter-add over the ord
+        column (SURVEY.md §7.2.8); None → host path (multi-valued extras
+        or no servable column)."""
+        seg = ctx.view.segment
+        col = seg.doc_values.get(self.field)
+        if col is None or col.kind != "ord" or col.extra:
+            return None
+        from elasticsearch_tpu.search.aggregations import device
+        counts = device.terms_counts(ctx.view.pack, self.field,
+                                     np.asarray(mask))
+        if counts is None:
+            return None
+        ord_terms = ctx.view.pack.dv_ord_terms[self.field]
+        hot = np.nonzero(counts)[0]
+        if len(hot) > self.shard_size:
+            top = hot[np.argsort(-counts[hot], kind="stable")]
+            hot = top[: self.shard_size]
+        buckets = {}
+        for o in hot:
+            key = ord_terms[int(o)]
+            buckets[key] = Bucket(key, int(counts[o]), {})
         return InternalTerms(self.size, self.min_doc_count, buckets,
                              self.order_by, self.order_asc)
 
@@ -236,6 +266,10 @@ class HistogramAggregator(Aggregator):
         self.calendar = calendar
 
     def collect(self, ctx, mask) -> InternalHistogram:
+        if not self.sub and not self.calendar:
+            res = self._collect_device(ctx, mask)
+            if res is not None:
+                return res
         vals, docs, ord_terms = ctx.field_values(self.field, mask)
         if ord_terms is not None:
             raise IllegalArgumentException(
@@ -265,6 +299,42 @@ class HistogramAggregator(Aggregator):
         interval = None if self.calendar else self.interval
         return InternalHistogram(buckets, self.min_doc_count, interval,
                                  self.date)
+
+    MAX_DEVICE_BUCKETS = 65536
+
+    def _collect_device(self, ctx, mask) -> Optional[InternalHistogram]:
+        """Fixed-interval histogram as one device scatter-add; the static
+        bucket span comes from the segment's min/max column stats
+        (SURVEY.md §7.2.8). None → host path."""
+        seg = ctx.view.segment
+        col = seg.doc_values.get(self.field)
+        if col is None or col.kind == "ord" or col.extra:
+            return None
+        from elasticsearch_tpu.search.aggregations import device
+        from elasticsearch_tpu.search.can_match import _segment_minmax
+        mm = _segment_minmax(seg, self.field)
+        if mm is None:
+            return InternalHistogram({}, self.min_doc_count,
+                                     self.interval, self.date)
+        import math as _math
+        lo_idx = int(_math.floor((mm[0] - self.offset) / self.interval))
+        hi_idx = int(_math.floor((mm[1] - self.offset) / self.interval))
+        n_buckets = hi_idx - lo_idx + 1
+        if n_buckets <= 0 or n_buckets > self.MAX_DEVICE_BUCKETS:
+            return None
+        counts = device.histogram_counts(
+            ctx.view.pack, self.field, np.asarray(mask), self.offset,
+            self.interval, lo_idx, n_buckets)
+        if counts is None:
+            return None
+        buckets: Dict[Any, Bucket] = {}
+        for i in np.nonzero(counts)[0]:
+            k = (lo_idx + int(i)) * self.interval + self.offset
+            key = int(k) if self.date else float(k)
+            buckets[key] = Bucket(key, int(counts[i]), {},
+                                  _millis_iso(key) if self.date else None)
+        return InternalHistogram(buckets, self.min_doc_count,
+                                 self.interval, self.date)
 
     def empty(self) -> InternalHistogram:
         return InternalHistogram({}, self.min_doc_count,

@@ -1,0 +1,193 @@
+"""Device-side aggregation kernels (SURVEY.md §7.2.8, §2.1#38).
+
+The reference's largest subsystem spends its time in per-segment leaf
+collectors walking docs; here the big three collectors — terms,
+histogram/date_histogram, numeric stats — are MASKED SEGMENT REDUCTIONS
+over the pack's doc-value columns, so they run as XLA scatter-add /
+reduce ops over the same dense mask the query planner produced:
+
+    terms:     counts[ord]   += mask        (scatter-add, drop-mode)
+    histogram: counts[floor((v-off)/w)] += mask
+    stats:     (count, sum, min, max) via masked reductions
+
+Shapes are bucketed to powers of two so the jit cache stays small, and
+each pack view caches its device-resident columns (first agg query per
+segment pays the transfer, steady state reads HBM). Aggregators fall
+back to the host numpy path when the device can't express the request
+(multi-valued extras, sub-aggregations needing per-bucket masks,
+calendar intervals)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _pow2(n: int, floor: int = 16) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+# bounded global budget for device-resident agg columns: columns are a
+# derived cache, so LRU eviction just re-transfers on the next agg query.
+# Tracked here (not per-pack) so many segments × many fields can't
+# accumulate unaccounted HBM behind the circuit breaker's back; entries
+# hold only weakrefs to the per-pack caches, so a merged-away pack frees
+# its columns with the pack itself.
+DEV_COL_BUDGET_BYTES = 1 << 30
+_dev_registry: "OrderedDict[int, Tuple[Any, Any, int]]" = None  # type: ignore
+_dev_lock = None
+_dev_total = 0
+_dev_seq = 0
+
+
+def _dev_col(pack, kind: str, field: str):
+    """Device-resident copy of a pack dv column, cached on the pack and
+    accounted against DEV_COL_BUDGET_BYTES (LRU across all packs)."""
+    global _dev_registry, _dev_lock, _dev_total, _dev_seq
+    import threading
+    import weakref
+    from collections import OrderedDict
+
+    import jax
+    if _dev_lock is None:
+        _dev_lock = threading.Lock()
+        _dev_registry = OrderedDict()
+    cache = getattr(pack, "_dev_cols", None)
+    if cache is None:
+        cache = {}
+        pack._dev_cols = cache
+    key = (kind, field)
+    arr = cache.get(key)
+    if arr is not None:
+        return arr
+    host = {"ord": pack.dv_ord, "i64": pack.dv_i64,
+            "f64": pack.dv_f64}[kind][field]
+    arr = jax.device_put(host)
+    nbytes = int(host.nbytes)
+    with _dev_lock:
+        if key in cache:  # racing transfer of the same column
+            return cache[key]
+        cache[key] = arr
+        _dev_seq += 1
+        _dev_registry[_dev_seq] = (weakref.ref(pack), key, nbytes)
+        _dev_total += nbytes
+        while _dev_total > DEV_COL_BUDGET_BYTES and _dev_registry:
+            _, (pref, pkey, pbytes) = _dev_registry.popitem(last=False)
+            _dev_total -= pbytes
+            p = pref()
+            if p is not None:
+                getattr(p, "_dev_cols", {}).pop(pkey, None)
+    return arr
+
+
+@functools.lru_cache(maxsize=64)
+def _terms_counts_fn(n_out: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(ords, mask):
+        idx = jnp.where(mask & (ords >= 0), ords, n_out)
+        return jnp.zeros(n_out, dtype=jnp.int64).at[idx].add(
+            1, mode="drop")
+
+    return f
+
+
+def terms_counts(pack, field: str, mask) -> Optional[np.ndarray]:
+    """Per-ordinal doc counts for a keyword terms agg, on device.
+    Returns None when the column isn't device-servable."""
+    col = pack.dv_ord.get(field)
+    terms = pack.dv_ord_terms.get(field)
+    if col is None or not terms:
+        return None
+    import jax.numpy as jnp
+    n_out = _pow2(len(terms))
+    counts = _terms_counts_fn(n_out)(_dev_col(pack, "ord", field),
+                                     jnp.asarray(mask))
+    return np.asarray(counts)[: len(terms)]
+
+
+@functools.lru_cache(maxsize=64)
+def _histo_counts_fn(n_out: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(col, valid, base, interval):
+        # f64 bucket math for BOTH column kinds: intervals/offsets are
+        # doubles in the request (interval 2.5 on a long field is
+        # valid); i64 values ≤ 2^53 convert exactly
+        ids = jnp.floor((col.astype(jnp.float64) - base)
+                        / interval).astype(jnp.int64)
+        idx = jnp.where(valid & (ids >= 0) & (ids < n_out), ids, n_out)
+        return jnp.zeros(n_out, dtype=jnp.int64).at[idx].add(
+            1, mode="drop")
+
+    return f
+
+
+def histogram_counts(pack, field: str, mask, offset, interval,
+                     lo_bucket: int, n_buckets: int
+                     ) -> Optional[np.ndarray]:
+    """Fixed-interval histogram counts on device: bucket i counts docs in
+    [offset + (lo_bucket+i)·interval, ...+interval). Returns i64 counts
+    [n_buckets] or None when no device column exists."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.segment import MISSING_I64
+    m = jnp.asarray(mask)
+    if field in pack.dv_i64:
+        col = _dev_col(pack, "i64", field)
+        valid = m & (col != MISSING_I64)
+    elif field in pack.dv_f64:
+        col = _dev_col(pack, "f64", field)
+        valid = m & ~jnp.isnan(col)
+    else:
+        return None
+    n_out = _pow2(n_buckets)
+    base = float(offset) + float(lo_bucket) * float(interval)
+    counts = _histo_counts_fn(n_out)(
+        col, valid, jnp.float64(base), jnp.float64(interval))
+    return np.asarray(counts)[: n_buckets]
+
+
+@functools.lru_cache(maxsize=8)
+def _stats_fn(is_float: bool):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(col, valid):
+        colf = col.astype(jnp.float64)
+        cnt = jnp.sum(valid)
+        s = jnp.sum(jnp.where(valid, colf, 0.0))
+        mn = jnp.min(jnp.where(valid, colf, jnp.inf))
+        mx = jnp.max(jnp.where(valid, colf, -jnp.inf))
+        return cnt, s, mn, mx
+
+    return f
+
+
+def numeric_stats(pack, field: str, mask
+                  ) -> Optional[Tuple[int, float, float, float]]:
+    """(count, sum, min, max) of a numeric column under the mask, on
+    device. None when no device column exists."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.segment import MISSING_I64
+    m = jnp.asarray(mask)
+    if field in pack.dv_i64:
+        col = _dev_col(pack, "i64", field)
+        valid = m & (col != MISSING_I64)
+        cnt, s, mn, mx = _stats_fn(False)(col, valid)
+    elif field in pack.dv_f64:
+        col = _dev_col(pack, "f64", field)
+        valid = m & ~jnp.isnan(col)
+        cnt, s, mn, mx = _stats_fn(True)(col, valid)
+    else:
+        return None
+    return int(cnt), float(s), float(mn), float(mx)

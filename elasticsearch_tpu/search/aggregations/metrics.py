@@ -78,6 +78,10 @@ class NumericMetricAggregator(Aggregator):
         self.missing = missing
 
     def collect(self, ctx: SegmentAggContext, mask) -> InternalNumericMetric:
+        if self.missing is None:
+            res = self._collect_device(ctx, mask)
+            if res is not None:
+                return res
         vals, docs, ord_terms = ctx.field_values(self.field, mask)
         out = InternalNumericMetric(self.kind)
         if ord_terms is not None and self.kind != "value_count":
@@ -96,6 +100,28 @@ class NumericMetricAggregator(Aggregator):
             out.count = int(len(v))
             out.minv = float(v.min())
             out.maxv = float(v.max())
+        return out
+
+    def _collect_device(self, ctx: SegmentAggContext, mask
+                        ) -> "Optional[InternalNumericMetric]":
+        """count/sum/min/max as masked device reductions over the numeric
+        column (SURVEY.md §7.2.8); None → host path."""
+        seg = ctx.view.segment
+        col = seg.doc_values.get(self.field)
+        if col is None or col.kind == "ord" or col.extra:
+            return None
+        from elasticsearch_tpu.search.aggregations import device
+        stats = device.numeric_stats(ctx.view.pack, self.field,
+                                     np.asarray(mask))
+        if stats is None:
+            return None
+        cnt, total, mn, mx = stats
+        out = InternalNumericMetric(self.kind)
+        if cnt:
+            out.count = cnt
+            out.total = total
+            out.minv = mn
+            out.maxv = mx
         return out
 
     def empty(self) -> InternalNumericMetric:

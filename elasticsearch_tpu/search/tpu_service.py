@@ -345,7 +345,7 @@ class _PackQueue:
     bounded in-flight queue is the backpressure."""
 
     IDLE_EXIT_S = 60.0
-    PIPELINE_DEPTH = 2
+    PIPELINE_DEPTH = 3
 
     def __init__(self, batcher: "MicroBatcher", resident: ResidentPack):
         import queue as _queue
@@ -487,7 +487,7 @@ class MicroBatcher:
     Each pack has its own queue + worker, so launches for different
     packs overlap."""
 
-    def __init__(self, window_s: float = 0.01, max_batch: int = 128):
+    def __init__(self, window_s: float = 0.01, max_batch: int = 64):
         self.window_s = window_s
         self.max_batch = max_batch
         self._lock = threading.Lock()
@@ -825,16 +825,12 @@ def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     from jax.sharding import NamedSharding, PartitionSpec as P
     from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
-    sb = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS))
-    put = jax.device_put
+    ops = dist.pack_pruned_operands(batch, t_starts, t_lengths, t_weights)
     t_disp = time.perf_counter()
     packed = fn(
         resident.imp_device_arrays[0], resident.imp_device_arrays[1],
         resident.device_arrays[0], resident.device_arrays[1],
-        put(batch.starts, sbt), put(batch.lengths, sbt),
-        put(batch.weights, sbt),
-        put(t_starts, sbt), put(t_lengths, sbt), put(t_weights, sbt),
-        put(batch.tail_bounds, sb))
+        jax.device_put(ops, sbt))
     t_dev = time.perf_counter()
     if stages is not None:
         stages.add("batch_prep", t_disp - t_prep)
@@ -916,7 +912,7 @@ class TpuSearchService:
     micro-batched execution. One instance per node."""
 
     def __init__(self, breaker=None, mesh=None, window_s: float = 0.01,
-                 max_batch: int = 128, batch_timeout_s: float = 30.0):
+                 max_batch: int = 64, batch_timeout_s: float = 30.0):
         _ensure_compile_cache()
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.batch_timeout_s = batch_timeout_s

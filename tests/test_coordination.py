@@ -144,3 +144,31 @@ def test_five_node_cluster_survives_two_failures(rng):
     leader = cluster.run_until_stable(live=live)
     state = cluster.nodes[leader].state()
     assert len(state.nodes) == 3
+
+
+def test_diff_publication_roundtrip_and_fallback():
+    """Publications ship diffs (reference: Diff<ClusterState>); a
+    receiver whose accepted base doesn't match answers need_full and
+    applies the re-sent full state."""
+    from elasticsearch_tpu.cluster.state import (ClusterState, IndexMeta,
+                                                 apply_diff, state_diff)
+    s0 = ClusterState.empty("u")
+    s1 = s0.with_updates(term=1, version=1, master_node_id="m",
+                         indices={"a": IndexMeta("a", "ua", {}, None, 2, 0)})
+    s2 = s1.with_updates(
+        version=2,
+        indices={**s1.indices,
+                 "b": IndexMeta("b", "ub", {}, None, 1, 1)})
+    d = state_diff(s1, s2)
+    # the diff carries only the changed index, not index "a"
+    assert "b" in d["entries"]["indices"]["set"]
+    assert "a" not in d["entries"]["indices"]["set"]
+    applied = apply_diff(s1, d)
+    assert applied is not None and applied.to_json() == s2.to_json()
+    # wrong base → None (receiver asks for the full state)
+    assert apply_diff(s0, d) is None
+    # removal round-trips
+    s3 = s2.with_updates(version=3, indices={"b": s2.indices["b"]})
+    d2 = state_diff(s2, s3)
+    assert d2["entries"]["indices"]["removed"] == ["a"]
+    assert apply_diff(s2, d2).to_json() == s3.to_json()

@@ -1,0 +1,96 @@
+"""Transport layer: handshake, binary frames, in-flight backpressure.
+
+Reference: TransportHandshaker (connect-time identity + wire version),
+MultiChunkTransfer's raw-byte chunks, bounded pending (SURVEY.md
+§2.1#7, VERDICT r3 weak #7/#5)."""
+
+from __future__ import annotations
+
+import pytest
+
+from elasticsearch_tpu.transport.service import (
+    MAX_INFLIGHT_PER_CONN, TransportRejectedException, TransportService,
+    WIRE_VERSION)
+
+
+@pytest.fixture()
+def pair():
+    a = TransportService(local_node={"node_id": "a", "name": "alpha"})
+    b = TransportService(local_node={"node_id": "b", "name": "beta"})
+    a.start()
+    b.start()
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_handshake_exchanges_identity(pair):
+    a, b = pair
+    b.register_handler("ping", lambda p, f: {"pong": True, "from": f})
+    out = a.send_request(b.bound_address, "ping", {"x": 1})
+    assert out["pong"] and out["from"]["node_id"] == "a"
+    conn = a._conns[(b.host, b.port)]
+    assert conn.peer["node_id"] == "b"
+
+
+def test_wire_version_mismatch_refused(pair):
+    """An incompatible peer (old wire version in its handshake reply) is
+    refused at connect time, before any request flows."""
+    import socket
+    import threading
+
+    from elasticsearch_tpu.transport.service import (
+        ConnectTransportException, _frame, _read_frame)
+    a, _b = pair
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def old_peer():
+        s, _ = srv.accept()
+        _read_frame(s)  # the client's handshake
+        s.sendall(_frame({"t": "hr", "wire_version": WIRE_VERSION + 9,
+                          "node": {"node_id": "old"}}))
+        s.close()
+
+    t = threading.Thread(target=old_peer, daemon=True)
+    t.start()
+    fut = a.send_request_async(srv.getsockname(), "ping", {})
+    with pytest.raises(ConnectTransportException):
+        fut.result(timeout=5)
+    srv.close()
+
+
+def test_binary_blob_roundtrip(pair):
+    a, b = pair
+    payload_bytes = bytes(range(256)) * 1000
+
+    def echo(p, f):
+        assert p["_blob"] == payload_bytes
+        return {"_blob": p["_blob"][::-1], "n": len(p["_blob"])}
+
+    b.register_handler("blob", echo)
+    out = a.send_request(b.bound_address, "blob",
+                         {"_blob": payload_bytes, "meta": 7})
+    assert out["n"] == len(payload_bytes)
+    assert out["_blob"] == payload_bytes[::-1]
+
+
+def test_inflight_cap_rejects(pair):
+    a, b = pair
+    import threading
+    release = threading.Event()
+    b.register_handler("slow", lambda p, f: (release.wait(10), {})[1])
+    futs = []
+    rejected = 0
+    try:
+        for _ in range(MAX_INFLIGHT_PER_CONN + 5):
+            fut = a.send_request_async(b.bound_address, "slow", {})
+            if fut.done() and isinstance(fut.exception(),
+                                         TransportRejectedException):
+                rejected += 1
+            else:
+                futs.append(fut)
+        assert rejected >= 5
+    finally:
+        release.set()
