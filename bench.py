@@ -71,7 +71,6 @@ def main() -> None:
         f"({time.perf_counter() - t0:.1f}s)")
 
     # ---- index into a real node ----
-    t0 = time.perf_counter()
     # PRODUCTION serving config — no batch-timeout crutch (VERDICT r3
     # #3): the pack build + XLA compiles happen in the explicit prewarm
     # step below (the reference's index-warmer seam), and the persistent
@@ -79,24 +78,39 @@ def main() -> None:
     node = Node(tempfile.mkdtemp(prefix="es_tpu_bench_"),
                 settings=Settings.of({
                     "index": {"translog": {"durability": "async"}}}))
+    t0 = time.perf_counter()  # bulk ingest + refresh-to-searchable
     idx = node.create_index(
         "bench", Settings.of({"index": {
             "number_of_shards": n_shards,
             "translog": {"durability": "async"}}}),
         {"properties": {"body": {"type": "text"}}})
-    # the production write path: REST _bulk (NDJSON), which groups ops per
-    # shard through the engine's batched path (VERDICT r3 #4)
+    # the production write path: REST _bulk (NDJSON) from a few
+    # concurrent clients (the standard ES load-driver shape), grouped per
+    # shard through the engine's batched path (VERDICT r3 #4). Analysis
+    # runs native code that releases the GIL, so clients overlap.
     bulk_sz = 4000
-    for start in range(0, corpus.num_docs, bulk_sz):
-        lines = []
-        for i in range(start, min(start + bulk_sz, corpus.num_docs)):
-            lines.append(json.dumps({"index": {"_id": str(i)}}))
-            lines.append(json.dumps({"body": corpus.doc_text(i)}))
-        s, resp = node.handle("POST", "/bench/_bulk", {},
-                              "\n".join(lines) + "\n")
-        assert s == 200 and not resp.get("errors"), str(resp)[:500]
-        if (start + bulk_sz) % 48_000 == 0:
-            log(f"  indexed {start + bulk_sz}/{corpus.num_docs}")
+    bulk_clients = _env("BULK_CLIENTS", 2)
+    starts = list(range(0, corpus.num_docs, bulk_sz))
+    bulk_errors = []
+
+    def bulk_client(ci: int) -> None:
+        for si in range(ci, len(starts), bulk_clients):
+            start = starts[si]
+            lines = []
+            for i in range(start, min(start + bulk_sz, corpus.num_docs)):
+                lines.append(json.dumps({"index": {"_id": str(i)}}))
+                lines.append(json.dumps({"body": corpus.doc_text(i)}))
+            s, resp = node.handle("POST", "/bench/_bulk", {},
+                                  "\n".join(lines) + "\n")
+            if s != 200 or resp.get("errors"):
+                bulk_errors.append(str(resp)[:500])
+                return
+
+    bulk_threads = [threading.Thread(target=bulk_client, args=(ci,))
+                    for ci in range(bulk_clients)]
+    [t.start() for t in bulk_threads]
+    [t.join() for t in bulk_threads]
+    assert not bulk_errors, bulk_errors[:1]
     idx.refresh()
     index_dt = time.perf_counter() - t0
     log(f"indexing: {corpus.num_docs} docs in {index_dt:.1f}s "

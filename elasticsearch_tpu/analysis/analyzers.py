@@ -23,6 +23,7 @@ respecting removed stop words (holes) — phrase queries need the gaps.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import re
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -44,6 +45,61 @@ class Token:
 # Unicode "word" runs; \w covers letters/digits/underscore across scripts.
 _WORD_RE = re.compile(r"\w+(?:[.']\w+)*", re.UNICODE)
 _LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+
+class _NativeTokenizer:
+    """ctypes wrapper for native/fast_tokenize.c: the ASCII fast path of
+    tokenize+lowercase (the bulk-indexing hot loop; the reference's
+    analysis chain is native Lucene code for the same reason). Returns
+    None → caller uses the Python regex path (non-ASCII, overlong
+    tokens, or no compiler)."""
+
+    def __init__(self):
+        self._fn = None
+        self._tried = False
+
+    def _load(self) -> bool:
+        if not self._tried:
+            self._tried = True
+            import ctypes
+
+            from elasticsearch_tpu import native
+            self._fn = native.bind(
+                "fast_tokenize", "fast_tokenize_ascii", ctypes.c_long,
+                [ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+                 ctypes.c_char_p, ctypes.c_long,
+                 ctypes.POINTER(ctypes.c_long)])
+        return self._fn is not None
+
+    _tls = threading.local()
+
+    def lowered_tokens(self, text: str, max_token_length: int):
+        if not self._load():
+            return None
+        import ctypes
+        try:
+            raw = text.encode("ascii")
+        except UnicodeEncodeError:
+            return None
+        tls = self._tls
+        cap = getattr(tls, "cap", 0)
+        if cap < len(raw) + 16:
+            cap = max(1 << 16, 2 * (len(raw) + 16))
+            tls.cap = cap
+            tls.buf = ctypes.create_string_buffer(cap)
+            tls.out_len = ctypes.c_long(0)
+            tls.out_ref = ctypes.byref(tls.out_len)
+        n = self._fn(raw, len(raw), max_token_length, tls.buf, cap,
+                     tls.out_ref)
+        if n < 0:
+            return None
+        if n == 0:
+            return []
+        return ctypes.string_at(tls.buf,
+                                tls.out_len.value).decode("ascii").split("\n")
+
+
+_NATIVE = _NativeTokenizer()
 
 
 def standard_tokenize(text: str, max_token_length: int = 255) -> List[str]:
@@ -156,8 +212,12 @@ class StandardAnalyzer(Analyzer):
 
     def analyze_slots(self, text: str) -> List[Optional[str]]:
         # no stop filter (the default) ⇒ tokenize emits no holes and the
-        # chain is exactly one lowercase pass — C-level map, no genexprs
+        # chain is exactly one lowercase pass. The native tokenizer does
+        # tokenize+lower in one C scan for ASCII text; None → regex path
         if not self._has_stop:
+            toks = _NATIVE.lowered_tokens(text, self.max_token_length)
+            if toks is not None:
+                return toks
             return list(map(str.lower,
                             standard_tokenize(text, self.max_token_length)))
         return super().analyze_slots(text)

@@ -345,8 +345,10 @@ class InternalEngine:
                 self._version_map[doc_id] = VersionValue(
                     seq_no, primary_term, new_version, False,
                     ("buffer", ord_))
-                tl_ops.append(TranslogOp("index", seq_no, primary_term,
-                                         doc_id, parsed.source, new_version))
+                tl_ops.append({"op": "index", "seq_no": seq_no,
+                               "primary_term": primary_term,
+                               "version": new_version, "id": doc_id,
+                               "source": parsed.source})
                 results.append(IndexResult(
                     doc_id, seq_no, primary_term, new_version,
                     created=not is_update,

@@ -171,15 +171,17 @@ class Translog:
         fsync granularity, not per-op (SURVEY.md §2.1#25; VERDICT r3 #4)."""
         if not ops:
             return
-        parts = []
-        for op in ops:
-            payload = json.dumps(op.to_dict(),
-                                 separators=(",", ":")).encode("utf-8")
-            parts.append(_HDR.pack(len(payload), zlib.crc32(payload)))
-            parts.append(payload)
+        # the whole batch serializes as ONE json array record (one
+        # dumps, one crc) — snapshot() fans it back out. Ops may be
+        # TranslogOp objects or pre-built wire dicts (the engine bulk
+        # path skips the intermediate objects entirely).
+        dicts = [op.to_dict() if isinstance(op, TranslogOp) else op
+                 for op in ops]
+        payload = json.dumps(dicts, separators=(",", ":")).encode("utf-8")
+        rec = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:
-            self._file.write(b"".join(parts))
-            mx = max(op.seq_no for op in ops)
+            self._file.write(rec)
+            mx = max(d["seq_no"] for d in dicts)
             if mx > self.checkpoint.max_seq_no:
                 self.checkpoint.max_seq_no = mx
             if self.durability == self.DURABILITY_REQUEST:
@@ -295,9 +297,14 @@ class Translog:
                 if zlib.crc32(payload) != crc:
                     raise TranslogCorruptedException(
                         f"translog [{p}] checksum mismatch")
-                op = TranslogOp.from_dict(json.loads(payload.decode("utf-8")))
-                if op.seq_no >= from_seq_no:
-                    yield op
+                decoded = json.loads(payload.decode("utf-8"))
+                # a record is one op dict, or a LIST of op dicts (the
+                # bulk path writes whole batches as one record)
+                for d in (decoded if isinstance(decoded, list)
+                          else (decoded,)):
+                    op = TranslogOp.from_dict(d)
+                    if op.seq_no >= from_seq_no:
+                        yield op
 
     def stats(self) -> Dict[str, int]:
         ops = 0

@@ -28,13 +28,35 @@ from elasticsearch_tpu.index.translog import write_atomic
 from elasticsearch_tpu.mapping import MapperService
 
 
+_native_murmur3 = None
+_native_murmur3_tried = False
+
+
+def _load_native_murmur3():
+    global _native_murmur3, _native_murmur3_tried
+    if not _native_murmur3_tried:
+        _native_murmur3_tried = True
+        import ctypes
+
+        from elasticsearch_tpu import native
+        _native_murmur3 = native.bind(
+            "fast_tokenize", "murmur3_32", ctypes.c_int32,
+            [ctypes.c_char_p, ctypes.c_long])
+    return _native_murmur3
+
+
 def murmur3_hash(key: str, encoding: str = "utf-16-le") -> int:
     """murmur3_x86_32, seed 0, as signed i32. The reference's
     Murmur3HashFunction#hash(String) feeds TWO BYTES PER JAVA CHAR
     (little-endian UTF-16 code units), not UTF-8 — utf-16-le reproduces
     that exactly, surrogate pairs included, so routing is bit-identical
-    (cluster/routing/Murmur3HashFunction, SURVEY.md §2.1#19)."""
+    (cluster/routing/Murmur3HashFunction, SURVEY.md §2.1#19). The C
+    implementation (native/fast_tokenize.c) serves the hot path; this
+    Python body is the fallback and the executable spec."""
     data = key.encode(encoding)
+    fn = _load_native_murmur3()
+    if fn is not None:
+        return int(fn(data, len(data)))
     c1, c2 = 0xCC9E2D51, 0x1B873593
     h1 = 0
     n = len(data) & ~3

@@ -34,7 +34,10 @@ from elasticsearch_tpu.analysis import AnalysisRegistry
 from elasticsearch_tpu.common.errors import MapperParsingException
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.mapping.types import (
+    CompletionFieldType,
     FieldType,
+    IpFieldType,
+    RangeFieldType,
     TextFieldType,
     field_type_for,
 )
@@ -100,6 +103,26 @@ class DocumentMapper:
         self.dynamic = dynamic  # "true" | "false" | "strict"
         self.source_enabled = source_enabled
         self.nested_roots = set(nested_roots or ())
+
+    @property
+    def fast_text_fields(self) -> Dict[str, "TextFieldType"]:
+        """Top-level text fields with no multi-fields and no stop filter
+        — docs touching ONLY these take the flat parse fast path
+        (computed once; DocumentMapper is immutable)."""
+        cached = getattr(self, "_fast_text", None)
+        if cached is None:
+            cached = {}
+            for path, ft in self.fields.items():
+                if ("." in path or not isinstance(ft, TextFieldType)
+                        or path in METADATA_FIELDS
+                        or getattr(ft.analyzer, "_has_stop", True)):
+                    continue
+                prefix = path + "."
+                if any(p.startswith(prefix) for p in self.fields):
+                    continue  # has multi-fields
+                cached[path] = ft
+            object.__setattr__(self, "_fast_text", cached)
+        return cached
 
     def to_mapping(self) -> dict:
         props: Dict[str, Any] = {}
@@ -254,6 +277,8 @@ class MapperService:
             elif isinstance(t, RangeFieldType):
                 out[f + RangeFieldType.GTE_SUFFIX] = t.bound_kind
                 out[f + RangeFieldType.LTE_SUFFIX] = t.bound_kind
+            elif isinstance(t, CompletionFieldType):
+                out[f + CompletionFieldType.WEIGHT_SUFFIX] = "i64"
         return out
 
     def to_mapping(self) -> dict:
@@ -267,6 +292,26 @@ class MapperService:
         Mutates the live mapping via merge() when new fields appear (the
         engine calls this under its write path; distributed callers route
         the update through cluster metadata first)."""
+        # flat fast path (the bulk-indexing common case): every field a
+        # plain string mapped to a no-multi-field text type — one
+        # analyzer call per field, none of the generic walk
+        mapper = self.mapper
+        fast = mapper.fast_text_fields
+        if fast and not mapper.nested_roots:
+            postings: Dict[str, List[str]] = {}
+            lengths: Dict[str, int] = {}
+            slots_map: Dict[str, List[List[Optional[str]]]] = {}
+            for name, value in source.items():
+                ft = fast.get(name)
+                if ft is None or type(value) is not str:
+                    break
+                slots = ft.analyzer.analyze_slots(value)
+                postings[name] = slots  # no stop filter ⇒ no holes
+                lengths[name] = len(slots)
+                slots_map[name] = [slots]
+            else:
+                return ParsedDocument(doc_id, routing, source, postings,
+                                      lengths, slots_map, {})
         parsed = ParsedDocument(doc_id, routing, source, {}, {}, {}, {})
         update_props: Dict[str, Any] = {}
         self._parse_object(source, "", parsed, update_props)
@@ -296,24 +341,20 @@ class MapperService:
                     _flatten_nested_object(obj, path + ".", flat)
                     out.append(flat)
                 continue
-            if isinstance(value, dict):
-                from elasticsearch_tpu.mapping.types import RangeFieldType
-                if not isinstance(self.mapper.fields.get(path),
-                                  RangeFieldType):
-                    # plain object: descend; range-field values ARE
-                    # {gte/lte} objects and index as intervals below
-                    self._parse_object(value, path + ".", parsed,
-                                       update_props)
-                    continue
-            from elasticsearch_tpu.mapping.types import \
-                RangeFieldType as _RFT
-            is_range_field = isinstance(self.mapper.fields.get(path), _RFT)
+            # range/completion field VALUES are objects ({gte/lte},
+            # {input/weight}) — everything else dict-shaped descends as
+            # a plain object
+            value_is_object_field = isinstance(
+                self.mapper.fields.get(path),
+                (RangeFieldType, CompletionFieldType))
+            if isinstance(value, dict) and not value_is_object_field:
+                self._parse_object(value, path + ".", parsed,
+                                   update_props)
+                continue
             values = value if isinstance(value, list) else [value]
-            # nested objects inside arrays flatten too (object, not nested,
-            # semantics) — except range-field values, which are intervals
             flat_values = []
             for v in values:
-                if isinstance(v, dict) and not is_range_field:
+                if isinstance(v, dict) and not value_is_object_field:
                     self._parse_object(v, path + ".", parsed, update_props)
                 else:
                     flat_values.append(v)
@@ -357,8 +398,13 @@ class MapperService:
                     parsed.postings_terms.setdefault(path, []).extend(terms)
                     if length:
                         parsed.field_lengths[path] = parsed.field_lengths.get(path, 0) + length
-            from elasticsearch_tpu.mapping.types import (IpFieldType,
-                                                         RangeFieldType)
+            if isinstance(ft, CompletionFieldType):
+                inputs, weight = CompletionFieldType.parse_inputs(v)
+                for inp in inputs:
+                    _append_dv(parsed, path, inp)
+                _append_dv(parsed, path + CompletionFieldType.WEIGHT_SUFFIX,
+                           weight)
+                continue
             if isinstance(ft, IpFieldType):
                 # 128-bit address split into two signed-offset i64
                 # synthetic columns — the vectorized range path then

@@ -392,6 +392,49 @@ class RangeFieldType(FieldType):
         return self.parse_bound(value)
 
 
+class CompletionFieldType(FieldType):
+    """`completion` — suggestion inputs stored as an ordinal column
+    (sorted unique strings per segment), so prefix lookup is a binary
+    search over the ord table (reference: CompletionFieldMapper's FST,
+    same observable contract: inputs + optional weight). Weight lives in
+    the synthetic `<f>._weight` i64 column."""
+
+    type_name = "completion"
+    dv_kind = "ord"
+    is_indexed = False
+    WEIGHT_SUFFIX = "._weight"
+
+    @staticmethod
+    def parse_inputs(value: Any) -> Tuple[List[str], int]:
+        """value (str | [str] | {"input": ..., "weight": w}) →
+        (input strings, weight)."""
+        weight = 1
+        if isinstance(value, dict):
+            weight = int(value.get("weight", 1))
+            value = value.get("input")
+            if value is None:
+                raise MapperParsingException(
+                    "completion object requires [input]")
+        inputs = value if isinstance(value, list) else [value]
+        out = []
+        for v in inputs:
+            if not isinstance(v, str):
+                raise MapperParsingException(
+                    f"completion input must be a string, got [{v!r}]")
+            out.append(v)
+        return out, weight
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [], 0
+
+    def doc_value(self, value: Any):
+        inputs, _ = self.parse_inputs(value)
+        return inputs if len(inputs) > 1 else inputs[0]
+
+    def normalize_term(self, value: Any) -> str:
+        return str(value)
+
+
 def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
     """Build a FieldType from one field's mapping JSON."""
     t = mapping.get("type")
@@ -413,4 +456,6 @@ def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
         return IpFieldType(name, params)
     if t in RangeFieldType.RANGE_TYPES:
         return RangeFieldType(name, t, params)
+    if t == "completion":
+        return CompletionFieldType(name, params)
     raise MapperParsingException(f"no handler for type [{t}] declared on field [{name}]")

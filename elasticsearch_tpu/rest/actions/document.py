@@ -272,18 +272,32 @@ def _apply_index_run(node, ops, positions, items, refresh_shards) -> None:
         except EsException as exc:
             items[pos] = _bulk_error_item("index", entry["index"],
                                           entry["id"], exc)
-    for (index, shard_num), poss in groups.items():
+    # shard bulks apply CONCURRENTLY (engine locks are per shard; the
+    # analysis hot loop runs native code that releases the GIL) —
+    # reference: TransportBulkAction fans shard bulks out in parallel
+    def run_group(item):
+        (index, shard_num), poss = item
         try:
             svc = node.indices.index(index)
             shard = svc.shard(shard_num)
             docs = [(ops[p]["id"], ops[p]["_resolved"][2]) for p in poss]
-            results = shard.apply_bulk_index_on_primary(docs)
-            refresh_shards.add(shard)
+            return shard, shard.apply_bulk_index_on_primary(docs)
         except EsException as exc:
+            return None, exc
+
+    group_items = list(groups.items())
+    if len(group_items) > 1:
+        outs = list(_bulk_executor().map(run_group, group_items))
+    else:
+        outs = [run_group(g) for g in group_items]
+    for ((index, shard_num), poss), (shard, results) in zip(group_items,
+                                                            outs):
+        if shard is None:
             for p in poss:
                 items[p] = _bulk_error_item("index", index, ops[p]["id"],
-                                            exc)
+                                            results)
             continue
+        refresh_shards.add(shard)
         for p, r in zip(poss, results):
             the_id = ops[p]["id"]
             if isinstance(r, Exception):
@@ -298,6 +312,21 @@ def _apply_index_run(node, ops, positions, items, refresh_shards) -> None:
                 "result": r.result, "_seq_no": r.seq_no,
                 "_primary_term": r.primary_term,
                 "status": 201 if r.created else 200}}
+
+
+_BULK_EXECUTOR = None
+
+
+def _bulk_executor():
+    """Shared pool for concurrent shard-bulk application."""
+    global _BULK_EXECUTOR
+    if _BULK_EXECUTOR is None:
+        import os
+        from concurrent.futures import ThreadPoolExecutor
+        _BULK_EXECUTOR = ThreadPoolExecutor(
+            max_workers=min(8, os.cpu_count() or 1),
+            thread_name_prefix="shard-bulk")
+    return _BULK_EXECUTOR
 
 
 def _bulk_error_item(op, index, the_id, exc) -> Dict[str, Any]:

@@ -155,7 +155,7 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
     # unimplemented keys get a 400, never silently ignored (VERDICT r1
     # weak #1): a sorted/highlighted query must not return wrong results
     # with a 200
-    unsupported = set(body) & {"collapse", "rescore", "script_fields"}
+    unsupported = set(body) & {"script_fields"}
     if unsupported:
         raise IllegalArgumentException(
             f"search body keys {sorted(unsupported)} are not supported "
@@ -164,13 +164,29 @@ def parse_search_body(body: Optional[Dict[str, Any]]):
                            "_source", "min_score", "track_total_hits",
                            "sort", "search_after", "timeout", "pit",
                            "profile", "highlight", "suggest",
-                           "version", "seq_no_primary_term"}
+                           "version", "seq_no_primary_term",
+                           "rescore", "collapse"}
     if unknown:
         raise IllegalArgumentException(
             f"unknown search body keys {sorted(unknown)}")
     query = dsl.parse_query(body.get("query") or {"match_all": {}})
     aggs_spec = body.get("aggs") or body.get("aggregations")
     aggs = parse_aggregations(aggs_spec) if aggs_spec else None
+    if body.get("rescore") is not None:
+        from elasticsearch_tpu.search.rescore import parse_rescore
+        parse_rescore(body["rescore"])  # validate at parse time (400s)
+    if body.get("collapse") is not None:
+        spec = body["collapse"]
+        if not isinstance(spec, dict) or not spec.get("field"):
+            raise IllegalArgumentException("[collapse] requires [field]")
+        if spec.get("inner_hits") is not None:
+            raise IllegalArgumentException(
+                "[collapse] inner_hits is not supported yet")
+        if body.get("sort") is not None or body.get("rescore") is not None:
+            # keep the supported surface honest: collapse composes with
+            # relevance ranking only for now
+            raise IllegalArgumentException(
+                "[collapse] cannot be combined with [sort]/[rescore] yet")
     return query, aggs, body
 
 
@@ -226,6 +242,13 @@ def search(indices: IndicesService, index_expr: Optional[str],
         # suppresses _source
         fetch_source = True if source is False else source
 
+    rescore_specs = None
+    if body.get("rescore") is not None:
+        from elasticsearch_tpu.search.rescore import parse_rescore
+        rescore_specs = parse_rescore(body["rescore"])
+    collapse_field = (body.get("collapse") or {}).get("field") \
+        if body.get("collapse") else None
+
     # ---- TPU fast path: micro-batched kernel over resident packs ----
     # (VERDICT r1 #1: the batched pipeline IS the serving path for the
     # queries it can express; everything else falls through to the
@@ -235,7 +258,8 @@ def search(indices: IndicesService, index_expr: Optional[str],
             and not profile  # profiling instruments the planner path
             and not alias_filters  # filtered aliases run the planner
             and not any(k in body for k in ("sort", "search_after",
-                                            "highlight", "suggest"))):
+                                            "highlight", "suggest",
+                                            "rescore", "collapse"))):
         fast = _search_fast(indices, names, query, tpu_search,
                             size=size, from_=from_, min_score=min_score,
                             source=source, t0=t0,
@@ -271,11 +295,38 @@ def search(indices: IndicesService, index_expr: Optional[str],
                 skipped += 1  # disjoint range stats: skip the shard
                 continue
             q0 = time.perf_counter()
-            res = execute_query(reader, eff_query, size=size + from_,
-                                from_=0,
-                                min_score=min_score, aggs=aggs,
-                                sort_specs=sort_specs or None,
-                                search_after=search_after, ctx=ctx)
+            # the rescore window may exceed the response window
+            k_shard = size + from_
+            if rescore_specs:
+                k_shard = max(k_shard,
+                              max(s.window_size for s in rescore_specs))
+            if collapse_field:
+                # exact grouped top-N per shard (no candidate-depth cap;
+                # a dominating key can't starve later groups)
+                from elasticsearch_tpu.search.collapse import \
+                    collapse_top_groups
+                from elasticsearch_tpu.search.query_phase import \
+                    QuerySearchResult
+                pairs, total_sh = collapse_top_groups(
+                    reader, eff_query, collapse_field, size + from_)
+                res = QuerySearchResult(
+                    [h for h, _ in pairs], total_sh,
+                    pairs[0][0].score if pairs else None)
+                if aggs is not None:
+                    res.aggregations = execute_query(
+                        reader, eff_query, size=0, aggs=aggs,
+                        ctx=ctx).aggregations
+            else:
+                res = execute_query(reader, eff_query, size=k_shard,
+                                    from_=0,
+                                    min_score=min_score, aggs=aggs,
+                                    sort_specs=sort_specs or None,
+                                    search_after=search_after, ctx=ctx)
+            if rescore_specs:
+                from elasticsearch_tpu.search.rescore import \
+                    rescore_shard_hits
+                res.hits = rescore_shard_hits(reader, res.hits,
+                                              rescore_specs)
             elapsed = time.perf_counter() - q0
             query_nanos[(name, shard_num)] = int(elapsed * 1e9)
             if svc.search_slowlog.enabled:
@@ -299,7 +350,28 @@ def search(indices: IndicesService, index_expr: Optional[str],
                 key = -hit.score
             merged.append((key, si, rank, hit))
     merged.sort(key=lambda t: (t[0], t[1], t[2]))
-    window = merged[from_: from_ + size]
+    if collapse_field:
+        # field collapsing (reference: CollapseBuilder): keep the best
+        # hit per key walking the merged ranking; missing-key docs are
+        # not collapsed together
+        seen_keys = set()
+        collapsed = []
+        hit_keys: Dict[int, Any] = {}
+        for entry in merged:
+            _, si, _, hit = entry
+            reader = shard_results[si][2]
+            key = _collapse_key(reader, hit, collapse_field)
+            if key is not None:
+                if key in seen_keys:
+                    continue
+                seen_keys.add(key)
+            hit_keys[id(hit)] = key
+            collapsed.append(entry)
+            if len(collapsed) >= from_ + size:
+                break
+        window = collapsed[from_: from_ + size]
+    else:
+        window = merged[from_: from_ + size]
 
     # ---- fetch phase: group winners by shard ----
     by_shard: Dict[int, List[ShardHit]] = {}
@@ -338,6 +410,10 @@ def search(indices: IndicesService, index_expr: Optional[str],
         doc["_score"] = None if (sort_specs and hit.sort_values) else hit.score
         if hit.sort_values is not None:
             doc["sort"] = hit.sort_values
+        if collapse_field:
+            key = hit_keys.get(id(hit))
+            if key is not None:
+                doc["fields"] = {collapse_field: [key]}
         hits_json.append(doc)
 
     if sort_specs:
@@ -382,6 +458,25 @@ def search(indices: IndicesService, index_expr: Optional[str],
         from elasticsearch_tpu.search.suggest import run_suggest
         out["suggest"] = run_suggest(indices, names, body["suggest"])
     return out
+
+
+def _collapse_key(reader, hit, field: str):
+    """The collapse key of one hit: first doc value of `field` (None =
+    missing → the hit is not collapsed with anything)."""
+    for v in reader.views:
+        if v.segment.name == hit.ref.segment:
+            col = v.segment.doc_values.get(field)
+            if col is None:
+                return None
+            raw = col.values[hit.ref.ord]
+            if col.kind == "ord":
+                return None if raw < 0 else col.ord_terms[int(raw)]
+            from elasticsearch_tpu.index.segment import MISSING_I64
+            if col.kind == "i64":
+                return None if raw == MISSING_I64 else int(raw)
+            import math
+            return None if math.isnan(raw) else float(raw)
+    return None
 
 
 def build_profile(query, shard_results, query_nanos, fetch_nanos
@@ -624,6 +719,7 @@ def search_shard_group(indices: IndicesService,
         if (tpu_search is not None and aggs is None and not sort_specs
                 and search_after is None and k > 0 and min_score is None
                 and not body.get("profile")
+                and not body.get("rescore") and not body.get("collapse")
                 and not (index_filters or {}).get(name)
                 and set(shard_nums) == set(svc.shards.keys())):
             res = tpu_search.try_search(svc, query, k=k,
@@ -643,6 +739,12 @@ def search_shard_group(indices: IndicesService,
                     shard_results.append(("__fast__", name, sn, rank, doc))
         if not used_fast:
             from elasticsearch_tpu.search.can_match import can_match
+            group_rescore = None
+            if body.get("rescore") is not None:
+                from elasticsearch_tpu.search.rescore import parse_rescore
+                group_rescore = parse_rescore(body["rescore"])
+            group_collapse = (body.get("collapse") or {}).get("field") \
+                if body.get("collapse") else None
             for shard_num in sorted(shard_nums):
                 shard = svc.shard(shard_num)
                 reader = shard.acquire_searcher()
@@ -650,10 +752,36 @@ def search_shard_group(indices: IndicesService,
                     group_skipped += 1
                     continue
                 q0 = time.perf_counter()
-                res = execute_query(reader, eff_query, size=k, from_=0,
-                                    min_score=min_score, aggs=aggs,
-                                    sort_specs=sort_specs or None,
-                                    search_after=search_after, ctx=ctx)
+                k_shard = k
+                if group_rescore:
+                    k_shard = max(k_shard, max(s.window_size
+                                               for s in group_rescore))
+                if group_collapse:
+                    from elasticsearch_tpu.search.collapse import \
+                        collapse_top_groups
+                    from elasticsearch_tpu.search.query_phase import \
+                        QuerySearchResult
+                    pairs, total_sh = collapse_top_groups(
+                        reader, eff_query, group_collapse, k)
+                    res = QuerySearchResult(
+                        [h for h, _ in pairs], total_sh,
+                        pairs[0][0].score if pairs else None)
+                    if aggs is not None:
+                        res.aggregations = execute_query(
+                            reader, eff_query, size=0, aggs=aggs,
+                            ctx=ctx).aggregations
+                else:
+                    res = execute_query(reader, eff_query, size=k_shard,
+                                        from_=0,
+                                        min_score=min_score, aggs=aggs,
+                                        sort_specs=sort_specs or None,
+                                        search_after=search_after,
+                                        ctx=ctx)
+                if group_rescore:
+                    from elasticsearch_tpu.search.rescore import \
+                        rescore_shard_hits
+                    res.hits = rescore_shard_hits(reader, res.hits,
+                                                  group_rescore)
                 elapsed = time.perf_counter() - q0
                 group_query_nanos[(name, shard_num)] = int(elapsed * 1e9)
                 group_profile_entries.append((name, shard_num, None, res))
@@ -675,6 +803,10 @@ def search_shard_group(indices: IndicesService,
                     doc["_score"] = hit.score
                     if hit.sort_values is not None:
                         doc["sort"] = hit.sort_values
+                    if group_collapse:
+                        ck = _collapse_key(reader, hit, group_collapse)
+                        if ck is not None:
+                            doc["fields"] = {group_collapse: [ck]}
                     if highlight_spec is not None:
                         from elasticsearch_tpu.search.highlight import \
                             build_highlights
@@ -698,6 +830,8 @@ def search_shard_group(indices: IndicesService,
             key = -(doc.get("_score") or 0.0)
         entries.append((key, name, shard_num, rank, doc))
     entries.sort(key=lambda t: t[:4])
+    # under collapse, each shipped hit is already its shard's best per
+    # key (collapse_top_groups), so k per node suffices
     hits = []
     for key, name, shard_num, rank, doc in entries[:k]:
         hits.append(doc)
@@ -766,7 +900,24 @@ def merge_group_responses(groups: List[Dict[str, Any]],
             merged.append((key, doc.get("_index", ""),
                            doc.pop("__shard", 0), rank, doc))
     merged.sort(key=lambda t: t[:4])
-    window = [doc for _, _, _, _, doc in merged[from_: from_ + size]]
+    collapse_field = (body.get("collapse") or {}).get("field") \
+        if body.get("collapse") else None
+    if collapse_field:
+        seen_keys = set()
+        picked = []
+        for entry in merged:
+            doc = entry[4]
+            key_vals = (doc.get("fields") or {}).get(collapse_field)
+            if key_vals:
+                if key_vals[0] in seen_keys:
+                    continue
+                seen_keys.add(key_vals[0])
+            picked.append(doc)
+            if len(picked) >= from_ + size:
+                break
+        window = picked[from_: from_ + size]
+    else:
+        window = [doc for _, _, _, _, doc in merged[from_: from_ + size]]
 
     if sort_specs:
         only_score = all(s.field == "_score" for s in sort_specs)

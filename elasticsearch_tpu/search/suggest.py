@@ -49,6 +49,8 @@ def _bounded_distance(a: str, b: str, k: int):
 
 
 class TermSuggestSpec:
+    kind = "term"
+
     def __init__(self, name: str, body: Dict[str, Any]):
         self.name = name
         self.text = body.get("text")
@@ -73,10 +75,58 @@ class TermSuggestSpec:
                 f"[term] unknown suggest_mode [{self.suggest_mode}]")
 
 
-def parse_suggest(body: Dict[str, Any]) -> List[TermSuggestSpec]:
+class PhraseSuggestSpec:
+    """Reference: PhraseSuggester — whole-phrase corrections built from
+    per-token candidates, scored by candidate confidence × doc
+    frequency; `max_errors` bounds how many tokens may change;
+    `highlight` wraps changed tokens."""
+
+    kind = "phrase"
+
+    def __init__(self, name: str, body: Dict[str, Any]):
+        self.name = name
+        self.text = body.get("text")
+        spec = body.get("phrase")
+        if self.text is None or not isinstance(spec, dict):
+            raise IllegalArgumentException(
+                f"suggester [{name}] requires [text] and [phrase]")
+        self.field = spec.get("field")
+        if not self.field:
+            raise IllegalArgumentException(
+                f"phrase suggester [{name}] requires [field]")
+        self.size = int(spec.get("size", 5))
+        self.max_errors = float(spec.get("max_errors", 1.0))
+        self.max_edits = 2
+        hl = spec.get("highlight") or {}
+        self.pre_tag = hl.get("pre_tag", "")
+        self.post_tag = hl.get("post_tag", "")
+
+
+class CompletionSuggestSpec:
+    """Reference: CompletionSuggester over a `completion` field —
+    prefix lookup of stored inputs, weight-ranked."""
+
+    kind = "completion"
+
+    def __init__(self, name: str, body: Dict[str, Any]):
+        self.name = name
+        self.prefix = body.get("prefix", body.get("text"))
+        spec = body.get("completion")
+        if self.prefix is None or not isinstance(spec, dict):
+            raise IllegalArgumentException(
+                f"suggester [{name}] requires [prefix] and [completion]")
+        self.field = spec.get("field")
+        if not self.field:
+            raise IllegalArgumentException(
+                f"completion suggester [{name}] requires [field]")
+        self.size = int(spec.get("size", 5))
+        self.skip_duplicates = bool(spec.get("skip_duplicates", False))
+
+
+def parse_suggest(body: Dict[str, Any]) -> List[Any]:
     if not isinstance(body, dict):
         raise IllegalArgumentException("[suggest] must be an object")
-    specs = []
+    specs: List[Any] = []
     global_text = body.get("text")
     for name, spec in body.items():
         if name == "text":
@@ -84,13 +134,19 @@ def parse_suggest(body: Dict[str, Any]) -> List[TermSuggestSpec]:
         if not isinstance(spec, dict):
             raise IllegalArgumentException(
                 f"suggester [{name}] must be an object")
-        if "term" not in spec:
-            raise IllegalArgumentException(
-                f"suggester [{name}]: only the [term] suggester is "
-                f"supported")
-        if "text" not in spec and global_text is not None:
+        if "text" not in spec and "prefix" not in spec \
+                and global_text is not None:
             spec = dict(spec, text=global_text)
-        specs.append(TermSuggestSpec(name, spec))
+        if "term" in spec:
+            specs.append(TermSuggestSpec(name, spec))
+        elif "phrase" in spec:
+            specs.append(PhraseSuggestSpec(name, spec))
+        elif "completion" in spec:
+            specs.append(CompletionSuggestSpec(name, spec))
+        else:
+            raise IllegalArgumentException(
+                f"suggester [{name}]: one of [term], [phrase], "
+                f"[completion] is required")
     return specs
 
 
@@ -125,12 +181,23 @@ def run_suggest(indices, names: List[str],
     specs = parse_suggest(body)
     out: Dict[str, Any] = {}
     freq_cache: Dict[str, Dict[str, int]] = {}
+
+    def freqs_for(field: str) -> Dict[str, int]:
+        f = freq_cache.get(field)
+        if f is None:
+            f = _field_frequencies(indices, names, field, shard_filter)
+            freq_cache[field] = f
+        return f
+
     for spec in specs:
-        freqs = freq_cache.get(spec.field)
-        if freqs is None:
-            freqs = _field_frequencies(indices, names, spec.field,
-                                       shard_filter)
-            freq_cache[spec.field] = freqs
+        if spec.kind == "completion":
+            out[spec.name] = _run_completion(indices, names, spec,
+                                             shard_filter)
+            continue
+        if spec.kind == "phrase":
+            out[spec.name] = _run_phrase(freqs_for(spec.field), spec)
+            continue
+        freqs = freqs_for(spec.field)
         entries = []
         for m in _TOKEN.finditer(str(spec.text)):
             token = m.group(0).lower()
@@ -146,6 +213,134 @@ def run_suggest(indices, names: List[str],
             entries.append(entry)
         out[spec.name] = entries
     return out
+
+
+def _run_phrase(freqs: Dict[str, int],
+                spec: PhraseSuggestSpec) -> List[Dict[str, Any]]:
+    """Beam over per-token candidates (the token itself + close terms),
+    scored by Π token confidence·log-df; at most `max_errors` tokens
+    change (fraction when < 1, absolute otherwise — reference rule)."""
+    import math
+    text = str(spec.text)
+    matches = list(_TOKEN.finditer(text))
+    tokens = [m.group(0).lower() for m in matches]
+    if not tokens:
+        return [{"text": text, "offset": 0, "length": len(text),
+                 "options": []}]
+    max_changes = (max(1, int(round(spec.max_errors * len(tokens))))
+                   if spec.max_errors < 1.0 else int(spec.max_errors))
+
+    shim = TermSuggestSpec("_", {"text": "", "term": {"field": spec.field,
+                                                      "size": 3}})
+    per_token: List[List[Tuple[str, float, bool]]] = []
+    for tok in tokens:
+        df = freqs.get(tok, 0)
+        own_conf = 1.0 if df > 0 else 0.05
+        opts = [(tok, own_conf * math.log1p(df + 1), False)]
+        for cand in _candidates(tok, freqs, shim):
+            opts.append((cand["text"],
+                         cand["score"] * math.log1p(cand["freq"] + 1),
+                         True))
+        per_token.append(opts)
+
+    beams: List[Tuple[List[str], int, float]] = [([], 0, 0.0)]
+    for opts in per_token:
+        nxt = []
+        for terms, changes, score in beams:
+            for term, s, changed in opts:
+                c = changes + (1 if changed else 0)
+                if c > max_changes:
+                    continue
+                nxt.append((terms + [term], c, score + s))
+        nxt.sort(key=lambda b: -b[2])
+        beams = nxt[:20]
+
+    options = []
+    seen = set()
+    for terms, changes, score in beams:
+        if changes == 0:
+            continue  # the input itself is not a suggestion
+        phrase = " ".join(terms)
+        if phrase in seen:
+            continue
+        seen.add(phrase)
+        opt = {"text": phrase,
+               "score": round(score / max(1, len(terms)), 6)}
+        if spec.pre_tag or spec.post_tag:
+            opt["highlighted"] = " ".join(
+                f"{spec.pre_tag}{t}{spec.post_tag}" if t != tokens[i]
+                else t for i, t in enumerate(terms))
+        options.append(opt)
+    options.sort(key=lambda o: (-o["score"], o["text"]))
+    return [{"text": text, "offset": 0, "length": len(text),
+             "options": options[: spec.size]}]
+
+
+def _run_completion(indices, names: List[str],
+                    spec: CompletionSuggestSpec,
+                    shard_filter=None) -> List[Dict[str, Any]]:
+    """Prefix lookup over the completion field's ordinal tables (sorted
+    unique inputs per segment → binary search), weight-ranked."""
+    import bisect
+
+    import numpy as np
+
+    from elasticsearch_tpu.mapping.types import CompletionFieldType
+    prefix = str(spec.prefix)
+    best: Dict[str, float] = {}
+    for name in names:
+        svc = indices.index(name)
+        wanted = (None if shard_filter is None
+                  else set(shard_filter.get(name, ())))
+        for num, shard in sorted(svc.shards.items()):
+            if wanted is not None and num not in wanted:
+                continue
+            reader = shard.acquire_searcher()
+            for view in reader.views:
+                pack = view.pack
+                terms = pack.dv_ord_terms.get(spec.field)
+                col = pack.dv_ord.get(spec.field)
+                if not terms or col is None:
+                    continue
+                # ordinal range of prefix matches: scan from the left
+                # bound while startswith (no string sentinel — a non-BMP
+                # next char would sort past any BMP sentinel)
+                lo = bisect.bisect_left(terms, prefix)
+                hi = lo
+                while hi < len(terms) and terms[hi].startswith(prefix):
+                    hi += 1
+                if lo >= hi:
+                    continue
+                wcol = pack.dv_i64.get(
+                    spec.field + CompletionFieldType.WEIGHT_SUFFIX)
+                live = view.live_mask
+                seg_col = np.asarray(col)
+                n = len(seg_col)
+                warr = None if wcol is None else np.asarray(wcol)
+
+                def record(ord_idx: int, doc: int) -> None:
+                    w = 1.0 if warr is None else float(warr[doc])
+                    t = terms[ord_idx]
+                    if t not in best or w > best[t]:
+                        best[t] = w
+
+                # one pass over the column for all matching ordinals
+                in_range = ((seg_col >= lo) & (seg_col < hi)
+                            & live[:n])
+                for doc in np.nonzero(in_range)[0].tolist():
+                    record(int(seg_col[doc]), doc)
+                # multi-input docs keep extras in the segment column
+                dv = view.segment.doc_values.get(spec.field)
+                if dv is not None and dv.extra:
+                    for d, extra in dv.extra.items():
+                        if d < len(live) and live[d]:
+                            for eo in extra:
+                                if lo <= eo < hi:
+                                    record(int(eo), d)
+    options = [{"text": t, "score": s} for t, s in best.items()]
+    options.sort(key=lambda o: (-o["score"], o["text"]))
+    return [{"text": prefix, "offset": 0, "length": len(prefix),
+             "options": options[: spec.size]}]
 
 
 def merge_suggest(specs: List[TermSuggestSpec],
@@ -173,7 +368,9 @@ def merge_suggest(specs: List[TermSuggestSpec],
                     if existing is None:
                         cur["options"][opt["text"]] = dict(opt)
                     else:
-                        existing["freq"] += opt["freq"]
+                        if "freq" in opt:
+                            existing["freq"] = existing.get("freq", 0) \
+                                + opt["freq"]
                         existing["score"] = max(existing["score"],
                                                 opt["score"])
         size = by_name[name].size
@@ -181,7 +378,8 @@ def merge_suggest(specs: List[TermSuggestSpec],
         for key in order:
             entry = merged_entries[key]
             options = sorted(entry["options"].values(),
-                             key=lambda o: (-o["score"], -o["freq"],
+                             key=lambda o: (-o["score"],
+                                            -o.get("freq", 0),
                                             o["text"]))[: size]
             out[name].append({"text": entry["text"],
                               "offset": entry["offset"],

@@ -1,0 +1,136 @@
+"""Query rescorer + field collapsing (reference: QueryRescorer,
+CollapseBuilder; SURVEY.md §2.1#50)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+def _h(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture()
+def seeded(node):
+    s, b = _h(node, "PUT", "/m", body={
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "body": {"type": "text"}, "boosted": {"type": "text"},
+            "group": {"type": "keyword"}, "rank": {"type": "integer"}}}})
+    assert s == 200, b
+    docs = {
+        "1": {"body": "alpha alpha alpha", "boosted": "nothing",
+              "group": "g1", "rank": 1},
+        "2": {"body": "alpha alpha", "boosted": "special",
+              "group": "g1", "rank": 2},
+        "3": {"body": "alpha", "boosted": "special", "group": "g2",
+              "rank": 3},
+        "4": {"body": "alpha beta", "boosted": "nothing", "group": "g2",
+              "rank": 4},
+        "5": {"body": "gamma", "boosted": "special", "group": "g3",
+              "rank": 5},
+    }
+    for i, src in docs.items():
+        _h(node, "PUT", f"/m/_doc/{i}", body=src)
+    _h(node, "POST", "/m/_refresh")
+    return node
+
+
+class TestRescore:
+    def test_rescore_promotes_matches(self, seeded):
+        base = {"query": {"match": {"body": "alpha"}}, "size": 4}
+        s, plain = _h(seeded, "POST", "/m/_search", body=dict(base))
+        assert s == 200 and plain["hits"]["hits"][0]["_id"] == "1"
+        s, r = _h(seeded, "POST", "/m/_search", body={
+            **base,
+            "rescore": {"window_size": 10, "query": {
+                "rescore_query": {"match": {"boosted": "special"}},
+                "rescore_query_weight": 100.0}}})
+        assert s == 200, r
+        top2 = {h["_id"] for h in r["hits"]["hits"][:2]}
+        assert top2 == {"2", "3"}, r["hits"]["hits"]
+        # unmatched docs keep query_weight * original
+        scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert scores["1"] == pytest.approx(
+            {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}["1"])
+
+    def test_rescore_window_limits_scope(self, node):
+        # windows are PER SHARD (reference semantics) — single shard
+        # makes it deterministic: window 1 touches only the top hit,
+        # which doesn't match the rescore query, so ranks are unchanged
+        s, b = _h(node, "PUT", "/w", body={
+            "settings": {"number_of_shards": 1},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "boosted": {"type": "text"}}}})
+        assert s == 200, b
+        _h(node, "PUT", "/w/_doc/1",
+           body={"body": "alpha alpha alpha", "boosted": "nothing"})
+        _h(node, "PUT", "/w/_doc/2",
+           body={"body": "alpha", "boosted": "special"})
+        _h(node, "POST", "/w/_refresh")
+        s, r = _h(node, "POST", "/w/_search", body={
+            "query": {"match": {"body": "alpha"}}, "size": 4,
+            "rescore": {"window_size": 1, "query": {
+                "rescore_query": {"match": {"boosted": "special"}},
+                "rescore_query_weight": 100.0}}})
+        assert s == 200, r
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1", "2"], r
+        # window 10 re-ranks doc 2 to the top
+        s, r = _h(node, "POST", "/w/_search", body={
+            "query": {"match": {"body": "alpha"}}, "size": 4,
+            "rescore": {"window_size": 10, "query": {
+                "rescore_query": {"match": {"boosted": "special"}},
+                "rescore_query_weight": 100.0}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["2", "1"], r
+
+    def test_rescore_validation(self, seeded):
+        s, r = _h(seeded, "POST", "/m/_search", body={
+            "query": {"match_all": {}},
+            "rescore": {"query": {"rescore_query": {"match_all": {}},
+                                  "score_mode": "nope"}}})
+        assert s == 400, r
+
+
+class TestCollapse:
+    def test_collapse_keeps_best_per_group(self, seeded):
+        s, r = _h(seeded, "POST", "/m/_search", body={
+            "query": {"match": {"body": "alpha"}}, "size": 10,
+            "collapse": {"field": "group"}})
+        assert s == 200, r
+        hits = r["hits"]["hits"]
+        ids = [h["_id"] for h in hits]
+        assert ids == ["1", "4"], hits  # best of g1, best of g2
+        assert hits[0]["fields"] == {"group": ["g1"]}
+        # total is NOT collapsed (reference behavior)
+        assert r["hits"]["total"]["value"] == 4
+
+    def test_collapse_numeric_field(self, seeded):
+        s, r = _h(seeded, "POST", "/m/_search", body={
+            "query": {"match_all": {}}, "size": 10,
+            "collapse": {"field": "rank"}})
+        assert s == 200, r
+        assert len(r["hits"]["hits"]) == 5  # all ranks distinct
+
+    def test_collapse_rejects_inner_hits_and_sort(self, seeded):
+        s, r = _h(seeded, "POST", "/m/_search", body={
+            "query": {"match_all": {}},
+            "collapse": {"field": "group", "inner_hits": {}}})
+        assert s == 400, r
+        s, r = _h(seeded, "POST", "/m/_search", body={
+            "query": {"match_all": {}}, "sort": [{"rank": "asc"}],
+            "collapse": {"field": "group"}})
+        assert s == 400, r

@@ -83,7 +83,11 @@ class TestTermSuggest:
         status, _ = _handle(corpus, "POST", "/s/_search", body={
             "suggest": {"fix": {"text": "x",
                                 "phrase": {"field": "body"}}}})
-        assert status == 400  # only term suggester
+        assert status == 200  # the phrase suggester is supported now
+        status, _ = _handle(corpus, "POST", "/s/_search", body={
+            "suggest": {"fix": {"text": "x",
+                                "nope": {"field": "body"}}}})
+        assert status == 400  # unknown suggester kind
         status, _ = _handle(corpus, "POST", "/s/_search", body={
             "suggest": {"fix": {"text": "x", "term": {
                 "field": "body", "max_edits": 5}}}})

@@ -1,0 +1,115 @@
+"""Phrase + completion suggesters (reference: PhraseSuggester,
+CompletionSuggester/CompletionFieldMapper; SURVEY.md §2.1#50)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+def _h(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture()
+def seeded(node):
+    s, b = _h(node, "PUT", "/s", body={
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "body": {"type": "text"},
+            "sugg": {"type": "completion"}}}})
+    assert s == 200, b
+    docs = [
+        {"body": "the quick brown fox", "sugg": ["quick fox"]},
+        {"body": "quick brown foxes run", "sugg": {"input":
+            ["quick brown", "quiet night"], "weight": 5}},
+        {"body": "brown bears sleep", "sugg": "brown bear"},
+        {"body": "quick quick quick", "sugg": ["quorum call"]},
+    ]
+    for i, src in enumerate(docs):
+        _h(node, "PUT", f"/s/_doc/{i}", body=src)
+    _h(node, "POST", "/s/_refresh")
+    return node
+
+
+class TestPhrase:
+    def test_phrase_corrects_typos(self, seeded):
+        s, r = _h(seeded, "POST", "/s/_search", body={
+            "size": 0, "suggest": {"fix": {
+                "text": "quick browm fox",
+                "phrase": {"field": "body", "size": 3}}}})
+        assert s == 200, r
+        opts = r["suggest"]["fix"][0]["options"]
+        assert opts, r["suggest"]
+        assert opts[0]["text"] == "quick brown fox", opts
+
+    def test_phrase_highlight_and_max_errors(self, seeded):
+        s, r = _h(seeded, "POST", "/s/_search", body={
+            "size": 0, "suggest": {"fix": {
+                "text": "quick browm foxs",
+                "phrase": {"field": "body", "max_errors": 2,
+                           "highlight": {"pre_tag": "<em>",
+                                         "post_tag": "</em>"}}}}})
+        assert s == 200, r
+        opts = r["suggest"]["fix"][0]["options"]
+        assert any(o["text"] == "quick brown fox" for o in opts), opts
+        top = opts[0]
+        assert "<em>" in top["highlighted"], top
+        assert not top["highlighted"].startswith("<em>quick"), top
+
+    def test_phrase_no_correction_needed(self, seeded):
+        s, r = _h(seeded, "POST", "/s/_search", body={
+            "size": 0, "suggest": {"fix": {
+                "text": "zzzzqqq",
+                "phrase": {"field": "body"}}}})
+        assert s == 200, r
+
+
+class TestCompletion:
+    def test_prefix_lookup_weight_ranked(self, seeded):
+        s, r = _h(seeded, "POST", "/s/_search", body={
+            "size": 0, "suggest": {"c": {
+                "prefix": "qui",
+                "completion": {"field": "sugg"}}}})
+        assert s == 200, r
+        opts = r["suggest"]["c"][0]["options"]
+        texts = [o["text"] for o in opts]
+        # weight 5 inputs rank first; then weight-1, text asc
+        assert texts[0] in ("quick brown", "quiet night"), opts
+        assert set(texts) == {"quick brown", "quiet night", "quick fox"}, \
+            opts
+
+    def test_prefix_no_match(self, seeded):
+        s, r = _h(seeded, "POST", "/s/_search", body={
+            "size": 0, "suggest": {"c": {
+                "prefix": "zebra", "completion": {"field": "sugg"}}}})
+        assert s == 200, r
+        assert r["suggest"]["c"][0]["options"] == []
+
+    def test_completion_survives_restart(self, seeded, tmp_path):
+        _h(seeded, "POST", "/s/_flush")
+        seeded.close()
+        node2 = Node(str(tmp_path / "data"), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            s, r = _h(node2, "POST", "/s/_search", body={
+                "size": 0, "suggest": {"c": {
+                    "prefix": "bro", "completion": {"field": "sugg"}}}})
+            assert s == 200, r
+            assert [o["text"] for o in r["suggest"]["c"][0]["options"]] \
+                == ["brown bear"], r["suggest"]
+        finally:
+            node2.close()
