@@ -1,0 +1,239 @@
+"""Cross-cluster search — the DCN federation tier.
+
+Reference: `transport/RemoteClusterService` + CCS in
+`TransportSearchAction` (SURVEY.md §2.1 P8, §5.8): remote clusters
+register under `cluster.remote.<alias>.seeds`; index expressions name
+them as `alias:index`; the coordinating node fans the search out over
+the inter-cluster (DCN) link and merges, reporting a `_clusters`
+section. Remote hits carry `alias:index` in `_index`.
+
+Scope kept honest: relevance-ranked queries (score merge). Aggs, sort,
+suggest, collapse, rescore and scroll/pit across clusters 400 instead of
+returning silently-wrong merges. `skip_unavailable: true` turns a dead
+remote into `_clusters.skipped` instead of an error."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (EsException,
+                                             IllegalArgumentException)
+
+ACTION_REMOTE_SEARCH = "indices/data/remote_search"
+
+_CCS_UNSUPPORTED = ("aggs", "aggregations", "sort", "search_after",
+                    "suggest", "collapse", "rescore", "pit", "highlight")
+
+
+def remote_clusters(node) -> Dict[str, Dict[str, Any]]:
+    """alias → {"seeds": [(host, port), ...], "skip_unavailable": bool,
+    "error": str|None} from the live (node + dynamic cluster) settings.
+    Parsing is LENIENT per alias: a malformed entry gets an `error` that
+    surfaces only when THAT alias is targeted — it never breaks searches
+    against healthy remotes."""
+    out: Dict[str, Dict[str, Any]] = {}
+    flat = node.settings.get_as_dict()
+    prefix = "cluster.remote."
+    for key, value in flat.items():
+        if not key.startswith(prefix):
+            continue
+        rest = key[len(prefix):]
+        alias, _, prop = rest.partition(".")
+        entry = out.setdefault(alias, {"seeds": [],
+                                       "skip_unavailable": False,
+                                       "error": None})
+        if prop == "seeds":
+            seeds = value if isinstance(value, list) else \
+                [s.strip() for s in str(value).split(",") if s.strip()]
+            parsed = []
+            for s in seeds:
+                host, _, port = str(s).rpartition(":")
+                if not host or not port.isdigit():
+                    entry["error"] = (f"invalid remote seed [{s}] for "
+                                      f"[{alias}]")
+                    break
+                parsed.append((host, int(port)))
+            entry["seeds"] = parsed
+        elif prop == "skip_unavailable":
+            entry["skip_unavailable"] = str(value).lower() == "true"
+    return {a: e for a, e in out.items() if e["seeds"] or e["error"]}
+
+
+def split_expression(expr: str, remotes: Dict[str, Any]
+                     ) -> Tuple[Optional[str], Dict[str, str]]:
+    """`"local,b:logs,c:*"` → ("local", {"b": "logs", "c": "*"})."""
+    local_parts: List[str] = []
+    remote_parts: Dict[str, List[str]] = {}
+    for part in expr.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            alias, _, rest = part.partition(":")
+            if alias not in remotes:
+                raise IllegalArgumentException(
+                    f"no such remote cluster: [{alias}]")
+            remote_parts.setdefault(alias, []).append(rest)
+        else:
+            local_parts.append(part)
+    return (",".join(local_parts) or None,
+            {a: ",".join(ps) for a, ps in remote_parts.items()})
+
+
+def _transport(node):
+    if node.cluster is not None:
+        return node.cluster.transport
+    client = getattr(node, "_ccs_transport", None)
+    if client is None:
+        from elasticsearch_tpu.transport.service import TransportService
+        client = TransportService(local_node={
+            "node_id": node.node_id, "name": node.node_name})
+        node._ccs_transport = client  # outbound-only; no start()
+    return client
+
+
+def handle_remote_search(node, payload: Dict[str, Any],
+                         from_node) -> Dict[str, Any]:
+    """The remote side of CCS: run the search locally, full response.
+    The work registers with the remote's task manager so it is visible
+    (cross-cluster cancellation propagation is not wired yet)."""
+    from elasticsearch_tpu.search import coordinator
+    index = payload.get("index")
+    body = payload.get("body") or {}
+    params = payload.get("params") or {}
+    task = node.task_manager.register(
+        "indices:data/read/search[ccs]",
+        description=f"remote search indices[{index}] from "
+                    f"[{(from_node or {}).get('name', '?')}]")
+    try:
+        if node.cluster is not None:
+            return node.cluster.route_search(index, body, params,
+                                             task=task)
+        return coordinator.search(node.indices, index, body, params,
+                                  tpu_search=getattr(node, "tpu_search",
+                                                     None), task=task)
+    finally:
+        node.task_manager.unregister(task)
+
+
+def maybe_cross_cluster(node, index_expr: Optional[str],
+                        body: Optional[Dict[str, Any]],
+                        params: Optional[Dict[str, str]],
+                        task=None) -> Optional[Dict[str, Any]]:
+    """None ⇒ purely local expression; otherwise the full federated
+    response."""
+    if not index_expr or ":" not in index_expr:
+        return None
+    remotes = remote_clusters(node)
+    local_expr, remote_exprs = split_expression(index_expr, remotes)
+    if not remote_exprs:
+        return None
+    body = dict(body or {})
+    params = dict(params or {})
+    bad = sorted(set(body) & set(_CCS_UNSUPPORTED))
+    if bad or params.get("scroll"):
+        raise IllegalArgumentException(
+            f"search body keys {bad or ['scroll']} are not supported "
+            f"across clusters yet")
+    import time
+    t0 = time.perf_counter()
+    size = int(params.pop("size", body.get("size", 10)))
+    from_ = int(params.pop("from", body.get("from", 0)))
+    sub_body = dict(body, size=size + from_)
+    sub_body.pop("from", None)
+
+    for alias in remote_exprs:
+        err = remotes[alias].get("error")
+        if err:  # a targeted alias with a malformed registration
+            raise IllegalArgumentException(err)
+
+    transport = _transport(node)
+    payload_of = {alias: {"index": expr, "body": sub_body,
+                          "params": params}
+                  for alias, expr in remote_exprs.items()}
+    futures = []
+    for alias in sorted(remote_exprs):
+        entry = remotes[alias]
+        futures.append((alias, entry, 0,
+                        transport.send_request_async(
+                            entry["seeds"][0], ACTION_REMOTE_SEARCH,
+                            payload_of[alias])))
+
+    responses: List[Tuple[str, Dict[str, Any]]] = []
+    skipped = 0
+    n_clusters = len(remote_exprs) + (1 if local_expr else 0)
+    if local_expr:
+        from elasticsearch_tpu.search import coordinator
+        if node.cluster is not None:
+            local = node.cluster.route_search(local_expr, sub_body,
+                                              params, task=task)
+        else:
+            local = coordinator.search(
+                node.indices, local_expr, sub_body, params,
+                tpu_search=getattr(node, "tpu_search", None), task=task)
+        responses.append(("", local))
+
+    from elasticsearch_tpu.transport.service import \
+        RemoteTransportException
+    deadline = time.monotonic() + 30.0  # ONE deadline across remotes
+    for alias, entry, seed_idx, fut in futures:
+        while True:
+            try:
+                responses.append((alias, fut.result(
+                    timeout=max(0.5, deadline - time.monotonic()))))
+                break
+            except RemoteTransportException as exc:
+                # the remote RAN the search and errored (bad index, bad
+                # query) — an application error, never "unavailable"
+                raise IllegalArgumentException(
+                    f"remote cluster [{alias}] search failed "
+                    f"[{exc.error_type}]: {exc.reason}") from exc
+            except EsException:
+                raise
+            except Exception as exc:  # noqa: BLE001 — connectivity
+                seed_idx += 1
+                if seed_idx < len(entry["seeds"]) \
+                        and time.monotonic() < deadline:
+                    fut = transport.send_request_async(  # next seed
+                        entry["seeds"][seed_idx], ACTION_REMOTE_SEARCH,
+                        payload_of[alias])
+                    continue
+                if not entry.get("skip_unavailable"):
+                    raise IllegalArgumentException(
+                        f"remote cluster [{alias}] is unavailable: "
+                        f"{exc}") from exc
+                skipped += 1
+                break
+
+    merged: List[Tuple[float, int, int, Dict[str, Any]]] = []
+    total = 0
+    relation = "eq"
+    shards = {"total": 0, "successful": 0, "skipped": 0, "failed": 0}
+    timed_out = False
+    for ci, (alias, resp) in enumerate(responses):
+        hits = resp.get("hits") or {}
+        tot = hits.get("total") or {}
+        total += int(tot.get("value", 0))
+        if tot.get("relation") == "gte":
+            relation = "gte"
+        for key in shards:
+            shards[key] += int((resp.get("_shards") or {}).get(key, 0))
+        timed_out = timed_out or bool(resp.get("timed_out"))
+        for rank, doc in enumerate(hits.get("hits") or []):
+            if alias:
+                doc["_index"] = f"{alias}:{doc.get('_index', '')}"
+            merged.append((-(doc.get("_score") or 0.0), ci, rank, doc))
+    merged.sort(key=lambda t: t[:3])
+    window = [doc for _, _, _, doc in merged[from_: from_ + size]]
+    max_score = -merged[0][0] if merged else None
+    return {
+        "took": int((time.perf_counter() - t0) * 1000),
+        "timed_out": timed_out,
+        "_shards": shards,
+        "_clusters": {"total": n_clusters,
+                      "successful": n_clusters - skipped,
+                      "skipped": skipped},
+        "hits": {"total": {"value": total, "relation": relation},
+                 "max_score": max_score,
+                 "hits": window},
+    }

@@ -79,9 +79,11 @@ ACTION_DELETE_PIPELINE = "cluster/admin/delete_pipeline"
 # cluster-wide settings this build can apply at runtime (reference:
 # ClusterSettings registry of Dynamic-flagged settings)
 DYNAMIC_CLUSTER_SETTINGS = ("action.auto_create_index",)
-DYNAMIC_CLUSTER_PREFIXES = ("logger.",)
+DYNAMIC_CLUSTER_PREFIXES = ("logger.", "cluster.remote.")
 ACTION_SHARD_STARTED = "cluster/shard/started"
 ACTION_SHARD_FAILED = "cluster/shard/failed"
+
+from elasticsearch_tpu.ccs import ACTION_REMOTE_SEARCH  # noqa: E402
 
 _RECOVERY_CHUNK = 1 << 20  # 1MB file-copy chunks
 
@@ -254,6 +256,7 @@ class ClusterService:
                 (ACTION_DOC_OP, self._handle_doc_op),
                 (ACTION_BULK, self._handle_bulk_group),
                 (ACTION_QUERY_GROUP, self._handle_query_group),
+                (ACTION_REMOTE_SEARCH, self._handle_remote_search),
                 (ACTION_MAINTENANCE, self._handle_maintenance),
                 (ACTION_COUNT_GROUP, self._handle_count_group),
                 (ACTION_CREATE_INDEX, self._handle_create_index),
@@ -1471,6 +1474,12 @@ class ClusterService:
                                node_id, exc)
         return coord.merge_group_responses(groups, body, params, t0,
                                            failed_shards=failed)
+
+    def _handle_remote_search(self, payload, from_node) -> Dict[str, Any]:
+        """CCS target side (reference: the remote half of
+        TransportSearchAction's cross-cluster fan-out)."""
+        from elasticsearch_tpu import ccs
+        return ccs.handle_remote_search(self.node, payload, from_node)
 
     def _handle_query_group(self, payload, from_node) -> Dict[str, Any]:
         from elasticsearch_tpu.search import coordinator as coord

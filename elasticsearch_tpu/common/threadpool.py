@@ -1,0 +1,126 @@
+"""Named bounded executors + admission control.
+
+Reference: `threadpool/ThreadPool` + `EsExecutors` +
+`EsRejectedExecutionException` (SURVEY.md §2.1#44): every request class
+runs under a NAMED pool with a bounded worker count and a bounded queue;
+when both are full the request is REJECTED (429) instead of piling up
+threads — the node sheds load instead of melting.
+
+Here requests execute on their transport/HTTP thread (the heavy work is
+on-device), so a pool is an admission gate: `size` concurrent executions,
+up to `queue_size` waiters, reject beyond. Same observable contract:
+bounded concurrency, bounded wait depth, typed rejection, per-pool
+active/queue/rejected/completed stats in _nodes/stats."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+from elasticsearch_tpu.common.errors import EsRejectedExecutionException
+
+
+class ThreadPool:
+    """One named admission pool: bounded active slots + bounded queue."""
+
+    def __init__(self, name: str, size: int, queue_size: int):
+        self.name = name
+        self.size = max(1, int(size))
+        self.queue_size = max(0, int(queue_size))
+        self._cv = threading.Condition()
+        self._tls = threading.local()
+        self.active = 0
+        self.queued = 0
+        self.rejected = 0
+        self.completed = 0
+
+    @contextlib.contextmanager
+    def execute(self):
+        # reentrancy: a thread already holding a slot (a handler
+        # re-entering the dispatch layer for an internal sub-request)
+        # must not consume — or deadlock on — a second one; admission
+        # gates the OUTERMOST request only
+        if getattr(self._tls, "depth", 0) > 0:
+            self._tls.depth += 1
+            try:
+                yield
+            finally:
+                self._tls.depth -= 1
+            return
+        with self._cv:
+            if self.active >= self.size:
+                if self.queued >= self.queue_size:
+                    self.rejected += 1
+                    raise EsRejectedExecutionException(
+                        f"rejected execution on [{self.name}]: "
+                        f"{self.active} active, queue capacity "
+                        f"{self.queue_size} full")
+                self.queued += 1
+                try:
+                    while self.active >= self.size:
+                        self._cv.wait()
+                finally:
+                    self.queued -= 1
+            self.active += 1
+        self._tls.depth = 1
+        try:
+            yield
+        finally:
+            self._tls.depth = 0
+            with self._cv:
+                self.active -= 1
+                self.completed += 1
+                self._cv.notify()
+
+    def stats(self) -> Dict[str, int]:
+        with self._cv:
+            return {"threads": self.size, "queue_size": self.queue_size,
+                    "active": self.active, "queue": self.queued,
+                    "rejected": self.rejected,
+                    "completed": self.completed}
+
+
+class ThreadPools:
+    """The node's named pools (reference defaults, scaled to the host):
+    search (cpu·3/2+1, queue 1000), write (cpu, queue 10000), get (cpu,
+    queue 1000); everything else is unpooled management work. Sizes come
+    from `thread_pool.<name>.{size,queue_size}` settings."""
+
+    # search differs from the reference's cpu·3/2+1: reference search
+    # threads are CPU-bound scorers, ours mostly PARK on a micro-batch
+    # future while the device scores — a parked waiter costs a thread,
+    # not a core. Size for two pipelined full kernel batches (2×128)
+    # plus planner headroom; the queue still bounds pile-up beyond that.
+    DEFAULTS = {
+        "search": (lambda cpu: max(cpu * 3 // 2 + 1, 384), 1000),
+        "write": (lambda cpu: max(cpu, 8), 10000),
+        "get": (lambda cpu: max(cpu, 8), 1000),
+    }
+
+    def __init__(self, settings=None):
+        import os
+        cpu = os.cpu_count() or 1
+        self.pools: Dict[str, ThreadPool] = {}
+        for name, (size_fn, queue) in self.DEFAULTS.items():
+            size = size_fn(cpu)
+            if settings is not None:
+                size = settings.get_int(f"thread_pool.{name}.size", size)
+                queue = settings.get_int(
+                    f"thread_pool.{name}.queue_size", queue)
+            self.pools[name] = ThreadPool(name, size, queue)
+
+    def get(self, name: str) -> Optional[ThreadPool]:
+        return self.pools.get(name)
+
+    @contextlib.contextmanager
+    def execute(self, name: Optional[str]):
+        pool = self.pools.get(name) if name else None
+        if pool is None:
+            yield
+            return
+        with pool.execute():
+            yield
+
+    def stats(self) -> Dict[str, Any]:
+        return {name: p.stats() for name, p in self.pools.items()}

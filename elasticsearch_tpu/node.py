@@ -101,7 +101,10 @@ class Node:
                     "search.tpu_serving.max_batch", 64),
                 batch_timeout_s=self.settings.get_float(
                     "search.tpu_serving.batch_timeout_seconds", 30.0))
+        from elasticsearch_tpu.common.threadpool import ThreadPools
+        self.thread_pools = ThreadPools(self.settings)
         self.controller = RestController()
+        self.controller.thread_pools = self.thread_pools
         self._register_actions()
         self._refresh_interval = self.settings.get_float(
             "index.refresh_interval_seconds", 1.0)
@@ -337,6 +340,9 @@ class Node:
             self.cluster.close()
         if self.tpu_search is not None:
             self.tpu_search.close()
+        ccs_client = getattr(self, "_ccs_transport", None)
+        if ccs_client is not None:
+            ccs_client.close()
         self.indices.close()
 
     # ---------------- in-process dispatch (tests + http) ----------------

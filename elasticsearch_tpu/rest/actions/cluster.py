@@ -113,6 +113,9 @@ def register(controller: RestController, node) -> None:
         if node.tpu_search is not None:
             out["nodes"][node.node_id]["tpu_search"] = \
                 node.tpu_search.stats()
+        if getattr(node, "thread_pools", None) is not None:
+            out["nodes"][node.node_id]["thread_pool"] = \
+                node.thread_pools.stats()
         if getattr(node, "breakers", None) is not None:
             # the service's own stats() — includes the PARENT breaker,
             # the signal the hierarchy exists for

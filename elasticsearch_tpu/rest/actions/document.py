@@ -323,8 +323,10 @@ def _bulk_executor():
     if _BULK_EXECUTOR is None:
         import os
         from concurrent.futures import ThreadPoolExecutor
+        # floor of 4: shard bulks overlap on GIL-releasing work (native
+        # analysis, translog I/O, numpy) even on small host cpu counts
         _BULK_EXECUTOR = ThreadPoolExecutor(
-            max_workers=min(8, os.cpu_count() or 1),
+            max_workers=min(8, max(4, os.cpu_count() or 1)),
             thread_name_prefix="shard-bulk")
     return _BULK_EXECUTOR
 

@@ -23,6 +23,11 @@ def register(controller: RestController, node) -> None:
                 raise IllegalArgumentException(
                     "[pit] must be an object with an [id]")
             return scroll_mod.search_pit(node, body, params, task=task)
+        from elasticsearch_tpu import ccs
+        federated = ccs.maybe_cross_cluster(node, index, body, params,
+                                            task=task)
+        if federated is not None:
+            return federated
         if node.cluster is not None:
             return node.cluster.route_search(index, body, params,
                                              task=task)

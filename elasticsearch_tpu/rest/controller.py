@@ -87,9 +87,39 @@ def error_body(exc: Exception, status: int) -> Dict[str, Any]:
     return {"error": {"root_cause": [cause], **cause}, "status": status}
 
 
+_SEARCH_SUFFIXES = ("_search", "_msearch", "_count", "_search_shards",
+                    "_rank_eval")
+_WRITE_SUFFIXES = ("_bulk", "_update_by_query", "_delete_by_query",
+                   "_reindex")
+_GET_SUFFIXES = ("_mget",)
+
+
+def classify_pool(method: str, path: str) -> str:
+    """Route → named thread pool (reference: each ActionType declares
+    its executor). Doc CRUD is checked FIRST by position — an _id that
+    happens to spell an endpoint name (`GET /idx/_doc/_search`) must not
+    misroute — then API suffixes at their actual position (last segment;
+    `_search/scroll` is the only two-segment tail). Management runs
+    unpooled."""
+    parts = path.strip("/").split("/")
+    if len(parts) >= 2 and parts[1] in ("_doc", "_create", "_update"):
+        return "get" if method in ("GET", "HEAD") else "write"
+    last = parts[-1]
+    if last in _SEARCH_SUFFIXES or (
+            len(parts) >= 2 and parts[-2] == "_search"):
+        return "search"
+    if last in _WRITE_SUFFIXES:
+        return "write"
+    if last in _GET_SUFFIXES:
+        return "get"
+    return ""
+
+
 class RestController:
     def __init__(self):
         self._root = _TrieNode()
+        # set by the node: ThreadPools admission gates per request class
+        self.thread_pools = None
 
     def register(self, method: str, template: str, handler: Handler) -> None:
         node = self._root
@@ -143,6 +173,10 @@ class RestController:
         params.update(path_params)
         req = RestRequest(method.upper(), path, params, body, raw_body)
         try:
+            if self.thread_pools is not None:
+                with self.thread_pools.execute(
+                        classify_pool(method.upper(), path)):
+                    return handler(req)
             return handler(req)
         except Exception as exc:  # noqa: BLE001 — REST boundary
             status = error_status(exc)

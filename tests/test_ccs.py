@@ -1,0 +1,139 @@
+"""Cross-cluster search (reference: RemoteClusterService + CCS in
+TransportSearchAction; SURVEY.md P8/§5.8 — the DCN federation tier)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _h(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+def _mk_cluster_node(tmp_path, name, port):
+    node = Node(str(tmp_path / name), node_name=name,
+                settings=Settings.of(
+                    {"search.tpu_serving.enabled": "false"}))
+    node.start_cluster(transport_port=port,
+                       seed_hosts=[("127.0.0.1", port)],
+                       initial_master_nodes=[name])
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if node.cluster.coordinator.is_master():
+            return node
+        time.sleep(0.1)
+    raise AssertionError("single-node cluster did not elect itself")
+
+
+@pytest.fixture()
+def two_clusters(tmp_path):
+    pa, pb = _free_ports(2)
+    a = _mk_cluster_node(tmp_path, "a-node", pa)
+    b = _mk_cluster_node(tmp_path, "b-node", pb)
+    # seed data on both
+    for node, idx, text in ((a, "logs", "alpha local event"),
+                            (b, "logs", "alpha remote event")):
+        s, r = _h(node, "PUT", f"/{idx}", body={
+            "settings": {"number_of_shards": 1},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        assert s == 200, r
+        for i in range(3):
+            _h(node, "PUT", f"/{idx}/_doc/{i}",
+               body={"body": f"{text} {i}"})
+        _h(node, "POST", f"/{idx}/_refresh")
+    # register b as a remote of a
+    s, r = _h(a, "PUT", "/_cluster/settings", body={
+        "persistent": {"cluster": {"remote": {"b": {
+            "seeds": [f"127.0.0.1:{pb}"]}}}}})
+    assert s == 200, r
+    from elasticsearch_tpu import ccs
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if "b" in ccs.remote_clusters(a):
+            break
+        time.sleep(0.1)  # the settings applier runs async
+    assert "b" in ccs.remote_clusters(a)
+    yield a, b, pb
+    a.close()
+    b.close()
+
+
+def test_remote_only_search(two_clusters):
+    a, b, _pb = two_clusters
+    s, r = _h(a, "POST", "/b:logs/_search",
+              body={"query": {"match": {"body": "remote"}}, "size": 10})
+    assert s == 200, r
+    assert r["hits"]["total"]["value"] == 3
+    assert all(h["_index"] == "b:logs" for h in r["hits"]["hits"])
+    assert r["_clusters"] == {"total": 1, "successful": 1, "skipped": 0}
+
+
+def test_mixed_local_and_remote(two_clusters):
+    a, b, _pb = two_clusters
+    s, r = _h(a, "POST", "/logs,b:logs/_search",
+              body={"query": {"match": {"body": "alpha"}}, "size": 10})
+    assert s == 200, r
+    assert r["hits"]["total"]["value"] == 6
+    indices = {h["_index"] for h in r["hits"]["hits"]}
+    assert indices == {"logs", "b:logs"}
+    assert r["_clusters"]["total"] == 2
+
+
+def test_unknown_remote_400(two_clusters):
+    a, _b, _pb = two_clusters
+    s, r = _h(a, "POST", "/nope:logs/_search",
+              body={"query": {"match_all": {}}})
+    assert s == 400 and "no such remote cluster" in json.dumps(r), r
+
+
+def test_unsupported_body_400(two_clusters):
+    a, _b, _pb = two_clusters
+    s, r = _h(a, "POST", "/b:logs/_search",
+              body={"query": {"match_all": {}},
+                    "aggs": {"t": {"terms": {"field": "body"}}}})
+    assert s == 400, r
+
+
+def test_dead_remote_errors_then_skips(two_clusters, tmp_path):
+    a, b, pb = two_clusters
+    b.close()
+    time.sleep(0.3)
+    s, r = _h(a, "POST", "/b:logs/_search",
+              body={"query": {"match_all": {}}})
+    assert s == 400 and "unavailable" in json.dumps(r), r
+    # skip_unavailable: the dead remote degrades to _clusters.skipped
+    s, r = _h(a, "PUT", "/_cluster/settings", body={
+        "persistent": {"cluster": {"remote": {"b": {
+            "skip_unavailable": True}}}}})
+    assert s == 200, r
+    from elasticsearch_tpu import ccs
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ccs.remote_clusters(a).get("b", {}).get("skip_unavailable"):
+            break
+        time.sleep(0.1)  # the settings applier runs async
+    s, r = _h(a, "POST", "/logs,b:logs/_search",
+              body={"query": {"match": {"body": "alpha"}}, "size": 10})
+    assert s == 200, r
+    assert r["_clusters"]["skipped"] == 1
+    assert r["hits"]["total"]["value"] == 3  # local only

@@ -1,0 +1,124 @@
+"""Named bounded executors + admission control (reference: ThreadPool /
+EsExecutors / EsRejectedExecutionException; SURVEY.md §2.1#44)."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from elasticsearch_tpu.common.errors import EsRejectedExecutionException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.threadpool import ThreadPool, ThreadPools
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import classify_pool
+
+
+class TestThreadPool:
+    def test_bounded_queue_rejects(self):
+        pool = ThreadPool("t", size=1, queue_size=1)
+        entered = threading.Event()
+        release = threading.Event()
+        results = []
+
+        def worker():
+            with pool.execute():
+                entered.set()
+                release.wait(5)
+
+        def queued():
+            with pool.execute():
+                results.append("ran")
+
+        t1 = threading.Thread(target=worker)
+        t1.start()
+        assert entered.wait(5)
+        t2 = threading.Thread(target=queued)
+        t2.start()
+        # give t2 time to enter the queue slot
+        deadline = threading.Event()
+        for _ in range(100):
+            if pool.stats()["queue"] == 1:
+                break
+            deadline.wait(0.01)
+        # active full + queue full → immediate rejection
+        with pytest.raises(EsRejectedExecutionException):
+            with pool.execute():
+                pass
+        assert pool.stats()["rejected"] == 1
+        release.set()
+        t1.join(5)
+        t2.join(5)
+        st = pool.stats()
+        assert st["active"] == 0 and st["queue"] == 0
+        assert st["completed"] == 2 and results == ["ran"]
+
+    def test_settings_override(self):
+        pools = ThreadPools(Settings.of({
+            "thread_pool": {"search": {"size": 3, "queue_size": 7}}}))
+        st = pools.stats()["search"]
+        assert st["threads"] == 3 and st["queue_size"] == 7
+
+
+class TestClassify:
+    def test_routes(self):
+        assert classify_pool("POST", "/idx/_search") == "search"
+        assert classify_pool("GET", "/_msearch") == "search"
+        assert classify_pool("POST", "/idx/_count") == "search"
+        assert classify_pool("POST", "/_bulk") == "write"
+        assert classify_pool("PUT", "/idx/_doc/1") == "write"
+        assert classify_pool("GET", "/idx/_doc/1") == "get"
+        assert classify_pool("POST", "/idx/_update/1") == "write"
+        assert classify_pool("POST", "/idx/_mget") == "get"
+        assert classify_pool("GET", "/idx/_doc/_search") == "get"
+        assert classify_pool("GET", "/_search/scroll") == "search"
+        assert classify_pool("GET", "/_cluster/health") == ""
+        assert classify_pool("PUT", "/idx") == ""
+
+
+class TestRestAdmission:
+    def test_saturated_search_pool_429s(self, tmp_path):
+        node = Node(str(tmp_path / "d"), settings=Settings.of({
+            "search.tpu_serving.enabled": "false",
+            "thread_pool": {"search": {"size": 1, "queue_size": 0}}}))
+        try:
+            node.handle("PUT", "/x", None, None,
+                        json.dumps({"mappings": {"properties": {
+                            "a": {"type": "text"}}}}).encode())
+            node.handle("PUT", "/x/_doc/1", None, None,
+                        json.dumps({"a": "hello"}).encode())
+            node.handle("POST", "/x/_refresh", None, None, b"")
+            entered = threading.Event()
+            release = threading.Event()
+            pool = node.thread_pools.get("search")
+            orig_execute = pool.execute
+
+            # occupy the single search slot from another thread
+            def occupy():
+                with orig_execute():
+                    entered.set()
+                    release.wait(5)
+
+            t = threading.Thread(target=occupy)
+            t.start()
+            assert entered.wait(5)
+            s, resp = node.handle(
+                "POST", "/x/_search", None, None,
+                json.dumps({"query": {"match_all": {}}}).encode())
+            assert s == 429, resp
+            assert "rejected" in json.dumps(resp)
+            release.set()
+            t.join(5)
+            # slot freed: the same search succeeds
+            s, resp = node.handle(
+                "POST", "/x/_search", None, None,
+                json.dumps({"query": {"match_all": {}}}).encode())
+            assert s == 200, resp
+            # rejection shows up in node stats
+            s, stats = node.handle("GET", "/_nodes/stats", None, None, b"")
+            tp = stats["nodes"][node.node_id]["thread_pool"]
+            assert tp["search"]["rejected"] == 1, tp
+        finally:
+            release.set()
+            node.close()
