@@ -388,6 +388,40 @@ class DropProcessor(Processor):
         raise DropDocument()
 
 
+@register_processor
+class ScriptProcessor(Processor):
+    """{"script": {"source": "ctx.field = ...", ...}} — run a restricted
+    expression script against the document (reference: ingest
+    ScriptProcessor with `ctx` as the source map; SURVEY.md §2.1#41/42).
+    Compiled at PUT time (bad script = 400, never a per-doc 500)."""
+
+    type_name = "script"
+
+    def __init__(self, config):
+        super().__init__(config)
+        from elasticsearch_tpu.script import (ScriptException,
+                                              compile_script)
+        spec = {k: config[k] for k in ("source", "lang", "params",
+                                       "inline") if k in config}
+        if not spec:
+            raise IllegalArgumentException(
+                "[script] required property [source] is missing")
+        try:
+            self.script = compile_script(spec)
+        except ScriptException as e:
+            raise IllegalArgumentException(
+                f"[script] {e.args[0] if e.args else e}") from None
+
+    def process(self, doc):
+        from elasticsearch_tpu.script import ScriptException
+        try:
+            self.script.execute({"ctx": doc})
+        except ScriptException as e:
+            raise IngestProcessorException(
+                f"script failed: {e.args[0] if e.args else e}"
+            ) from None
+
+
 # ----------------------------------------------------------------------
 # pipeline + service
 # ----------------------------------------------------------------------

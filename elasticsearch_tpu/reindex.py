@@ -105,15 +105,23 @@ def _run_by_query(node, index: str, query: Optional[dict], *,
         for hits in _scroll_source(node, index, query, batch_size,
                                    seq_no_primary_term):
             ops = []
+            saw_hits = False
             for h in hits:
                 if max_docs is not None and out["total"] >= max_docs:
                     break
                 out["total"] += 1
-                ops.append(make_op(h))
-            if not ops:
+                saw_hits = True
+                op = make_op(h)
+                if op is None:          # script said ctx.op = 'noop'
+                    out["noops"] += 1
+                    continue
+                ops.append(op)
+            if not saw_hits:
                 break
-            out["batches"] += 1
-            _summarize(_apply_ops(node, ops), out, conflicts_proceed)
+            if ops:
+                out["batches"] += 1
+                _summarize(_apply_ops(node, ops), out,
+                           conflicts_proceed)
             if max_docs is not None and out["total"] >= max_docs:
                 break
     except _Abort:
@@ -146,10 +154,28 @@ def reindex(node, body: Dict[str, Any]) -> Dict[str, Any]:
         raise IllegalArgumentException(
             f"[reindex] unsupported dest.op_type [{op_type}]")
     pipeline = dest.get("pipeline")
+    script = None
+    if "script" in body:
+        from elasticsearch_tpu.script import (ScriptException,
+                                              compile_script)
+        try:
+            script = compile_script(body["script"])
+        except ScriptException as e:
+            raise IllegalArgumentException(
+                str(e.args[0] if e.args else e)) from None
 
     def make_op(h):
+        source = h.get("_source") or {}
+        if script is not None:
+            from elasticsearch_tpu.rest.actions.document import \
+                run_update_script
+            op, source = run_update_script(script, source)
+            if op in ("none", "delete"):
+                # reindex scripts may noop a doc; delete makes no sense
+                # against the DEST index and is treated as noop too
+                return None
         return {"op": op_type, "index": dst_index, "id": h["_id"],
-                "routing": None, "source": h.get("_source") or {},
+                "routing": None, "source": source,
                 "pipeline": pipeline}
 
     return _run_by_query(
@@ -163,18 +189,38 @@ def update_by_query(node, index: str,
                     body: Optional[Dict[str, Any]],
                     params: Dict[str, str]) -> Dict[str, Any]:
     """Re-indexes each matching doc's snapshot source in place (bumping
-    its version; through ?pipeline= when given) — the reference's
-    scriptless update-by-query. The snapshot seq_no guards every write."""
+    its version; through ?pipeline= when given), optionally transformed
+    by a restricted-expression script (ctx._source mutation, ctx.op
+    noop/delete — reference: TransportUpdateByQueryAction with a
+    Painless script). The snapshot seq_no guards every write."""
     body = body or {}
+    script = None
     if "script" in body:
-        raise IllegalArgumentException(
-            "[update_by_query] scripted updates are not supported "
-            "(scripting module not present)")
+        from elasticsearch_tpu.script import (ScriptException,
+                                              compile_script)
+        try:
+            script = compile_script(body["script"])
+        except ScriptException as e:
+            raise IllegalArgumentException(
+                str(e.args[0] if e.args else e)) from None
     pipeline = params.get("pipeline")
 
     def make_op(h):
+        source = h.get("_source") or {}
+        op = "index"
+        if script is not None:
+            from elasticsearch_tpu.rest.actions.document import \
+                run_update_script
+            op, source = run_update_script(script, source)
+        if op == "delete":
+            return {"op": "delete", "index": h["_index"],
+                    "id": h["_id"], "routing": None, "source": None,
+                    "if_seq_no": h.get("_seq_no"),
+                    "if_primary_term": h.get("_primary_term")}
+        if op == "none":
+            return None  # counted as a noop, nothing written
         return {"op": "index", "index": h["_index"], "id": h["_id"],
-                "routing": None, "source": h.get("_source") or {},
+                "routing": None, "source": source,
                 "pipeline": pipeline,
                 "if_seq_no": h.get("_seq_no"),
                 "if_primary_term": h.get("_primary_term")}

@@ -124,10 +124,40 @@ def exec_delete_doc(node, index: str, doc_id: str, params,
                  "_shards": {"total": 1, "successful": 1, "failed": 0}}
 
 
+def run_update_script(script, source: Dict[str, Any],
+                      *, op: str = "index") -> Tuple[str, Dict[str, Any]]:
+    """Execute an update script against a `ctx` holding `_source` and
+    `op` (reference: UpdateHelper#executeScriptedUpsert). → (op,
+    new_source); op ∈ index|none|delete. Mutates a COPY."""
+    import copy
+    from elasticsearch_tpu.script import ScriptException
+    ctx = {"_source": copy.deepcopy(source), "op": op,
+           "_now": int(time.time() * 1000)}
+    try:
+        script.execute({"ctx": ctx})
+    except ScriptException as e:
+        raise IllegalArgumentException(
+            f"failed to execute script: "
+            f"{e.args[0] if e.args else e}") from None
+    out_op = ctx.get("op", "index")
+    if out_op in ("noop", "none"):
+        out_op = "none"
+    elif out_op not in ("index", "delete", "create"):
+        raise IllegalArgumentException(
+            f"Operation type [{out_op}] not allowed, only "
+            f"[create, index, noop, delete] are allowed")
+    new_source = ctx.get("_source")
+    if not isinstance(new_source, dict):
+        raise IllegalArgumentException(
+            "update script removed [ctx._source]")
+    return out_op, new_source
+
+
 def exec_update_doc(node, index: str, doc_id: str, body, params,
                     shard_num: Optional[int] = None) -> Tuple[int, Dict]:
-    """_update: doc merge or scripted update is reference behavior;
-    doc-merge and doc_as_upsert are supported here."""
+    """_update: doc-merge, doc_as_upsert, and scripted updates
+    (ctx._source mutation, ctx.op noop/delete, scripted_upsert) —
+    reference: UpdateHelper#prepare."""
     index = node.indices.resolve_write_index(index)
     svc = node.indices.index(index)
     svc.check_write_block()
@@ -136,19 +166,64 @@ def exec_update_doc(node, index: str, doc_id: str, body, params,
     shard = svc.shard(shard_num)
     body = body or {}
     partial = body.get("doc")
-    if partial is None:
+    script = None
+    if "script" in body:
+        if partial is not None:
+            raise IllegalArgumentException(
+                "Validation Failed: can't provide both script and doc")
+        from elasticsearch_tpu.script import (ScriptException,
+                                              compile_script)
+        try:
+            script = compile_script(body["script"])
+        except ScriptException as e:
+            raise IllegalArgumentException(
+                str(e.args[0] if e.args else e)) from None
+    if partial is None and script is None:
         raise IllegalArgumentException(
-            "[_update] requires a [doc] (scripted updates need the "
-            "script module)")
+            "Validation Failed: script or doc is missing")
     existing = shard.get(doc_id)
     if existing is None:
-        if body.get("doc_as_upsert") or "upsert" in body:
-            base = body.get("upsert", {})
+        if script is not None:
+            if "upsert" not in body:
+                raise DocumentMissingException(
+                    f"[{doc_id}]: document missing")
+            base = body["upsert"]
+            if body.get("scripted_upsert"):
+                op, merged = run_update_script(script, base, op="create")
+                if op == "delete":   # deleting a doc that never existed
+                    op = "none"
+            else:
+                op, merged = "index", base
+        elif body.get("doc_as_upsert"):
+            op, merged = "index", partial
+        elif "upsert" in body:
+            op, merged = "index", body["upsert"]
         else:
             raise DocumentMissingException(f"[{doc_id}]: document missing")
     else:
         base = dict(existing["_source"] or {})
-    merged = _deep_merge(base, partial)
+        if script is not None:
+            op, merged = run_update_script(script, base)
+        else:
+            merged = _deep_merge(base, partial)
+            # doc-merge with no change is a noop (detect_noop default)
+            op = "none" if (body.get("detect_noop", True)
+                            and merged == base) else "index"
+    if op == "none":
+        return 200, {"_index": index, "_id": doc_id,
+                     "_version": (existing or {}).get("_version", 1),
+                     "result": "noop",
+                     "_shards": {"total": 0, "successful": 0,
+                                 "failed": 0}}
+    if op == "delete":
+        result = shard.apply_delete_on_primary(doc_id)
+        node.replicate("delete", index, shard_num, doc_id, None, result)
+        if params.get("refresh") in ("", "true", "wait_for"):
+            shard.refresh()
+        return 200, {"_index": index, "_id": doc_id,
+                     "_version": result.version, "result": "deleted",
+                     "_seq_no": result.seq_no,
+                     "_primary_term": result.primary_term}
     result = shard.apply_index_on_primary(doc_id, merged)
     node.replicate("index", index, shard_num, doc_id, merged, result)
     if params.get("refresh") in ("", "true", "wait_for"):
@@ -361,13 +436,45 @@ def _apply_one_op(node, entry: Dict[str, Any],
                 "_seq_no": r.seq_no, "_primary_term": r.primary_term,
                 "status": status}}
         if op == "update":
-            partial = (source or {}).get("doc")
+            body = source or {}
+            script = None
+            if "script" in body:
+                if body.get("doc") is not None:
+                    raise IllegalArgumentException(
+                        "Validation Failed: can't provide both script "
+                        "and doc")
+                from elasticsearch_tpu.script import (ScriptException,
+                                                      compile_script)
+                try:
+                    script = compile_script(body["script"])
+                except ScriptException as e:
+                    raise IllegalArgumentException(
+                        str(e.args[0] if e.args else e)) from None
+            partial = body.get("doc")
             existing = shard.get(the_id)
-            if existing is None and not (source or {}).get("doc_as_upsert"):
+            if existing is None and not body.get("doc_as_upsert"):
                 raise DocumentMissingException(
                     f"[{the_id}]: document missing")
             base = dict((existing or {}).get("_source") or {})
-            merged = _deep_merge(base, partial or {})
+            if script is not None:
+                upd_op, merged = run_update_script(script, base)
+            else:
+                upd_op, merged = "index", _deep_merge(base, partial or {})
+            if upd_op == "none":
+                return {"update": {
+                    "_index": index, "_id": the_id,
+                    "_version": (existing or {}).get("_version", 1),
+                    "result": "noop", "status": 200}}
+            if upd_op == "delete":
+                r = shard.apply_delete_on_primary(the_id)
+                node.replicate("delete", index, shard_num, the_id,
+                               None, r)
+                refresh_shards.add(shard)
+                return {"update": {
+                    "_index": index, "_id": the_id,
+                    "_version": r.version, "result": "deleted",
+                    "_seq_no": r.seq_no,
+                    "_primary_term": r.primary_term, "status": 200}}
             r = shard.apply_index_on_primary(the_id, merged)
             node.replicate("index", index, shard_num, the_id, merged, r)
             refresh_shards.add(shard)

@@ -1,0 +1,951 @@
+"""Restricted script engine — the Painless/lang-expression analog.
+
+Reference: `script/ScriptService`, `modules/lang-painless` (ANTLR →
+bytecode) and `modules/lang-expression` (SURVEY.md §2.1#42, §7.2.9).
+The reference compiles a sandboxed language to JVM bytecode; rebuilding
+a bytecode compiler would be a port, not a design. The TPU-native
+stance: one small recursive-descent parser over a Painless-shaped
+grammar, with TWO interpreters over the same AST —
+
+- **scalar**: tree-walking over Python values, used by ingest `script`
+  processors, scripted `_update`/`_update_by_query` (`ctx._source`
+  mutation, `ctx.op`), and `bucket_script`/`bucket_selector` pipeline
+  aggregations. Mutation-capable, statement language (if / for-in /
+  def / assignment / return).
+- **vector**: the same AST evaluated over `jnp` arrays for
+  `script_score` — `doc['f'].value` resolves to a whole doc-values
+  COLUMN, arithmetic/comparisons/ternaries become elementwise array
+  ops, so one script evaluation scores every candidate document on
+  device with no per-doc host loop. This is where the design diverges
+  from the reference on purpose: Painless scores one doc per call
+  inside the Lucene collector; on TPU the script IS the kernel.
+
+Safety model (the Whitelist analog): no `eval`, no attribute access on
+arbitrary Python objects — only dict/list/str values reached from the
+declared context variables, a fixed method whitelist (`contains`,
+`size`, `substring`, …) and the `Math`/bare function table below.
+Loops are only `for (x : list)` (bounded by data) plus an operation
+budget; a runaway script raises rather than hangs.
+
+Missing-value semantics follow lang-expression, not Painless:
+`doc['f'].value` for a doc without the field is 0 (vector mode), and
+`doc['f'].empty` / `.size()` let scripts branch — Painless's
+per-document throw cannot exist in a vectorized kernel.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import EsException
+
+
+class ScriptException(EsException):
+    """Compile or runtime script failure (400, like the reference's
+    ScriptException which carries script_stack context)."""
+    status = 400
+
+
+# ----------------------------------------------------------------------
+# lexer
+# ----------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<comment>//[^\n]*)
+  | (?P<num>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?[LlFfDd]?)
+  | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><=|>=|==|!=|&&|\|\||\+=|-=|\*=|/=|%=|\+\+|--|[-+*/%<>=!?:;,.(){}\[\]])
+""", re.VERBOSE)
+
+_KEYWORDS = {"if", "else", "for", "return", "def", "true", "false",
+             "null", "in", "new"}
+
+
+def _lex(src: str) -> List[Tuple[str, str, int]]:
+    out: List[Tuple[str, str, int]] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise ScriptException(
+                f"unexpected character [{src[pos]!r}] at offset {pos}")
+        kind = m.lastgroup or ""
+        text = m.group()
+        pos = m.end()
+        if kind in ("ws", "comment"):
+            continue
+        if kind == "name" and text in _KEYWORDS:
+            kind = text
+        out.append((kind, text, m.start()))
+    out.append(("eof", "", len(src)))
+    return out
+
+
+# ----------------------------------------------------------------------
+# AST — plain tuples: (kind, *payload). Small, picklable, cheap.
+# ----------------------------------------------------------------------
+#   ("num", float|int) ("str", s) ("bool", b) ("null",)
+#   ("var", name) ("attr", obj, name) ("index", obj, key)
+#   ("call", obj_or_None, name, [args])
+#   ("bin", op, l, r) ("un", op, e) ("ternary", c, a, b)
+#   ("assign", target, op, value)  op in = += -= *= /= %=
+#   ("if", cond, then_block, else_block|None)
+#   ("forin", name, iterable, block)
+#   ("def", name, value|None) ("return", expr|None) ("expr", e)
+#   ("block", [stmts])
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str, int]], src: str):
+        self.toks = tokens
+        self.i = 0
+        self.src = src
+
+    # -- token helpers --
+    def peek(self) -> Tuple[str, str, int]:
+        return self.toks[self.i]
+
+    def next(self) -> Tuple[str, str, int]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, text: str) -> bool:
+        kind, tok, _ = self.toks[self.i]
+        if tok == text and kind in ("op",) + tuple(_KEYWORDS):
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, text: str) -> None:
+        if not self.accept(text):
+            kind, tok, off = self.toks[self.i]
+            raise ScriptException(
+                f"expected [{text}] but found [{tok or kind}] at "
+                f"offset {off}")
+
+    # -- statements --
+    def parse_program(self) -> tuple:
+        stmts = []
+        while self.peek()[0] != "eof":
+            stmts.append(self.statement())
+        return ("block", stmts)
+
+    def statement(self) -> tuple:
+        kind, tok, _ = self.peek()
+        if kind == "if":
+            return self.if_stmt()
+        if kind == "for":
+            return self.for_stmt()
+        if kind == "return":
+            self.next()
+            if self.accept(";"):
+                return ("return", None)
+            e = self.expression()
+            self.accept(";")
+            return ("return", e)
+        if kind == "def":
+            self.next()
+            nk, name, off = self.next()
+            if nk != "name":
+                raise ScriptException(
+                    f"expected identifier after [def] at offset {off}")
+            value = None
+            if self.accept("="):
+                value = self.expression()
+            self.accept(";")
+            return ("def", name, value)
+        if tok == "{":
+            return self.block()
+        e = self.expression()
+        # assignment?
+        kind2, tok2, _ = self.peek()
+        if tok2 in ("=", "+=", "-=", "*=", "/=", "%="):
+            self.next()
+            value = self.expression()
+            self.accept(";")
+            if e[0] not in ("var", "attr", "index"):
+                raise ScriptException(
+                    "left-hand side of assignment must be a variable, "
+                    "field, or index expression")
+            return ("assign", e, tok2, value)
+        self.accept(";")
+        return ("expr", e)
+
+    def block(self) -> tuple:
+        self.expect("{")
+        stmts = []
+        while not self.accept("}"):
+            if self.peek()[0] == "eof":
+                raise ScriptException("unterminated block: missing [}]")
+            stmts.append(self.statement())
+        return ("block", stmts)
+
+    def if_stmt(self) -> tuple:
+        self.expect("if")
+        self.expect("(")
+        cond = self.expression()
+        self.expect(")")
+        then = self.statement()
+        otherwise = None
+        if self.accept("else"):
+            otherwise = self.statement()
+        return ("if", cond, then, otherwise)
+
+    def for_stmt(self) -> tuple:
+        """Painless-style bounded iteration: for (def x : expr) {...}
+        (also accepts `for (x in expr)`); C-style for is rejected —
+        unbounded loops don't belong in a restricted engine."""
+        self.expect("for")
+        self.expect("(")
+        self.accept("def")
+        nk, name, off = self.next()
+        if nk != "name":
+            raise ScriptException(
+                f"expected loop variable at offset {off}")
+        if not self.accept(":") and not self.accept("in"):
+            raise ScriptException(
+                "only for (x : iterable) loops are supported")
+        it = self.expression()
+        self.expect(")")
+        body = self.statement()
+        return ("forin", name, it, body)
+
+    # -- expressions (precedence climbing) --
+    def expression(self) -> tuple:
+        return self.ternary()
+
+    def ternary(self) -> tuple:
+        cond = self.or_expr()
+        if self.accept("?"):
+            a = self.expression()
+            self.expect(":")
+            b = self.expression()
+            return ("ternary", cond, a, b)
+        return cond
+
+    def _binop(self, sub, ops) -> tuple:
+        left = sub()
+        while True:
+            _, tok, _ = self.peek()
+            if tok in ops:
+                self.next()
+                left = ("bin", tok, left, sub())
+            else:
+                return left
+
+    def or_expr(self):
+        return self._binop(self.and_expr, ("||",))
+
+    def and_expr(self):
+        return self._binop(self.cmp_expr, ("&&",))
+
+    def cmp_expr(self):
+        return self._binop(self.add_expr,
+                           ("==", "!=", "<", "<=", ">", ">="))
+
+    def add_expr(self):
+        return self._binop(self.mul_expr, ("+", "-"))
+
+    def mul_expr(self):
+        return self._binop(self.unary, ("*", "/", "%"))
+
+    def unary(self) -> tuple:
+        _, tok, _ = self.peek()
+        if tok == "-":
+            self.next()
+            return ("un", "-", self.unary())
+        if tok == "!":
+            self.next()
+            return ("un", "!", self.unary())
+        if tok == "+":
+            self.next()
+            return self.unary()
+        return self.postfix()
+
+    def postfix(self) -> tuple:
+        e = self.primary()
+        while True:
+            if self.accept("."):
+                nk, name, off = self.next()
+                if nk not in ("name",):
+                    raise ScriptException(
+                        f"expected member name at offset {off}")
+                if self.accept("("):
+                    args = self.arg_list()
+                    e = ("call", e, name, args)
+                else:
+                    e = ("attr", e, name)
+            elif self.accept("["):
+                key = self.expression()
+                self.expect("]")
+                e = ("index", e, key)
+            else:
+                return e
+
+    def arg_list(self) -> list:
+        args = []
+        if self.accept(")"):
+            return args
+        while True:
+            args.append(self.expression())
+            if self.accept(")"):
+                return args
+            self.expect(",")
+
+    def primary(self) -> tuple:
+        kind, tok, off = self.next()
+        if kind == "num":
+            text = tok.rstrip("LlFfDd")
+            if ("." in text or "e" in text or "E" in text
+                    or tok[-1] in "FfDd"):
+                return ("num", float(text))
+            return ("num", int(text))
+        if kind == "str":
+            body = tok[1:-1]
+            body = re.sub(r"\\(.)",
+                          lambda m: {"n": "\n", "t": "\t"}.get(
+                              m.group(1), m.group(1)), body)
+            return ("str", body)
+        if kind == "true":
+            return ("bool", True)
+        if kind == "false":
+            return ("bool", False)
+        if kind == "null":
+            return ("null",)
+        if kind == "name":
+            if self.peek()[1] == "(" and self.peek()[0] == "op":
+                self.next()
+                return ("call", None, tok, self.arg_list())
+            return ("var", tok)
+        if tok == "(":
+            e = self.expression()
+            self.expect(")")
+            return e
+        if kind == "new":
+            raise ScriptException("object construction is not allowed")
+        raise ScriptException(
+            f"unexpected token [{tok or kind}] at offset {off}")
+
+
+# ----------------------------------------------------------------------
+# function tables
+# ----------------------------------------------------------------------
+
+# Math.* (Painless exposes java.lang.Math; lang-expression the same set
+# as bare names). One table serves both spellings.
+_SCALAR_FUNCS: Dict[str, Callable] = {
+    "abs": abs, "ceil": math.ceil, "floor": math.floor,
+    "exp": math.exp, "log": math.log, "log10": math.log10,
+    "sqrt": math.sqrt, "pow": math.pow, "min": min, "max": max,
+    "sin": math.sin, "cos": math.cos, "tan": math.tan,
+    "round": round, "signum": lambda x: (x > 0) - (x < 0),
+    "ln": math.log,  # lang-expression alias
+}
+
+_OP_BUDGET = 100_000  # scalar interpreter op ceiling per execution
+
+
+class _Returned(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+# ----------------------------------------------------------------------
+# scalar interpreter
+# ----------------------------------------------------------------------
+
+class _ScalarEval:
+    def __init__(self, variables: Dict[str, Any]):
+        self.vars = dict(variables)
+        # context bindings (ctx, params, …) may be MUTATED but never
+        # rebound — `ctx = 5` is an error, `ctx.x = 5` is the point
+        self.protected = frozenset(variables)
+        self.ops = 0
+
+    def _tick(self):
+        self.ops += 1
+        if self.ops > _OP_BUDGET:
+            raise ScriptException(
+                "script exceeded the operation budget "
+                f"[{_OP_BUDGET}] (runaway loop?)")
+
+    def run(self, node) -> Any:
+        try:
+            self.stmt(node)
+        except _Returned as r:
+            return r.value
+        return None
+
+    def stmt(self, node) -> None:
+        self._tick()
+        kind = node[0]
+        if kind == "block":
+            for s in node[1]:
+                self.stmt(s)
+        elif kind == "expr":
+            self.eval(node[1])
+        elif kind == "if":
+            if _truthy(self.eval(node[1])):
+                self.stmt(node[2])
+            elif node[3] is not None:
+                self.stmt(node[3])
+        elif kind == "forin":
+            _, name, it_expr, body = node
+            it = self.eval(it_expr)
+            if isinstance(it, dict):
+                it = list(it.keys())
+            if not isinstance(it, (list, tuple, str)):
+                raise ScriptException(
+                    f"cannot iterate over [{type(it).__name__}]")
+            for item in it:
+                self._tick()
+                self.vars[name] = item
+                self.stmt(body)
+        elif kind == "def":
+            _, name, value = node
+            self.vars[name] = self.eval(value) if value is not None \
+                else None
+        elif kind == "return":
+            raise _Returned(
+                self.eval(node[1]) if node[1] is not None else None)
+        elif kind == "assign":
+            self.assign(node[1], node[2], node[3])
+        else:
+            raise ScriptException(f"unsupported statement [{kind}]")
+
+    def assign(self, target, op, value_expr) -> None:
+        value = self.eval(value_expr)
+        if op != "=":
+            current = self.eval(target)
+            value = _scalar_binop(op[:-1], current, value)
+        kind = target[0]
+        if kind == "var":
+            name = target[1]
+            if name in self.protected:
+                raise ScriptException(
+                    f"cannot reassign context variable [{name}]")
+            self.vars[name] = value
+        elif kind in ("attr", "index"):
+            container = self.eval(target[1])
+            key = target[2] if kind == "attr" else \
+                self.eval(target[2])
+            if isinstance(container, dict):
+                container[key] = value
+            elif isinstance(container, list):
+                if not isinstance(key, int):
+                    raise ScriptException("list index must be an integer")
+                container[key] = value
+            else:
+                raise ScriptException(
+                    f"cannot assign into [{type(container).__name__}]")
+
+    def eval(self, node) -> Any:
+        self._tick()
+        kind = node[0]
+        if kind == "num" or kind == "str" or kind == "bool":
+            return node[1]
+        if kind == "null":
+            return None
+        if kind == "var":
+            name = node[1]
+            if name == "Math":
+                return _MATH_SENTINEL
+            if name in self.vars:
+                return self.vars[name]
+            raise ScriptException(f"unknown variable [{name}]")
+        if kind == "attr":
+            return self._attr(self.eval(node[1]), node[2])
+        if kind == "index":
+            obj = self.eval(node[1])
+            key = self.eval(node[2])
+            if isinstance(obj, dict):
+                return obj.get(key)
+            if isinstance(obj, (list, str)):
+                if not isinstance(key, int):
+                    raise ScriptException("index must be an integer")
+                try:
+                    return obj[key]
+                except IndexError:
+                    raise ScriptException(
+                        f"index [{key}] out of bounds") from None
+            raise ScriptException(
+                f"cannot index [{type(obj).__name__}]")
+        if kind == "call":
+            return self._call(node)
+        if kind == "bin":
+            op = node[1]
+            if op == "&&":
+                return _truthy(self.eval(node[2])) and \
+                    _truthy(self.eval(node[3]))
+            if op == "||":
+                return _truthy(self.eval(node[2])) or \
+                    _truthy(self.eval(node[3]))
+            return _scalar_binop(op, self.eval(node[2]),
+                                 self.eval(node[3]))
+        if kind == "un":
+            v = self.eval(node[2])
+            if node[1] == "-":
+                _require_num(v)
+                return -v
+            return not _truthy(v)
+        if kind == "ternary":
+            return self.eval(node[2]) if _truthy(self.eval(node[1])) \
+                else self.eval(node[3])
+        raise ScriptException(f"unsupported expression [{kind}]")
+
+    def _attr(self, obj, name):
+        if obj is _MATH_SENTINEL_DATA:
+            raise ScriptException("Math has no fields")
+        if isinstance(obj, dict):
+            return obj.get(name)
+        if name == "length" and isinstance(obj, (list, str)):
+            return len(obj)
+        raise ScriptException(
+            f"unknown field [{name}] on [{type(obj).__name__}]")
+
+    def _call(self, node):
+        _, recv_expr, name, arg_exprs = node
+        args = [self.eval(a) for a in arg_exprs]
+        if recv_expr is None:
+            fn = _SCALAR_FUNCS.get(name)
+            if fn is None:
+                raise ScriptException(f"unknown function [{name}]")
+            try:
+                return fn(*args)
+            except (TypeError, ValueError, ArithmeticError) as e:
+                raise ScriptException(f"[{name}] failed: {e}") from None
+        recv = self.eval(recv_expr)
+        if recv is _MATH_SENTINEL_DATA:
+            fn = _SCALAR_FUNCS.get(name)
+            if fn is None:
+                raise ScriptException(f"unknown function [Math.{name}]")
+            try:
+                return fn(*args)
+            except (TypeError, ValueError, ArithmeticError) as e:
+                raise ScriptException(
+                    f"[Math.{name}] failed: {e}") from None
+        return _method(recv, name, args)
+
+
+_MATH_SENTINEL_DATA = object()
+_MATH_SENTINEL = _MATH_SENTINEL_DATA
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if v is None:
+        return False
+    if isinstance(v, (int, float)):
+        return v != 0
+    raise ScriptException(
+        f"condition must be boolean, got [{type(v).__name__}]")
+
+
+def _require_num(v):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise ScriptException(
+            f"expected a number, got [{type(v).__name__}]")
+
+
+def _scalar_binop(op, a, b):
+    if op == "+":
+        if isinstance(a, str) or isinstance(b, str):
+            return _to_str(a) + _to_str(b)
+        if isinstance(a, list) and isinstance(b, list):
+            return a + b
+        _require_num(a), _require_num(b)
+        return a + b
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op in ("<", "<=", ">", ">="):
+        if isinstance(a, str) and isinstance(b, str):
+            pass
+        else:
+            _require_num(a), _require_num(b)
+        return {"<": a < b, "<=": a <= b,
+                ">": a > b, ">=": a >= b}[op]
+    _require_num(a), _require_num(b)
+    try:
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b if isinstance(a, float) or isinstance(b, float) \
+                else (a // b if a % b == 0 else a / b)
+        if op == "%":
+            return a % b
+    except ZeroDivisionError:
+        raise ScriptException("division by zero") from None
+    raise ScriptException(f"unknown operator [{op}]")
+
+
+def _to_str(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+_METHODS: Dict[Tuple[type, str], Callable] = {
+    (str, "contains"): lambda s, x: x in s,
+    (str, "startsWith"): lambda s, x: s.startswith(x),
+    (str, "endsWith"): lambda s, x: s.endswith(x),
+    (str, "indexOf"): lambda s, x: s.find(x),
+    (str, "substring"): lambda s, a, b=None:
+        s[a:] if b is None else s[a:b],
+    (str, "toLowerCase"): lambda s: s.lower(),
+    (str, "toUpperCase"): lambda s: s.upper(),
+    (str, "trim"): lambda s: s.strip(),
+    (str, "replace"): lambda s, a, b: s.replace(a, b),
+    (str, "length"): lambda s: len(s),
+    (str, "isEmpty"): lambda s: len(s) == 0,
+    (str, "splitOnToken"): lambda s, t: s.split(t),
+    (list, "contains"): lambda l, x: x in l,
+    (list, "add"): lambda l, x: (l.append(x), True)[1],
+    (list, "size"): lambda l: len(l),
+    (list, "isEmpty"): lambda l: len(l) == 0,
+    (list, "indexOf"): lambda l, x: l.index(x) if x in l else -1,
+    (dict, "containsKey"): lambda d, k: k in d,
+    (dict, "get"): lambda d, k, default=None: d.get(k, default),
+    (dict, "put"): lambda d, k, v: d.__setitem__(k, v),
+    (dict, "remove"): lambda d, k: d.pop(k, None),
+    (dict, "keySet"): lambda d: list(d.keys()),
+    (dict, "values"): lambda d: list(d.values()),
+    (dict, "size"): lambda d: len(d),
+    (dict, "isEmpty"): lambda d: len(d) == 0,
+}
+
+
+def _list_remove(l: list, x):
+    """Painless List.remove(int) removes BY INDEX; remove(Object) by
+    value. Mirror the index flavor for ints (the common script idiom)."""
+    if isinstance(x, int) and not isinstance(x, bool):
+        if 0 <= x < len(l):
+            return l.pop(x)
+        raise ScriptException(f"index [{x}] out of bounds")
+    if x in l:
+        l.remove(x)
+        return True
+    return False
+
+
+_METHODS[(list, "remove")] = _list_remove
+
+
+def _method(recv, name, args):
+    for base in type(recv).__mro__:
+        fn = _METHODS.get((base, name))
+        if fn is not None:
+            try:
+                return fn(recv, *args)
+            except ScriptException:
+                raise
+            except (TypeError, ValueError) as e:
+                raise ScriptException(
+                    f"[{name}] failed: {e}") from None
+    raise ScriptException(
+        f"unknown method [{name}] on [{type(recv).__name__}]")
+
+
+# ----------------------------------------------------------------------
+# vector interpreter (script_score)
+# ----------------------------------------------------------------------
+
+class FieldColumn:
+    """What `doc['field']` yields in vector mode: a doc-values column
+    plus its presence mask, both device arrays."""
+
+    __slots__ = ("values", "present")
+
+    def __init__(self, values, present):
+        self.values = values
+        self.present = present
+
+
+class _VectorEval:
+    """Expression-only evaluation producing one jnp array per AST node.
+    Statements other than a single trailing `return` are rejected —
+    matching lang-expression, which is expression-only too."""
+
+    def __init__(self, resolver: Callable[[str], FieldColumn],
+                 variables: Dict[str, Any]):
+        import jax.numpy as jnp
+        self.jnp = jnp
+        self.resolver = resolver
+        self.vars = variables
+
+    def eval(self, node):
+        jnp = self.jnp
+        kind = node[0]
+        if kind in ("num", "str", "bool"):
+            return node[1]
+        if kind == "null":
+            return None
+        if kind == "var":
+            name = node[1]
+            if name == "Math":
+                return _MATH_SENTINEL
+            if name == "doc":
+                return _DOC_SENTINEL
+            if name in self.vars:
+                return self.vars[name]
+            raise ScriptException(f"unknown variable [{name}]")
+        if kind == "index":
+            obj = self.eval(node[1])
+            key = self.eval(node[2])
+            if obj is _DOC_SENTINEL:
+                if not isinstance(key, str):
+                    raise ScriptException("doc[...] takes a field name")
+                return self.resolver(key)
+            if isinstance(obj, dict):
+                return obj.get(key)
+            raise ScriptException("only doc[...] and params[...] "
+                                  "indexing are supported in scores")
+        if kind == "attr":
+            obj = self.eval(node[1])
+            name = node[2]
+            if isinstance(obj, FieldColumn):
+                if name == "value":
+                    return obj.values
+                if name == "empty":
+                    return ~obj.present
+                raise ScriptException(
+                    f"unknown doc-values field [{name}]")
+            if isinstance(obj, dict):
+                return obj.get(name)
+            raise ScriptException(
+                f"unknown field [{name}] in score context")
+        if kind == "call":
+            return self._call(node)
+        if kind == "bin":
+            op = node[1]
+            a = self.eval(node[2])
+            b = self.eval(node[3])
+            return self._binop(op, a, b)
+        if kind == "un":
+            v = self.eval(node[2])
+            if node[1] == "-":
+                return -self._num(v)
+            b = self._bool(v)
+            return (not b) if isinstance(b, bool) else ~b
+        if kind == "ternary":
+            c = self._bool(self.eval(node[1]))
+            a = self._num(self.eval(node[2]))
+            b = self._num(self.eval(node[3]))
+            return jnp.where(c, a, b)
+        raise ScriptException(
+            f"[{kind}] is not allowed in score scripts")
+
+    def _num(self, v):
+        if isinstance(v, bool):
+            return float(v)
+        if v is None:
+            raise ScriptException("null in arithmetic context")
+        return v
+
+    def _bool(self, v):
+        jnp = self.jnp
+        import numpy as _np
+        if isinstance(v, bool):
+            return v
+        if hasattr(v, "dtype") and v.dtype == _np.bool_:
+            return v
+        if hasattr(v, "dtype"):
+            return v != 0
+        raise ScriptException("condition must be boolean")
+
+    def _binop(self, op, a, b):
+        jnp = self.jnp
+        if op == "&&":
+            return self._bool(a) & self._bool(b)
+        if op == "||":
+            return self._bool(a) | self._bool(b)
+        if op in ("==", "!="):
+            eq = self._num(a) == self._num(b) if not (
+                isinstance(a, str) or isinstance(b, str)) else (a == b)
+            return eq if op == "==" else ~eq if hasattr(eq, "dtype") \
+                else not eq
+        a, b = self._num(a), self._num(b)
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b
+        if op == "%":
+            return a % b
+        raise ScriptException(f"unknown operator [{op}]")
+
+    def _call(self, node):
+        jnp = self.jnp
+        _, recv_expr, name, arg_exprs = node
+        recv = None if recv_expr is None else self.eval(recv_expr)
+        if isinstance(recv, FieldColumn):
+            if name == "size":
+                return jnp.where(recv.present, 1, 0)
+            raise ScriptException(
+                f"unknown doc-values method [{name}]")
+        if recv is not None and recv is not _MATH_SENTINEL:
+            raise ScriptException(
+                f"method calls on [{type(recv).__name__}] are not "
+                "allowed in score scripts")
+        fn = _VECTOR_FUNCS.get(name)
+        if fn is None:
+            raise ScriptException(f"unknown function [{name}]")
+        args = [self._num(self.eval(a)) for a in arg_exprs]
+        try:
+            return fn(jnp, *args)
+        except TypeError as e:
+            raise ScriptException(f"[{name}] failed: {e}") from None
+
+
+_DOC_SENTINEL = object()
+
+_VECTOR_FUNCS: Dict[str, Callable] = {
+    "abs": lambda jnp, x: jnp.abs(x),
+    "ceil": lambda jnp, x: jnp.ceil(x),
+    "floor": lambda jnp, x: jnp.floor(x),
+    "exp": lambda jnp, x: jnp.exp(x),
+    "log": lambda jnp, x: jnp.log(x),
+    "ln": lambda jnp, x: jnp.log(x),
+    "log10": lambda jnp, x: jnp.log10(x),
+    "sqrt": lambda jnp, x: jnp.sqrt(x),
+    "pow": lambda jnp, x, y: jnp.power(x, y),
+    "min": lambda jnp, *xs: _vec_reduce(jnp.minimum, xs),
+    "max": lambda jnp, *xs: _vec_reduce(jnp.maximum, xs),
+    "sin": lambda jnp, x: jnp.sin(x),
+    "cos": lambda jnp, x: jnp.cos(x),
+    "tan": lambda jnp, x: jnp.tan(x),
+    "round": lambda jnp, x: jnp.round(x),
+    "signum": lambda jnp, x: jnp.sign(x),
+    "saturation": lambda jnp, x, p: x / (x + p),
+    "sigmoid": lambda jnp, x, k, a:
+        jnp.power(x, a) / (jnp.power(k, a) + jnp.power(x, a)),
+}
+
+
+def _vec_reduce(fn, xs):
+    if len(xs) < 2:
+        raise TypeError("needs at least 2 arguments")
+    out = xs[0]
+    for x in xs[1:]:
+        out = fn(out, x)
+    return out
+
+
+# ----------------------------------------------------------------------
+# public API
+# ----------------------------------------------------------------------
+
+class CompiledScript:
+    """One parsed script. `execute` runs the scalar interpreter;
+    `score_vector` the vectorized one. Reference: ScriptService#compile
+    caching compiled scripts per (lang, source)."""
+
+    def __init__(self, source: str, params: Dict[str, Any],
+                 lang: str):
+        self.source = source
+        self.params = params
+        self.lang = lang
+        try:
+            self.ast = _Parser(_lex(source), source).parse_program()
+        except ScriptException as e:
+            raise ScriptException(
+                f"compile error in script [{source[:80]}]: "
+                f"{e.args[0] if e.args else e}") from None
+        stmts = self.ast[1]
+        self.is_expression = (
+            len(stmts) == 1 and stmts[0][0] in ("expr", "return"))
+
+    # -- scalar --
+    def execute(self, variables: Dict[str, Any]) -> Any:
+        """Run with the given context variables. Dicts passed here are
+        mutated in place (that's the point for ctx scripts). Returns
+        the `return` value, or the last expression's value for
+        single-expression scripts."""
+        vars_in = {"params": dict(self.params)}
+        for k, v in variables.items():
+            vars_in[k] = v
+        ev = _ScalarEval(vars_in)
+        if self.is_expression:
+            node = self.ast[1][0]
+            expr = node[1]
+            if expr is None:
+                return None
+            return ev.eval(expr)
+        return ev.run(self.ast)
+
+    # -- vector --
+    def score_vector(self, resolver: Callable[[str], FieldColumn],
+                     score) -> Any:
+        """Evaluate as one array program: `_score` is the base score
+        array, `doc['f']` resolves through `resolver`. Returns the
+        per-doc score array (float32)."""
+        if not self.is_expression:
+            raise ScriptException(
+                "score scripts must be a single expression "
+                "(lang-expression semantics); statements are only "
+                "available in update/ingest contexts")
+        node = self.ast[1][0]
+        expr = node[1]
+        if expr is None:
+            raise ScriptException("score script returns nothing")
+        ev = _VectorEval(resolver, {"_score": score,
+                                    "params": dict(self.params)})
+        import jax.numpy as jnp
+        out = ev.eval(expr)
+        if isinstance(out, (int, float)):
+            out = jnp.full_like(score, float(out))
+        if hasattr(out, "dtype") and out.dtype == bool:
+            out = out.astype(jnp.float32)
+        return out.astype(jnp.float32)
+
+
+_SUPPORTED_LANGS = ("painless", "expression")
+
+
+def compile_script(spec: Any, *, default_source_key: str = "source"
+                   ) -> CompiledScript:
+    """Parse the REST script grammar: a bare string, or
+    {"source": ..., "lang": ..., "params": {...}} (reference:
+    Script#parse). Stored scripts ("id") are not supported."""
+    if isinstance(spec, str):
+        return CompiledScript(spec, {}, "painless")
+    if not isinstance(spec, dict):
+        raise ScriptException(
+            "script must be a string or an object with [source]")
+    if "id" in spec:
+        raise ScriptException(
+            "stored scripts are not supported; inline [source] only")
+    source = spec.get(default_source_key, spec.get("inline"))
+    if not isinstance(source, str):
+        raise ScriptException("script requires a [source] string")
+    lang = spec.get("lang", "painless")
+    if lang not in _SUPPORTED_LANGS:
+        raise ScriptException(
+            f"unsupported script lang [{lang}]; this build implements "
+            f"a restricted expression subset under {_SUPPORTED_LANGS}")
+    params = spec.get("params") or {}
+    if not isinstance(params, dict):
+        raise ScriptException("[params] must be an object")
+    return CompiledScript(source, params, lang)

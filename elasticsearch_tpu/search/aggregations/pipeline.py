@@ -129,6 +129,66 @@ class Pipeline:
                     prev = v
 
 
+@dataclasses.dataclass
+class ScriptPipeline(Pipeline):
+    """bucket_script / bucket_selector (reference:
+    BucketScriptPipelineAggregationBuilder): `buckets_path` is a MAP of
+    script variable → metric path; the expression script computes one
+    value per bucket (bucket_script adds it, bucket_selector keeps the
+    bucket iff truthy). SURVEY.md §2.1#42 — one of the four subsystems
+    the restricted expression engine unlocks."""
+
+    paths: Dict[str, str] = dataclasses.field(default_factory=dict)
+    script: Any = None  # CompiledScript
+
+    def _bucket_vars(self, bucket: Dict[str, Any]
+                     ) -> Optional[Dict[str, float]]:
+        out: Dict[str, float] = {}
+        for var, path in self.paths.items():
+            segments = (path.split(">") if path != "_count"
+                        else ["_count"])
+            v = self._metric_from_bucket(bucket, segments)
+            if v is None:
+                if self.gap_policy == "insert_zeros":
+                    v = 0.0
+                else:
+                    return None  # skip: bucket lacks an input
+            out[var] = v
+        return out
+
+    def compute_parent(self, buckets: List[Dict[str, Any]]) -> None:
+        from elasticsearch_tpu.script import ScriptException
+        keep: List[Dict[str, Any]] = []
+        for b in buckets:
+            vars_in = self._bucket_vars(b)
+            if vars_in is None:
+                if self.kind == "bucket_script":
+                    continue            # no value emitted for the gap
+                keep.append(b)          # selector: gaps are kept
+                continue
+            try:
+                result = self.script.execute(
+                    {"params": {**self.script.params, **vars_in},
+                     **vars_in})
+            except ScriptException as e:
+                raise IllegalArgumentException(
+                    f"[{self.kind}] [{self.name}] script failed: "
+                    f"{e.args[0] if e.args else e}") from None
+            if self.kind == "bucket_script":
+                if result is not None and not isinstance(
+                        result, (int, float)):
+                    raise IllegalArgumentException(
+                        f"[bucket_script] [{self.name}] must return a "
+                        f"number, got [{type(result).__name__}]")
+                if result is not None:
+                    b[self.name] = {"value": float(result)}
+            else:  # bucket_selector
+                if bool(result):
+                    keep.append(b)
+        if self.kind == "bucket_selector":
+            buckets[:] = keep
+
+
 def apply_pipelines(factories: AggregatorFactories,
                     node: Dict[str, Any]) -> None:
     """Walk the response tree alongside the parsed agg tree, recursing
@@ -204,3 +264,35 @@ for _kind in ("avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
     register_pipeline(_kind)(_parse(_kind, SIBLING))
 for _kind in ("derivative", "cumulative_sum"):
     register_pipeline(_kind)(_parse(_kind, PARENT))
+
+
+def _parse_script_pipeline(kind: str):
+    def parser(name, body) -> ScriptPipeline:
+        body = body or {}
+        paths = body.get("buckets_path")
+        if not isinstance(paths, dict) or not paths:
+            raise IllegalArgumentException(
+                f"[{kind}] requires [buckets_path] as an object of "
+                f"script variable → metric path")
+        if "script" not in body:
+            raise IllegalArgumentException(f"[{kind}] requires [script]")
+        from elasticsearch_tpu.script import (ScriptException,
+                                              compile_script)
+        try:
+            script = compile_script(body["script"])
+        except ScriptException as e:
+            raise IllegalArgumentException(
+                f"[{kind}] {e.args[0] if e.args else e}") from None
+        gap = str(body.get("gap_policy", "skip"))
+        if gap not in ("skip", "insert_zeros"):
+            raise IllegalArgumentException(
+                f"[{kind}] unknown gap_policy [{gap}]")
+        return ScriptPipeline(
+            name, kind, PARENT, "", gap,
+            paths={str(k): str(v) for k, v in paths.items()},
+            script=script)
+    return parser
+
+
+for _kind in ("bucket_script", "bucket_selector"):
+    register_pipeline(_kind)(_parse_script_pipeline(_kind))

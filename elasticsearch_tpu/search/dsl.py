@@ -176,6 +176,22 @@ class ScoreFunction:
     filter_query: Optional[QueryNode] = None
     weight: Optional[float] = None
     field_value_factor: Optional[Dict[str, Any]] = None
+    script_score: Optional[Any] = None  # CompiledScript
+
+
+@dataclasses.dataclass
+class ScriptScoreQuery(QueryNode):
+    """{"script_score": {"query": ..., "script": ...}} — replace the
+    base query's score with a script over doc values and `_score`
+    (reference: ScriptScoreQueryBuilder; evaluated VECTORIZED here —
+    one array program over all candidates, SURVEY.md §2.1#42)."""
+
+    query: QueryNode = None  # type: ignore[assignment]
+    script: Any = None       # CompiledScript
+    min_score: Optional[float] = None
+
+    def query_name(self) -> str:
+        return "script_score"
 
 
 @dataclasses.dataclass
@@ -452,13 +468,26 @@ def _parse_function_score(body) -> FunctionScoreQuery:
         else MatchAllQuery()
 
     def parse_fn(obj) -> ScoreFunction:
-        known = {"filter", "weight", "field_value_factor"}
+        known = {"filter", "weight", "field_value_factor",
+                 "script_score"}
         unknown = set(obj) - known
         if unknown:
             raise ParsingException(
                 f"[function_score] unsupported function parameter "
-                f"{sorted(unknown)} (filter/weight/field_value_factor "
-                f"are available)")
+                f"{sorted(unknown)} (filter/weight/field_value_factor/"
+                f"script_score are available)")
+        script = None
+        if obj.get("script_score") is not None:
+            spec = obj["script_score"]
+            if not isinstance(spec, dict) or "script" not in spec:
+                raise ParsingException(
+                    "[script_score] requires a [script]")
+            from elasticsearch_tpu.script import (ScriptException,
+                                                  compile_script)
+            try:
+                script = compile_script(spec["script"])
+            except ScriptException as e:
+                raise ParsingException(str(e)) from None
         fvf = obj.get("field_value_factor")
         if fvf is not None:
             if "field" not in fvf:
@@ -477,16 +506,17 @@ def _parse_function_score(body) -> FunctionScoreQuery:
                         raise ParsingException(
                             f"[field_value_factor] [{num_key}] must be "
                             f"numeric, got [{fvf[num_key]}]") from None
-        if obj.get("weight") is None and fvf is None:
+        if obj.get("weight") is None and fvf is None and script is None:
             raise ParsingException(
-                "[function_score] function needs [weight] or "
-                "[field_value_factor]")
+                "[function_score] function needs [weight], "
+                "[field_value_factor], or [script_score]")
         return ScoreFunction(
             filter_query=(parse_query(obj["filter"])
                           if "filter" in obj else None),
             weight=(None if obj.get("weight") is None
                     else float(obj["weight"])),
-            field_value_factor=fvf)
+            field_value_factor=fvf,
+            script_score=script)
 
     functions: List[ScoreFunction] = []
     if "functions" in body:
@@ -495,7 +525,8 @@ def _parse_function_score(body) -> FunctionScoreQuery:
                                    "an array")
         functions = [parse_fn(f) for f in body["functions"]]
     else:
-        shorthand = {k: body[k] for k in ("weight", "field_value_factor")
+        shorthand = {k: body[k] for k in ("weight", "field_value_factor",
+                                          "script_score")
                      if k in body}
         if shorthand:
             functions = [parse_fn(shorthand)]
@@ -509,7 +540,8 @@ def _parse_function_score(body) -> FunctionScoreQuery:
             raise ParsingException(
                 f"[function_score] unknown {mode_key} [{mode}]")
     known = {"query", "functions", "weight", "field_value_factor",
-             "score_mode", "boost_mode", "max_boost", "boost"}
+             "script_score", "score_mode", "boost_mode", "max_boost",
+             "boost"}
     unknown = set(body) - known
     if unknown:
         raise ParsingException(
@@ -520,6 +552,29 @@ def _parse_function_score(body) -> FunctionScoreQuery:
         boost_mode=str(body.get("boost_mode", "multiply")),
         max_boost=(None if body.get("max_boost") is None
                    else float(body["max_boost"])),
+        boost=float(body.get("boost", 1.0)))
+
+
+def _parse_script_score(body) -> ScriptScoreQuery:
+    if not isinstance(body, dict):
+        raise ParsingException("[script_score] expects an object")
+    if "query" not in body:
+        raise ParsingException("[script_score] requires a [query]")
+    if "script" not in body:
+        raise ParsingException("[script_score] requires a [script]")
+    unknown = set(body) - {"query", "script", "min_score", "boost"}
+    if unknown:
+        raise ParsingException(
+            f"[script_score] unknown parameter {sorted(unknown)}")
+    from elasticsearch_tpu.script import ScriptException, compile_script
+    try:
+        script = compile_script(body["script"])
+    except ScriptException as e:
+        raise ParsingException(str(e)) from None
+    return ScriptScoreQuery(
+        query=parse_query(body["query"]), script=script,
+        min_score=(None if body.get("min_score") is None
+                   else float(body["min_score"])),
         boost=float(body.get("boost", 1.0)))
 
 
@@ -540,4 +595,5 @@ _PARSERS = {
     "wildcard": _parse_wildcard,
     "fuzzy": _parse_fuzzy,
     "function_score": _parse_function_score,
+    "script_score": _parse_script_score,
 }

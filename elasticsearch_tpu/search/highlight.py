@@ -118,6 +118,8 @@ def collect_matchers(query: dsl.QueryNode, field: str,
             walk(node.filter_query)
         elif isinstance(node, dsl.FunctionScoreQuery):
             walk(node.query)
+        elif isinstance(node, dsl.ScriptScoreQuery):
+            walk(node.query)
 
     walk(query)
     return out

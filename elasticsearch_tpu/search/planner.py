@@ -156,6 +156,8 @@ class SegmentQueryExecutor:
                 scoring, constant=False)
         if isinstance(node, dsl.FunctionScoreQuery):
             return self._eval_function_score(node, scoring)
+        if isinstance(node, dsl.ScriptScoreQuery):
+            return self._eval_script_score(node, scoring)
         if isinstance(node, dsl.NestedQuery):
             return self._eval_nested(node, scoring)
         if hasattr(node, "evaluate"):
@@ -278,6 +280,9 @@ class SegmentQueryExecutor:
             if fn.field_value_factor is not None:
                 factor = factor * self._field_value_factor(
                     fn.field_value_factor)
+            if fn.script_score is not None:
+                factor = factor * self._run_score_script(
+                    fn.script_score, score)
             if fn.weight is not None:
                 factor = factor * fn.weight
             if fn.filter_query is not None:
@@ -322,12 +327,9 @@ class SegmentQueryExecutor:
             final = jnp.minimum(score, combined)
         return mask, jnp.where(mask, final * node.boost, 0.0)
 
-    def _field_value_factor(self, fvf: dict) -> jnp.ndarray:
-        """Per-doc factor from a doc-values column (reference:
-        FieldValueFactorFunction)."""
-        field = fvf["field"]
-        factor = float(fvf.get("factor", 1.0))
-        missing = fvf.get("missing")
+    def _dv_column(self, field: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Numeric doc-values column → (values_f32, present_mask); the
+        one extraction both score scripts and field_value_factor use."""
         pack = self.view.pack
         if field in pack.dv_f64:
             vals = jnp.asarray(pack.dv_f64[field], dtype=jnp.float32)
@@ -339,6 +341,53 @@ class SegmentQueryExecutor:
         else:
             present = jnp.zeros(self.d_pad, dtype=bool)
             vals = jnp.zeros(self.d_pad, dtype=jnp.float32)
+        return vals, present
+
+    def _script_resolver(self, field: str):
+        """doc['field'] in a score script → FieldColumn over this
+        view's doc-values (numeric; missing = 0 with .empty mask —
+        lang-expression semantics, see script module docstring)."""
+        from elasticsearch_tpu.script import FieldColumn
+        vals, present = self._dv_column(field)
+        return FieldColumn(jnp.where(present, vals, 0.0), present)
+
+    def _run_score_script(self, script, base_score) -> jnp.ndarray:
+        from elasticsearch_tpu.script import ScriptException
+        try:
+            return script.score_vector(self._script_resolver, base_score)
+        except ScriptException:
+            raise
+        except Exception as e:  # noqa: BLE001 — surface as a 400
+            from elasticsearch_tpu.script import ScriptException as SE
+            raise SE(f"runtime error in score script "
+                     f"[{script.source[:80]}]: {e}") from None
+
+    def _eval_script_score(self, node: dsl.ScriptScoreQuery,
+                           scoring: bool):
+        # min_score prunes MATCHES, so it must run even in filter
+        # context (a filter-placed script_score matches the same docs
+        # as a query-placed one)
+        needs_script = scoring or node.min_score is not None
+        mask, score = self._eval(node.query, scoring or needs_script)
+        if not needs_script:
+            return mask, score
+        scripted = self._run_score_script(node.script, score)
+        # the reference rejects negative script scores (since 7.x)
+        scripted = jnp.maximum(scripted, 0.0)
+        if node.min_score is not None:
+            mask = mask & (scripted >= node.min_score)
+        if not scoring:
+            return mask, jnp.zeros_like(scripted)
+        return mask, jnp.where(mask, scripted * node.boost,
+                               0.0).astype(jnp.float32)
+
+    def _field_value_factor(self, fvf: dict) -> jnp.ndarray:
+        """Per-doc factor from a doc-values column (reference:
+        FieldValueFactorFunction)."""
+        field = fvf["field"]
+        factor = float(fvf.get("factor", 1.0))
+        missing = fvf.get("missing")
+        vals, present = self._dv_column(field)
         if missing is None:
             # the reference errors on missing values without [missing];
             # a dense kernel can't throw per-doc, so treat as 0

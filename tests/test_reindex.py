@@ -109,9 +109,11 @@ class TestUpdateByQuery:
         _s, got = _handle(src, "GET", "/src/_doc/9")
         assert got["_source"]["touched"] == 1
 
-    def test_script_rejected(self, src):
+    def test_invalid_script_rejected(self, src):
+        # scripted UBQ is supported (tests/test_script.py); a script
+        # that fails to COMPILE must 400 before any doc is touched
         status, _ = _handle(src, "POST", "/src/_update_by_query", body={
-            "script": {"source": "ctx._source.x = 1"}})
+            "script": {"source": "ctx._source.x = "}})
         assert status == 400
 
 

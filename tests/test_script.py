@@ -1,0 +1,510 @@
+"""Restricted expression scripting — engine semantics plus the four
+subsystems it unlocks (SURVEY.md §2.1#42, §7.2.9): script_score,
+bucket_script/bucket_selector, the ingest script processor, scripted
+_update / _update_by_query / reindex."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.script import (CompiledScript, ScriptException,
+                                      compile_script)
+
+
+def _handle(node, method, path, params=None, body=None, raw=None):
+    if raw is not None:
+        payload = raw.encode("utf-8")
+    else:
+        payload = json.dumps(body).encode("utf-8") if body is not None \
+            else b""
+    return node.handle(method, path, params, None, payload)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def ranked(node):
+    docs = [
+        {"title": "alpha fox", "rank": 10, "price": 2.5},
+        {"title": "beta fox", "rank": 5, "price": 4.0},
+        {"title": "gamma fox", "rank": 2},          # price missing
+        {"title": "delta snail", "rank": 100, "price": 1.0},
+    ]
+    for i, d in enumerate(docs):
+        _handle(node, "PUT", f"/books/_doc/{i}",
+                params={"refresh": "true"}, body=d)
+    return node
+
+
+# ----------------------------------------------------------------------
+# engine semantics
+# ----------------------------------------------------------------------
+
+class TestEngine:
+    def test_arithmetic_precedence(self):
+        assert compile_script("1 + 2 * 3 - 4 / 2").execute({}) == 5
+        assert compile_script("(1 + 2) * 3").execute({}) == 9
+        assert compile_script("7 % 4").execute({}) == 3
+        assert compile_script("-2 * 3").execute({}) == -6
+
+    def test_math_functions_both_spellings(self):
+        assert compile_script("Math.log(Math.exp(2))").execute({}) \
+            == pytest.approx(2.0)
+        assert compile_script("log(exp(2))").execute({}) \
+            == pytest.approx(2.0)
+        assert compile_script("Math.max(3, Math.min(7, 5))").execute({}) \
+            == 5
+        assert compile_script("pow(2, 10)").execute({}) == 1024
+
+    def test_params(self):
+        s = compile_script({"source": "params.a * params.b",
+                            "params": {"a": 6, "b": 7}})
+        assert s.execute({}) == 42
+
+    def test_ternary_and_comparison(self):
+        s = compile_script("params.x > 10 ? 'big' : 'small'")
+        assert s.execute({"params": {"x": 11}}) == "big"
+        assert s.execute({"params": {"x": 3}}) == "small"
+
+    def test_boolean_ops_shortcircuit(self):
+        # RHS would throw (unknown var) — && must not evaluate it
+        s = compile_script("false && nosuchvar")
+        assert s.execute({}) is False
+
+    def test_string_methods_and_concat(self):
+        s = compile_script("('ab' + 'cd').toUpperCase().contains('BC')")
+        assert s.execute({}) is True
+        assert compile_script("'hello'.substring(1, 3)").execute({}) == "el"
+        assert compile_script("'a,b,c'.splitOnToken(',')").execute({}) \
+            == ["a", "b", "c"]
+
+    def test_statements_mutate_ctx(self):
+        s = compile_script(
+            "ctx._source.count += 1;"
+            "if (ctx._source.count >= 3) { ctx.op = 'delete' } "
+            "else { ctx._source.tag = 'low' }")
+        ctx = {"_source": {"count": 1}, "op": "index"}
+        s.execute({"ctx": ctx})
+        assert ctx == {"_source": {"count": 2, "tag": "low"},
+                       "op": "index"}
+        ctx2 = {"_source": {"count": 2}, "op": "index"}
+        s.execute({"ctx": ctx2})
+        assert ctx2["op"] == "delete"
+
+    def test_for_in_and_def(self):
+        s = compile_script(
+            "def total = 0;"
+            "for (x : ctx.values) { total += x }"
+            "ctx.sum = total; return total;")
+        ctx = {"values": [1, 2, 3, 4, 5]}
+        assert s.execute({"ctx": ctx}) == 15
+        assert ctx["sum"] == 15
+
+    def test_list_and_map_methods(self):
+        s = compile_script(
+            "if (!ctx.tags.contains('new')) { ctx.tags.add('new') }")
+        ctx = {"tags": ["old"]}
+        s.execute({"ctx": ctx})
+        s.execute({"ctx": ctx})  # idempotent thanks to contains()
+        assert ctx["tags"] == ["old", "new"]
+        s2 = compile_script("ctx.m.remove('a'); ctx.n = ctx.m.size()")
+        ctx2 = {"m": {"a": 1, "b": 2}}
+        s2.execute({"ctx": ctx2})
+        assert ctx2["m"] == {"b": 2} and ctx2["n"] == 1
+
+    def test_op_budget_stops_runaway(self):
+        # self-extending list would iterate forever without the budget
+        s = compile_script("for (x : ctx.l) { ctx.l.add(x) }")
+        with pytest.raises(ScriptException, match="budget"):
+            s.execute({"ctx": {"l": [1]}})
+
+    def test_rejections(self):
+        for bad in ("new HashMap()",
+                    "def x = ",
+                    "1 +",
+                    "if (true {",
+                    "x ===== 3"):
+            with pytest.raises(ScriptException):
+                compile_script(bad)
+        with pytest.raises(ScriptException, match="unknown function"):
+            compile_script("__import__('os')").execute({})
+        with pytest.raises(ScriptException, match="unknown method"):
+            compile_script("'x'.__class__()").execute({})
+        with pytest.raises(ScriptException, match="unknown variable"):
+            compile_script("open").execute({})
+        with pytest.raises(ScriptException, match="division by zero"):
+            compile_script("1 / 0").execute({})
+
+    def test_stored_scripts_and_bad_lang_rejected(self):
+        with pytest.raises(ScriptException, match="stored"):
+            compile_script({"id": "mylib"})
+        with pytest.raises(ScriptException, match="lang"):
+            compile_script({"source": "1", "lang": "groovy"})
+
+    def test_string_number_coercion_in_concat(self):
+        assert compile_script("'v=' + 3").execute({}) == "v=3"
+        assert compile_script("'b=' + true").execute({}) == "b=true"
+
+
+# ----------------------------------------------------------------------
+# script_score — query and function_score flavors (vectorized)
+# ----------------------------------------------------------------------
+
+class TestScriptScore:
+    def test_script_score_query_replaces_score(self, ranked):
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source": "doc['rank'].value * 2"}}},
+            "size": 10})
+        assert status == 200, res
+        hits = res["hits"]["hits"]
+        assert [h["_id"] for h in hits] == ["0", "1", "2"]
+        assert [h["_score"] for h in hits] == [20.0, 10.0, 4.0]
+
+    def test_script_score_sees_base_score(self, ranked):
+        base = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"match": {"title": "fox"}}, "size": 10})[1]
+        scores = {h["_id"]: h["_score"] for h in base["hits"]["hits"]}
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source": "_score * 10"}}},
+            "size": 10})
+        assert status == 200, res
+        for h in res["hits"]["hits"]:
+            assert h["_score"] == pytest.approx(
+                scores[h["_id"]] * 10, rel=1e-5)
+
+    def test_missing_value_and_ternary(self, ranked):
+        # doc['price'].empty branches per doc; missing price → fallback 9
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {
+                    "source": "doc['price'].empty ? 9.0 "
+                              ": doc['price'].value"}}},
+            "size": 10})
+        assert status == 200, res
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id == {"0": 2.5, "1": 4.0, "2": 9.0}
+
+    def test_min_score_filters(self, ranked):
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source": "doc['rank'].value"},
+                "min_score": 4}},
+            "size": 10})
+        assert status == 200, res
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"0", "1"}
+
+    def test_function_score_script_function(self, ranked):
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"function_score": {
+                "query": {"match": {"title": "fox"}},
+                "functions": [
+                    {"script_score": {"script":
+                        "Math.log(2 + doc['rank'].value)"}}],
+                "boost_mode": "replace"}},
+            "size": 10})
+        assert status == 200, res
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id["0"] == pytest.approx(math.log(12), rel=1e-5)
+        assert by_id["2"] == pytest.approx(math.log(4), rel=1e-5)
+
+    def test_saturation_helper(self, ranked):
+        # rank_feature-style saturation is exposed as a score function
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source":
+                           "saturation(doc['rank'].value, 5)"}}},
+            "size": 10})
+        assert status == 200, res
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id["0"] == pytest.approx(10 / 15, rel=1e-5)
+
+    def test_bad_script_is_400(self, ranked):
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match_all": {}},
+                "script": {"source": "doc['rank'].value +"}}}})
+        assert status == 400
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match_all": {}},
+                "script": {"source":
+                           "ctx.x = 1; doc['rank'].value"}}}})
+        assert status == 400  # statements rejected in score context
+
+    def test_min_score_applies_in_filter_context(self, ranked):
+        # filter-placed script_score must match the same docs as
+        # query-placed (min_score prunes matches, not just scores)
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"bool": {"filter": [{"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source": "doc['rank'].value"},
+                "min_score": 4}}]}},
+            "size": 10})
+        assert status == 200, res
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"0", "1"}
+
+    def test_highlight_through_script_score(self, ranked):
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source": "_score * 2"}}},
+            "highlight": {"fields": {"title": {}}},
+            "size": 10})
+        assert status == 200, res
+        h0 = [h for h in res["hits"]["hits"] if h["_id"] == "0"][0]
+        assert "<em>fox</em>" in h0["highlight"]["title"][0]
+
+    def test_float_suffix_and_not_operator(self, ranked):
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source":
+                           "!params.flag ? 1.5f : 3.0d",
+                           "params": {"flag": False}}}},
+            "size": 10})
+        assert status == 200, res
+        assert all(h["_score"] == 1.5 for h in res["hits"]["hits"])
+
+    def test_negative_scores_clamped(self, ranked):
+        status, res = _handle(ranked, "POST", "/books/_search", body={
+            "query": {"script_score": {
+                "query": {"match": {"title": "fox"}},
+                "script": {"source": "doc['rank'].value - 6"}}},
+            "size": 10})
+        assert status == 200, res
+        for h in res["hits"]["hits"]:
+            assert h["_score"] >= 0.0
+
+
+# ----------------------------------------------------------------------
+# bucket_script / bucket_selector
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def sales(node):
+    rows = [("2021-01-01", 10, 1), ("2021-01-05", 30, 3),
+            ("2021-02-02", 100, 2), ("2021-02-20", 50, 5),
+            ("2021-03-03", 8, 2)]
+    for i, (d, revenue, units) in enumerate(rows):
+        _handle(node, "PUT", f"/sales/_doc/{i}",
+                params={"refresh": "true"},
+                body={"date": d, "revenue": revenue, "units": units})
+    return node
+
+
+class TestBucketScriptSelector:
+    def _monthly(self, node, extra_aggs):
+        body = {"size": 0, "aggs": {"by_month": {
+            "date_histogram": {"field": "date",
+                               "calendar_interval": "month"},
+            "aggs": {
+                "revenue": {"sum": {"field": "revenue"}},
+                "units": {"sum": {"field": "units"}},
+                **extra_aggs}}}}
+        status, res = _handle(node, "POST", "/sales/_search", body=body)
+        assert status == 200, res
+        return res["aggregations"]["by_month"]["buckets"]
+
+    def test_bucket_script_per_unit_price(self, sales):
+        buckets = self._monthly(sales, {
+            "per_unit": {"bucket_script": {
+                "buckets_path": {"r": "revenue", "u": "units"},
+                "script": "params.r / params.u"}}})
+        assert buckets[0]["per_unit"]["value"] == pytest.approx(10.0)
+        assert buckets[1]["per_unit"]["value"] == pytest.approx(150 / 7)
+        assert buckets[2]["per_unit"]["value"] == pytest.approx(4.0)
+
+    def test_bucket_selector_drops_buckets(self, sales):
+        buckets = self._monthly(sales, {
+            "keep_big": {"bucket_selector": {
+                "buckets_path": {"r": "revenue"},
+                "script": "params.r >= 40"}}})
+        # Jan=40, Feb=150, Mar=8 → Mar dropped
+        assert len(buckets) == 2
+        assert [b["revenue"]["value"] for b in buckets] == [40.0, 150.0]
+
+    def test_count_path_and_compose(self, sales):
+        buckets = self._monthly(sales, {
+            "dense": {"bucket_selector": {
+                "buckets_path": {"c": "_count"},
+                "script": "params.c >= 2"}}})
+        assert all(b["doc_count"] >= 2 for b in buckets)
+
+    def test_bad_script_and_paths_400(self, sales):
+        body = {"size": 0, "aggs": {"m": {
+            "date_histogram": {"field": "date",
+                               "calendar_interval": "month"},
+            "aggs": {"x": {"bucket_script": {
+                "buckets_path": {"r": "revenue"},
+                "script": "params.r +"}}}}}}
+        status, _ = _handle(sales, "POST", "/sales/_search", body=body)
+        assert status == 400
+        body["aggs"]["m"]["aggs"]["x"]["bucket_script"] = {
+            "buckets_path": "notamap", "script": "1"}
+        status, _ = _handle(sales, "POST", "/sales/_search", body=body)
+        assert status == 400
+
+
+# ----------------------------------------------------------------------
+# ingest script processor
+# ----------------------------------------------------------------------
+
+class TestIngestScript:
+    def test_pipeline_script_processor(self, node):
+        status, _ = _handle(node, "PUT", "/_ingest/pipeline/pricer",
+                            body={"processors": [{"script": {
+                                "source": "ctx.total = ctx.price * "
+                                          "ctx.qty; "
+                                          "ctx.tier = ctx.total > 100 "
+                                          "? 'gold' : 'basic'"}}]})
+        assert status == 200
+        status, _ = _handle(node, "PUT", "/orders/_doc/1",
+                            params={"refresh": "true",
+                                    "pipeline": "pricer"},
+                            body={"price": 30, "qty": 5})
+        assert status in (200, 201)
+        _, doc = _handle(node, "GET", "/orders/_doc/1")
+        assert doc["_source"]["total"] == 150
+        assert doc["_source"]["tier"] == "gold"
+
+    def test_simulate_with_script(self, node):
+        status, res = _handle(node, "POST", "/_ingest/pipeline/_simulate",
+                              body={
+                                  "pipeline": {"processors": [{"script": {
+                                      "source": "ctx.v = ctx.a + ctx.b"}}]},
+                                  "docs": [{"_source": {"a": 1, "b": 2}}]})
+        assert status == 200, res
+        assert res["docs"][0]["doc"]["_source"]["v"] == 3
+
+    def test_bad_script_rejected_at_put(self, node):
+        status, res = _handle(node, "PUT", "/_ingest/pipeline/bad",
+                              body={"processors": [{"script": {
+                                  "source": "ctx.v ="}}]})
+        assert status == 400
+
+
+# ----------------------------------------------------------------------
+# scripted update / update_by_query / reindex
+# ----------------------------------------------------------------------
+
+class TestScriptedUpdate:
+    def test_update_with_script(self, node):
+        _handle(node, "PUT", "/inv/_doc/1", params={"refresh": "true"},
+                body={"stock": 5, "tags": ["a"]})
+        status, res = _handle(node, "POST", "/inv/_update/1", body={
+            "script": {"source": "ctx._source.stock -= params.n",
+                       "params": {"n": 2}}})
+        assert status == 200, res
+        assert res["result"] == "updated"
+        _, doc = _handle(node, "GET", "/inv/_doc/1")
+        assert doc["_source"]["stock"] == 3
+
+    def test_update_script_noop_and_delete(self, node):
+        _handle(node, "PUT", "/inv/_doc/2", params={"refresh": "true"},
+                body={"stock": 0})
+        status, res = _handle(node, "POST", "/inv/_update/2", body={
+            "script": "if (ctx._source.stock > 0) "
+                      "{ ctx._source.stock -= 1 } else { ctx.op = 'noop' }"})
+        assert status == 200 and res["result"] == "noop"
+        status, res = _handle(node, "POST", "/inv/_update/2", body={
+            "script": "ctx.op = 'delete'"})
+        assert status == 200 and res["result"] == "deleted"
+        status, _ = _handle(node, "GET", "/inv/_doc/2")
+        assert status == 404
+
+    def test_scripted_upsert(self, node):
+        _handle(node, "PUT", "/inv")  # _update never auto-creates
+        status, res = _handle(node, "POST", "/inv/_update/9", body={
+            "scripted_upsert": True,
+            "script": "ctx._source.visits = "
+                      "(ctx._source.containsKey('visits') ? "
+                      "ctx._source.visits : 0) + 1",
+            "upsert": {}})
+        assert status == 200, res
+        _, doc = _handle(node, "GET", "/inv/_doc/9")
+        assert doc["_source"]["visits"] == 1
+
+    def test_bulk_update_with_script(self, node):
+        _handle(node, "PUT", "/inv/_doc/7", params={"refresh": "true"},
+                body={"n": 1})
+        raw = ('{"update": {"_id": "7", "_index": "inv"}}\n'
+               '{"script": {"source": "ctx._source.n += 10"}}\n')
+        status, res = _handle(node, "POST", "/_bulk", raw=raw)
+        assert status == 200, res
+        item = res["items"][0]["update"]
+        assert item["status"] == 200 and item["result"] == "updated"
+        _, doc = _handle(node, "GET", "/inv/_doc/7")
+        assert doc["_source"]["n"] == 11
+
+    def test_ctx_rebind_rejected(self):
+        with pytest.raises(ScriptException, match="reassign"):
+            compile_script("ctx = 5").execute({"ctx": {}})
+
+    def test_update_doc_and_script_conflict_400(self, node):
+        _handle(node, "PUT", "/inv/_doc/3", params={"refresh": "true"},
+                body={"x": 1})
+        status, _ = _handle(node, "POST", "/inv/_update/3", body={
+            "doc": {"x": 2}, "script": "ctx._source.x = 3"})
+        assert status == 400
+
+    def test_update_by_query_script(self, node):
+        for i in range(5):
+            _handle(node, "PUT", f"/logs/_doc/{i}",
+                    params={"refresh": "true"},
+                    body={"level": "info" if i % 2 else "debug",
+                          "seen": 0})
+        status, res = _handle(node, "POST", "/logs/_update_by_query",
+                              body={
+                                  "query": {"term": {"level": "debug"}},
+                                  "script": "ctx._source.seen += 1"})
+        assert status == 200, res
+        assert res["updated"] == 3
+        _handle(node, "POST", "/logs/_refresh")
+        _, r = _handle(node, "POST", "/logs/_search", body={
+            "query": {"term": {"seen": 1}}, "size": 10})
+        assert r["hits"]["total"]["value"] == 3
+
+    def test_update_by_query_script_noop_counted(self, node):
+        for i in range(4):
+            _handle(node, "PUT", f"/m/_doc/{i}",
+                    params={"refresh": "true"}, body={"v": i})
+        status, res = _handle(node, "POST", "/m/_update_by_query", body={
+            "query": {"match_all": {}},
+            "script": "if (ctx._source.v < 2) { ctx._source.v += 10 } "
+                      "else { ctx.op = 'noop' }"})
+        assert status == 200, res
+        assert res["updated"] == 2 and res["noops"] == 2
+
+    def test_reindex_with_script(self, node):
+        for i in range(3):
+            _handle(node, "PUT", f"/src/_doc/{i}",
+                    params={"refresh": "true"}, body={"v": i})
+        status, res = _handle(node, "POST", "/_reindex", body={
+            "source": {"index": "src"}, "dest": {"index": "dst"},
+            "script": "ctx._source.v *= 100; "
+                      "if (ctx._source.v >= 200) { ctx.op = 'noop' }"})
+        assert status == 200, res
+        assert res["created"] == 2 and res["noops"] == 1
+        _handle(node, "POST", "/dst/_refresh")
+        _, r = _handle(node, "POST", "/dst/_search", body={
+            "query": {"match_all": {}}, "size": 10})
+        vs = sorted(h["_source"]["v"] for h in r["hits"]["hits"])
+        assert vs == [0, 100]
