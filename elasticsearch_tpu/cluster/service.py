@@ -60,6 +60,7 @@ ACTION_RECOVERY_FINISH = "indices/recovery/finish"
 ACTION_STORE_FOUND = "cluster/shard/store_found"
 ACTION_BULK = "indices/data/bulk_group"
 ACTION_QUERY_GROUP = "indices/data/search_group"
+ACTION_KNN_GROUP = "indices/data/knn_group"
 ACTION_COUNT_GROUP = "indices/data/count_group"
 # master-plane actions (reference: cluster:admin/*, internal:cluster/shard/*)
 ACTION_MAINTENANCE = "indices/data/maintenance"
@@ -256,6 +257,7 @@ class ClusterService:
                 (ACTION_DOC_OP, self._handle_doc_op),
                 (ACTION_BULK, self._handle_bulk_group),
                 (ACTION_QUERY_GROUP, self._handle_query_group),
+                (ACTION_KNN_GROUP, self._handle_knn_group),
                 (ACTION_REMOTE_SEARCH, self._handle_remote_search),
                 (ACTION_MAINTENANCE, self._handle_maintenance),
                 (ACTION_COUNT_GROUP, self._handle_count_group),
@@ -1436,6 +1438,11 @@ class ClusterService:
         coord.parse_search_body(body or {})
         by_node, addr, failed = self._route_shards(names)
 
+        if body and body.get("knn") is not None:
+            body, knn_failed = self._resolve_knn_phase(
+                body, by_node, addr, alias_filters)
+            failed += knn_failed
+
         futures: List[Tuple[str, Any]] = []
         local_targets: Optional[List[Tuple[str, int]]] = None
         for node_id, targets in sorted(by_node.items()):
@@ -1480,6 +1487,95 @@ class ClusterService:
         TransportSearchAction's cross-cluster fan-out)."""
         from elasticsearch_tpu import ccs
         return ccs.handle_remote_search(self.node, payload, from_node)
+
+    def _resolve_knn_phase(self, body, by_node, addr, alias_filters
+                           ) -> Tuple[Dict[str, Any], int]:
+        """Cluster-level knn candidate phase (reference: the knn half
+        of DfsQueryPhase): fan ACTION_KNN_GROUP to every shard group,
+        reduce to the GLOBAL top k per clause, ship the winners in the
+        `_knn_docs` body key. NOTE: candidates and the query phase
+        acquire separate readers; a refresh between the two phases can
+        drop a winner (same read-consistency window as the reference's
+        two-phase search without PIT)."""
+        from elasticsearch_tpu.search import coordinator as coord
+        from elasticsearch_tpu.search import knn as knn_mod
+        specs = knn_mod.parse_knn(body["knn"])
+        payload_body = {"knn": body["knn"],
+                        "index_filters": alias_filters}
+        futures = []
+        results = []
+        failed = 0
+        local_targets = None
+        for node_id, targets in sorted(by_node.items()):
+            if node_id == self.local_node.node_id:
+                local_targets = targets
+                continue
+            fut = self.transport.send_request_async(
+                addr[node_id], ACTION_KNN_GROUP,
+                {"targets": targets, **payload_body})
+            futures.append((node_id, fut))
+        if local_targets is not None:
+            # local matmuls AFTER the async sends: overlap with remote RTT
+            results.append(self._knn_group_local(
+                local_targets, specs, alias_filters))
+        for node_id, fut in futures:
+            try:
+                results.append(fut.result(timeout=60.0))
+            except Exception as exc:  # noqa: BLE001
+                failed += len(by_node.get(node_id, []))
+                logger.warning("knn candidates on [%s] failed: %s",
+                               node_id, exc)
+        # reduce: per clause, merge every shard's candidates → global k
+        knn_wrap: Dict[Tuple[str, int], list] = {}
+        for ci, spec in enumerate(specs):
+            per_shard = {}
+            for group in results:
+                for key, clause_lists in group.items():
+                    name, _, shard_s = key.rpartition("#")
+                    cands = [(float(s), seg, int(o), d)
+                             for s, seg, o, d in clause_lists[ci]]
+                    per_shard[(name, int(shard_s))] = cands
+            grouped = knn_mod.global_topk(per_shard, spec.k)
+            for shard_key, seg_map in grouped.items():
+                knn_wrap.setdefault(shard_key, []).append(
+                    (seg_map, spec.boost))
+        out_body = {k: v for k, v in body.items() if k != "knn"}
+        out_body["_knn_docs"] = coord.encode_knn_docs(knn_wrap)
+        return out_body, failed
+
+    def _knn_group_local(self, targets, specs, alias_filters
+                         ) -> Dict[str, Any]:
+        """Run the candidate phase over local shards → {"index#shard":
+        [per-clause [(score, seg, ord, doc_id), ...]]}."""
+        from elasticsearch_tpu.search import knn as knn_mod
+        from elasticsearch_tpu.search.coordinator import \
+            with_alias_filters
+        from elasticsearch_tpu.search import dsl
+        import dataclasses as _dc
+        out: Dict[str, Any] = {}
+        for name, shard_num in targets:
+            svc = self.node.indices.index(name)
+            reader = svc.shard(int(shard_num)).acquire_searcher()
+            clause_lists = []
+            for spec in specs:
+                eff = spec
+                afilts = (alias_filters or {}).get(name)
+                if afilts:
+                    base = spec.filter_query or dsl.MatchAllQuery()
+                    eff = _dc.replace(spec, filter_query=
+                                      with_alias_filters(base, afilts))
+                cands = knn_mod.shard_candidates(reader, eff)
+                clause_lists.append(
+                    [[s, seg, o, d] for s, seg, o, d in cands])
+            out[f"{name}#{int(shard_num)}"] = clause_lists
+        return out
+
+    def _handle_knn_group(self, payload, from_node) -> Dict[str, Any]:
+        from elasticsearch_tpu.search import knn as knn_mod
+        specs = knn_mod.parse_knn(payload["knn"])
+        targets = [(t[0], int(t[1])) for t in payload["targets"]]
+        return self._knn_group_local(targets, specs,
+                                     payload.get("index_filters"))
 
     def _handle_query_group(self, payload, from_node) -> Dict[str, Any]:
         from elasticsearch_tpu.search import coordinator as coord

@@ -84,10 +84,13 @@ class SegmentPack:
     dv_ord: Dict[str, np.ndarray]
     dv_ord_terms: Dict[str, List[str]]
     live_mask: np.ndarray  # bool[D_pad]; False for tombstoned/padded docs
+    # dense_vector matrices f32[D_pad, dims] (NaN rows = missing/padding)
+    # — the kNN brute-force operand, MXU-shaped (SURVEY.md §7.2.9)
+    dv_vec: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
     def nbytes(self) -> int:
         total = sum(f.nbytes() for f in self.fields.values())
-        for d in (self.dv_i64, self.dv_f64, self.dv_ord):
+        for d in (self.dv_i64, self.dv_f64, self.dv_ord, self.dv_vec):
             total += sum(a.nbytes for a in d.values())
         return total + self.live_mask.nbytes
 
@@ -135,8 +138,14 @@ def build_segment_pack(segment: Segment,
     dv_f64: Dict[str, np.ndarray] = {}
     dv_ord: Dict[str, np.ndarray] = {}
     dv_ord_terms: Dict[str, List[str]] = {}
+    dv_vec: Dict[str, np.ndarray] = {}
     for field, col in segment.doc_values.items():
-        if col.kind == "i64":
+        if col.kind == "vec":
+            dims = col.values.shape[1]
+            a = np.full((d_pad, dims), np.nan, dtype=np.float32)
+            a[: segment.num_docs] = col.values
+            dv_vec[field] = a
+        elif col.kind == "i64":
             a = np.full(d_pad, MISSING_I64, dtype=np.int64)
             a[: segment.num_docs] = col.values
             dv_i64[field] = a
@@ -155,4 +164,5 @@ def build_segment_pack(segment: Segment,
     else:
         live[: segment.num_docs] = True
     return SegmentPack(segment.name, segment.num_docs, d_pad, fields,
-                       dv_i64, dv_f64, dv_ord, dv_ord_terms, live)
+                       dv_i64, dv_f64, dv_ord, dv_ord_terms, live,
+                       dv_vec=dv_vec)

@@ -35,13 +35,16 @@ MISSING_I64 = -(2**63)
 
 @dataclasses.dataclass
 class DocValuesColumn:
-    kind: str  # "i64" | "f64" | "ord"
-    values: np.ndarray  # i64/f64; for "ord": i32 ordinals into ord_terms, -1 = missing
+    kind: str  # "i64" | "f64" | "ord" | "vec"
+    values: np.ndarray  # i64/f64; "ord": i32 ordinals, -1 = missing;
+    #                     "vec": f32[n, dims], NaN rows = missing
     # multi-valued docs: values stores the FIRST value; extra values per doc here
     extra: Dict[int, List[Any]]
     ord_terms: Optional[List[str]] = None  # sorted unique terms for "ord"
 
     def value_count(self) -> int:
+        if self.kind == "vec":
+            return int((~np.isnan(self.values).any(axis=1)).sum())
         return int((self.values != (MISSING_I64 if self.kind != "ord" else -1)).sum()) + sum(
             len(v) for v in self.extra.values()
         )
@@ -286,6 +289,14 @@ def _build_postings(entries: List[Tuple[int, List[str]]], n: int
 
 def _build_dv_column(kind: str, per_doc: Dict[int, Any], n: int) -> DocValuesColumn:
     extra: Dict[int, List[Any]] = {}
+    if kind == "vec":
+        # one fixed-dim vector per doc — the VALUE is the list; there is
+        # no multi-value flavor (the mapper rejects nested arrays)
+        dims = len(next(iter(per_doc.values()))) if per_doc else 0
+        values = np.full((n, max(dims, 1)), np.nan, dtype=np.float32)
+        for d, v in per_doc.items():
+            values[d] = np.asarray(v, dtype=np.float32)
+        return DocValuesColumn("vec", values, extra)
     if kind == "ord":
         uniq = set()
         for v in per_doc.values():
@@ -433,6 +444,12 @@ def merge_segments(name: str, segments: List[Segment],
             for old in range(len(col.values)):
                 new = int(m[old])
                 if new < 0:
+                    continue
+                if col.kind == "vec":
+                    row = col.values[old]
+                    if np.isnan(row).any():
+                        continue
+                    per_doc[new] = row
                     continue
                 if col.kind == "ord":
                     if col.values[old] < 0:

@@ -35,6 +35,7 @@ from elasticsearch_tpu.common.errors import MapperParsingException
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.mapping.types import (
     CompletionFieldType,
+    DenseVectorFieldType,
     FieldType,
     IpFieldType,
     RangeFieldType,
@@ -350,6 +351,12 @@ class MapperService:
             if isinstance(value, dict) and not value_is_object_field:
                 self._parse_object(value, path + ".", parsed,
                                    update_props)
+                continue
+            if isinstance(self.mapper.fields.get(path),
+                          DenseVectorFieldType):
+                # the ARRAY is the value — never flattened per element
+                self._index_values(self.mapper.fields[path], path,
+                                   [value], parsed)
                 continue
             values = value if isinstance(value, list) else [value]
             flat_values = []

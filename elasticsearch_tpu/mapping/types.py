@@ -435,6 +435,72 @@ class CompletionFieldType(FieldType):
         return str(value)
 
 
+class DenseVectorFieldType(FieldType):
+    """`dense_vector` — fixed-dim float vectors stored as one dense
+    [docs, dims] f32 matrix per segment (reference:
+    DenseVectorFieldMapper + kNN search, SURVEY.md §7.2.9,
+    BASELINE.json config #5). Where the reference wraps Lucene HNSW,
+    the TPU design is brute-force matmul top-k: a [D_pad, dims] @
+    [dims] matvec saturates the MXU and needs no graph structure —
+    exact (recall 1.0), not approximate."""
+
+    type_name = "dense_vector"
+    dv_kind = "vec"
+    is_indexed = False
+    SIMILARITIES = ("cosine", "dot_product", "l2_norm")
+    MAX_DIMS = 4096
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, params)
+        dims = (params or {}).get("dims")
+        if dims is None:
+            raise MapperParsingException(
+                f"[dense_vector] field [{name}] requires [dims]")
+        self.dims = int(dims)
+        if not 1 <= self.dims <= self.MAX_DIMS:
+            raise MapperParsingException(
+                f"[dense_vector] [dims] must be in [1, {self.MAX_DIMS}], "
+                f"got {self.dims}")
+        self.similarity = str((params or {}).get("similarity", "cosine"))
+        if self.similarity not in self.SIMILARITIES:
+            raise MapperParsingException(
+                f"[dense_vector] unknown similarity "
+                f"[{self.similarity}]; one of {self.SIMILARITIES}")
+
+    def parse_vector(self, value: Any) -> List[float]:
+        if not isinstance(value, list):
+            raise MapperParsingException(
+                f"field [{self.name}] of type [dense_vector] expects an "
+                f"array of numbers")
+        if len(value) != self.dims:
+            raise MapperParsingException(
+                f"field [{self.name}] has [dims={self.dims}] but a "
+                f"vector of length [{len(value)}] was provided")
+        out = []
+        for v in value:
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise MapperParsingException(
+                    f"field [{self.name}] vector entries must be "
+                    f"numbers, got [{v!r}]")
+            out.append(float(v))
+        return out
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [], 0
+
+    def doc_value(self, value: Any):
+        return self.parse_vector(value)
+
+    def normalize_term(self, value: Any) -> str:
+        raise MapperParsingException(
+            f"field [{self.name}] of type [dense_vector] does not "
+            f"support term queries")
+
+    def to_mapping(self) -> dict:
+        return {"type": "dense_vector", "dims": self.dims,
+                "similarity": self.similarity}
+
+
 def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
     """Build a FieldType from one field's mapping JSON."""
     t = mapping.get("type")
@@ -458,4 +524,6 @@ def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
         return RangeFieldType(name, t, params)
     if t == "completion":
         return CompletionFieldType(name, params)
+    if t == "dense_vector":
+        return DenseVectorFieldType(name, params)
     raise MapperParsingException(f"no handler for type [{t}] declared on field [{name}]")

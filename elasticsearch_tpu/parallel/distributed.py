@@ -766,3 +766,169 @@ def resolve_hits(pack: StackedShardPack,
                              "_score": score})
         out.append(hits)
     return out
+
+
+# ----------------------------------------------------------------------
+# distributed kNN: brute-force matmul top-k over the docs axis
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StackedVectorPack:
+    """S doc-axis shards of one dense_vector field as a [S, D_pad, dims]
+    f32 tensor (NaN rows = missing docs), sharded over the "shards"
+    mesh axis (SURVEY.md §7.2.9 / §2.3 P1 applied to vectors). The
+    matmul [D_pad, dims] @ [dims, B] per device is the MXU-native
+    replacement for the reference's per-query HNSW graph walk — exact
+    instead of approximate, batched instead of sequential."""
+
+    field: str
+    num_shards: int
+    d_pad: int
+    dims: int
+    vectors: np.ndarray          # f32[S, D_pad, dims]
+    live: np.ndarray             # bool[S, D_pad]
+    shard_doc_ids: List[List[str]]
+    similarity: str = "cosine"
+
+
+def build_stacked_vector_pack(segments: Sequence[Segment], field: str,
+                              live_docs: Optional[Sequence[Optional[np.ndarray]]] = None,
+                              similarity: str = "cosine",
+                              pad_shards_to: Optional[int] = None
+                              ) -> StackedVectorPack:
+    """Each segment is one doc-axis shard; shapes pad to the max."""
+    from elasticsearch_tpu.index.pack import _pad_to as pad_to
+    dims = 0
+    for seg in segments:
+        col = seg.doc_values.get(field)
+        if col is not None and col.kind == "vec":
+            dims = max(dims, col.values.shape[1])
+    if dims == 0:
+        raise ValueError(f"no dense_vector column [{field}] in segments")
+    d_pad = pad_to(max((s.num_docs for s in segments), default=1))
+    s = len(segments)
+    s_pad = max(pad_shards_to or s, s)
+    vectors = np.full((s_pad, d_pad, dims), np.nan, dtype=np.float32)
+    live = np.zeros((s_pad, d_pad), dtype=bool)
+    doc_ids: List[List[str]] = []
+    for i, seg in enumerate(segments):
+        col = seg.doc_values.get(field)
+        if col is not None and col.kind == "vec":
+            vectors[i, : seg.num_docs, : col.values.shape[1]] = col.values
+        if live_docs is not None and live_docs[i] is not None:
+            live[i, : seg.num_docs] = live_docs[i]
+        else:
+            live[i, : seg.num_docs] = True
+        doc_ids.append(list(seg.doc_ids))
+    return StackedVectorPack(field, s_pad, d_pad, dims, vectors, live,
+                             doc_ids, similarity)
+
+
+def _knn_local_body(vectors, live, queries, *, similarity: str, k: int,
+                    d_pad: int, first_shard):
+    """Per-device scores over an [s_l, D_pad, dims] block (s_l = shards
+    resident on this device): one flattened [B, s_l·D] matmul → local
+    top-k with global ids (same id scheme as the BM25 kernel:
+    shard · (d_pad+1) + ord)."""
+    s_l = vectors.shape[0]
+    flat = vectors.reshape(s_l * d_pad, -1)              # [N, dims]
+    safe = jnp.nan_to_num(flat)
+    present = ~jnp.isnan(flat[:, 0])
+    q = queries.astype(jnp.float32)                      # [B, dims]
+    if similarity == "l2_norm":
+        # ||d - q||^2 = ||d||^2 - 2 d.q + ||q||^2, one matmul
+        d2 = (jnp.sum(safe * safe, axis=1)[None, :]
+              - 2.0 * (q @ safe.T)
+              + jnp.sum(q * q, axis=1)[:, None])
+        scores = 1.0 / (1.0 + jnp.maximum(d2, 0.0))
+    elif similarity == "dot_product":
+        scores = (1.0 + q @ safe.T) / 2.0
+    else:  # cosine
+        dn = jnp.sqrt(jnp.sum(safe * safe, axis=1))      # [N]
+        qn = jnp.sqrt(jnp.sum(q * q, axis=1))            # [B]
+        cos = (q @ safe.T) / jnp.maximum(qn[:, None] * dn[None, :],
+                                         1e-12)
+        scores = (1.0 + cos) / 2.0
+    ok = present & live.reshape(s_l * d_pad)
+    scores = jnp.where(ok[None, :], scores, NEG_INF)     # [B, N]
+    vals, flat_idx = jax.lax.top_k(scores, min(k, s_l * d_pad))
+    j = (flat_idx // d_pad).astype(jnp.int64)
+    ords = (flat_idx % d_pad).astype(jnp.int64)
+    gids = (first_shard + j) * (d_pad + 1) + ords
+    gids = jnp.where(vals == NEG_INF, -1, gids)
+    return vals, gids
+
+
+@lru_cache(maxsize=32)
+def make_distributed_knn(mesh: Mesh, *, d_pad: int, dims: int, k: int,
+                         similarity: str):
+    """SPMD kNN step over the (data, shards) mesh: local matmul top-k
+    per device, all_gather over "shards", global top-k on device — the
+    identical collective shape as make_distributed_search, so BM25 and
+    kNN share the serving geometry (hybrid search reuses both)."""
+
+    def body(vectors, live, queries):
+        my = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int64)
+        s_l = vectors.shape[0]   # shards resident on this device
+        vals_b, gids_b = _knn_local_body(
+            vectors, live, queries, similarity=similarity, k=k,
+            d_pad=d_pad, first_shard=my * s_l)
+        all_vals = jax.lax.all_gather(vals_b, SHARD_AXIS, axis=1,
+                                      tiled=True)
+        all_ids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1,
+                                     tiled=True)
+        return _merge_topk(all_vals, all_ids, k)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None, None), P(SHARD_AXIS, None),
+                  P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def distributed_knn(pack: StackedVectorPack, queries: np.ndarray, k: int,
+                    mesh: Optional[Mesh] = None,
+                    device_arrays: Optional[Tuple] = None):
+    """Batched exact kNN: queries [B, dims] → (scores [B, k], refs
+    [[(score, shard, ord), ...]]). Single-device fallback when mesh is
+    None (one chip: plain vmap-free matmul, same math)."""
+    q = np.asarray(queries, dtype=np.float32)
+    if q.ndim == 1:
+        q = q[None, :]
+    if mesh is not None:
+        step = make_distributed_knn(mesh, d_pad=pack.d_pad,
+                                    dims=pack.dims, k=k,
+                                    similarity=pack.similarity)
+        if device_arrays is not None:
+            vectors, live = device_arrays
+        else:
+            vectors, live = device_put_vector_pack(pack, mesh)
+        vals, gids = step(vectors, live, jnp.asarray(q))
+    else:
+        vals, gids = _knn_local_body(
+            jnp.asarray(pack.vectors), jnp.asarray(pack.live),
+            jnp.asarray(q), similarity=pack.similarity, k=k,
+            d_pad=pack.d_pad, first_shard=jnp.int64(0))
+        vals, gids = _merge_topk(vals, gids, k)
+    vals = np.asarray(vals)
+    gids = np.asarray(gids)
+    refs = []
+    for qi in range(vals.shape[0]):
+        row = []
+        for v, gid in zip(vals[qi], gids[qi]):
+            if v == NEG_INF or gid < 0:
+                continue
+            shard, ord_ = divmod(int(gid), pack.d_pad + 1)
+            row.append((float(v), shard, ord_))
+        refs.append(row)
+    return vals, refs
+
+
+def device_put_vector_pack(pack: StackedVectorPack, mesh: Mesh):
+    """Place the vector tensor with NamedSharding over "shards"."""
+    sh = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+    sh2 = NamedSharding(mesh, P(SHARD_AXIS, None))
+    return (jax.device_put(pack.vectors, sh),
+            jax.device_put(pack.live, sh2))

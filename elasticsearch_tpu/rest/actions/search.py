@@ -17,6 +17,12 @@ def register(controller: RestController, node) -> None:
         """One search request — pit bodies, cluster routing, and the
         local planner all covered (shared by _search and _msearch so an
         item body never silently drops a key)."""
+        if "_knn_docs" in (body or {}):
+            # internal wire key (resolved knn winners between cluster
+            # coordinator and shard groups) — never client-settable: it
+            # would inject arbitrary per-doc scores past knn validation
+            raise IllegalArgumentException(
+                "unknown search body keys ['_knn_docs']")
         from elasticsearch_tpu.search import scroll as scroll_mod
         if "pit" in body:
             if not isinstance(body["pit"], dict):

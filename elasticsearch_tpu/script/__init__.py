@@ -678,10 +678,12 @@ class _VectorEval:
     matching lang-expression, which is expression-only too."""
 
     def __init__(self, resolver: Callable[[str], FieldColumn],
-                 variables: Dict[str, Any]):
+                 variables: Dict[str, Any],
+                 vec_resolver: Optional[Callable[[str], Any]] = None):
         import jax.numpy as jnp
         self.jnp = jnp
         self.resolver = resolver
+        self.vec_resolver = vec_resolver
         self.vars = variables
 
     def eval(self, node):
@@ -809,6 +811,8 @@ class _VectorEval:
             raise ScriptException(
                 f"method calls on [{type(recv).__name__}] are not "
                 "allowed in score scripts")
+        if name in ("cosineSimilarity", "dotProduct", "l2norm"):
+            return self._vector_similarity(name, arg_exprs)
         fn = _VECTOR_FUNCS.get(name)
         if fn is None:
             raise ScriptException(f"unknown function [{name}]")
@@ -817,6 +821,45 @@ class _VectorEval:
             return fn(jnp, *args)
         except TypeError as e:
             raise ScriptException(f"[{name}] failed: {e}") from None
+
+    def _vector_similarity(self, name, arg_exprs):
+        """cosineSimilarity(params.qv, 'field') / dotProduct / l2norm —
+        the reference's score-script vector access (denseVector
+        functions of DenseVectorFieldMapper), evaluated as one matvec
+        over the segment's [docs, dims] matrix."""
+        jnp = self.jnp
+        if self.vec_resolver is None:
+            raise ScriptException(
+                f"[{name}] is only available in document score context")
+        if len(arg_exprs) != 2:
+            raise ScriptException(
+                f"[{name}] takes (query_vector, field)")
+        qv = self.eval(arg_exprs[0])
+        if not isinstance(qv, list) or not all(
+                isinstance(x, (int, float)) and not isinstance(x, bool)
+                for x in qv):
+            raise ScriptException(
+                f"[{name}] first argument must be an array of numbers "
+                f"(e.g. params.query_vector)")
+        fexpr = arg_exprs[1]
+        if fexpr[0] != "str":
+            raise ScriptException(
+                f"[{name}] second argument must be a field name string")
+        mat = self.vec_resolver(fexpr[1])  # f32[docs, dims] (NaN = missing)
+        q = jnp.asarray(qv, dtype=jnp.float32)
+        if mat.shape[1] != q.shape[0]:
+            raise ScriptException(
+                f"[{name}] query vector has length {q.shape[0]} but "
+                f"field [{fexpr[1]}] has dims {mat.shape[1]}")
+        safe = jnp.nan_to_num(mat)
+        if name == "l2norm":
+            return jnp.sqrt(jnp.sum((safe - q[None, :]) ** 2, axis=1))
+        dot = safe @ q
+        if name == "dotProduct":
+            return dot
+        norms = jnp.sqrt(jnp.sum(safe * safe, axis=1))
+        qn = jnp.sqrt(jnp.sum(q * q))
+        return dot / jnp.maximum(norms * qn, 1e-12)
 
 
 _DOC_SENTINEL = object()
@@ -897,10 +940,12 @@ class CompiledScript:
 
     # -- vector --
     def score_vector(self, resolver: Callable[[str], FieldColumn],
-                     score) -> Any:
+                     score, vec_resolver: Optional[Callable] = None
+                     ) -> Any:
         """Evaluate as one array program: `_score` is the base score
-        array, `doc['f']` resolves through `resolver`. Returns the
-        per-doc score array (float32)."""
+        array, `doc['f']` resolves through `resolver`, dense_vector
+        fields through `vec_resolver` (cosineSimilarity et al.).
+        Returns the per-doc score array (float32)."""
         if not self.is_expression:
             raise ScriptException(
                 "score scripts must be a single expression "
@@ -911,7 +956,8 @@ class CompiledScript:
         if expr is None:
             raise ScriptException("score script returns nothing")
         ev = _VectorEval(resolver, {"_score": score,
-                                    "params": dict(self.params)})
+                                    "params": dict(self.params)},
+                         vec_resolver=vec_resolver)
         import jax.numpy as jnp
         out = ev.eval(expr)
         if isinstance(out, (int, float)):

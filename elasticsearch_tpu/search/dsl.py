@@ -210,6 +210,25 @@ class FunctionScoreQuery(QueryNode):
 
 
 @dataclasses.dataclass
+class KnnScoreDocQuery(QueryNode):
+    """The coordinator-rewritten form of a `knn` search clause
+    (reference: KnnScoreDocQueryBuilder): the GLOBAL top-k winners of
+    the candidate phase, pinned to exact (segment, ord, score) triples
+    for ONE shard. Unioned with the text query: matching docs score
+    query_score + Σ knn_score·boost (the reference's hybrid rule).
+    Never parsed from JSON — built by search/knn.py."""
+
+    query: Optional[QueryNode] = None
+    # one {segment_name: (ords i64[], scores f32[])} map per knn clause
+    doc_sets: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    boosts: List[float] = dataclasses.field(default_factory=list)
+
+    def query_name(self) -> str:
+        return "knn_score_doc"
+
+
+@dataclasses.dataclass
 class BoolQuery(QueryNode):
     must: List[QueryNode] = dataclasses.field(default_factory=list)
     should: List[QueryNode] = dataclasses.field(default_factory=list)

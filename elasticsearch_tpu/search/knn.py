@@ -1,0 +1,203 @@
+"""kNN search over dense_vector fields — brute-force matmul top-k.
+
+Reference: the `knn` search section + KnnScoreDocQueryBuilder
+(SURVEY.md §7.2.9, BASELINE.json config #5). The reference wraps
+Lucene HNSW (approximate, graph-walk per query); the TPU design is a
+dense [D_pad, dims] @ [dims, B] matmul per segment — the single most
+MXU-friendly workload in the blueprint — giving EXACT top-k (recall
+1.0 by construction), batched across queries.
+
+Phase shape mirrors the reference's two-phase knn:
+  1. candidate phase (`shard_candidates`): every shard scores its
+     vectors against the query, returns its top `num_candidates`;
+  2. the coordinator keeps the GLOBAL top k per clause and rewrites
+     them into per-shard KnnScoreDocQuery nodes (dsl.KnnScoreDocQuery)
+     that the normal query phase unions with the text query —
+     hybrid BM25 + kNN scoring is (query_score + knn_score·boost) on
+     docs in both sets, exactly the reference's combination rule.
+
+Similarity → score maps (reference: DenseVectorFieldMapper):
+  cosine      → (1 + cos(q, d)) / 2
+  dot_product → (1 + q·d) / 2        (vectors should be unit-norm)
+  l2_norm     → 1 / (1 + ||q - d||²)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.search import dsl
+
+
+@dataclasses.dataclass
+class KnnSpec:
+    field: str
+    query_vector: np.ndarray     # f32[dims]
+    k: int
+    num_candidates: int
+    filter_query: Optional[dsl.QueryNode] = None
+    boost: float = 1.0
+    similarity: Optional[float] = None  # min raw-similarity cutoff
+
+
+def parse_knn(spec: Any) -> List[KnnSpec]:
+    """The `knn` search-body section: one object or a list of them
+    (reference: RestSearchAction knn parsing)."""
+    specs = spec if isinstance(spec, list) else [spec]
+    out: List[KnnSpec] = []
+    for s in specs:
+        if not isinstance(s, dict):
+            raise IllegalArgumentException("[knn] must be an object")
+        unknown = set(s) - {"field", "query_vector", "k",
+                            "num_candidates", "filter", "boost",
+                            "similarity"}
+        if unknown:
+            raise IllegalArgumentException(
+                f"[knn] unknown parameter {sorted(unknown)}")
+        field = s.get("field")
+        qv = s.get("query_vector")
+        if not field or qv is None:
+            raise IllegalArgumentException(
+                "[knn] requires [field] and [query_vector]")
+        if not isinstance(qv, list) or not qv or not all(
+                isinstance(x, (int, float)) and not isinstance(x, bool)
+                for x in qv):
+            raise IllegalArgumentException(
+                "[knn] [query_vector] must be a non-empty array of "
+                "numbers")
+        k = int(s.get("k", 10))
+        num_candidates = int(s.get("num_candidates", max(k * 10, 100)))
+        if k < 1:
+            raise IllegalArgumentException("[knn] [k] must be >= 1")
+        if num_candidates < k:
+            raise IllegalArgumentException(
+                f"[knn] [num_candidates] ({num_candidates}) cannot be "
+                f"less than [k] ({k})")
+        filt = None
+        if s.get("filter") is not None:
+            f = s["filter"]
+            if isinstance(f, list):
+                filt = dsl.BoolQuery(filter=[dsl.parse_query(x)
+                                             for x in f])
+            else:
+                filt = dsl.parse_query(f)
+        out.append(KnnSpec(
+            field=str(field),
+            query_vector=np.asarray(qv, dtype=np.float32),
+            k=k, num_candidates=num_candidates, filter_query=filt,
+            boost=float(s.get("boost", 1.0)),
+            similarity=(None if s.get("similarity") is None
+                        else float(s["similarity"]))))
+    return out
+
+
+def _similarity_scores(vectors: jnp.ndarray, q: jnp.ndarray,
+                       kind: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (raw similarity, score) per doc row. NaN rows (missing docs)
+    yield NaN; callers mask them."""
+    if kind == "l2_norm":
+        d2 = jnp.sum((vectors - q[None, :]) ** 2, axis=1)
+        return -jnp.sqrt(d2), 1.0 / (1.0 + d2)
+    dot = vectors @ q
+    if kind == "dot_product":
+        return dot, (1.0 + dot) / 2.0
+    # cosine
+    norms = jnp.sqrt(jnp.sum(vectors * vectors, axis=1))
+    qn = jnp.sqrt(jnp.sum(q * q))
+    cos = dot / jnp.maximum(norms * qn, 1e-12)
+    return cos, (1.0 + cos) / 2.0
+
+
+def shard_candidates(reader, spec: KnnSpec
+                     ) -> List[Tuple[float, str, int, str]]:
+    """Candidate phase on one shard: → [(score, segment_name, ord,
+    doc_id)] top num_candidates (score desc, already
+    similarity-filtered and live/filter-masked)."""
+    ft = reader.mapper.field_type(spec.field)
+    from elasticsearch_tpu.mapping.types import DenseVectorFieldType
+    if ft is None or not isinstance(ft, DenseVectorFieldType):
+        raise IllegalArgumentException(
+            f"[knn] field [{spec.field}] is not a [dense_vector] field")
+    if len(spec.query_vector) != ft.dims:
+        raise IllegalArgumentException(
+            f"[knn] query_vector has length [{len(spec.query_vector)}] "
+            f"but field [{spec.field}] has [dims={ft.dims}]")
+    out: List[Tuple[float, str, int, str]] = []
+    q = jnp.asarray(spec.query_vector)
+    for idx, view in enumerate(reader.views):
+        mat = view.pack.dv_vec.get(spec.field)
+        if mat is None:
+            continue
+        vectors = jnp.asarray(mat)
+        raw, score = _similarity_scores(vectors, q, ft.similarity)
+        ok = ~jnp.isnan(raw) & jnp.asarray(view.live_mask)
+        if spec.filter_query is not None:
+            from elasticsearch_tpu.search.planner import \
+                SegmentQueryExecutor
+            fmask, _ = SegmentQueryExecutor(reader, idx)._eval(
+                spec.filter_query, scoring=False)
+            ok = ok & fmask
+        if spec.similarity is not None:
+            # reference semantics: for cosine/dot_product `similarity`
+            # is the MIN raw similarity; for l2_norm it is the MAX
+            # distance (raw here is -distance, so flip the sign)
+            if ft.similarity == "l2_norm":
+                ok = ok & (raw >= -spec.similarity)
+            else:
+                ok = ok & (raw >= spec.similarity)
+        score = jnp.where(ok, score, -jnp.inf)
+        n = min(spec.num_candidates, int(score.shape[0]))
+        vals, ords = jax.lax.top_k(score, n)
+        vals = np.asarray(vals)
+        ords = np.asarray(ords)
+        seg = view.segment
+        for v, d in zip(vals, ords):
+            if v == -np.inf:
+                break
+            out.append((float(v), seg.name, int(d),
+                        seg.doc_ids[int(d)]))
+    out.sort(key=lambda t: (-t[0], t[1], t[2]))
+    return out[: spec.num_candidates]
+
+
+def global_topk(per_shard: Dict[Tuple[str, int], List[Tuple[float, str, int, str]]],
+                k: int) -> Dict[Tuple[str, int], Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+    """Reduce candidate lists from every shard to the GLOBAL top k,
+    then re-group by shard → {segment_name: (ords, scores)} for the
+    KnnScoreDocQuery rewrite (reference: the coordinator's
+    knn-results-per-shard in DfsQueryPhase)."""
+    merged: List[Tuple[float, Tuple[str, int], str, int]] = []
+    for shard_key, cands in per_shard.items():
+        for score, seg_name, ord_, _doc_id in cands:
+            merged.append((score, shard_key, seg_name, ord_))
+    merged.sort(key=lambda t: (-t[0], t[1], t[2], t[3]))
+    winners = merged[:k]
+    grouped: Dict[Tuple[str, int], Dict[str, Tuple[List[int], List[float]]]] = {}
+    for score, shard_key, seg_name, ord_ in winners:
+        seg_map = grouped.setdefault(shard_key, {})
+        ords, scores = seg_map.setdefault(seg_name, ([], []))
+        ords.append(ord_)
+        scores.append(score)
+    return {
+        shard: {seg: (np.asarray(o, dtype=np.int64),
+                      np.asarray(s, dtype=np.float32))
+                for seg, (o, s) in seg_map.items()}
+        for shard, seg_map in grouped.items()}
+
+
+def wrap_query(base: Optional[dsl.QueryNode],
+               knn_doc_sets: List[Tuple[Dict[str, Tuple[np.ndarray, np.ndarray]], float]]
+               ) -> dsl.QueryNode:
+    """base query + resolved knn winners → the per-shard union node the
+    query phase executes. knn_doc_sets: one (segment→(ords, scores),
+    boost) entry per knn clause."""
+    return dsl.KnnScoreDocQuery(
+        query=base,
+        doc_sets=[ds for ds, _ in knn_doc_sets],
+        boosts=[b for _, b in knn_doc_sets])

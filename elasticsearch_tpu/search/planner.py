@@ -158,6 +158,8 @@ class SegmentQueryExecutor:
             return self._eval_function_score(node, scoring)
         if isinstance(node, dsl.ScriptScoreQuery):
             return self._eval_script_score(node, scoring)
+        if isinstance(node, dsl.KnnScoreDocQuery):
+            return self._eval_knn_score_doc(node, scoring)
         if isinstance(node, dsl.NestedQuery):
             return self._eval_nested(node, scoring)
         if hasattr(node, "evaluate"):
@@ -327,6 +329,32 @@ class SegmentQueryExecutor:
             final = jnp.minimum(score, combined)
         return mask, jnp.where(mask, final * node.boost, 0.0)
 
+    def _eval_knn_score_doc(self, node: dsl.KnnScoreDocQuery,
+                            scoring: bool):
+        """Union of the base query with pinned knn winners: a doc
+        matches if the query matches OR it is a knn winner; its score
+        is query_score + Σ knn_score·boost (reference hybrid rule)."""
+        seg_name = self.view.segment.name
+        knn_mask = np.zeros(self.d_pad, dtype=bool)
+        knn_score = np.zeros(self.d_pad, dtype=np.float32)
+        for doc_set, boost in zip(node.doc_sets, node.boosts):
+            entry = doc_set.get(seg_name)
+            if entry is None:
+                continue
+            ords, scores = entry
+            knn_mask[ords] = True
+            knn_score[ords] += scores * boost
+        kmask = jnp.asarray(knn_mask)
+        kscore = jnp.asarray(knn_score)
+        if node.query is None:
+            return kmask, (kscore if scoring
+                           else jnp.zeros_like(kscore))
+        bmask, bscore = self._eval(node.query, scoring)
+        mask = bmask | kmask
+        if not scoring:
+            return mask, jnp.zeros_like(kscore)
+        return mask, jnp.where(bmask, bscore, 0.0) + kscore
+
     def _dv_column(self, field: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Numeric doc-values column → (values_f32, present_mask); the
         one extraction both score scripts and field_value_factor use."""
@@ -351,10 +379,21 @@ class SegmentQueryExecutor:
         vals, present = self._dv_column(field)
         return FieldColumn(jnp.where(present, vals, 0.0), present)
 
+    def _vec_column(self, field: str) -> jnp.ndarray:
+        """dense_vector matrix f32[d_pad, dims] for score scripts
+        (cosineSimilarity et al.); unknown field → 400."""
+        mat = self.view.pack.dv_vec.get(field)
+        if mat is None:
+            from elasticsearch_tpu.script import ScriptException
+            raise ScriptException(
+                f"[{field}] is not a dense_vector field")
+        return jnp.asarray(mat)
+
     def _run_score_script(self, script, base_score) -> jnp.ndarray:
         from elasticsearch_tpu.script import ScriptException
         try:
-            return script.score_vector(self._script_resolver, base_score)
+            return script.score_vector(self._script_resolver, base_score,
+                                       vec_resolver=self._vec_column)
         except ScriptException:
             raise
         except Exception as e:  # noqa: BLE001 — surface as a 400

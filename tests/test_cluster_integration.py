@@ -392,3 +392,51 @@ def test_delete_index_everywhere(cluster):
     assert not any(n.indices.has_index("auto") for n in cluster)
     status, _ = _handle(cluster[0], "GET", "/auto/_doc/1")
     assert status == 404
+
+
+def test_knn_across_nodes(cluster):
+    """Distributed kNN: candidate phase fans out over the transport,
+    global top-k reduces at the coordinator, hybrid union scores
+    (SURVEY.md §7.2.9; the DfsQueryPhase-for-knn shape)."""
+    import numpy as np
+    status, _ = _handle(cluster[0], "PUT", "/vecs", body={
+        "settings": {"number_of_shards": 3, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "e": {"type": "dense_vector", "dims": 4},
+            "title": {"type": "text"}}}})
+    assert status == 200
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        h = cluster[1].cluster.health()
+        if h["status"] == "green" and h["active_primary_shards"] >= 3:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(cluster[1].cluster.health())
+    rng = np.random.RandomState(3)
+    vecs = {}
+    for i in range(24):
+        v = rng.randn(4).tolist()
+        vecs[str(i)] = v
+        status, _ = _handle(cluster[i % 3], "PUT", f"/vecs/_doc/{i}",
+                            body={"e": v, "title": f"doc {i}"})
+        assert status in (200, 201)
+    _handle(cluster[0], "POST", "/vecs/_refresh")
+    q = rng.randn(4).tolist()
+
+    def cos(a, b):
+        a, b = np.asarray(a), np.asarray(b)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+    oracle = sorted(vecs, key=lambda d: -cos(q, vecs[d]))[:5]
+    # any node can coordinate; ranking must be the global one
+    for node in cluster:
+        status, res = _handle(node, "POST", "/vecs/_search", body={
+            "knn": {"field": "e", "query_vector": q, "k": 5,
+                    "num_candidates": 20}})
+        assert status == 200, res
+        got = [h["_id"] for h in res["hits"]["hits"]]
+        assert got == oracle, (got, oracle)
+        for h in res["hits"]["hits"]:
+            assert h["_score"] == pytest.approx(
+                (1 + cos(q, vecs[h["_id"]])) / 2, rel=1e-4)

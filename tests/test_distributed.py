@@ -130,3 +130,91 @@ class TestDistributedSearch:
             d1 = set(int(x) for x in p.get("w1", (np.array([]), 0))[0])
             expected |= {(si, d) for d in d0 & d1}
         assert got == expected
+
+
+class TestDistributedKnn:
+    """Mesh-sharded brute-force kNN (SURVEY.md §7.2.9): exact vs a
+    numpy oracle, every similarity, shards sharded over the mesh."""
+
+    @pytest.fixture
+    def mesh(self):
+        return make_mesh()
+
+    def _make_vec_segments(self, rng, n_shards, docs_per_shard, dims):
+        from elasticsearch_tpu.index.segment import SegmentWriter
+        from elasticsearch_tpu.mapping import ParsedDocument
+        segments, all_vecs, all_ids = [], [], []
+        for s in range(n_shards):
+            w = SegmentWriter(f"seg{s}")
+            for d in range(docs_per_shard):
+                vec = rng.standard_normal(dims).astype(np.float32)
+                doc_id = f"s{s}d{d}"
+                pd = ParsedDocument(
+                    doc_id=doc_id, routing=None,
+                    source={"e": vec.tolist()},
+                    postings_terms={}, field_lengths={},
+                    doc_values={"e": vec.tolist()}, term_slots={},
+                    nested={})
+                w.add_document(pd, {"e": "vec"})
+                all_vecs.append(vec)
+                all_ids.append(doc_id)
+            segments.append(w.freeze())
+        return segments, np.stack(all_vecs), all_ids
+
+    @pytest.mark.parametrize("similarity", ["cosine", "dot_product",
+                                            "l2_norm"])
+    def test_matches_oracle(self, seeded_np, mesh, similarity):
+        n_shards = mesh.shape["shards"]
+        segments, mat, ids = self._make_vec_segments(
+            seeded_np, n_shards, 40, 16)
+        pack = dist.build_stacked_vector_pack(
+            segments, "e", similarity=similarity)
+        q = seeded_np.standard_normal((3, 16)).astype(np.float32)
+        vals, refs = dist.distributed_knn(pack, q, 10, mesh)
+        for qi in range(3):
+            if similarity == "l2_norm":
+                d2 = ((mat - q[qi]) ** 2).sum(axis=1)
+                oracle_scores = 1.0 / (1.0 + d2)
+            elif similarity == "dot_product":
+                oracle_scores = (1.0 + mat @ q[qi]) / 2.0
+            else:
+                cos = (mat @ q[qi]) / (
+                    np.linalg.norm(mat, axis=1) * np.linalg.norm(q[qi]))
+                oracle_scores = (1.0 + cos) / 2.0
+            oracle_order = np.argsort(-oracle_scores)[:10]
+            got_ids = []
+            for score, shard, ord_ in refs[qi]:
+                got_ids.append(pack.shard_doc_ids[shard][ord_])
+            assert got_ids == [ids[i] for i in oracle_order]
+            np.testing.assert_allclose(
+                [v for v in vals[qi] if v != dist.NEG_INF][:10],
+                oracle_scores[oracle_order], rtol=2e-4)
+
+    def test_single_device_fallback_matches_mesh(self, seeded_np, mesh):
+        segments, mat, ids = self._make_vec_segments(
+            seeded_np, mesh.shape["shards"], 25, 8)
+        pack = dist.build_stacked_vector_pack(segments, "e")
+        q = seeded_np.standard_normal((2, 8)).astype(np.float32)
+        vals_m, refs_m = dist.distributed_knn(pack, q, 5, mesh)
+        vals_s, refs_s = dist.distributed_knn(pack, q, 5, None)
+        np.testing.assert_allclose(vals_m, vals_s, rtol=1e-5)
+        assert refs_m == refs_s
+
+    def test_tombstones_excluded(self, seeded_np, mesh):
+        n_shards = mesh.shape["shards"]
+        segments, mat, ids = self._make_vec_segments(
+            seeded_np, n_shards, 20, 4)
+        live = []
+        dead = set()
+        for s, seg in enumerate(segments):
+            m = np.ones(seg.num_docs, dtype=bool)
+            m[3] = False
+            dead.add(f"s{s}d3")
+            live.append(m)
+        pack = dist.build_stacked_vector_pack(segments, "e",
+                                              live_docs=live)
+        q = seeded_np.standard_normal((1, 4)).astype(np.float32)
+        _, refs = dist.distributed_knn(pack, q, 200, mesh)
+        got = {pack.shard_doc_ids[s][o] for _, s, o in refs[0]}
+        assert not (got & dead)
+        assert len(got) == n_shards * 20 - len(dead)

@@ -1,0 +1,304 @@
+"""dense_vector + kNN search (SURVEY.md §7.2.9, BASELINE.json config
+#5): mapping validation, exact brute-force top-k vs a numpy oracle,
+similarity formulas, filters, hybrid BM25+kNN union scoring,
+multi-segment/tombstone behavior, persistence, and the
+cosineSimilarity score-script path."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+VECS = {
+    "0": [1.0, 0.0, 0.0, 0.0],
+    "1": [0.9, 0.1, 0.0, 0.0],
+    "2": [0.0, 1.0, 0.0, 0.0],
+    "3": [0.0, 0.0, 1.0, 0.0],
+    "4": [0.5, 0.5, 0.0, 0.0],
+}
+
+
+@pytest.fixture
+def vecindex(node):
+    _handle(node, "PUT", "/v", body={"mappings": {"properties": {
+        "emb": {"type": "dense_vector", "dims": 4,
+                "similarity": "cosine"},
+        "color": {"type": "keyword"},
+        "title": {"type": "text"}}}})
+    for doc_id, v in VECS.items():
+        _handle(node, "PUT", f"/v/_doc/{doc_id}",
+                params={"refresh": "true"},
+                body={"emb": v, "color": "red" if int(doc_id) % 2 == 0
+                      else "blue", "title": f"doc {doc_id} fox"})
+    return node
+
+
+def _cos(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+
+class TestMapping:
+    def test_requires_dims(self, node):
+        status, res = _handle(node, "PUT", "/bad", body={"mappings": {
+            "properties": {"e": {"type": "dense_vector"}}}})
+        assert status == 400
+
+    def test_rejects_wrong_length_vector(self, vecindex):
+        status, res = _handle(vecindex, "PUT", "/v/_doc/x",
+                              body={"emb": [1.0, 2.0]})
+        assert status == 400
+
+    def test_rejects_bad_similarity(self, node):
+        status, _ = _handle(node, "PUT", "/bad", body={"mappings": {
+            "properties": {"e": {"type": "dense_vector", "dims": 2,
+                                 "similarity": "hamming"}}}})
+        assert status == 400
+
+    def test_mapping_roundtrip(self, vecindex):
+        _, res = _handle(vecindex, "GET", "/v/_mapping")
+        emb = res["v"]["mappings"]["properties"]["emb"]
+        assert emb == {"type": "dense_vector", "dims": 4,
+                       "similarity": "cosine"}
+
+
+class TestKnnSearch:
+    def test_knn_only_exact_order(self, vecindex):
+        q = [1.0, 0.05, 0.0, 0.0]
+        status, res = _handle(vecindex, "POST", "/v/_search", body={
+            "knn": {"field": "emb", "query_vector": q, "k": 3,
+                    "num_candidates": 10}})
+        assert status == 200, res
+        hits = res["hits"]["hits"]
+        # oracle: cosine ranking
+        oracle = sorted(VECS, key=lambda d: -_cos(q, VECS[d]))[:3]
+        assert [h["_id"] for h in hits] == oracle
+        # ES score formula (1 + cos) / 2
+        for h in hits:
+            expect = (1 + _cos(q, VECS[h["_id"]])) / 2
+            assert h["_score"] == pytest.approx(expect, rel=1e-5)
+        assert res["hits"]["total"]["value"] == 3
+
+    def test_knn_filter(self, vecindex):
+        q = [1.0, 0.0, 0.0, 0.0]
+        status, res = _handle(vecindex, "POST", "/v/_search", body={
+            "knn": {"field": "emb", "query_vector": q, "k": 2,
+                    "num_candidates": 10,
+                    "filter": {"term": {"color": "blue"}}}})
+        assert status == 200, res
+        ids = [h["_id"] for h in res["hits"]["hits"]]
+        assert set(ids) <= {"1", "3"}  # blue docs only
+        assert ids[0] == "1"
+
+    def test_knn_k_and_candidates_validation(self, vecindex):
+        status, _ = _handle(vecindex, "POST", "/v/_search", body={
+            "knn": {"field": "emb", "query_vector": [1, 0, 0, 0],
+                    "k": 10, "num_candidates": 3}})
+        assert status == 400
+        status, _ = _handle(vecindex, "POST", "/v/_search", body={
+            "knn": {"field": "emb", "query_vector": [1, 0]}})
+        assert status == 400  # dims mismatch
+        status, _ = _handle(vecindex, "POST", "/v/_search", body={
+            "knn": {"field": "title", "query_vector": [1, 0, 0, 0]}})
+        assert status == 400  # not a dense_vector field
+
+    def test_hybrid_query_plus_knn_sums_scores(self, vecindex):
+        q = [1.0, 0.0, 0.0, 0.0]
+        text = {"match": {"title": "fox"}}
+        base = _handle(vecindex, "POST", "/v/_search",
+                       body={"query": text, "size": 10})[1]
+        text_scores = {h["_id"]: h["_score"]
+                       for h in base["hits"]["hits"]}
+        status, res = _handle(vecindex, "POST", "/v/_search", body={
+            "query": text,
+            "knn": {"field": "emb", "query_vector": q, "k": 2,
+                    "num_candidates": 10},
+            "size": 10})
+        assert status == 200, res
+        knn_top2 = sorted(VECS, key=lambda d: -_cos(q, VECS[d]))[:2]
+        for h in res["hits"]["hits"]:
+            expect = text_scores.get(h["_id"], 0.0)
+            if h["_id"] in knn_top2:
+                expect += (1 + _cos(q, VECS[h["_id"]])) / 2
+            assert h["_score"] == pytest.approx(expect, rel=1e-4), h
+        # all text matches stay in the result set (union semantics)
+        assert res["hits"]["total"]["value"] == len(text_scores)
+
+    def test_knn_boost(self, vecindex):
+        q = [1.0, 0.0, 0.0, 0.0]
+        status, res = _handle(vecindex, "POST", "/v/_search", body={
+            "knn": {"field": "emb", "query_vector": q, "k": 1,
+                    "num_candidates": 10, "boost": 7.0}})
+        assert status == 200, res
+        h = res["hits"]["hits"][0]
+        assert h["_id"] == "0"
+        assert h["_score"] == pytest.approx(7.0 * 1.0, rel=1e-5)
+
+    def test_knn_across_segments_and_deletes(self, node):
+        _handle(node, "PUT", "/seg", body={"mappings": {"properties": {
+            "e": {"type": "dense_vector", "dims": 2}}}})
+        # several refreshes → several segments
+        rng = np.random.RandomState(7)
+        vecs = {}
+        for i in range(20):
+            v = rng.randn(2).tolist()
+            vecs[str(i)] = v
+            _handle(node, "PUT", f"/seg/_doc/{i}",
+                    params={"refresh": str(i % 3 == 0).lower()},
+                    body={"e": v})
+        _handle(node, "POST", "/seg/_refresh")
+        # delete a few (tombstones must not surface)
+        for i in (3, 7):
+            _handle(node, "DELETE", f"/seg/_doc/{i}",
+                    params={"refresh": "true"})
+            del vecs[str(i)]
+        q = rng.randn(2).tolist()
+        status, res = _handle(node, "POST", "/seg/_search", body={
+            "knn": {"field": "e", "query_vector": q, "k": 5,
+                    "num_candidates": 30}})
+        assert status == 200, res
+        oracle = sorted(vecs, key=lambda d: -_cos(q, vecs[d]))[:5]
+        assert [h["_id"] for h in res["hits"]["hits"]] == oracle
+
+    def test_exact_recall_vs_oracle(self, node):
+        """Brute force IS exact: recall@10 == 1.0 against numpy."""
+        _handle(node, "PUT", "/big", body={"mappings": {"properties": {
+            "e": {"type": "dense_vector", "dims": 8,
+                  "similarity": "l2_norm"}}}})
+        rng = np.random.RandomState(42)
+        mat = rng.randn(150, 8).astype(np.float32)
+        lines = []
+        for i in range(150):
+            lines.append(json.dumps({"index": {"_id": str(i)}}))
+            lines.append(json.dumps({"e": mat[i].tolist()}))
+        raw = ("\n".join(lines) + "\n").encode()
+        node.handle("POST", "/big/_bulk", {"refresh": "true"}, None, raw)
+        q = rng.randn(8).astype(np.float32)
+        status, res = _handle(node, "POST", "/big/_search", body={
+            "knn": {"field": "e", "query_vector": q.tolist(), "k": 10,
+                    "num_candidates": 50},
+            "size": 10})
+        assert status == 200, res
+        got = [h["_id"] for h in res["hits"]["hits"]]
+        d2 = ((mat - q) ** 2).sum(axis=1)
+        oracle = [str(i) for i in np.argsort(d2)[:10]]
+        assert got == oracle  # recall@10 = 1.0, exact order
+        # l2 score formula
+        top = res["hits"]["hits"][0]
+        assert top["_score"] == pytest.approx(
+            1.0 / (1.0 + float(d2[int(top["_id"])])), rel=1e-4)
+
+    def test_knn_survives_restart(self, tmp_data_path):
+        n = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        _handle(n, "PUT", "/p", body={"mappings": {"properties": {
+            "e": {"type": "dense_vector", "dims": 2}}}})
+        _handle(n, "PUT", "/p/_doc/a", params={"refresh": "true"},
+                body={"e": [1.0, 0.0]})
+        _handle(n, "POST", "/p/_flush")
+        n.close()
+        n2 = Node(str(tmp_data_path), settings=Settings.of(
+            {"search.tpu_serving.enabled": "false"}))
+        try:
+            status, res = _handle(n2, "POST", "/p/_search", body={
+                "knn": {"field": "e", "query_vector": [1.0, 0.0],
+                        "k": 1}})
+            assert status == 200, res
+            assert res["hits"]["hits"][0]["_id"] == "a"
+            assert res["hits"]["hits"][0]["_score"] == pytest.approx(1.0)
+        finally:
+            n2.close()
+
+    def test_similarity_threshold(self, node):
+        _handle(node, "PUT", "/thr", body={"mappings": {"properties": {
+            "e": {"type": "dense_vector", "dims": 2,
+                  "similarity": "l2_norm"}}}})
+        for i, v in enumerate([[0.0, 0.0], [3.0, 0.0], [10.0, 0.0]]):
+            _handle(node, "PUT", f"/thr/_doc/{i}",
+                    params={"refresh": "true"}, body={"e": v})
+        # l2_norm: `similarity` is the MAX distance (reference API)
+        status, res = _handle(node, "POST", "/thr/_search", body={
+            "knn": {"field": "e", "query_vector": [0.0, 0.0], "k": 3,
+                    "num_candidates": 10, "similarity": 5.0}})
+        assert status == 200, res
+        assert {h["_id"] for h in res["hits"]["hits"]} == {"0", "1"}
+        # cosine: `similarity` is the MIN raw cosine
+        _handle(node, "PUT", "/thc", body={"mappings": {"properties": {
+            "e": {"type": "dense_vector", "dims": 2}}}})
+        for i, v in enumerate([[1.0, 0.0], [0.0, 1.0]]):
+            _handle(node, "PUT", f"/thc/_doc/{i}",
+                    params={"refresh": "true"}, body={"e": v})
+        status, res = _handle(node, "POST", "/thc/_search", body={
+            "knn": {"field": "e", "query_vector": [1.0, 0.0], "k": 2,
+                    "num_candidates": 10, "similarity": 0.9}})
+        assert status == 200, res
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["0"]
+
+    def test_internal_knn_docs_key_rejected_from_rest(self, vecindex):
+        status, _ = _handle(vecindex, "POST", "/v/_search", body={
+            "_knn_docs": {"v#0": [{"boost": 1.0, "segments": {}}]}})
+        assert status == 400
+
+    def test_knn_rejects_sort_combo(self, vecindex):
+        status, _ = _handle(vecindex, "POST", "/v/_search", body={
+            "knn": {"field": "emb", "query_vector": [1, 0, 0, 0]},
+            "sort": [{"color": "asc"}]})
+        assert status == 400
+
+
+class TestScriptVectorFunctions:
+    def test_cosine_similarity_script(self, vecindex):
+        q = [1.0, 0.0, 0.0, 0.0]
+        status, res = _handle(vecindex, "POST", "/v/_search", body={
+            "query": {"script_score": {
+                "query": {"exists": {"field": "emb"}},
+                "script": {
+                    "source": "cosineSimilarity(params.qv, 'emb') + 1.0",
+                    "params": {"qv": q}}}},
+            "size": 10})
+        assert status == 200, res
+        for h in res["hits"]["hits"]:
+            assert h["_score"] == pytest.approx(
+                _cos(q, VECS[h["_id"]]) + 1.0, rel=1e-5)
+
+    def test_dot_product_and_l2(self, vecindex):
+        q = [0.5, 0.5, 0.0, 0.0]
+        status, res = _handle(vecindex, "POST", "/v/_search", body={
+            "query": {"script_score": {
+                "query": {"term": {"color": "red"}},
+                "script": {"source": "dotProduct(params.qv, 'emb')",
+                           "params": {"qv": q}}}},
+            "size": 10})
+        assert status == 200, res
+        for h in res["hits"]["hits"]:
+            expect = float(np.asarray(q) @ np.asarray(VECS[h["_id"]]))
+            assert h["_score"] == pytest.approx(expect, rel=1e-5, abs=1e-6)
+
+    def test_bad_field_in_script_400(self, vecindex):
+        status, _ = _handle(vecindex, "POST", "/v/_search", body={
+            "query": {"script_score": {
+                "query": {"match_all": {}},
+                "script": {"source":
+                           "cosineSimilarity(params.qv, 'nope')",
+                           "params": {"qv": [1, 0, 0, 0]}}}}})
+        assert status == 400
