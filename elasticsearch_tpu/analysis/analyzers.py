@@ -154,13 +154,17 @@ class Analyzer:
         return slots
 
     def analyze(self, text: str) -> List[Token]:
-        """Run the chain. Filters see/emit per-slot terms; a filter marks a
-        removed token as None, which leaves a position hole."""
+        """Run the chain. Filters see/emit per-slot terms; a filter marks
+        a removed token as None (position hole); a list entry stacks
+        several terms at one position (synonyms/ngrams)."""
+        from elasticsearch_tpu.analysis.filters import slot_terms
         return [Token(term, pos)
-                for pos, term in enumerate(self.analyze_slots(text)) if term]
+                for pos, entry in enumerate(self.analyze_slots(text))
+                for term in slot_terms(entry)]
 
     def terms(self, text: str) -> List[str]:
-        return [t for t in self.analyze_slots(text) if t]
+        from elasticsearch_tpu.analysis.filters import flatten_slots
+        return flatten_slots(self.analyze_slots(text))
 
 
 def lowercase_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
@@ -299,19 +303,114 @@ class AnalysisRegistry:
     def build(self, index_settings) -> Dict[str, Analyzer]:
         """index_settings: a common.settings.Settings scoped to one index."""
         analyzers: Dict[str, Analyzer] = {name: cls() for name, cls in self.BUILTIN.items()}
-        prefix = "index.analysis.analyzer."
-        custom: Dict[str, Dict] = {}
-        for key in index_settings.keys():
-            if not key.startswith(prefix):
-                continue
-            rest = key[len(prefix):]
-            name, _, prop = rest.partition(".")
-            custom.setdefault(name, {})[prop] = index_settings.raw_get(key)
-        for name, props in custom.items():
-            analyzers[name] = self._build_one(name, props)
+
+        def collect(prefix: str) -> Dict[str, Dict]:
+            out: Dict[str, Dict] = {}
+            for key in index_settings.keys():
+                if key.startswith(prefix):
+                    rest = key[len(prefix):]
+                    name, _, prop = rest.partition(".")
+                    out.setdefault(name, {})[prop] = \
+                        index_settings.raw_get(key)
+            return out
+
+        # custom filter/tokenizer definitions resolve by name from
+        # analyzer chains (reference: AnalysisRegistry builds filters
+        # first, then analyzers reference them)
+        custom_filters = {
+            name: self._build_filter(name, props)
+            for name, props in collect("index.analysis.filter.").items()}
+        custom_tokenizers = {
+            name: self._build_tokenizer(name, props)
+            for name, props in collect(
+                "index.analysis.tokenizer.").items()}
+        for name, props in collect("index.analysis.analyzer.").items():
+            analyzers[name] = self._build_one(
+                name, props, custom_filters, custom_tokenizers)
         return analyzers
 
-    def _build_one(self, name: str, props: Dict) -> Analyzer:
+    def _build_filter(self, name: str, props: Dict) -> Callable:
+        """One `index.analysis.filter.<name>` definition → a slot
+        filter (reference: TokenFilterFactory registry)."""
+        from elasticsearch_tpu.analysis import filters as flt
+        ftype = props.get("type")
+        if ftype is None:
+            raise IllegalArgumentException(
+                f"token filter [{name}] must specify [type]")
+        if ftype in ("ngram", "nGram"):
+            return flt.make_ngram_filter(
+                int(props.get("min_gram", 1)),
+                int(props.get("max_gram", 2)),
+                preserve_original=_boolish(
+                    props.get("preserve_original", False)))
+        if ftype in ("edge_ngram", "edgeNGram"):
+            return flt.make_ngram_filter(
+                int(props.get("min_gram", 1)),
+                int(props.get("max_gram", 2)), edge=True,
+                preserve_original=_boolish(
+                    props.get("preserve_original", False)))
+        if ftype == "shingle":
+            return flt.make_shingle_filter(
+                int(props.get("min_shingle_size", 2)),
+                int(props.get("max_shingle_size", 2)),
+                output_unigrams=_boolish(
+                    props.get("output_unigrams", True)),
+                token_separator=str(props.get("token_separator", " ")),
+                filler_token=str(props.get("filler_token", "_")))
+        if ftype in ("synonym", "synonym_graph"):
+            rules = props.get("synonyms")
+            if isinstance(rules, str):
+                rules = [rules]
+            if not isinstance(rules, list) or not rules:
+                raise IllegalArgumentException(
+                    f"synonym filter [{name}] requires [synonyms] rules "
+                    f"(synonyms_path files are not supported)")
+            return flt.make_synonym_filter([str(r) for r in rules])
+        if ftype == "stemmer":
+            return flt.make_stemmer_filter(
+                str(props.get("language", props.get("name", "english"))))
+        if ftype == "porter_stem":
+            return flt.porter_stem_filter
+        if ftype == "stop":
+            stop = props.get("stopwords", "_english_")
+            if stop == "_english_":
+                stop = ENGLISH_STOP_WORDS
+            elif isinstance(stop, str):
+                stop = [stop]
+            return make_stop_filter([str(s) for s in stop])
+        if ftype == "length":
+            return make_length_filter(int(props.get("min", 0)),
+                                      int(props.get("max", 2**31)))
+        if ftype == "lowercase":
+            return lowercase_filter
+        if ftype == "asciifolding":
+            return asciifolding_filter
+        raise IllegalArgumentException(
+            f"unknown token filter type [{ftype}] for [{name}]")
+
+    def _build_tokenizer(self, name: str, props: Dict) -> Callable:
+        from elasticsearch_tpu.analysis import filters as flt
+        ttype = props.get("type")
+        if ttype is None:
+            raise IllegalArgumentException(
+                f"tokenizer [{name}] must specify [type]")
+        if ttype in ("ngram", "nGram"):
+            return flt.make_ngram_tokenizer(
+                int(props.get("min_gram", 1)),
+                int(props.get("max_gram", 2)))
+        if ttype in ("edge_ngram", "edgeNGram"):
+            return flt.make_ngram_tokenizer(
+                int(props.get("min_gram", 1)),
+                int(props.get("max_gram", 2)), edge=True)
+        if ttype in _TOKENIZERS:
+            return _TOKENIZERS[ttype]
+        raise IllegalArgumentException(
+            f"unknown tokenizer type [{ttype}] for [{name}]")
+
+    def _build_one(self, name: str, props: Dict,
+                   custom_filters: Optional[Dict[str, Callable]] = None,
+                   custom_tokenizers: Optional[Dict[str, Callable]] = None
+                   ) -> Analyzer:
         atype = props.get("type", "custom")
         if atype in self.BUILTIN and atype != "custom":
             if atype == "standard":
@@ -325,23 +424,42 @@ class AnalysisRegistry:
             return self.BUILTIN[atype]()
         if atype != "custom":
             raise IllegalArgumentException(f"unknown analyzer type [{atype}] for [{name}]")
+        custom_filters = custom_filters or {}
+        custom_tokenizers = custom_tokenizers or {}
         tok_name = props.get("tokenizer", "standard")
-        tokenizer = _TOKENIZERS.get(tok_name)
+        tokenizer = custom_tokenizers.get(tok_name) or \
+            _TOKENIZERS.get(tok_name)
         if tokenizer is None:
             raise IllegalArgumentException(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
+        from elasticsearch_tpu.analysis import filters as flt
         filters = []
         if tok_name == "lowercase":
             filters.append(lowercase_filter)
         raw_filters = props.get("filter", [])
         if isinstance(raw_filters, str):
             raw_filters = [f.strip() for f in raw_filters.split(",") if f.strip()]
+        builtin_filters: Dict[str, Callable] = {
+            "lowercase": lowercase_filter,
+            "asciifolding": asciifolding_filter,
+            "porter_stem": flt.porter_stem_filter,
+            "stemmer": flt.make_stemmer_filter("english"),
+            "ngram": flt.make_ngram_filter(1, 2),
+            "edge_ngram": flt.make_ngram_filter(1, 2, edge=True),
+            "shingle": flt.make_shingle_filter(),
+        }
         for f in raw_filters:
-            if f == "lowercase":
-                filters.append(lowercase_filter)
+            if f in custom_filters:
+                filters.append(custom_filters[f])
             elif f == "stop":
                 filters.append(make_stop_filter(ENGLISH_STOP_WORDS))
-            elif f == "asciifolding":
-                filters.append(asciifolding_filter)
+            elif f in builtin_filters:
+                filters.append(builtin_filters[f])
             else:
                 raise IllegalArgumentException(f"unknown token filter [{f}] for analyzer [{name}]")
         return CustomAnalyzer(tokenizer, filters)
+
+
+def _boolish(v) -> bool:
+    if isinstance(v, str):
+        return v.lower() not in ("false", "0", "no", "")
+    return bool(v)

@@ -389,6 +389,366 @@ class DropProcessor(Processor):
 
 
 @register_processor
+class DateProcessor(Processor):
+    """{"date": {"field", "formats": [...], "target_field"="@timestamp",
+    "timezone", "output_format"}} — parse dates into ISO8601 (reference:
+    ingest-common DateProcessor). Formats: java-time patterns are
+    matched by a pattern-translation subset plus the named formats
+    ISO8601 / UNIX / UNIX_MS / TAI64N(unsupported→400)."""
+
+    type_name = "date"
+
+    # longest-first so e.g. MMM translates before MM could eat it
+    _JAVA_TO_STRPTIME = [
+        ("yyyy", "%Y"), ("SSS", "%f"), ("MMM", "%b"), ("EEE", "%a"),
+        ("XXX", "%z"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+        ("mm", "%M"), ("ss", "%S"), ("XX", "%z"), ("yy", "%y"),
+        ("X", "%z"), ("Z", "%z"),
+    ]
+
+    def __init__(self, config):
+        super().__init__(config)
+        import datetime as dt
+        self.field = self._req(config, "field")
+        formats = self._req(config, "formats")
+        if not isinstance(formats, list) or not formats:
+            raise IllegalArgumentException(
+                "[date] [formats] must be a non-empty list")
+        self.target = config.get("target_field", "@timestamp")
+        self.formats = [str(f) for f in formats]
+        # translated once at PUT time — the per-doc path only parses
+        self.strptime = {f: self._translate(f) for f in self.formats
+                         if f.upper() not in ("ISO8601", "UNIX",
+                                              "UNIX_MS")}
+        for f in self.formats:
+            if f.upper() == "TAI64N":
+                raise IllegalArgumentException(
+                    "[date] TAI64N format is not supported")
+        self.tz = self._parse_tz(config.get("timezone"))
+        out_fmt = config.get("output_format")
+        self.output_strftime = (None if out_fmt is None
+                                else self._translate(str(out_fmt)))
+
+    @staticmethod
+    def _parse_tz(spec):
+        """timezone config → tzinfo: "UTC", or "+HH:MM"/"-HH:MM"
+        offsets (named zoneinfo ids when the tzdata lookup succeeds)."""
+        import datetime as dt
+        if spec is None:
+            return dt.timezone.utc
+        s = str(spec)
+        if s.upper() == "UTC":
+            return dt.timezone.utc
+        m = re.fullmatch(r"([+-])(\d{2}):?(\d{2})", s)
+        if m:
+            sign = 1 if m.group(1) == "+" else -1
+            delta = dt.timedelta(hours=int(m.group(2)),
+                                 minutes=int(m.group(3)))
+            return dt.timezone(sign * delta)
+        try:
+            import zoneinfo
+            return zoneinfo.ZoneInfo(s)
+        except Exception:
+            raise IllegalArgumentException(
+                f"[date] unknown timezone [{s}]") from None
+
+    @classmethod
+    def _translate(cls, java_fmt: str) -> str:
+        out = java_fmt
+        for j, p in cls._JAVA_TO_STRPTIME:
+            out = out.replace(j, p)
+        # java single-quote literals: 'T' → T
+        return out.replace("'", "")
+
+    def _parse_one(self, value, fmt: str):
+        import datetime as dt
+        name = fmt.upper()
+        if name == "ISO8601":
+            s = str(value)
+            if s.endswith("Z"):
+                s = s[:-1] + "+00:00"
+            return dt.datetime.fromisoformat(s)
+        if name == "UNIX":
+            return dt.datetime.fromtimestamp(float(value),
+                                             dt.timezone.utc)
+        if name == "UNIX_MS":
+            return dt.datetime.fromtimestamp(float(value) / 1000.0,
+                                             dt.timezone.utc)
+        return dt.datetime.strptime(str(value), self.strptime[fmt])
+
+    def process(self, doc):
+        value = get_field(doc, self.field)
+        if value is None:
+            raise IngestProcessorException(
+                f"field [{self.field}] is null or missing")
+        last_err = None
+        for fmt in self.formats:
+            try:
+                parsed = self._parse_one(value, fmt)
+                break
+            except (ValueError, TypeError, OverflowError) as e:
+                last_err = e
+        else:
+            raise IngestProcessorException(
+                f"unable to parse date [{value}] with any of "
+                f"{self.formats}: {last_err}")
+        if parsed.tzinfo is None:
+            # zone-less input is interpreted in the configured timezone
+            # (reference: the processor's `timezone` option)
+            parsed = parsed.replace(tzinfo=self.tz)
+        if self.output_strftime is not None:
+            out = parsed.strftime(self.output_strftime)
+        else:
+            out = parsed.isoformat(timespec="milliseconds")
+        set_field(doc, self.target, out)
+
+
+# A practical subset of the reference's grok pattern library
+# (libs/grok grok-patterns file); %{SYNTAX:SEMANTIC} resolution below.
+GROK_PATTERNS: Dict[str, str] = {
+    "WORD": r"\b\w+\b",
+    "NOTSPACE": r"\S+",
+    "SPACE": r"\s*",
+    "DATA": r".*?",
+    "GREEDYDATA": r".*",
+    "INT": r"[+-]?(?:[0-9]+)",
+    "NUMBER": r"[+-]?(?:[0-9]+(?:\.[0-9]+)?)",
+    "BASE10NUM": r"[+-]?(?:[0-9]+(?:\.[0-9]+)?)",
+    "POSINT": r"\b[1-9][0-9]*\b",
+    "NONNEGINT": r"\b[0-9]+\b",
+    "USERNAME": r"[a-zA-Z0-9._-]+",
+    "USER": r"[a-zA-Z0-9._-]+",
+    "EMAILADDRESS": r"[a-zA-Z0-9_.+-=:]+@[0-9A-Za-z][0-9A-Za-z-]{0,62}"
+                    r"(?:\.[0-9A-Za-z][0-9A-Za-z-]{0,62})*",
+    "IPV4": r"(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"
+            r"(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)",
+    "IP": r"(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"
+          r"(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)",
+    "HOSTNAME": r"\b(?:[0-9A-Za-z][0-9A-Za-z-]{0,62})"
+                r"(?:\.(?:[0-9A-Za-z][0-9A-Za-z-]{0,62}))*\.?\b",
+    "UUID": r"[A-Fa-f0-9]{8}-(?:[A-Fa-f0-9]{4}-){3}[A-Fa-f0-9]{12}",
+    "TIMESTAMP_ISO8601": r"\d{4}-\d{2}-\d{2}[T ]\d{2}:\d{2}"
+                         r"(?::\d{2}(?:\.\d+)?)?"
+                         r"(?:Z|[+-]\d{2}:?\d{2})?",
+    "LOGLEVEL": r"(?:[Aa]lert|ALERT|[Tt]race|TRACE|[Dd]ebug|DEBUG|"
+                r"[Nn]otice|NOTICE|[Ii]nfo|INFO|[Ww]arn(?:ing)?|"
+                r"WARN(?:ING)?|[Ee]rr(?:or)?|ERR(?:OR)?|[Cc]rit(?:ical)?|"
+                r"CRIT(?:ICAL)?|[Ff]atal|FATAL|[Ss]evere|SEVERE)",
+    "QUOTEDSTRING": r"(?:\"(?:[^\"\\]|\\.)*\"|'(?:[^'\\]|\\.)*')",
+    "PATH": r"(?:/[\w_%!$@:.,+~-]*)+",
+    "HTTPDATE": r"\d{2}/\w{3}/\d{4}:\d{2}:\d{2}:\d{2} [+-]\d{4}",
+}
+
+_GROK_REF = re.compile(r"%\{(\w+)(?::([\w.\[\]]+))?(?::(int|float))?\}")
+
+
+def compile_grok(pattern: str):
+    """Grok pattern → (compiled regex, group→semantic names, group→type
+    casts). Named captures use sanitized group names (regex group names
+    can't contain dots)."""
+    casts: Dict[str, str] = {}
+    names: Dict[str, str] = {}
+    counter = [0]
+
+    def repl(m):
+        syntax, semantic, cast = m.group(1), m.group(2), m.group(3)
+        base = GROK_PATTERNS.get(syntax)
+        if base is None:
+            raise IllegalArgumentException(
+                f"Unable to find pattern [{syntax}] in Grok's pattern "
+                f"dictionary")
+        # nested %{...} inside library patterns are not used in the
+        # subset above (all entries are plain regex)
+        if semantic is None:
+            return f"(?:{base})"
+        counter[0] += 1
+        g = f"g{counter[0]}"
+        names[g] = semantic
+        if cast:
+            casts[g] = cast
+        return f"(?P<{g}>{base})"
+
+    regex = _GROK_REF.sub(repl, pattern)
+    if "%{" in regex:
+        # a construct the subset doesn't parse (e.g. an unsupported
+        # cast type) must 400 at PUT, never linger as literal text
+        bad = regex[regex.index("%{"):].split("}")[0] + "}"
+        raise IllegalArgumentException(
+            f"invalid grok construct [{bad}] in pattern [{pattern}] "
+            f"(supported casts: int, float)")
+    try:
+        return re.compile(regex), names, casts
+    except re.error as e:
+        raise IllegalArgumentException(
+            f"invalid grok pattern [{pattern}]: {e}") from None
+
+
+@register_processor
+class GrokProcessor(Processor):
+    """{"grok": {"field", "patterns": [...], "ignore_missing"}} — first
+    matching pattern's named captures become fields (reference:
+    ingest-common GrokProcessor over libs/grok)."""
+
+    type_name = "grok"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        patterns = self._req(config, "patterns")
+        if not isinstance(patterns, list) or not patterns:
+            raise IllegalArgumentException(
+                "[grok] [patterns] must be a non-empty list")
+        self.compiled = [compile_grok(str(p)) for p in patterns]
+        self.ignore_missing = bool(config.get("ignore_missing", False))
+
+    def process(self, doc):
+        value = get_field(doc, self.field)
+        if value is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{self.field}] is null or missing")
+        for regex, names, casts in self.compiled:
+            m = regex.search(str(value))
+            if m is None:
+                continue
+            for g, semantic in names.items():
+                v = m.group(g)
+                if v is None:
+                    continue
+                cast = casts.get(g)
+                try:
+                    if cast == "int":
+                        v = int(float(v)) if "." in v else int(v)
+                    elif cast == "float":
+                        v = float(v)
+                except ValueError as e:
+                    raise IngestProcessorException(
+                        f"[grok] cannot cast [{v}] to {cast}: {e}"
+                    ) from None
+                set_field(doc, semantic, v)
+            return
+        raise IngestProcessorException(
+            f"Provided Grok expressions do not match field value: "
+            f"[{value}]")
+
+
+@register_processor
+class DissectProcessor(Processor):
+    """{"dissect": {"field", "pattern", "append_separator"}} —
+    delimiter-based extraction (reference: libs/dissect). Supports
+    %{key}, %{} (skip), %{+key} (append), %{?key} (named skip)."""
+
+    type_name = "dissect"
+
+    _KEY = re.compile(r"%\{([^}]*)\}")
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        self.pattern = str(self._req(config, "pattern"))
+        self.append_sep = str(config.get("append_separator", ""))
+        self.ignore_missing = bool(config.get("ignore_missing", False))
+        # parse into [literal, key, literal, key, ..., literal]
+        self.parts: List[str] = []      # literals between keys
+        self.keys: List[str] = []
+        last = 0
+        for m in self._KEY.finditer(self.pattern):
+            self.parts.append(self.pattern[last:m.start()])
+            self.keys.append(m.group(1))
+            last = m.end()
+        self.parts.append(self.pattern[last:])
+        if not self.keys:
+            raise IllegalArgumentException(
+                "[dissect] pattern needs at least one %{key}")
+        for lit in self.parts[1:-1]:
+            if lit == "":
+                raise IllegalArgumentException(
+                    "[dissect] consecutive keys without a separator "
+                    "are ambiguous")
+
+    def process(self, doc):
+        value = get_field(doc, self.field)
+        if value is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{self.field}] is null or missing")
+        s = str(value)
+        if self.parts[0]:
+            if not s.startswith(self.parts[0]):
+                raise IngestProcessorException(
+                    f"Unable to find match for dissect pattern "
+                    f"[{self.pattern}] against source [{s}]")
+            s = s[len(self.parts[0]):]
+        out: Dict[str, Any] = {}
+        appends: Dict[str, List[str]] = {}
+        for i, key in enumerate(self.keys):
+            lit = self.parts[i + 1]
+            if lit == "":      # final key takes the rest
+                piece = s
+                s = ""
+            else:
+                idx = s.find(lit)
+                if idx < 0:
+                    raise IngestProcessorException(
+                        f"Unable to find match for dissect pattern "
+                        f"[{self.pattern}] against source [{value}]")
+                piece = s[:idx]
+                s = s[idx + len(lit):]
+            if key == "" or key.startswith("?"):
+                continue
+            if key.startswith("+"):
+                appends.setdefault(key[1:], []).append(piece)
+            else:
+                out[key] = piece
+        for k, vs in appends.items():
+            base = [out[k]] if k in out else []
+            out[k] = self.append_sep.join(base + vs)
+        for k, v in out.items():
+            set_field(doc, k, v)
+
+
+@register_processor
+class ForeachProcessor(Processor):
+    """{"foreach": {"field", "processor": {type: {...}}}} — run one
+    processor per element with `_ingest._value` bound (reference:
+    ingest-common ForeachProcessor)."""
+
+    type_name = "foreach"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = self._req(config, "field")
+        spec = self._req(config, "processor")
+        procs = _parse_processors([spec])
+        self.processor = procs[0]
+        self.ignore_missing = bool(config.get("ignore_missing", False))
+
+    def process(self, doc):
+        values = get_field(doc, self.field)
+        if values is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{self.field}] is null or missing")
+        if not isinstance(values, list):
+            raise IngestProcessorException(
+                f"field [{self.field}] of type "
+                f"[{type(values).__name__}] cannot be iterated")
+        new_values = []
+        for v in values:
+            ingest_meta = doc.setdefault("_ingest", {})
+            ingest_meta["_value"] = v
+            self.processor.process(doc)
+            new_values.append(doc.get("_ingest", {}).get("_value"))
+        doc.get("_ingest", {}).pop("_value", None)
+        if not doc.get("_ingest"):
+            doc.pop("_ingest", None)
+        set_field(doc, self.field, new_values)
+
+
+@register_processor
 class ScriptProcessor(Processor):
     """{"script": {"source": "ctx.field = ...", ...}} — run a restricted
     expression script against the document (reference: ingest

@@ -79,15 +79,24 @@ def slots_to_positions(slot_lists: List[List[Optional[str]]]
                        ) -> List[Tuple[str, int]]:
     """Per-value slot lists → [(term, absolute position)], reproducing the
     write-path gap rule: value j starts at (tokens so far) + 100·(values
-    so far with tokens before them)."""
+    so far with tokens before them). A list slot entry stacks several
+    terms at ONE position (synonyms/ngram filters — Lucene's
+    posIncrement=0)."""
     out: List[Tuple[str, int]] = []
     base = 0
     for slots in slot_lists:
         gap = 100 if base else 0
         n = 0
-        for si, term in enumerate(slots):
-            if term:
-                out.append((term, si + base + gap))
+        for si, entry in enumerate(slots):
+            if not entry:
+                continue
+            if isinstance(entry, list):
+                for term in entry:
+                    if term:
+                        out.append((term, si + base + gap))
+                        n += 1
+            else:
+                out.append((entry, si + base + gap))
                 n += 1
         base = base + gap + n
     return out
@@ -390,11 +399,17 @@ class MapperService:
             if ft.is_indexed:
                 if isinstance(ft, TextFieldType):
                     # slots carry the positions implicitly (index = slot,
-                    # holes = None); the +100 array-value gap is applied
-                    # lazily by slots_to_positions — no per-token work here
+                    # holes = None, list = stacked terms at one position);
+                    # the +100 array-value gap is applied lazily by
+                    # slots_to_positions — no per-token work here
                     slots = ft.analyzer.analyze_slots(str(v))
-                    terms = [t for t in slots if t] \
-                        if None in slots else slots
+                    if None in slots or any(
+                            isinstance(s, list) for s in slots):
+                        from elasticsearch_tpu.analysis.filters import \
+                            flatten_slots
+                        terms = flatten_slots(slots)
+                    else:
+                        terms = slots
                     base = parsed.field_lengths.get(path, 0)
                     parsed.field_lengths[path] = \
                         base + (100 if base else 0) + len(terms)

@@ -110,6 +110,12 @@ def register(controller: RestController, node) -> None:
             svc = indices.index(index)
             ft = svc.mapper.field_type(body["field"])
             analyzer = getattr(ft, "analyzer", None)
+        elif index:
+            # the index's OWN registry: custom analyzers defined in
+            # index.analysis.* resolve here (reference:
+            # TransportAnalyzeAction on an index)
+            svc = indices.index(index)
+            analyzer = svc.mapper.analyzers.get(analyzer_name)
         else:
             from elasticsearch_tpu.analysis import AnalysisRegistry
             from elasticsearch_tpu.common.settings import Settings
@@ -120,8 +126,11 @@ def register(controller: RestController, node) -> None:
                 f"failed to find analyzer [{analyzer_name}]")
         tokens = []
         for t in texts:
-            for pos, term in enumerate(analyzer.terms(str(t))):
-                tokens.append({"token": term, "position": pos,
+            # analyze() preserves position stacking (synonyms/ngrams at
+            # one position) and stop-word holes
+            for tok in analyzer.analyze(str(t)):
+                tokens.append({"token": tok.term,
+                               "position": tok.position,
                                "type": "<ALPHANUM>"})
         return 200, {"tokens": tokens}
 

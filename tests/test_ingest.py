@@ -205,3 +205,204 @@ class TestPipelineRest:
             assert status == 200
         finally:
             n2.close()
+
+
+class TestDateProcessor:
+    def test_iso8601_and_custom_format(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"date": {
+            "field": "ts", "formats": ["ISO8601",
+                                       "yyyy/MM/dd HH:mm:ss"]}}]})
+        out = p.execute({"ts": "2021-03-04T05:06:07Z"})
+        assert out["@timestamp"].startswith("2021-03-04T05:06:07")
+        out2 = p.execute({"ts": "2021/03/04 05:06:07"})
+        assert out2["@timestamp"].startswith("2021-03-04T05:06:07")
+
+    def test_unix_and_unix_ms(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"date": {
+            "field": "t", "formats": ["UNIX_MS"],
+            "target_field": "when"}}]})
+        out = p.execute({"t": 1614852000123})
+        assert out["when"].startswith("2021-03-04T10:00:00.123")
+
+    def test_unparseable_is_processor_error(self, node):
+        from elasticsearch_tpu.ingest import (IngestProcessorException,
+                                              Pipeline)
+        p = Pipeline("p", {"processors": [{"date": {
+            "field": "t", "formats": ["yyyy-MM-dd"]}}]})
+        with pytest.raises(IngestProcessorException):
+            p.execute({"t": "not a date"})
+
+
+class TestGrokProcessor:
+    def test_apache_style_line(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"grok": {
+            "field": "message",
+            "patterns": ["%{IPV4:client} %{WORD:method} "
+                         "%{NOTSPACE:path} %{NUMBER:bytes:int}"]}}]})
+        out = p.execute({"message": "1.2.3.4 GET /index.html 1234"})
+        assert out["client"] == "1.2.3.4"
+        assert out["method"] == "GET"
+        assert out["path"] == "/index.html"
+        assert out["bytes"] == 1234
+
+    def test_first_matching_pattern_wins(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"grok": {
+            "field": "m",
+            "patterns": ["level=%{LOGLEVEL:lvl}",
+                         "%{GREEDYDATA:rest}"]}}]})
+        assert p.execute({"m": "level=ERROR x"})["lvl"] == "ERROR"
+        out = p.execute({"m": "no level here"})
+        assert out["rest"] == "no level here" and "lvl" not in out
+
+    def test_no_match_errors(self, node):
+        from elasticsearch_tpu.ingest import (IngestProcessorException,
+                                              Pipeline)
+        p = Pipeline("p", {"processors": [{"grok": {
+            "field": "m", "patterns": ["%{IPV4:ip}"]}}]})
+        with pytest.raises(IngestProcessorException, match="do not match"):
+            p.execute({"m": "hello"})
+
+    def test_unknown_pattern_is_400_at_put(self, node):
+        status, _ = _handle(node, "PUT", "/_ingest/pipeline/badgrok",
+                            body={"processors": [{"grok": {
+                                "field": "m",
+                                "patterns": ["%{NOSUCH:x}"]}}]})
+        assert status == 400
+
+    def test_dotted_semantic_builds_object(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"grok": {
+            "field": "m", "patterns": ["%{WORD:user.name}"]}}]})
+        out = p.execute({"m": "kimchy"})
+        assert out["user"]["name"] == "kimchy"
+
+
+class TestDissectProcessor:
+    def test_basic_split(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"dissect": {
+            "field": "m",
+            "pattern": "%{clientip} %{ident} %{auth} [%{ts}]"}}]})
+        out = p.execute({"m": "1.2.3.4 - alice [2021-01-01]"})
+        assert out["clientip"] == "1.2.3.4"
+        assert out["ident"] == "-"
+        assert out["auth"] == "alice"
+        assert out["ts"] == "2021-01-01"
+
+    def test_skip_and_append(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"dissect": {
+            "field": "m", "pattern": "%{+name} %{} %{+name}",
+            "append_separator": "-"}}]})
+        out = p.execute({"m": "john mid doe"})
+        assert out["name"] == "john-doe"
+
+    def test_mismatch_errors(self, node):
+        from elasticsearch_tpu.ingest import (IngestProcessorException,
+                                              Pipeline)
+        p = Pipeline("p", {"processors": [{"dissect": {
+            "field": "m", "pattern": "%{a}: %{b}"}}]})
+        with pytest.raises(IngestProcessorException):
+            p.execute({"m": "no separator here"})
+
+
+class TestForeachProcessor:
+    def test_uppercase_each(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"foreach": {
+            "field": "tags",
+            "processor": {"uppercase": {"field": "_ingest._value"}}}}]})
+        out = p.execute({"tags": ["a", "b"]})
+        assert out["tags"] == ["A", "B"]
+        assert "_ingest" not in out
+
+    def test_foreach_script(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"foreach": {
+            "field": "nums",
+            "processor": {"script": {
+                "source": "ctx._ingest._value = "
+                          "ctx._ingest._value * 10"}}}}]})
+        out = p.execute({"nums": [1, 2, 3]})
+        assert out["nums"] == [10, 20, 30]
+
+    def test_non_list_errors(self, node):
+        from elasticsearch_tpu.ingest import (IngestProcessorException,
+                                              Pipeline)
+        p = Pipeline("p", {"processors": [{"foreach": {
+            "field": "x",
+            "processor": {"uppercase": {"field": "_ingest._value"}}}}]})
+        with pytest.raises(IngestProcessorException):
+            p.execute({"x": "notalist"})
+
+
+class TestLogPipelineEndToEnd:
+    def test_grok_date_convert_chain(self, node):
+        status, _ = _handle(node, "PUT", "/_ingest/pipeline/weblogs",
+                            body={"processors": [
+                                {"grok": {"field": "message",
+                                          "patterns": [
+                                              "%{IPV4:ip} %{WORD:verb} "
+                                              "%{NOTSPACE:path} "
+                                              "%{NUMBER:status:int} "
+                                              "%{TIMESTAMP_ISO8601:ts}"]}},
+                                {"date": {"field": "ts",
+                                          "formats": ["ISO8601"]}},
+                                {"remove": {"field": "ts"}}]})
+        assert status == 200
+        status, _ = _handle(node, "PUT", "/logs/_doc/1",
+                            params={"refresh": "true",
+                                    "pipeline": "weblogs"},
+                            body={"message":
+                                  "10.0.0.5 GET /about 200 "
+                                  "2021-06-01T12:00:00Z"})
+        assert status in (200, 201)
+        _, doc = _handle(node, "GET", "/logs/_doc/1")
+        src = doc["_source"]
+        assert src["ip"] == "10.0.0.5" and src["status"] == 200
+        assert src["@timestamp"].startswith("2021-06-01T12:00:00")
+        assert "ts" not in src
+
+
+class TestIngestReviewRegressions:
+    def test_grok_cast_failure_respects_ignore_failure(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"grok": {
+            "field": "m", "patterns": ["%{WORD:x:int}"],
+            "ignore_failure": True}}]})
+        out = p.execute({"m": "abc"})  # int("abc") fails → ignored
+        assert out == {"m": "abc"}
+
+    def test_grok_unsupported_cast_rejected_at_put(self, node):
+        status, _ = _handle(node, "PUT", "/_ingest/pipeline/badcast",
+                            body={"processors": [{"grok": {
+                                "field": "m",
+                                "patterns": ["%{NUMBER:bytes:long}"]}}]})
+        assert status == 400
+
+    def test_date_timezone_offset(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"date": {
+            "field": "t", "formats": ["yyyy-MM-dd HH:mm:ss"],
+            "timezone": "+05:30"}}]})
+        out = p.execute({"t": "2021-03-04 10:00:00"})
+        assert out["@timestamp"].endswith("+05:30")
+
+    def test_date_output_format(self, node):
+        from elasticsearch_tpu.ingest import Pipeline
+        p = Pipeline("p", {"processors": [{"date": {
+            "field": "t", "formats": ["ISO8601"],
+            "output_format": "yyyy/MM/dd"}}]})
+        out = p.execute({"t": "2021-03-04T05:06:07Z"})
+        assert out["@timestamp"] == "2021/03/04"
+
+    def test_date_bad_timezone_400(self, node):
+        status, _ = _handle(node, "PUT", "/_ingest/pipeline/badtz",
+                            body={"processors": [{"date": {
+                                "field": "t", "formats": ["ISO8601"],
+                                "timezone": "Not/AZone"}}]})
+        assert status == 400
