@@ -167,22 +167,32 @@ class Analyzer:
         return flatten_slots(self.analyze_slots(text))
 
 
+def _map_terms(slots, fn):
+    """1:1 term mapping over the slot structure, handling the stacked
+    (list) entries multi-token filters produce — every basic filter must
+    compose AFTER ngram/synonym/shingle, not just before."""
+    from elasticsearch_tpu.analysis.filters import _map_each
+    return _map_each(slots, fn)
+
+
 def lowercase_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
-    return [s.lower() if s else s for s in slots]
+    return _map_terms(slots, str.lower)
 
 
 def make_stop_filter(stopwords) -> Callable:
     stopset = frozenset(stopwords)
 
     def stop_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
-        return [None if s and s in stopset else s for s in slots]
+        return _map_terms(slots,
+                          lambda s: None if s in stopset else s)
 
     return stop_filter
 
 
 def make_length_filter(min_len: int = 0, max_len: int = 2**31) -> Callable:
     def length_filter(slots):
-        return [s if s and min_len <= len(s) <= max_len else None for s in slots]
+        return _map_terms(
+            slots, lambda s: s if min_len <= len(s) <= max_len else None)
 
     return length_filter
 
@@ -195,7 +205,7 @@ def asciifolding_filter(slots: List[Optional[str]]) -> List[Optional[str]]:
             c for c in unicodedata.normalize("NFKD", s) if not unicodedata.combining(c)
         )
 
-    return [fold(s) if s else s for s in slots]
+    return _map_terms(slots, fold)
 
 
 class StandardAnalyzer(Analyzer):

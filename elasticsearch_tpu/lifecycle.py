@@ -144,14 +144,31 @@ def rollover(node, alias: str, body: Optional[Dict[str, Any]],
 def shrink(node, source: str, target: str,
            body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """PUT /<source>/_shrink/<target>: rebuild the source's live docs
-    into an index with fewer shards. Preconditions per the reference:
-    the target shard count divides the source's, and the source carries
-    a write block. Custom-routed docs re-route by _id in the target
-    (per-doc _routing is not persisted — divergence noted)."""
+    into an index with fewer shards (reference: TransportResizeAction,
+    SHRINK flavor)."""
+    return _resize(node, source, target, body, mode="shrink")
+
+
+def split(node, source: str, target: str,
+          body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """PUT /<source>/_split/<target>: more shards, target count a
+    multiple of the source's (reference: TransportResizeAction, SPLIT
+    flavor — SURVEY.md §2.1#49)."""
+    return _resize(node, source, target, body, mode="split")
+
+
+def _resize(node, source: str, target: str,
+            body: Optional[Dict[str, Any]], *, mode: str
+            ) -> Dict[str, Any]:
+    """Shared resize: copy live docs into a fresh index with the target
+    shard count. Preconditions per the reference: divisibility in the
+    right direction and a write block on the source. Custom-routed docs
+    re-route by _id in the target (per-doc _routing is not persisted —
+    divergence noted)."""
     if node.cluster is not None:
         raise IllegalArgumentException(
-            "_shrink is supported on single-node deployments only for "
-            "now (cluster resize requires co-located source shards)")
+            f"_{mode} is supported on single-node deployments only for "
+            f"now (cluster resize requires co-located source shards)")
     indices = node.indices
     svc = indices.index(source)
     if svc.closed:
@@ -164,13 +181,19 @@ def shrink(node, source: str, target: str,
     settings = Settings.normalize_index_settings(body.get("settings"))
     n_target = int(settings.get("index.number_of_shards", 1))
     settings["index.number_of_shards"] = n_target
-    # the shrunken index must not inherit the source's write block
+    # the resized index must not inherit the source's write block
     settings.setdefault("index.blocks.write", None)
     settings = {k: v for k, v in settings.items() if v is not None}
-    if n_target <= 0 or svc.num_shards % n_target != 0:
-        raise IllegalArgumentException(
-            f"the number of source shards [{svc.num_shards}] must be a "
-            f"multiple of [{n_target}]")
+    if mode == "shrink":
+        if n_target <= 0 or svc.num_shards % n_target != 0:
+            raise IllegalArgumentException(
+                f"the number of source shards [{svc.num_shards}] must "
+                f"be a multiple of [{n_target}]")
+    else:
+        if n_target <= 0 or n_target % svc.num_shards != 0:
+            raise IllegalArgumentException(
+                f"the number of target shards [{n_target}] must be a "
+                f"multiple of the source shards [{svc.num_shards}]")
     tgt = node.create_index(target, Settings(settings),
                             svc.mapper.to_mapping())
     copied = 0

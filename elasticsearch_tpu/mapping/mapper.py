@@ -37,6 +37,7 @@ from elasticsearch_tpu.mapping.types import (
     CompletionFieldType,
     DenseVectorFieldType,
     FieldType,
+    GeoPointFieldType,
     IpFieldType,
     RangeFieldType,
     TextFieldType,
@@ -289,6 +290,9 @@ class MapperService:
                 out[f + RangeFieldType.LTE_SUFFIX] = t.bound_kind
             elif isinstance(t, CompletionFieldType):
                 out[f + CompletionFieldType.WEIGHT_SUFFIX] = "i64"
+            elif isinstance(t, GeoPointFieldType):
+                out[f + GeoPointFieldType.LAT_SUFFIX] = "f64"
+                out[f + GeoPointFieldType.LON_SUFFIX] = "f64"
         return out
 
     def to_mapping(self) -> dict:
@@ -356,7 +360,8 @@ class MapperService:
             # a plain object
             value_is_object_field = isinstance(
                 self.mapper.fields.get(path),
-                (RangeFieldType, CompletionFieldType))
+                (RangeFieldType, CompletionFieldType,
+                 GeoPointFieldType))
             if isinstance(value, dict) and not value_is_object_field:
                 self._parse_object(value, path + ".", parsed,
                                    update_props)
@@ -364,6 +369,15 @@ class MapperService:
             if isinstance(self.mapper.fields.get(path),
                           DenseVectorFieldType):
                 # the ARRAY is the value — never flattened per element
+                self._index_values(self.mapper.fields[path], path,
+                                   [value], parsed)
+                continue
+            if isinstance(self.mapper.fields.get(path),
+                          GeoPointFieldType) and \
+                    isinstance(value, list) and value and \
+                    isinstance(value[0], (int, float)):
+                # [lon, lat] is ONE point (GeoJSON order), not a
+                # multi-value array (reference disambiguation rule)
                 self._index_values(self.mapper.fields[path], path,
                                    [value], parsed)
                 continue
@@ -434,6 +448,13 @@ class MapperService:
                 hi, lo = IpFieldType.split128(ft.parse_ip(v))
                 _append_dv(parsed, path + IpFieldType.HI_SUFFIX, hi)
                 _append_dv(parsed, path + IpFieldType.LO_SUFFIX, lo)
+                continue
+            if isinstance(ft, GeoPointFieldType):
+                lat, lon = ft.parse_point(v)
+                _append_dv(parsed, path + GeoPointFieldType.LAT_SUFFIX,
+                           lat)
+                _append_dv(parsed, path + GeoPointFieldType.LON_SUFFIX,
+                           lon)
                 continue
             if isinstance(ft, RangeFieldType):
                 glo, ghi = ft.parse_range(v)

@@ -435,6 +435,181 @@ class CompletionFieldType(FieldType):
         return str(value)
 
 
+class RankFeatureFieldType(FieldType):
+    """`rank_feature` — a positive per-doc float scored through
+    saturation/log/sigmoid at query time (reference:
+    modules/mapper-extras RankFeatureFieldMapper + RankFeatureQuery,
+    SURVEY.md §2.1#54). The value lives in an f64 doc-values column;
+    the rank_feature query is pure column math on device — the natural
+    TPU formulation of the reference's impact-encoded postings trick."""
+
+    type_name = "rank_feature"
+    dv_kind = "f64"
+    is_indexed = False
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        super().__init__(name, params)
+        self.positive_score_impact = bool(
+            (params or {}).get("positive_score_impact", True))
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [], 0
+
+    def doc_value(self, value: Any):
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            raise MapperParsingException(
+                f"[rank_feature] field [{self.name}] expects a number, "
+                f"got [{value!r}]") from None
+        if not v > 0 or v != v or v == float("inf"):
+            raise MapperParsingException(
+                f"[rank_feature] field [{self.name}] must be a finite "
+                f"positive normal float, got [{value}]")
+        return v
+
+    def normalize_term(self, value: Any) -> str:
+        raise MapperParsingException(
+            f"[rank_feature] field [{self.name}] does not support term "
+            f"queries (use the rank_feature query)")
+
+    def to_mapping(self) -> dict:
+        out = {"type": "rank_feature"}
+        if not self.positive_score_impact:
+            out["positive_score_impact"] = False
+        return out
+
+
+class GeoPointFieldType(FieldType):
+    """`geo_point` — lat/lon pairs in two synthetic f64 doc-value
+    columns (`<f>._lat`, `<f>._lon`), the same split-column trick as
+    `ip` (reference: GeoPointFieldMapper, SURVEY.md §2.1#55). Distance
+    and bounding-box queries become vectorized column math — haversine
+    over a whole segment in one fused elementwise pass, no BKD tree."""
+
+    type_name = "geo_point"
+    dv_kind = "none"
+    has_doc_values = False  # columns are the synthetic pair below
+    is_indexed = False
+
+    LAT_SUFFIX = "._lat"
+    LON_SUFFIX = "._lon"
+
+    _GEOHASH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+    @classmethod
+    def parse_point(cls, value: Any) -> Tuple[float, float]:
+        """Accepts {"lat","lon"}, "lat,lon", [lon, lat] (GeoJSON
+        order!), or a geohash string → (lat, lon)."""
+        if isinstance(value, dict):
+            if "lat" not in value or "lon" not in value:
+                raise MapperParsingException(
+                    "geo_point object must have [lat] and [lon]")
+            lat, lon = float(value["lat"]), float(value["lon"])
+        elif isinstance(value, (list, tuple)):
+            if len(value) != 2:
+                raise MapperParsingException(
+                    "geo_point array must be [lon, lat]")
+            lon, lat = float(value[0]), float(value[1])
+        elif isinstance(value, str):
+            if "," in value:
+                parts = value.split(",")
+                if len(parts) != 2:
+                    raise MapperParsingException(
+                        f"failed to parse geo_point [{value}]")
+                try:
+                    lat, lon = float(parts[0]), float(parts[1])
+                except ValueError:
+                    raise MapperParsingException(
+                        f"failed to parse geo_point [{value}]") from None
+            else:
+                lat, lon = cls.geohash_decode(value)
+        else:
+            raise MapperParsingException(
+                f"failed to parse geo_point [{value!r}]")
+        if not -90.0 <= lat <= 90.0:
+            raise MapperParsingException(
+                f"latitude [{lat}] out of range [-90, 90]")
+        if not -180.0 <= lon <= 180.0:
+            raise MapperParsingException(
+                f"longitude [{lon}] out of range [-180, 180]")
+        return lat, lon
+
+    @classmethod
+    def geohash_decode(cls, gh: str) -> Tuple[float, float]:
+        lat_lo, lat_hi = -90.0, 90.0
+        lon_lo, lon_hi = -180.0, 180.0
+        even = True
+        for c in gh.lower():
+            idx = cls._GEOHASH32.find(c)
+            if idx < 0:
+                raise MapperParsingException(
+                    f"invalid geohash character [{c}]")
+            for bit in (16, 8, 4, 2, 1):
+                if even:
+                    mid = (lon_lo + lon_hi) / 2
+                    if idx & bit:
+                        lon_lo = mid
+                    else:
+                        lon_hi = mid
+                else:
+                    mid = (lat_lo + lat_hi) / 2
+                    if idx & bit:
+                        lat_lo = mid
+                    else:
+                        lat_hi = mid
+                even = not even
+        return (lat_lo + lat_hi) / 2, (lon_lo + lon_hi) / 2
+
+    @classmethod
+    def geohash_encode(cls, lat: float, lon: float,
+                       precision: int = 5) -> str:
+        lat_lo, lat_hi = -90.0, 90.0
+        lon_lo, lon_hi = -180.0, 180.0
+        even = True
+        out = []
+        idx = 0
+        nbits = 0
+        while len(out) < precision:
+            if even:
+                mid = (lon_lo + lon_hi) / 2
+                if lon >= mid:
+                    idx = idx * 2 + 1
+                    lon_lo = mid
+                else:
+                    idx = idx * 2
+                    lon_hi = mid
+            else:
+                mid = (lat_lo + lat_hi) / 2
+                if lat >= mid:
+                    idx = idx * 2 + 1
+                    lat_lo = mid
+                else:
+                    idx = idx * 2
+                    lat_hi = mid
+            even = not even
+            nbits += 1
+            if nbits == 5:
+                out.append(cls._GEOHASH32[idx])
+                idx = 0
+                nbits = 0
+        return "".join(out)
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [], 0
+
+    def doc_value(self, value: Any):
+        return self.parse_point(value)
+
+    def normalize_term(self, value: Any) -> str:
+        raise MapperParsingException(
+            f"[geo_point] field [{self.name}] does not support term "
+            f"queries")
+
+    def to_mapping(self) -> dict:
+        return {"type": "geo_point"}
+
+
 class DenseVectorFieldType(FieldType):
     """`dense_vector` — fixed-dim float vectors stored as one dense
     [docs, dims] f32 matrix per segment (reference:
@@ -526,4 +701,8 @@ def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
         return CompletionFieldType(name, params)
     if t == "dense_vector":
         return DenseVectorFieldType(name, params)
+    if t == "rank_feature":
+        return RankFeatureFieldType(name, params)
+    if t == "geo_point":
+        return GeoPointFieldType(name, params)
     raise MapperParsingException(f"no handler for type [{t}] declared on field [{name}]")

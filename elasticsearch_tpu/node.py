@@ -241,11 +241,12 @@ class Node:
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
-                                                    document, ingest, search,
+                                                    document, ingest,
+                                                    introspect, search,
                                                     snapshots, tasks,
                                                     templates)
         for module in (document, search, admin, cluster, tasks, ingest,
-                       snapshots, aliases, templates):
+                       snapshots, aliases, templates, introspect):
             module.register(self.controller, self)
         self.plugins.install_rest_handlers(self.controller, self)
 
@@ -378,6 +379,10 @@ class _Handler(BaseHTTPRequestHandler):
                                            None, raw)
         if isinstance(payload, dict) and "_cat" in payload and len(payload) == 1:
             data = payload["_cat"].encode("utf-8")
+            ctype = "text/plain; charset=UTF-8"
+        elif isinstance(payload, str):
+            # text endpoints (_nodes/hot_threads) respond as plain text
+            data = payload.encode("utf-8")
             ctype = "text/plain; charset=UTF-8"
         else:
             data = json.dumps(payload).encode("utf-8")

@@ -100,6 +100,11 @@ def register(controller: RestController, node) -> None:
         return 200, lifecycle.shrink(node, req.param("index"),
                                      req.param("target"), req.body)
 
+    def split_index(req: RestRequest):
+        from elasticsearch_tpu import lifecycle
+        return 200, lifecycle.split(node, req.param("index"),
+                                    req.param("target"), req.body)
+
     def get_index(req: RestRequest):
         if node.cluster is not None:
             state = node.cluster.applied_state()
@@ -261,6 +266,8 @@ def register(controller: RestController, node) -> None:
                         rollover_named)
     controller.register("PUT", "/{index}/_shrink/{target}", shrink_index)
     controller.register("POST", "/{index}/_shrink/{target}", shrink_index)
+    controller.register("PUT", "/{index}/_split/{target}", split_index)
+    controller.register("POST", "/{index}/_split/{target}", split_index)
     controller.register("GET", "/{index}", get_index)
     controller.register("HEAD", "/{index}", head_index)
     controller.register("PUT", "/{index}/_mapping", put_mapping)

@@ -1,0 +1,357 @@
+"""Introspection / debugging APIs: _field_caps, _validate/query,
+_explain, _termvectors, _nodes/hot_threads, _cluster/allocation/explain
+(reference: FieldCapabilities*, TransportValidateQueryAction,
+TransportExplainAction, TermVectorsService, HotThreads,
+ClusterAllocationExplainAction — SURVEY.md §2.1#40/47/49/56, §5.1).
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (DocumentMissingException,
+                                             IllegalArgumentException,
+                                             IndexNotFoundException)
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+
+# field types that aggregate via doc-values columns
+_AGGREGATABLE = {"keyword", "long", "integer", "short", "byte", "double",
+                 "float", "half_float", "date", "boolean", "ip",
+                 "rank_feature", "geo_point"}
+_SEARCHABLE_EXTRA = {"dense_vector", "rank_feature", "geo_point"}
+
+
+def field_caps(node, index_expr: Optional[str],
+               fields_param: Optional[str]) -> Dict[str, Any]:
+    """→ the _field_caps response: per field, per type, searchable /
+    aggregatable, with the contributing indices listed (reference:
+    FieldCapabilitiesResponse)."""
+    import fnmatch
+
+    from elasticsearch_tpu.search.coordinator import resolve_targets
+    names, _filters = resolve_targets(node.indices, index_expr)
+    patterns = [p.strip() for p in (fields_param or "*").split(",")
+                if p.strip()]
+    per_field: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for name in names:
+        svc = node.indices.index(name)
+        for path, ft in svc.mapper.mapper.fields.items():
+            if not any(fnmatch.fnmatchcase(path, p) for p in patterns):
+                continue
+            t = ft.type_name
+            entry = per_field.setdefault(path, {}).setdefault(t, {
+                "type": t,
+                "metadata_field": False,
+                "searchable": bool(getattr(ft, "is_indexed", True))
+                or t in _SEARCHABLE_EXTRA,
+                "aggregatable": t in _AGGREGATABLE,
+                "indices": []})
+            entry["indices"].append(name)
+    out_fields: Dict[str, Any] = {}
+    for path, types in per_field.items():
+        out: Dict[str, Any] = {}
+        for t, entry in types.items():
+            # `indices` is only reported when the field does NOT span
+            # every target index (reference behavior)
+            if len(entry["indices"]) == len(names):
+                entry = {k: v for k, v in entry.items()
+                         if k != "indices"}
+            out[t] = entry
+        out_fields[path] = out
+    return {"indices": sorted(names), "fields": out_fields}
+
+
+def validate_query(node, index_expr: Optional[str],
+                   body: Optional[Dict[str, Any]],
+                   explain: bool) -> Dict[str, Any]:
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.coordinator import resolve_targets
+    names, _ = resolve_targets(node.indices, index_expr)
+    spec = (body or {}).get("query") or {"match_all": {}}
+    try:
+        parsed = dsl.parse_query(spec)
+    except Exception as exc:  # noqa: BLE001 — the point is to report it
+        out = {"valid": False,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if explain:
+            out["error"] = str(exc)
+        return out
+    out = {"valid": True,
+           "_shards": {"total": 1, "successful": 1, "failed": 0}}
+    if explain:
+        out["explanations"] = [
+            {"index": name, "valid": True,
+             "explanation": parsed.query_name()} for name in names]
+    return out
+
+
+def explain_doc(node, index: str, doc_id: str,
+                body: Optional[Dict[str, Any]],
+                params: Dict[str, str]) -> Dict[str, Any]:
+    """GET /{index}/_explain/{id}: does the query match this doc, and
+    with what score (reference: TransportExplainAction; the Lucene
+    explanation tree is summarized — scores here come from one fused
+    kernel, not a per-clause scorer walk)."""
+    import numpy as np
+
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.planner import SegmentQueryExecutor
+    spec = (body or {}).get("query")
+    if spec is None:
+        raise IllegalArgumentException("[_explain] requires a [query]")
+    query = dsl.parse_query(spec)
+    svc = node.indices.index(index)
+    shard_num = svc.shard_for_id(doc_id, params.get("routing"))
+    reader = svc.shard(shard_num).acquire_searcher()
+    for view_idx, view in enumerate(reader.views):
+        ord_ = view.segment.id_to_ord.get(doc_id)
+        if ord_ is None or not view.live_mask[ord_]:
+            continue
+        mask, score = SegmentQueryExecutor(reader, view_idx).execute(
+            query)
+        matched = bool(np.asarray(mask)[ord_])
+        value = float(np.asarray(score)[ord_]) if matched else 0.0
+        desc = f"score({query.query_name()})" if matched else \
+            "no matching clause"
+        return {"_index": index, "_id": doc_id, "matched": matched,
+                "explanation": {"value": value, "description": desc,
+                                "details": []}}
+    raise DocumentMissingException(f"[{doc_id}]: document missing")
+
+
+def termvectors(node, index: str, doc_id: str,
+                body: Optional[Dict[str, Any]],
+                params: Dict[str, str]) -> Dict[str, Any]:
+    """GET /{index}/_termvectors/{id}: per text field, the doc's terms
+    with frequencies and positions (re-derived from _source through the
+    field's analyzer — the realtime flavor of TermVectorsService)."""
+    from elasticsearch_tpu.mapping.types import TextFieldType
+    body = body or {}
+    svc = node.indices.index(index)
+    shard_num = svc.shard_for_id(doc_id, params.get("routing"))
+    doc = svc.shard(shard_num).get(doc_id)
+    if doc is None:
+        return {"_index": index, "_id": doc_id, "found": False}
+    source = doc.get("_source") or {}
+    want = body.get("fields") or params.get("fields")
+    if isinstance(want, str):
+        want = [f.strip() for f in want.split(",") if f.strip()]
+    from elasticsearch_tpu.ingest import get_field
+    reader = svc.shard(shard_num).acquire_searcher()
+    tv: Dict[str, Any] = {}
+    for path, ft in svc.mapper.mapper.fields.items():
+        if not isinstance(ft, TextFieldType):
+            continue
+        if want and path not in want:
+            continue
+        # dotted traversal: object-mapped fields live nested in _source;
+        # multi-fields (title.en) read their parent's value
+        value = get_field(source, path)
+        if value is None and "." in path:
+            value = get_field(source, path.rsplit(".", 1)[0])
+        if value is None:
+            continue
+        values = value if isinstance(value, list) else [value]
+        term_stats: Dict[str, Dict[str, Any]] = {}
+        pos_base = 0
+        for v in values:
+            tokens = ft.analyzer.analyze(str(v))
+            for tok in tokens:
+                entry = term_stats.setdefault(
+                    tok.term, {"term_freq": 0, "tokens": []})
+                entry["term_freq"] += 1
+                entry["tokens"].append(
+                    {"position": pos_base + tok.position})
+            pos_base += 100 + len(tokens)
+        if not term_stats:
+            continue
+        doc_count, avgdl = reader.field_stats(path)
+        field_block: Dict[str, Any] = {
+            "field_statistics": {
+                "sum_doc_freq": sum(
+                    reader.doc_freq(path, t) for t in term_stats),
+                "doc_count": doc_count,
+                "sum_ttf": int(avgdl * doc_count)},
+            "terms": {}}
+        want_stats = (str(params.get("term_statistics",
+                                     body.get("term_statistics",
+                                              "false"))).lower()
+                      == "true")
+        for term in sorted(term_stats):
+            entry = dict(term_stats[term])
+            if want_stats:
+                entry["doc_freq"] = reader.doc_freq(path, term)
+            field_block["terms"][term] = entry
+        tv[path] = field_block
+    return {"_index": index, "_id": doc_id, "found": True,
+            "took": 0, "term_vectors": tv}
+
+
+def hot_threads(node, params: Dict[str, str]) -> str:
+    """_nodes/hot_threads: sample every Python thread's stack N times,
+    rank by how often each top frame is seen (reference:
+    monitor/jvm/HotThreads — a text report, not JSON)."""
+    import threading
+    import traceback
+
+    snapshots = int(params.get("snapshots", 3))
+    interval_s = 0.05
+    threads = int(params.get("threads", 3))
+    counts: Dict[str, int] = collections.Counter()
+    samples: Dict[str, List[str]] = {}
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    for _ in range(snapshots):
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = traceback.extract_stack(frame)
+            if not stack:
+                continue
+            key = names.get(ident, str(ident))
+            counts[key] += 1
+            samples[key] = [
+                f"  {f.name} ({f.filename.rsplit('/', 1)[-1]}:"
+                f"{f.lineno})" for f in reversed(stack[-10:])]
+        time.sleep(interval_s)
+    lines = [f"::: {{{node.node_name}}}",
+             f"   Hot threads at {time.strftime('%Y-%m-%dT%H:%M:%S')}, "
+             f"interval={int(interval_s * 1000)}ms, busiestThreads="
+             f"{threads}, ignoreIdleThreads=true:"]
+    for name, cnt in counts.most_common(threads):
+        share = 100.0 * cnt / max(snapshots, 1)
+        lines.append(f"   {share:.1f}% sampled usage by thread "
+                     f"'{name}'")
+        lines.extend(samples.get(name, []))
+    return "\n".join(lines) + "\n"
+
+
+def allocation_explain(node, body: Optional[Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """_cluster/allocation/explain (reference:
+    ClusterAllocationExplainAction): where one shard is and why, or —
+    with an empty body — the first unassigned shard found."""
+    body = body or {}
+    cluster = node.cluster
+    if cluster is None:
+        # single-node: explain against the local registry
+        index = body.get("index")
+        names = [index] if index else sorted(node.indices.indices)
+        shard_num = int(body.get("shard", 0))
+        for name in names:
+            try:
+                svc = node.indices.index(name)
+            except IndexNotFoundException:
+                raise
+            if shard_num not in svc.shards:
+                continue
+            return {"index": name, "shard": shard_num,
+                    "primary": bool(body.get("primary", True)),
+                    "current_state": "started",
+                    "current_node": {"id": node.node_name,
+                                     "name": node.node_name},
+                    "explanation": "shard is started on the only node"}
+        raise IllegalArgumentException(
+            "unable to find any shards to explain "
+            f"[{body}] in the routing table")
+    state = cluster.applied_state()
+    targets = []
+    if body.get("index") is not None:
+        targets.append((str(body["index"]), int(body.get("shard", 0)),
+                        bool(body.get("primary", True))))
+    else:
+        # first unassigned shard, as the reference defaults
+        for name, meta in state.indices.items():
+            for s in range(meta.number_of_shards):
+                copies = state.shard_copies(name, s)
+                started = [c for c in copies if c.state == "STARTED"]
+                if len(started) < 1 + meta.number_of_replicas:
+                    targets.append((name, s, len(started) == 0))
+                    break
+    if not targets:
+        raise IllegalArgumentException(
+            "unable to find any unassigned shards to explain; specify "
+            "the target shard [index/shard/primary] in the request")
+    name, shard_num, primary = targets[0]
+    meta = state.indices.get(name)
+    if meta is None:
+        raise IndexNotFoundException(f"no such index [{name}]")
+    copies = state.shard_copies(name, shard_num)
+    started = [c for c in copies if c.state == "STARTED"]
+    out: Dict[str, Any] = {"index": name, "shard": shard_num,
+                           "primary": primary}
+    if started:
+        c = started[0]
+        nname = state.nodes[c.node_id].name \
+            if c.node_id in state.nodes else c.node_id
+        out["current_state"] = "started"
+        out["current_node"] = {"id": c.node_id, "name": nname}
+        out["explanation"] = (
+            f"shard has {len(started)} started "
+            f"{'copies' if len(started) > 1 else 'copy'} of "
+            f"{1 + meta.number_of_replicas} wanted")
+    else:
+        out["current_state"] = "unassigned"
+        out["unassigned_info"] = {"reason": "NODE_LEFT" if copies
+                                  else "INDEX_CREATED"}
+        out["explanation"] = (
+            "cannot allocate because no node holds an in-sync copy "
+            "of the shard" if copies else
+            "the shard has never been assigned")
+    return out
+
+
+def register(controller: RestController, node) -> None:
+    def do_field_caps(req: RestRequest):
+        fields = req.params.get("fields")
+        if fields is None and isinstance(req.body, dict):
+            f = req.body.get("fields")
+            fields = ",".join(f) if isinstance(f, list) else f
+        return 200, field_caps(node, req.param("index"), fields)
+
+    def do_validate(req: RestRequest):
+        explain = str(req.params.get("explain", "false")).lower() == \
+            "true"
+        return 200, validate_query(node, req.param("index"),
+                                   req.body or {}, explain)
+
+    def do_explain(req: RestRequest):
+        return 200, explain_doc(node, req.param("index"),
+                                req.param("id"), req.body or {},
+                                req.params)
+
+    def do_termvectors(req: RestRequest):
+        return 200, termvectors(node, req.param("index"),
+                                req.param("id"), req.body or {},
+                                req.params)
+
+    def do_hot_threads(req: RestRequest):
+        return 200, hot_threads(node, req.params)
+
+    def do_alloc_explain(req: RestRequest):
+        return 200, allocation_explain(node, req.body or {})
+
+    controller.register("GET", "/_field_caps", do_field_caps)
+    controller.register("POST", "/_field_caps", do_field_caps)
+    controller.register("GET", "/{index}/_field_caps", do_field_caps)
+    controller.register("POST", "/{index}/_field_caps", do_field_caps)
+    controller.register("GET", "/{index}/_validate/query", do_validate)
+    controller.register("POST", "/{index}/_validate/query", do_validate)
+    controller.register("GET", "/_validate/query", do_validate)
+    controller.register("POST", "/_validate/query", do_validate)
+    controller.register("GET", "/{index}/_explain/{id}", do_explain)
+    controller.register("POST", "/{index}/_explain/{id}", do_explain)
+    controller.register("GET", "/{index}/_termvectors/{id}",
+                        do_termvectors)
+    controller.register("POST", "/{index}/_termvectors/{id}",
+                        do_termvectors)
+    controller.register("GET", "/_nodes/hot_threads", do_hot_threads)
+    controller.register("GET", "/_nodes/{node_id}/hot_threads",
+                        do_hot_threads)
+    controller.register("GET", "/_cluster/allocation/explain",
+                        do_alloc_explain)
+    controller.register("POST", "/_cluster/allocation/explain",
+                        do_alloc_explain)

@@ -927,3 +927,108 @@ def _parse_significant_terms(name, body, sub):
     return SignificantTermsAggregator(
         name, field, size, max(size, shard_size),
         int(body.get("min_doc_count", 3)), sub)
+
+
+# ---------------------------------------------------------------------------
+# geohash_grid
+# ---------------------------------------------------------------------------
+
+def geohash_encode_batch(lats: np.ndarray, lons: np.ndarray,
+                         precision: int) -> List[str]:
+    """Vectorized geohash: interleave lon/lat bisection bits across the
+    whole array (reference: Geohash utils behind GeoHashGridAggregator).
+    5·precision bisection steps over numpy arrays, no per-doc loop."""
+    from elasticsearch_tpu.mapping.types import GeoPointFieldType
+    n = len(lats)
+    nbits = 5 * precision
+    lat_lo = np.full(n, -90.0)
+    lat_hi = np.full(n, 90.0)
+    lon_lo = np.full(n, -180.0)
+    lon_hi = np.full(n, 180.0)
+    bits = np.zeros((nbits, n), dtype=np.int8)
+    for b in range(nbits):
+        if b % 2 == 0:  # even bit: longitude
+            mid = (lon_lo + lon_hi) / 2
+            hi = lons >= mid
+            bits[b] = hi
+            lon_lo = np.where(hi, mid, lon_lo)
+            lon_hi = np.where(hi, lon_hi, mid)
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            hi = lats >= mid
+            bits[b] = hi
+            lat_lo = np.where(hi, mid, lat_lo)
+            lat_hi = np.where(hi, lat_hi, mid)
+    alphabet = GeoPointFieldType._GEOHASH32
+    chars = np.zeros((precision, n), dtype=np.int8)
+    for c in range(precision):
+        for k in range(5):
+            chars[c] = chars[c] * 2 + bits[c * 5 + k]
+    return ["".join(alphabet[chars[c, i]] for c in range(precision))
+            for i in range(n)]
+
+
+class GeoHashGridAggregator(Aggregator):
+    """{"geohash_grid": {"field": f, "precision": 1..12, "size": N}} —
+    bucket geo points by geohash cell (reference:
+    geogrid/GeoHashGridAggregator, SURVEY.md §2.1#55). Reduces through
+    the InternalTerms machinery (count-ordered cells)."""
+
+    def __init__(self, name, field, precision, size, shard_size, sub):
+        super().__init__(name, sub)
+        self.field = field
+        self.precision = precision
+        self.size = size
+        self.shard_size = shard_size
+
+    def _points(self, ctx: SegmentAggContext, mask):
+        from elasticsearch_tpu.mapping.types import GeoPointFieldType
+        pack = ctx.view.pack
+        n = ctx.view.segment.num_docs
+        lat = pack.dv_f64.get(self.field + GeoPointFieldType.LAT_SUFFIX)
+        lon = pack.dv_f64.get(self.field + GeoPointFieldType.LON_SUFFIX)
+        if lat is None or lon is None:
+            return (np.empty(0), np.empty(0),
+                    np.empty(0, dtype=np.int64))
+        m = np.asarray(mask)[:n] & ~np.isnan(lat[:n])
+        docs = np.nonzero(m)[0]
+        return lat[:n][m], lon[:n][m], docs
+
+    def collect(self, ctx: SegmentAggContext, mask) -> InternalTerms:
+        lats, lons, docs = self._points(ctx, mask)
+        buckets: Dict[Any, Bucket] = {}
+        if len(lats):
+            hashes = np.asarray(geohash_encode_batch(
+                lats, lons, self.precision))
+            uniq, inv = np.unique(hashes, return_inverse=True)
+            counts = np.bincount(inv)
+            order = np.argsort(-counts, kind="stable")[: self.shard_size]
+            for i in order:
+                key = str(uniq[i])
+                sub = {}
+                if self.sub:
+                    bucket_mask = np.zeros_like(np.asarray(mask))
+                    bucket_mask[docs[inv == i]] = True
+                    sub = self.sub.collect(
+                        ctx, np.asarray(mask) & bucket_mask)
+                buckets[key] = Bucket(key, int(counts[i]), sub)
+        return InternalTerms(self.size, 1, buckets, "_count", False)
+
+    def empty(self) -> InternalTerms:
+        return InternalTerms(self.size, 1, {}, "_count", False)
+
+
+@register_agg("geohash_grid")
+def _parse_geohash_grid(name, body, sub):
+    field = body.get("field")
+    if field is None:
+        raise IllegalArgumentException("[geohash_grid] requires a field")
+    precision = int(body.get("precision", 5))
+    if not 1 <= precision <= 12:
+        raise IllegalArgumentException(
+            f"[geohash_grid] precision must be in [1, 12], got "
+            f"{precision}")
+    size = int(body.get("size", 10000))
+    shard_size = int(body.get("shard_size", max(size, 10) * 3 // 2 + 10))
+    return GeoHashGridAggregator(name, field, precision, size,
+                                 max(size, shard_size), sub)

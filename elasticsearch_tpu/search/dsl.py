@@ -24,6 +24,7 @@ Lowering to kernels happens in search/planner.py against a shard reader
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import ParsingException
@@ -207,6 +208,54 @@ class FunctionScoreQuery(QueryNode):
 
     def query_name(self) -> str:
         return "function_score"
+
+
+@dataclasses.dataclass
+class RankFeatureQuery(QueryNode):
+    """{"rank_feature": {"field": f, "saturation"|"log"|"sigmoid"|
+    "linear": {...}}} — score docs by a stored feature value
+    (reference: mapper-extras RankFeatureQueryBuilder; SURVEY.md
+    §2.1#54). Default function: saturation with an index-derived
+    pivot."""
+
+    field: str = ""
+    function: str = "saturation"   # saturation | log | sigmoid | linear
+    pivot: Optional[float] = None  # saturation/sigmoid
+    scaling_factor: Optional[float] = None  # log
+    exponent: Optional[float] = None        # sigmoid
+
+    def query_name(self) -> str:
+        return "rank_feature"
+
+
+@dataclasses.dataclass
+class GeoDistanceQuery(QueryNode):
+    """{"geo_distance": {"distance": "12km", "<field>": point}} —
+    haversine radius filter on a geo_point column (reference:
+    GeoDistanceQueryBuilder; SURVEY.md §2.1#55)."""
+
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+
+    def query_name(self) -> str:
+        return "geo_distance"
+
+
+@dataclasses.dataclass
+class GeoBoundingBoxQuery(QueryNode):
+    """{"geo_bounding_box": {"<field>": {"top_left": ..,
+    "bottom_right": ..}}} (reference: GeoBoundingBoxQueryBuilder)."""
+
+    field: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
+
+    def query_name(self) -> str:
+        return "geo_bounding_box"
 
 
 @dataclasses.dataclass
@@ -574,6 +623,132 @@ def _parse_function_score(body) -> FunctionScoreQuery:
         boost=float(body.get("boost", 1.0)))
 
 
+DISTANCE_UNITS_M = {
+    "mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+    "in": 0.0254, "ft": 0.3048, "yd": 0.9144,
+    "mi": 1609.344, "miles": 1609.344, "nmi": 1852.0, "NM": 1852.0,
+}
+
+
+def parse_distance_m(spec: Any) -> float:
+    """Distance grammar "12km"/"5mi"/number-of-meters (reference:
+    DistanceUnit#parse)."""
+    if isinstance(spec, (int, float)) and not isinstance(spec, bool):
+        return float(spec)
+    s = str(spec).strip()
+    m = re.fullmatch(r"([\d.]+)\s*([a-zA-Z]*)", s)
+    if not m:
+        raise ParsingException(f"failed to parse distance [{spec}]")
+    value = float(m.group(1))
+    unit = m.group(2) or "m"
+    factor = DISTANCE_UNITS_M.get(unit)
+    if factor is None:
+        raise ParsingException(f"unknown distance unit [{unit}]")
+    return value * factor
+
+
+def _parse_rank_feature(body) -> RankFeatureQuery:
+    if not isinstance(body, dict) or "field" not in body:
+        raise ParsingException("[rank_feature] requires [field]")
+    fns = [k for k in ("saturation", "log", "sigmoid", "linear")
+           if k in body]
+    if len(fns) > 1:
+        raise ParsingException(
+            f"[rank_feature] can only have one function, got {fns}")
+    unknown = set(body) - {"field", "boost", "saturation", "log",
+                           "sigmoid", "linear"}
+    if unknown:
+        raise ParsingException(
+            f"[rank_feature] unknown parameter {sorted(unknown)}")
+    fn = fns[0] if fns else "saturation"
+    spec = body.get(fn) or {}
+    q = RankFeatureQuery(field=str(body["field"]), function=fn,
+                         boost=float(body.get("boost", 1.0)))
+    if fn == "saturation" and spec.get("pivot") is not None:
+        q.pivot = float(spec["pivot"])
+    if fn == "log":
+        if spec.get("scaling_factor") is None:
+            raise ParsingException(
+                "[rank_feature] [log] requires [scaling_factor]")
+        q.scaling_factor = float(spec["scaling_factor"])
+    if fn == "sigmoid":
+        if spec.get("pivot") is None or spec.get("exponent") is None:
+            raise ParsingException(
+                "[rank_feature] [sigmoid] requires [pivot] and "
+                "[exponent]")
+        q.pivot = float(spec["pivot"])
+        q.exponent = float(spec["exponent"])
+    return q
+
+
+def _parse_geo_distance(body) -> GeoDistanceQuery:
+    if not isinstance(body, dict) or "distance" not in body:
+        raise ParsingException("[geo_distance] requires [distance]")
+    dist = parse_distance_m(body["distance"])
+    field = None
+    point = None
+    for k, v in body.items():
+        if k in ("distance", "distance_type", "validation_method",
+                 "boost", "_name"):
+            continue
+        if field is not None:
+            raise ParsingException(
+                f"[geo_distance] only one field allowed, got "
+                f"[{field}] and [{k}]")
+        field, point = k, v
+    if field is None:
+        raise ParsingException("[geo_distance] requires a field point")
+    from elasticsearch_tpu.mapping.types import GeoPointFieldType
+    try:
+        lat, lon = GeoPointFieldType.parse_point(point)
+    except Exception as e:  # noqa: BLE001 — mapper error → parse error
+        raise ParsingException(str(e)) from None
+    return GeoDistanceQuery(field=field, lat=lat, lon=lon,
+                            distance_m=dist,
+                            boost=float(body.get("boost", 1.0)))
+
+
+def _parse_geo_bounding_box(body) -> GeoBoundingBoxQuery:
+    if not isinstance(body, dict):
+        raise ParsingException("[geo_bounding_box] expects an object")
+    field = None
+    spec = None
+    for k, v in body.items():
+        if k in ("validation_method", "type", "boost", "_name"):
+            continue
+        if field is not None:
+            raise ParsingException(
+                "[geo_bounding_box] only one field allowed")
+        field, spec = k, v
+    if field is None or not isinstance(spec, dict):
+        raise ParsingException(
+            "[geo_bounding_box] requires a field with corner points")
+    from elasticsearch_tpu.mapping.types import GeoPointFieldType
+    try:
+        if "top_left" in spec and "bottom_right" in spec:
+            top, left = GeoPointFieldType.parse_point(spec["top_left"])
+            bottom, right = GeoPointFieldType.parse_point(
+                spec["bottom_right"])
+        elif all(k in spec for k in ("top", "left", "bottom", "right")):
+            top, left = float(spec["top"]), float(spec["left"])
+            bottom, right = float(spec["bottom"]), float(spec["right"])
+        else:
+            raise ParsingException(
+                "[geo_bounding_box] requires [top_left]+[bottom_right] "
+                "or [top]/[left]/[bottom]/[right]")
+    except ParsingException:
+        raise
+    except Exception as e:  # noqa: BLE001
+        raise ParsingException(str(e)) from None
+    if bottom > top:
+        raise ParsingException(
+            f"[geo_bounding_box] top [{top}] must be >= bottom "
+            f"[{bottom}]")
+    return GeoBoundingBoxQuery(field=field, top=top, left=left,
+                               bottom=bottom, right=right,
+                               boost=float(body.get("boost", 1.0)))
+
+
 def _parse_script_score(body) -> ScriptScoreQuery:
     if not isinstance(body, dict):
         raise ParsingException("[script_score] expects an object")
@@ -615,4 +790,7 @@ _PARSERS = {
     "fuzzy": _parse_fuzzy,
     "function_score": _parse_function_score,
     "script_score": _parse_script_score,
+    "rank_feature": _parse_rank_feature,
+    "geo_distance": _parse_geo_distance,
+    "geo_bounding_box": _parse_geo_bounding_box,
 }

@@ -160,6 +160,12 @@ class SegmentQueryExecutor:
             return self._eval_script_score(node, scoring)
         if isinstance(node, dsl.KnnScoreDocQuery):
             return self._eval_knn_score_doc(node, scoring)
+        if isinstance(node, dsl.RankFeatureQuery):
+            return self._eval_rank_feature(node, scoring)
+        if isinstance(node, dsl.GeoDistanceQuery):
+            return self._eval_geo_distance(node)
+        if isinstance(node, dsl.GeoBoundingBoxQuery):
+            return self._eval_geo_bbox(node)
         if isinstance(node, dsl.NestedQuery):
             return self._eval_nested(node, scoring)
         if hasattr(node, "evaluate"):
@@ -354,6 +360,107 @@ class SegmentQueryExecutor:
         if not scoring:
             return mask, jnp.zeros_like(kscore)
         return mask, jnp.where(bmask, bscore, 0.0) + kscore
+
+    def _eval_rank_feature(self, node: dsl.RankFeatureQuery,
+                           scoring: bool):
+        """Feature-value scoring on the f64 column (reference:
+        RankFeatureQuery; the impact-postings trick becomes plain
+        column math on device). Missing docs don't match."""
+        vals, present = self._dv_column(node.field)
+        mask = present
+        if not scoring:
+            return mask, jnp.zeros(self.d_pad, dtype=jnp.float32)
+        from elasticsearch_tpu.mapping.types import RankFeatureFieldType
+        ft = self.reader.mapper.field_type(node.field)
+        if ft is not None and isinstance(ft, RankFeatureFieldType) \
+                and not ft.positive_score_impact:
+            # negative impact: smaller values score higher — the
+            # reference inverts inside the same saturation shape
+            vals = jnp.where(present, 1.0 / jnp.maximum(vals, 1e-9),
+                             0.0)
+        x = jnp.where(present, vals, 0.0).astype(jnp.float32)
+        if node.function == "linear":
+            score = x
+        elif node.function == "log":
+            score = jnp.log(jnp.maximum(
+                node.scaling_factor + x, 1e-9))
+        elif node.function == "sigmoid":
+            xp = jnp.power(x, node.exponent)
+            score = xp / (xp + jnp.power(node.pivot, node.exponent))
+        else:  # saturation
+            pivot = node.pivot
+            if pivot is None:
+                # index-derived default pivot: geometric mean of the
+                # shard's feature values (reference computes an
+                # approximate geometric mean from the impacts)
+                pivot = self._rank_feature_default_pivot(node.field)
+            score = x / (x + pivot)
+        return mask, jnp.where(mask, score * node.boost,
+                               0.0).astype(jnp.float32)
+
+    def _rank_feature_default_pivot(self, field: str) -> float:
+        cache = getattr(self.reader, "_rf_pivot_cache", None)
+        if cache is None:
+            cache = {}
+            self.reader._rf_pivot_cache = cache
+        if field in cache:
+            return cache[field]
+        logs, count = 0.0, 0
+        for v in self.reader.views:
+            col = v.segment.doc_values.get(field)
+            if col is None or col.kind != "f64":
+                continue
+            vals = col.values
+            ok = ~np.isnan(vals) & (vals > 0)
+            if ok.any():
+                logs += float(np.log(vals[ok]).sum())
+                count += int(ok.sum())
+        pivot = float(np.exp(logs / count)) if count else 1.0
+        cache[field] = pivot
+        return pivot
+
+    _EARTH_R_M = 6371008.7714  # mean earth radius, as Lucene uses
+
+    def _geo_columns(self, field: str):
+        from elasticsearch_tpu.mapping.types import GeoPointFieldType
+        pack = self.view.pack
+        lat = pack.dv_f64.get(field + GeoPointFieldType.LAT_SUFFIX)
+        lon = pack.dv_f64.get(field + GeoPointFieldType.LON_SUFFIX)
+        if lat is None or lon is None:
+            return None, None, jnp.zeros(self.d_pad, dtype=bool)
+        lat = jnp.asarray(lat)
+        lon = jnp.asarray(lon)
+        present = ~jnp.isnan(lat)
+        return lat, lon, present
+
+    def _eval_geo_distance(self, node: dsl.GeoDistanceQuery):
+        """Vectorized haversine over the segment's lat/lon columns —
+        one fused elementwise pass (no BKD tree)."""
+        lat, lon, present = self._geo_columns(node.field)
+        if lat is None:
+            return self._none()
+        rad = jnp.pi / 180.0
+        dlat = (lat - node.lat) * rad
+        dlon = (lon - node.lon) * rad
+        a = jnp.sin(dlat / 2) ** 2 + jnp.cos(lat * rad) * \
+            jnp.cos(node.lat * rad) * jnp.sin(dlon / 2) ** 2
+        dist = 2 * self._EARTH_R_M * jnp.arcsin(
+            jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+        mask = present & (dist <= node.distance_m)
+        return mask, jnp.where(mask, node.boost, 0.0).astype(jnp.float32)
+
+    def _eval_geo_bbox(self, node: dsl.GeoBoundingBoxQuery):
+        lat, lon, present = self._geo_columns(node.field)
+        if lat is None:
+            return self._none()
+        lat_ok = (lat <= node.top) & (lat >= node.bottom)
+        if node.left <= node.right:
+            lon_ok = (lon >= node.left) & (lon <= node.right)
+        else:
+            # box crossing the antimeridian (reference behavior)
+            lon_ok = (lon >= node.left) | (lon <= node.right)
+        mask = present & lat_ok & lon_ok
+        return mask, jnp.where(mask, node.boost, 0.0).astype(jnp.float32)
 
     def _dv_column(self, field: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Numeric doc-values column → (values_f32, present_mask); the

@@ -307,3 +307,29 @@ class TestReviewRegressions:
             "analyzer": "a", "text": "x"})
         # 1-char token < min_gram and preserve_original=false → dropped
         assert res["tokens"] == []
+
+    def test_basic_filters_after_multi_token_filters(self, node):
+        # review regression: lowercase/stop AFTER ngram/synonym must
+        # handle stacked list slots, not crash
+        status, _ = _handle(node, "PUT", "/ord", body={
+            "settings": {"analysis": {
+                "filter": {"syn": {"type": "synonym",
+                                   "synonyms": ["tv, television"]}},
+                "analyzer": {
+                    "ng_lower": {"type": "custom",
+                                 "tokenizer": "standard",
+                                 "filter": ["edge_ngram", "lowercase"]},
+                    "syn_stop": {"type": "custom",
+                                 "tokenizer": "standard",
+                                 "filter": ["lowercase", "syn",
+                                            "stop"]}}}}})
+        assert status == 200
+        status, res = _handle(node, "GET", "/ord/_analyze", body={
+            "analyzer": "ng_lower", "text": "AB"})
+        assert status == 200, res
+        assert {t["token"] for t in res["tokens"]} == {"a", "ab"}
+        status, res = _handle(node, "GET", "/ord/_analyze", body={
+            "analyzer": "syn_stop", "text": "the tv"})
+        assert status == 200, res
+        assert {t["token"] for t in res["tokens"]} == \
+            {"tv", "television"}

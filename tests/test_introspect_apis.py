@@ -1,0 +1,219 @@
+"""API gap sweep (VERDICT r4 item 9): _field_caps, _validate/query,
+_explain, _termvectors, _nodes/hot_threads, _cluster/allocation/explain,
+_split — reference-shaped responses, each with a test."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def seeded(node):
+    _handle(node, "PUT", "/lib", body={"mappings": {"properties": {
+        "title": {"type": "text"},
+        "year": {"type": "integer"},
+        "tag": {"type": "keyword"}}}})
+    _handle(node, "PUT", "/lib2", body={"mappings": {"properties": {
+        "title": {"type": "text"},
+        "rating": {"type": "float"}}}})
+    for i, (t, y) in enumerate([("quick fox", 2001),
+                                ("lazy dog", 2005),
+                                ("quick dog", 2010)]):
+        _handle(node, "PUT", f"/lib/_doc/{i}",
+                params={"refresh": "true"},
+                body={"title": t, "year": y, "tag": f"t{i}"})
+    return node
+
+
+class TestFieldCaps:
+    def test_across_indices(self, seeded):
+        status, res = _handle(seeded, "GET", "/_field_caps",
+                              params={"fields": "*"})
+        assert status == 200, res
+        assert set(res["indices"]) == {"lib", "lib2"}
+        f = res["fields"]
+        assert f["title"]["text"]["searchable"] is True
+        assert f["title"]["text"]["aggregatable"] is False
+        # year exists only in lib → indices listed
+        assert f["year"]["integer"]["indices"] == ["lib"]
+        assert f["tag"]["keyword"]["aggregatable"] is True
+
+    def test_field_pattern(self, seeded):
+        _, res = _handle(seeded, "GET", "/lib/_field_caps",
+                         params={"fields": "t*"})
+        assert set(res["fields"]) == {"title", "tag"}
+
+    def test_post_body_fields(self, seeded):
+        _, res = _handle(seeded, "POST", "/lib/_field_caps",
+                         body={"fields": ["year"]})
+        assert set(res["fields"]) == {"year"}
+
+
+class TestValidateQuery:
+    def test_valid(self, seeded):
+        status, res = _handle(seeded, "GET", "/lib/_validate/query",
+                              body={"query": {"match": {
+                                  "title": "fox"}}})
+        assert status == 200 and res["valid"] is True
+
+    def test_invalid_with_explain(self, seeded):
+        status, res = _handle(seeded, "GET", "/lib/_validate/query",
+                              params={"explain": "true"},
+                              body={"query": {"nosuch": {}}})
+        assert status == 200, res
+        assert res["valid"] is False
+        assert "nosuch" in res["error"]
+
+    def test_explanations_listed(self, seeded):
+        _, res = _handle(seeded, "GET", "/lib/_validate/query",
+                         params={"explain": "true"},
+                         body={"query": {"term": {"tag": "t0"}}})
+        assert res["valid"] is True
+        assert res["explanations"][0]["index"] == "lib"
+
+
+class TestExplain:
+    def test_matching_doc(self, seeded):
+        status, res = _handle(seeded, "GET", "/lib/_explain/0",
+                              body={"query": {"match": {
+                                  "title": "quick"}}})
+        assert status == 200, res
+        assert res["matched"] is True
+        assert res["explanation"]["value"] > 0
+        # the explained score equals the search score for that doc
+        _, sr = _handle(seeded, "POST", "/lib/_search", body={
+            "query": {"match": {"title": "quick"}}})
+        score = {h["_id"]: h["_score"]
+                 for h in sr["hits"]["hits"]}["0"]
+        assert res["explanation"]["value"] == pytest.approx(
+            score, rel=1e-5)
+
+    def test_non_matching_doc(self, seeded):
+        _, res = _handle(seeded, "GET", "/lib/_explain/1",
+                         body={"query": {"match": {"title": "quick"}}})
+        assert res["matched"] is False
+
+    def test_missing_doc_404(self, seeded):
+        status, _ = _handle(seeded, "GET", "/lib/_explain/99",
+                            body={"query": {"match_all": {}}})
+        assert status == 404
+
+
+class TestTermvectors:
+    def test_terms_freqs_positions(self, seeded):
+        _handle(seeded, "PUT", "/lib/_doc/tv",
+                params={"refresh": "true"},
+                body={"title": "fox fox jumps"})
+        status, res = _handle(seeded, "GET", "/lib/_termvectors/tv")
+        assert status == 200, res
+        terms = res["term_vectors"]["title"]["terms"]
+        assert terms["fox"]["term_freq"] == 2
+        assert [t["position"] for t in terms["fox"]["tokens"]] == [0, 1]
+        assert terms["jumps"]["term_freq"] == 1
+
+    def test_term_statistics(self, seeded):
+        status, res = _handle(seeded, "GET", "/lib/_termvectors/0",
+                              params={"term_statistics": "true"})
+        assert status == 200, res
+        terms = res["term_vectors"]["title"]["terms"]
+        assert terms["quick"]["doc_freq"] == 2  # docs 0 and 2
+
+    def test_missing_doc(self, seeded):
+        _, res = _handle(seeded, "GET", "/lib/_termvectors/zz")
+        assert res["found"] is False
+
+
+class TestHotThreads:
+    def test_text_report(self, node):
+        status, res = _handle(node, "GET", "/_nodes/hot_threads",
+                              params={"snapshots": "2"})
+        assert status == 200
+        assert isinstance(res, str)
+        assert "Hot threads at" in res
+
+
+class TestAllocationExplain:
+    def test_single_node_started_shard(self, seeded):
+        status, res = _handle(seeded, "POST",
+                              "/_cluster/allocation/explain",
+                              body={"index": "lib", "shard": 0,
+                                    "primary": True})
+        assert status == 200, res
+        assert res["current_state"] == "started"
+        assert res["index"] == "lib"
+
+    def test_no_body_no_unassigned_400(self, seeded):
+        status, res = _handle(seeded, "POST",
+                              "/_cluster/allocation/explain")
+        # single node: first index's shard 0 reported as started
+        assert status in (200, 400)
+
+
+class TestSplit:
+    def test_split_doubles_shards(self, node):
+        _handle(node, "PUT", "/src", body={
+            "settings": {"number_of_shards": 2}})
+        for i in range(20):
+            _handle(node, "PUT", f"/src/_doc/{i}",
+                    params={"refresh": "true"}, body={"v": i})
+        _handle(node, "PUT", "/src/_settings",
+                body={"index.blocks.write": True})
+        status, res = _handle(node, "PUT", "/src/_split/dst",
+                              body={"settings": {
+                                  "index.number_of_shards": 4}})
+        assert status == 200, res
+        assert res["copied_docs"] == 20
+        _, sr = _handle(node, "POST", "/dst/_search", body={
+            "query": {"match_all": {}}, "size": 0})
+        assert sr["hits"]["total"]["value"] == 20
+        _, st = _handle(node, "GET", "/dst/_settings")
+        assert int(st["dst"]["settings"]["index"]["number_of_shards"]) \
+            == 4
+
+    def test_split_requires_multiple(self, node):
+        _handle(node, "PUT", "/s2", body={
+            "settings": {"number_of_shards": 2}})
+        _handle(node, "PUT", "/s2/_settings",
+                body={"index.blocks.write": True})
+        status, _ = _handle(node, "PUT", "/s2/_split/d2",
+                            body={"settings": {
+                                "index.number_of_shards": 3}})
+        assert status == 400
+
+    def test_split_requires_write_block(self, node):
+        _handle(node, "PUT", "/s3", body={
+            "settings": {"number_of_shards": 1}})
+        status, _ = _handle(node, "PUT", "/s3/_split/d3",
+                            body={"settings": {
+                                "index.number_of_shards": 2}})
+        assert status == 400
+
+
+class TestTermvectorsNested:
+    def test_object_mapped_field(self, node):
+        _handle(node, "PUT", "/obj", body={"mappings": {"properties": {
+            "a": {"properties": {"b": {"type": "text"}}}}}})
+        _handle(node, "PUT", "/obj/_doc/1", params={"refresh": "true"},
+                body={"a": {"b": "hello world"}})
+        _, res = _handle(node, "GET", "/obj/_termvectors/1")
+        assert "a.b" in res["term_vectors"], res
+        assert res["term_vectors"]["a.b"]["terms"]["hello"][
+            "term_freq"] == 1

@@ -1,0 +1,310 @@
+"""rank_feature + geo_point first slice (SURVEY.md §2.1#54, #55):
+mappers, rank_feature query functions, geo_distance/geo_bounding_box
+queries as vectorized column math, geohash_grid agg."""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.mapping.types import GeoPointFieldType
+from elasticsearch_tpu.node import Node
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture
+def node(tmp_data_path):
+    n = Node(str(tmp_data_path),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    yield n
+    n.close()
+
+
+class TestGeohashCodec:
+    def test_known_values(self):
+        # canonical example: Jutland peninsula point
+        assert GeoPointFieldType.geohash_encode(57.64911, 10.40744,
+                                                11) == "u4pruydqqvj"
+        lat, lon = GeoPointFieldType.geohash_decode("u4pruydqqvj")
+        assert lat == pytest.approx(57.64911, abs=1e-4)
+        assert lon == pytest.approx(10.40744, abs=1e-4)
+
+    def test_roundtrip(self):
+        rng = np.random.RandomState(5)
+        for _ in range(50):
+            lat = float(rng.uniform(-90, 90))
+            lon = float(rng.uniform(-180, 180))
+            gh = GeoPointFieldType.geohash_encode(lat, lon, 9)
+            dlat, dlon = GeoPointFieldType.geohash_decode(gh)
+            assert dlat == pytest.approx(lat, abs=1e-3)
+            assert dlon == pytest.approx(lon, abs=1e-3)
+
+    def test_batch_matches_scalar(self):
+        from elasticsearch_tpu.search.aggregations.bucket import \
+            geohash_encode_batch
+        rng = np.random.RandomState(6)
+        lats = rng.uniform(-90, 90, 40)
+        lons = rng.uniform(-180, 180, 40)
+        batch = geohash_encode_batch(lats, lons, 6)
+        for i in range(40):
+            assert batch[i] == GeoPointFieldType.geohash_encode(
+                lats[i], lons[i], 6)
+
+
+CITIES = {
+    "london": (51.5074, -0.1278),
+    "paris": (48.8566, 2.3522),
+    "berlin": (52.52, 13.405),
+    "nyc": (40.7128, -74.0060),
+    "sydney": (-33.8688, 151.2093),
+}
+
+
+@pytest.fixture
+def geo(node):
+    _handle(node, "PUT", "/places", body={"mappings": {"properties": {
+        "location": {"type": "geo_point"},
+        "name": {"type": "keyword"}}}})
+    forms = {
+        "london": {"lat": 51.5074, "lon": -0.1278},     # object
+        "paris": "48.8566,2.3522",                       # "lat,lon"
+        "berlin": [13.405, 52.52],                       # [lon, lat]
+        "nyc": {"lat": 40.7128, "lon": -74.0060},
+        "sydney": {"lat": -33.8688, "lon": 151.2093},
+    }
+    for name, loc in forms.items():
+        _handle(node, "PUT", f"/places/_doc/{name}",
+                params={"refresh": "true"},
+                body={"location": loc, "name": name})
+    return node
+
+
+def _haversine_km(a, b):
+    r = 6371.0088
+    la1, lo1, la2, lo2 = map(math.radians, [a[0], a[1], b[0], b[1]])
+    h = (math.sin((la2 - la1) / 2) ** 2
+         + math.cos(la1) * math.cos(la2) * math.sin((lo2 - lo1) / 2) ** 2)
+    return 2 * r * math.asin(math.sqrt(h))
+
+
+class TestGeoQueries:
+    def test_all_input_forms_parse(self, geo):
+        _, res = _handle(geo, "POST", "/places/_search", body={
+            "query": {"exists": {"field": "location"}}, "size": 10})
+        assert res["hits"]["total"]["value"] == 5
+
+    def test_geo_distance(self, geo):
+        # 500km around london: only paris is in range among the others
+        status, res = _handle(geo, "POST", "/places/_search", body={
+            "query": {"geo_distance": {
+                "distance": "500km",
+                "location": {"lat": 51.5074, "lon": -0.1278}}},
+            "size": 10})
+        assert status == 200, res
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"london", "paris"}
+        # sanity: true distance london-paris ≈ 344km, berlin ≈ 932km
+        assert _haversine_km(CITIES["london"], CITIES["paris"]) < 500
+        assert _haversine_km(CITIES["london"], CITIES["berlin"]) > 500
+
+    def test_geo_distance_units(self, geo):
+        status, res = _handle(geo, "POST", "/places/_search", body={
+            "query": {"geo_distance": {
+                "distance": "250mi",  # ≈ 402km
+                "location": [-0.1278, 51.5074]}},
+            "size": 10})
+        assert status == 200, res
+        assert {h["_id"] for h in res["hits"]["hits"]} == \
+            {"london", "paris"}
+
+    def test_geo_bounding_box(self, geo):
+        # box over western/central europe
+        status, res = _handle(geo, "POST", "/places/_search", body={
+            "query": {"geo_bounding_box": {"location": {
+                "top_left": {"lat": 60.0, "lon": -10.0},
+                "bottom_right": {"lat": 45.0, "lon": 20.0}}}},
+            "size": 10})
+        assert status == 200, res
+        assert {h["_id"] for h in res["hits"]["hits"]} == \
+            {"london", "paris", "berlin"}
+
+    def test_bbox_crossing_antimeridian(self, node):
+        _handle(node, "PUT", "/pac", body={"mappings": {"properties": {
+            "p": {"type": "geo_point"}}}})
+        _handle(node, "PUT", "/pac/_doc/fiji",
+                params={"refresh": "true"},
+                body={"p": {"lat": -17.7, "lon": 178.0}})
+        _handle(node, "PUT", "/pac/_doc/samoa",
+                params={"refresh": "true"},
+                body={"p": {"lat": -13.8, "lon": -171.8}})
+        _handle(node, "PUT", "/pac/_doc/london",
+                params={"refresh": "true"},
+                body={"p": {"lat": 51.5, "lon": -0.13}})
+        _, res = _handle(node, "POST", "/pac/_search", body={
+            "query": {"geo_bounding_box": {"p": {
+                "top": 0.0, "left": 170.0,
+                "bottom": -30.0, "right": -160.0}}},
+            "size": 10})
+        assert {h["_id"] for h in res["hits"]["hits"]} == \
+            {"fiji", "samoa"}
+
+    def test_bad_points_400(self, geo):
+        status, _ = _handle(geo, "PUT", "/places/_doc/bad",
+                            body={"location": {"lat": 95.0, "lon": 0}})
+        assert status == 400
+        status, _ = _handle(geo, "POST", "/places/_search", body={
+            "query": {"geo_distance": {"distance": "10zz",
+                                       "location": [0, 0]}}})
+        assert status == 400
+
+    def test_geo_distance_filter_context(self, geo):
+        status, res = _handle(geo, "POST", "/places/_search", body={
+            "query": {"bool": {
+                "filter": [{"geo_distance": {
+                    "distance": "500km", "location": [2.35, 48.85]}}],
+                "must": [{"term": {"name": "paris"}}]}},
+            "size": 10})
+        assert status == 200, res
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["paris"]
+
+
+class TestGeohashGridAgg:
+    def test_cells(self, geo):
+        status, res = _handle(geo, "POST", "/places/_search", body={
+            "size": 0, "aggs": {"cells": {"geohash_grid": {
+                "field": "location", "precision": 3}}}})
+        assert status == 200, res
+        buckets = res["aggregations"]["cells"]["buckets"]
+        keys = {b["key"] for b in buckets}
+        # london's gcpv..., paris u09..., known prefixes
+        assert GeoPointFieldType.geohash_encode(51.5074, -0.1278,
+                                                3) in keys
+        assert len(buckets) == 5
+        assert all(b["doc_count"] == 1 for b in buckets)
+
+    def test_precision_groups(self, node):
+        _handle(node, "PUT", "/pts", body={"mappings": {"properties": {
+            "p": {"type": "geo_point"}}}})
+        # two points very close together + one far away
+        for i, loc in enumerate([(48.8566, 2.3522), (48.8570, 2.3530),
+                                 (-33.8, 151.2)]):
+            _handle(node, "PUT", f"/pts/_doc/{i}",
+                    params={"refresh": "true"},
+                    body={"p": {"lat": loc[0], "lon": loc[1]}})
+        _, res = _handle(node, "POST", "/pts/_search", body={
+            "size": 0, "aggs": {"g": {"geohash_grid": {
+                "field": "p", "precision": 4}}}})
+        buckets = res["aggregations"]["g"]["buckets"]
+        assert len(buckets) == 2
+        assert buckets[0]["doc_count"] == 2  # count-ordered
+
+    def test_sub_aggs(self, geo):
+        status, res = _handle(geo, "POST", "/places/_search", body={
+            "size": 0, "aggs": {"cells": {
+                "geohash_grid": {"field": "location", "precision": 1},
+                "aggs": {"names": {"terms": {"field": "name"}}}}}})
+        assert status == 200, res
+        for b in res["aggregations"]["cells"]["buckets"]:
+            assert b["names"]["buckets"], b
+
+    def test_bad_precision_400(self, geo):
+        status, _ = _handle(geo, "POST", "/places/_search", body={
+            "size": 0, "aggs": {"g": {"geohash_grid": {
+                "field": "location", "precision": 13}}}})
+        assert status == 400
+
+
+@pytest.fixture
+def featured(node):
+    _handle(node, "PUT", "/docs", body={"mappings": {"properties": {
+        "pagerank": {"type": "rank_feature"},
+        "title": {"type": "text"}}}})
+    for i, pr in enumerate([0.5, 2.0, 8.0, 32.0]):
+        _handle(node, "PUT", f"/docs/_doc/{i}",
+                params={"refresh": "true"},
+                body={"pagerank": pr, "title": f"doc {i}"})
+    return node
+
+
+class TestRankFeature:
+    def test_saturation_with_pivot(self, featured):
+        status, res = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"rank_feature": {"field": "pagerank",
+                                       "saturation": {"pivot": 8}}},
+            "size": 10})
+        assert status == 200, res
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        for i, pr in enumerate([0.5, 2.0, 8.0, 32.0]):
+            assert by_id[str(i)] == pytest.approx(pr / (pr + 8),
+                                                  rel=1e-5)
+
+    def test_default_pivot_is_geometric_mean(self, featured):
+        status, res = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"rank_feature": {"field": "pagerank"}},
+            "size": 10})
+        assert status == 200, res
+        gm = float(np.exp(np.mean(np.log([0.5, 2.0, 8.0, 32.0]))))
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id["3"] == pytest.approx(32 / (32 + gm), rel=1e-4)
+
+    def test_log_and_sigmoid(self, featured):
+        _, res = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"rank_feature": {
+                "field": "pagerank",
+                "log": {"scaling_factor": 2}}}, "size": 10})
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id["2"] == pytest.approx(math.log(10), rel=1e-5)
+        _, res = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"rank_feature": {
+                "field": "pagerank",
+                "sigmoid": {"pivot": 8, "exponent": 0.6}}}, "size": 10})
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        expect = 8 ** 0.6 / (8 ** 0.6 + 8 ** 0.6)
+        assert by_id["2"] == pytest.approx(expect, rel=1e-5)
+
+    def test_missing_docs_dont_match(self, featured):
+        _handle(featured, "PUT", "/docs/_doc/nofeat",
+                params={"refresh": "true"}, body={"title": "no rank"})
+        _, res = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"rank_feature": {"field": "pagerank"}},
+            "size": 10})
+        assert "nofeat" not in {h["_id"] for h in res["hits"]["hits"]}
+
+    def test_hybrid_with_bm25_via_bool_should(self, featured):
+        status, res = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"bool": {
+                "must": [{"match": {"title": "doc"}}],
+                "should": [{"rank_feature": {"field": "pagerank",
+                                             "saturation": {
+                                                 "pivot": 8}}}]}},
+            "size": 10})
+        assert status == 200, res
+        # feature boosts ranking: highest pagerank wins
+        assert res["hits"]["hits"][0]["_id"] == "3"
+
+    def test_rejects_non_positive(self, featured):
+        status, _ = _handle(featured, "PUT", "/docs/_doc/bad",
+                            body={"pagerank": -1})
+        assert status == 400
+        status, _ = _handle(featured, "PUT", "/docs/_doc/bad",
+                            body={"pagerank": 0})
+        assert status == 400
+
+    def test_validation_400s(self, featured):
+        status, _ = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"rank_feature": {"field": "pagerank",
+                                       "log": {}}}})
+        assert status == 400
+        status, _ = _handle(featured, "POST", "/docs/_search", body={
+            "query": {"rank_feature": {"field": "pagerank",
+                                       "saturation": {},
+                                       "log": {"scaling_factor": 1}}}})
+        assert status == 400
