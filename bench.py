@@ -60,7 +60,10 @@ def main() -> None:
     n_shards = _env("SHARDS", 4 if on_tpu else 2)
     vocab = _env("VOCAB", 30_000 if on_tpu else 2000)
     n_queries = _env("QUERIES", 256 if on_tpu else 16)
-    clients = _env("CLIENTS", 128 if on_tpu else 4)
+    # the serving path batches up to 128 queries per launch; the load
+    # driver must offer ~2 trains of concurrency to keep the pipeline
+    # full (Rally-style closed-loop clients)
+    clients = _env("CLIENTS", 256 if on_tpu else 4)
     k = _env("K", 1000 if on_tpu else 32)
     seconds = _env("SECONDS", 20 if on_tpu else 3)
 

@@ -98,7 +98,7 @@ class Node:
                 window_s=self.settings.get_float(
                     "search.tpu_serving.batch_window_seconds", 0.01),
                 max_batch=self.settings.get_int(
-                    "search.tpu_serving.max_batch", 64),
+                    "search.tpu_serving.max_batch", 128),
                 batch_timeout_s=self.settings.get_float(
                     "search.tpu_serving.batch_timeout_seconds", 30.0))
         from elasticsearch_tpu.common.threadpool import ThreadPools

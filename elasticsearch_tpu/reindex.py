@@ -18,6 +18,7 @@ persisted per doc, so by-query ops target shards by _id."""
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -26,6 +27,7 @@ from elasticsearch_tpu.search import scroll as scroll_mod
 
 BATCH_SIZE = 500
 SCROLL_KEEPALIVE = "5m"
+MAX_SLICES = 16
 
 
 class _Abort(Exception):
@@ -33,8 +35,13 @@ class _Abort(Exception):
 
 
 def _scroll_source(node, index: str, query: Optional[dict],
-                   batch_size: int, seq_no_primary_term: bool):
-    """Yield scroll pages (lists of hits) over a pinned snapshot."""
+                   batch_size: int, seq_no_primary_term: bool,
+                   slice_spec: Optional[Dict[str, int]] = None):
+    """Yield scroll pages (lists of hits) over a pinned snapshot.
+    slice_spec {"id", "max"}: this generator yields only the docs whose
+    _id hashes into its slice — the reference's sliced-scroll partition
+    (`slices=N`, murmur3 on _id like operation routing)."""
+    from elasticsearch_tpu.indices.service import shard_for
     body: Dict[str, Any] = {"query": query or {"match_all": {}},
                             "sort": ["_doc"], "size": batch_size}
     if seq_no_primary_term:
@@ -48,10 +55,50 @@ def _scroll_source(node, index: str, query: Optional[dict],
             hits = page["hits"]["hits"]
             if not hits:
                 return
-            yield hits
+            if slice_spec is not None:
+                hits = [h for h in hits
+                        if shard_for(h["_id"], slice_spec["max"])
+                        == slice_spec["id"]]
+            if hits:
+                yield hits
             page = scroll_mod.next_page(node, sid, SCROLL_KEEPALIVE)
     finally:
         scroll_mod.clear(node, [sid])
+
+
+def _remote_source(node, cluster_alias: str, index: str,
+                   query: Optional[dict], batch_size: int):
+    """Yield pages from a REGISTERED remote cluster (reference: remote
+    reindex; here over the CCS transport instead of a raw HTTP URL —
+    the remote runs an ordinary _doc-ordered search_after walk)."""
+    from elasticsearch_tpu import ccs
+    remotes = ccs.remote_clusters(node)
+    entry = remotes.get(cluster_alias)
+    if entry is None or entry.get("error"):
+        raise IllegalArgumentException(
+            f"no such remote cluster: [{cluster_alias}]"
+            + (f" ({entry['error']})" if entry and entry.get("error")
+               else ""))
+    transport = ccs._transport(node)
+    cursor = None
+    while True:
+        body: Dict[str, Any] = {
+            "query": query or {"match_all": {}},
+            "sort": ["_doc"], "size": batch_size}
+        if cursor is not None:
+            body["search_after"] = cursor
+        fut = transport.send_request_async(
+            entry["seeds"][0], ccs.ACTION_REMOTE_SEARCH,
+            {"index": index, "body": body, "params": {}})
+        resp = fut.result(timeout=60.0)
+        hits = resp["hits"]["hits"]
+        if not hits:
+            return
+        yield hits
+        cursor = hits[-1].get("sort")
+        if cursor is None:
+            raise IllegalArgumentException(
+                "[reindex] remote did not return sort cursors")
 
 
 def _apply_ops(node, ops: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
@@ -89,11 +136,132 @@ def _summarize(items: List[Dict[str, Any]], out: Dict[str, Any],
                 raise _Abort()
 
 
+def _parse_slices(spec: Any, node, index: str) -> int:
+    """`slices` request value → concrete slice count ("auto" = the
+    source's shard count, reference default)."""
+    if spec is None:
+        return 1
+    if spec == "auto":
+        try:
+            n = node.indices.index(index).num_shards
+        except Exception:  # noqa: BLE001 — remote/unknown source
+            n = 1
+        return max(1, min(int(n), MAX_SLICES))
+    n = int(spec)
+    if n < 1 or n > MAX_SLICES:
+        raise IllegalArgumentException(
+            f"[slices] must be in [1, {MAX_SLICES}] or \"auto\", "
+            f"got [{spec}]")
+    return n
+
+
+def _run_sliced(node, index: str, query: Optional[dict], *,
+                n_slices: int, action: str, parent_task=None,
+                **kw) -> Dict[str, Any]:
+    """Run N slice workers in parallel (reference: the `slices=N`
+    parallel sub-requests of BulkByScrollParallelizationHelper), each a
+    child task visible in _tasks; summaries merge into one response.
+
+    ONE producer scans the index (a single scroll snapshot) and
+    partitions each page by `_id` hash to the slice workers — the scan
+    is not multiplied by N, only the transform+bulk work parallelizes
+    (where the time goes: analysis releases the GIL)."""
+    import queue as _queue
+
+    from elasticsearch_tpu.indices.service import shard_for
+    max_docs = kw.pop("max_docs", None)
+    per_slice = [None] * n_slices
+    if max_docs is not None:
+        base, rem = divmod(int(max_docs), n_slices)
+        per_slice = [base + (1 if i < rem else 0)
+                     for i in range(n_slices)]
+    outs: List[Optional[Dict[str, Any]]] = [None] * n_slices
+    errors: List[Exception] = []
+    queues = [_queue.Queue(maxsize=4) for _ in range(n_slices)]
+
+    def producer() -> None:
+        try:
+            for hits in _scroll_source(node, index, query,
+                                       kw["batch_size"],
+                                       kw["seq_no_primary_term"]):
+                parts: List[List[dict]] = [[] for _ in range(n_slices)]
+                for h in hits:
+                    parts[shard_for(h["_id"], n_slices)].append(h)
+                for si, part in enumerate(parts):
+                    if part:
+                        queues[si].put(part)
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+        finally:
+            for q in queues:
+                q.put(None)
+
+    drained = [False] * n_slices
+
+    def pages_of(si: int):
+        while True:
+            page = queues[si].get()
+            if page is None:
+                drained[si] = True
+                return
+            yield page
+
+    def worker(si: int) -> None:
+        task = node.task_manager.register(
+            f"{action}[s{si}]",
+            description=f"slice [{si}] of [{n_slices}] on [{index}]",
+            parent_task_id=(parent_task.full_id
+                            if parent_task is not None else None))
+        try:
+            outs[si] = _run_by_query(
+                node, index, query, max_docs=per_slice[si],
+                source_pages=pages_of(si), **kw)
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+        finally:
+            # a worker stopping early (max_docs / abort) must not
+            # deadlock the producer on a full queue: consume until the
+            # producer's end-of-stream sentinel
+            while not drained[si]:
+                if queues[si].get() is None:
+                    drained[si] = True
+            node.task_manager.unregister(task)
+
+    threads = [threading.Thread(target=worker, args=(si,))
+               for si in range(n_slices)]
+    prod = threading.Thread(target=producer)
+    prod.start()
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    prod.join()
+    if errors:
+        raise errors[0]
+    merged: Dict[str, Any] = {
+        "total": 0, "created": 0, "updated": 0, "deleted": 0,
+        "batches": 0, "version_conflicts": 0, "noops": 0,
+        "retries": {"bulk": 0, "search": 0}, "failures": [],
+        "slices": []}
+    took = 0
+    for o in outs:
+        assert o is not None
+        for key in ("total", "created", "updated", "deleted",
+                    "batches", "version_conflicts", "noops"):
+            merged[key] += o[key]
+        merged["failures"].extend(o["failures"])
+        took = max(took, o["took"])
+        merged["slices"].append(o)
+    merged["took"] = took
+    merged["timed_out"] = False
+    return merged
+
+
 def _run_by_query(node, index: str, query: Optional[dict], *,
                   make_op: Callable[[Dict[str, Any]], Dict[str, Any]],
                   batch_size: int, conflicts_proceed: bool,
                   max_docs: Optional[int],
-                  seq_no_primary_term: bool) -> Dict[str, Any]:
+                  seq_no_primary_term: bool,
+                  slice_spec: Optional[Dict[str, int]] = None,
+                  source_pages=None) -> Dict[str, Any]:
     """The shared scroll → build ops → bulk → summarize loop all three
     APIs wrap (reference: AbstractAsyncBulkByScrollAction)."""
     t0 = time.perf_counter()
@@ -101,9 +269,11 @@ def _run_by_query(node, index: str, query: Optional[dict], *,
         "total": 0, "created": 0, "updated": 0, "deleted": 0,
         "batches": 0, "version_conflicts": 0, "noops": 0,
         "retries": {"bulk": 0, "search": 0}, "failures": []}
+    pages = source_pages if source_pages is not None else \
+        _scroll_source(node, index, query, batch_size,
+                       seq_no_primary_term, slice_spec=slice_spec)
     try:
-        for hits in _scroll_source(node, index, query, batch_size,
-                                   seq_no_primary_term):
+        for hits in pages:
             ops = []
             saw_hits = False
             for h in hits:
@@ -137,15 +307,19 @@ def _conflicts_proceed(params: Dict[str, str],
                                             "abort")) == "proceed"
 
 
-def reindex(node, body: Dict[str, Any]) -> Dict[str, Any]:
+def reindex(node, body: Dict[str, Any],
+            params: Optional[Dict[str, str]] = None,
+            task=None) -> Dict[str, Any]:
+    params = params or {}
     source = body.get("source") or {}
     dest = body.get("dest") or {}
     src_index = source.get("index")
     dst_index = dest.get("index")
+    remote = source.get("remote")
     if not src_index or not dst_index:
         raise IllegalArgumentException(
             "[reindex] requires [source.index] and [dest.index]")
-    if src_index == dst_index:
+    if src_index == dst_index and remote is None:
         raise IllegalArgumentException(
             "reindex cannot write into an index its reading from "
             f"[{dst_index}]")
@@ -178,16 +352,41 @@ def reindex(node, body: Dict[str, Any]) -> Dict[str, Any]:
                 "routing": None, "source": source,
                 "pipeline": pipeline}
 
-    return _run_by_query(
-        node, src_index, source.get("query"), make_op=make_op,
-        batch_size=int(source.get("size", BATCH_SIZE)),
-        conflicts_proceed=_conflicts_proceed({}, body),
-        max_docs=body.get("max_docs"), seq_no_primary_term=False)
+    batch_size = int(source.get("size", BATCH_SIZE))
+    if remote is not None:
+        # remote reindex over the CCS transport (registered remotes —
+        # this build's analog of the reference's URL-based remote)
+        if not isinstance(remote, dict) or not remote.get("cluster"):
+            raise IllegalArgumentException(
+                "[reindex] [source.remote] requires [cluster] (a "
+                "registered cluster.remote.<alias>; raw host URLs are "
+                "not supported in this build)")
+        pages = _remote_source(node, str(remote["cluster"]), src_index,
+                               source.get("query"), batch_size)
+        return _run_by_query(
+            node, src_index, source.get("query"), make_op=make_op,
+            batch_size=batch_size,
+            conflicts_proceed=_conflicts_proceed(params, body),
+            max_docs=body.get("max_docs"), seq_no_primary_term=False,
+            source_pages=pages)
+    n_slices = _parse_slices(params.get("slices", body.get("slices")),
+                             node, src_index)
+    common = dict(make_op=make_op, batch_size=batch_size,
+                  conflicts_proceed=_conflicts_proceed(params, body),
+                  max_docs=body.get("max_docs"),
+                  seq_no_primary_term=False)
+    if n_slices == 1:
+        return _run_by_query(node, src_index, source.get("query"),
+                             **common)
+    return _run_sliced(node, src_index, source.get("query"),
+                       n_slices=n_slices,
+                       action="indices:data/write/reindex",
+                       parent_task=task, **common)
 
 
 def update_by_query(node, index: str,
                     body: Optional[Dict[str, Any]],
-                    params: Dict[str, str]) -> Dict[str, Any]:
+                    params: Dict[str, str], task=None) -> Dict[str, Any]:
     """Re-indexes each matching doc's snapshot source in place (bumping
     its version; through ?pipeline= when given), optionally transformed
     by a restricted-expression script (ctx._source mutation, ctx.op
@@ -225,11 +424,19 @@ def update_by_query(node, index: str,
                 "if_seq_no": h.get("_seq_no"),
                 "if_primary_term": h.get("_primary_term")}
 
-    out = _run_by_query(
-        node, index, body.get("query"), make_op=make_op,
-        batch_size=BATCH_SIZE,
-        conflicts_proceed=_conflicts_proceed(params, body),
-        max_docs=body.get("max_docs"), seq_no_primary_term=True)
+    n_slices = _parse_slices(params.get("slices", body.get("slices")),
+                             node, index)
+    common = dict(make_op=make_op, batch_size=BATCH_SIZE,
+                  conflicts_proceed=_conflicts_proceed(params, body),
+                  max_docs=body.get("max_docs"),
+                  seq_no_primary_term=True)
+    if n_slices == 1:
+        out = _run_by_query(node, index, body.get("query"), **common)
+    else:
+        out = _run_sliced(node, index, body.get("query"),
+                          n_slices=n_slices,
+                          action="indices:data/write/update/byquery",
+                          parent_task=task, **common)
     out["updated"] += out.pop("created", 0)
     out["created"] = 0
     return out
@@ -237,7 +444,7 @@ def update_by_query(node, index: str,
 
 def delete_by_query(node, index: str,
                     body: Optional[Dict[str, Any]],
-                    params: Dict[str, str]) -> Dict[str, Any]:
+                    params: Dict[str, str], task=None) -> Dict[str, Any]:
     body = body or {}
     if "query" not in body:
         raise IllegalArgumentException(
@@ -249,8 +456,14 @@ def delete_by_query(node, index: str,
                 "if_seq_no": h.get("_seq_no"),
                 "if_primary_term": h.get("_primary_term")}
 
-    return _run_by_query(
-        node, index, body["query"], make_op=make_op,
-        batch_size=BATCH_SIZE,
-        conflicts_proceed=_conflicts_proceed(params, body),
-        max_docs=body.get("max_docs"), seq_no_primary_term=True)
+    n_slices = _parse_slices(params.get("slices", body.get("slices")),
+                             node, index)
+    common = dict(make_op=make_op, batch_size=BATCH_SIZE,
+                  conflicts_proceed=_conflicts_proceed(params, body),
+                  max_docs=body.get("max_docs"),
+                  seq_no_primary_term=True)
+    if n_slices == 1:
+        return _run_by_query(node, index, body["query"], **common)
+    return _run_sliced(node, index, body["query"], n_slices=n_slices,
+                       action="indices:data/write/delete/byquery",
+                       parent_task=task, **common)

@@ -615,19 +615,30 @@ def register(controller: RestController, node) -> None:
         return 200, {"took": int((time.perf_counter() - t0) * 1000),
                      "errors": bulk_has_errors(items), "items": items}
 
+    def _by_query(action: str, fn, *args):
+        task = node.task_manager.register(action)
+        try:
+            return 200, fn(*args, task=task)
+        finally:
+            node.task_manager.unregister(task)
+
     def do_reindex(req: RestRequest):
         from elasticsearch_tpu import reindex as reindex_mod
-        return 200, reindex_mod.reindex(node, req.body or {})
+        return _by_query("indices:data/write/reindex",
+                         reindex_mod.reindex, node, req.body or {},
+                         req.params)
 
     def do_update_by_query(req: RestRequest):
         from elasticsearch_tpu import reindex as reindex_mod
-        return 200, reindex_mod.update_by_query(
-            node, req.param("index"), req.body, req.params)
+        return _by_query("indices:data/write/update/byquery",
+                         reindex_mod.update_by_query, node,
+                         req.param("index"), req.body, req.params)
 
     def do_delete_by_query(req: RestRequest):
         from elasticsearch_tpu import reindex as reindex_mod
-        return 200, reindex_mod.delete_by_query(
-            node, req.param("index"), req.body, req.params)
+        return _by_query("indices:data/write/delete/byquery",
+                         reindex_mod.delete_by_query, node,
+                         req.param("index"), req.body, req.params)
 
     controller.register("POST", "/_reindex", do_reindex)
     controller.register("POST", "/{index}/_update_by_query",

@@ -412,16 +412,30 @@ class _PackQueue:
                         # window_s — no refill, no latency floor.
                         deadline = time.monotonic() + batcher.window_s
                         waited_busy = False
+                        # a HALF-full train launches even while the
+                        # device is busy (pipeline depth > 1 must not
+                        # require a completely full queue — with C
+                        # concurrent clients the queue can never exceed
+                        # C minus in-flight, so gating on max_batch
+                        # serializes trains when C ≈ max_batch)
+                        pipeline_min = max(8, batcher.max_batch // 2)
                         while (len(self.pendings) < batcher.max_batch
                                and not self.closed):
                             now = time.monotonic()
                             if now >= deadline:
-                                if self.n_inflight > 0:
+                                if self.n_inflight > 0 and \
+                                        len(self.pendings) < pipeline_min:
                                     waited_busy = True
                                     self.cv.wait(timeout=0.25)
                                     continue
                                 if not waited_busy:
                                     break
+                                # one refill window after a busy wait:
+                                # the just-released cohort joins THIS
+                                # train — fuller trains beat an instant
+                                # launch (measured: trains shrink to
+                                # ~bucket-half and padding wins without
+                                # this)
                                 waited_busy = False
                                 deadline = now + max(
                                     0.05, batcher.window_s)
@@ -487,7 +501,7 @@ class MicroBatcher:
     Each pack has its own queue + worker, so launches for different
     packs overlap."""
 
-    def __init__(self, window_s: float = 0.01, max_batch: int = 64):
+    def __init__(self, window_s: float = 0.01, max_batch: int = 128):
         self.window_s = window_s
         self.max_batch = max_batch
         self._lock = threading.Lock()
@@ -590,17 +604,21 @@ class FlatQueryResult:
 # (T slots, window, chunk len, batch bucket, candidate k) to a handful of
 # values so steady-state serving NEVER re-compiles.
 #
-# Tiered escalation (VERDICT r4 diagnosis: at 262k docs the tier-1
-# validity bound fails for hot-term queries and the full-postings exact
-# kernel is orders slower): tier 1 scores the top-16k impact prefix of
-# each term (measured on the bench corpus at B=64/k=1000: 405ms/launch at
-# 4k with 5% validity failures vs 441ms at 16k with ~none — per-launch
-# cost is dominated by fixed dispatch/transfer overhead, not sort width,
-# and every retry is another fixed-cost launch); failures re-run at the
-# 32k prefix (tier 2); only then the exact kernel. Every tier has a
-# pinned jit signature, prewarmed.
-PREFIX_CAP = 16384
-PREFIX_CAP2 = 32768
+# r5 routing (replaces r4's try-then-retry tiering, whose ~1-per-train
+# validity retries each cost a full ~100ms launch): the HOST knows every
+# term's postings length at lowering time, so each query routes to the
+# smallest FULL-POSTINGS sort width that holds ALL its terms' rows —
+# phase-A run totals are then EXACT BM25 (no prefixes, no rescore, no
+# validity bound, nothing to escalate). Only queries too hot for the
+# widest bucket (Σ slots > max(FULL_SLOT_BUCKETS) on some shard row)
+# take the prefix+rescore path at PREFIX_CAP2, escalating PREFIX_CAP3 →
+# exact on validity failures. Measured at 2.6M docs: exact-at-width
+# ≈ prefix-at-the-same-width minus the whole rescore phase, and the
+# 23%-invalid escalation storm of prefix@16k disappears.
+FULL_SLOT_BUCKETS = (32, 128)   # sort widths 131k / 524k (x CHUNK_CAP)
+PREFIX_CAP = 4096               # base prefix for ad-hoc prefix runs
+PREFIX_CAP2 = 16384             # hot-tier prefix (queries over-width)
+PREFIX_CAP3 = 65536             # escalation prefix
 PRUNE_MAX_K = 1000
 PRUNE_MAX_TERMS = 8          # > 8 query terms → exact path
 _PRUNE_WINDOW = 8
@@ -616,24 +634,55 @@ def _candidate_k(k: int) -> int:
     return 128 if k <= 64 else 2048
 
 
-def _serving_bucket(n: int, cap: int = 64) -> int:
-    """Two batch buckets in the common range: small (8) and full (64);
-    larger batches (bigger max_batch settings) fall back to pow2."""
+def _serving_bucket(n: int, cap: int = 128) -> int:
+    """Three batch buckets (8 / 64 / 128) — trains launch at whatever
+    fill the host managed, so the mid bucket avoids ~2x padding when
+    GIL-bound clients can't refill to 128 in one device cycle; every
+    bucket×width×k signature is prewarmed."""
     if n <= 8:
         return 8
+    if n <= 64:
+        return 64
     if n <= cap:
         return cap
     return _batch_bucket(n, 1024)
+
+
+def _slots_needed(resident: ResidentPack, flat: FlatQuery) -> int:
+    """Max over shard rows of Σ_terms ceil(row_len/CHUNK): the slot
+    count a FULL-postings sorted-merge of this query needs."""
+    pack = resident.pack
+    worst = 0
+    for si in range(len(pack.vocabs)):
+        vocab = pack.vocabs[si]
+        rstart = pack.row_starts[si]
+        n = 0
+        for t in flat.terms:
+            r = vocab.get(t)
+            if r is None:
+                continue
+            ln = int(rstart[r + 1] - rstart[r])
+            n += (ln + dist.CHUNK_CAP - 1) // dist.CHUNK_CAP
+        worst = max(worst, n)
+    return max(worst, 1)
+
+
+def _full_bucket(slots: int) -> Optional[int]:
+    for b in FULL_SLOT_BUCKETS:
+        if slots <= b:
+            return b
+    return None
 
 
 def launch_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
                       k: int, mesh=None,
                       stages: Optional[StageTimes] = None) -> Dict[str, Any]:
     """Phase 1 of a micro-batch: host prep + ASYNC kernel dispatch for
-    the tier-1 pruned subset and the exact subset (msm/AND, big k, many
-    terms). Returns an opaque launch state for finish_flat_batch. JAX
-    dispatch is asynchronous, so the caller can launch batch N+1 while
-    batch N executes on device (double-buffered serving; VERDICT r3 #1d)."""
+    the tier-E pruned subset (rescore-free), the tier-H pruned subset,
+    and the exact subset (msm/AND, big k, many terms). Returns an
+    opaque launch state for finish_flat_batch. JAX dispatch is
+    asynchronous, so the caller can launch batch N+1 while batch N
+    executes on device (double-buffered serving; VERDICT r3 #1d)."""
     if mesh is None:
         mesh = make_mesh(shape=(1, _n_local_devices()))
     pruned_idx = [i for i, f in enumerate(flats)
@@ -641,13 +690,38 @@ def launch_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
                   and len(f.terms) <= PRUNE_MAX_TERMS
                   and resident.imp_device_arrays is not None]
     exact_idx = [i for i in range(len(flats)) if i not in set(pruned_idx)]
+    # route each query to the smallest exact-sort width that holds its
+    # FULL postings; overflow goes to the prefix+rescore path
+    full_groups: Dict[int, List[int]] = {b: [] for b in FULL_SLOT_BUCKETS}
+    hot_idx: List[int] = []
+    for i in pruned_idx:
+        b = _full_bucket(_slots_needed(resident, flats[i]))
+        if b is None:
+            hot_idx.append(i)
+        else:
+            full_groups[b].append(i)
+    # a tiny group isn't worth its own ~100ms launch floor: fold it into
+    # the next WIDER bucket when that bucket launches anyway (always
+    # correct — wider holds everything; folding into an EMPTY wider
+    # bucket would save nothing and widen the sort for nothing)
+    buckets = list(FULL_SLOT_BUCKETS)
+    for bi, b in enumerate(buckets[:-1]):
+        if 0 < len(full_groups[b]) < 16 and full_groups[buckets[bi + 1]]:
+            full_groups[buckets[bi + 1]].extend(full_groups[b])
+            full_groups[b] = []
     st: Dict[str, Any] = {"resident": resident, "flats": flats, "k": k,
                           "mesh": mesh, "stages": stages,
-                          "pruned_idx": pruned_idx, "exact_idx": exact_idx}
-    if pruned_idx:
-        st["pruned_launch"] = _launch_pruned(
-            resident, [flats[i] for i in pruned_idx], k, mesh,
-            prefix_cap=PREFIX_CAP, stages=stages)
+                          "full_groups": full_groups, "hot_idx": hot_idx,
+                          "exact_idx": exact_idx}
+    for b, idxs in full_groups.items():
+        if idxs:
+            st[f"full_launch_{b}"] = _launch_pruned(
+                resident, [flats[i] for i in idxs], k, mesh,
+                stages=stages, full_slots=b)
+    if hot_idx:
+        st["hot_launch"] = _launch_pruned(
+            resident, [flats[i] for i in hot_idx], k, mesh,
+            prefix_cap=PREFIX_CAP2, stages=stages)
     if exact_idx:
         st["exact_launch"] = _launch_exact(
             resident, [flats[i] for i in exact_idx], k, mesh)
@@ -655,32 +729,42 @@ def launch_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
 
 
 def finish_flat_batch(st: Dict[str, Any]) -> List[FlatQueryResult]:
-    """Phase 2: materialize device results, run the tier-2 retry for
-    validity failures (deeper prefix), and the exact tier-3 fallback."""
+    """Phase 2: materialize device results; residual tier-H validity
+    failures escalate to the deeper PREFIX_CAP3 prefix, then exact."""
     resident, flats, k, mesh, stages = (st["resident"], st["flats"],
                                         st["k"], st["mesh"], st["stages"])
-    pruned_idx, exact_idx = st["pruned_idx"], list(st["exact_idx"])
     out: List[Optional[FlatQueryResult]] = [None] * len(flats)
     tier3_idx: List[int] = []
-    if pruned_idx:
-        results, invalid = _finish_pruned(st["pruned_launch"],
+    escalate: List[int] = []
+    for b, idxs in st["full_groups"].items():
+        if not idxs:
+            continue
+        results, invalid = _finish_pruned(st[f"full_launch_{b}"],
                                           stages=stages)
-        for j, i in enumerate(pruned_idx):
+        for j, i in enumerate(idxs):
             out[i] = results[j]
-        if invalid:
-            # tier 2: deeper prefix, pinned signature — still ~free vs
-            # the exact kernel's full-postings sort
-            retry_idx = [pruned_idx[j] for j in invalid]
-            if stages is not None:
-                stages.add("pruned_invalid_t1", 0.0, n=len(retry_idx))
-            results2, invalid2 = _execute_pruned(
-                resident, [flats[i] for i in retry_idx], k, mesh,
-                stages=stages, prefix_cap=PREFIX_CAP2)
-            for j, i in enumerate(retry_idx):
-                out[i] = results2[j]
-            if invalid2 and stages is not None:
-                stages.add("pruned_invalid_t2", 0.0, n=len(invalid2))
-            tier3_idx = [retry_idx[j] for j in invalid2]
+        # full-postings runs are exact ⇒ beta 0 ⇒ no invalids; if the
+        # invariant ever breaks, escalate rather than crash serving
+        escalate.extend(idxs[j] for j in invalid)
+    if st["hot_idx"]:
+        hot_idx = st["hot_idx"]
+        results, invalid = _finish_pruned(st["hot_launch"],
+                                          stages=stages)
+        for j, i in enumerate(hot_idx):
+            out[i] = results[j]
+        escalate.extend(hot_idx[j] for j in invalid)
+    if escalate:
+        retry_idx = escalate
+        if stages is not None:
+            stages.add("pruned_invalid_t2", 0.0, n=len(retry_idx))
+        results2, invalid2 = _execute_pruned(
+            resident, [flats[i] for i in retry_idx], k, mesh,
+            stages=stages, prefix_cap=PREFIX_CAP3)
+        for j, i in enumerate(retry_idx):
+            out[i] = results2[j]
+        if invalid2 and stages is not None:
+            stages.add("pruned_invalid_t3", 0.0, n=len(invalid2))
+        tier3_idx = [retry_idx[j] for j in invalid2]
     if "exact_launch" in st:
         results = _finish_exact(st["exact_launch"])
         for j, i in enumerate(st["exact_idx"]):
@@ -702,9 +786,9 @@ def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
                        stages: Optional[StageTimes] = None
                        ) -> List[FlatQueryResult]:
     """Run one micro-batch synchronously. OR-queries (min_count == 1,
-    k ≤ 1000) go through the block-max pruned pipeline; msm/AND queries
-    and pruned queries whose validity bound fails escalate (32k prefix,
-    then exact kernel)."""
+    k ≤ 1000) go through the block-max pruned pipeline (tier E or H by
+    per-term df); msm/AND queries and pruned queries whose validity
+    bound fails escalate (64k prefix, then exact kernel)."""
     return finish_flat_batch(launch_flat_batch(resident, flats, k, mesh,
                                                stages=stages))
 
@@ -793,11 +877,16 @@ def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
 
 def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
                    k: int, mesh, prefix_cap: int = PREFIX_CAP,
-                   stages: Optional[StageTimes] = None) -> Dict[str, Any]:
-    """Block-max pipeline (SURVEY.md §5.7/§7.3#3), one fused ASYNC
-    launch: candidate generation over impact-sorted prefixes + EXACT
-    on-device re-score (binary search in the doc-sorted postings) +
-    final order; only [B, k] results cross the device→host link."""
+                   stages: Optional[StageTimes] = None,
+                   with_rescore: bool = True,
+                   full_slots: Optional[int] = None) -> Dict[str, Any]:
+    """One fused ASYNC launch. Two modes:
+    - full_slots=N: FULL-postings sorted-merge at the N-slot width —
+      run totals are exact BM25, no rescore (SURVEY.md §5.7 applied as
+      width buckets instead of prefixes);
+    - prefix mode (block-max, §7.3#3): candidate generation over
+      impact-sorted prefixes + EXACT on-device re-score (binary search
+      in the doc-sorted postings). Only [B, k] crosses device→host."""
     import jax
 
     t_prep = time.perf_counter()
@@ -806,13 +895,24 @@ def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     k_cand = _candidate_k(k)
     k_out = 128 if k_cand == 128 else 1024
     b_bucket = _serving_bucket(len(flats))
-    batch = dist.prepare_query_batch(
-        pack, [f.terms for f in flats],
-        boosts=[f.boost for f in flats],
-        min_counts=[1] * len(flats),
-        pad_batch_to=b_bucket,
-        prefix_cap=prefix_cap, imp_impacts=imp_impacts,
-        pad_t_slots=_prune_t_slots(prefix_cap), pad_max_len=dist.CHUNK_CAP)
+    if full_slots is not None:
+        with_rescore = False
+        k_cand = k_out  # exact totals: the candidate pool IS the result
+        batch = dist.prepare_query_batch(
+            pack, [f.terms for f in flats],
+            boosts=[f.boost for f in flats],
+            min_counts=[1] * len(flats),
+            pad_batch_to=b_bucket,
+            pad_t_slots=full_slots, pad_max_len=dist.CHUNK_CAP)
+    else:
+        batch = dist.prepare_query_batch(
+            pack, [f.terms for f in flats],
+            boosts=[f.boost for f in flats],
+            min_counts=[1] * len(flats),
+            pad_batch_to=b_bucket,
+            prefix_cap=prefix_cap, imp_impacts=imp_impacts,
+            pad_t_slots=_prune_t_slots(prefix_cap),
+            pad_max_len=dist.CHUNK_CAP)
     t_starts, t_lengths, t_weights = dist.prepare_term_ranges(
         pack, [f.terms for f in flats],
         boosts=[f.boost for f in flats],
@@ -821,7 +921,7 @@ def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
         c_cand=k_cand, k_out=k_out,
         t_window=max(_PRUNE_WINDOW, batch.window),
-        t_terms=PRUNE_MAX_TERMS)
+        t_terms=PRUNE_MAX_TERMS, with_rescore=with_rescore)
     from jax.sharding import NamedSharding, PartitionSpec as P
     from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
@@ -890,12 +990,16 @@ def _finish_pruned(launch: Dict[str, Any],
 
 def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
                     k: int, mesh, stages: Optional[StageTimes] = None,
-                    prefix_cap: int = PREFIX_CAP
+                    prefix_cap: int = PREFIX_CAP,
+                    with_rescore: bool = True,
+                    full_slots: Optional[int] = None
                     ) -> Tuple[List[FlatQueryResult], List[int]]:
-    """Synchronous pruned execution (tier-2 retries, prewarm, dryrun)."""
+    """Synchronous pruned execution (escalations, prewarm, dryrun)."""
     return _finish_pruned(
         _launch_pruned(resident, flats, k, mesh, prefix_cap=prefix_cap,
-                       stages=stages), stages=stages)
+                       stages=stages, with_rescore=with_rescore,
+                       full_slots=full_slots),
+        stages=stages)
 
 
 def _n_local_devices() -> int:
@@ -912,7 +1016,7 @@ class TpuSearchService:
     micro-batched execution. One instance per node."""
 
     def __init__(self, breaker=None, mesh=None, window_s: float = 0.01,
-                 max_batch: int = 64, batch_timeout_s: float = 30.0):
+                 max_batch: int = 128, batch_timeout_s: float = 30.0):
         _ensure_compile_cache()
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.batch_timeout_s = batch_timeout_s
@@ -1035,20 +1139,30 @@ class TpuSearchService:
                     terms = [next(iter(v))]
                     break
             flat = FlatQuery(field, terms or ["_warm_"], 1.0, 1)
-            buckets = [8, 64]
-            full = _serving_bucket(self.batcher.max_batch)
-            if full not in buckets:
-                buckets.append(full)
-            table = []
+            buckets = [8, 64, _serving_bucket(self.batcher.max_batch)]
+            buckets = sorted(set(buckets))
+            table = []   # (batch, k, slots|None, prefix|None)
             for b_bucket in buckets:
                 for k in (10, PRUNE_MAX_K):
-                    for cap in (PREFIX_CAP, PREFIX_CAP2):
-                        table.append((b_bucket, k, cap))
-            for b_bucket, k, cap in table:
+                    for slots in FULL_SLOT_BUCKETS:
+                        table.append((b_bucket, k, slots, None))
+                    table.append((b_bucket, k, None, PREFIX_CAP2))
+            # the PREFIX_CAP3 escalation runs inline in the batch
+            # completer with clients waiting — it must NEVER compile
+            # there (a cold compile at multi-million-doc shapes blows
+            # the batch timeout and trips the kernel breaker); BOTH
+            # k-bucket signatures (k_cand 128 and 2048) are reachable
+            for b_bucket in buckets:
+                for k in (10, PRUNE_MAX_K):
+                    table.append((b_bucket, k, None, PREFIX_CAP3))
+            for b_bucket, k, slots, cap in table:
                 t1 = time.perf_counter()
                 _execute_pruned(resident, [flat] * b_bucket, k,
-                                self.packs.mesh, prefix_cap=cap)
-                compiled.append({"batch": b_bucket, "k": k, "prefix": cap,
+                                self.packs.mesh,
+                                prefix_cap=cap or PREFIX_CAP2,
+                                full_slots=slots)
+                compiled.append({"batch": b_bucket, "k": k,
+                                 "slots": slots, "prefix": cap,
                                  "seconds": round(
                                      time.perf_counter() - t1, 2)})
             # exact kernel (msm/AND tier 1, OR tier 3) at its common

@@ -44,12 +44,16 @@ def register_transport_handlers(node, transport) -> None:
 
 class Task:
     def __init__(self, task_id: int, node_id: str, action: str,
-                 description: str, cancellable: bool = True):
+                 description: str, cancellable: bool = True,
+                 parent_task_id: Optional[str] = None):
         self.id = task_id
         self.node_id = node_id
         self.action = action
         self.description = description
         self.cancellable = cancellable
+        # cross-node task tree (reference: TaskId parent linkage; the
+        # _tasks API shows children under ?parent_task_id=)
+        self.parent_task_id = parent_task_id
         self.start_time_millis = int(time.time() * 1000)
         self._start = time.monotonic()
         self._cancelled = threading.Event()
@@ -76,7 +80,7 @@ class Task:
                 f"[{self.cancel_reason}]")
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "node": self.node_id, "id": self.id,
             "type": "transport", "action": self.action,
             "description": self.description,
@@ -86,6 +90,9 @@ class Task:
             "cancellable": self.cancellable,
             "cancelled": self.cancelled,
         }
+        if self.parent_task_id is not None:
+            out["parent_task_id"] = self.parent_task_id
+        return out
 
 
 class TaskManager:
@@ -98,11 +105,12 @@ class TaskManager:
         self._tasks: Dict[int, Task] = {}
 
     def register(self, action: str, description: str = "",
-                 cancellable: bool = True) -> Task:
+                 cancellable: bool = True,
+                 parent_task_id: Optional[str] = None) -> Task:
         with self._lock:
             self._seq += 1
             task = Task(self._seq, self.node_id, action, description,
-                        cancellable)
+                        cancellable, parent_task_id=parent_task_id)
             self._tasks[task.id] = task
             return task
 

@@ -137,3 +137,18 @@ def test_dead_remote_errors_then_skips(two_clusters, tmp_path):
     assert s == 200, r
     assert r["_clusters"]["skipped"] == 1
     assert r["hits"]["total"]["value"] == 3  # local only
+
+
+def test_remote_reindex(two_clusters):
+    """Remote reindex pulls from a registered remote over the CCS
+    transport (reference: reindex-from-remote; SURVEY.md §2.1#51)."""
+    a, b, _pb = two_clusters
+    s, res = _h(a, "POST", "/_reindex", body={
+        "source": {"index": "logs", "remote": {"cluster": "b"}},
+        "dest": {"index": "pulled"}})
+    assert s == 200, res
+    assert res["created"] == 3
+    _h(a, "POST", "/pulled/_refresh")
+    s, r = _h(a, "POST", "/pulled/_search", body={
+        "query": {"match": {"body": "remote"}}, "size": 10})
+    assert r["hits"]["total"]["value"] == 3  # b's docs, now local on a

@@ -181,3 +181,72 @@ class TestDeleteByQuery:
         _handle(src, "POST", "/src/_delete_by_query",
                 body={"query": {"term": {"n": 2}}})
         assert src.search_contexts.active_count() == before
+
+
+class TestSlices:
+    def test_update_by_query_sliced(self, node):
+        for i in range(40):
+            _handle(node, "PUT", f"/sl/_doc/{i}",
+                    params={"refresh": "true"}, body={"v": i, "m": 0})
+        status, res = _handle(node, "POST", "/sl/_update_by_query",
+                              params={"slices": "4"},
+                              body={"query": {"match_all": {}},
+                                    "script": "ctx._source.m = 1"})
+        assert status == 200, res
+        assert res["updated"] == 40
+        assert len(res["slices"]) == 4
+        # every doc updated exactly once (slices partition, not overlap)
+        assert sum(s["updated"] for s in res["slices"]) == 40
+        _handle(node, "POST", "/sl/_refresh")
+        _, r = _handle(node, "POST", "/sl/_search", body={
+            "query": {"term": {"m": 1}}, "size": 0})
+        assert r["hits"]["total"]["value"] == 40
+
+    def test_reindex_sliced_auto(self, node):
+        _handle(node, "PUT", "/src4", body={
+            "settings": {"number_of_shards": 3}})
+        for i in range(30):
+            _handle(node, "PUT", f"/src4/_doc/{i}",
+                    params={"refresh": "true"}, body={"v": i})
+        status, res = _handle(node, "POST", "/_reindex",
+                              body={"source": {"index": "src4"},
+                                    "dest": {"index": "dst4"},
+                                    "slices": "auto"})
+        assert status == 200, res
+        assert res["created"] == 30
+        assert len(res["slices"]) == 3  # auto = source shard count
+        _handle(node, "POST", "/dst4/_refresh")
+        _, r = _handle(node, "POST", "/dst4/_search", body={"size": 0})
+        assert r["hits"]["total"]["value"] == 30
+
+    def test_delete_by_query_sliced_max_docs(self, node):
+        for i in range(20):
+            _handle(node, "PUT", f"/dl/_doc/{i}",
+                    params={"refresh": "true"}, body={"v": i})
+        status, res = _handle(node, "POST", "/dl/_delete_by_query",
+                              params={"slices": "2"},
+                              body={"query": {"match_all": {}},
+                                    "max_docs": 10})
+        assert status == 200, res
+        assert res["total"] == 10  # max_docs divided across slices
+
+    def test_bad_slices_400(self, node):
+        _handle(node, "PUT", "/sb/_doc/1", params={"refresh": "true"},
+                body={"v": 1})
+        status, _ = _handle(node, "POST", "/sb/_update_by_query",
+                            params={"slices": "99"},
+                            body={"query": {"match_all": {}}})
+        assert status == 400
+
+
+class TestRemoteReindex:
+    def test_remote_requires_registered_cluster(self, node):
+        status, res = _handle(node, "POST", "/_reindex", body={
+            "source": {"index": "s",
+                       "remote": {"cluster": "nosuch"}},
+            "dest": {"index": "d"}})
+        assert status == 400
+        status, res = _handle(node, "POST", "/_reindex", body={
+            "source": {"index": "s", "remote": {"host": "http://x:9200"}},
+            "dest": {"index": "d"}})
+        assert status == 400  # raw URLs unsupported, clear message
