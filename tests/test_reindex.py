@@ -250,3 +250,12 @@ class TestRemoteReindex:
             "source": {"index": "s", "remote": {"host": "http://x:9200"}},
             "dest": {"index": "d"}})
         assert status == 400  # raw URLs unsupported, clear message
+
+    def test_max_docs_below_slices_400(self, node):
+        _handle(node, "PUT", "/md/_doc/1", params={"refresh": "true"},
+                body={"v": 1})
+        status, _ = _handle(node, "POST", "/md/_update_by_query",
+                            params={"slices": "4"},
+                            body={"query": {"match_all": {}},
+                                  "max_docs": 2})
+        assert status == 400
