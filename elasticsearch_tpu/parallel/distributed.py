@@ -1032,8 +1032,9 @@ def make_term_sharded_search(mesh: Mesh, *, n_docs_pad: int, k: int):
         for qi in range(b):  # B is small/static for this path
             wq = jnp.repeat(w[qi], td.shape[1])
             dense = dense.at[qi].add(
-                jnp.zeros(n_docs_pad + 1).at[flat_idx].add(
-                    wq * per_term))
+                jnp.zeros(n_docs_pad + 1,
+                          dtype=jnp.float32).at[flat_idx].add(
+                    (wq * per_term).astype(jnp.float32)))
         full = jax.lax.psum(dense, SHARD_AXIS)[:, :n_docs_pad]
         vals, docs = jax.lax.top_k(full, min(k, n_docs_pad))
         vals = jnp.where(vals > 0.0, vals, NEG_INF)

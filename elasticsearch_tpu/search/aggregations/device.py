@@ -191,3 +191,131 @@ def numeric_stats(pack, field: str, mask
     else:
         return None
     return int(cnt), float(s), float(mn), float(mx)
+
+
+@functools.lru_cache(maxsize=64)
+def _ord_presence_fn(n_out: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(ords, mask):
+        idx = jnp.where(mask & (ords >= 0), ords, n_out)
+        return jnp.zeros(n_out, dtype=jnp.int32).at[idx].max(
+            1, mode="drop")
+
+    return f
+
+
+def ord_presence(pack, field: str, mask) -> Optional[np.ndarray]:
+    """bool[n_terms]: which keyword ordinals appear under the mask —
+    the device half of an exact-per-segment cardinality collect (the
+    host then feeds only the DISTINCT terms into the HLL sketch that
+    merges across shards, instead of hashing every doc)."""
+    col = pack.dv_ord.get(field)
+    terms = pack.dv_ord_terms.get(field)
+    if col is None or not terms:
+        return None
+    import jax.numpy as jnp
+    n_out = _pow2(len(terms))
+    present = _ord_presence_fn(n_out)(_dev_col(pack, "ord", field),
+                                      jnp.asarray(mask))
+    return np.asarray(present)[: len(terms)] > 0
+
+
+@functools.lru_cache(maxsize=64)
+def _bounded_bucket_fn(n_bounds: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(col, valid, bounds):
+        # bucket of v = index of the last boundary ≤ v (searchsorted
+        # right − 1); out-of-range and invalid docs drop. The f64 cast
+        # stays INSIDE the jit so it fuses (no per-query HBM copy)
+        ids = jnp.searchsorted(bounds, col.astype(jnp.float64),
+                               side="right") - 1
+        idx = jnp.where(valid & (ids >= 0), ids, n_bounds)
+        return jnp.zeros(n_bounds, dtype=jnp.int64).at[idx].add(
+            1, mode="drop")
+
+    return f
+
+
+def bounded_bucket_counts(pack, field: str, mask,
+                          boundaries: np.ndarray
+                          ) -> Optional[np.ndarray]:
+    """Counts per variable-width bucket [boundaries[i], boundaries[i+1])
+    — calendar intervals (month/quarter/year) become one device
+    searchsorted + scatter-add over host-precomputed month starts
+    (SURVEY.md §7.2.8; VERDICT r4 item 8: calendar intervals fell off
+    the device path)."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.segment import MISSING_I64
+    m = jnp.asarray(mask)
+    if field in pack.dv_i64:
+        col = _dev_col(pack, "i64", field)
+        valid = m & (col != MISSING_I64)
+    elif field in pack.dv_f64:
+        col = _dev_col(pack, "f64", field)
+        valid = m & ~jnp.isnan(col)
+    else:
+        return None
+    n = _pow2(len(boundaries))
+    bounds = np.full(n, np.iinfo(np.int64).max, dtype=np.float64)
+    bounds[: len(boundaries)] = boundaries
+    counts = _bounded_bucket_fn(n)(col, valid, jnp.asarray(bounds))
+    return np.asarray(counts)[: len(boundaries)]
+
+
+@functools.lru_cache(maxsize=64)
+def _terms_metric_fn(n_out: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(ords, vals, ok):
+        idx = jnp.where(ok, ords, n_out)
+        z = lambda fill: jnp.full(n_out, fill, dtype=jnp.float64)
+        cnt = jnp.zeros(n_out, dtype=jnp.int64).at[idx].add(
+            1, mode="drop")
+        v = vals.astype(jnp.float64)
+        s = z(0.0).at[idx].add(jnp.where(ok, v, 0.0), mode="drop")
+        mn = z(jnp.inf).at[idx].min(jnp.where(ok, v, jnp.inf),
+                                    mode="drop")
+        mx = z(-jnp.inf).at[idx].max(jnp.where(ok, v, -jnp.inf),
+                                     mode="drop")
+        return cnt, s, mn, mx
+
+    return f
+
+
+def terms_numeric_stats(pack, key_field: str, val_field: str, mask
+                        ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray, np.ndarray]]:
+    """One-level sub-agg on device (VERDICT r4 item 8): per keyword
+    ordinal of `key_field`, the (count, sum, min, max) of `val_field`
+    — a numeric metric nested under a terms agg runs as FOUR
+    scatter-reductions instead of per-bucket host masks."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.segment import MISSING_I64
+    ord_col = pack.dv_ord.get(key_field)
+    terms = pack.dv_ord_terms.get(key_field)
+    if ord_col is None or not terms:
+        return None
+    m = jnp.asarray(mask)
+    if val_field in pack.dv_i64:
+        vals = _dev_col(pack, "i64", val_field)
+        valid = m & (vals != MISSING_I64)
+    elif val_field in pack.dv_f64:
+        vals = _dev_col(pack, "f64", val_field)
+        valid = m & ~jnp.isnan(vals)
+    else:
+        return None
+    ords = _dev_col(pack, "ord", key_field)
+    ok = valid & (ords >= 0)
+    n_out = _pow2(len(terms))
+    cnt, s, mn, mx = _terms_metric_fn(n_out)(ords, vals, ok)
+    n = len(terms)
+    return (np.asarray(cnt)[:n], np.asarray(s)[:n],
+            np.asarray(mn)[:n], np.asarray(mx)[:n])

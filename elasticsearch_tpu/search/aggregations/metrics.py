@@ -178,22 +178,43 @@ class CardinalityAggregator(Aggregator):
 
     def collect(self, ctx: SegmentAggContext, mask) -> InternalCardinality:
         from elasticsearch_tpu.indices.service import murmur3_hash
-        vals, _, ord_terms = ctx.field_values(self.field, mask)
+        keys = self._device_distinct_keys(ctx, mask)
+        if keys is None:
+            vals, _, ord_terms = ctx.field_values(self.field, mask)
+            keys = []
+            if len(vals):
+                if ord_terms is not None:
+                    uniq = np.unique(np.asarray(vals, dtype=np.int64))
+                    keys = [ord_terms[int(v)] for v in uniq]
+                else:
+                    keys = [repr(v) for v in np.unique(vals)]
         regs = np.zeros(1 << HLL_P, dtype=np.uint8)
-        if len(vals):
-            if ord_terms is not None:
-                uniq = np.unique(np.asarray(vals, dtype=np.int64))
-                keys = [ord_terms[int(v)] for v in uniq]
-            else:
-                keys = [repr(v) for v in np.unique(vals)]
-            for k in keys:
-                h = murmur3_hash(k) & 0xFFFFFFFF
-                idx = h >> (32 - HLL_P)
-                w = (h << HLL_P) & 0xFFFFFFFF
-                rank = (32 - HLL_P) + 1 if w == 0 else (32 - w.bit_length()) + 1
-                if rank > regs[idx]:
-                    regs[idx] = rank
+        for k in keys:
+            h = murmur3_hash(k) & 0xFFFFFFFF
+            idx = h >> (32 - HLL_P)
+            w = (h << HLL_P) & 0xFFFFFFFF
+            rank = (32 - HLL_P) + 1 if w == 0 else (32 - w.bit_length()) + 1
+            if rank > regs[idx]:
+                regs[idx] = rank
         return InternalCardinality(regs)
+
+    def _device_distinct_keys(self, ctx, mask):
+        """Keyword cardinality, device half (SURVEY.md §7.2.8): a
+        scatter-max presence bitmap over the ord column gives this
+        segment's DISTINCT ordinals — the host hashes only those into
+        the HLL (the cross-shard merge representation), not every doc.
+        None → host path (non-keyword, or multi-valued extras)."""
+        seg = ctx.view.segment
+        col = seg.doc_values.get(self.field)
+        if col is None or col.kind != "ord" or col.extra:
+            return None
+        from elasticsearch_tpu.search.aggregations import device
+        present = device.ord_presence(ctx.view.pack, self.field,
+                                      np.asarray(mask))
+        if present is None:
+            return None
+        terms = ctx.view.pack.dv_ord_terms[self.field]
+        return [terms[i] for i in np.nonzero(present)[0]]
 
     def empty(self) -> InternalCardinality:
         return InternalCardinality(np.zeros(1 << HLL_P, dtype=np.uint8))

@@ -57,6 +57,11 @@ def _host_only(monkeypatch):
     monkeypatch.setattr(device, "terms_counts", lambda *a, **k: None)
     monkeypatch.setattr(device, "histogram_counts", lambda *a, **k: None)
     monkeypatch.setattr(device, "numeric_stats", lambda *a, **k: None)
+    monkeypatch.setattr(device, "ord_presence", lambda *a, **k: None)
+    monkeypatch.setattr(device, "bounded_bucket_counts",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(device, "terms_numeric_stats",
+                        lambda *a, **k: None)
 
 
 AGG_BODIES = [
@@ -75,6 +80,26 @@ AGG_BODIES = [
     {"query": {"range": {"n": {"gte": 10, "lt": 40}}},
      "aggs": {"t": {"terms": {"field": "tag"}},
               "s": {"stats": {"field": "x"}}}, "size": 0},
+    # ---- phase 2 (VERDICT r4 item 8) ----
+    # cardinality via the device presence bitmap
+    {"aggs": {"c": {"cardinality": {"field": "tag"}}}, "size": 0},
+    # calendar intervals via device searchsorted buckets
+    {"aggs": {"d": {"date_histogram": {"field": "when",
+                                       "calendar_interval": "month"}}},
+     "size": 0},
+    {"aggs": {"d": {"date_histogram": {"field": "when",
+                                       "calendar_interval": "week"}}},
+     "size": 0},
+    # one-level numeric metric sub-aggs under terms, on device
+    {"aggs": {"t": {"terms": {"field": "tag"},
+                    "aggs": {"mx": {"max": {"field": "n"}},
+                             "s": {"stats": {"field": "x"}},
+                             "a": {"avg": {"field": "x"}}}}},
+     "size": 0},
+    {"query": {"range": {"n": {"gte": 5}}},
+     "aggs": {"t": {"terms": {"field": "tag"},
+                    "aggs": {"sm": {"sum": {"field": "n"}}}}},
+     "size": 0},
 ]
 
 
