@@ -39,6 +39,7 @@ from elasticsearch_tpu.mapping.types import (
     FieldType,
     GeoPointFieldType,
     IpFieldType,
+    PercolatorFieldType,
     RangeFieldType,
     TextFieldType,
     field_type_for,
@@ -237,7 +238,8 @@ class MapperService:
     def __init__(self, index_settings: Optional[Settings] = None,
                  mapping: Optional[dict] = None):
         self._lock = threading.Lock()
-        self.analyzers = AnalysisRegistry().build(index_settings or Settings.EMPTY)
+        self.index_settings = index_settings or Settings.EMPTY
+        self.analyzers = AnalysisRegistry().build(self.index_settings)
         fields = {}
         dynamic = "true"
         meta = {}
@@ -361,7 +363,7 @@ class MapperService:
             value_is_object_field = isinstance(
                 self.mapper.fields.get(path),
                 (RangeFieldType, CompletionFieldType,
-                 GeoPointFieldType))
+                 GeoPointFieldType, PercolatorFieldType))
             if isinstance(value, dict) and not value_is_object_field:
                 self._parse_object(value, path + ".", parsed,
                                    update_props)
@@ -455,6 +457,9 @@ class MapperService:
                            lat)
                 _append_dv(parsed, path + GeoPointFieldType.LON_SUFFIX,
                            lon)
+                continue
+            if isinstance(ft, PercolatorFieldType):
+                ft.validate(v)  # bad query = 400 at WRITE time
                 continue
             if isinstance(ft, RangeFieldType):
                 glo, ghi = ft.parse_range(v)

@@ -610,6 +610,46 @@ class GeoPointFieldType(FieldType):
         return {"type": "geo_point"}
 
 
+class PercolatorFieldType(FieldType):
+    """`percolator` — the field VALUE is a query (reference:
+    modules/percolator PercolatorFieldMapper; SURVEY.md §2.1#52).
+    Validated at index time (a bad query is a 400 on the write, never
+    a silent no-match later); the query itself lives in _source and is
+    parsed on demand by search/percolator.py."""
+
+    type_name = "percolator"
+    dv_kind = "none"
+    has_doc_values = False
+    is_indexed = False
+
+    def index_terms(self, value: Any) -> Tuple[List[str], int]:
+        return [], 0
+
+    def doc_value(self, value: Any):
+        return None
+
+    def validate(self, value: Any) -> None:
+        from elasticsearch_tpu.search import dsl
+        if not isinstance(value, dict):
+            raise MapperParsingException(
+                f"[percolator] field [{self.name}] expects a query "
+                f"object")
+        try:
+            dsl.parse_query(value)
+        except Exception as e:  # noqa: BLE001 — surface as mapping err
+            raise MapperParsingException(
+                f"[percolator] field [{self.name}] holds an invalid "
+                f"query: {e}") from None
+
+    def normalize_term(self, value: Any) -> str:
+        raise MapperParsingException(
+            f"[percolator] field [{self.name}] does not support term "
+            f"queries (use the percolate query)")
+
+    def to_mapping(self) -> dict:
+        return {"type": "percolator"}
+
+
 class DenseVectorFieldType(FieldType):
     """`dense_vector` — fixed-dim float vectors stored as one dense
     [docs, dims] f32 matrix per segment (reference:
@@ -703,6 +743,8 @@ def field_type_for(name: str, mapping: dict, analyzers=None) -> FieldType:
         return DenseVectorFieldType(name, params)
     if t == "rank_feature":
         return RankFeatureFieldType(name, params)
+    if t == "percolator":
+        return PercolatorFieldType(name, params)
     if t == "geo_point":
         return GeoPointFieldType(name, params)
     raise MapperParsingException(f"no handler for type [{t}] declared on field [{name}]")

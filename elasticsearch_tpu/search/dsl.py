@@ -259,6 +259,20 @@ class GeoBoundingBoxQuery(QueryNode):
 
 
 @dataclasses.dataclass
+class PercolateQuery(QueryNode):
+    """{"percolate": {"field": f, "document": {...}}} — match the
+    stored-query docs whose query matches the document(s) (reference:
+    modules/percolator PercolateQueryBuilder; SURVEY.md §2.1#52)."""
+
+    field: str = ""
+    documents: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+    def query_name(self) -> str:
+        return "percolate"
+
+
+@dataclasses.dataclass
 class KnnScoreDocQuery(QueryNode):
     """The coordinator-rewritten form of a `knn` search clause
     (reference: KnnScoreDocQueryBuilder): the GLOBAL top-k winners of
@@ -749,6 +763,28 @@ def _parse_geo_bounding_box(body) -> GeoBoundingBoxQuery:
                                boost=float(body.get("boost", 1.0)))
 
 
+def _parse_percolate(body) -> PercolateQuery:
+    if not isinstance(body, dict) or not body.get("field"):
+        raise ParsingException("[percolate] requires [field]")
+    unknown = set(body) - {"field", "document", "documents", "boost",
+                           "_name"}
+    if unknown:
+        raise ParsingException(
+            f"[percolate] unknown parameter {sorted(unknown)}")
+    if ("document" in body) == ("documents" in body):
+        raise ParsingException(
+            "[percolate] requires exactly one of [document] or "
+            "[documents]")
+    docs = body.get("documents", [body.get("document")])
+    if not isinstance(docs, list) or not docs or not all(
+            isinstance(d, dict) for d in docs):
+        raise ParsingException(
+            "[percolate] [documents] must be a non-empty array of "
+            "objects")
+    return PercolateQuery(field=str(body["field"]), documents=docs,
+                          boost=float(body.get("boost", 1.0)))
+
+
 def _parse_script_score(body) -> ScriptScoreQuery:
     if not isinstance(body, dict):
         raise ParsingException("[script_score] expects an object")
@@ -793,4 +829,5 @@ _PARSERS = {
     "rank_feature": _parse_rank_feature,
     "geo_distance": _parse_geo_distance,
     "geo_bounding_box": _parse_geo_bounding_box,
+    "percolate": _parse_percolate,
 }

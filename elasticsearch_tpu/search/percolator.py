@@ -1,0 +1,76 @@
+"""Percolator — inverted search: index QUERIES as documents, then ask
+which stored queries match a given document.
+
+Reference: `modules/percolator` (PercolateQueryBuilder, QueryAnalyzer,
+the `percolator` mapper field — SURVEY.md §2.1#52). Kept contracts:
+the `percolator` mapping type validates and stores a query; the
+{"percolate": {"field": f, "document": {...}}} query matches the docs
+whose stored query matches the document; `documents` (plural) matches
+when ANY of them does, with the matched slots in the response's
+`_percolator_document_slot` field (single-doc slot [0]).
+
+Divergences (documented): the reference extracts terms from stored
+queries into hidden fields so a candidate pre-filter skips most
+non-matching queries; this build evaluates every live stored query
+against the percolated document (parsed queries are cached per
+segment). Brute force is O(stored queries) per percolate call — fine
+for alerting-sized query sets; the pre-filter is an optimization seam,
+not a semantic one. The reference's `_percolator_document_slot`
+response field (which of the plural documents matched per hit) is not
+emitted: multi-document percolation matches on ANY document.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+
+def build_doc_reader(mapper, documents: List[Dict[str, Any]]):
+    """The percolated documents as a tiny in-memory index, parsed by an
+    ISOLATED CLONE of the index's mapper (same analyzers/field types as
+    if indexed — the reference's MemoryIndex). A clone, because
+    parse_document applies dynamic-mapping updates: a read-only search
+    must never mutate the live index mapping, and the doc-values kind
+    table must include any dynamically-added fields of the document."""
+    from elasticsearch_tpu.index.reader import ShardReader
+    from elasticsearch_tpu.index.segment import SegmentWriter
+    from elasticsearch_tpu.mapping.mapper import MapperService
+    clone = MapperService(mapper.index_settings, mapper.to_mapping())
+    writer = SegmentWriter("_percolate_docs")
+    for slot, document in enumerate(documents):
+        if not isinstance(document, dict):
+            raise IllegalArgumentException(
+                "[percolate] [document] must be an object")
+        parsed = clone.parse_document(f"_slot_{slot}", document)
+        # kinds re-read per doc: dynamic mapping may have added fields
+        writer.add_document(parsed, clone.dv_kinds())
+    segment = writer.freeze()
+    return ShardReader([(segment, None)], clone)
+
+
+def segment_parsed_queries(segment, field: str):
+    """Parsed query cache per (segment, field): stored queries are
+    immutable once a segment freezes, so each parses once."""
+    cache = getattr(segment, "_percolator_cache", None)
+    if cache is None:
+        cache = {}
+        segment._percolator_cache = cache
+    entry = cache.get(field)
+    if entry is None:
+        from elasticsearch_tpu.search import dsl
+        entry = {}
+        for ord_ in range(segment.num_docs):
+            src = segment.stored_source[ord_] or {}
+            spec = src.get(field)
+            if spec is None:
+                continue
+            try:
+                entry[ord_] = dsl.parse_query(spec)
+            except Exception:  # noqa: BLE001 — validated at index
+                continue  # time; an unparsable survivor just no-matches
+        cache[field] = entry
+    return entry

@@ -168,6 +168,8 @@ class SegmentQueryExecutor:
             return self._eval_geo_bbox(node)
         if isinstance(node, dsl.NestedQuery):
             return self._eval_nested(node, scoring)
+        if isinstance(node, dsl.PercolateQuery):
+            return self._eval_percolate(node, scoring)
         if hasattr(node, "evaluate"):
             # plugin-registered query types evaluate themselves against
             # the executor (SearchPlugin#getQueries seam)
@@ -461,6 +463,50 @@ class SegmentQueryExecutor:
             lon_ok = (lon >= node.left) | (lon <= node.right)
         mask = present & lat_ok & lon_ok
         return mask, jnp.where(mask, node.boost, 0.0).astype(jnp.float32)
+
+    def _eval_percolate(self, node: dsl.PercolateQuery, scoring: bool):
+        """Evaluate every live stored query of this segment against the
+        percolated document(s) (search/percolator.py; reference:
+        PercolateQuery with MemoryIndex verification — here without
+        the term-extraction pre-filter, see module docstring). Score =
+        boost for matching stored queries (the reference scores 1.0
+        filter-style unless the inner query scores)."""
+        from elasticsearch_tpu.search import percolator as perc
+        ft = self.reader.mapper.field_type(node.field)
+        from elasticsearch_tpu.mapping.types import PercolatorFieldType
+        if ft is None or not isinstance(ft, PercolatorFieldType):
+            raise QueryShardException(
+                f"[percolate] field [{node.field}] is not a "
+                f"[percolator] field")
+        # one tiny in-memory index of the documents per REQUEST, keyed
+        # by the index's mapper (a multi-index search re-parses the
+        # documents per index — each index's own analyzers/types apply)
+        readers = getattr(node, "_doc_readers", None)
+        if readers is None:
+            readers = {}
+            node._doc_readers = readers
+        cached = readers.get(id(self.reader.mapper))
+        if cached is None:
+            cached = perc.build_doc_reader(self.reader.mapper,
+                                           node.documents)
+            readers[id(self.reader.mapper)] = cached
+        queries = perc.segment_parsed_queries(self.view.segment,
+                                              node.field)
+        doc_exec = SegmentQueryExecutor(cached, 0)
+        doc_live = cached.views[0].live_mask
+        live = self.view.live_mask  # skip tombstoned stored queries
+        mask = np.zeros(self.d_pad, dtype=bool)
+        for ord_, q in queries.items():
+            if not live[ord_]:
+                continue
+            qmask, _ = doc_exec._eval(q, scoring=False)
+            if bool((np.asarray(qmask)[: len(doc_live)]
+                     & doc_live).any()):
+                mask[ord_] = True
+        m = jnp.asarray(mask)
+        score = jnp.where(m, node.boost if scoring else 0.0,
+                          0.0).astype(jnp.float32)
+        return m, score
 
     def _dv_column(self, field: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Numeric doc-values column → (values_f32, present_mask); the
