@@ -369,6 +369,12 @@ class MapperService:
                                    update_props)
                 continue
             if isinstance(self.mapper.fields.get(path),
+                          PercolatorFieldType) and \
+                    isinstance(value, list):
+                raise MapperParsingException(
+                    f"[percolator] field [{path}] holds ONE query; "
+                    f"arrays of queries are not supported")
+            if isinstance(self.mapper.fields.get(path),
                           DenseVectorFieldType):
                 # the ARRAY is the value — never flattened per element
                 self._index_values(self.mapper.fields[path], path,

@@ -44,6 +44,8 @@ from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
 
 NEG_INF = float("-inf")
 CHUNK_CAP = 4096  # max postings chunk per slot; flat arrays pad by this much
+FUSE_ROWS = 8     # max segment rows fused into one phase-A sort pool
+#                   (more rows sequence through lax.map — HBM bound)
 
 
 @dataclasses.dataclass
@@ -574,58 +576,89 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
         s_l, b = starts.shape[0], starts.shape[1]
         my = jax.lax.axis_index(SHARD_AXIS)
 
-        # ---- phase A, FUSED over local rows: this device's s_l rows
-        # merge into ONE [b, s_l·t·L] sort per query on shard-offset gid
-        # keys — sort cost is ROW-count-bound on TPU (measured: 4x wider
-        # at 1/4 the rows ≈ same sort time, one big top_k instead of
-        # s_l·b small ones), so fusing rows is ~1.5x on phase A.
+        # ---- phase A, FUSED over local rows in GROUPS: rows merge
+        # into [b, G·t·L] sorts per query on shard-offset gid keys —
+        # sort cost is ROW-count-bound on TPU (measured: 4x wider at
+        # 1/4 the rows ≈ same sort time), so fusing is ~1.5x on phase
+        # A. Groups of ≤ FUSE_ROWS sequence through lax.map so only ONE
+        # group's gather/sort intermediates are live — all-rows fusion
+        # at 16 rows × B=128 OOM'd 24G of 16G HBM at MS-MARCO scale.
         flat_imp_docs = fd_imp.reshape(-1)
         flat_imp_imps = fi_imp.reshape(-1)
         row_of_slot = jnp.broadcast_to(
             jnp.arange(s_l, dtype=jnp.int32)[:, None, None],
             starts.shape)                                   # [S_l, B, T]
         starts_abs = starts + row_of_slot * p_pad
+        g = min(FUSE_ROWS, s_l)
+        n_groups = (s_l + g - 1) // g
+        pad_rows = n_groups * g - s_l
 
-        def fuse(a):  # [S_l, B, T] → [B, S_l*T]
-            return jnp.transpose(a, (1, 0, 2)).reshape(b, -1)
+        def grouped(a):  # [S_l, B, T] → [n_groups, B, G*T]
+            if pad_rows:
+                a = jnp.concatenate(
+                    [a, jnp.zeros((pad_rows,) + a.shape[1:],
+                                  dtype=a.dtype)], axis=0)
+            return jnp.transpose(
+                a.reshape(n_groups, g, b, t), (0, 2, 1, 3)
+            ).reshape(n_groups, b, g * t)
 
-        f_starts = fuse(starts_abs)
-        f_lengths = fuse(lengths)
-        f_weights = fuse(weights)
-        f_rows = fuse(row_of_slot)
+        g_starts = grouped(starts_abs)
+        g_lengths = grouped(lengths)
+        g_weights = grouped(weights)
+        g_rows = grouped(row_of_slot)
         idx = jnp.arange(max_len, dtype=jnp.int32)
+        width = g * t * max_len
+        k_dev = min(c_local, width)
 
         def slice_one(s):
             return (jax.lax.dynamic_slice(flat_imp_docs, (s,), (max_len,)),
                     jax.lax.dynamic_slice(flat_imp_imps, (s,), (max_len,)))
 
-        docs, imps = jax.vmap(jax.vmap(slice_one))(f_starts)  # [B, W', L]
-        valid = idx[None, None, :] < f_lengths[:, :, None]
-        # gid key: row·(d_pad+1)+doc — distinct docs across rows never
-        # merge; padded lanes carry impact 0 and drop via total>0
-        gid = (f_rows[:, :, None] * (d_pad + 1)
-               + jnp.where(valid, docs, d_pad))
-        imp = jnp.where(valid, f_weights[:, :, None] * imps, 0.0)
-        width = gid.shape[1] * max_len
-        sk, sv = jax.lax.sort(
-            [gid.reshape(b, width), imp.reshape(b, width)], num_keys=1)
-        total = sparse.segmented_run_sum(sk, sv, t_window)
-        run_end = jnp.concatenate(
-            [sk[:, :-1] != sk[:, 1:], jnp.ones((b, 1), bool)], axis=1)
-        ok = run_end & (total > 0.0)
-        score = jnp.where(ok, total, NEG_INF)
-        totals_b = jnp.sum(ok, axis=1).astype(jnp.int32)
-        k_dev = min(c_local, width)
-        vals_b, pos = jax.lax.top_k(score, k_dev)
-        gid_local = jnp.take_along_axis(sk, pos, axis=1)
+        def one_group(opnds):
+            f_starts, f_lengths, f_weights, f_rows = opnds
+            docs, imps = jax.vmap(jax.vmap(slice_one))(f_starts)
+            valid = idx[None, None, :] < f_lengths[:, :, None]
+            # gid key: row·(d_pad+1)+doc — distinct docs across rows
+            # never merge; padded lanes carry impact 0, drop via total>0
+            gid = (f_rows[:, :, None] * (d_pad + 1)
+                   + jnp.where(valid, docs, d_pad))
+            imp = jnp.where(valid, f_weights[:, :, None] * imps, 0.0)
+            sk, sv = jax.lax.sort(
+                [gid.reshape(b, width), imp.reshape(b, width)],
+                num_keys=1)
+            total = sparse.segmented_run_sum(sk, sv, t_window)
+            run_end = jnp.concatenate(
+                [sk[:, :-1] != sk[:, 1:], jnp.ones((b, 1), bool)],
+                axis=1)
+            ok = run_end & (total > 0.0)
+            score = jnp.where(ok, total, NEG_INF)
+            totals_g = jnp.sum(ok, axis=1).astype(jnp.int32)
+            vals_g, pos = jax.lax.top_k(score, k_dev)
+            gid_g = jnp.take_along_axis(sk, pos, axis=1)
+            return vals_g, gid_g, totals_g
+
+        if n_groups == 1:
+            vals_g, gid_g, totals_g = one_group(
+                (g_starts[0], g_lengths[0], g_weights[0], g_rows[0]))
+            vals_b, gid_local, totals_b = vals_g, gid_g, totals_g
+            cut_local = vals_b[:, -1]
+        else:
+            vals_gs, gid_gs, totals_gs = jax.lax.map(
+                one_group, (g_starts, g_lengths, g_weights, g_rows))
+            # [n_groups, B, k_dev] → [B, n_groups·k_dev]
+            vals_b = jnp.transpose(vals_gs, (1, 0, 2)).reshape(b, -1)
+            gid_local = jnp.transpose(gid_gs, (1, 0, 2)).reshape(b, -1)
+            totals_b = jnp.sum(totals_gs, axis=0)
+            # a doc cut in ANY group fell below ITS group's k_dev-th
+            cut_local = jnp.max(vals_gs[:, :, -1], axis=0)
         # local gid → global gid (row offset by this device's first row)
         gids_b = (gid_local.astype(jnp.int64)
                   + (my * s_l).astype(jnp.int64) * (d_pad + 1))
         gids_b = jnp.where(vals_b > NEG_INF, gids_b, 0)
 
-        # per-device approx cutoff (the k_dev-th value): docs cut HERE
-        # are bounded by it in the validity check
-        row_cut = jax.lax.pmax(vals_b[:, -1], SHARD_AXIS)
+        # per-device/group approx cutoff: docs cut THERE are bounded by
+        # it in the validity check
+        row_cut = jax.lax.pmax(cut_local, SHARD_AXIS)
         all_vals = jax.lax.all_gather(vals_b, SHARD_AXIS, axis=1, tiled=True)
         all_gids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1, tiled=True)
         totals = jax.lax.psum(totals_b, SHARD_AXIS)

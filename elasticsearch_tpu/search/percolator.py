@@ -6,8 +6,7 @@ the `percolator` mapper field — SURVEY.md §2.1#52). Kept contracts:
 the `percolator` mapping type validates and stores a query; the
 {"percolate": {"field": f, "document": {...}}} query matches the docs
 whose stored query matches the document; `documents` (plural) matches
-when ANY of them does, with the matched slots in the response's
-`_percolator_document_slot` field (single-doc slot [0]).
+when ANY of them does.
 
 Divergences (documented): the reference extracts terms from stored
 queries into hidden fields so a candidate pre-filter skips most
@@ -22,9 +21,7 @@ emitted: multi-document percolation matches on ANY document.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
-
-import numpy as np
+from typing import Any, Dict, List
 
 from elasticsearch_tpu.common.errors import IllegalArgumentException
 
@@ -61,11 +58,13 @@ def segment_parsed_queries(segment, field: str):
         segment._percolator_cache = cache
     entry = cache.get(field)
     if entry is None:
+        from elasticsearch_tpu.ingest import get_field
         from elasticsearch_tpu.search import dsl
         entry = {}
         for ord_ in range(segment.num_docs):
             src = segment.stored_source[ord_] or {}
-            spec = src.get(field)
+            # dotted traversal: object-nested percolator fields
+            spec = get_field(src, field)
             if spec is None:
                 continue
             try:

@@ -499,7 +499,11 @@ class SegmentQueryExecutor:
         for ord_, q in queries.items():
             if not live[ord_]:
                 continue
-            qmask, _ = doc_exec._eval(q, scoring=False)
+            try:
+                qmask, _ = doc_exec._eval(q, scoring=False)
+            except Exception:  # noqa: BLE001 — one poisonous stored
+                continue  # query (e.g. type mismatch vs the document's
+                #           dynamic fields) must not break the search
             if bool((np.asarray(qmask)[: len(doc_live)]
                      & doc_live).any()):
                 mask[ord_] = True

@@ -182,3 +182,35 @@ class TestReviewRegressions:
                 "field": "query", "document": {"body": "calm"}}},
             "size": 10})
         assert res["hits"]["total"]["value"] == 0
+
+    def test_poisonous_stored_query_doesnt_break_search(self, alerts):
+        # parses fine, fails at EVAL (range on text) — must no-match,
+        # never 400 the whole percolate
+        _handle(alerts, "PUT", "/alerts/_doc/poison",
+                params={"refresh": "true"},
+                body={"query": {"range": {"body": {"gte": 1}}}})
+        status, res = _handle(alerts, "POST", "/alerts/_search", body={
+            "query": {"percolate": {
+                "field": "query", "document": {"body": "error"}}},
+            "size": 10})
+        assert status == 200, res
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert "errors" in ids and "poison" not in ids
+
+    def test_array_of_queries_rejected(self, alerts):
+        status, _ = _handle(alerts, "PUT", "/alerts/_doc/arr",
+                            body={"query": [{"match": {"body": "a"}},
+                                            {"match": {"body": "b"}}]})
+        assert status == 400
+
+    def test_object_nested_percolator_field(self, node):
+        _handle(node, "PUT", "/np", body={"mappings": {"properties": {
+            "meta": {"properties": {"query": {"type": "percolator"}}},
+            "body": {"type": "text"}}}})
+        _handle(node, "PUT", "/np/_doc/r", params={"refresh": "true"},
+                body={"meta": {"query": {"match": {"body": "boom"}}}})
+        _, res = _handle(node, "POST", "/np/_search", body={
+            "query": {"percolate": {"field": "meta.query",
+                                    "document": {"body": "boom"}}},
+            "size": 10})
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["r"]

@@ -553,3 +553,26 @@ class TestBlockMaxPruning:
                         pack.flat_docs[si, a:b].tolist()
         finally:
             tpu.close()
+
+
+def test_grouped_phase_a_many_segments(svc, seeded_np):
+    """> FUSE_ROWS segment rows exercise the lax.map-grouped phase A
+    (HBM-bounded fusion at MS-MARCO scale); results stay exact."""
+    idx = svc.create_index(
+        "grouped", Settings.of({"index": {"number_of_shards": 1}}),
+        {"properties": {"body": {"type": "text"}}})
+    for i in range(120):
+        n_words = int(seeded_np.integers(3, 10))
+        words = [WORDS[int(w)] for w in
+                 seeded_np.integers(0, len(WORDS), n_words)]
+        shard = idx.shard(idx.shard_for_id(f"d{i}"))
+        shard.apply_index_on_primary(f"d{i}", {"body": " ".join(words)})
+        if i % 11 == 10:
+            idx.flush()  # many small segments → many pack rows
+    idx.refresh()
+    reader = idx.shard(0).acquire_searcher()
+    assert len(reader.views) > 8, "fixture must exceed FUSE_ROWS"
+    fast, slow = both_paths(
+        svc, "grouped",
+        {"query": {"match": {"body": "alpha beta"}}, "size": 40})
+    assert_equivalent(fast, slow)
