@@ -360,34 +360,30 @@ class MapperService:
             # range/completion field VALUES are objects ({gte/lte},
             # {input/weight}) — everything else dict-shaped descends as
             # a plain object
+            known_ft = self.mapper.fields.get(path)
             value_is_object_field = isinstance(
-                self.mapper.fields.get(path),
+                known_ft,
                 (RangeFieldType, CompletionFieldType,
                  GeoPointFieldType, PercolatorFieldType))
             if isinstance(value, dict) and not value_is_object_field:
                 self._parse_object(value, path + ".", parsed,
                                    update_props)
                 continue
-            if isinstance(self.mapper.fields.get(path),
-                          PercolatorFieldType) and \
+            if isinstance(known_ft, PercolatorFieldType) and \
                     isinstance(value, list):
                 raise MapperParsingException(
                     f"[percolator] field [{path}] holds ONE query; "
                     f"arrays of queries are not supported")
-            if isinstance(self.mapper.fields.get(path),
-                          DenseVectorFieldType):
+            if isinstance(known_ft, DenseVectorFieldType):
                 # the ARRAY is the value — never flattened per element
-                self._index_values(self.mapper.fields[path], path,
-                                   [value], parsed)
+                self._index_values(known_ft, path, [value], parsed)
                 continue
-            if isinstance(self.mapper.fields.get(path),
-                          GeoPointFieldType) and \
+            if isinstance(known_ft, GeoPointFieldType) and \
                     isinstance(value, list) and value and \
                     isinstance(value[0], (int, float)):
                 # [lon, lat] is ONE point (GeoJSON order), not a
                 # multi-value array (reference disambiguation rule)
-                self._index_values(self.mapper.fields[path], path,
-                                   [value], parsed)
+                self._index_values(known_ft, path, [value], parsed)
                 continue
             values = value if isinstance(value, list) else [value]
             flat_values = []

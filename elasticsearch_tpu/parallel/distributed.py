@@ -45,7 +45,16 @@ from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
 NEG_INF = float("-inf")
 CHUNK_CAP = 4096  # max postings chunk per slot; flat arrays pad by this much
 FUSE_ROWS = 8     # max segment rows fused into one phase-A sort pool
-#                   (more rows sequence through lax.map — HBM bound)
+# phase-A gather/sort element budget per fused group (× ~8 bytes × a
+# few sort buffers ≈ peak live HBM): the group size derives from this,
+# so wide-slot × big-batch launches shrink their fusion instead of
+# exhausting the 16G chip at MS-MARCO scale
+FUSE_ELEM_BUDGET = 192 * 1024 * 1024
+
+
+def fuse_group_rows(batch_b: int, t_slots: int, max_len: int) -> int:
+    per_row = batch_b * t_slots * max_len
+    return max(1, min(FUSE_ROWS, FUSE_ELEM_BUDGET // max(per_row, 1)))
 
 
 @dataclasses.dataclass
@@ -589,7 +598,7 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
             jnp.arange(s_l, dtype=jnp.int32)[:, None, None],
             starts.shape)                                   # [S_l, B, T]
         starts_abs = starts + row_of_slot * p_pad
-        g = min(FUSE_ROWS, s_l)
+        g = min(fuse_group_rows(b, t, max_len), s_l)
         n_groups = (s_l + g - 1) // g
         pad_rows = n_groups * g - s_l
 

@@ -63,8 +63,11 @@ def segment_parsed_queries(segment, field: str):
         entry = {}
         for ord_ in range(segment.num_docs):
             src = segment.stored_source[ord_] or {}
-            # dotted traversal: object-nested percolator fields
-            spec = get_field(src, field)
+            # literal dotted key first (the flat {"a.b": ...} source
+            # form), then dotted traversal (object-nested form)
+            spec = src.get(field)
+            if spec is None:
+                spec = get_field(src, field)
             if spec is None:
                 continue
             try:

@@ -214,3 +214,15 @@ class TestReviewRegressions:
                                     "document": {"body": "boom"}}},
             "size": 10})
         assert [h["_id"] for h in res["hits"]["hits"]] == ["r"]
+
+    def test_flat_dotted_source_form(self, node):
+        _handle(node, "PUT", "/fd", body={"mappings": {"properties": {
+            "meta": {"properties": {"query": {"type": "percolator"}}},
+            "body": {"type": "text"}}}})
+        _handle(node, "PUT", "/fd/_doc/r", params={"refresh": "true"},
+                body={"meta.query": {"match": {"body": "boom"}}})
+        _, res = _handle(node, "POST", "/fd/_search", body={
+            "query": {"percolate": {"field": "meta.query",
+                                    "document": {"body": "boom"}}},
+            "size": 10})
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["r"]
