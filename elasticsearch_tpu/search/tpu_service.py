@@ -1173,29 +1173,51 @@ class TpuSearchService:
             for b_bucket in buckets:
                 for k in (10, PRUNE_MAX_K):
                     table.append((b_bucket, k, None, PREFIX_CAP3))
-            for b_bucket, k, slots, cap in table:
+            # prewarm is BEST-EFFORT per signature: one kernel that the
+            # backend cannot compile at this pack's shapes (observed:
+            # the compile helper dying on the exact kernel at MS-MARCO
+            # scale) must not abort the warmer — serving degrades that
+            # one path to the planner, the rest stay kernel-served
+            consecutive_failures = [0]
+
+            def warm_one(entry, run):
+                if consecutive_failures[0] >= 3:
+                    entry["error"] = "skipped: systemic prewarm failure"
+                    compiled.append(entry)
+                    return
                 t1 = time.perf_counter()
-                _execute_pruned(resident, [flat] * b_bucket, k,
-                                self.packs.mesh,
-                                prefix_cap=cap or PREFIX_CAP2,
-                                full_slots=slots)
-                compiled.append({"batch": b_bucket, "k": k,
-                                 "slots": slots, "prefix": cap,
-                                 "seconds": round(
-                                     time.perf_counter() - t1, 2)})
+                try:
+                    run()
+                    consecutive_failures[0] = 0
+                except Exception as exc:  # noqa: BLE001 — record, go on
+                    entry["error"] = f"{type(exc).__name__}: {exc}"[:160]
+                    consecutive_failures[0] += 1
+                    logger.warning("prewarm %s failed: %s", entry, exc)
+                finally:
+                    # failures carry their cost too (a 90s compile that
+                    # dies is exactly what the warmer must surface)
+                    entry["seconds"] = round(time.perf_counter() - t1, 2)
+                compiled.append(entry)
+
+            for b_bucket, k, slots, cap in table:
+                warm_one({"batch": b_bucket, "k": k, "slots": slots,
+                          "prefix": cap},
+                         lambda b_bucket=b_bucket, k=k, slots=slots,
+                         cap=cap: _execute_pruned(
+                             resident, [flat] * b_bucket, k,
+                             self.packs.mesh,
+                             prefix_cap=cap or PREFIX_CAP2,
+                             full_slots=slots))
             # exact kernel (msm/AND tier 1, OR tier 3) at its common
             # bucketed signatures; with_counts=True via min_count=2.
             # Hot-term slot buckets (t_slots > 8) compile once ever and
             # persist in the compilation cache.
             flat_and = FlatQuery(flat.field, flat.terms * 2, 1.0, 2)
             for b_bucket, k in ((8, 10), (64, PRUNE_MAX_K)):
-                t1 = time.perf_counter()
-                _execute_exact(resident, [flat_and] * b_bucket, k,
-                               self.packs.mesh)
-                compiled.append({"batch": b_bucket, "k": k,
-                                 "exact": True,
-                                 "seconds": round(
-                                     time.perf_counter() - t1, 2)})
+                warm_one({"batch": b_bucket, "k": k, "exact": True},
+                         lambda b_bucket=b_bucket, k=k: _execute_exact(
+                             resident, [flat_and] * b_bucket, k,
+                             self.packs.mesh))
         return {"pack_seconds": round(t_pack, 2), "compiled": compiled,
                 "total_seconds": round(time.perf_counter() - t0, 2)}
 
