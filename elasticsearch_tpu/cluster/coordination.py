@@ -51,6 +51,11 @@ ACTION_PING = "cluster/coord/ping"
 
 CANDIDATE, LEADER, FOLLOWER = "CANDIDATE", "LEADER", "FOLLOWER"
 
+#: publish/commit sends to one node retry this many times total on
+#: transport failure (0.05s base, doubling, 0.5s cap) before the
+#: publication timeout decides the node's fate
+PUBLISH_RESEND_ATTEMPTS = 3
+
 
 class FailedToCommitException(Exception):
     """Publication could not reach a voting quorum (reference:
@@ -489,9 +494,29 @@ class Coordinator:
                                                  on_timeout)
         self._publish_timeout = timeout_handle
 
-        def send_to(n, payload) -> None:
+        def send_to(n, payload, attempt: int = 0) -> None:
             def ack(ok: bool, result: Any) -> None:
-                if (ok and result and result.get("need_full")
+                if not ok:
+                    # transport-level failure (never an application
+                    # reject — those come back ok=True with
+                    # accepted=False): bounded exponential-backoff
+                    # resend on the scheduler seam, so the sim steps it
+                    # deterministically (reference: RetryableAction
+                    # inside Publication's ack listeners). The publish
+                    # timeout still owns giving up on the node.
+                    if attempt + 1 >= PUBLISH_RESEND_ATTEMPTS:
+                        return
+                    with self.lock:
+                        abandoned = (committed[0] or self._stopped
+                                     or self.mode != LEADER
+                                     or self.current_term != pub_term)
+                    if abandoned:
+                        return
+                    delay = min(0.5, 0.05 * (2 ** attempt))
+                    self.scheduler.schedule(
+                        delay, lambda: send_to(n, payload, attempt + 1))
+                    return
+                if (result and result.get("need_full")
                         and "diff" in payload):
                     # receiver's accepted base didn't match the diff —
                     # re-send the full state (reference:

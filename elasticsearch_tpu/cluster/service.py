@@ -40,9 +40,13 @@ from elasticsearch_tpu.cluster.state import (INITIALIZING, STARTED,
                                              IndexMeta, ShardRouting)
 from elasticsearch_tpu.common.errors import (EsException,
                                              IllegalArgumentException,
-                                             IndexNotFoundException)
+                                             IndexNotFoundException,
+                                             NoShardAvailableActionException,
+                                             shard_failure_entry)
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.translog import write_atomic
+from elasticsearch_tpu.transport.retry import (RetryPolicy, is_retryable,
+                                               send_with_retry)
 from elasticsearch_tpu.transport.service import (ConnectTransportException,
                                                  RemoteTransportException,
                                                  TransportService)
@@ -190,6 +194,10 @@ class _CoordTransport:
         def cb(f: Future) -> None:
             exc = f.exception()
             if exc is not None:
+                if is_retryable(exc):
+                    # a dead pooled connection must not poison the
+                    # coordinator's resend — next attempt dials fresh
+                    self.ts.evict(tuple(address))
                 on_done(False, None)
             else:
                 on_done(True, f.result())
@@ -1387,17 +1395,23 @@ class ClusterService:
 
     def _route_shards(self, names: List[str]
                       ) -> Tuple[Dict[str, List[Tuple[str, int]]],
-                                 Dict[str, Tuple[str, int]], int]:
+                                 Dict[str, Tuple[str, int]],
+                                 List[Tuple[str, int]],
+                                 Dict[Tuple[str, int], List[str]]]:
         """→ (node_id → [(index, shard)], node_id → address,
-        failed_shard_count). Any STARTED copy may serve a read —
-        replicas included — ranked by the node-latency EWMA (ARS-lite:
-        OperationRouting#searchShards + ResponseCollectorService,
-        SURVEY.md §2.1#19); copies on unmeasured nodes rotate
-        round-robin so load spreads until measurements exist."""
+        unassigned [(index, shard)] with no live copy,
+        (index, shard) → ARS-ranked node_ids of EVERY live copy).
+        Any STARTED copy may serve a read — replicas included — ranked
+        by the node-latency EWMA (ARS-lite: OperationRouting#
+        searchShards + ResponseCollectorService, SURVEY.md §2.1#19);
+        copies on unmeasured nodes rotate round-robin so load spreads
+        until measurements exist. The full ranked list backs per-shard
+        failover: a failed copy retries on the next-ranked one."""
         state = self.applied_state()
         by_node: Dict[str, List[Tuple[str, int]]] = {}
         addr: Dict[str, Tuple[str, int]] = {}
-        failed = 0
+        unassigned: List[Tuple[str, int]] = []
+        ranked_copies: Dict[Tuple[str, int], List[str]] = {}
         with self._ars_lock:
             ewma = dict(self._node_ewma)
             self._ars_rr += 1
@@ -1410,7 +1424,7 @@ class ClusterService:
                 copies = [c for c in state.shard_copies(name, shard)
                           if c.state == STARTED and c.node_id in state.nodes]
                 if not copies:
-                    failed += 1
+                    unassigned.append((name, shard))
                     continue
                 def ars_rank(ic):
                     i, c = ic
@@ -1421,10 +1435,49 @@ class ClusterService:
                     bucket = -1 if e is None else int(e * 100)
                     return (bucket, (i + rr) % len(copies))
 
-                chosen = min(enumerate(copies), key=ars_rank)[1]
-                by_node.setdefault(chosen.node_id, []).append((name, shard))
-                addr[chosen.node_id] = state.nodes[chosen.node_id].address
-        return by_node, addr, failed
+                order = sorted(enumerate(copies), key=ars_rank)
+                ranked = []
+                for _i, c in order:
+                    if c.node_id not in ranked:
+                        ranked.append(c.node_id)
+                    addr[c.node_id] = state.nodes[c.node_id].address
+                ranked_copies[(name, shard)] = ranked
+                by_node.setdefault(ranked[0], []).append((name, shard))
+        return by_node, addr, unassigned, ranked_copies
+
+    #: failover fan-out retry budget: a dead peer burns at most this
+    #: many seconds of backoff before its shards move to another copy
+    FANOUT_RETRY = RetryPolicy(initial_delay=0.05, max_delay=0.5,
+                               deadline=2.0)
+
+    def _run_shard_group(self, node_id: str, addr: Dict[str, Tuple[str, int]],
+                         targets: List[Tuple[str, int]],
+                         body, params, alias_filters,
+                         retry: bool = False) -> Dict[str, Any]:
+        """Execute one query group on `node_id` — inline for the local
+        node, over transport otherwise (with bounded backoff retries on
+        connection faults when `retry` is set)."""
+        from elasticsearch_tpu.search import coordinator as coord
+        if node_id == self.local_node.node_id:
+            l0 = time.perf_counter()
+            out = coord.search_shard_group(
+                self.node.indices, targets, body, params,
+                tpu_search=self.node.tpu_search,
+                index_filters=alias_filters)
+            self.record_node_latency(node_id, time.perf_counter() - l0)
+            return out
+        payload = {"targets": targets, "body": body, "params": params,
+                   "index_filters": alias_filters}
+        r0 = time.perf_counter()
+        if retry:
+            out = send_with_retry(self.transport, addr[node_id],
+                                  ACTION_QUERY_GROUP, payload,
+                                  policy=self.FANOUT_RETRY)
+        else:
+            out = self.transport.send_request(
+                addr[node_id], ACTION_QUERY_GROUP, payload, timeout=60.0)
+        self.record_node_latency(node_id, time.perf_counter() - r0)
+        return out
 
     def route_search(self, index_expr: Optional[str],
                      body: Optional[Dict[str, Any]],
@@ -1436,12 +1489,15 @@ class ClusterService:
         # validates the body once on the coordinating node (400 before
         # any fan-out, reference behavior)
         coord.parse_search_body(body or {})
-        by_node, addr, failed = self._route_shards(names)
-
+        by_node, addr, unassigned, ranked_copies = self._route_shards(names)
+        failures: List[Dict[str, Any]] = [
+            shard_failure_entry(n, s, NoShardAvailableActionException(
+                f"no active shard copy for [{n}][{s}]"))
+            for n, s in unassigned]
+        knn_failed = 0
         if body and body.get("knn") is not None:
             body, knn_failed = self._resolve_knn_phase(
                 body, by_node, addr, alias_filters)
-            failed += knn_failed
 
         futures: List[Tuple[str, Any]] = []
         local_targets: Optional[List[Tuple[str, int]]] = None
@@ -1455,32 +1511,94 @@ class ClusterService:
                  "index_filters": alias_filters})
             futures.append((node_id, fut))
 
+        # gather; a failed copy — whole group OR single shard inside a
+        # group response — goes to the failover queue instead of
+        # counting failed outright (reference:
+        # AbstractSearchAsyncAction#performPhaseOnShard retries the
+        # next copy from the shard iterator)
         groups: List[Dict[str, Any]] = []
+        retry_q: Dict[Tuple[str, int], Dict[str, Any]] = {}  # → failure
+        tried: Dict[Tuple[str, int], Set[str]] = {}          # → node_ids
+
+        def absorb(group: Dict[str, Any], node_id: str) -> None:
+            """Keep a group's surviving partial result; its per-shard
+            failures queue for failover on another copy."""
+            for f in group.pop("failures", []):
+                key = (f["index"], int(f["shard"]))
+                tried.setdefault(key, set()).add(node_id)
+                retry_q[key] = dict(f, node=node_id)
+            groups.append(group)
+
+        def group_failed(node_id: str, targets, exc: Exception) -> None:
+            # a failed/slow node ranks last until it recovers; a dead
+            # pooled connection must not poison the retry
+            self.record_node_latency(node_id, 60.0)
+            if is_retryable(exc):
+                self.transport.evict(addr[node_id])
+            for name, shard in targets:
+                key = (name, int(shard))
+                tried.setdefault(key, set()).add(node_id)
+                retry_q[key] = shard_failure_entry(
+                    name, int(shard), exc, node=node_id)
+
         if local_targets is not None:
-            l0 = time.perf_counter()
-            groups.append(coord.search_shard_group(
-                self.node.indices, local_targets, body, params,
-                tpu_search=self.node.tpu_search,
-                index_filters=alias_filters))
-            self.record_node_latency(self.local_node.node_id,
-                                     time.perf_counter() - l0)
+            absorb(self._run_shard_group(
+                self.local_node.node_id, addr, local_targets, body,
+                params, alias_filters), self.local_node.node_id)
         for node_id, fut in futures:
             if task is not None:
                 task.ensure_not_cancelled()
             r0 = time.perf_counter()
             try:
-                groups.append(fut.result(timeout=60.0))
+                absorb(fut.result(timeout=60.0), node_id)
                 self.record_node_latency(node_id,
                                          time.perf_counter() - r0)
             except Exception as exc:  # noqa: BLE001 — shard-group failure
-                n = len(by_node.get(node_id, []))
-                failed += n
-                # a failed/slow node ranks last until it recovers
-                self.record_node_latency(node_id, 60.0)
                 logger.warning("search group on [%s] failed: %s",
                                node_id, exc)
+                group_failed(node_id, by_node.get(node_id, []), exc)
+
+        # failover rounds: each still-failed shard moves to its best
+        # untried copy until copies run out (tried sets grow every
+        # round, so this terminates)
+        while retry_q:
+            if task is not None:
+                task.ensure_not_cancelled()
+            round_nodes: Dict[str, List[Tuple[str, int]]] = {}
+            for key, entry in list(retry_q.items()):
+                cands = [nid for nid in ranked_copies.get(key, [])
+                         if nid not in tried.get(key, set())]
+                if not cands:
+                    failures.append(entry)
+                    del retry_q[key]
+                    continue
+                # local copy first (no network), then ARS rank
+                nid = (self.local_node.node_id
+                       if self.local_node.node_id in cands else cands[0])
+                tried.setdefault(key, set()).add(nid)
+                round_nodes.setdefault(nid, []).append(key)
+            for node_id, targets in sorted(round_nodes.items()):
+                try:
+                    group = self._run_shard_group(
+                        node_id, addr, targets, body, params,
+                        alias_filters, retry=True)
+                except Exception as exc:  # noqa: BLE001 — next copy
+                    group_failed(node_id, targets, exc)
+                    continue
+                for key in targets:
+                    retry_q.pop(key, None)
+                absorb(group, node_id)
+                logger.info("failover: %d shard(s) retried on [%s]",
+                            len(targets), node_id)
+
+        check = getattr(coord, "check_shard_failures", None)
+        if check is not None:
+            successful = sum(g.get("shards", 0) for g in groups)
+            check(failures, successful,
+                  coord.allow_partial_results(params))
         return coord.merge_group_responses(groups, body, params, t0,
-                                           failed_shards=failed)
+                                           failed_shards=knn_failed,
+                                           failures=failures)
 
     def _handle_remote_search(self, payload, from_node) -> Dict[str, Any]:
         """CCS target side (reference: the remote half of
@@ -1590,7 +1708,8 @@ class ClusterService:
         from elasticsearch_tpu.search import dsl
         names, alias_filters = self.resolve_targets(index_expr)
         dsl.parse_query((body or {}).get("query") or {"match_all": {}})
-        by_node, addr, failed = self._route_shards(names)
+        by_node, addr, unassigned, _copies = self._route_shards(names)
+        failed = len(unassigned)
         total = 0
         ok_shards = 0
         futures = []

@@ -127,12 +127,52 @@ class TaskCancelledException(EsException):
     status = 400
 
 
+def exception_type_name(exc: BaseException) -> str:
+    """Snake-case wire name of any exception class, matching the
+    reference's `ElasticsearchException.getExceptionName` (used for the
+    ``reason.type`` of shard failures raised by non-EsException code)."""
+    if isinstance(exc, EsException):
+        return exc.error_type
+    name = type(exc).__name__
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+def shard_failure_entry(index: str, shard: int, exc: BaseException,
+                        node: Optional[str] = None) -> Dict[str, Any]:
+    """One `_shards.failures[]` element (reference: ShardSearchFailure
+    xcontent — shard, index, optional node, nested reason)."""
+    reason = (exc.to_xcontent() if isinstance(exc, EsException)
+              else {"type": exception_type_name(exc), "reason": str(exc)})
+    entry: Dict[str, Any] = {"shard": shard, "index": index,
+                             "reason": reason,
+                             "status": (int(getattr(exc, "status", 503))
+                                        if isinstance(exc, EsException)
+                                        else 503)}
+    if node is not None:
+        entry["node"] = node
+    return entry
+
+
 class SearchPhaseExecutionException(EsException):
     status = 503
 
     def __init__(self, phase: str, reason: str, shard_failures: Optional[list] = None):
         super().__init__(reason, phase=phase, grouped=True)
         self.shard_failures = shard_failures or []
+        # status derives from the shard failures (reference:
+        # SearchPhaseExecutionException#status): a parse error that hit
+        # every shard is the CLIENT's 400, not a cluster 503; any
+        # 5xx-class failure keeps the 503.
+        statuses = [f.get("status", 503) for f in self.shard_failures
+                    if isinstance(f, dict)]
+        if statuses:
+            self.status = (503 if any(s >= 500 for s in statuses)
+                           else statuses[0])
 
     def to_xcontent(self) -> Dict[str, Any]:
         body = super().to_xcontent()
@@ -140,6 +180,13 @@ class SearchPhaseExecutionException(EsException):
             f.to_xcontent() if isinstance(f, EsException) else f for f in self.shard_failures
         ]
         return body
+
+
+class NoShardAvailableActionException(EsException):
+    """No STARTED copy of a shard exists to serve the request
+    (reference: action/NoShardAvailableActionException)."""
+
+    status = 503
 
 
 class NotMasterException(EsException):

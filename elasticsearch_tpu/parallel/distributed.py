@@ -40,7 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from elasticsearch_tpu.index.pack import LANE, _pad_to
 from elasticsearch_tpu.index.segment import Segment
 from elasticsearch_tpu.ops import sparse
-from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+from elasticsearch_tpu.parallel.mesh import (DATA_AXIS, SHARD_AXIS,
+                                             shard_map)
 
 NEG_INF = float("-inf")
 CHUNK_CAP = 4096  # max postings chunk per slot; flat arrays pad by this much
@@ -479,12 +480,11 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
 
     spec_post = P(SHARD_AXIS, None)
     spec_sbt = P(SHARD_AXIS, DATA_AXIS, None)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(spec_post, spec_post, spec_sbt, spec_sbt, spec_sbt,
                   P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
-        check_vma=False)
+        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)))
     return jax.jit(mapped)
 
 
@@ -744,11 +744,10 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
 
     spec_post = P(SHARD_AXIS, None)
     spec_sbt = P(SHARD_AXIS, DATA_AXIS, None)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(spec_post, spec_post, spec_post, spec_post, spec_sbt),
-        out_specs=P(DATA_AXIS, None),
-        check_vma=False)
+        out_specs=P(DATA_AXIS, None))
     return jax.jit(mapped)
 
 
@@ -977,12 +976,11 @@ def make_distributed_knn(mesh: Mesh, *, d_pad: int, dims: int, k: int,
                                      tiled=True)
         return _merge_topk(all_vals, all_ids, k)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(SHARD_AXIS, None, None), P(SHARD_AXIS, None),
                   P(None, None)),
-        out_specs=(P(None, None), P(None, None)),
-        check_vma=False)
+        out_specs=(P(None, None), P(None, None)))
     return jax.jit(mapped)
 
 
@@ -1083,12 +1081,11 @@ def make_term_sharded_search(mesh: Mesh, *, n_docs_pad: int, k: int):
         docs = jnp.where(vals > NEG_INF, docs, n_docs_pad)
         return vals, docs
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(SHARD_AXIS, None, None), P(SHARD_AXIS, None, None),
                   P(SHARD_AXIS, None, None), P(SHARD_AXIS, None, None)),
-        out_specs=(P(None, None), P(None, None)),
-        check_vma=False)
+        out_specs=(P(None, None), P(None, None)))
     return jax.jit(mapped)
 
 
@@ -1153,12 +1150,11 @@ def make_split_row_topk(mesh: Mesh, *, block: int, k: int,
         out_ids = jnp.where(out_v > NEG_INF, out_ids, d_pad)
         return out_v, out_ids
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
                   P(SHARD_AXIS, None)),
-        out_specs=(P(None), P(None)),
-        check_vma=False)
+        out_specs=(P(None), P(None)))
     return jax.jit(mapped)
 
 

@@ -26,6 +26,19 @@ DATA_AXIS = "data"
 SHARD_AXIS = "shards"
 
 
+def shard_map(body, *, mesh: Mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions: new jax exposes it top-level
+    with `check_vma`; 0.4.x has it under `jax.experimental` with the
+    older `check_rep` spelling. Replication checking stays off either
+    way (the kernels' collectives are hand-placed)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 def factorize_2d(n: int) -> Tuple[int, int]:
     """(data, shards) grid for n devices: favor the shards axis (search
     scales with document partitions first), keep data as the largest

@@ -79,6 +79,12 @@ def error_status(exc: Exception) -> int:
 
 
 def error_body(exc: Exception, status: int) -> Dict[str, Any]:
+    if isinstance(exc, es_errors.EsException):
+        # structured rendering (type/reason plus metadata such as a
+        # SearchPhaseExecutionException's phase and failed_shards)
+        body = exc.to_xcontent()
+        cause = {"type": body["type"], "reason": body["reason"]}
+        return {"error": {"root_cause": [cause], **body}, "status": status}
     t = type(exc).__name__
     # CamelCase → snake_case exception type names like the reference
     snake = re.sub(r"(?<!^)(?=[A-Z])", "_", t).lower()

@@ -12,10 +12,12 @@ reduce ops over the same dense mask the query planner produced:
 
 Shapes are bucketed to powers of two so the jit cache stays small, and
 each pack view caches its device-resident columns (first agg query per
-segment pays the transfer, steady state reads HBM). Aggregators fall
-back to the host numpy path when the device can't express the request
-(multi-valued extras, sub-aggregations needing per-bucket masks,
-calendar intervals)."""
+segment pays the transfer, steady state reads HBM). Calendar intervals
+run on device via host-precomputed bucket boundaries (bucket.py), and
+one-level sub-aggregations run as per-bucket masked reductions here;
+aggregators fall back to the host numpy path only when the device
+can't express the request (multi-valued extras, deeper sub-agg
+nesting)."""
 
 from __future__ import annotations
 

@@ -17,14 +17,55 @@ import fnmatch
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from elasticsearch_tpu.common.errors import (IllegalArgumentException,
-                                             IndexNotFoundException)
+import logging
+
+from elasticsearch_tpu.common.errors import (CircuitBreakingException,
+                                             EsRejectedExecutionException,
+                                             IllegalArgumentException,
+                                             IndexNotFoundException,
+                                             SearchPhaseExecutionException,
+                                             TaskCancelledException,
+                                             shard_failure_entry)
 from elasticsearch_tpu.indices.service import IndicesService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
                                                    parse_aggregations)
 from elasticsearch_tpu.search.query_phase import (ShardHit, execute_fetch,
-                                                  execute_query)
+                                                  execute_query, fault_check)
+
+logger = logging.getLogger("elasticsearch_tpu.search.coordinator")
+
+#: failures that must abort the whole request rather than degrade to a
+#: per-shard failure: cancellation is the caller's decision, and breaker
+#: / executor rejections must surface as 429s (reference: the breaker
+#: trips BEFORE work is admitted, it is not a shard fault)
+_NON_DEGRADABLE = (TaskCancelledException, CircuitBreakingException,
+                   EsRejectedExecutionException)
+
+
+def allow_partial_results(params: Optional[Dict[str, str]]) -> bool:
+    """`allow_partial_search_results` query param (reference default:
+    true — a search survives individual shard failures and reports them
+    in `_shards.failures`)."""
+    raw = (params or {}).get("allow_partial_search_results", "true")
+    return str(raw).lower() not in ("false", "0", "no")
+
+
+def check_shard_failures(failures: List[Dict[str, Any]], successful: int,
+                         allow_partial: bool, phase: str = "query") -> None:
+    """Reference AbstractSearchAsyncAction#onPhaseFailure semantics:
+    every shard failing — or any shard failing when partial results are
+    disallowed — raises SearchPhaseExecutionException (503) instead of
+    returning a degraded 200."""
+    if not failures:
+        return
+    if successful == 0:
+        raise SearchPhaseExecutionException(phase, "all shards failed",
+                                            failures)
+    if not allow_partial:
+        raise SearchPhaseExecutionException(
+            phase, "Search rejected due to failed shards "
+            "[allow_partial_search_results=false]", failures)
 
 
 def _is_closed(entry) -> bool:
@@ -344,22 +385,45 @@ def search(indices: IndicesService, index_expr: Optional[str],
             and not any(k in body for k in ("sort", "search_after",
                                             "highlight", "suggest",
                                             "rescore", "collapse"))):
-        fast = _search_fast(indices, names, query, tpu_search,
-                            size=size, from_=from_, min_score=min_score,
-                            source=source, t0=t0,
-                            version=bool(body.get("version")),
-                            seq_no_primary_term=bool(
-                                body.get("seq_no_primary_term")),
-                            ctx=ctx)
+        try:
+            fast = _search_fast(indices, names, query, tpu_search,
+                                size=size, from_=from_,
+                                min_score=min_score,
+                                source=source, t0=t0,
+                                version=bool(body.get("version")),
+                                seq_no_primary_term=bool(
+                                    body.get("seq_no_primary_term")),
+                                ctx=ctx)
+        except _NON_DEGRADABLE:
+            raise
+        except Exception:  # noqa: BLE001 — degrade to the planner path
+            # a kernel-path fault must not kill the request: the planner
+            # below re-runs it with per-shard failure capture
+            logger.warning("kernel fast path failed; falling back to "
+                           "the planner", exc_info=True)
+            fast = None
         if fast is not None:
             return fast
 
     # ---- query phase: every shard of every target index ----
+    # each shard executes under failure capture (reference:
+    # AbstractSearchAsyncAction#onShardFailure) — one copy throwing
+    # degrades to a `_shards.failures[]` entry, never a lost request
     shard_results = []   # (index_name, shard_num, reader, QuerySearchResult)
+    failures: List[Dict[str, Any]] = []
+    allow_partial = allow_partial_results(params)
     total = 0
     timed_out = False
     skipped = 0
-    n_shards_expected = sum(len(indices.index(n).shards) for n in names)
+    if pinned is not None:
+        # scroll/PIT accounting is over the SNAPSHOT's shards: copies
+        # that left the registry since the context opened are not
+        # "expected", copies missing from the snapshot are failures
+        name_set = set(names)
+        n_shards_expected = sum(1 for (n, _s) in pinned if n in name_set)
+    else:
+        n_shards_expected = sum(len(indices.index(n).shards)
+                                for n in names)
     query_nanos: Dict[Tuple[str, int], int] = {}
     from elasticsearch_tpu.search.can_match import can_match
     for name in names:
@@ -373,56 +437,67 @@ def search(indices: IndicesService, index_expr: Optional[str],
                 reader = pinned.get((name, shard_num))
                 if reader is None:
                     continue  # shard not part of the pinned snapshot
-            else:
-                reader = shard.acquire_searcher()
-            if knn_wrap is not None:
-                # union the shard's pinned knn winners with the text
-                # query (None base when the request had knn only)
-                sets = knn_wrap.get((name, shard_num), [])
-                if knn_only and not sets:
-                    skipped += 1  # nothing can match on this shard
-                    continue
-                from elasticsearch_tpu.search.knn import wrap_query
-                shard_query = wrap_query(
-                    None if knn_only else eff_query, sets)
-            else:
-                shard_query = eff_query
-                if not can_match(reader, eff_query, svc.mapper):
-                    skipped += 1  # disjoint range stats: skip the shard
-                    continue
-            q0 = time.perf_counter()
-            # the rescore window may exceed the response window
-            k_shard = size + from_
-            if rescore_specs:
-                k_shard = max(k_shard,
-                              max(s.window_size for s in rescore_specs))
-            if collapse_field:
-                # exact grouped top-N per shard (no candidate-depth cap;
-                # a dominating key can't starve later groups)
-                from elasticsearch_tpu.search.collapse import \
-                    collapse_top_groups
-                from elasticsearch_tpu.search.query_phase import \
-                    QuerySearchResult
-                pairs, total_sh = collapse_top_groups(
-                    reader, shard_query, collapse_field, size + from_)
-                res = QuerySearchResult(
-                    [h for h, _ in pairs], total_sh,
-                    pairs[0][0].score if pairs else None)
-                if aggs is not None:
-                    res.aggregations = execute_query(
-                        reader, shard_query, size=0, aggs=aggs,
-                        ctx=ctx).aggregations
-            else:
-                res = execute_query(reader, shard_query, size=k_shard,
-                                    from_=0,
-                                    min_score=min_score, aggs=aggs,
-                                    sort_specs=sort_specs or None,
-                                    search_after=search_after, ctx=ctx)
-            if rescore_specs:
-                from elasticsearch_tpu.search.rescore import \
-                    rescore_shard_hits
-                res.hits = rescore_shard_hits(reader, res.hits,
-                                              rescore_specs)
+            try:
+                fault_check(name, shard_num, "query")
+                if pinned is None:
+                    reader = shard.acquire_searcher()
+                if knn_wrap is not None:
+                    # union the shard's pinned knn winners with the text
+                    # query (None base when the request had knn only)
+                    sets = knn_wrap.get((name, shard_num), [])
+                    if knn_only and not sets:
+                        skipped += 1  # nothing can match on this shard
+                        continue
+                    from elasticsearch_tpu.search.knn import wrap_query
+                    shard_query = wrap_query(
+                        None if knn_only else eff_query, sets)
+                else:
+                    shard_query = eff_query
+                    if not can_match(reader, eff_query, svc.mapper):
+                        skipped += 1  # disjoint range stats: skip
+                        continue
+                q0 = time.perf_counter()
+                # the rescore window may exceed the response window
+                k_shard = size + from_
+                if rescore_specs:
+                    k_shard = max(k_shard,
+                                  max(s.window_size
+                                      for s in rescore_specs))
+                if collapse_field:
+                    # exact grouped top-N per shard (no candidate-depth
+                    # cap; a dominating key can't starve later groups)
+                    from elasticsearch_tpu.search.collapse import \
+                        collapse_top_groups
+                    from elasticsearch_tpu.search.query_phase import \
+                        QuerySearchResult
+                    pairs, total_sh = collapse_top_groups(
+                        reader, shard_query, collapse_field, size + from_)
+                    res = QuerySearchResult(
+                        [h for h, _ in pairs], total_sh,
+                        pairs[0][0].score if pairs else None)
+                    if aggs is not None:
+                        res.aggregations = execute_query(
+                            reader, shard_query, size=0, aggs=aggs,
+                            ctx=ctx).aggregations
+                else:
+                    res = execute_query(reader, shard_query, size=k_shard,
+                                        from_=0,
+                                        min_score=min_score, aggs=aggs,
+                                        sort_specs=sort_specs or None,
+                                        search_after=search_after,
+                                        ctx=ctx)
+                if rescore_specs:
+                    from elasticsearch_tpu.search.rescore import \
+                        rescore_shard_hits
+                    res.hits = rescore_shard_hits(reader, res.hits,
+                                                  rescore_specs)
+            except _NON_DEGRADABLE:
+                raise
+            except Exception as e:  # noqa: BLE001 — per-shard capture
+                logger.debug("shard [%s][%d] query phase failed",
+                             name, shard_num, exc_info=True)
+                failures.append(shard_failure_entry(name, shard_num, e))
+                continue
             elapsed = time.perf_counter() - q0
             query_nanos[(name, shard_num)] = int(elapsed * 1e9)
             if svc.search_slowlog.enabled:
@@ -434,6 +509,8 @@ def search(indices: IndicesService, index_expr: Optional[str],
             total += res.total_hits
         if timed_out:
             break
+    check_shard_failures(failures, len(shard_results) + skipped,
+                         allow_partial, "query")
 
     # ---- merge top-k: by sort key when sorting, else score desc; ties
     # toward lower index/shard order then rank (reference merge order) ----
@@ -477,29 +554,49 @@ def search(indices: IndicesService, index_expr: Optional[str],
     want_version = bool(body.get("version"))
     want_seqno = bool(body.get("seq_no_primary_term"))
     fetch_nanos: Dict[Tuple[str, int], int] = {}
+    fetch_failed: set = set()
     for si, hits in by_shard.items():
         # fetch against the SAME reader the query phase scored on —
         # a refresh in between must not remap doc ordinals
         name, shard_num, reader, _ = shard_results[si]
         f0 = time.perf_counter()
-        for hit, doc in zip(hits, execute_fetch(
-                reader, hits, fetch_source, version=want_version,
-                seq_no_primary_term=want_seqno)):
-            doc["_index"] = name
-            if highlight_spec is not None:
-                from elasticsearch_tpu.search.highlight import \
-                    build_highlights
-                # highlight the REQUEST query only — alias filters
-                # select docs, they are not something the user searched
-                hl = build_highlights(query, doc.get("_source"),
-                                      highlight_spec)
-                if hl:
-                    doc["highlight"] = hl
-                if source is False:
-                    doc.pop("_source", None)
-            fetched[(si, hit.doc_id)] = doc
+        try:
+            fault_check(name, shard_num, "fetch")
+            for hit, doc in zip(hits, execute_fetch(
+                    reader, hits, fetch_source, version=want_version,
+                    seq_no_primary_term=want_seqno)):
+                doc["_index"] = name
+                if highlight_spec is not None:
+                    from elasticsearch_tpu.search.highlight import \
+                        build_highlights
+                    # highlight the REQUEST query only — alias filters
+                    # select docs, they are not something the user
+                    # searched
+                    hl = build_highlights(query, doc.get("_source"),
+                                          highlight_spec)
+                    if hl:
+                        doc["highlight"] = hl
+                    if source is False:
+                        doc.pop("_source", None)
+                fetched[(si, hit.doc_id)] = doc
+        except _NON_DEGRADABLE:
+            raise
+        except Exception as e:  # noqa: BLE001 — per-shard capture
+            logger.debug("shard [%s][%d] fetch phase failed",
+                         name, shard_num, exc_info=True)
+            failures.append(shard_failure_entry(name, shard_num, e))
+            fetch_failed.add(si)
+            fetched = {k: v for k, v in fetched.items() if k[0] != si}
+            continue
         fetch_nanos[(name, shard_num)] = int(
             (time.perf_counter() - f0) * 1e9)
+    if fetch_failed:
+        # a shard that lost its fetch phase contributes NO hits and
+        # counts failed, even though its query phase ran
+        window = [e for e in window if e[1] not in fetch_failed]
+        check_shard_failures(
+            failures, len(shard_results) - len(fetch_failed) + skipped,
+            allow_partial, "fetch")
     hits_json = []
     for _key, si, _, hit in window:
         doc = fetched.get((si, hit.doc_id), {"_id": hit.doc_id})
@@ -522,16 +619,20 @@ def search(indices: IndicesService, index_expr: Optional[str],
                 doc["_score"] = hit.score
     else:
         max_score = -merged[0][0] if merged else None
+    shards_json: Dict[str, Any] = {
+        "total": n_shards_expected,
+        "successful": len(shard_results) - len(fetch_failed) + skipped,
+        "skipped": skipped,
+        "failed": len(failures)}
+    if failures:
+        shards_json["failures"] = failures
     out: Dict[str, Any] = {
         "took": int((time.perf_counter() - t0) * 1000),
         "timed_out": timed_out,
         # total reflects every targeted shard even when the deadline
         # stopped the scan early (successful = actually visited; skipped
         # shards count as successful, reference can_match semantics)
-        "_shards": {"total": n_shards_expected,
-                    "successful": len(shard_results) + skipped,
-                    "skipped": skipped,
-                    "failed": 0},
+        "_shards": shards_json,
         "hits": {"total": {"value": total,
                            "relation": "gte" if timed_out else "eq"},
                  "max_score": max_score,
@@ -809,6 +910,7 @@ def search_shard_group(indices: IndicesService,
     # group, so this is the common case)
     shard_results = []
     agg_parts = []   # one partial per executed shard, hits or not
+    group_failures: List[Dict[str, Any]] = []
     group_skipped = 0
     group_query_nanos: Dict[Tuple[str, int], int] = {}
     group_fetch_nanos: Dict[Tuple[str, int], int] = {}
@@ -827,8 +929,15 @@ def search_shard_group(indices: IndicesService,
                 and not body.get("rescore") and not body.get("collapse")
                 and not (index_filters or {}).get(name)
                 and set(shard_nums) == set(svc.shards.keys())):
-            res = tpu_search.try_search(svc, query, k=k,
-                                        timeout_s=ctx.remaining_s())
+            try:
+                res = tpu_search.try_search(svc, query, k=k,
+                                            timeout_s=ctx.remaining_s())
+            except _NON_DEGRADABLE:
+                raise
+            except Exception:  # noqa: BLE001 — degrade to planner
+                logger.warning("group kernel path failed; falling back "
+                               "to the planner", exc_info=True)
+                res = None
             if res is not None:
                 used_fast = True
                 total += res.total_hits
@@ -851,53 +960,71 @@ def search_shard_group(indices: IndicesService,
             group_collapse = (body.get("collapse") or {}).get("field") \
                 if body.get("collapse") else None
             for shard_num in sorted(shard_nums):
-                shard = svc.shard(shard_num)
-                reader = shard.acquire_searcher()
-                if group_knn is not None:
-                    sets = group_knn.get((name, shard_num), [])
-                    if group_knn_only and not sets:
-                        group_skipped += 1
-                        continue
-                    from elasticsearch_tpu.search.knn import wrap_query
-                    shard_query = wrap_query(
-                        None if group_knn_only else eff_query, sets)
-                else:
-                    shard_query = eff_query
-                    if not can_match(reader, eff_query, svc.mapper):
-                        group_skipped += 1
-                        continue
-                q0 = time.perf_counter()
-                k_shard = k
-                if group_rescore:
-                    k_shard = max(k_shard, max(s.window_size
-                                               for s in group_rescore))
-                if group_collapse:
-                    from elasticsearch_tpu.search.collapse import \
-                        collapse_top_groups
-                    from elasticsearch_tpu.search.query_phase import \
-                        QuerySearchResult
-                    pairs, total_sh = collapse_top_groups(
-                        reader, shard_query, group_collapse, k)
-                    res = QuerySearchResult(
-                        [h for h, _ in pairs], total_sh,
-                        pairs[0][0].score if pairs else None)
-                    if aggs is not None:
-                        res.aggregations = execute_query(
-                            reader, shard_query, size=0, aggs=aggs,
-                            ctx=ctx).aggregations
-                else:
-                    res = execute_query(reader, shard_query, size=k_shard,
-                                        from_=0,
-                                        min_score=min_score, aggs=aggs,
-                                        sort_specs=sort_specs or None,
-                                        search_after=search_after,
-                                        ctx=ctx)
-                if group_rescore:
-                    from elasticsearch_tpu.search.rescore import \
-                        rescore_shard_hits
-                    res.hits = rescore_shard_hits(reader, res.hits,
-                                                  group_rescore)
-                elapsed = time.perf_counter() - q0
+                try:
+                    fault_check(name, shard_num, "query")
+                    shard = svc.shard(shard_num)
+                    reader = shard.acquire_searcher()
+                    if group_knn is not None:
+                        sets = group_knn.get((name, shard_num), [])
+                        if group_knn_only and not sets:
+                            group_skipped += 1
+                            continue
+                        from elasticsearch_tpu.search.knn import \
+                            wrap_query
+                        shard_query = wrap_query(
+                            None if group_knn_only else eff_query, sets)
+                    else:
+                        shard_query = eff_query
+                        if not can_match(reader, eff_query, svc.mapper):
+                            group_skipped += 1
+                            continue
+                    q0 = time.perf_counter()
+                    k_shard = k
+                    if group_rescore:
+                        k_shard = max(k_shard, max(s.window_size
+                                                   for s in group_rescore))
+                    if group_collapse:
+                        from elasticsearch_tpu.search.collapse import \
+                            collapse_top_groups
+                        from elasticsearch_tpu.search.query_phase import \
+                            QuerySearchResult
+                        pairs, total_sh = collapse_top_groups(
+                            reader, shard_query, group_collapse, k)
+                        res = QuerySearchResult(
+                            [h for h, _ in pairs], total_sh,
+                            pairs[0][0].score if pairs else None)
+                        if aggs is not None:
+                            res.aggregations = execute_query(
+                                reader, shard_query, size=0, aggs=aggs,
+                                ctx=ctx).aggregations
+                    else:
+                        res = execute_query(reader, shard_query,
+                                            size=k_shard, from_=0,
+                                            min_score=min_score,
+                                            aggs=aggs,
+                                            sort_specs=sort_specs or None,
+                                            search_after=search_after,
+                                            ctx=ctx)
+                    if group_rescore:
+                        from elasticsearch_tpu.search.rescore import \
+                            rescore_shard_hits
+                        res.hits = rescore_shard_hits(reader, res.hits,
+                                                      group_rescore)
+                    elapsed = time.perf_counter() - q0
+                    fault_check(name, shard_num, "fetch")
+                    f0 = time.perf_counter()
+                    fetched = execute_fetch(reader, res.hits,
+                                            fetch_source,
+                                            version=want_version,
+                                            seq_no_primary_term=want_seqno)
+                except _NON_DEGRADABLE:
+                    raise
+                except Exception as e:  # noqa: BLE001 — captured per shard
+                    logger.debug("group shard [%s][%d] failed",
+                                 name, shard_num, exc_info=True)
+                    group_failures.append(
+                        shard_failure_entry(name, shard_num, e))
+                    continue
                 group_query_nanos[(name, shard_num)] = int(elapsed * 1e9)
                 group_profile_entries.append((name, shard_num, None, res))
                 if svc.search_slowlog.enabled:
@@ -907,10 +1034,6 @@ def search_shard_group(indices: IndicesService,
                 total += res.total_hits
                 if aggs is not None and res.aggregations is not None:
                     agg_parts.append(res.aggregations)
-                f0 = time.perf_counter()
-                fetched = execute_fetch(reader, res.hits, fetch_source,
-                                        version=want_version,
-                                        seq_no_primary_term=want_seqno)
                 group_fetch_nanos[(name, shard_num)] = int(
                     (time.perf_counter() - f0) * 1e9)
                 for rank, (hit, doc) in enumerate(zip(res.hits, fetched)):
@@ -955,11 +1078,17 @@ def search_shard_group(indices: IndicesService,
         "hits": hits, "total": total, "relation": relation,
         "timed_out": ctx.timed_out,
         "skipped": group_skipped,
-        "shards": len({(n, s) for n, s in targets}),
+        # shards counts only the copies that EXECUTED; failed copies
+        # travel in "failures" so the coordinator can retry them on
+        # another copy before counting them failed
+        "shards": (len({(n, s) for n, s in targets})
+                   - len(group_failures)),
         "max_score": (max((d.get("_score") or float("-inf")
                            for d in hits), default=None)
                       if not sort_specs and hits else None),
     }
+    if group_failures:
+        out["failures"] = group_failures
     if aggs:
         import base64
         import pickle
@@ -983,11 +1112,20 @@ def merge_group_responses(groups: List[Dict[str, Any]],
                           body: Optional[Dict[str, Any]],
                           params: Optional[Dict[str, str]],
                           t0: float,
-                          failed_shards: int = 0) -> Dict[str, Any]:
+                          failed_shards: int = 0,
+                          failures: Optional[List[Dict[str, Any]]] = None
+                          ) -> Dict[str, Any]:
     """Coordinator-side reduce of `search_shard_group` partials into one
-    reference-shaped _search response."""
+    reference-shaped _search response.
+
+    `failures`: consolidated `_shards.failures[]` entries for copies
+    that stayed failed AFTER the coordinator's failover attempts (the
+    caller owns retry; this function only reports). `failed_shards`
+    additionally counts failures with no entry (legacy callers)."""
     params = params or {}
     body = body or {}
+    failures = list(failures or [])
+    n_failed = failed_shards + len(failures)
     size = int(params.get("size", body.get("size", 10)))
     from_ = int(params.get("from", body.get("from", 0)))
     from elasticsearch_tpu.search import sort as sort_mod
@@ -996,7 +1134,7 @@ def merge_group_responses(groups: List[Dict[str, Any]],
     merged = []
     total = 0
     relation = "eq"
-    n_shards = failed_shards
+    n_shards = n_failed
     n_skipped = 0
     timed_out = False
     for gi, g in enumerate(groups):
@@ -1048,13 +1186,16 @@ def merge_group_responses(groups: List[Dict[str, Any]],
                          if g.get("max_score") is not None),
                         default=None)
 
+    shards_json: Dict[str, Any] = {"total": n_shards,
+                                   "successful": n_shards - n_failed,
+                                   "skipped": n_skipped,
+                                   "failed": n_failed}
+    if failures:
+        shards_json["failures"] = failures
     out: Dict[str, Any] = {
         "took": int((time.perf_counter() - t0) * 1000),
         "timed_out": timed_out,
-        "_shards": {"total": n_shards,
-                    "successful": n_shards - failed_shards,
-                    "skipped": n_skipped,
-                    "failed": failed_shards},
+        "_shards": shards_json,
         "hits": {"total": {"value": total, "relation": relation},
                  "max_score": max_score,
                  "hits": window},

@@ -21,6 +21,24 @@ from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.planner import SegmentQueryExecutor
 
 
+# ---- fault-injection seam (testing/disruption.py) -------------------
+# Hooks run at the top of each shard-level phase with (index, shard,
+# phase) and raise to simulate that copy failing mid-search. Empty in
+# production — the list is only populated by disruption schemes, so the
+# hot-path cost is one falsy check.
+_FAULT_HOOKS: List[Any] = []
+
+
+def fault_check(index: str, shard: int, phase: str) -> None:
+    """Give installed disruption schemes a chance to fail this shard's
+    `phase` ("query" | "fetch"). Called by the coordinator right before
+    it executes the phase, i.e. at the same point a real copy would
+    throw (reference: the fault points MockTransportService exercises)."""
+    if _FAULT_HOOKS:
+        for hook in list(_FAULT_HOOKS):
+            hook(index, shard, phase)
+
+
 @dataclasses.dataclass
 class ShardDocRef:
     segment: str

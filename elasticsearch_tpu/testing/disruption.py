@@ -1,0 +1,271 @@
+"""Fault-injection harness — composable network and shard disruptions.
+
+Reference analog: the test framework's `MockTransportService` +
+`DisruptableMockTransport` + `NetworkDisruption` schemes (SURVEY.md
+§4.2): tests wrap a live transport and declaratively drop, delay, or
+error messages, then assert the system degrades the way the resilience
+design promises (partial results, failover, bounded retry) instead of
+crashing.
+
+Three seams, one scheme vocabulary:
+
+  * `disrupt_sim(network, *schemes)` — wraps the in-memory
+    `tests/sim_cluster.SimNetwork.deliver`, so deterministic
+    virtual-time cluster tests inject faults with full (src, dst,
+    action) visibility.
+  * `disrupt_transport(service, *schemes)` — wraps the real
+    `TransportService.send_request_async`, so multi-node TCP tests
+    inject the same faults at the client edge (src is the wrapped
+    node; dst/action as on the wire).
+  * `shard_fault(index, ...)` — installs a hook on the search
+    coordinator's per-shard phase seam
+    (`search/query_phase.fault_check`), simulating a shard copy
+    throwing mid-query or mid-fetch.
+
+All three are context managers that restore the seam on exit, so a
+failing assertion can't leak a broken transport into the next test.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Iterator, Optional, Set, Tuple
+
+from elasticsearch_tpu.transport.service import ConnectTransportException
+
+Address = Tuple[str, int]
+
+# an intercept verdict: None = pass through, DROP = fail the send as a
+# connection error, ("delay", seconds) = deliver late
+DROP = "drop"
+
+
+class Scheme:
+    """One composable disruption rule. `intercept` sees every send and
+    returns a verdict; schemes compose by first non-None verdict."""
+
+    def intercept(self, src: Optional[Address], dst: Address,
+                  action: str) -> Optional[Any]:
+        raise NotImplementedError
+
+    def heal(self) -> None:
+        """Stop disrupting (schemes keep working until healed)."""
+        self._healed = True
+
+    @property
+    def healed(self) -> bool:
+        return getattr(self, "_healed", False)
+
+
+class DropAction(Scheme):
+    """Drop every send whose action matches one of `actions` (exact
+    names or prefixes ending in '*')."""
+
+    def __init__(self, *actions: str):
+        self.actions = set(actions)
+
+    def _matches(self, action: str) -> bool:
+        for pat in self.actions:
+            if pat.endswith("*"):
+                if action.startswith(pat[:-1]):
+                    return True
+            elif action == pat:
+                return True
+        return False
+
+    def intercept(self, src, dst, action):
+        if self.healed or not self._matches(action):
+            return None
+        return DROP
+
+
+class Delay(Scheme):
+    """Deliver matching sends `seconds` late (all actions when none
+    given) — the slow-network half of the reference's
+    NetworkDisruption.NetworkDelay."""
+
+    def __init__(self, seconds: float, *actions: str):
+        self.seconds = seconds
+        self.actions = set(actions)
+
+    def intercept(self, src, dst, action):
+        if self.healed:
+            return None
+        if self.actions and action not in self.actions:
+            return None
+        return ("delay", self.seconds)
+
+
+class ErrorRate(Scheme):
+    """Drop each send independently with probability `rate` (seeded —
+    deterministic under a fixed rng)."""
+
+    def __init__(self, rate: float, rng: Optional[random.Random] = None):
+        self.rate = rate
+        self.rng = rng or random.Random(0)
+
+    def intercept(self, src, dst, action):
+        if self.healed:
+            return None
+        return DROP if self.rng.random() < self.rate else None
+
+
+class OneShot(Scheme):
+    """Apply `inner` to the first matching send only, then self-heal —
+    the one-shot-then-heal pattern behind failover tests (first attempt
+    dies, the retry/failover succeeds)."""
+
+    def __init__(self, inner: Scheme):
+        self.inner = inner
+        self._lock = threading.Lock()
+
+    def intercept(self, src, dst, action):
+        with self._lock:
+            if self.healed:
+                return None
+            verdict = self.inner.intercept(src, dst, action)
+            if verdict is not None:
+                self.heal()
+            return verdict
+
+
+class Partition(Scheme):
+    """Blackhole traffic between two address groups, both directions
+    (reference: NetworkDisruption.TwoPartitions). On the real-transport
+    seam only the destination side is visible; a send counts as crossing
+    when src is unknown and dst is in either group's far side."""
+
+    def __init__(self, side_a: Set[Address], side_b: Set[Address]):
+        self.side_a = {tuple(a) for a in side_a}
+        self.side_b = {tuple(b) for b in side_b}
+
+    def intercept(self, src, dst, action):
+        if self.healed:
+            return None
+        dst = tuple(dst)
+        if src is not None:
+            src = tuple(src)
+            crossing = ((src in self.side_a and dst in self.side_b)
+                        or (src in self.side_b and dst in self.side_a))
+            return DROP if crossing else None
+        # client-edge seam: the wrapped node is implicitly one side
+        return DROP if dst in self.side_a or dst in self.side_b else None
+
+
+def _verdict(schemes, src, dst, action):
+    for scheme in schemes:
+        v = scheme.intercept(src, dst, action)
+        if v is not None:
+            return v
+    return None
+
+
+@contextlib.contextmanager
+def disrupt_sim(network, *schemes: Scheme) -> Iterator[None]:
+    """Weave `schemes` into a tests/sim_cluster.SimNetwork: dropped
+    sends fail with on_done(False, None) after one network lag (exactly
+    like a blackholed link), delayed sends deliver late — all on the
+    deterministic task queue."""
+    original = network.deliver
+
+    def deliver(src, dst, action, payload, on_done):
+        v = _verdict(schemes, src, dst, action)
+        if v == DROP:
+            network.queue.schedule(network._lag(),
+                                   lambda: on_done(False, None))
+            return
+        if isinstance(v, tuple) and v[0] == "delay":
+            network.queue.schedule(
+                v[1], lambda: original(src, dst, action, payload, on_done))
+            return
+        original(src, dst, action, payload, on_done)
+
+    network.deliver = deliver
+    try:
+        yield
+    finally:
+        network.deliver = original
+
+
+@contextlib.contextmanager
+def disrupt_transport(service, *schemes: Scheme) -> Iterator[None]:
+    """Weave `schemes` into a real TransportService at the client edge:
+    dropped sends resolve their Future with ConnectTransportException
+    (what a blackholed TCP connect looks like to callers), delayed
+    sends dispatch from a timer thread."""
+    original = service.send_request_async
+    src = getattr(service, "bound_address", None)
+
+    def send_request_async(address, action, payload, **kw):
+        v = _verdict(schemes, src, tuple(address), action)
+        if v == DROP:
+            fut: Future = Future()
+            fut.set_exception(ConnectTransportException(
+                f"disrupted send of [{action}] to {tuple(address)}"))
+            return fut
+        if isinstance(v, tuple) and v[0] == "delay":
+            fut = Future()
+
+            def fire() -> None:
+                inner = original(address, action, payload, **kw)
+
+                def done(f: Future) -> None:
+                    exc = f.exception()
+                    if exc is not None:
+                        fut.set_exception(exc)
+                    else:
+                        fut.set_result(f.result())
+
+                inner.add_done_callback(done)
+
+            t = threading.Timer(v[1], fire)
+            t.daemon = True
+            t.start()
+            return fut
+        return original(address, action, payload, **kw)
+
+    service.send_request_async = send_request_async
+    try:
+        yield
+    finally:
+        service.send_request_async = original
+
+
+@contextlib.contextmanager
+def shard_fault(index: str, shard: Optional[int] = None,
+                phase: Optional[str] = "query",
+                exc: Optional[Callable[[], BaseException]] = None,
+                one_shot: bool = False) -> Iterator[Dict[str, int]]:
+    """Make the matching shard copies throw from their query/fetch
+    phase. `shard=None` faults every shard of `index`; `phase=None`
+    faults both phases; `exc` builds the raised exception (default: a
+    RuntimeError that reads like a broken copy). `one_shot=True` heals
+    after the first raise — the failing-primary/healthy-replica
+    scenario (hits counts trips in the yielded dict)."""
+    from elasticsearch_tpu.search import query_phase
+
+    state = {"trips": 0}
+    lock = threading.Lock()
+
+    def hook(idx: str, sh: int, ph: str) -> None:
+        if idx != index:
+            return
+        if shard is not None and sh != shard:
+            return
+        if phase is not None and ph != phase:
+            return
+        with lock:
+            if one_shot and state["trips"] >= 1:
+                return
+            state["trips"] += 1
+        raise (exc() if exc is not None else RuntimeError(
+            f"simulated failure of [{idx}][{sh}] {ph} phase"))
+
+    query_phase._FAULT_HOOKS.append(hook)
+    try:
+        yield state
+    finally:
+        query_phase._FAULT_HOOKS.remove(hook)

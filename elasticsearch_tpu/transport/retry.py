@@ -1,0 +1,195 @@
+"""RetryableAction — bounded retry with exponential backoff and jitter.
+
+Reference analog: `action/support/RetryableAction` (SURVEY.md §2.4): an
+action that retries itself on *transient* transport failures — connect
+refusals, peer resets, in-flight-cap rejections, timeouts — with
+exponentially growing, jittered delays, until an overall deadline
+expires. Application errors (a handler raised on the remote node) are
+NEVER retried: re-running a query that threw a parse error yields the
+same parse error, only slower.
+
+Two consumers:
+
+  * `send_with_retry(...)` — synchronous fan-out helper used by the
+    search coordinator when a shard copy must be re-tried on a fresh
+    connection.
+  * `RetryableAction` — callback-style driver for code that owns a
+    scheduler seam (cluster-state publication), so the deterministic
+    sim scheduler can step the backoff clock.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from elasticsearch_tpu.transport.service import (
+    ConnectTransportException,
+    RemoteTransportException,
+    TransportRejectedException,
+)
+
+logger = logging.getLogger("elasticsearch_tpu.transport.retry")
+
+Address = Tuple[str, int]
+
+#: transient transport-level failures worth retrying. Note
+#: RemoteTransportException is absent on purpose — the request reached
+#: the peer and its handler raised, so the failure is the application's.
+RETRYABLE_EXCEPTIONS = (
+    ConnectionError,
+    ConnectTransportException,
+    TransportRejectedException,
+    FutureTimeoutError,
+    TimeoutError,
+    OSError,
+)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    if isinstance(exc, RemoteTransportException):
+        return False
+    return isinstance(exc, RETRYABLE_EXCEPTIONS)
+
+
+class RetryPolicy:
+    """Backoff schedule: delay_n = initial * multiplier^n, capped at
+    `max_delay`, each scaled by a uniform jitter in [1-jitter, 1], the
+    whole sequence bounded by `deadline` seconds of wall clock."""
+
+    def __init__(self, initial_delay: float = 0.05,
+                 max_delay: float = 2.0,
+                 multiplier: float = 2.0,
+                 jitter: float = 0.5,
+                 deadline: float = 10.0,
+                 rng: Optional[random.Random] = None):
+        if initial_delay <= 0 or multiplier < 1.0:
+            raise ValueError("backoff must grow from a positive base")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1)")
+        self.initial_delay = initial_delay
+        self.max_delay = max_delay
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.deadline = deadline
+        self._rng = rng or random.Random()
+
+    def delay(self, attempt: int) -> float:
+        """Jittered delay before retry number `attempt` (0-based)."""
+        base = min(self.max_delay,
+                   self.initial_delay * (self.multiplier ** attempt))
+        if self.jitter:
+            base *= 1.0 - self.jitter * self._rng.random()
+        return base
+
+
+class RetryableAction:
+    """Drive `attempt` (a callable taking (on_success, on_failure)
+    callbacks) through the retry schedule on an injectable scheduler.
+
+    `scheduler(delay_s, fn)` runs `fn` after `delay_s` — the real
+    implementation uses threading.Timer; the sim cluster passes its
+    DeterministicTaskQueue so tests step virtual time. Terminal outcome
+    lands on `listener(result, exc)` exactly once."""
+
+    def __init__(self, attempt: Callable[[Callable[[Any], None],
+                                          Callable[[BaseException], None]],
+                                         None],
+                 listener: Callable[[Any, Optional[BaseException]], None],
+                 policy: Optional[RetryPolicy] = None,
+                 scheduler: Optional[Callable[[float, Callable[[], None]],
+                                              Any]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 retryable: Callable[[BaseException], bool] = is_retryable):
+        self._attempt = attempt
+        self._listener = listener
+        self.policy = policy or RetryPolicy()
+        self._scheduler = scheduler or self._timer_schedule
+        self._clock = clock
+        self._retryable = retryable
+        self._lock = threading.Lock()
+        self._done = False
+        self.attempts = 0
+        self._start = 0.0
+
+    @staticmethod
+    def _timer_schedule(delay: float, fn: Callable[[], None]) -> None:
+        t = threading.Timer(delay, fn)
+        t.daemon = True
+        t.start()
+
+    def run(self) -> None:
+        self._start = self._clock()
+        self._try_once()
+
+    def cancel(self, exc: Optional[BaseException] = None) -> None:
+        self._finish(None, exc or FutureTimeoutError("cancelled"))
+
+    def _finish(self, result: Any, exc: Optional[BaseException]) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        self._listener(result, exc)
+
+    def _try_once(self) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self.attempts += 1
+        try:
+            self._attempt(lambda res: self._finish(res, None),
+                          self._on_failure)
+        except Exception as e:  # noqa: BLE001 — routed through retry gate
+            self._on_failure(e)
+
+    def _on_failure(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._done:
+                return
+            attempts = self.attempts
+        if not self._retryable(exc):
+            self._finish(None, exc)
+            return
+        delay = self.policy.delay(attempts - 1)
+        elapsed = self._clock() - self._start
+        if elapsed + delay > self.policy.deadline:
+            logger.debug("retryable action exhausted after %d attempts "
+                         "(%.2fs elapsed): %s", attempts, elapsed, exc)
+            self._finish(None, exc)
+            return
+        self._scheduler(delay, self._try_once)
+
+
+def send_with_retry(transport, address: Address, action: str,
+                    payload: Dict[str, Any],
+                    policy: Optional[RetryPolicy] = None,
+                    attempt_timeout: float = 30.0) -> Dict[str, Any]:
+    """Synchronous `transport.send_request` wrapped in the retry
+    schedule. A dead pooled connection is evicted before the retry so
+    the next attempt dials fresh instead of re-failing on the corpse."""
+    policy = policy or RetryPolicy()
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        remaining = policy.deadline - (time.monotonic() - start)
+        try:
+            return transport.send_request(
+                address, action, payload,
+                timeout=max(0.001, min(attempt_timeout, remaining)))
+        except Exception as e:  # noqa: BLE001 — gate below re-raises
+            if not is_retryable(e):
+                raise
+            if hasattr(transport, "evict"):
+                transport.evict(address)
+            delay = policy.delay(attempt)
+            attempt += 1
+            if (time.monotonic() - start) + delay > policy.deadline:
+                raise
+            logger.debug("retry %d to %s [%s] in %.3fs after: %s",
+                         attempt, address, action, delay, e)
+            time.sleep(delay)

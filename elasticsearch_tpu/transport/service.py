@@ -365,6 +365,17 @@ class TransportService:
         return self.send_request_async(address, action, payload).result(
             timeout=timeout)
 
+    def evict(self, address: Address) -> None:
+        """Drop the pooled connection to `address` (failing its in-flight
+        requests) so the next send dials fresh — the reference's dead-
+        connection detection in ClusterConnectionManager. Safe to call on
+        an address with no pooled connection."""
+        address = (address[0], int(address[1]))
+        with self._conns_lock:
+            conn = self._conns.pop(address, None)
+        if conn is not None:
+            conn.close()
+
     def close(self) -> None:
         self._closed = True
         if self._server_sock is not None:

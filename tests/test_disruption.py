@@ -1,0 +1,429 @@
+"""Disruption suite — search-path fault tolerance under injected
+failures.
+
+Reference analog: the *DisruptionIT suites (SearchWithRandomExceptions,
+ClusterDisruptionIT, SURVEY.md §4.3) — kill shard copies and network
+links mid-request, then assert the contract: partial results with
+honest `_shards` accounting, replica failover, bounded transport retry,
+and breaker trips as 429s — never a crash or a silent wrong answer."""
+
+from __future__ import annotations
+
+import json
+import signal
+import socket
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.errors import CircuitBreakingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.testing.disruption import (Delay, DropAction, OneShot,
+                                                  Partition, disrupt_sim,
+                                                  disrupt_transport,
+                                                  shard_fault)
+from elasticsearch_tpu.transport.retry import (RetryableAction, RetryPolicy,
+                                               send_with_retry)
+from elasticsearch_tpu.transport.service import (ConnectTransportException,
+                                                 RemoteTransportException,
+                                                 TransportService)
+
+pytestmark = pytest.mark.disruption
+
+
+@pytest.fixture(autouse=True)
+def _timeout_guard():
+    """Per-test wall-clock guard: a hung retry loop fails THIS test
+    instead of wedging the whole tier-1 run."""
+
+    def on_alarm(signum, frame):
+        raise TimeoutError("disruption test exceeded the 120s guard")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, 120.0)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def do(node, method, path, body=None, **params):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path,
+                       {k: str(v) for k, v in params.items()}, None, raw)
+
+
+# ---------------------------------------------------------------------
+# single-node: per-shard failure capture in the local coordinator
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def node(tmp_path):
+    # planner path (no kernel fast path) so per-shard fault points fire
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({"search.tpu_serving.enabled": "false"}))
+    status, body = do(n, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 3}},
+        "mappings": {"properties": {"title": {"type": "text"}}}})
+    assert status == 200, body
+    for i in range(30):
+        do(n, "PUT", f"/books/_doc/{i}",
+           body={"title": f"alpha common doc {i}"})
+    do(n, "POST", "/books/_refresh")
+    yield n
+    n.close()
+
+
+QUERY = {"query": {"match": {"title": "alpha"}}, "size": 30}
+
+
+def test_partial_results_when_one_shard_dies(node):
+    status, full = do(node, "POST", "/books/_search", body=QUERY)
+    assert status == 200 and full["_shards"]["failed"] == 0
+    full_ids = [h["_id"] for h in full["hits"]["hits"]]
+    assert len(full_ids) == 30
+
+    with shard_fault("books", shard=0):
+        status, part = do(node, "POST", "/books/_search", body=QUERY)
+    # HTTP 200 with honest accounting: the dead copy is failed (not
+    # silently dropped), survivors' hits keep their full-search order
+    assert status == 200
+    shards = part["_shards"]
+    assert shards["total"] == 3 and shards["failed"] == 1
+    assert shards["successful"] == 2
+    failures = shards["failures"]
+    assert failures and failures[0]["index"] == "books"
+    assert failures[0]["shard"] == 0
+    assert failures[0]["reason"]["type"] == "runtime_error"
+    assert "simulated failure" in failures[0]["reason"]["reason"]
+    part_ids = [h["_id"] for h in part["hits"]["hits"]]
+    assert 0 < len(part_ids) < 30
+    # rank-correctness: surviving hits appear in the same relative
+    # order (and with the same scores) as the healthy search
+    surviving = [i for i in full_ids if i in set(part_ids)]
+    assert part_ids == surviving
+    full_scores = {h["_id"]: h["_score"] for h in full["hits"]["hits"]}
+    for h in part["hits"]["hits"]:
+        assert h["_score"] == pytest.approx(full_scores[h["_id"]])
+
+
+def test_all_shards_failed_is_503_not_traceback(node):
+    with shard_fault("books"):
+        status, body = do(node, "POST", "/books/_search", body=QUERY)
+    assert status == 503
+    err = body["error"]
+    assert err["type"] == "search_phase_execution_exception"
+    assert err["phase"] == "query"
+    assert len(err["failed_shards"]) == 3
+
+
+def test_allow_partial_false_rejects_partial(node):
+    with shard_fault("books", shard=1):
+        status, body = do(node, "POST", "/books/_search", body=QUERY,
+                          allow_partial_search_results="false")
+    assert status == 503
+    assert body["error"]["type"] == "search_phase_execution_exception"
+    assert any(f["shard"] == 1 for f in body["error"]["failed_shards"])
+
+
+def test_fetch_phase_failure_counts_shard_failed(node):
+    with shard_fault("books", shard=2, phase="fetch"):
+        status, part = do(node, "POST", "/books/_search", body=QUERY)
+    assert status == 200
+    shards = part["_shards"]
+    assert shards["failed"] == 1
+    assert shards["failures"][0]["shard"] == 2
+    # a fetch-failed shard contributes zero hits
+    assert len(part["hits"]["hits"]) < 30
+
+
+def test_scroll_page_carries_real_shard_accounting(node):
+    status, first = do(node, "POST", "/books/_search", body=QUERY,
+                       scroll="1m", size=5)
+    assert status == 200
+    sid = first["_scroll_id"]
+    with shard_fault("books", shard=0):
+        status, page = do(node, "POST", "/_search/scroll",
+                          body={"scroll": "1m", "scroll_id": sid})
+    assert status == 200
+    assert page["_shards"]["failed"] == 1
+    assert page["_shards"]["total"] == 3
+    assert page["_shards"]["failures"][0]["index"] == "books"
+    do(node, "DELETE", "/_search/scroll",
+       body={"scroll_id": sid})
+
+
+def test_breaker_trip_surfaces_as_429(node):
+    with shard_fault("books", exc=lambda: CircuitBreakingException(
+            "[parent] data too large", 100, 10)):
+        status, body = do(node, "POST", "/books/_search", body=QUERY)
+    assert status == 429
+    assert body["error"]["type"] == "circuit_breaking_exception"
+
+
+# ---------------------------------------------------------------------
+# two-node cluster: replica failover
+# ---------------------------------------------------------------------
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    names = ["dis-0", "dis-1"]
+    ports = _free_ports(2)
+    seeds = [("127.0.0.1", p) for p in ports]
+    nodes = []
+    for i, name in enumerate(names):
+        data = tmp_path_factory.mktemp(f"data-{name}")
+        node = Node(str(data), node_name=name,
+                    settings=Settings.of(
+                        {"search.tpu_serving.enabled": "false"}))
+        node.start_cluster(transport_port=ports[i], seed_hosts=seeds,
+                           initial_master_nodes=names)
+        nodes.append(node)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(n.cluster.health()["number_of_nodes"] == 2 for n in nodes):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("cluster did not form")
+    yield nodes
+    for n in nodes:
+        try:
+            n.close()
+        except Exception:
+            pass
+
+
+def _wait_green(node, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if node.cluster.health()["status"] == "green":
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"not green: {node.cluster.health()}")
+
+
+def test_failover_to_replica_hides_the_failure(cluster):
+    status, body = do(cluster[0], "PUT", "/fo", body={
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    assert status == 200, body
+    _wait_green(cluster[0])
+    for i in range(10):
+        do(cluster[0], "PUT", f"/fo/_doc/{i}",
+           body={"body": f"gamma doc {i}"})
+    do(cluster[0], "POST", "/fo/_refresh")
+
+    # the FIRST copy to run the query phase dies once, then heals — the
+    # coordinator must retry the other copy and report a clean response
+    with shard_fault("fo", shard=0, one_shot=True) as state:
+        status, resp = do(cluster[0], "POST", "/fo/_search",
+                          body={"query": {"match": {"body": "gamma"}},
+                                "size": 20})
+    assert state["trips"] == 1, "fault never fired"
+    assert status == 200, resp
+    assert resp["_shards"]["failed"] == 0, resp["_shards"]
+    assert "failures" not in resp["_shards"]
+    assert resp["hits"]["total"]["value"] == 10
+
+
+def test_no_replica_means_honest_partial(cluster):
+    status, body = do(cluster[0], "PUT", "/solo", body={
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    assert status == 200, body
+    for i in range(10):
+        do(cluster[0], "PUT", f"/solo/_doc/{i}",
+           body={"body": f"delta doc {i}"})
+    do(cluster[0], "POST", "/solo/_refresh")
+    with shard_fault("solo", shard=0):
+        status, resp = do(cluster[0], "POST", "/solo/_search",
+                          body={"query": {"match": {"body": "delta"}},
+                                "size": 20})
+    assert status == 200, resp
+    assert resp["_shards"]["failed"] == 1
+    assert resp["_shards"]["failures"][0]["index"] == "solo"
+
+
+# ---------------------------------------------------------------------
+# transport retry: backoff shape, deadline bound, error classification
+# ---------------------------------------------------------------------
+
+def test_retryable_action_backs_off_exponentially_until_deadline():
+    delays = []
+    clock = {"t": 0.0}
+
+    def scheduler(delay, fn):
+        delays.append(delay)
+        clock["t"] += delay
+        fn()
+
+    attempts = {"n": 0}
+
+    def attempt(on_ok, on_fail):
+        attempts["n"] += 1
+        on_fail(ConnectionError("peer is a crater"))
+
+    done = []
+    action = RetryableAction(
+        attempt, lambda res, exc: done.append(exc),
+        policy=RetryPolicy(initial_delay=0.1, multiplier=2.0,
+                           jitter=0.0, deadline=1.0),
+        scheduler=scheduler, clock=lambda: clock["t"])
+    action.run()
+    # 0.1 + 0.2 + 0.4 fits inside 1.0; the next delay (0.8) would land
+    # past the deadline, so the action gives up with the last error
+    assert delays == [0.1, 0.2, 0.4]
+    assert attempts["n"] == 4
+    assert len(done) == 1 and isinstance(done[0], ConnectionError)
+
+
+def test_application_errors_never_retry():
+    attempts = {"n": 0}
+
+    def attempt(on_ok, on_fail):
+        attempts["n"] += 1
+        on_fail(RemoteTransportException("parse_error", "bad query"))
+
+    done = []
+    action = RetryableAction(
+        attempt, lambda res, exc: done.append(exc),
+        scheduler=lambda d, fn: fn())
+    action.run()
+    assert attempts["n"] == 1
+    assert isinstance(done[0], RemoteTransportException)
+
+
+def test_send_with_retry_bounded_against_dead_peer():
+    dead_port = _free_ports(1)[0]
+    ts = TransportService()
+    calls = []
+    orig = ts.send_request
+
+    def counting(address, action, payload, timeout=30.0):
+        calls.append(time.monotonic())
+        return orig(address, action, payload, timeout=timeout)
+
+    ts.send_request = counting
+    t0 = time.monotonic()
+    with pytest.raises((ConnectTransportException, ConnectionError)):
+        send_with_retry(ts, ("127.0.0.1", dead_port), "noop", {},
+                        policy=RetryPolicy(initial_delay=0.05,
+                                           max_delay=0.2, deadline=1.0))
+    elapsed = time.monotonic() - t0
+    assert len(calls) >= 2, "never retried"
+    assert elapsed < 5.0, f"retry loop ran past its deadline: {elapsed}"
+    ts.close()
+
+
+def test_evict_drops_pooled_connection():
+    a, b = TransportService(), TransportService()
+    b.register_handler("echo", lambda payload, frm: payload)
+    b.start()
+    try:
+        a.send_request(b.bound_address, "echo", {"x": 1}, timeout=5.0)
+        conn1 = a._conns[b.bound_address]
+        a.evict(b.bound_address)
+        assert conn1.closed and b.bound_address not in a._conns
+        # next send dials a FRESH connection and still works
+        out = a.send_request(b.bound_address, "echo", {"x": 2},
+                             timeout=5.0)
+        assert out == {"x": 2}
+        assert a._conns[b.bound_address] is not conn1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_disrupt_transport_drop_and_heal():
+    a, b = TransportService(), TransportService()
+    b.register_handler("echo", lambda payload, frm: payload)
+    b.start()
+    try:
+        scheme = DropAction("echo")
+        with disrupt_transport(a, scheme):
+            with pytest.raises(ConnectTransportException):
+                a.send_request(b.bound_address, "echo", {}, timeout=5.0)
+            scheme.heal()
+            assert a.send_request(b.bound_address, "echo", {"ok": 1},
+                                  timeout=5.0) == {"ok": 1}
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------
+# sim cluster: publication resend + partition tolerance, virtual time
+# ---------------------------------------------------------------------
+
+def _sim_cluster(n=3):
+    import random as _random
+
+    from tests.sim_cluster import SimCluster
+    cluster = SimCluster(n, rng=_random.Random(42))
+    cluster.start()
+    leader = cluster.run_until_stable()
+    return cluster, leader
+
+
+def test_publish_resend_survives_a_dropped_send():
+    from elasticsearch_tpu.cluster.coordination import ACTION_PUBLISH
+    cluster, leader_name = _sim_cluster()
+    leader = cluster.nodes[leader_name]
+    v0 = leader.state().version
+    done = []
+    with disrupt_sim(cluster.network, OneShot(DropAction(ACTION_PUBLISH))):
+        leader.submit_state_update(
+            lambda st: st.with_updates(term=st.term),
+            source="disruption-test", on_done=done.append)
+        cluster.queue.run_for(10.0)
+    # the dropped publish was resent with backoff; the update committed
+    assert done == [None]
+    for name, coord in cluster.nodes.items():
+        assert coord.state().version > v0, (name, coord.state().version)
+
+
+def test_minority_partition_does_not_block_commits():
+    cluster, leader_name = _sim_cluster()
+    leader = cluster.nodes[leader_name]
+    followers = [n for n in cluster.nodes if n != leader_name]
+    cut = cluster.nodes[followers[0]].local.address
+    v0 = leader.state().version
+    done = []
+    with disrupt_sim(cluster.network,
+                     Partition({leader.local.address}, {cut})):
+        leader.submit_state_update(
+            lambda st: st.with_updates(term=st.term),
+            source="partition-test", on_done=done.append)
+        cluster.queue.run_for(15.0)
+    assert done == [None]  # quorum = leader + the reachable follower
+    assert leader.state().version > v0
+    assert cluster.nodes[followers[1]].state().version > v0
+
+
+def test_delay_scheme_slows_but_does_not_break():
+    cluster, leader_name = _sim_cluster()
+    leader = cluster.nodes[leader_name]
+    v0 = leader.state().version
+    done = []
+    with disrupt_sim(cluster.network, Delay(0.4)):
+        leader.submit_state_update(
+            lambda st: st.with_updates(term=st.term),
+            source="slow-net-test", on_done=done.append)
+        cluster.queue.run_for(20.0)
+    assert done == [None]
+    assert leader.state().version > v0

@@ -341,8 +341,9 @@ def test_replica_reads_spread_and_fail_over(cluster):
     # then EWMA-ranked); collect the chosen owner over repeated routes
     chosen = set()
     for _ in range(9):
-        by_node, _addr, failed = cluster[0].cluster._route_shards(["ars"])
-        assert failed == 0
+        by_node, _addr, unassigned, _c = \
+            cluster[0].cluster._route_shards(["ars"])
+        assert not unassigned
         chosen.update(by_node.keys())
         s, resp = _handle(cluster[0], "POST", "/ars/_search",
                           body={"query": {"match": {"body": "alpha"}},
@@ -369,6 +370,6 @@ def test_replica_reads_spread_and_fail_over(cluster):
                                 "size": 20})
         if s == 200 and resp["hits"]["total"]["value"] == 12:
             ok += 1
-        by_node, _addr, _f = cluster[0].cluster._route_shards(["ars"])
+        by_node, _addr, _u, _c = cluster[0].cluster._route_shards(["ars"])
         assert victim_id not in by_node
     assert ok >= 5, f"only {ok}/6 searches succeeded after failover"
