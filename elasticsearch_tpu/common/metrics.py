@@ -7,6 +7,7 @@ small bag of these and renders them into the stats API response.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Any, Dict
@@ -78,6 +79,61 @@ class EWMA:
     @property
     def value(self) -> float:
         return self._value
+
+
+class SampleRing:
+    """Bounded ring of recent float samples; cheap percentile snapshots.
+
+    Per-stage latency distributions for the serving path: totals alone are
+    misleading for queue-style stages (summing per-query waits across a
+    batch over-counts wall time), so stats report recent-percentile views
+    alongside the running totals."""
+
+    __slots__ = ("_buf", "_size", "_next", "_count", "_lock")
+
+    def __init__(self, size: int = 512):
+        self._buf = [0.0] * size
+        self._size = size
+        self._next = 0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def add(self, sample: float) -> None:
+        with self._lock:
+            self._buf[self._next] = sample
+            self._next = (self._next + 1) % self._size
+            if self._count < self._size:
+                self._count += 1
+
+    def samples(self) -> list:
+        with self._lock:
+            return list(self._buf[: self._count])
+
+    def percentiles(self, pcts=(50.0, 95.0, 99.0)) -> Dict[float, float]:
+        """Nearest-rank percentiles over the retained window ({} if empty)."""
+        snap = self.samples()
+        if not snap:
+            return {}
+        snap.sort()
+        n = len(snap)
+        out: Dict[float, float] = {}
+        for p in pcts:
+            rank = max(0, min(n - 1, math.ceil(p / 100.0 * n) - 1))
+            out[p] = snap[rank]
+        return out
+
+
+def percentiles(samples, pcts=(50.0, 95.0, 99.0)) -> Dict[float, float]:
+    """Nearest-rank percentiles of an arbitrary sample list ({} if empty)."""
+    snap = sorted(samples)
+    if not snap:
+        return {}
+    n = len(snap)
+    out: Dict[float, float] = {}
+    for p in pcts:
+        rank = max(0, min(n - 1, math.ceil(p / 100.0 * n) - 1))
+        out[p] = snap[rank]
+    return out
 
 
 class StopWatch:

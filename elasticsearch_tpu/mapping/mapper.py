@@ -252,6 +252,9 @@ class MapperService:
             meta = mapping.get("_meta", {})
         self.mapper = DocumentMapper(fields, meta, dynamic,
                                      nested_roots=nested_roots)
+        # Monotonic mapping version; every live-mapping swap bumps it so
+        # downstream caches (e.g. the TPU lowered-plan cache) can key on it.
+        self.generation = 0
 
     def merge(self, mapping_update: dict) -> None:
         """Merge a mapping fragment (properties tree) into the live mapping."""
@@ -272,6 +275,7 @@ class MapperService:
             dynamic = str(mapping_update.get("dynamic", self.mapper.dynamic)).lower()
             self.mapper = DocumentMapper(merged, self.mapper.meta, dynamic,
                                          nested_roots=nested_roots)
+            self.generation += 1
 
     def field_type(self, path: str) -> Optional[FieldType]:
         return self.mapper.fields.get(path)
@@ -497,6 +501,7 @@ class MapperService:
             self.mapper = DocumentMapper(
                 merged, self.mapper.meta, self.mapper.dynamic,
                 nested_roots=self.mapper.nested_roots)
+            self.generation += 1
         return fields[path]
 
     @staticmethod

@@ -100,7 +100,17 @@ class Node:
                 max_batch=self.settings.get_int(
                     "search.tpu_serving.max_batch", 128),
                 batch_timeout_s=self.settings.get_float(
-                    "search.tpu_serving.batch_timeout_seconds", 30.0))
+                    "search.tpu_serving.batch_timeout_seconds", 30.0),
+                plan_cache_size=self.settings.get_int(
+                    "search.tpu_serving.plan_cache_size", 2048),
+                prewarm_concurrency=self.settings.get_int(
+                    "search.tpu_serving.prewarm_concurrency", 4),
+                # persistent XLA compile cache colocated with the node's
+                # data (restart = cache replay, not recompilation);
+                # ES_TPU_JAX_CACHE_DIR still overrides
+                compile_cache_dir=self.settings.get(
+                    "search.tpu_serving.compile_cache_dir",
+                    _os.path.join(data_path, "jax_compile_cache")))
         from elasticsearch_tpu.common.threadpool import ThreadPools
         self.thread_pools = ThreadPools(self.settings)
         self.controller = RestController()
@@ -385,7 +395,11 @@ class _Handler(BaseHTTPRequestHandler):
             data = payload.encode("utf-8")
             ctype = "text/plain; charset=UTF-8"
         else:
-            data = json.dumps(payload).encode("utf-8")
+            # dumps_response renders embedded ColumnarHits blocks from
+            # their device-result columns in one pass (no per-hit dicts
+            # on the serving path); plain payloads serialize as before
+            from elasticsearch_tpu.search.serializer import dumps_response
+            data = dumps_response(payload).encode("utf-8")
             ctype = "application/json; charset=UTF-8"
         self.send_response(status)
         self.send_header("Content-Type", ctype)

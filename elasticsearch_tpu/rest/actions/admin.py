@@ -153,12 +153,19 @@ def register(controller: RestController, node) -> None:
         return (200, {}) if names else (404, {})
 
     def put_mapping(req: RestRequest):
+        tpu = getattr(node, "tpu_search", None)
         if node.cluster is not None:
             for name in node.cluster.resolve_indices(req.param("index")):
                 node.cluster.put_mapping(name, req.body or {})
+                if tpu is not None:
+                    tpu.invalidate_plans(name)
             return 200, {"acknowledged": True}
         for name in resolve_indices(indices, req.param("index")):
             indices.index(name).mapper.merge(req.body or {})
+            if tpu is not None:
+                # lowered plans key on the mapping generation; purge the
+                # now-unreachable entries so the LRU doesn't carry them
+                tpu.invalidate_plans(name)
         indices.persist_metadata()  # mapping is part of gateway state
         return 200, {"acknowledged": True}
 

@@ -334,6 +334,18 @@ def register(controller: RestController, node) -> None:
     def do_alloc_explain(req: RestRequest):
         return 200, allocation_explain(node, req.body or {})
 
+    def do_tpu_stats(req: RestRequest):
+        # serving-path observability: stage timers (totals + per-query
+        # p50/p95/p99), plan/pack cache hit rates, prewarm progress and
+        # the kernel-path breaker state — the production view of what
+        # bench logs show offline
+        tpu = getattr(node, "tpu_search", None)
+        if tpu is None:
+            return 200, {"enabled": False}
+        out = {"enabled": True}
+        out.update(tpu.stats())
+        return 200, out
+
     controller.register("GET", "/_field_caps", do_field_caps)
     controller.register("POST", "/_field_caps", do_field_caps)
     controller.register("GET", "/{index}/_field_caps", do_field_caps)
@@ -355,3 +367,4 @@ def register(controller: RestController, node) -> None:
                         do_alloc_explain)
     controller.register("POST", "/_cluster/allocation/explain",
                         do_alloc_explain)
+    controller.register("GET", "/_tpu/stats", do_tpu_stats)

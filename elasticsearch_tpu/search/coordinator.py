@@ -764,13 +764,21 @@ def _search_fast(indices: IndicesService, names: List[str],
     if len(per_index) == 1:
         # single-index (the dominant case): the kernel result is already
         # merged best-first — the response window is a pair of array
-        # slices, no merge pass at all
+        # slices, no merge pass at all. The hits block stays COLUMNAR
+        # (a lazy ColumnarHits view): the REST layer serializes it
+        # straight from the arrays, and no per-hit dict exists unless an
+        # in-process consumer actually indexes into it.
+        from elasticsearch_tpu.search.serializer import ColumnarHits
         name, svc, res = per_index[0]
         scores = res.scores[from_: from_ + size]
         rows = res.rows[from_: from_ + size]
         ords = res.ords[from_: from_ + size]
-        hits_json = _assemble_hits(name, res.resident, scores, rows, ords,
-                                   source, version, seq_no_primary_term)
+        if res.resident is None or len(scores) == 0:
+            hits_json: Any = []
+        else:
+            hits_json = ColumnarHits(name, res.resident, scores, rows,
+                                     ords, source, version,
+                                     seq_no_primary_term)
         max_score = float(res.scores[0]) if len(res.scores) else None
     else:
         # cross-index merge: (score desc, index order, kernel rank) — the
@@ -821,34 +829,12 @@ def _assemble_hits(name: str, resident, scores, rows, ords, source,
     """Columnar window → response hit dicts. ids via one fancy-index;
     stored fields (when requested) read directly from the pinned
     segments the pack was scored against (same snapshot contract as the
-    fetch phase)."""
-    if resident is None or len(scores) == 0:
-        return []
-    ids = resident.resolve_ids(rows, ords).tolist()
-    scores_l = scores.tolist()
-    rows_l = rows.tolist()
-    ords_l = ords.tolist()
-    if source is False and not version and not seq_no_primary_term:
-        return [{"_index": name, "_id": i, "_score": s}
-                for i, s in zip(ids, scores_l)]
-    from elasticsearch_tpu.search.query_phase import _filter_source
-    segs = resident.row_segments
-    out = []
-    for i, s, row, o in zip(ids, scores_l, rows_l, ords_l):
-        doc: Dict[str, Any] = {"_index": name, "_id": i, "_score": s}
-        seg = segs[row]
-        if source is not False:
-            src = seg.stored_source[o]
-            if isinstance(source, (list, tuple)):
-                src = _filter_source(src or {}, list(source))
-            doc["_source"] = src
-        if version:
-            doc["_version"] = int(seg.doc_versions[o])
-        if seq_no_primary_term:
-            doc["_seq_no"] = int(seg.seq_nos[o])
-            doc["_primary_term"] = int(seg.primary_terms[o])
-        out.append(doc)
-    return out
+    fetch phase). Materialized form — callers that mutate hits (the
+    shard-group path tags `__shard`) or ship them over transport use
+    this; the local REST fast path uses the lazy ColumnarHits view."""
+    from elasticsearch_tpu.search.serializer import assemble_hits_list
+    return assemble_hits_list(name, resident, scores, rows, ords, source,
+                              version, seq_no_primary_term)
 
 
 # ----------------------------------------------------------------------

@@ -80,6 +80,30 @@ def _bucket(n: int, minimum: int = 1) -> int:
     return b
 
 
+def _analyzed_terms(ft, text) -> list:
+    """Analyze `text` through the field's search analyzer, memoized on
+    the FieldType instance. One query over an index re-analyzes the same
+    string once per segment view (and repeated query shapes re-analyze
+    it once per request); the memo collapses that to one analyzer run.
+    It lives on the FieldType, so a mapping update (which swaps the
+    FieldType) naturally drops it. Returns a fresh list — callers may
+    mutate their copy."""
+    text = str(text)
+    memo = getattr(ft, "_terms_memo", None)
+    if memo is None:
+        memo = {}
+        try:
+            ft._terms_memo = memo
+        except AttributeError:  # slotted/frozen field type: no memo
+            return ft.search_terms(text)
+    hit = memo.get(text)
+    if hit is None:
+        hit = ft.search_terms(text)
+        if len(memo) < 4096:  # bound pathological query cardinality
+            memo[text] = hit
+    return list(hit)
+
+
 class SegmentQueryExecutor:
     """Evaluates one parsed query against one segment view."""
 
@@ -659,7 +683,7 @@ class SegmentQueryExecutor:
         except _UnmappedField:
             return self._none()
         if isinstance(ft, TextFieldType):
-            terms = ft.search_terms(node.query)
+            terms = _analyzed_terms(ft, node.query)
         else:
             # match on keyword/numeric behaves like a term query
             terms = [ft.normalize_term(node.query)]
@@ -877,7 +901,7 @@ class SegmentQueryExecutor:
         if not isinstance(ft, TextFieldType):
             return self._eval_terms(node.field, [node.query], node.boost,
                                     scoring, "and", 1)
-        terms = ft.search_terms(node.query)
+        terms = _analyzed_terms(ft, node.query)
         if not terms:
             return self._none()
         seg = self.view.segment
@@ -997,7 +1021,7 @@ def _nested_object_matches(q: dsl.QueryNode, obj: Dict[str, list],
         if ft is None or not vals:
             return False
         if isinstance(ft, TextFieldType):
-            q_terms = ft.search_terms(q.query)
+            q_terms = _analyzed_terms(ft, q.query)
             if not q_terms:
                 return False
             doc_terms = set()

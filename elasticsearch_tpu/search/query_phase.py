@@ -292,16 +292,24 @@ def execute_fetch(reader: ShardReader, hits: List[ShardHit],
     return out
 
 
-def _filter_source(src: Dict[str, Any], includes: List[str]) -> Dict[str, Any]:
+def filter_source(src: Dict[str, Any],
+                  includes: List[str]) -> Dict[str, Any]:
+    """Project a stored _source onto an includes list (dotted paths
+    descend into objects). Shared by the planner fetch phase and the
+    TPU columnar serializer."""
     out: Dict[str, Any] = {}
     for key, value in src.items():
         for inc in includes:
             if key == inc or inc.startswith(key + ".") or key.startswith(inc + "."):
                 if isinstance(value, dict) and inc.startswith(key + "."):
-                    sub = _filter_source(value, [inc[len(key) + 1:]])
+                    sub = filter_source(value, [inc[len(key) + 1:]])
                     if sub:
                         out[key] = sub
                 else:
                     out[key] = value
                 break
     return out
+
+
+#: back-compat alias (pre-existing callers import the underscored name)
+_filter_source = filter_source

@@ -1,0 +1,186 @@
+"""Vectorized response assembly for the TPU serving path.
+
+The reference builds a SearchHit object per hit and serializes it
+field-by-field; at k=1000 that is ~1000 dict constructions + ~1000
+per-hit dumps per response and it shows up as the `assemble` stage in
+PERF.md (12.8 s over one bench run). Here the hot response shape —
+metadata-only hits (`"_source": false`), the shape high-QPS serving
+traffic uses — is serialized COLUMNAR: external ids resolve via one
+fancy-index over the pack's id table, ids and scores are JSON-encoded as
+whole arrays in single C-level `json.dumps` calls, and the hits block is
+assembled from the encoded fragments without ever constructing a per-hit
+dict (BM25S, arXiv 2407.03618: lexical serving throughput is won by
+moving per-item Python into batch array work).
+
+`ColumnarHits` is a lazy Sequence: in-process consumers (tests, ccs,
+rank_eval) that index or iterate it see ordinary hit dicts — built once,
+on first touch, via the same assembly loop the planner path uses — while
+the REST layer serializes it straight from the columns via
+`dumps_response` without materializing anything.
+"""
+
+from __future__ import annotations
+
+import json
+from collections.abc import Sequence
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ColumnarHits", "assemble_hits_list", "dumps_response"]
+
+
+def assemble_hits_list(name: str, resident, scores, rows, ords, source,
+                       version: bool, seq_no_primary_term: bool
+                       ) -> List[Dict[str, Any]]:
+    """Columnar window → response hit dicts (the materialized form).
+    ids via one fancy-index; stored fields (when requested) read
+    directly from the pinned segments the pack was scored against (same
+    snapshot contract as the fetch phase)."""
+    if resident is None or len(scores) == 0:
+        return []
+    ids = resident.resolve_ids(rows, ords).tolist()
+    scores_l = scores.tolist()
+    if source is False and not version and not seq_no_primary_term:
+        return [{"_index": name, "_id": i, "_score": s}
+                for i, s in zip(ids, scores_l)]
+    from elasticsearch_tpu.search.query_phase import filter_source
+    segs = resident.row_segments
+    rows_l = rows.tolist()
+    ords_l = ords.tolist()
+    out = []
+    for i, s, row, o in zip(ids, scores_l, rows_l, ords_l):
+        doc: Dict[str, Any] = {"_index": name, "_id": i, "_score": s}
+        seg = segs[row]
+        if source is not False:
+            src = seg.stored_source[o]
+            if isinstance(source, (list, tuple)):
+                src = filter_source(src or {}, list(source))
+            doc["_source"] = src
+        if version:
+            doc["_version"] = int(seg.doc_versions[o])
+        if seq_no_primary_term:
+            doc["_seq_no"] = int(seg.seq_nos[o])
+            doc["_primary_term"] = int(seg.primary_terms[o])
+        out.append(doc)
+    return out
+
+
+class ColumnarHits(Sequence):
+    """Lazy hits block over kernel result columns.
+
+    Reads like a list of hit dicts (len / index / slice / iterate);
+    materializes that list at most once and caches it, so consumers that
+    MUTATE hits (ccs rewrites `_index`) keep their edits visible to a
+    later serialization. `to_json()` renders the block; for the
+    metadata-only shape it never touches per-hit Python at all."""
+
+    __slots__ = ("name", "resident", "scores", "rows", "ords", "source",
+                 "version", "seq_no_primary_term", "_hits")
+
+    def __init__(self, name: str, resident, scores, rows, ords,
+                 source=False, version: bool = False,
+                 seq_no_primary_term: bool = False):
+        self.name = name
+        self.resident = resident
+        self.scores = scores
+        self.rows = rows
+        self.ords = ords
+        self.source = source
+        self.version = version
+        self.seq_no_primary_term = seq_no_primary_term
+        self._hits: Optional[List[Dict[str, Any]]] = None
+
+    # ---- list protocol --------------------------------------------------
+
+    def _materialize(self) -> List[Dict[str, Any]]:
+        if self._hits is None:
+            self._hits = assemble_hits_list(
+                self.name, self.resident, self.scores, self.rows,
+                self.ords, self.source, self.version,
+                self.seq_no_primary_term)
+        return self._hits
+
+    def __len__(self) -> int:
+        return len(self.scores)
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __eq__(self, other):
+        if isinstance(other, ColumnarHits):
+            other = other._materialize()
+        if isinstance(other, list):
+            return self._materialize() == other
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"ColumnarHits(n={len(self.scores)}, index={self.name!r})"
+
+    # ---- serialization --------------------------------------------------
+
+    def to_json(self) -> str:
+        if self._hits is not None:
+            # already materialized (possibly mutated) — honor the dicts
+            return json.dumps(self._hits, separators=(",", ":"))
+        fast = self._fast_json()
+        if fast is not None:
+            return fast
+        return json.dumps(self._materialize(), separators=(",", ":"))
+
+    def _fast_json(self) -> Optional[str]:
+        """Single-pass serialization of the metadata-only shape, or None
+        when this block needs the materialized path (_source / _version
+        / seq_no, or non-string ids)."""
+        if not (self.source is False and not self.version
+                and not self.seq_no_primary_term):
+            return None
+        if self.resident is None or len(self.scores) == 0:
+            return "[]"
+        ids = self.resident.resolve_ids(self.rows, self.ords).tolist()
+        if not all(type(i) is str for i in ids):
+            return None
+        # one C-level dumps per column, then split into per-hit
+        # fragments. Splitting the id array on '","' is exact: inside an
+        # encoded JSON string a quote can only appear escaped (\"), so
+        # the quote-comma-quote byte sequence occurs ONLY between
+        # adjacent array elements.
+        ids_json = json.dumps(ids, separators=(",", ":"))
+        core = ids_json[1:-1]
+        parts = core.split('","')
+        if len(parts) == 1:
+            id_frags = [core]
+        else:
+            id_frags = [parts[0] + '"']
+            id_frags.extend('"' + p + '"' for p in parts[1:-1])
+            id_frags.append('"' + parts[-1])
+        # floats contain no commas, so the score array splits trivially
+        score_frags = json.dumps(
+            self.scores.tolist(), separators=(",", ":"))[1:-1].split(",")
+        prefix = '{"_index":' + json.dumps(self.name) + ',"_id":'
+        mid = ',"_score":'
+        return "[" + ",".join(
+            prefix + i + mid + s + "}"
+            for i, s in zip(id_frags, score_frags)) + "]"
+
+
+def dumps_response(payload: Any) -> str:
+    """json.dumps that renders embedded ColumnarHits blocks via their
+    columnar serializer. Works at any nesting depth (plain search,
+    msearch `responses`, ...): the encoder emits a unique placeholder
+    token per block, then the tokens are spliced with the real JSON."""
+    blocks: Dict[str, ColumnarHits] = {}
+
+    def default(obj):
+        if isinstance(obj, ColumnarHits):
+            token = f"\x00columnar:{id(obj)}\x00"
+            blocks[token] = obj
+            return token
+        raise TypeError(
+            f"Object of type {type(obj).__name__} is not JSON serializable")
+
+    text = json.dumps(payload, default=default)
+    for token, block in blocks.items():
+        text = text.replace(json.dumps(token), block.to_json())
+    return text

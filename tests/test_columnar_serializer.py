@@ -1,0 +1,121 @@
+"""Correctness of the columnar response serializer: the fast metadata-only
+JSON path must be byte-level-safe for hostile ids (quotes, commas,
+backslashes, unicode), fall back to materialized hits for richer shapes,
+and honor consumer mutations (ccs rewrites `_index` in place)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.indices.service import IndicesService
+from elasticsearch_tpu.search import coordinator
+from elasticsearch_tpu.search.serializer import (ColumnarHits,
+                                                 assemble_hits_list,
+                                                 dumps_response)
+from elasticsearch_tpu.search.tpu_service import TpuSearchService
+
+EVIL_IDS = ['plain', 'has"quote', 'has,comma', 'has","both', 'back\\slash',
+            'unié中', 'tab\there', '{"j":1}', "'single'",
+            '":","']
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    svc = IndicesService(str(tmp_path))
+    idx = svc.create_index(
+        "corpus", Settings.of({"index": {"number_of_shards": 1}}),
+        {"properties": {"body": {"type": "text"}}})
+    for i, doc_id in enumerate(EVIL_IDS):
+        idx.shard(idx.shard_for_id(doc_id)).apply_index_on_primary(
+            doc_id, {"body": "alpha " * (i + 1)})
+    idx.refresh()
+    yield svc, idx
+    svc.close()
+
+
+def _search(svc, tpu, body):
+    return coordinator.search(svc, "corpus", dict(body), tpu_search=tpu)
+
+
+BODY = {"query": {"match": {"body": "alpha"}}, "size": 20,
+        "_source": False}
+
+
+def test_fast_json_hostile_ids_round_trip(corpus):
+    svc, idx = corpus
+    tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
+    try:
+        resp = _search(svc, tpu, BODY)
+        hits = resp["hits"]["hits"]
+        assert isinstance(hits, ColumnarHits)
+        assert tpu.served == 1
+        fast = json.loads(hits.to_json())
+        slow = assemble_hits_list(
+            hits.name, hits.resident, hits.scores, hits.rows, hits.ords,
+            False, False, False)
+        assert fast == json.loads(json.dumps(slow))
+        assert sorted(h["_id"] for h in fast) == sorted(EVIL_IDS)
+    finally:
+        tpu.close()
+
+
+def test_dumps_response_matches_plain_dumps(corpus):
+    svc, idx = corpus
+    tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
+    try:
+        resp = _search(svc, tpu, BODY)
+        assert isinstance(resp["hits"]["hits"], ColumnarHits)
+        fast_payload = json.loads(dumps_response(resp))
+        # reference: force-materialize and use stock json
+        resp["hits"]["hits"] = list(resp["hits"]["hits"])
+        ref_payload = json.loads(json.dumps(resp))
+        assert fast_payload == ref_payload
+    finally:
+        tpu.close()
+
+
+def test_source_shape_falls_back_to_materialized(corpus):
+    svc, idx = corpus
+    tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
+    try:
+        body = dict(BODY)
+        body["_source"] = True
+        resp = _search(svc, tpu, body)
+        hits = resp["hits"]["hits"]
+        assert isinstance(hits, ColumnarHits)
+        assert hits._fast_json() is None  # not the metadata-only shape
+        parsed = json.loads(hits.to_json())
+        assert all("_source" in h and "body" in h["_source"]
+                   for h in parsed)
+    finally:
+        tpu.close()
+
+
+def test_mutations_survive_serialization(corpus):
+    svc, idx = corpus
+    tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
+    try:
+        resp = _search(svc, tpu, BODY)
+        hits = resp["hits"]["hits"]
+        assert isinstance(hits, ColumnarHits)
+        hits[0]["_index"] = "remote:corpus"  # what ccs does
+        parsed = json.loads(dumps_response(resp))
+        assert parsed["hits"]["hits"][0]["_index"] == "remote:corpus"
+    finally:
+        tpu.close()
+
+
+def test_empty_hits_fast_path():
+    import numpy as np
+    empty = np.empty(0, dtype=np.float32)
+    rows = np.empty(0, dtype=np.int32)
+    h = ColumnarHits("i", None, empty, rows, rows, False, False, False)
+    assert h.to_json() == "[]"
+    assert len(h) == 0 and list(h) == []
+
+
+def test_dumps_response_without_columnar_is_plain_json():
+    payload = {"took": 1, "hits": {"total": {"value": 0, "relation": "eq"},
+                                   "hits": []}}
+    assert json.loads(dumps_response(payload)) == payload
