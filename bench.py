@@ -42,6 +42,28 @@ def _env(name: str, default: int) -> int:
     return int(os.environ.get(f"ES_TPU_BENCH_{name}", default))
 
 
+def _slowest_trace(tracer):
+    """Per-stage breakdown of the slowest sampled _search trace: where
+    did the worst query's time actually go (batch wait vs kernel vs
+    assembly), not just the total."""
+    if tracer is None:
+        return None
+    roots = [s for s in tracer.spans(limit=0)
+             if s["parent_id"] is None and s["name"].endswith("_search")]
+    if not roots:
+        return None
+    worst = max(roots, key=lambda s: s["duration_ms"])
+    stages_ms = {}
+    for s in tracer.trace(worst["trace_id"]):
+        if s["span_id"] == worst["span_id"]:
+            continue
+        stages_ms[s["name"]] = round(
+            stages_ms.get(s["name"], 0.0) + s["duration_ms"], 3)
+    return {"trace_id": worst["trace_id"],
+            "total_ms": round(worst["duration_ms"], 3),
+            "stages_ms": stages_ms}
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -78,9 +100,15 @@ def main() -> None:
     # #3): the pack build + XLA compiles happen in the explicit prewarm
     # step below (the reference's index-warmer seam), and the persistent
     # compilation cache makes warmed machines start in seconds
+    # trace a small sample of load queries so the result line can show
+    # WHERE the slowest query's time went (0 disables entirely)
+    trace_sample = float(os.environ.get("ES_TPU_BENCH_TRACE_SAMPLE",
+                                        "0.05"))
     node = Node(tempfile.mkdtemp(prefix="es_tpu_bench_"),
                 settings=Settings.of({
-                    "index": {"translog": {"durability": "async"}}}))
+                    "index": {"translog": {"durability": "async"}},
+                    "search": {"tracing": {
+                        "sample_rate": trace_sample}}}))
     t0 = time.perf_counter()  # bulk ingest + refresh-to-searchable
     idx = node.create_index(
         "bench", Settings.of({"index": {
@@ -206,6 +234,7 @@ def main() -> None:
     qps = total_queries / dt
     st = node.tpu_search.stats() if node.tpu_search else {}
     out["stages"] = st.get("stages")
+    out["slowest_trace"] = _slowest_trace(getattr(node, "tracer", None))
     if errors:
         out["error"] = f"search errors during load: {str(errors[0])[:300]}"
         out["value"] = round(qps, 2)

@@ -43,6 +43,7 @@ from elasticsearch_tpu.common.errors import (EsException,
                                              IndexNotFoundException,
                                              NoShardAvailableActionException,
                                              shard_failure_entry)
+from elasticsearch_tpu.common import tracing
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.translog import write_atomic
 from elasticsearch_tpu.transport.retry import (RetryPolicy, is_retryable,
@@ -1469,13 +1470,17 @@ class ClusterService:
         payload = {"targets": targets, "body": body, "params": params,
                    "index_filters": alias_filters}
         r0 = time.perf_counter()
-        if retry:
-            out = send_with_retry(self.transport, addr[node_id],
-                                  ACTION_QUERY_GROUP, payload,
-                                  policy=self.FANOUT_RETRY)
-        else:
-            out = self.transport.send_request(
-                addr[node_id], ACTION_QUERY_GROUP, payload, timeout=60.0)
+        with tracing.child_span("transport.fanout", node=node_id,
+                                shards=len(targets), retry=retry) as span:
+            tracing.inject_context(payload, span)
+            if retry:
+                out = send_with_retry(self.transport, addr[node_id],
+                                      ACTION_QUERY_GROUP, payload,
+                                      policy=self.FANOUT_RETRY)
+            else:
+                out = self.transport.send_request(
+                    addr[node_id], ACTION_QUERY_GROUP, payload,
+                    timeout=60.0)
         self.record_node_latency(node_id, time.perf_counter() - r0)
         return out
 
@@ -1494,6 +1499,8 @@ class ClusterService:
             shard_failure_entry(n, s, NoShardAvailableActionException(
                 f"no active shard copy for [{n}][{s}]"))
             for n, s in unassigned]
+        for n, s in unassigned:  # terminal by definition: no copy exists
+            self.node.indices.count_search_failure(n, s)
         knn_failed = 0
         if body and body.get("knn") is not None:
             body, knn_failed = self._resolve_knn_phase(
@@ -1501,14 +1508,25 @@ class ClusterService:
 
         futures: List[Tuple[str, Any]] = []
         local_targets: Optional[List[Tuple[str, int]]] = None
+        # one fanout child span per remote node, spanning dispatch →
+        # gather; the trace context rides in the payload so the remote
+        # handler continues the same trace
+        root_span = tracing.current_span()
+        fanout_spans: Dict[str, Any] = {}
         for node_id, targets in sorted(by_node.items()):
             if node_id == self.local_node.node_id:
                 local_targets = targets
                 continue
+            payload = {"targets": targets, "body": body, "params": params,
+                       "index_filters": alias_filters}
+            if root_span is not None:
+                span = root_span.tracer.start_span(
+                    "transport.fanout", parent=root_span,
+                    attributes={"node": node_id, "shards": len(targets)})
+                tracing.inject_context(payload, span)
+                fanout_spans[node_id] = span
             fut = self.transport.send_request_async(
-                addr[node_id], ACTION_QUERY_GROUP,
-                {"targets": targets, "body": body, "params": params,
-                 "index_filters": alias_filters})
+                addr[node_id], ACTION_QUERY_GROUP, payload)
             futures.append((node_id, fut))
 
         # gather; a failed copy — whole group OR single shard inside a
@@ -1549,6 +1567,7 @@ class ClusterService:
             if task is not None:
                 task.ensure_not_cancelled()
             r0 = time.perf_counter()
+            span = fanout_spans.pop(node_id, None)
             try:
                 absorb(fut.result(timeout=60.0), node_id)
                 self.record_node_latency(node_id,
@@ -1556,7 +1575,13 @@ class ClusterService:
             except Exception as exc:  # noqa: BLE001 — shard-group failure
                 logger.warning("search group on [%s] failed: %s",
                                node_id, exc)
+                if span is not None:
+                    span.set_attribute("error",
+                                       f"{type(exc).__name__}: {exc}")
                 group_failed(node_id, by_node.get(node_id, []), exc)
+            finally:
+                if span is not None:
+                    span.end()
 
         # failover rounds: each still-failed shard moves to its best
         # untried copy until copies run out (tried sets grow every
@@ -1569,6 +1594,13 @@ class ClusterService:
                 cands = [nid for nid in ranked_copies.get(key, [])
                          if nid not in tried.get(key, set())]
                 if not cands:
+                    # TERMINAL: every copy tried and failed — this is
+                    # the failure the response reports, so it's the one
+                    # the per-shard counter records
+                    self.node.indices.count_search_failure(key[0], key[1])
+                    tracing.add_event("shard.failed", index=key[0],
+                                      shard=key[1],
+                                      reason=entry.get("reason", {}))
                     failures.append(entry)
                     del retry_q[key]
                     continue
@@ -1698,10 +1730,20 @@ class ClusterService:
     def _handle_query_group(self, payload, from_node) -> Dict[str, Any]:
         from elasticsearch_tpu.search import coordinator as coord
         targets = [(t[0], int(t[1])) for t in payload["targets"]]
-        return coord.search_shard_group(
-            self.node.indices, targets, payload.get("body"),
-            payload.get("params"), tpu_search=self.node.tpu_search,
-            index_filters=payload.get("index_filters"))
+        # continue the coordinating node's trace on this shard node: the
+        # payload carries the fanout span's context, so the per-shard
+        # query + TPU stage spans recorded here share its trace id
+        ctx = tracing.extract_context(payload)
+        span = self.node.tracer.start_span(
+            "shard_group", parent=ctx,
+            attributes={"from": (from_node or {}).get("name"),
+                        "shards": len(targets)})
+        with span, tracing.use_span(span):
+            return coord.search_shard_group(
+                self.node.indices, targets, payload.get("body"),
+                payload.get("params"),
+                tpu_search=self.node.tpu_search,
+                index_filters=payload.get("index_filters"))
 
     def route_count(self, index_expr: Optional[str],
                     body: Optional[Dict[str, Any]]) -> Dict[str, Any]:

@@ -159,8 +159,220 @@ def stats_to_xcontent(stats: Dict[str, Any]) -> Dict[str, Any]:
             out[k] = {"count": v.count, "total_millis": v.sum, "mean_millis": v.mean}
         elif isinstance(v, EWMA):
             out[k] = v.value
+        elif isinstance(v, SampleRing):
+            out[k] = {f"p{p:g}": val for p, val in v.percentiles().items()}
         elif isinstance(v, dict):
             out[k] = stats_to_xcontent(v)
         else:
             out[k] = v
     return out
+
+
+# ---------------------------------------------------------------------------
+# unified metrics registry + Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+#: quantiles exported for summary-typed families (SampleRing)
+SUMMARY_QUANTILES = (50.0, 95.0, 99.0)
+
+_VALID_KINDS = ("counter", "gauge", "summary")
+
+
+def _infer_kind(metric: Any) -> str:
+    if isinstance(metric, CounterMetric):
+        return "counter"
+    if isinstance(metric, (MeanMetric, SampleRing)):
+        return "summary"
+    return "gauge"  # EWMA, callables, raw numbers
+
+
+def _escape_label(value: Any) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """One node-wide catalog of every metric, scraped as Prometheus text.
+
+    Two registration styles:
+
+      * ``register(name, metric, labels=..., help=...)`` — a static entry
+        for a metric object that lives as long as the node.
+      * ``add_collector(fn)`` — for dynamic families (per-stage rings,
+        per-shard failure counters, pools created later). ``fn`` is
+        called at scrape time and yields
+        ``(dotted_name, labels_dict, metric_or_value)`` or
+        ``(dotted_name, labels_dict, value, kind)`` tuples.
+
+    Dotted names become Prometheus families under the ``es_tpu``
+    namespace: ``search.plan_cache.hits`` → ``es_tpu_search_plan_cache_
+    hits_total`` (counters get the ``_total`` suffix). CounterMetric →
+    counter; EWMA/callable/raw number → gauge; MeanMetric → summary
+    (_count/_sum); SampleRing → summary with 50/95/99 quantiles.
+    """
+
+    def __init__(self, namespace: str = "es_tpu"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        #: name -> list of (labels, metric, kind, help)
+        self._static: Dict[str, list] = {}
+        self._help: Dict[str, str] = {}
+        self._collectors: list = []
+
+    # -- registration -----------------------------------------------------
+
+    def register(self, name: str, metric: Any, *,
+                 labels: Dict[str, Any] = None,
+                 kind: str = None, help: str = "") -> Any:
+        kind = kind or _infer_kind(metric)
+        if kind not in _VALID_KINDS:
+            raise ValueError(f"unknown metric kind {kind!r}")
+        with self._lock:
+            prior = self._static.get(name)
+            if prior and prior[0][2] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{prior[0][2]}, cannot re-register as {kind}")
+            self._static.setdefault(name, []).append(
+                (dict(labels or {}), metric, kind))
+            if help and name not in self._help:
+                self._help[name] = help
+        return metric
+
+    def set_help(self, name: str, help: str) -> None:
+        with self._lock:
+            self._help.setdefault(name, help)
+
+    def add_collector(self, fn) -> None:
+        """fn() yields (name, labels, metric_or_value[, kind]) tuples at
+        scrape time — for families whose member set changes at runtime."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    # -- scraping ---------------------------------------------------------
+
+    def _samples(self):
+        """→ list of (name, labels, metric_or_value, kind)."""
+        with self._lock:
+            static = [(n, lb, m, k)
+                      for n, entries in self._static.items()
+                      for (lb, m, k) in entries]
+            collectors = list(self._collectors)
+        out = list(static)
+        for fn in collectors:
+            try:
+                rows = list(fn())
+            except Exception:
+                continue  # a broken subsystem must not break the scrape
+            for row in rows:
+                if len(row) == 4:
+                    name, labels, metric, kind = row
+                else:
+                    name, labels, metric = row
+                    kind = _infer_kind(metric)
+                out.append((name, dict(labels or {}), metric, kind))
+        return out
+
+    def registered_objects(self) -> set:
+        """ids of every *metric object* (not raw values) the registry can
+        see — static and collector-yielded. Used by the completeness test
+        to catch subsystems that expose metrics without registering."""
+        ids = set()
+        for _name, _labels, metric, _kind in self._samples():
+            if isinstance(metric, (CounterMetric, MeanMetric, EWMA,
+                                   SampleRing)):
+                ids.add(id(metric))
+        return ids
+
+    def families(self) -> Dict[str, str]:
+        """dotted name -> kind, for every currently-visible family."""
+        fams: Dict[str, str] = {}
+        for name, _labels, _metric, kind in self._samples():
+            prior = fams.setdefault(name, kind)
+            if prior != kind:
+                raise ValueError(
+                    f"metric {name!r} exposed as both {prior} and {kind}")
+        return fams
+
+    def _family_name(self, dotted: str, kind: str) -> str:
+        base = f"{self.namespace}_" + dotted.replace(".", "_")
+        if kind == "counter" and not base.endswith("_total"):
+            base += "_total"
+        return base
+
+    @staticmethod
+    def _value_of(metric: Any) -> float:
+        if isinstance(metric, CounterMetric):
+            return metric.count
+        if isinstance(metric, EWMA):
+            return metric.value
+        if callable(metric):
+            return float(metric())
+        return float(metric)
+
+    def prometheus_text(self) -> str:
+        """Standard text exposition: one # HELP / # TYPE per family, then
+        its samples; families sorted by name for stable scrapes."""
+        groups: Dict[str, list] = {}
+        kinds: Dict[str, str] = {}
+        helps: Dict[str, str] = {}
+        with self._lock:
+            help_snapshot = dict(self._help)
+        for name, labels, metric, kind in self._samples():
+            fam = self._family_name(name, kind)
+            if kinds.setdefault(fam, kind) != kind:
+                raise ValueError(
+                    f"metric family {fam!r} exposed as both "
+                    f"{kinds[fam]} and {kind}")
+            helps.setdefault(fam, help_snapshot.get(name, name))
+            groups.setdefault(fam, []).append((labels, metric, kind))
+        lines = []
+        for fam in sorted(groups):
+            kind = kinds[fam]
+            lines.append(f"# HELP {fam} {helps[fam]}")
+            lines.append(f"# TYPE {fam} {kind}")
+            for labels, metric, _k in groups[fam]:
+                if kind == "summary" and isinstance(metric, SampleRing):
+                    pcts = metric.percentiles(SUMMARY_QUANTILES)
+                    snap = metric.samples()
+                    for q in SUMMARY_QUANTILES:
+                        ql = dict(labels)
+                        ql["quantile"] = f"{q / 100.0:g}"
+                        lines.append(
+                            f"{fam}{_fmt_labels(ql)} "
+                            f"{_fmt_value(pcts.get(q, float('nan')))}")
+                    lines.append(f"{fam}_count{_fmt_labels(labels)} "
+                                 f"{len(snap)}")
+                    lines.append(f"{fam}_sum{_fmt_labels(labels)} "
+                                 f"{_fmt_value(sum(snap))}")
+                elif kind == "summary" and isinstance(metric, MeanMetric):
+                    lines.append(f"{fam}_count{_fmt_labels(labels)} "
+                                 f"{metric.count}")
+                    lines.append(f"{fam}_sum{_fmt_labels(labels)} "
+                                 f"{_fmt_value(metric.sum)}")
+                else:
+                    lines.append(f"{fam}{_fmt_labels(labels)} "
+                                 f"{_fmt_value(self._value_of(metric))}")
+        return "\n".join(lines) + ("\n" if lines else "")

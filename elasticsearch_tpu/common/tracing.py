@@ -1,0 +1,416 @@
+"""Distributed tracing — spans, context propagation, a bounded ring of
+finished spans per node.
+
+Reference analog: the `tracing/` Task/APM layer (SURVEY.md §2.1#47-ish):
+the REST layer opens (or adopts, via a W3C `traceparent`-style header) a
+root span per request; the coordinator attaches the trace context to
+every transport fan-out payload; shard-side handlers continue the span;
+the TPU serving pipeline reports its stage boundaries as child spans.
+
+Design constraints:
+
+  * **Zero overhead when disabled.** `search.tracing.sample_rate = 0`
+    (the default) must add nothing measurable to the hostpath: every
+    instrumentation helper's disabled path is one thread-local read plus
+    a None check, allocating nothing.
+  * **Bounded memory.** Finished spans land in a deque ring
+    (`search.tracing.max_spans`); old traces fall off the end.
+  * **Head sampling.** The root makes the sampling decision; the
+    decision travels in the `traceparent` flags byte, so a fan-out child
+    never re-rolls the dice (one trace is complete or absent, never
+    partial by chance).
+
+Slow traces: a root span finishing above
+`search.tracing.slow_threshold_ms` is emitted through the slowlog
+channel (`elasticsearch_tpu.trace.slowlog`) with its per-stage
+breakdown, same spirit as the per-shard search slowlog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import random
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+slowlog = logging.getLogger("elasticsearch_tpu.trace.slowlog")
+
+#: wire context: (trace_id, parent span_id, sampled)
+WireContext = Tuple[str, str, bool]
+
+_tls = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# traceparent encoding (W3C trace-context shaped: 00-<trace>-<span>-<flags>)
+# ---------------------------------------------------------------------------
+
+def format_traceparent(trace_id: str, span_id: str,
+                       sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[WireContext]:
+    """→ (trace_id, span_id, sampled), or None for anything malformed
+    (a bad header must never fail the request it rode in on)."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _ver, trace_id, span_id, flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return trace_id, span_id, flags == "01"
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class Span:
+    """One timed operation. Mutated only by the thread that runs the
+    operation; `end()` hands the finished record to the tracer ring."""
+
+    __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
+                 "start", "_start_pc", "duration_ms", "attributes",
+                 "events", "root", "_ended")
+
+    is_recording = True
+
+    def __init__(self, tracer: "Tracer", trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str,
+                 attributes: Optional[Dict[str, Any]] = None,
+                 root: bool = False,
+                 start: Optional[float] = None,
+                 duration_s: Optional[float] = None):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = time.time() if start is None else start
+        self._start_pc = time.perf_counter()
+        self.duration_ms: Optional[float] = (
+            None if duration_s is None else duration_s * 1000.0)
+        self.attributes: Dict[str, Any] = dict(attributes) if attributes \
+            else {}
+        self.events: List[Dict[str, Any]] = []
+        self.root = root
+        self._ended = False
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        self.events.append({"name": name, "time": time.time(),
+                            **attributes})
+
+    def context(self) -> WireContext:
+        return self.trace_id, self.span_id, True
+
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id, True)
+
+    def end(self) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        if self.duration_ms is None:
+            self.duration_ms = (time.perf_counter()
+                                - self._start_pc) * 1000.0
+        self.tracer._finish(self)
+
+    # context-manager form: exceptions annotate the span, then reraise
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.set_attribute("error", f"{type(exc).__name__}: {exc}")
+        self.end()
+        return False
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"trace_id": self.trace_id, "span_id": self.span_id,
+               "parent_id": self.parent_id, "name": self.name,
+               "start": self.start,
+               "duration_ms": round(self.duration_ms or 0.0, 3),
+               "node": self.tracer.node_name}
+        if self.attributes:
+            out["attributes"] = dict(self.attributes)
+        if self.events:
+            out["events"] = list(self.events)
+        return out
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled/unsampled path. All mutators
+    are no-ops and `is_recording` is False so callers can skip work."""
+
+    __slots__ = ()
+    is_recording = False
+    trace_id = span_id = parent_id = name = ""
+    attributes: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        pass
+
+    def context(self) -> None:
+        return None
+
+    def end(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+class Tracer:
+    """Per-node span factory + bounded ring of finished spans."""
+
+    def __init__(self, sample_rate: float = 0.0, max_spans: int = 4096,
+                 slow_threshold_ms: Optional[float] = None,
+                 node_name: str = "",
+                 rng: Optional[random.Random] = None):
+        self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
+        self.slow_threshold_ms = slow_threshold_ms
+        self.node_name = node_name
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=max(1, int(max_spans)))
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample_rate > 0.0
+
+    def start_span(self, name: str,
+                   parent: Any = None,
+                   attributes: Optional[Dict[str, Any]] = None,
+                   root: bool = False,
+                   start: Optional[float] = None,
+                   duration_s: Optional[float] = None):
+        """`parent`: a live Span (local child), a WireContext tuple
+        (continuation of a remote span — the remote sampling decision
+        wins, even over a local sample_rate of 0), or None (a new root,
+        subject to this tracer's sample_rate)."""
+        if isinstance(parent, Span):
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif isinstance(parent, _NoopSpan):
+            return NOOP_SPAN
+        elif isinstance(parent, tuple):
+            trace_id, parent_id, sampled = parent
+            if not sampled:
+                return NOOP_SPAN
+        elif parent is None:
+            if self.sample_rate <= 0.0 or (
+                    self.sample_rate < 1.0
+                    and self._rng.random() >= self.sample_rate):
+                return NOOP_SPAN
+            trace_id, parent_id = uuid.uuid4().hex, None
+        else:
+            return NOOP_SPAN
+        return Span(self, trace_id, uuid.uuid4().hex[:16], parent_id,
+                    name, attributes, root=root, start=start,
+                    duration_s=duration_s)
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+        if (span.root and self.slow_threshold_ms is not None
+                and span.duration_ms is not None
+                and span.duration_ms >= self.slow_threshold_ms
+                and slowlog.isEnabledFor(logging.WARNING)):
+            self._emit_slow(span)
+
+    def _emit_slow(self, span: Span) -> None:
+        children = sorted(
+            (s for s in self.spans(trace_id=span.trace_id, limit=0)
+             if s["span_id"] != span.span_id),
+            key=lambda s: -s["duration_ms"])[:8]
+        breakdown = ", ".join(f"{s['name']}={s['duration_ms']:.1f}ms"
+                              for s in children) or "no child spans"
+        slowlog.warning(
+            "slow trace [%s] [%s] took %.1fms (threshold %.0fms): %s",
+            span.trace_id, span.name, span.duration_ms,
+            self.slow_threshold_ms, breakdown)
+
+    def spans(self, trace_id: Optional[str] = None,
+              min_duration_ms: float = 0.0,
+              limit: int = 200) -> List[Dict[str, Any]]:
+        """Finished spans, NEWEST first. limit=0 → no cap."""
+        with self._lock:
+            snap = list(self._spans)
+        out = []
+        for span in reversed(snap):
+            if trace_id is not None and span.trace_id != trace_id:
+                continue
+            if min_duration_ms and (span.duration_ms or 0.0) \
+                    < min_duration_ms:
+                continue
+            out.append(span.to_dict())
+            if limit and len(out) >= limit:
+                break
+        return out
+
+    def trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Every retained span of one trace, in start order."""
+        got = self.spans(trace_id=trace_id, limit=0)
+        got.sort(key=lambda s: s["start"])
+        return got
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+# ---------------------------------------------------------------------------
+# thread-local current span + instrumentation helpers
+#
+# The helpers below are the only API instrumented code needs: they read
+# the CURRENT span from a thread-local, so deep call stacks (coordinator
+# → planner → kernel service) need no tracer plumbing, and a node's
+# handler threads never mix spans across concurrent requests. Every
+# disabled-path costs one getattr + None check.
+# ---------------------------------------------------------------------------
+
+def current_span() -> Optional[Span]:
+    """The thread's current RECORDING span, or None."""
+    span = getattr(_tls, "span", None)
+    if span is None or not span.is_recording:
+        return None
+    return span
+
+
+@contextlib.contextmanager
+def use_span(span) -> Iterator[Any]:
+    """Make `span` current for the block. Does NOT end the span — the
+    owner ends it (lets a span outlive the block that populated it)."""
+    prev = getattr(_tls, "span", None)
+    _tls.span = span
+    try:
+        yield span
+    finally:
+        _tls.span = prev
+
+
+class _NoopCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return NOOP_SPAN
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP_CTX = _NoopCtx()
+
+
+class _ChildCtx:
+    """Starts a child of `parent`, makes it current, ends it on exit."""
+
+    __slots__ = ("span", "_prev")
+
+    def __init__(self, span: Span):
+        self.span = span
+
+    def __enter__(self) -> Span:
+        self._prev = getattr(_tls, "span", None)
+        _tls.span = self.span
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _tls.span = self._prev
+        if exc is not None:
+            self.span.set_attribute("error",
+                                    f"{type(exc).__name__}: {exc}")
+        self.span.end()
+        return False
+
+
+def child_span(name: str, **attributes: Any):
+    """Context manager: a child span of the thread's current span
+    (no-op — shared singleton, zero allocation — when not tracing)."""
+    cur = getattr(_tls, "span", None)
+    if cur is None or not cur.is_recording:
+        return _NOOP_CTX
+    return _ChildCtx(cur.tracer.start_span(
+        name, parent=cur, attributes=attributes or None))
+
+
+def span_under(parent: Optional[Span], name: str, **attributes: Any):
+    """Like `child_span` but under an EXPLICIT parent — for work that
+    hops threads (micro-batcher workers) where the thread-local of the
+    submitting request is unavailable."""
+    if parent is None or not parent.is_recording:
+        return _NOOP_CTX
+    return _ChildCtx(parent.tracer.start_span(
+        name, parent=parent, attributes=attributes or None))
+
+
+def record_stage(name: str, seconds: float, n: int = 1,
+                 **attributes: Any) -> None:
+    """Record an ALREADY-MEASURED duration as a completed child span of
+    the current span (start back-dated by the duration). This is how
+    stage timers (StageTimes) reconcile with traces: the span duration
+    is the same dt the stats ring recorded."""
+    cur = getattr(_tls, "span", None)
+    if cur is None or not cur.is_recording:
+        return
+    if n > 1:
+        attributes = dict(attributes or {})
+        attributes["count"] = n
+    span = cur.tracer.start_span(
+        name, parent=cur, attributes=attributes or None,
+        start=time.time() - seconds, duration_s=seconds)
+    span.end()
+
+
+def add_event(name: str, **attributes: Any) -> None:
+    """Attach an event to the current span (no-op when not tracing)."""
+    cur = getattr(_tls, "span", None)
+    if cur is None or not cur.is_recording:
+        return
+    cur.add_event(name, **attributes)
+
+
+def inject_context(payload: Dict[str, Any],
+                   span: Optional[Span] = None) -> Dict[str, Any]:
+    """Attach the trace context to a transport payload (in place) so the
+    remote handler can continue the trace. No-op when not tracing."""
+    if span is None:
+        span = getattr(_tls, "span", None)
+    if span is not None and span.is_recording:
+        payload["_trace"] = span.traceparent()
+    return payload
+
+
+def extract_context(payload: Optional[Dict[str, Any]]
+                    ) -> Optional[WireContext]:
+    """Wire context out of a transport payload, or None."""
+    if not payload:
+        return None
+    return parse_traceparent(payload.get("_trace"))

@@ -22,6 +22,7 @@ from elasticsearch_tpu.common.errors import (
     IndexNotFoundException,
     ShardNotFoundException,
 )
+from elasticsearch_tpu.common.metrics import CounterMetric
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.shard import IndexShard, ShardId
 from elasticsearch_tpu.index.translog import write_atomic
@@ -269,7 +270,35 @@ class IndicesService:
         # alias → index → props ({"filter": query-json,
         # "is_write_index": bool}); reference: AliasMetadata
         self.aliases: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        # per-(index, shard) search failure counters — fed by the
+        # coordinator's query/fetch phases and the cluster fan-out's
+        # terminal failures, exported via the metrics registry
+        self._search_failures: Dict[tuple, CounterMetric] = {}
+        self._failures_lock = threading.Lock()
         self._load_metadata()
+
+    # -------- per-shard search failure accounting --------
+
+    def count_search_failure(self, index: str, shard: int) -> None:
+        key = (index, int(shard))
+        with self._failures_lock:
+            counter = self._search_failures.get(key)
+            if counter is None:
+                counter = self._search_failures[key] = CounterMetric()
+        counter.inc()
+
+    def search_failure_metrics(self):
+        """→ [((index, shard), CounterMetric)] snapshot."""
+        with self._failures_lock:
+            return list(self._search_failures.items())
+
+    def search_failure_stats(self) -> Dict[str, Dict[str, int]]:
+        with self._failures_lock:
+            snap = list(self._search_failures.items())
+        out: Dict[str, Dict[str, int]] = {}
+        for (index, shard), counter in snap:
+            out.setdefault(index, {})[str(shard)] = counter.count
+        return out
 
     # -------- gateway metadata (survives restart) --------
 

@@ -115,6 +115,22 @@ class Node:
         self.thread_pools = ThreadPools(self.settings)
         self.controller = RestController()
         self.controller.thread_pools = self.thread_pools
+        # tracing: per-request root spans + propagation through the
+        # coordinator fan-out and the TPU batch pipeline (sample_rate=0,
+        # the default, keeps the hostpath allocation-free)
+        from elasticsearch_tpu.common.tracing import Tracer
+        self.tracer = Tracer(
+            sample_rate=self.settings.get_float(
+                "search.tracing.sample_rate", 0.0),
+            max_spans=self.settings.get_int(
+                "search.tracing.max_spans", 4096),
+            slow_threshold_ms=self.settings.get_float(
+                "search.tracing.slow_threshold_ms", 3000.0),
+            node_name=node_name)
+        self.controller.tracer = self.tracer
+        from elasticsearch_tpu.common.metrics import MetricsRegistry
+        self.metrics = MetricsRegistry()
+        self._register_metrics()
         self._register_actions()
         self._refresh_interval = self.settings.get_float(
             "index.refresh_interval_seconds", 1.0)
@@ -248,6 +264,110 @@ class Node:
         if self.cluster is not None:
             self.cluster.replicate_op(op, index, shard_num, doc_id,
                                       source, result)
+
+    def _register_metrics(self) -> None:
+        """Register every subsystem's metrics with the node-wide
+        registry (scraped by GET /_prometheus/metrics). Dynamic families
+        — per-pool, per-breaker, per-stage, per-shard — go through
+        collectors so members created later still show up."""
+        reg = self.metrics
+        reg.set_help("threadpool.active",
+                     "Requests currently executing in the pool")
+        reg.set_help("threadpool.queue", "Requests waiting for a slot")
+        reg.set_help("search.plan_cache.hits",
+                     "Lowered-plan cache lookups served from cache")
+        reg.set_help("transport.retries",
+                     "Transport sends retried after a retryable failure")
+
+        def _threadpools():
+            for name, pool in self.thread_pools.pools.items():
+                st = pool.stats()
+                lb = {"pool": name}
+                yield ("threadpool.threads", lb, st["threads"], "gauge")
+                yield ("threadpool.queue_capacity", lb,
+                       st["queue_size"], "gauge")
+                yield ("threadpool.active", lb, st["active"], "gauge")
+                yield ("threadpool.queue", lb, st["queue"], "gauge")
+                yield ("threadpool.rejected", lb, st["rejected"],
+                       "counter")
+                yield ("threadpool.completed", lb, st["completed"],
+                       "counter")
+        reg.add_collector(_threadpools)
+
+        def _breakers():
+            svc = getattr(self, "breakers", None)
+            if svc is None:
+                return
+            for name, st in svc.stats().items():
+                lb = {"breaker": name}
+                yield ("breaker.limit_bytes", lb,
+                       st["limit_size_in_bytes"], "gauge")
+                yield ("breaker.estimated_bytes", lb,
+                       st["estimated_size_in_bytes"], "gauge")
+                yield ("breaker.tripped", lb, st["tripped"], "counter")
+        reg.add_collector(_breakers)
+
+        def _tpu():
+            svc = self.tpu_search
+            if svc is None:
+                return
+            nl = {}
+            yield ("search.tpu.served", nl, svc.served, "counter")
+            yield ("search.tpu.fallback", nl, svc.fallback, "counter")
+            yield ("search.tpu.timeouts", nl, svc.timeouts, "counter")
+            yield ("search.tpu.kernel_breaker_open", nl,
+                   1 if svc._tripped else 0, "gauge")
+            yield ("search.tpu.batches_executed", nl,
+                   svc.batcher.batches_executed, "counter")
+            yield ("search.tpu.batched_queries", nl,
+                   svc.batcher.queries_executed, "counter")
+            plans = svc.plans.stats()
+            yield ("search.plan_cache.size", nl, plans["size"], "gauge")
+            for key in ("hits", "misses", "evictions", "invalidations"):
+                yield (f"search.plan_cache.{key}", nl, plans[key],
+                       "counter")
+            packs = svc.packs.stats()
+            yield ("search.pack_cache.resident", nl, packs["resident"],
+                   "gauge")
+            for key in ("hits", "misses", "stale_served"):
+                yield (f"search.pack_cache.{key}", nl, packs[key],
+                       "counter")
+            with svc._prewarm_lock:
+                warm = dict(svc._prewarm_progress)
+            yield ("search.tpu.prewarm_total", nl, warm["total"], "gauge")
+            yield ("search.tpu.prewarm_done", nl, warm["done"], "gauge")
+            for stage, seconds, count, ring in svc.stages.metrics_view():
+                lb = {"stage": stage}
+                yield ("search.tpu.stage_seconds", lb, seconds, "counter")
+                yield ("search.tpu.stage_operations", lb, count,
+                       "counter")
+                if ring is not None:
+                    yield ("search.tpu.stage_latency_seconds", lb, ring,
+                           "summary")
+        reg.add_collector(_tpu)
+
+        def _transport():
+            # zeros when single-node: the family names stay stable
+            # whether or not the node ever joined a cluster
+            transport = getattr(self.cluster, "transport", None) \
+                if self.cluster is not None else None
+            nl = {}
+            yield ("transport.rx", nl,
+                   transport.rx_count if transport else 0, "counter")
+            yield ("transport.tx", nl,
+                   transport.tx_count if transport else 0, "counter")
+            yield ("transport.retries", nl,
+                   transport.retry_count if transport else 0, "counter")
+            yield ("transport.evictions", nl,
+                   transport.evict_count if transport else 0, "counter")
+        reg.add_collector(_transport)
+
+        def _search_failures():
+            for (index, shard), counter in \
+                    self.indices.search_failure_metrics():
+                yield ("search.shard_failures",
+                       {"index": index, "shard": shard}, counter)
+        reg.add_collector(_search_failures)
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
@@ -383,6 +503,11 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         params = {k: v[0] if v else "" for k, v in
                   parse_qs(parsed.query, keep_blank_values=True).items()}
+        # trace context arrives as an HTTP header; the controller reads
+        # it from params (header wins over a query-param duplicate)
+        traceparent = self.headers.get("traceparent")
+        if traceparent:
+            params["traceparent"] = traceparent
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b""
         status, payload = self.node.handle(self.command, parsed.path, params,

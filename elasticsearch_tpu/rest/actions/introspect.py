@@ -226,6 +226,16 @@ def hot_threads(node, params: Dict[str, str]) -> str:
         lines.append(f"   {share:.1f}% sampled usage by thread "
                      f"'{name}'")
         lines.extend(samples.get(name, []))
+    # per-pool admission state rides along so stall diagnosis (is the
+    # pool saturated or is one thread wedged?) is one call, not two
+    pools = getattr(node, "thread_pools", None)
+    if pools is not None:
+        lines.append("   Thread pools:")
+        for pname, st in sorted(pools.stats().items()):
+            lines.append(
+                f"   [{pname}] active={st['active']}/{st['threads']} "
+                f"queue={st['queue']}/{st['queue_size']} "
+                f"rejected={st['rejected']} completed={st['completed']}")
     return "\n".join(lines) + "\n"
 
 
@@ -346,6 +356,28 @@ def register(controller: RestController, node) -> None:
         out.update(tpu.stats())
         return 200, out
 
+    def do_tpu_traces(req: RestRequest):
+        # recent finished spans (newest first), filterable by trace id /
+        # minimum duration — the query surface for the tracing layer
+        tracer = getattr(node, "tracer", None)
+        if tracer is None:
+            return 200, {"sample_rate": 0.0, "total": 0, "spans": []}
+        trace_id = req.params.get("trace_id")
+        min_ms = float(req.params.get("min_duration_ms", 0) or 0)
+        limit = int(req.params.get("limit", 200) or 200)
+        if trace_id:
+            spans = [s for s in tracer.trace(trace_id)
+                     if (s["duration_ms"] or 0) >= min_ms]
+        else:
+            spans = tracer.spans(min_duration_ms=min_ms, limit=limit)
+        return 200, {"sample_rate": tracer.sample_rate,
+                     "slow_threshold_ms": tracer.slow_threshold_ms,
+                     "total": len(spans), "spans": spans}
+
+    def do_prometheus(req: RestRequest):
+        # text exposition (str payload → text/plain at the HTTP layer)
+        return 200, node.metrics.prometheus_text()
+
     controller.register("GET", "/_field_caps", do_field_caps)
     controller.register("POST", "/_field_caps", do_field_caps)
     controller.register("GET", "/{index}/_field_caps", do_field_caps)
@@ -368,3 +400,5 @@ def register(controller: RestController, node) -> None:
     controller.register("POST", "/_cluster/allocation/explain",
                         do_alloc_explain)
     controller.register("GET", "/_tpu/stats", do_tpu_stats)
+    controller.register("GET", "/_tpu/traces", do_tpu_traces)
+    controller.register("GET", "/_prometheus/metrics", do_prometheus)

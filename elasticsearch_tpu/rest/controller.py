@@ -16,6 +16,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common import errors as es_errors
+from elasticsearch_tpu.common import tracing as _tracing
 
 
 @dataclasses.dataclass
@@ -126,6 +127,8 @@ class RestController:
         self._root = _TrieNode()
         # set by the node: ThreadPools admission gates per request class
         self.thread_pools = None
+        # set by the node: per-request root spans (None ⇒ no tracing)
+        self.tracer = None
 
     def register(self, method: str, template: str, handler: Handler) -> None:
         node = self._root
@@ -177,13 +180,46 @@ class RestController:
                         f"{sorted(node.handlers)}"), 405)
         params = dict(query_params or {})
         params.update(path_params)
+        # trace context: adopt a caller-supplied `traceparent` (HTTP
+        # header or query param — the caller's sampling decision wins),
+        # else open a locally-sampled root span
+        traceparent = params.pop("traceparent", None)
         req = RestRequest(method.upper(), path, params, body, raw_body)
+        span = None
+        tracer = self.tracer
+        if tracer is not None and (traceparent or tracer.enabled):
+            span = tracer.start_span(
+                f"rest {req.method} {path}",
+                parent=_tracing.parse_traceparent(traceparent),
+                attributes={"http.method": req.method, "http.path": path},
+                root=True)
+            if not span.is_recording:
+                span = None
         try:
-            if self.thread_pools is not None:
-                with self.thread_pools.execute(
-                        classify_pool(method.upper(), path)):
-                    return handler(req)
-            return handler(req)
+            if span is None:
+                if self.thread_pools is not None:
+                    with self.thread_pools.execute(
+                            classify_pool(req.method, path)):
+                        return handler(req)
+                return handler(req)
+            with _tracing.use_span(span):
+                try:
+                    if self.thread_pools is not None:
+                        with self.thread_pools.execute(
+                                classify_pool(req.method, path)):
+                            status, payload = handler(req)
+                    else:
+                        status, payload = handler(req)
+                except Exception as exc:
+                    span.set_attribute(
+                        "error", f"{type(exc).__name__}: {exc}")
+                    span.set_attribute("http.status", error_status(exc))
+                    raise
+                else:
+                    span.set_attribute("http.status", status)
+                    return status, payload
+                finally:
+                    span.end()
         except Exception as exc:  # noqa: BLE001 — REST boundary
             status = error_status(exc)
             if status == 500:

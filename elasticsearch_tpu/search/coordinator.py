@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import logging
 
+from elasticsearch_tpu.common import tracing
 from elasticsearch_tpu.common.errors import (CircuitBreakingException,
                                              EsRejectedExecutionException,
                                              IllegalArgumentException,
@@ -496,10 +497,16 @@ def search(indices: IndicesService, index_expr: Optional[str],
             except Exception as e:  # noqa: BLE001 — per-shard capture
                 logger.debug("shard [%s][%d] query phase failed",
                              name, shard_num, exc_info=True)
+                indices.count_search_failure(name, shard_num)
+                tracing.add_event("shard.query_failed", index=name,
+                                  shard=shard_num,
+                                  error=f"{type(e).__name__}: {e}")
                 failures.append(shard_failure_entry(name, shard_num, e))
                 continue
             elapsed = time.perf_counter() - q0
             query_nanos[(name, shard_num)] = int(elapsed * 1e9)
+            tracing.record_stage("shard.query", elapsed, index=name,
+                                 shard=shard_num)
             if svc.search_slowlog.enabled:
                 svc.search_slowlog.maybe_log(elapsed, shard_num,
                                              source=body,
@@ -584,12 +591,18 @@ def search(indices: IndicesService, index_expr: Optional[str],
         except Exception as e:  # noqa: BLE001 — per-shard capture
             logger.debug("shard [%s][%d] fetch phase failed",
                          name, shard_num, exc_info=True)
+            indices.count_search_failure(name, shard_num)
+            tracing.add_event("shard.fetch_failed", index=name,
+                              shard=shard_num,
+                              error=f"{type(e).__name__}: {e}")
             failures.append(shard_failure_entry(name, shard_num, e))
             fetch_failed.add(si)
             fetched = {k: v for k, v in fetched.items() if k[0] != si}
             continue
-        fetch_nanos[(name, shard_num)] = int(
-            (time.perf_counter() - f0) * 1e9)
+        f_elapsed = time.perf_counter() - f0
+        fetch_nanos[(name, shard_num)] = int(f_elapsed * 1e9)
+        tracing.record_stage("shard.fetch", f_elapsed, index=name,
+                             shard=shard_num)
     if fetch_failed:
         # a shard that lost its fetch phase contributes NO hits and
         # counts failed, even though its query phase ran
@@ -750,9 +763,11 @@ def _search_fast(indices: IndicesService, names: List[str],
             timeout_s=ctx.remaining_s() if ctx is not None else None)
         if res is None:
             return None
+        q_elapsed = time.perf_counter() - q0
+        tracing.record_stage("kernel.search", q_elapsed, index=name)
         if svc.search_slowlog.enabled:
             svc.search_slowlog.maybe_log(
-                time.perf_counter() - q0, "kernel",
+                q_elapsed, "kernel",
                 source={"query": query.query_name()},
                 total_hits=res.total_hits)
         per_index.append((name, svc, res))
@@ -1008,10 +1023,16 @@ def search_shard_group(indices: IndicesService,
                 except Exception as e:  # noqa: BLE001 — captured per shard
                     logger.debug("group shard [%s][%d] failed",
                                  name, shard_num, exc_info=True)
+                    indices.count_search_failure(name, shard_num)
+                    tracing.add_event("shard.query_failed", index=name,
+                                      shard=shard_num,
+                                      error=f"{type(e).__name__}: {e}")
                     group_failures.append(
                         shard_failure_entry(name, shard_num, e))
                     continue
                 group_query_nanos[(name, shard_num)] = int(elapsed * 1e9)
+                tracing.record_stage("shard.query", elapsed, index=name,
+                                     shard=shard_num)
                 group_profile_entries.append((name, shard_num, None, res))
                 if svc.search_slowlog.enabled:
                     svc.search_slowlog.maybe_log(

@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu.common import tracing
 from elasticsearch_tpu.mapping.types import TextFieldType
 from elasticsearch_tpu.parallel import distributed as dist
 from elasticsearch_tpu.parallel.mesh import SHARD_AXIS, make_mesh
@@ -81,6 +82,9 @@ class StageTimes:
             if ring is None:
                 ring = self._rings[stage] = self._ring_cls(self.RING_SIZE)
         ring.add(dt / n if n > 1 else dt)
+        # the same dt the stats ring keeps also lands on the active trace
+        # (no-op — one thread-local read — when the request isn't traced)
+        tracing.record_stage("tpu." + stage, dt, n=n)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -98,6 +102,16 @@ class StageTimes:
                 out[s]["p95_ms"] = round(pcts[95.0] * 1000.0, 3)
                 out[s]["p99_ms"] = round(pcts[99.0] * 1000.0, 3)
         return out
+
+    def metrics_view(self) -> List[Tuple[str, float, int, Any]]:
+        """(stage, total_seconds, count, ring) rows for the metrics
+        registry — the live ring OBJECTS, so the Prometheus summary
+        exports current quantiles and the completeness check can see
+        every ring is registered."""
+        with self._lock:
+            return [(s, self.seconds[s], self.counts.get(s, 0),
+                     self._rings.get(s))
+                    for s in sorted(self.seconds)]
 
 
 # ---------------------------------------------------------------------------
@@ -488,6 +502,10 @@ class _Pending:
     flat: FlatQuery
     k: int
     future: Future
+    # the submitting request's span (None when untraced): batch workers
+    # parent their launch/device spans under the FIRST traced query of
+    # the train so a trace shows which batch served it
+    trace_span: Any = None
 
 
 def _batch_bucket(n: int, cap: int) -> int:
@@ -612,11 +630,16 @@ class _PackQueue:
                     return
                 if not taken:
                     continue
+                trace_parent = next(
+                    (p.trace_span for p in taken if p.trace_span), None)
                 try:
-                    st = launch_flat_batch(
-                        self.resident, [p.flat for p in taken],
-                        k=max(p.k for p in taken), mesh=batcher.mesh,
-                        stages=batcher.stages)
+                    with tracing.span_under(trace_parent,
+                                            "tpu.batch_launch",
+                                            queries=len(taken)):
+                        st = launch_flat_batch(
+                            self.resident, [p.flat for p in taken],
+                            k=max(p.k for p in taken), mesh=batcher.mesh,
+                            stages=batcher.stages)
                 except Exception as exc:  # noqa: BLE001 — per query
                     for p in taken:
                         if not p.future.done():
@@ -636,8 +659,12 @@ class _PackQueue:
             if item is None:
                 return
             st, taken = item
+            trace_parent = next(
+                (p.trace_span for p in taken if p.trace_span), None)
             try:
-                results = finish_flat_batch(st)
+                with tracing.span_under(trace_parent, "tpu.batch_finish",
+                                        queries=len(taken)):
+                    results = finish_flat_batch(st)
             except Exception as exc:  # noqa: BLE001 — per query
                 for p in taken:
                     if not p.future.done():
@@ -698,7 +725,9 @@ class MicroBatcher:
     def submit(self, resident: ResidentPack, flat: FlatQuery,
                k: int) -> Future:
         fut: Future = Future()
-        pending = _Pending(flat, k, fut)
+        # capture on the REQUEST thread — the batch workers have no
+        # request thread-local to read
+        pending = _Pending(flat, k, fut, tracing.current_span())
         while True:
             with self._lock:
                 if self._closed:

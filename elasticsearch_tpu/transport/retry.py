@@ -27,6 +27,7 @@ import time
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from elasticsearch_tpu.common import tracing
 from elasticsearch_tpu.transport.service import (
     ConnectTransportException,
     RemoteTransportException,
@@ -190,6 +191,14 @@ def send_with_retry(transport, address: Address, action: str,
             attempt += 1
             if (time.monotonic() - start) + delay > policy.deadline:
                 raise
+            if hasattr(transport, "retry_count"):
+                transport.retry_count += 1
+            # the retry is part of the request's story: the active span
+            # (if any) records it as an event so a trace shows the
+            # wasted attempt, not just the final latency
+            tracing.add_event("transport.retry", target=str(address),
+                              action=action, attempt=attempt,
+                              error=f"{type(e).__name__}: {e}")
             logger.debug("retry %d to %s [%s] in %.3fs after: %s",
                          attempt, address, action, delay, e)
             time.sleep(delay)

@@ -226,9 +226,11 @@ class TransportService:
         self._next_id = 0
         self._id_lock = threading.Lock()
         self._closed = False
-        # counters (surface in node stats)
+        # counters (surface in node stats + the metrics registry)
         self.rx_count = 0
         self.tx_count = 0
+        self.retry_count = 0   # sends retried by send_with_retry
+        self.evict_count = 0   # pooled connections dropped as dead
 
     # ------------- registry -------------
 
@@ -374,6 +376,7 @@ class TransportService:
         with self._conns_lock:
             conn = self._conns.pop(address, None)
         if conn is not None:
+            self.evict_count += 1
             conn.close()
 
     def close(self) -> None:

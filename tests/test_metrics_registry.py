@@ -1,0 +1,287 @@
+"""Metrics registry suite — registration semantics, Prometheus text
+exposition validity on a live node, and the completeness check: every
+metric object reachable from the node's stats trees must be visible to
+the registry (no subsystem may grow metrics without exposing them)."""
+
+from __future__ import annotations
+
+import json
+import re
+
+import pytest
+
+from elasticsearch_tpu.common.metrics import (EWMA, CounterMetric,
+                                              MeanMetric, MetricsRegistry,
+                                              SampleRing, stats_to_xcontent)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def do(node, method, path, body=None, **params):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path,
+                       {k: str(v) for k, v in params.items()}, None, raw)
+
+
+# ---------------------------------------------------------------------
+# registry unit behavior
+# ---------------------------------------------------------------------
+
+def test_kind_inference_and_family_naming():
+    reg = MetricsRegistry()
+    reg.register("a.hits", CounterMetric())
+    reg.register("a.depth", 7)                 # raw number → gauge
+    reg.register("a.latency", SampleRing())    # → summary
+    reg.register("a.load", EWMA())             # → gauge
+    fams = reg.families()
+    assert fams == {"a.hits": "counter", "a.depth": "gauge",
+                    "a.latency": "summary", "a.load": "gauge"}
+    text = reg.prometheus_text()
+    assert "# TYPE es_tpu_a_hits_total counter" in text
+    assert "# TYPE es_tpu_a_depth gauge" in text
+    assert "es_tpu_a_depth 7" in text
+
+
+def test_counter_values_and_labels_render():
+    reg = MetricsRegistry()
+    c = reg.register("x.ops", CounterMetric(),
+                     labels={"pool": "search"}, help="ops by pool")
+    c.inc(5)
+    reg.register("x.ops", CounterMetric(), labels={"pool": "write"})
+    text = reg.prometheus_text()
+    assert '# HELP es_tpu_x_ops_total ops by pool' in text
+    assert 'es_tpu_x_ops_total{pool="search"} 5' in text
+    assert 'es_tpu_x_ops_total{pool="write"} 0' in text
+    # one HELP/TYPE for the family even with two labeled series
+    assert text.count("# TYPE es_tpu_x_ops_total") == 1
+
+
+def test_kind_conflict_is_an_error():
+    reg = MetricsRegistry()
+    reg.register("y.val", CounterMetric())
+    with pytest.raises(ValueError):
+        reg.register("y.val", 3.0)  # gauge vs counter
+
+
+def test_collectors_yield_dynamic_rows_and_objects():
+    reg = MetricsRegistry()
+    ring = SampleRing()
+    for v in (0.1, 0.2, 0.3):
+        ring.add(v)
+    counter = CounterMetric()
+    counter.inc(9)
+
+    def rows():
+        yield ("dyn.queue", {"pool": "p0"}, 4, "gauge")
+        yield ("dyn.done", {"pool": "p0"}, counter)     # kind inferred
+        yield ("dyn.lat", {"pool": "p0"}, ring)
+
+    reg.add_collector(rows)
+    text = reg.prometheus_text()
+    assert 'es_tpu_dyn_queue{pool="p0"} 4' in text
+    assert 'es_tpu_dyn_done_total{pool="p0"} 9' in text
+    assert 'es_tpu_dyn_lat{pool="p0",quantile="0.5"}' in text
+    assert 'es_tpu_dyn_lat_count{pool="p0"} 3' in text
+    # collector-yielded metric objects count as registered
+    assert id(ring) in reg.registered_objects()
+    assert id(counter) in reg.registered_objects()
+
+
+def test_broken_collector_does_not_break_the_scrape():
+    reg = MetricsRegistry()
+    reg.register("ok.val", 1)
+
+    def broken():
+        raise RuntimeError("subsystem on fire")
+        yield  # pragma: no cover
+
+    reg.add_collector(broken)
+    assert "es_tpu_ok_val 1" in reg.prometheus_text()
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    reg.register("z.v", 1, labels={"idx": 'we"ird\\name\nx'})
+    text = reg.prometheus_text()
+    assert 'idx="we\\"ird\\\\name\\nx"' in text
+
+
+def test_mean_metric_renders_count_and_sum():
+    reg = MetricsRegistry()
+    m = MeanMetric()
+    m.inc(2.0)
+    m.inc(4.0)
+    reg.register("m.took", m)
+    text = reg.prometheus_text()
+    assert "es_tpu_m_took_count 2" in text
+    assert "es_tpu_m_took_sum 6" in text
+
+
+def test_stats_to_xcontent_renders_sample_ring_percentiles():
+    ring = SampleRing()
+    for v in range(100):
+        ring.add(float(v))
+    out = stats_to_xcontent({"lat": ring, "n": 3})
+    assert out["n"] == 3
+    assert set(out["lat"]) == {"p50", "p95", "p99"}
+    assert out["lat"]["p50"] == pytest.approx(49.5, abs=2.0)
+
+
+# ---------------------------------------------------------------------
+# live-node exposition validity
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    # default settings: the TPU serving path (and with it the plan
+    # cache, pack cache, breakers, and stage rings) must all be live
+    n = Node(str(tmp_path_factory.mktemp("data")), settings=Settings.of({}))
+    status, body = do(n, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 2}},
+        "mappings": {"properties": {"title": {"type": "text"}}}})
+    assert status == 200, body
+    for i in range(10):
+        do(n, "PUT", f"/books/_doc/{i}", body={"title": f"beta doc {i}"})
+    do(n, "POST", "/books/_refresh")
+    # exercise the search path twice so plan-cache hit AND miss counters
+    # plus the per-stage rings are live at scrape time
+    for _ in range(2):
+        status, resp = do(n, "POST", "/books/_search",
+                          body={"query": {"match": {"title": "beta"}}})
+        assert status == 200 and resp["_shards"]["failed"] == 0
+    # and one recorded failure so the per-shard counter family exists
+    n.indices.count_search_failure("books", 1)
+    yield n
+    n.close()
+
+
+SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                 # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (?:[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|Inf)|NaN)$')
+
+
+def test_exposition_lines_are_valid(node):
+    status, text = do(node, "GET", "/_prometheus/metrics")
+    assert status == 200
+    assert isinstance(text, str) and text.endswith("\n")
+    seen_help, seen_type = set(), set()
+    current_family = None
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            fam = line.split()[2]
+            assert fam not in seen_help, f"duplicate HELP for {fam}"
+            seen_help.add(fam)
+        elif line.startswith("# TYPE "):
+            _, _, fam, kind = line.split(None, 3)
+            assert fam not in seen_type, f"duplicate TYPE for {fam}"
+            assert kind in ("counter", "gauge", "summary")
+            seen_type.add(fam)
+            current_family = fam
+        else:
+            assert SAMPLE_RE.match(line), f"invalid sample line: {line!r}"
+            name = line.split("{")[0].split(" ")[0]
+            # samples belong to the most recent TYPE'd family
+            assert current_family is not None
+            assert name == current_family or name.startswith(
+                current_family + "_"), (name, current_family)
+    assert seen_help == seen_type
+
+
+def test_required_families_are_present(node):
+    _, text = do(node, "GET", "/_prometheus/metrics")
+    for family in (
+            "es_tpu_search_plan_cache_hits_total",
+            "es_tpu_search_plan_cache_misses_total",
+            "es_tpu_threadpool_queue",
+            "es_tpu_threadpool_active",
+            "es_tpu_breaker_estimated_bytes",
+            "es_tpu_breaker_tripped_total",
+            "es_tpu_transport_retries_total",
+            "es_tpu_search_shard_failures_total",
+            "es_tpu_search_tpu_stage_seconds_total",
+            "es_tpu_search_tpu_stage_latency_seconds"):
+        assert f"# TYPE {family} " in text, f"missing family {family}"
+    # the failure we recorded in the fixture shows up labeled
+    assert ('es_tpu_search_shard_failures_total'
+            '{index="books",shard="1"} 1') in text
+    # counters are suffixed _total, and plan cache saw a hit by now
+    hits = [l for l in text.splitlines()
+            if l.startswith("es_tpu_search_plan_cache_hits_total")]
+    assert hits and int(hits[0].rsplit(" ", 1)[1]) >= 1
+
+
+def test_counter_families_never_regress_between_scrapes(node):
+    def counters(text):
+        out = {}
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            if name.endswith("_total"):
+                key = line.rsplit(" ", 1)[0]
+                out[key] = float(line.rsplit(" ", 1)[1])
+        return out
+
+    _, before = do(node, "GET", "/_prometheus/metrics")
+    do(node, "POST", "/books/_search",
+       body={"query": {"match": {"title": "beta"}}})
+    _, after = do(node, "GET", "/_prometheus/metrics")
+    b, a = counters(before), counters(after)
+    for key, val in b.items():
+        assert a.get(key, 0.0) >= val, f"counter went backwards: {key}"
+
+
+# ---------------------------------------------------------------------
+# completeness: every reachable metric object is registered
+# ---------------------------------------------------------------------
+
+_METRIC_TYPES = (CounterMetric, MeanMetric, EWMA, SampleRing)
+
+
+def _reachable_metrics(*roots):
+    """BFS over elasticsearch_tpu objects + containers, collecting every
+    metric object in reach. Bounded depth keeps it from wandering into
+    index internals."""
+    found = {}
+    seen = set()
+    queue = [(r, 0) for r in roots if r is not None]
+    while queue:
+        obj, depth = queue.pop()
+        if id(obj) in seen or depth > 6:
+            continue
+        seen.add(id(obj))
+        if isinstance(obj, _METRIC_TYPES):
+            found[id(obj)] = obj
+            continue
+        if isinstance(obj, dict):
+            queue.extend((v, depth + 1) for v in obj.values())
+        elif isinstance(obj, (list, tuple, set)):
+            queue.extend((v, depth + 1) for v in obj)
+        elif type(obj).__module__.startswith("elasticsearch_tpu"):
+            for attr in ("__dict__",):
+                d = getattr(obj, attr, None)
+                if isinstance(d, dict):
+                    queue.extend((v, depth + 1) for v in d.values())
+            for slot in getattr(type(obj), "__slots__", ()):
+                try:
+                    queue.append((getattr(obj, slot), depth + 1))
+                except AttributeError:
+                    pass
+    return found
+
+
+def test_every_reachable_metric_object_is_registered(node):
+    reachable = _reachable_metrics(
+        node.thread_pools,
+        getattr(node, "breakers", None),
+        node.tpu_search,
+        node.indices)
+    assert reachable, "traversal found no metric objects at all"
+    registered = node.metrics.registered_objects()
+    missing = [obj for oid, obj in reachable.items()
+               if oid not in registered]
+    assert not missing, (
+        "metric objects reachable from stats trees but invisible to the "
+        f"registry: {[(type(m).__name__, m) for m in missing]}")

@@ -1,0 +1,395 @@
+"""Tracing suite — span mechanics, REST root spans, and trace-context
+propagation across the sim-cluster transport (fan-out, retry, replica
+failover must all keep parent/child linkage)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import socket
+import time
+
+import pytest
+
+from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.tracing import (Tracer, format_traceparent,
+                                              parse_traceparent)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.testing.disruption import shard_fault
+
+
+@pytest.fixture(autouse=True)
+def _timeout_guard():
+    """Per-test wall-clock guard mirroring test_disruption.py: a hung
+    cluster fixture fails THIS test instead of wedging tier-1."""
+
+    def on_alarm(signum, frame):
+        raise TimeoutError("tracing test exceeded the 120s guard")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, 120.0)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def do(node, method, path, body=None, **params):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path,
+                       {k: str(v) for k, v in params.items()}, None, raw)
+
+
+# ---------------------------------------------------------------------
+# tracer unit behavior
+# ---------------------------------------------------------------------
+
+def test_parent_child_linkage_and_ring_query():
+    tracer = Tracer(sample_rate=1.0)
+    root = tracer.start_span("root", root=True)
+    child = tracer.start_span("child", parent=root)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    child.end()
+    root.end()
+    spans = tracer.trace(root.trace_id)
+    assert [s["name"] for s in spans] == ["root", "child"]
+    assert spans[1]["parent_id"] == spans[0]["span_id"]
+
+
+def test_sample_rate_zero_is_noop_everywhere():
+    tracer = Tracer(sample_rate=0.0)
+    span = tracer.start_span("root", root=True)
+    assert span is tracing.NOOP_SPAN
+    assert not span.is_recording
+    with tracing.use_span(span):
+        assert tracing.current_span() is None
+        # every helper must be a silent no-op with no current span
+        with tracing.child_span("x") as c:
+            assert not c.is_recording
+        tracing.record_stage("stage", 0.01)
+        tracing.add_event("ev")
+        payload = {}
+        tracing.inject_context(payload)
+        assert "_trace" not in payload
+    span.end()
+    assert tracer.spans(limit=0) == []
+
+
+def test_adopted_context_overrides_local_sample_rate():
+    tracer = Tracer(sample_rate=0.0)  # locally disabled
+    ctx = ("a" * 32, "b" * 16, True)
+    span = tracer.start_span("adopted", parent=ctx)
+    assert span.is_recording
+    assert span.trace_id == "a" * 32
+    assert span.parent_id == "b" * 16
+    # the remote decided NOT to sample → honored too
+    assert not tracer.start_span(
+        "x", parent=("a" * 32, "b" * 16, False)).is_recording
+
+
+def test_traceparent_roundtrip_and_malformed():
+    hdr = format_traceparent("c" * 32, "d" * 16, True)
+    assert parse_traceparent(hdr) == ("c" * 32, "d" * 16, True)
+    assert parse_traceparent(
+        format_traceparent("c" * 32, "d" * 16, False))[2] is False
+    for bad in (None, "", "00-zz-xx-01", "00-abc-def-01",
+                "not a header", "00-" + "c" * 32 + "-" + "d" * 16,
+                "00-" + "g" * 32 + "-" + "d" * 16 + "-01"):
+        assert parse_traceparent(bad) is None
+
+
+def test_span_ring_is_bounded():
+    tracer = Tracer(sample_rate=1.0, max_spans=16)
+    for i in range(100):
+        tracer.start_span(f"s{i}", root=True).end()
+    spans = tracer.spans(limit=0)
+    assert len(spans) == 16
+    assert spans[0]["name"] == "s99"  # newest first
+
+
+def test_record_stage_backdates_a_completed_child():
+    tracer = Tracer(sample_rate=1.0)
+    root = tracer.start_span("root", root=True)
+    with tracing.use_span(root):
+        tracing.record_stage("work", 0.25, index="i")
+    root.end()
+    stage = [s for s in tracer.spans(limit=0) if s["name"] == "work"][0]
+    assert stage["duration_ms"] == pytest.approx(250.0)
+    assert stage["parent_id"] == root.span_id
+    assert stage["attributes"]["index"] == "i"
+
+
+def test_slow_root_span_hits_the_slowlog(caplog):
+    tracer = Tracer(sample_rate=1.0, slow_threshold_ms=50.0)
+    with caplog.at_level(logging.WARNING,
+                         logger="elasticsearch_tpu.trace.slowlog"):
+        span = tracer.start_span("rest POST /x/_search", root=True)
+        with tracing.use_span(span):
+            tracing.record_stage("shard.query", 0.2)
+        span.duration_ms = 120.0  # finished above the threshold
+        span.end()
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("slow trace" in m and span.trace_id in m for m in msgs)
+    assert any("shard.query" in m for m in msgs)
+    # fast roots stay quiet
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="elasticsearch_tpu.trace.slowlog"):
+        tracer.start_span("fast", root=True).end()
+    assert not caplog.records
+
+
+def test_exception_annotates_and_ends_child_span():
+    tracer = Tracer(sample_rate=1.0)
+    root = tracer.start_span("root", root=True)
+    with tracing.use_span(root):
+        with pytest.raises(ValueError):
+            with tracing.child_span("boom"):
+                raise ValueError("nope")
+    root.end()
+    boom = [s for s in tracer.spans(limit=0) if s["name"] == "boom"][0]
+    assert "ValueError" in boom["attributes"]["error"]
+
+
+# ---------------------------------------------------------------------
+# single-node REST integration
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def traced_node(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({
+                 "search.tpu_serving.enabled": "false",
+                 "search.tracing.sample_rate": "1.0"}))
+    status, body = do(n, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 3}},
+        "mappings": {"properties": {"title": {"type": "text"}}}})
+    assert status == 200, body
+    for i in range(12):
+        do(n, "PUT", f"/books/_doc/{i}",
+           body={"title": f"alpha doc {i}"})
+    do(n, "POST", "/books/_refresh")
+    n.tracer.clear()
+    yield n
+    n.close()
+
+
+def test_rest_search_yields_one_linked_trace(traced_node):
+    status, resp = do(traced_node, "POST", "/books/_search",
+                      body={"query": {"match": {"title": "alpha"}}})
+    assert status == 200 and resp["_shards"]["failed"] == 0
+    status, tr = do(traced_node, "GET", "/_tpu/traces")
+    assert status == 200 and tr["sample_rate"] == 1.0
+    roots = [s for s in tr["spans"]
+             if s["name"] == "rest POST /books/_search"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["parent_id"] is None
+    assert root["attributes"]["http.status"] == 200
+    # the whole trace, filterable by id, in start order
+    status, one = do(traced_node, "GET", "/_tpu/traces",
+                     trace_id=root["trace_id"])
+    assert status == 200
+    names = [s["name"] for s in one["spans"]]
+    assert names[0] == "rest POST /books/_search"
+    assert names.count("shard.query") == 3  # one per shard
+    span_ids = {s["span_id"] for s in one["spans"]}
+    for s in one["spans"]:
+        assert s["trace_id"] == root["trace_id"]
+        assert s["parent_id"] is None or s["parent_id"] in span_ids
+
+
+def test_traces_filter_by_min_duration(traced_node):
+    do(traced_node, "POST", "/books/_search",
+       body={"query": {"match_all": {}}})
+    status, tr = do(traced_node, "GET", "/_tpu/traces",
+                    min_duration_ms=10_000_000)
+    assert status == 200 and tr["spans"] == []
+
+
+def test_traceparent_header_is_adopted(tmp_path):
+    # tracing locally OFF — the caller's sampled context still traces
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({
+                 "search.tpu_serving.enabled": "false"}))
+    try:
+        assert not n.tracer.enabled
+        hdr = format_traceparent("e" * 32, "f" * 16, True)
+        status, _ = do(n, "GET", "/", traceparent=hdr)
+        assert status == 200
+        spans = n.tracer.spans(trace_id="e" * 32, limit=0)
+        assert len(spans) == 1
+        assert spans[0]["parent_id"] == "f" * 16
+        assert spans[0]["name"] == "rest GET /"
+        # an unsampled caller context stays untraced
+        status, _ = do(n, "GET", "/", traceparent=format_traceparent(
+            "e" * 32, "f" * 16, False))
+        assert status == 200
+        assert len(n.tracer.spans(limit=0)) == 1
+    finally:
+        n.close()
+
+
+def test_disabled_tracing_records_nothing(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings=Settings.of({
+                 "search.tpu_serving.enabled": "false"}))
+    try:
+        do(n, "PUT", "/q", body={"settings": {"number_of_shards": 1}})
+        do(n, "PUT", "/q/_doc/1", body={"f": "x"})
+        do(n, "POST", "/q/_refresh")
+        do(n, "POST", "/q/_search", body={"query": {"match_all": {}}})
+        assert n.tracer.spans(limit=0) == []
+        status, tr = do(n, "GET", "/_tpu/traces")
+        assert status == 200 and tr["total"] == 0
+    finally:
+        n.close()
+
+
+# ---------------------------------------------------------------------
+# two-node cluster: propagation across the transport
+# ---------------------------------------------------------------------
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    names = ["tr-0", "tr-1"]
+    ports = _free_ports(2)
+    seeds = [("127.0.0.1", p) for p in ports]
+    nodes = []
+    for i, name in enumerate(names):
+        data = tmp_path_factory.mktemp(f"data-{name}")
+        node = Node(str(data), node_name=name,
+                    settings=Settings.of({
+                        "search.tpu_serving.enabled": "false",
+                        "search.tracing.sample_rate": "1.0"}))
+        node.start_cluster(transport_port=ports[i], seed_hosts=seeds,
+                           initial_master_nodes=names)
+        nodes.append(node)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(n.cluster.health()["number_of_nodes"] == 2 for n in nodes):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("cluster did not form")
+    yield nodes
+    for n in nodes:
+        try:
+            n.close()
+        except Exception:
+            pass
+
+
+def _wait_green(node, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if node.cluster.health()["status"] == "green":
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"not green: {node.cluster.health()}")
+
+
+def _trace_union(nodes, trace_id):
+    spans = []
+    for n in nodes:
+        spans.extend(n.tracer.trace(trace_id))
+    spans.sort(key=lambda s: s["start"])
+    return spans
+
+
+def test_fanout_linkage_survives_the_transport(cluster):
+    status, body = do(cluster[0], "PUT", "/fan", body={
+        "settings": {"number_of_shards": 4, "number_of_replicas": 0},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    assert status == 200, body
+    _wait_green(cluster[0])
+    for i in range(20):
+        do(cluster[0], "PUT", f"/fan/_doc/{i}",
+           body={"body": f"epsilon doc {i}"})
+    do(cluster[0], "POST", "/fan/_refresh")
+    for n in cluster:
+        n.tracer.clear()
+
+    status, resp = do(cluster[0], "POST", "/fan/_search",
+                      body={"query": {"match": {"body": "epsilon"}},
+                            "size": 30})
+    assert status == 200 and resp["_shards"]["failed"] == 0
+
+    roots = [s for s in cluster[0].tracer.spans(limit=0)
+             if s["name"] == "rest POST /fan/_search"]
+    assert len(roots) == 1
+    trace_id = roots[0]["trace_id"]
+    union = _trace_union(cluster, trace_id)
+    by_name = {}
+    for s in union:
+        by_name.setdefault(s["name"], []).append(s)
+    # 4 shards over 2 nodes: the balancer spreads them, so the
+    # coordinator must have fanned out to the other node
+    fanouts = by_name.get("transport.fanout", [])
+    assert fanouts, f"no fanout spans in {sorted(by_name)}"
+    remote_groups = by_name.get("shard_group", [])
+    assert remote_groups, f"no remote shard_group in {sorted(by_name)}"
+    fanout_ids = {s["span_id"] for s in fanouts}
+    for g in remote_groups:
+        # the remote span continues a coordinator-side fanout span
+        assert g["trace_id"] == trace_id
+        assert g["parent_id"] in fanout_ids
+        assert g["node"] != roots[0]["node"]
+    # every shard's query phase is in the trace, on whichever node ran it
+    assert len(by_name.get("shard.query", [])) == 4
+    # full linkage: every non-root parent id resolves inside the union
+    span_ids = {s["span_id"] for s in union}
+    for s in union:
+        assert s["parent_id"] is None or s["parent_id"] in span_ids
+
+
+def test_failover_keeps_the_trace_linked(cluster):
+    status, body = do(cluster[0], "PUT", "/fotr", body={
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    assert status == 200, body
+    _wait_green(cluster[0])
+    for i in range(10):
+        do(cluster[0], "PUT", f"/fotr/_doc/{i}",
+           body={"body": f"zeta doc {i}"})
+    do(cluster[0], "POST", "/fotr/_refresh")
+    for n in cluster:
+        n.tracer.clear()
+
+    # first copy dies once, failover serves the replica — the trace must
+    # show the failed attempt AND stay fully linked
+    with shard_fault("fotr", shard=0, one_shot=True) as state:
+        status, resp = do(cluster[0], "POST", "/fotr/_search",
+                          body={"query": {"match": {"body": "zeta"}},
+                                "size": 20})
+    assert state["trips"] == 1, "fault never fired"
+    assert status == 200 and resp["_shards"]["failed"] == 0
+
+    roots = [s for s in cluster[0].tracer.spans(limit=0)
+             if s["name"] == "rest POST /fotr/_search"]
+    assert len(roots) == 1
+    union = _trace_union(cluster, roots[0]["trace_id"])
+    span_ids = {s["span_id"] for s in union}
+    for s in union:
+        assert s["parent_id"] is None or s["parent_id"] in span_ids
+    # the failed first attempt left its mark on some span of the trace
+    events = [e["name"] for s in union for e in s.get("events", [])]
+    assert "shard.query_failed" in events
+    # and the query phase that SUCCEEDED is in the trace too
+    assert any(s["name"] == "shard.query" for s in union)
