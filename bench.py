@@ -20,6 +20,10 @@ What runs:
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Env knobs: ES_TPU_BENCH_{DOCS,SHARDS,VOCAB,QUERIES,CLIENTS,K,SECONDS}.
+ES_TPU_BENCH_KERNEL_COMPARE=1 additionally reruns a short load phase once
+per device-kernel variant (packed single-key sort vs two-operand ref) and
+emits a "kernel_compare" block with per-variant device p50/p99 and
+device_ms_per_query (PERF.md round 8).
 
 Timing note: through the axon tunnel block_until_ready can return before
 remote execution finishes, but every REST response here materializes hit
@@ -209,28 +213,32 @@ def main() -> None:
         sys.exit(1)
 
     # ---- throughput through REST with concurrent clients ----
-    stop_at = time.perf_counter() + seconds
-    counts = [0] * clients
     errors = []
 
-    def client(ci: int) -> None:
-        qi = ci
-        while time.perf_counter() < stop_at:
-            body = dict(query_bodies[qi % len(query_bodies)])
-            s, resp = node.handle("POST", "/bench/_search", {}, body)
-            if s != 200:
-                errors.append(resp)
-                return
-            counts[ci] += 1
-            qi += clients
+    def load_phase(phase_seconds: float):
+        """Closed-loop client load for phase_seconds → (queries, dt)."""
+        stop_at = time.perf_counter() + phase_seconds
+        counts = [0] * clients
 
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=client, args=(ci,))
-               for ci in range(clients)]
-    [t.start() for t in threads]
-    [t.join() for t in threads]
-    dt = time.perf_counter() - t0
-    total_queries = sum(counts)
+        def client(ci: int) -> None:
+            qi = ci
+            while time.perf_counter() < stop_at:
+                body = dict(query_bodies[qi % len(query_bodies)])
+                s, resp = node.handle("POST", "/bench/_search", {}, body)
+                if s != 200:
+                    errors.append(resp)
+                    return
+                counts[ci] += 1
+                qi += clients
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        return sum(counts), time.perf_counter() - t0
+
+    total_queries, dt = load_phase(seconds)
     qps = total_queries / dt
     st = node.tpu_search.stats() if node.tpu_search else {}
     out["stages"] = st.get("stages")
@@ -245,6 +253,53 @@ def main() -> None:
         f"{qps:.1f} QPS (kernel-served: {st.get('served')}, "
         f"batches: {st.get('batches')})")
     log(f"stage breakdown: {st.get('stages')}")
+
+    # ---- kernel-variant A/B (ES_TPU_BENCH_KERNEL_COMPARE=1): rerun a
+    # short load phase once per device-kernel variant (packed single-key
+    # sort vs two-operand ref, PERF.md round 8). Device time per variant
+    # comes from the variant-tagged stage rings — *_device_wait.packed
+    # only ever accumulates packed launches, so diffing (seconds, count)
+    # across the phase isolates each variant's device floor. ----
+    if _env("KERNEL_COMPARE", 0) == 1 and node.tpu_search is not None:
+        tpu = node.tpu_search
+        original = tpu.kernel_packed_sort
+        compare_s = max(2, seconds // 2)
+        out["kernel_compare"] = {}
+        for label, enabled in (("packed", True), ("ref", False)):
+            tpu.set_kernel_packed_sort(enabled)
+            before = tpu.stats().get("stages") or {}
+            nq, pdt = load_phase(compare_s)
+            after = tpu.stats().get("stages") or {}
+            dev_s = 0.0
+            stage_detail = {}
+            for base in ("batch_device_wait", "exact_device_wait",
+                         "batch_dispatch", "exact_dispatch"):
+                name = f"{base}.{label}"
+                a, b = after.get(name), before.get(name)
+                if not a:
+                    continue
+                secs = a["seconds"] - (b["seconds"] if b else 0.0)
+                cnt = a["count"] - (b["count"] if b else 0)
+                if cnt <= 0:
+                    continue
+                if base.endswith("_device_wait"):
+                    dev_s += secs
+                entry = {"count": cnt,
+                         "ms_per_call": round(1000.0 * secs / cnt, 4)}
+                for pk in ("p50_ms", "p99_ms"):
+                    if pk in a:
+                        entry[pk] = a[pk]
+                stage_detail[name] = entry
+            dev_ms_q = round(1000.0 * dev_s / max(1, nq), 4)
+            out["kernel_compare"][label] = {
+                "qps": round(nq / pdt, 2),
+                "queries": nq,
+                "device_ms_per_query": dev_ms_q,
+                "stages": stage_detail,
+            }
+            log(f"kernel_compare[{label}]: {nq} queries in {pdt:.1f}s, "
+                f"device {dev_ms_q} ms/query")
+        tpu.set_kernel_packed_sort(original)
 
     # ---- CPU oracle baseline on the same corpus/queries ----
     segments = []

@@ -32,6 +32,51 @@ class CounterMetric:
         return self._value
 
 
+class LabeledCounters:
+    """A family of CounterMetric keyed by a label-value tuple.
+
+    For counter families whose member set is data-driven — e.g. the
+    kernel-variant launch counters keyed (kernel, variant) — one object
+    owns every member so a single metrics-registry collector can yield
+    the whole family. Members are created on first inc and never
+    dropped (Prometheus counters must not disappear between scrapes)."""
+
+    __slots__ = ("_label_names", "_members", "_lock")
+
+    def __init__(self, *label_names: str):
+        self._label_names = tuple(label_names)
+        self._members: Dict[tuple, CounterMetric] = {}
+        self._lock = threading.Lock()
+
+    def child(self, *label_values) -> CounterMetric:
+        if len(label_values) != len(self._label_names):
+            raise ValueError(
+                f"expected {len(self._label_names)} label values "
+                f"({self._label_names}), got {label_values!r}")
+        key = tuple(str(v) for v in label_values)
+        member = self._members.get(key)
+        if member is None:
+            with self._lock:
+                member = self._members.setdefault(key, CounterMetric())
+        return member
+
+    def inc(self, *label_values, n: int = 1) -> None:
+        self.child(*label_values).inc(n)
+
+    def items(self):
+        """→ [(labels_dict, CounterMetric), ...] snapshot for collectors."""
+        with self._lock:
+            snap = list(self._members.items())
+        return [(dict(zip(self._label_names, key)), metric)
+                for key, metric in snap]
+
+    def counts(self) -> Dict[str, int]:
+        """Plain JSON view: "v1,v2" -> count (stats API rendering)."""
+        with self._lock:
+            snap = list(self._members.items())
+        return {",".join(key): m.count for key, m in snap}
+
+
 class MeanMetric:
     """Tracks a running (count, sum) pair — e.g. query count + total time."""
 

@@ -110,7 +110,13 @@ class Node:
                 # ES_TPU_JAX_CACHE_DIR still overrides
                 compile_cache_dir=self.settings.get(
                     "search.tpu_serving.compile_cache_dir",
-                    _os.path.join(data_path, "jax_compile_cache")))
+                    _os.path.join(data_path, "jax_compile_cache")),
+                # packed-key device kernels (PERF.md round 8): single
+                # uint32 sort key + hierarchical top-k, with automatic
+                # per-launch exact-f32 fallback when the pack/batch
+                # overflows the packed layout
+                packed_sort=self.settings.get_bool(
+                    "search.tpu_serving.kernel.packed_sort", True))
         from elasticsearch_tpu.common.threadpool import ThreadPools
         self.thread_pools = ThreadPools(self.settings)
         self.controller = RestController()
@@ -278,6 +284,8 @@ class Node:
                      "Lowered-plan cache lookups served from cache")
         reg.set_help("transport.retries",
                      "Transport sends retried after a retryable failure")
+        reg.set_help("kernel.variant",
+                     "Device-kernel launches by (kernel, variant)")
 
         def _threadpools():
             for name, pool in self.thread_pools.pools.items():
@@ -336,6 +344,14 @@ class Node:
                 warm = dict(svc._prewarm_progress)
             yield ("search.tpu.prewarm_total", nl, warm["total"], "gauge")
             yield ("search.tpu.prewarm_done", nl, warm["done"], "gauge")
+            from elasticsearch_tpu.search.tpu_service import (
+                KERNEL_CONFIG, KERNEL_VARIANT_COUNTS)
+            yield ("search.tpu.kernel_packed_sort", nl,
+                   1 if KERNEL_CONFIG["packed_sort"] else 0, "gauge")
+            # per-(kernel, variant) launch counts:
+            # es_tpu_kernel_variant_total{kernel=...,variant=...}
+            for labels, counter in KERNEL_VARIANT_COUNTS.items():
+                yield ("kernel.variant", labels, counter)
             for stage, seconds, count, ring in svc.stages.metrics_view():
                 lb = {"stage": stage}
                 yield ("search.tpu.stage_seconds", lb, seconds, "counter")

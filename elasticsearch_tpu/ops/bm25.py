@@ -137,10 +137,15 @@ def range_mask_f64(col: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("k",))
 def topk(scores: jax.Array, *, k: int) -> Tuple[jax.Array, jax.Array]:
     """Top-k per query row with Lucene tie-breaking (equal scores → smaller
-    doc id wins). lax.top_k already returns the earliest index among equals,
-    which is exactly that order for a doc-ordinal axis."""
+    doc id wins). Routed through the hierarchical per-block reduction
+    (sparse.hierarchical_top_k, PERF.md round 8), which is selection- AND
+    tie-break-identical to lax.top_k — equal-score winners still come out
+    in ascending doc-ordinal order — while shrinking the full-width sort
+    network on wide (padded-doc-axis) score rows. Narrow or non-block
+    widths fall back to lax.top_k inside the helper."""
+    from elasticsearch_tpu.ops.sparse import hierarchical_top_k
     k = min(k, scores.shape[-1])
-    return jax.lax.top_k(scores, k)
+    return hierarchical_top_k(scores, k)
 
 
 @jax.jit

@@ -24,6 +24,22 @@ is the equal-doc run length, which is exact because each slot holds a doc
 at most once (postings rows have unique docs, and chunks of one term
 partition its row). Ties break like Lucene: equal scores → smaller doc id
 (sorted axis + top_k's earliest-index-wins).
+
+Packed-key variant (variant="packed", PERF.md round 8): the merge sort
+dominates kernel time and is memory-bandwidth-bound, so instead of
+sorting a (docs int32, impacts f32) key+value PAIR, each lane packs
+  key = doc_id << 16  |  monotone 16-bit impact code
+into ONE uint32 and the sort moves half the bytes. The code is the top
+16 bits of the f32 bit pattern (bf16-style truncation) — order-preserving
+for non-negative floats, so run structure, run lengths (msm counts) and
+totals are exact; only the impact VALUES are approximate. Top candidates
+are then selected hierarchically (per-block top-k' + merge instead of one
+full-width top_k over T*L_c) and re-scored in exact f32 by binary-searching
+each candidate in the doc-sorted chunks — summed in the reference
+variant's exact order, so returned scores, doc ids, tie-breaks and totals
+are bit-identical to variant="ref". Requires packable() inputs (doc ids
+< 2**16, sane non-negative weights); the serving stack checks that at
+lowering time and falls back to "ref" otherwise.
 """
 
 from __future__ import annotations
@@ -37,6 +53,94 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG_INF = float("-inf")
+
+#: doc-id field width of the packed sort key: doc ids (including the
+#: d_pad sentinel) must be < 2**16 for the packed variant to apply
+PACKED_DOC_LIMIT = 1 << 16
+
+#: positive slot weights outside this range route to the exact-f32
+#: fallback: below the floor a real match's weighted impact could
+#: truncate to code 0 (dropping it from totals), above the ceiling the
+#: quantized sums lose the ordering guarantees the rescore slack assumes
+PACKED_WEIGHT_MIN = 1e-12
+PACKED_WEIGHT_MAX = 1e30
+
+KERNEL_VARIANTS = ("ref", "packed")
+
+
+def impact_code16(x: jax.Array) -> jax.Array:
+    """Monotone 16-bit code of a non-negative finite f32: the top 16
+    bits of its bit pattern (bf16-style truncation). Order-preserving —
+    x <= y implies code(x) <= code(y) — and decode_code16(code(x)) is a
+    lower bound of x, so quantized run totals never overshoot."""
+    return jax.lax.bitcast_convert_type(x, jnp.uint32) >> 16
+
+
+def decode_code16(code: jax.Array) -> jax.Array:
+    """Inverse of impact_code16 up to truncation: the largest f32 whose
+    code equals `code` rounds down to this value (zero low bits)."""
+    return jax.lax.bitcast_convert_type(
+        (code << 16).astype(jnp.uint32), jnp.float32)
+
+
+def packable(d_pad: int, weights: Optional[np.ndarray] = None) -> bool:
+    """Host-side lowering-time check: may the packed-key variant serve
+    this (pack, batch)? False routes the batch to the exact-f32
+    reference variant. Conditions: every doc id INCLUDING the d_pad
+    sentinel must fit the 16-bit doc field, and every slot weight must
+    be finite, non-negative and (when positive) inside
+    [PACKED_WEIGHT_MIN, PACKED_WEIGHT_MAX] — negative weights break the
+    monotone code, and out-of-range magnitudes could zero or saturate a
+    real contribution's 16-bit code."""
+    if d_pad >= PACKED_DOC_LIMIT:
+        return False
+    if weights is not None:
+        w = np.asarray(weights)
+        if w.size:
+            if not np.isfinite(w).all() or bool((w < 0).any()):
+                return False
+            pos = w[w > 0]
+            if pos.size and (float(pos.min()) < PACKED_WEIGHT_MIN
+                             or float(pos.max()) > PACKED_WEIGHT_MAX):
+                return False
+    return True
+
+
+def hierarchical_top_k(score: jax.Array, k: int, block: int = 4096,
+                       split: Optional[bool] = None,
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """top_k over [R, L] as per-block top-k' then a merge top-k — the
+    full-width lax.top_k over T*L_c is the other half of the device
+    floor at the 128-slot widths. Selection and tie-breaking are
+    IDENTICAL to lax.top_k(score, k): with k' = min(k, block) a global
+    winner is always inside its block's top-k', and equal values keep
+    earliest-global-index preference because blocks merge in index
+    order and each block's top_k is earliest-index-first among ties.
+    Falls back to the flat top_k when the width doesn't split (L not a
+    multiple of `block`, or k so large the merge wouldn't shrink).
+
+    split=None picks per backend at trace time: the per-block reduction
+    pays on sort-network backends (TPU lowers top_k to a bitonic sort
+    of the FULL width, so blocking cuts real comparator work), while
+    XLA:CPU's TopK custom call is already O(n) selection and the split
+    only adds per-row dispatch overhead (measured ~5x slower at the
+    32-slot serving width — tests/test_kernel_bench.py pins this).
+    split=True forces the per-block path (parity tests exercise its
+    merge logic on CPU); split=False forces flat."""
+    r, length = score.shape
+    kk = min(k, length)
+    if split is None:
+        split = jax.default_backend() == "tpu"
+    if not split or length <= block or kk >= block or length % block:
+        return jax.lax.top_k(score, kk)
+    n_blocks = length // block
+    k_b = min(kk, block)
+    v, p = jax.lax.top_k(score.reshape(r, n_blocks, block), k_b)
+    base = (jnp.arange(n_blocks, dtype=jnp.int32) * block)[None, :, None]
+    v = v.reshape(r, n_blocks * k_b)
+    p = (p + base).reshape(r, n_blocks * k_b)
+    vals, pos2 = jax.lax.top_k(v, kk)
+    return vals, jnp.take_along_axis(p, pos2, axis=1)
 
 
 def segmented_run_sum(sk: jax.Array, sv: jax.Array,
@@ -60,7 +164,8 @@ def segmented_run_sum(sk: jax.Array, sv: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("max_len", "d_pad", "k", "t_window",
-                                   "with_counts", "with_totals"))
+                                   "with_counts", "with_totals",
+                                   "variant"))
 def sorted_merge_topk(
     flat_docs: jax.Array,    # int32[P_flat] postings doc ids (pad = d_pad)
     flat_impact: jax.Array,  # f32[P_flat] eager BM25 impacts
@@ -75,11 +180,22 @@ def sorted_merge_topk(
     t_window: int,           # static: T (slot count = max same-doc entries)
     with_counts: bool,       # static: evaluate min_count (msm/AND)
     with_totals: bool = False,  # static: also return matched-doc counts
+    variant: str = "ref",    # static: "ref" | "packed" (see module doc)
 ) -> Tuple[jax.Array, ...]:
     """→ (scores f32[R, k'], doc_ids int32[R, k'][, totals int32[R]]);
     empty lanes are (-inf, d_pad). k' = min(k, T·L_c). totals (when
     with_totals) is the exact per-row count of matching docs — the
-    TotalHits value of the reference's query phase."""
+    TotalHits value of the reference's query phase. variant="packed"
+    computes the same outputs bit-for-bit via the single-key sort +
+    hierarchical top-k + exact rescore pipeline; callers must have
+    checked packable() host-side."""
+    if variant not in KERNEL_VARIANTS:
+        raise ValueError(f"unknown kernel variant {variant!r}")
+    packed = variant == "packed"
+    if packed and d_pad >= PACKED_DOC_LIMIT:
+        raise ValueError(
+            f"packed variant needs d_pad < {PACKED_DOC_LIMIT}, got "
+            f"{d_pad} — caller must fall back to variant='ref'")
     r, t_slots = starts.shape
     idx = jnp.arange(max_len, dtype=jnp.int32)
 
@@ -93,8 +209,22 @@ def sorted_merge_topk(
     imp = jnp.where(valid, weights[:, :, None] * imps, 0.0)
 
     length = t_slots * max_len
-    sk, sv = jax.lax.sort(
-        [docs.reshape(r, length), imp.reshape(r, length)], num_keys=1)
+    kk = min(k, length)
+    if packed:
+        # ONE uint32 sort key per lane: doc id high, impact code low —
+        # half the sorted bytes of the (docs, imp) pair. Equal-doc lanes
+        # stay contiguous (doc owns the high bits); padded lanes carry
+        # (d_pad, code 0) and sort to the tail like the reference.
+        key = ((docs.astype(jnp.uint32) << 16)
+               | impact_code16(imp)).reshape(r, length)
+        sk_key = jax.lax.sort(key)
+        sk = (sk_key >> 16).astype(jnp.int32)
+        # decoded codes are LOWER bounds of the exact lane impacts, so
+        # total>0 tests and candidate ordering are conservative
+        sv = decode_code16(sk_key & jnp.uint32(0xFFFF))
+    else:
+        sk, sv = jax.lax.sort(
+            [docs.reshape(r, length), imp.reshape(r, length)], num_keys=1)
 
     total = segmented_run_sum(sk, sv, t_window)
 
@@ -102,20 +232,109 @@ def sorted_merge_topk(
         [sk[:, :-1] != sk[:, 1:], jnp.ones((r, 1), bool)], axis=1)
     ok = run_end & (sk < d_pad) & (total > 0)
 
-    if with_counts:
+    cnt = None
+    if with_counts or packed:
         # clause count per doc = run length (each slot holds a doc at most
         # once: postings rows have unique docs, chunks of one term
         # partition its row). Runs are ≤ t_window long by the same
-        # argument, so the log-step scan sees the whole run.
+        # argument, so the log-step scan sees the whole run. The packed
+        # rescore needs it too: the run length is the matched-slot count.
         cnt = segmented_run_sum(sk, jnp.ones_like(sv), t_window)
+    if with_counts:
         ok = ok & (cnt >= min_count[:, None].astype(jnp.float32))
 
+    # totals BEFORE candidate selection: the count is a property of the
+    # full sorted axis, and computing it here keeps every downstream
+    # top-k shape (full-width or hierarchical) from being able to drop
+    # or truncate it
+    totals = jnp.sum(ok, axis=1, dtype=jnp.int32) if with_totals else None
+
     score = jnp.where(ok, total, NEG_INF)
-    vals, pos = jax.lax.top_k(score, min(k, length))
-    hit_docs = jnp.take_along_axis(sk, pos, axis=1)
-    hit_docs = jnp.where(vals > NEG_INF, hit_docs, d_pad)
+    if packed:
+        vals, hit_docs = _packed_rescore_topk(
+            flat_docs, flat_impact, starts, lengths, weights,
+            sk, score, cnt, kk, max_len=max_len, d_pad=d_pad,
+            t_window=t_window)
+    else:
+        vals, pos = jax.lax.top_k(score, kk)
+        hit_docs = jnp.take_along_axis(sk, pos, axis=1)
+        hit_docs = jnp.where(vals > NEG_INF, hit_docs, d_pad)
     if with_totals:
-        return vals, hit_docs, jnp.sum(ok, axis=1, dtype=jnp.int32)
+        return vals, hit_docs, totals
+    return vals, hit_docs
+
+
+def _packed_rescore_topk(flat_docs, flat_impact, starts, lengths, weights,
+                         sk, score, cnt, kk, *, max_len: int, d_pad: int,
+                         t_window: int):
+    """Candidate selection + exact-f32 rescore for the packed variant.
+
+    Selection: hierarchical top-k over the QUANTIZED run totals, with
+    slack — every code is a lower bound within 2**-8 relative of its
+    lane, so any true top-kk doc ranks above quantized-rank kk + m
+    unless m+1 other docs land inside that relative band of the
+    boundary; the slack makes the sweep-tested shapes exact in practice
+    while the width stays a small multiple of kk instead of T*L_c.
+
+    Rescore: each candidate's exact contribution per slot comes from a
+    lower_bound binary search in that slot's doc-sorted chunk, then the
+    matched contributions are compacted (stable, slot order — the same
+    value order the reference's stable doc sort produces) and summed by
+    the SAME log-step guarded scan over the same run length, so the
+    f32 rounding tree is bit-identical to segmented_run_sum's and the
+    returned scores equal variant="ref" exactly, not just closely."""
+    r, t_slots = starts.shape
+    length = sk.shape[1]
+    kc = min(length, kk + max(kk, 64))
+    a_vals, a_pos = hierarchical_top_k(score, kc)
+    cand_docs = jnp.take_along_axis(sk, a_pos, axis=1)           # [R, kc]
+    cand_cnt = jnp.take_along_axis(cnt, a_pos, axis=1).astype(jnp.int32)
+
+    # exact per-slot contribution: lower_bound of the candidate doc in
+    # each chunk's [start, start+len) range of the doc-sorted postings
+    lo = jnp.broadcast_to(starts[:, None, :], (r, kc, t_slots))
+    ln3 = jnp.broadcast_to(lengths[:, None, :], (r, kc, t_slots))
+    end = lo + ln3
+    hi = end
+    target = cand_docs[:, :, None]
+    for _ in range(max(1, int(max_len).bit_length())):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        v = jnp.take(flat_docs, mid, mode="fill", fill_value=d_pad)
+        go = v < target
+        lo = jnp.where(active & go, mid + 1, lo)
+        hi = jnp.where(active & ~go, mid, hi)
+    v = jnp.take(flat_docs, lo, mode="fill", fill_value=d_pad)
+    found = (ln3 > 0) & (lo < end) & (v == target) & (target < d_pad)
+    imp_exact = jnp.take(flat_impact, lo, mode="fill", fill_value=0.0)
+    contrib = jnp.where(found, weights[:, None, :] * imp_exact, 0.0)
+
+    # compact matched slots to the front (stable ⇒ slot order preserved:
+    # exactly the lane order of the reference's equal-doc run) and redo
+    # the run sum with the reference's tree: the guarded log-step scan's
+    # rounding order depends only on offset-in-run and step count, both
+    # reproduced here, so the sums are bit-identical
+    flat_rc = (r * kc, t_slots)
+    comp_key, comp_val = jax.lax.sort(
+        [jnp.where(found, 0, 1).astype(jnp.int32).reshape(flat_rc),
+         contrib.reshape(flat_rc)], num_keys=1)
+    run_pos = jnp.arange(t_slots, dtype=jnp.int32)[None, :]
+    m = cand_cnt.reshape(r * kc, 1)
+    scan_keys = jnp.where(run_pos < m, 0, run_pos + 1)
+    scan_tot = segmented_run_sum(scan_keys, comp_val, t_window)
+    gather_at = jnp.clip(m - 1, 0, t_slots - 1)
+    exact = jnp.take_along_axis(scan_tot, gather_at,
+                                axis=1).reshape(r, kc)
+    exact = jnp.where(a_vals > NEG_INF, exact, NEG_INF)
+
+    # final order on EXACT scores with the reference tie rule (equal
+    # scores → smaller doc id); -inf lanes pinned to (+inf, d_pad) keys
+    # so they tail-sort identically
+    neg = jnp.where(exact > NEG_INF, -exact, jnp.inf)
+    docs_key = jnp.where(exact > NEG_INF, cand_docs, d_pad)
+    neg_s, docs_s = jax.lax.sort([neg, docs_key], num_keys=2)
+    vals = jnp.where(jnp.isinf(neg_s[:, :kk]), NEG_INF, -neg_s[:, :kk])
+    hit_docs = jnp.where(vals > NEG_INF, docs_s[:, :kk], d_pad)
     return vals, hit_docs
 
 

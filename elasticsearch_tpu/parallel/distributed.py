@@ -396,7 +396,8 @@ def prepare_query_batch(pack: StackedShardPack,
 
 def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
                 *, max_len: int, d_pad: int, p_pad: int, k: int,
-                t_window: int, with_counts: bool, shard_offset):
+                t_window: int, with_counts: bool, shard_offset,
+                variant: str = "ref"):
     """Score this device's S_l shards × B queries and return per-query
     (vals, global ids) merged over the local shards.
 
@@ -414,7 +415,7 @@ def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
         weights.reshape(r, t),
         jnp.tile(min_count, s_l),
         max_len=max_len, d_pad=d_pad, k=k, t_window=t_window,
-        with_counts=with_counts, with_totals=True)
+        with_counts=with_counts, with_totals=True, variant=variant)
     k_l = vals.shape[1]
     vals = vals.reshape(s_l, b, k_l)
     docs = docs.reshape(s_l, b, k_l)
@@ -427,15 +428,20 @@ def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
     return vals_b, gids_b, totals_b
 
 
-def _merge_topk(vals_b, gids_b, k: int):
-    top_vals, pos = jax.lax.top_k(vals_b, min(k, vals_b.shape[1]))
+def _merge_topk(vals_b, gids_b, k: int, variant: str = "ref"):
+    if variant == "packed":
+        top_vals, pos = sparse.hierarchical_top_k(
+            vals_b, min(k, vals_b.shape[1]))
+    else:
+        top_vals, pos = jax.lax.top_k(vals_b, min(k, vals_b.shape[1]))
     top_ids = jnp.take_along_axis(gids_b, pos, axis=1)
     return top_vals, top_ids
 
 
 @lru_cache(maxsize=64)
 def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
-                      t_window: int, with_counts: bool = False):
+                      t_window: int, with_counts: bool = False,
+                      variant: str = "ref"):
     """Single-device search step: S shards × B queries → global top-k.
     Used by the bench on one chip and as the compile-check entry point.
     lru_cached so repeated bucket signatures reuse the jitted step (and
@@ -447,8 +453,8 @@ def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
             flat_docs, flat_impact, starts, lengths, weights, min_count,
             max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
             t_window=t_window, with_counts=with_counts,
-            shard_offset=jnp.int64(0))
-        top_vals, top_ids = _merge_topk(vals_b, gids_b, k)
+            shard_offset=jnp.int64(0), variant=variant)
+        top_vals, top_ids = _merge_topk(vals_b, gids_b, k, variant)
         return top_vals, top_ids, totals_b
 
     return step
@@ -457,7 +463,8 @@ def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
 @lru_cache(maxsize=64)
 def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
                             p_pad: int, k: int, t_window: int,
-                            with_counts: bool = False):
+                            with_counts: bool = False,
+                            variant: str = "ref"):
     """SPMD search step over a (data, shards) mesh: local sorted-merge
     per device, then all_gather over "shards" + final top-k on device
     (SURVEY.md §5.8: the P3 reduce rides ICI). lru_cached by (mesh, bucket
@@ -471,11 +478,11 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
             flat_docs, flat_impact, starts, lengths, weights, min_count,
             max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
             t_window=t_window, with_counts=with_counts,
-            shard_offset=my * s_l)
+            shard_offset=my * s_l, variant=variant)
         all_vals = jax.lax.all_gather(vals_b, SHARD_AXIS, axis=1, tiled=True)
         all_ids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1, tiled=True)
         totals = jax.lax.psum(totals_b, SHARD_AXIS)  # TotalHits reduce
-        top_vals, top_ids = _merge_topk(all_vals, all_ids, k)
+        top_vals, top_ids = _merge_topk(all_vals, all_ids, k, variant)
         return top_vals, top_ids, totals
 
     spec_post = P(SHARD_AXIS, None)
@@ -540,7 +547,8 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
                        c_cand: int, k_out: int, t_window: int,
                        t_terms: int, search_iters: Optional[int] = None,
                        c_local: Optional[int] = None,
-                       with_rescore: bool = True):
+                       with_rescore: bool = True,
+                       variant: str = "ref"):
     """Block-max serving step, ONE fused launch (SURVEY.md §5.7/§7.3#3):
 
       phase A  candidate generation over impact-sorted postings prefixes
@@ -642,7 +650,14 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
             ok = run_end & (total > 0.0)
             score = jnp.where(ok, total, NEG_INF)
             totals_g = jnp.sum(ok, axis=1).astype(jnp.int32)
-            vals_g, pos = jax.lax.top_k(score, k_dev)
+            # gid keys span row·(d_pad+1)+doc — far beyond the 16-bit
+            # packed-key range — so the pruned path only takes the
+            # hierarchical top-k half of the packed variant; selection
+            # and tie-breaks are provably identical to lax.top_k
+            if variant == "packed":
+                vals_g, pos = sparse.hierarchical_top_k(score, k_dev)
+            else:
+                vals_g, pos = jax.lax.top_k(score, k_dev)
             gid_g = jnp.take_along_axis(sk, pos, axis=1)
             return vals_g, gid_g, totals_g
 
@@ -672,7 +687,10 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
         all_gids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1, tiled=True)
         totals = jax.lax.psum(totals_b, SHARD_AXIS)
         c = min(c_cand, all_vals.shape[1])
-        cand_vals, pos = jax.lax.top_k(all_vals, c)
+        if variant == "packed":
+            cand_vals, pos = sparse.hierarchical_top_k(all_vals, c)
+        else:
+            cand_vals, pos = jax.lax.top_k(all_vals, c)
         cand_gids = jnp.take_along_axis(all_gids, pos, axis=1)  # [B, C]
 
         if with_rescore:
@@ -790,7 +808,8 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
                            k: int, mesh: Mesh, device_arrays=None,
                            with_counts: Optional[bool] = None,
                            t_window: Optional[int] = None,
-                           materialize: bool = True):
+                           materialize: bool = True,
+                           variant: str = "ref"):
     """One distributed query step, RAW outputs: numpy (vals [B,k'],
     gids int64 [B,k'], totals [B]) with no per-hit host decoding — the
     serving path decodes the whole batch vectorized (VERDICT r3 #1).
@@ -807,7 +826,7 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
     flat_docs, flat_impact = device_arrays
     fn = make_distributed_search(
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
-        k=k, t_window=t_window, with_counts=with_counts)
+        k=k, t_window=t_window, with_counts=with_counts, variant=variant)
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     db = NamedSharding(mesh, P(DATA_AXIS))
     vals, ids, totals = fn(flat_docs, flat_impact,

@@ -38,11 +38,30 @@ from elasticsearch_tpu.mapping.types import (
     RangeFieldType,
     TextFieldType,
 )
-from elasticsearch_tpu.ops import bm25
+from elasticsearch_tpu.ops import bm25, sparse
 from elasticsearch_tpu.ops.smallfloat import bm25_norm_cache
 from elasticsearch_tpu.search import dsl
 
 MAX_SLOTS_PER_PASS = 32
+
+
+def choose_kernel_variant(d_pad: int,
+                          weights: Optional[np.ndarray] = None,
+                          enabled: bool = True) -> str:
+    """Pick the device-kernel variant for one lowered pack/batch.
+
+    Lowering-time decision (PERF.md round 8): "packed" — the single
+    uint32-key sort + hierarchical top-k + exact-f32 rescore — whenever
+    the pack's doc axis and the batch's slot weights fit the 16-bit
+    packed layout (sparse.packable); otherwise the exact-f32 reference
+    kernel. The fallback conditions are the documented overflow cases:
+    d_pad ≥ 2^16 chunk-local doc ids, non-finite/negative weights, or
+    weight magnitudes outside [1e-12, 1e30] (where the monotone 16-bit
+    impact code could turn a positive contribution into code 0 and
+    perturb TotalHits)."""
+    if enabled and sparse.packable(d_pad, weights):
+        return "packed"
+    return "ref"
 
 
 def _edit_distance_lte(a: str, b: str, k: int) -> bool:

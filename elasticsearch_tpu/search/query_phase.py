@@ -147,6 +147,10 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
             agg_ctx = SegmentAggContext(reader, idx)
             agg_parts.append(aggs.collect(agg_ctx, np.asarray(match)))
         if k > 0:
+            # bm25.topk runs the hierarchical per-block reduction over
+            # the dense padded doc axis (round 8) — identical selection
+            # and tie-breaks to full-width lax.top_k, cheaper at the
+            # multi-million-doc segment widths this loop sees
             vals, idxs = bm25.topk(final[None, :], k=min(k, view.pack.d_pad))
             per_segment.append((idx, np.asarray(vals[0]), np.asarray(idxs[0])))
     # merge across segments: (score desc, segment ord asc, doc ord asc) —

@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common.metrics import LabeledCounters
 from elasticsearch_tpu.mapping.types import TextFieldType
 from elasticsearch_tpu.parallel import distributed as dist
 from elasticsearch_tpu.parallel.mesh import SHARD_AXIS, make_mesh
@@ -814,6 +815,35 @@ PRUNE_MAX_K = 1000
 PRUNE_MAX_TERMS = 8          # > 8 query terms → exact path
 _PRUNE_WINDOW = 8
 
+# device-kernel variant selection (PERF.md round 8). packed_sort=True
+# routes launches through the single-packed-key sort + hierarchical
+# top-k kernels; choose_kernel_variant still falls back to "ref"
+# per-launch whenever the pack/batch overflows the 16-bit packed layout
+# (the setting is the ceiling, packability is the floor). Process-wide
+# because the jitted kernels and their prewarmed signatures are too
+# (`search.tpu_serving.kernel.packed_sort`).
+KERNEL_CONFIG = {"packed_sort": True}
+
+#: per-(kernel, variant) launch counters → es_tpu_kernel_variant_total
+KERNEL_VARIANT_COUNTS = LabeledCounters("kernel", "variant")
+
+
+def _choose_exact_variant(resident: ResidentPack, batch) -> str:
+    """Lowering-time variant pick for one exact-kernel launch (the
+    planner owns the decision rule; this just feeds it the pack's doc
+    axis and the prepared batch's slot weights)."""
+    from elasticsearch_tpu.search.planner import choose_kernel_variant
+    return choose_kernel_variant(resident.pack.d_pad, batch.weights,
+                                 enabled=KERNEL_CONFIG["packed_sort"])
+
+
+def _pruned_variant() -> str:
+    """The pruned kernel sorts shard-offset gid keys (way past 16 bits)
+    so it never packs — its "packed" variant is the hierarchical top-k
+    half only, which is unconditionally safe. Setting-gated so the
+    bench can A/B it."""
+    return "packed" if KERNEL_CONFIG["packed_sort"] else "ref"
+
 
 def _prune_t_slots(prefix_cap: int) -> int:
     from elasticsearch_tpu.parallel.distributed import CHUNK_CAP
@@ -930,7 +960,8 @@ def launch_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
             prefix_cap=PREFIX_CAP2, stages=stages)
     if exact_idx:
         st["exact_launch"] = _launch_exact(
-            resident, [flats[i] for i in exact_idx], k, mesh)
+            resident, [flats[i] for i in exact_idx], k, mesh,
+            stages=stages)
     return st
 
 
@@ -972,13 +1003,14 @@ def finish_flat_batch(st: Dict[str, Any]) -> List[FlatQueryResult]:
             stages.add("pruned_invalid_t3", 0.0, n=len(invalid2))
         tier3_idx = [retry_idx[j] for j in invalid2]
     if "exact_launch" in st:
-        results = _finish_exact(st["exact_launch"])
+        results = _finish_exact(st["exact_launch"], stages=stages)
         for j, i in enumerate(st["exact_idx"]):
             out[i] = results[j]
     if tier3_idx:
         t0 = time.perf_counter()
         results = _execute_exact(resident,
-                                 [flats[i] for i in tier3_idx], k, mesh)
+                                 [flats[i] for i in tier3_idx], k, mesh,
+                                 stages=stages)
         if stages is not None:
             stages.add("exact_batch", time.perf_counter() - t0,
                        n=len(tier3_idx))
@@ -1031,7 +1063,9 @@ def _columnar_results(resident: ResidentPack, vals: np.ndarray,
 
 
 def _launch_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
-                  k: int, mesh) -> Dict[str, Any]:
+                  k: int, mesh,
+                  stages: Optional[StageTimes] = None,
+                  variant: Optional[str] = None) -> Dict[str, Any]:
     """Full-postings kernel, async dispatch: exact scores, exact totals
     (tier 3 for OR queries whose validity bounds failed twice; tier 1
     for msm/AND). Every jit dimension is BUCKETED — batch (8/64/pow2),
@@ -1041,6 +1075,7 @@ def _launch_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
     persisted by the compilation cache)."""
     import dataclasses as _dc
 
+    t_prep = time.perf_counter()
     pack = resident.pack
     batch = dist.prepare_query_batch(
         pack, [f.terms for f in flats],
@@ -1060,32 +1095,54 @@ def _launch_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
             weights=np.pad(batch.weights, pad), t_slots=t_pin)
     k_kernel = 128 if k <= 128 else (1024 if k <= 1024
                                      else _batch_bucket(k, 16384))
+    if variant is None:
+        variant = _choose_exact_variant(resident, batch)
+    KERNEL_VARIANT_COUNTS.inc("exact", variant)
+    t_disp = time.perf_counter()
     vals, gids, totals = dist.distributed_search_raw(
         pack, batch, k_kernel, mesh, device_arrays=resident.device_arrays,
-        t_window=max(_PRUNE_WINDOW, batch.window), materialize=False)
+        t_window=max(_PRUNE_WINDOW, batch.window), materialize=False,
+        variant=variant)
+    if stages is not None:
+        stages.add("exact_prep", t_disp - t_prep)
+        stages.add(f"exact_dispatch.{variant}",
+                   time.perf_counter() - t_disp)
     return {"resident": resident, "n": len(flats), "k": k,
-            "vals": vals, "gids": gids, "totals": totals}
+            "vals": vals, "gids": gids, "totals": totals,
+            "variant": variant}
 
 
-def _finish_exact(launch: Dict[str, Any]) -> List[FlatQueryResult]:
+def _finish_exact(launch: Dict[str, Any],
+                  stages: Optional[StageTimes] = None
+                  ) -> List[FlatQueryResult]:
+    t_dev = time.perf_counter()
     vals = np.asarray(launch["vals"])
     gids = np.asarray(launch["gids"])
     totals = np.asarray(launch["totals"])
+    if stages is not None:
+        # variant-tagged: the bench's kernel_compare diffs these rings
+        # per variant for device_ms_per_query
+        stages.add(f"exact_device_wait.{launch['variant']}",
+                   time.perf_counter() - t_dev)
     return _columnar_results(launch["resident"], vals, gids, totals,
                              launch["n"], lambda qi: "eq",
                              k_cap=launch["k"])
 
 
 def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
-                   k: int, mesh) -> List[FlatQueryResult]:
-    return _finish_exact(_launch_exact(resident, flats, k, mesh))
+                   k: int, mesh, stages: Optional[StageTimes] = None,
+                   variant: Optional[str] = None) -> List[FlatQueryResult]:
+    return _finish_exact(_launch_exact(resident, flats, k, mesh,
+                                       stages=stages, variant=variant),
+                         stages=stages)
 
 
 def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
                    k: int, mesh, prefix_cap: int = PREFIX_CAP,
                    stages: Optional[StageTimes] = None,
                    with_rescore: bool = True,
-                   full_slots: Optional[int] = None) -> Dict[str, Any]:
+                   full_slots: Optional[int] = None,
+                   variant: Optional[str] = None) -> Dict[str, Any]:
     """One fused ASYNC launch. Two modes:
     - full_slots=N: FULL-postings sorted-merge at the N-slot width —
       run totals are exact BM25, no rescore (SURVEY.md §5.7 applied as
@@ -1123,11 +1180,16 @@ def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
         pack, [f.terms for f in flats],
         boosts=[f.boost for f in flats],
         pad_batch_to=b_bucket, pad_terms=PRUNE_MAX_TERMS)
+    if variant is None:
+        variant = _pruned_variant()
+    KERNEL_VARIANT_COUNTS.inc("full" if full_slots is not None
+                              else "pruned", variant)
     fn = dist.make_pruned_search(
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
         c_cand=k_cand, k_out=k_out,
         t_window=max(_PRUNE_WINDOW, batch.window),
-        t_terms=PRUNE_MAX_TERMS, with_rescore=with_rescore)
+        t_terms=PRUNE_MAX_TERMS, with_rescore=with_rescore,
+        variant=variant)
     from jax.sharding import NamedSharding, PartitionSpec as P
     from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
@@ -1141,8 +1203,9 @@ def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     if stages is not None:
         stages.add("batch_prep", t_disp - t_prep)
         stages.add("batch_dispatch", t_dev - t_disp)
+        stages.add(f"batch_dispatch.{variant}", t_dev - t_disp)
     return {"resident": resident, "flats": flats, "k": k,
-            "packed": packed}
+            "packed": packed, "variant": variant}
 
 
 def _finish_pruned(launch: Dict[str, Any],
@@ -1162,6 +1225,10 @@ def _finish_pruned(launch: Dict[str, Any],
     t_decode = time.perf_counter()
     if stages is not None:
         stages.add("batch_device_wait", t_decode - t_dev)
+        # variant-tagged sibling ring: kernel_compare reads per-variant
+        # device time from here without disturbing the canonical stage
+        stages.add(f"batch_device_wait.{launch['variant']}",
+                   t_decode - t_dev)
 
     # vectorized batch decode (VERDICT r3 #1): clamp each query to its
     # first min(n_valid, k) entries, then check the WAND validity bound
@@ -1198,13 +1265,14 @@ def _execute_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
                     k: int, mesh, stages: Optional[StageTimes] = None,
                     prefix_cap: int = PREFIX_CAP,
                     with_rescore: bool = True,
-                    full_slots: Optional[int] = None
+                    full_slots: Optional[int] = None,
+                    variant: Optional[str] = None
                     ) -> Tuple[List[FlatQueryResult], List[int]]:
     """Synchronous pruned execution (escalations, prewarm, dryrun)."""
     return _finish_pruned(
         _launch_pruned(resident, flats, k, mesh, prefix_cap=prefix_cap,
                        stages=stages, with_rescore=with_rescore,
-                       full_slots=full_slots),
+                       full_slots=full_slots, variant=variant),
         stages=stages)
 
 
@@ -1225,8 +1293,10 @@ class TpuSearchService:
                  max_batch: int = 128, batch_timeout_s: float = 30.0,
                  plan_cache_size: int = 2048,
                  prewarm_concurrency: int = 4,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 packed_sort: bool = True):
         _ensure_compile_cache(compile_cache_dir)
+        KERNEL_CONFIG["packed_sort"] = bool(packed_sort)
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.plans = PlanCache(max_entries=plan_cache_size)
         self.batch_timeout_s = batch_timeout_s
@@ -1255,6 +1325,16 @@ class TpuSearchService:
         self._prewarm_lock = threading.Lock()
         self._prewarm_progress: Dict[str, Any] = {
             "state": "idle", "total": 0, "done": 0, "seconds": 0.0}
+
+    def set_kernel_packed_sort(self, enabled: bool) -> None:
+        """Flip the packed-sort kernel variant at runtime (the bench's
+        kernel_compare mode A/Bs through this; per-launch packability
+        fallback still applies when enabling)."""
+        KERNEL_CONFIG["packed_sort"] = bool(enabled)
+
+    @property
+    def kernel_packed_sort(self) -> bool:
+        return KERNEL_CONFIG["packed_sort"]
 
     def invalidate_index(self, index_name: str) -> None:
         """Drop resident packs AND lowered plans of a deleted/closed
@@ -1462,35 +1542,54 @@ class TpuSearchService:
         for b_bucket in buckets:
             for k in (10, PRUNE_MAX_K):
                 table.append((b_bucket, k, None, PREFIX_CAP3))
+        # both kernel variants warm when packed sorting is on: "ref"
+        # stays reachable (per-launch packability fallback, the runtime
+        # toggle, the bench A/B) and must never cold-compile inside the
+        # batch completer. Pruned kernels never pack their gid keys, so
+        # their "packed" variant differs only in the top-k reduction.
+        if KERNEL_CONFIG["packed_sort"]:
+            pruned_variants = ("packed", "ref")
+        else:
+            pruned_variants = ("ref",)
+        from elasticsearch_tpu.ops import sparse as _sparse
+        if (KERNEL_CONFIG["packed_sort"]
+                and _sparse.packable(resident.pack.d_pad)):
+            exact_variants = ("packed", "ref")
+        else:
+            exact_variants = ("ref",)
         # dedupe to canonical jit signatures: the kernel is compiled per
-        # (batch bucket, candidate-k bucket, width|prefix) — requested k
-        # values that bucket identically would recompile NOTHING, so
-        # warming them again just serializes the warmer
+        # (batch bucket, candidate-k bucket, width|prefix, variant) —
+        # requested k values that bucket identically would recompile
+        # NOTHING, so warming them again just serializes the warmer
         seen = set()
         jobs = []  # (entry, run)
         for b_bucket, k, slots, cap in table:
-            sig = (b_bucket, _candidate_k(k), slots, cap)
-            if sig in seen:
-                continue
-            seen.add(sig)
-            jobs.append(({"batch": b_bucket, "k": k, "slots": slots,
-                          "prefix": cap},
-                         lambda b_bucket=b_bucket, k=k, slots=slots,
-                         cap=cap: _execute_pruned(
-                             resident, [flat] * b_bucket, k,
-                             self.packs.mesh,
-                             prefix_cap=cap or PREFIX_CAP2,
-                             full_slots=slots)))
+            for variant in pruned_variants:
+                sig = (b_bucket, _candidate_k(k), slots, cap, variant)
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                jobs.append(({"batch": b_bucket, "k": k, "slots": slots,
+                              "prefix": cap, "variant": variant},
+                             lambda b_bucket=b_bucket, k=k, slots=slots,
+                             cap=cap, variant=variant: _execute_pruned(
+                                 resident, [flat] * b_bucket, k,
+                                 self.packs.mesh,
+                                 prefix_cap=cap or PREFIX_CAP2,
+                                 full_slots=slots, variant=variant)))
         # exact kernel (msm/AND tier 1, OR tier 3) at its common
         # bucketed signatures; with_counts=True via min_count=2.
         # Hot-term slot buckets (t_slots > 8) compile once ever and
         # persist in the compilation cache.
         flat_and = FlatQuery(flat.field, flat.terms * 2, 1.0, 2)
         for b_bucket, k in ((8, 10), (64, PRUNE_MAX_K)):
-            jobs.append(({"batch": b_bucket, "k": k, "exact": True},
-                         lambda b_bucket=b_bucket, k=k: _execute_exact(
-                             resident, [flat_and] * b_bucket, k,
-                             self.packs.mesh)))
+            for variant in exact_variants:
+                jobs.append(({"batch": b_bucket, "k": k, "exact": True,
+                              "variant": variant},
+                             lambda b_bucket=b_bucket, k=k,
+                             variant=variant: _execute_exact(
+                                 resident, [flat_and] * b_bucket, k,
+                                 self.packs.mesh, variant=variant)))
         with self._prewarm_lock:
             self._prewarm_progress["total"] = len(jobs)
         # prewarm is BEST-EFFORT per signature: one kernel that the
@@ -1550,6 +1649,8 @@ class TpuSearchService:
                 "plan_cache": self.plans.stats(),
                 "pack_cache": self.packs.stats(),
                 "prewarm": prewarm,
+                "kernel": {"packed_sort": KERNEL_CONFIG["packed_sort"],
+                           "variants": KERNEL_VARIANT_COUNTS.counts()},
                 "stages": self.stages.snapshot()}
 
     def close(self) -> None:

@@ -243,16 +243,23 @@ class TestColdStartGrace:
             assert prog["state"] == "done"
             assert prog["done"] == prog["total"] == len(warm["compiled"])
             # deduped: every warmed entry maps to a distinct canonical
-            # jit signature
+            # jit signature (the kernel variant is part of the signature
+            # since round 8 — packed and ref compile separately)
             sigs = []
             for e in warm["compiled"]:
                 if e.get("exact"):
                     sigs.append((e["batch"], "exact",
-                                 svc_mod._candidate_k(e["k"])))
+                                 svc_mod._candidate_k(e["k"]),
+                                 e.get("variant")))
                 else:
                     sigs.append((e["batch"], svc_mod._candidate_k(e["k"]),
-                                 e["slots"], e["prefix"]))
+                                 e["slots"], e["prefix"],
+                                 e.get("variant")))
             assert len(sigs) == len(set(sigs))
+            # with packed_sort on (the default) the small corpus is
+            # packable, so both variants appear in the warm table
+            assert {e.get("variant") for e in warm["compiled"]} == \
+                {"packed", "ref"}
             assert not any(e.get("error") for e in warm["compiled"])
         finally:
             tpu.close()

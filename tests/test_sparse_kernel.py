@@ -26,7 +26,12 @@ def brute_force(rows, flat_docs, flat_impact, d_pad, min_count):
     return out
 
 
-def make_flat(rng, n_terms, d_pad, max_df, slack=256):
+def make_flat(rng, n_terms, d_pad, max_df, slack=4352):
+    # slack must cover the kernel's max_len bucket (≤ chunk_cap = 4096):
+    # sorted_merge_topk slices max_len lanes from each start via
+    # dynamic_slice, which CLAMPS out-of-bounds starts — too little tail
+    # padding silently shifts the last term's read window onto earlier
+    # postings. The serving planner always pads flats by the chunk cap.
     rows = []
     sizes = [int(rng.integers(1, max_df)) for _ in range(n_terms)]
     total = sum(sizes)
@@ -44,14 +49,19 @@ def make_flat(rng, n_terms, d_pad, max_df, slack=256):
 
 
 def run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k, chunk_cap=4096,
-               with_counts=False):
+               with_counts=False, with_totals=False, variant="ref"):
     plan = sparse.plan_slots(rows, mins, chunk_cap=chunk_cap, lane=8)
-    vals, docs = sparse.sorted_merge_topk(
+    out = sparse.sorted_merge_topk(
         jnp.asarray(flat_docs), jnp.asarray(flat_imp),
         jnp.asarray(plan.starts), jnp.asarray(plan.lengths),
         jnp.asarray(plan.weights), jnp.asarray(plan.min_count),
         max_len=plan.max_len, d_pad=d_pad, k=k,
-        t_window=plan.window, with_counts=with_counts)
+        t_window=plan.window, with_counts=with_counts,
+        with_totals=with_totals, variant=variant)
+    if with_totals:
+        vals, docs, totals = out
+        return np.asarray(vals), np.asarray(docs), np.asarray(totals)
+    vals, docs = out
     return np.asarray(vals), np.asarray(docs)
 
 
@@ -136,6 +146,190 @@ class TestSortedMergeTopk:
         rows = [[(0, 2, 1.0, 0)]]
         vals, docs = run_kernel(flat_docs, flat_imp, rows, [1], d_pad, k=2)
         assert docs[0][0] == 5 and docs[0][1] == 9
+
+
+def make_case(rng, *, tie_heavy=False):
+    """Random corpus + query rows for a packed-vs-ref parity check.
+
+    tie_heavy quantizes impacts to multiples of 1/8 so many docs land on
+    EXACTLY equal scores — the regime where the packed path's tie-break
+    (earliest doc id) must still match the reference bit for bit."""
+    d_pad = int(rng.integers(200, 5000))
+    n_terms = int(rng.integers(2, 7))
+    max_df = max(2, min(d_pad - 1, int(rng.integers(20, 800))))
+    flat_docs, flat_imp, ext = make_flat(rng, n_terms, d_pad, max_df)
+    if tie_heavy:
+        flat_imp = (np.ceil(flat_imp * 8.0) / 8.0).astype(np.float32)
+    weights = [float(rng.uniform(0.2, 4.0)) for _ in range(n_terms)]
+    if tie_heavy:
+        weights = [1.0] * n_terms
+    rows = [[(ext[t][0], ext[t][1], weights[t], t)
+             for t in range(n_terms)]]
+    mc = int(rng.integers(1, n_terms + 1))  # OR → msm → AND
+    k = int(rng.integers(1, 64))
+    return flat_docs, flat_imp, rows, [mc], d_pad, k
+
+
+def assert_variants_identical(flat_docs, flat_imp, rows, mins, d_pad, k,
+                              chunk_cap=4096):
+    """Bit-identical scores, doc ids, AND totals across variants."""
+    wc = any(m > 1 for m in mins)
+    rv, rd, rt = run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k,
+                            chunk_cap=chunk_cap, with_counts=wc,
+                            with_totals=True, variant="ref")
+    pv, pd_, pt = run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k,
+                             chunk_cap=chunk_cap, with_counts=wc,
+                             with_totals=True, variant="packed")
+    # bitwise: view as uint32 so -inf/-0.0 compare exactly too
+    np.testing.assert_array_equal(rv.view(np.uint32), pv.view(np.uint32))
+    np.testing.assert_array_equal(rd, pd_)
+    np.testing.assert_array_equal(rt, pt)
+    return rv, rd, rt
+
+
+class TestPackedParity:
+    """Packed single-key variant vs reference: the acceptance bar is
+    bit-identical scores, doc ids, and totals (ISSUE 4 / PERF round 8)."""
+
+    def test_parity_small(self, seeded_np):
+        # tier-1 sized: a handful of random corpora incl. tie-heavy
+        for i in range(4):
+            case = make_case(seeded_np, tie_heavy=(i % 2 == 1))
+            assert_variants_identical(*case)
+
+    @pytest.mark.slow
+    def test_parity_sweep(self, seeded_np):
+        # the full sweep: random corpora × msm/AND × tie-heavy × chunking
+        for i in range(40):
+            fd, fi, rows, mins, d_pad, k = make_case(
+                seeded_np, tie_heavy=(i % 3 == 0))
+            cap = 64 if i % 4 == 0 else 4096  # force chunk splitting too
+            assert_variants_identical(fd, fi, rows, mins, d_pad, k,
+                                      chunk_cap=cap)
+
+    @pytest.mark.slow
+    def test_parity_near_doc_limit(self, seeded_np):
+        # d_pad just under the packed range: codes use the full 16 doc bits
+        d_pad = sparse.PACKED_DOC_LIMIT - 1
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 3, d_pad, 3000)
+        rows = [[(ext[t][0], ext[t][1], 1.0 + t, t) for t in range(3)]]
+        assert_variants_identical(flat_docs, flat_imp, rows, [1],
+                                  d_pad, 50)
+
+    def test_tie_break_earliest_doc_id(self):
+        # many docs at EXACTLY the same score: both variants must emit
+        # them in ascending doc-id order
+        d_pad = 512
+        docs = np.arange(7, 450, 7, dtype=np.int32)
+        flat_docs = np.concatenate(
+            [docs, np.full(64, d_pad, dtype=np.int32)])
+        flat_imp = np.concatenate(
+            [np.full(docs.size, 0.25, dtype=np.float32),
+             np.zeros(64, dtype=np.float32)])
+        rows = [[(0, docs.size, 2.0, 0)]]
+        rv, rd, _ = assert_variants_identical(
+            flat_docs, flat_imp, rows, [1], d_pad, 10)
+        np.testing.assert_array_equal(rd[0], docs[:10])
+
+    def test_packed_rejects_doc_overflow(self, seeded_np):
+        d_pad = sparse.PACKED_DOC_LIMIT  # one past the packable range
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 2, d_pad, 50)
+        rows = [[(ext[t][0], ext[t][1], 1.0, t) for t in range(2)]]
+        with pytest.raises(ValueError, match="packed"):
+            run_kernel(flat_docs, flat_imp, rows, [1], d_pad, 10,
+                       variant="packed")
+        # ref variant is unaffected by the doc range
+        run_kernel(flat_docs, flat_imp, rows, [1], d_pad, 10,
+                   variant="ref")
+
+    def test_unknown_variant_rejected(self, seeded_np):
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 1, 64, 10)
+        rows = [[(ext[0][0], ext[0][1], 1.0, 0)]]
+        with pytest.raises(ValueError, match="variant"):
+            run_kernel(flat_docs, flat_imp, rows, [1], 64, 4,
+                       variant="fancy")
+
+    def test_packable_gates(self):
+        # doc-range gate
+        assert sparse.packable(sparse.PACKED_DOC_LIMIT - 1)
+        assert not sparse.packable(sparse.PACKED_DOC_LIMIT)
+        # weight gates: negative, non-finite, and out-of-range magnitudes
+        ok = np.array([0.5, 2.0], dtype=np.float32)
+        assert sparse.packable(1000, ok)
+        assert not sparse.packable(1000, np.array([-1.0, 2.0]))
+        assert not sparse.packable(1000, np.array([np.inf, 1.0]))
+        assert not sparse.packable(1000, np.array([np.nan, 1.0]))
+        assert not sparse.packable(1000, np.array([1e31, 1.0]))
+        assert not sparse.packable(1000, np.array([1e-13, 1.0]))
+        # zeros are fine (absent-term slots carry weight 0)
+        assert sparse.packable(1000, np.array([0.0, 1.0]))
+
+    def test_code16_monotone_lower_bound(self):
+        x = jnp.asarray(np.geomspace(1e-12, 1e30, 400, dtype=np.float32))
+        codes = np.asarray(sparse.impact_code16(x))
+        assert (np.diff(codes.astype(np.int64)) >= 0).all()
+        dec = np.asarray(sparse.decode_code16(jnp.asarray(codes)))
+        xs = np.asarray(x)
+        assert (dec <= xs).all()            # lower bound
+        assert (codes > 0).all()            # never rounds to "no match"
+
+
+class TestTotals:
+    def test_totals_exceed_k_both_variants(self, seeded_np):
+        """TotalHits must be the FULL match count, computed before top-k
+        truncation (regression: with_totals used to see only k rows),
+        and identical for both variants vs the numpy oracle."""
+        d_pad = 600
+        # deterministic postings: term t matches 200 docs starting at 3t
+        sizes = [200, 200, 200]
+        flat_docs = np.full(sum(sizes) + 64, d_pad, dtype=np.int32)
+        flat_imp = np.zeros(sum(sizes) + 64, dtype=np.float32)
+        ext, pos = [], 0
+        for t, sz in enumerate(sizes):
+            flat_docs[pos:pos + sz] = np.arange(3 * t, 3 * t + sz,
+                                                dtype=np.int32)
+            flat_imp[pos:pos + sz] = seeded_np.uniform(
+                0.1, 1.0, size=sz).astype(np.float32)
+            ext.append((pos, sz))
+            pos += sz
+        rows = [[(ext[t][0], ext[t][1], 1.0 + 0.3 * t, t)
+                 for t in range(3)],
+                [(ext[t][0], ext[t][1], 1.0, t) for t in range(3)]]
+        mins = [1, 2]
+        expected = brute_force(rows, flat_docs, flat_imp, d_pad, mins)
+        k = 5  # far below the expected match counts
+        assert len(expected[0]) > k and len(expected[1]) > k
+        for variant in sparse.KERNEL_VARIANTS:
+            _, _, totals = run_kernel(flat_docs, flat_imp, rows, mins,
+                                      d_pad, k, with_counts=True,
+                                      with_totals=True, variant=variant)
+            assert totals.tolist() == [len(e) for e in expected]
+
+
+class TestHierarchicalTopK:
+    def test_matches_flat_topk_with_ties(self, seeded_np):
+        import jax.lax
+        # block-multiple width with integer scores → massive tie groups
+        # split=True: exercise the per-block merge on CPU, where the
+        # trace-time default routes to the flat TopK custom call
+        score = jnp.asarray(seeded_np.integers(
+            0, 50, size=(3, 8192)).astype(np.float32))
+        for k in (1, 32, 100):
+            hv, hp = sparse.hierarchical_top_k(score, k, split=True)
+            fv, fp = jax.lax.top_k(score, k)
+            np.testing.assert_array_equal(np.asarray(hv), np.asarray(fv))
+            np.testing.assert_array_equal(np.asarray(hp), np.asarray(fp))
+
+    def test_fallback_widths(self, seeded_np):
+        import jax.lax
+        # narrow and non-block-multiple widths fall back to flat top_k
+        for width in (7, 4095, 4097):
+            score = jnp.asarray(
+                seeded_np.normal(size=(2, width)).astype(np.float32))
+            hv, hp = sparse.hierarchical_top_k(score, 5, split=True)
+            fv, fp = jax.lax.top_k(score, 5)
+            np.testing.assert_array_equal(np.asarray(hv), np.asarray(fv))
+            np.testing.assert_array_equal(np.asarray(hp), np.asarray(fp))
 
 
 class TestPlanSlots:

@@ -576,3 +576,67 @@ def test_grouped_phase_a_many_segments(svc, seeded_np):
         svc, "grouped",
         {"query": {"match": {"body": "alpha beta"}}, "size": 40})
     assert_equivalent(fast, slow)
+
+
+class TestKernelVariant:
+    """Round-8 packed-sort knob: lowering-time variant choice, the
+    runtime toggle, and the stats surface (PERF.md round 8)."""
+
+    def test_choose_kernel_variant_gates(self):
+        from elasticsearch_tpu.ops.sparse import PACKED_DOC_LIMIT
+        from elasticsearch_tpu.search.planner import choose_kernel_variant
+        ok_w = np.array([0.5, 2.0], dtype=np.float32)
+        assert choose_kernel_variant(1000, ok_w) == "packed"
+        # doc ids past the 16-bit field → exact-f32 fallback
+        assert choose_kernel_variant(PACKED_DOC_LIMIT, ok_w) == "ref"
+        # hostile weights → fallback (negative / non-finite / huge)
+        assert choose_kernel_variant(1000, np.array([-1.0])) == "ref"
+        assert choose_kernel_variant(1000, np.array([np.inf])) == "ref"
+        assert choose_kernel_variant(1000, np.array([1e31])) == "ref"
+        # setting off → fallback regardless of packability
+        assert choose_kernel_variant(1000, ok_w, enabled=False) == "ref"
+
+    @staticmethod
+    def _moved(before, after, variant):
+        """Launch-counter keys ("kernel,variant") that incremented."""
+        return [key for key, n in after.items()
+                if key.split(",")[1] == variant
+                and n > before.get(key, 0)]
+
+    def test_variant_selected_counted_and_equivalent(self, svc,
+                                                     seeded_np):
+        """Packed on → packed launches; toggled off at runtime → ref
+        launches; both bit-compatible with the planner path."""
+        from elasticsearch_tpu.search import tpu_service as svc_mod
+        make_corpus(svc, seeded_np)
+        body = {"query": {"match": {"body": {
+                    "query": "alpha beta gamma",
+                    "minimum_should_match": 2}}},
+                "size": 20, "_source": False}
+        slow = coordinator.search(svc, "corpus", dict(body),
+                                  tpu_search=None)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0,
+                               packed_sort=True)
+        try:
+            for expect in ("packed", "ref"):
+                before = dict(svc_mod.KERNEL_VARIANT_COUNTS.counts())
+                fast = coordinator.search(svc, "corpus", dict(body),
+                                          tpu_search=tpu)
+                assert tpu.served > 0
+                assert_equivalent(fast, slow)
+                stats = tpu.stats()
+                assert stats["kernel"]["packed_sort"] is \
+                    (expect == "packed")
+                after = stats["kernel"]["variants"]
+                assert self._moved(before, after, expect), \
+                    (expect, before, after)
+                other = "ref" if expect == "packed" else "packed"
+                assert not self._moved(before, after, other), \
+                    (expect, before, after)
+                tpu.set_kernel_packed_sort(False)
+                assert tpu.kernel_packed_sort is False
+        finally:
+            tpu.close()
+            # the knob is process-global (jit cache + prewarm are too):
+            # restore the default for the rest of the suite
+            svc_mod.KERNEL_CONFIG["packed_sort"] = True
