@@ -39,10 +39,12 @@ from elasticsearch_tpu.cluster.state import (INITIALIZING, STARTED,
                                              ClusterState, DiscoveryNode,
                                              IndexMeta, ShardRouting)
 from elasticsearch_tpu.common.errors import (EsException,
+                                             EsRejectedExecutionException,
                                              IllegalArgumentException,
                                              IndexNotFoundException,
                                              NoShardAvailableActionException,
                                              shard_failure_entry)
+from elasticsearch_tpu.common.pressure import operation_bytes
 from elasticsearch_tpu.common import tracing
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.translog import write_atomic
@@ -195,9 +197,12 @@ class _CoordTransport:
         def cb(f: Future) -> None:
             exc = f.exception()
             if exc is not None:
-                if is_retryable(exc):
+                if is_retryable(exc) and not isinstance(
+                        exc, RemoteTransportException):
                     # a dead pooled connection must not poison the
-                    # coordinator's resend — next attempt dials fresh
+                    # coordinator's resend — next attempt dials fresh.
+                    # A remote rejection (429 pushback) travelled over a
+                    # HEALTHY connection; keep it pooled.
                     self.ts.evict(tuple(address))
                 on_done(False, None)
             else:
@@ -1280,76 +1285,98 @@ class ClusterService:
         from elasticsearch_tpu.rest.actions import document as doc_mod
         from elasticsearch_tpu.rest.controller import error_status
 
-        # resolve each op's target node; group preserving positions
+        # resolve each op's target node; group preserving positions.
+        # Coordinating-stage admission happens HERE, per op, before any
+        # dispatch: a rejected op becomes a per-item 429 without ever
+        # leaving this node, its siblings still fan out (reference:
+        # TransportBulkAction charges IndexingPressure per bulk op)
         groups: Dict[str, List[Tuple[int, Dict[str, Any]]]] = {}
         items: List[Optional[Dict[str, Any]]] = [None] * len(ops)
         addr_of: Dict[str, Tuple[str, int]] = {}
         alias_view = self._StateView(self.applied_state())
-        for pos, entry in enumerate(ops):
-            try:
-                index = entry["index"]
-                if index is None:
-                    raise IllegalArgumentException("_index is missing")
-                index = self.resolve_write_index(index, alias_view)
-                entry = dict(entry, index=index)
-                meta = self._ensure_index(index)
-                shard = shard_for(entry.get("routing") or entry["id"],
-                                  meta.number_of_shards)
-                _primary, target = self._primary_node(index, shard)
-                entry = dict(entry, shard=shard)
-                groups.setdefault(target.node_id, []).append((pos, entry))
-                addr_of[target.node_id] = target.address
-            except EsException as exc:
-                items[pos] = {entry["op"]: {
-                    "_index": entry.get("index"), "_id": entry.get("id"),
-                    "status": error_status(exc),
-                    "error": {"type": type(exc).__name__,
-                              "reason": str(exc)}}}
-
-        # dispatch every remote group first so their work overlaps the
-        # local apply, then run the local group in this thread
-        futures: List[Tuple[List[int], Future]] = []
-        local_group: Optional[List[Tuple[int, Dict[str, Any]]]] = None
-        for node_id, group in groups.items():
-            if node_id == self.local_node.node_id:
-                local_group = group
-                continue
-            positions = [pos for pos, _ in group]
-            sub_ops = [entry for _, entry in group]
-            fut = self.transport.send_request_async(
-                addr_of[node_id], ACTION_BULK,
-                {"ops": sub_ops, "refresh": refresh})
-            futures.append((positions, fut))
-        if local_group is not None:
-            positions = [pos for pos, _ in local_group]
-            sub_ops = [entry for _, entry in local_group]
-            fut = Future()
-            try:
-                fut.set_result({"items": doc_mod.apply_bulk_ops(
-                    self.node, sub_ops, refresh=refresh)})
-            except Exception as e:  # noqa: BLE001
-                fut.set_exception(e)
-            futures.append((positions, fut))
-
-        for positions, fut in futures:
-            try:
-                sub_items = fut.result(timeout=60.0)["items"]
-                for pos, item in zip(positions, sub_items):
-                    items[pos] = item
-            except Exception as exc:  # noqa: BLE001 — node-level failure
-                for pos in positions:
-                    op = ops[pos]["op"]
-                    items[pos] = {op: {
-                        "_index": ops[pos].get("index"),
-                        "_id": ops[pos].get("id"), "status": 503,
-                        "error": {"type": "unavailable_shards_exception",
+        pressure = getattr(self.node, "indexing_pressure", None)
+        releases: List[Any] = []
+        try:
+            for pos, entry in enumerate(ops):
+                try:
+                    if pressure is not None:
+                        releases.append(pressure.mark_coordinating(
+                            operation_bytes(entry.get("source"))))
+                    index = entry["index"]
+                    if index is None:
+                        raise IllegalArgumentException("_index is missing")
+                    index = self.resolve_write_index(index, alias_view)
+                    entry = dict(entry, index=index)
+                    meta = self._ensure_index(index)
+                    shard = shard_for(entry.get("routing") or entry["id"],
+                                      meta.number_of_shards)
+                    _primary, target = self._primary_node(index, shard)
+                    entry = dict(entry, shard=shard)
+                    groups.setdefault(target.node_id, []).append(
+                        (pos, entry))
+                    addr_of[target.node_id] = target.address
+                except EsException as exc:
+                    items[pos] = {entry["op"]: {
+                        "_index": entry.get("index"),
+                        "_id": entry.get("id"),
+                        "status": error_status(exc),
+                        "error": {"type": type(exc).__name__,
                                   "reason": str(exc)}}}
-        return [it for it in items if it is not None]
+
+            # dispatch every remote group first so their work overlaps the
+            # local apply, then run the local group in this thread
+            futures: List[Tuple[List[int], Future]] = []
+            local_group: Optional[List[Tuple[int, Dict[str, Any]]]] = None
+            for node_id, group in groups.items():
+                if node_id == self.local_node.node_id:
+                    local_group = group
+                    continue
+                positions = [pos for pos, _ in group]
+                sub_ops = [entry for _, entry in group]
+                fut = self.transport.send_request_async(
+                    addr_of[node_id], ACTION_BULK,
+                    {"ops": sub_ops, "refresh": refresh})
+                futures.append((positions, fut))
+            if local_group is not None:
+                positions = [pos for pos, _ in local_group]
+                sub_ops = [entry for _, entry in local_group]
+                fut = Future()
+                try:
+                    # this node's coordinating admission covers the local
+                    # primary work: accounted as primary, not re-checked
+                    fut.set_result({"items": doc_mod.apply_bulk_ops(
+                        self.node, sub_ops, refresh=refresh,
+                        pressure_stage="primary_local")})
+                except Exception as e:  # noqa: BLE001
+                    fut.set_exception(e)
+                futures.append((positions, fut))
+
+            for positions, fut in futures:
+                try:
+                    sub_items = fut.result(timeout=60.0)["items"]
+                    for pos, item in zip(positions, sub_items):
+                        items[pos] = item
+                except Exception as exc:  # noqa: BLE001 — node failure
+                    for pos in positions:
+                        op = ops[pos]["op"]
+                        items[pos] = {op: {
+                            "_index": ops[pos].get("index"),
+                            "_id": ops[pos].get("id"), "status": 503,
+                            "error": {
+                                "type": "unavailable_shards_exception",
+                                "reason": str(exc)}}}
+            return [it for it in items if it is not None]
+        finally:
+            for release in releases:
+                release()
 
     def _handle_bulk_group(self, payload, from_node) -> Dict[str, Any]:
         from elasticsearch_tpu.rest.actions import document as doc_mod
+        # a remote coordinating node admitted these ops against ITS
+        # budget; this node re-checks them against its own primary budget
         return {"items": doc_mod.apply_bulk_ops(
-            self.node, payload["ops"], refresh=bool(payload.get("refresh")))}
+            self.node, payload["ops"], refresh=bool(payload.get("refresh")),
+            pressure_stage="primary")}
 
     # ------------------------------------------------------------------
     # search routing (query_then_fetch across nodes)
@@ -2187,14 +2214,27 @@ class ClusterService:
                    "version": result.version}
         futures = []
         for c, addr in targets:
-            futures.append((c, self.transport.send_request_async(
+            futures.append((c, addr, self.transport.send_request_async(
                 addr, ACTION_REPLICA_OP, payload)))
-        for c, fut in futures:
+        for c, addr, fut in futures:
             try:
                 fut.result(timeout=30.0)
             except RemoteTransportException as e:
                 if e.error_type == "ShardNotFoundException":
                     continue  # recovery will replay from the translog
+                if e.error_type == "EsRejectedExecutionException":
+                    # the replica is ALIVE but shedding load (indexing
+                    # pressure pushback) — a transient condition, not a
+                    # broken copy. Retry with bounded backoff before
+                    # giving up and failing the shard; the seqno dedup
+                    # on the replica makes a re-send idempotent.
+                    try:
+                        send_with_retry(
+                            self.transport, addr, ACTION_REPLICA_OP,
+                            payload, policy=RetryPolicy(deadline=3.0))
+                        continue
+                    except Exception as retry_exc:  # noqa: BLE001
+                        e = retry_exc
                 if c is not None:
                     self._fail_replica(index, shard, c, e)
             except Exception as e:  # noqa: BLE001 — replica unreachable
@@ -2246,7 +2286,15 @@ class ClusterService:
               "primary_term": payload["primary_term"],
               "id": payload["id"], "source": payload.get("source"),
               "version": payload.get("version")}
-        self._apply_replica_op_dict(shard, op)
+        # replica-stage admission (1.5× budget): a saturated replica
+        # pushes back on its primary with a typed 429 BEFORE applying —
+        # the primary retries with backoff rather than silently queueing
+        pressure = getattr(self.node, "indexing_pressure", None)
+        if pressure is not None:
+            with pressure.replica(operation_bytes(payload.get("source"))):
+                self._apply_replica_op_dict(shard, op)
+        else:
+            self._apply_replica_op_dict(shard, op)
         return {"acknowledged": True}
 
     def _handle_shard_failed(self, payload, from_node) -> Dict[str, Any]:

@@ -195,11 +195,16 @@ class StopWatch:
 
 
 def stats_to_xcontent(stats: Dict[str, Any]) -> Dict[str, Any]:
-    """Render a dict possibly containing metric objects into plain JSON."""
+    """Render a dict possibly containing metric objects into plain JSON.
+    Handles CounterMetric/MeanMetric/EWMA/SampleRing/LabeledCounters and
+    recurses into dicts — e.g. the `indexing_pressure` stats block nests
+    per-stage counters two levels deep."""
     out: Dict[str, Any] = {}
     for k, v in stats.items():
         if isinstance(v, CounterMetric):
             out[k] = v.count
+        elif isinstance(v, LabeledCounters):
+            out[k] = v.counts()
         elif isinstance(v, MeanMetric):
             out[k] = {"count": v.count, "total_millis": v.sum, "mean_millis": v.mean}
         elif isinstance(v, EWMA):

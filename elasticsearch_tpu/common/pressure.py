@@ -1,0 +1,377 @@
+"""Node-wide overload protection: memory-accounted write admission and
+search load shedding.
+
+Reference: `index/IndexingPressure` (7.9+) and the search backpressure
+service (8.x) — SURVEY.md §2.1 breaker hierarchy. Every write charges
+its operation bytes at the replication stage it is entering:
+
+  * coordinating — the node that accepted the client request;
+  * primary — the node applying the op to the primary shard;
+  * replica — a node applying the replicated op.
+
+Coordinating and primary charges share one budget
+(`indexing_pressure.memory.limit`); replica charges get 1.5× that
+budget, so a saturated client edge can never starve replication of
+writes the primary already acked. A charge that would breach its limit
+is rejected with `EsRejectedExecutionException` (HTTP 429) BEFORE any
+work happens; admitted charges are released when the operation
+completes, success or failure.
+
+A primary charge made on the node that already charged the same bytes
+at the coordinating stage skips the limit re-check (the op was already
+admitted once; double-checking would spuriously reject at ~half the
+budget) but is still accounted — the reference's
+`markPrimaryOperationLocalToCoordinatingNodeStarted`.
+
+`SearchBackpressureService` is the read-side twin: when the node is
+under duress (pressure near its limit, or the search pool's queue
+saturated across consecutive checks) it cancels the oldest
+past-deadline cancellable search tasks and declines new expensive
+searches with 429 before any fan-out work is done.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common.errors import EsRejectedExecutionException
+from elasticsearch_tpu.common.metrics import CounterMetric
+from elasticsearch_tpu.common.units import ByteSizeValue
+
+#: fixed per-op accounting overhead (id, routing, seqno bookkeeping) so
+#: even a source-less op (delete) holds a non-zero charge
+OPERATION_OVERHEAD_BYTES = 50
+
+STAGES = ("coordinating", "primary", "replica")
+
+
+def operation_bytes(source: Any,
+                    overhead: int = OPERATION_OVERHEAD_BYTES) -> int:
+    """Estimate the in-flight footprint of one write op from its source
+    document. Charges must never throw on odd payloads — estimation
+    failure degrades to the bare overhead."""
+    if source is None:
+        return overhead
+    if isinstance(source, (bytes, bytearray)):
+        return len(source) + overhead
+    if isinstance(source, str):
+        return len(source.encode("utf-8", errors="replace")) + overhead
+    try:
+        return len(json.dumps(source, separators=(",", ":"),
+                              default=str)) + overhead
+    except (TypeError, ValueError):
+        return overhead
+
+
+class IndexingPressure:
+    """Per-stage in-flight byte accounting with typed 429 rejection.
+
+    `mark_*` methods admit-or-reject a charge and return an IDEMPOTENT
+    release callable; the `coordinating`/`primary`/`replica` context
+    managers wrap mark+release so exception paths can't leak bytes.
+    `limit <= 0` disables rejection (accounting still runs)."""
+
+    def __init__(self, settings=None):
+        raw = (settings.get("indexing_pressure.memory.limit", "64mb")
+               if settings is not None else "64mb")
+        self.limit = ByteSizeValue.parse(raw).bytes
+        # replica ops protect writes the primary already acked: they get
+        # headroom over new client traffic (reference: 1.5× the limit)
+        self.replica_limit = int(self.limit * 1.5)
+        self._lock = threading.Lock()
+        self._current: Dict[str, int] = {s: 0 for s in STAGES}
+        self._tls = threading.local()
+        self.coordinating_total = CounterMetric()
+        self.primary_total = CounterMetric()
+        self.replica_total = CounterMetric()
+        self.coordinating_rejections = CounterMetric()
+        self.primary_rejections = CounterMetric()
+        self.replica_rejections = CounterMetric()
+
+    # -- charging ---------------------------------------------------------
+
+    def mark_coordinating(self, nbytes: int) -> Callable[[], None]:
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            combined = (self._current["coordinating"]
+                        + self._current["primary"])
+            rejected = 0 < self.limit < combined + nbytes
+            if not rejected:
+                self._current["coordinating"] += nbytes
+        if rejected:
+            self._reject("coordinating", self.coordinating_rejections,
+                         nbytes, combined, self.limit)
+        self.coordinating_total.inc(nbytes)
+        return self._releaser("coordinating", nbytes)
+
+    def mark_primary(self, nbytes: int, *,
+                     local_to_coordinating: Optional[bool] = None
+                     ) -> Callable[[], None]:
+        if local_to_coordinating is None:
+            local_to_coordinating = \
+                getattr(self._tls, "coordinating_depth", 0) > 0
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            combined = (self._current["coordinating"]
+                        + self._current["primary"])
+            rejected = (not local_to_coordinating
+                        and 0 < self.limit < combined + nbytes)
+            if not rejected:
+                self._current["primary"] += nbytes
+        if rejected:
+            self._reject("primary", self.primary_rejections,
+                         nbytes, combined, self.limit)
+        self.primary_total.inc(nbytes)
+        return self._releaser("primary", nbytes)
+
+    def mark_replica(self, nbytes: int) -> Callable[[], None]:
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            current = self._current["replica"]
+            rejected = 0 < self.replica_limit < current + nbytes
+            if not rejected:
+                self._current["replica"] += nbytes
+        if rejected:
+            self._reject("replica", self.replica_rejections,
+                         nbytes, current, self.replica_limit)
+        self.replica_total.inc(nbytes)
+        return self._releaser("replica", nbytes)
+
+    def _reject(self, stage: str, counter: CounterMetric, nbytes: int,
+                current: int, limit: int) -> None:
+        counter.inc()
+        tracing.add_event("indexing_pressure.reject", stage=stage,
+                          operation_bytes=nbytes, current_bytes=current,
+                          limit_bytes=limit)
+        raise EsRejectedExecutionException(
+            f"rejected execution of {stage} operation "
+            f"[current_{stage}_bytes={current}, operation_bytes={nbytes}, "
+            f"limit_bytes={limit}]")
+
+    def _releaser(self, stage: str, nbytes: int) -> Callable[[], None]:
+        state = {"released": False}
+
+        def release() -> None:
+            with self._lock:
+                if state["released"]:
+                    return
+                state["released"] = True
+                self._current[stage] -= nbytes
+        return release
+
+    # -- context managers (release through every exit path) ---------------
+
+    @contextlib.contextmanager
+    def coordinating(self, nbytes: int):
+        release = self.mark_coordinating(nbytes)
+        # primary charges by this thread are local-to-coordinating while
+        # the coordinating charge is held: admitted once is admitted
+        prev = getattr(self._tls, "coordinating_depth", 0)
+        self._tls.coordinating_depth = prev + 1
+        try:
+            yield
+        finally:
+            self._tls.coordinating_depth = prev
+            release()
+
+    @contextlib.contextmanager
+    def primary(self, nbytes: int, *,
+                local_to_coordinating: Optional[bool] = None):
+        release = self.mark_primary(
+            nbytes, local_to_coordinating=local_to_coordinating)
+        try:
+            yield
+        finally:
+            release()
+
+    @contextlib.contextmanager
+    def replica(self, nbytes: int):
+        release = self.mark_replica(nbytes)
+        try:
+            yield
+        finally:
+            release()
+
+    # -- fault injection ---------------------------------------------------
+
+    def hold(self, stage: str, nbytes: int) -> Callable[[], None]:
+        """Charge `nbytes` at `stage` WITHOUT an admission check or
+        total/rejection accounting — the LoadSpike disruption's hook for
+        simulating a saturated node. Returns the idempotent release."""
+        if stage not in STAGES:
+            raise ValueError(f"unknown pressure stage [{stage}]")
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            self._current[stage] += nbytes
+        return self._releaser(stage, nbytes)
+
+    # -- views -------------------------------------------------------------
+
+    def current(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._current)
+
+    def combined_current(self) -> int:
+        with self._lock:
+            return self._current["coordinating"] + self._current["primary"]
+
+    def stats(self) -> Dict[str, Any]:
+        """The `_nodes/stats` `indexing_pressure` section, in the
+        reference's memory/current/total shape."""
+        cur = self.current()
+        combined = cur["coordinating"] + cur["primary"]
+        return {"memory": {
+            "current": {
+                "combined_coordinating_and_primary_in_bytes": combined,
+                "coordinating_in_bytes": cur["coordinating"],
+                "primary_in_bytes": cur["primary"],
+                "replica_in_bytes": cur["replica"],
+                "all_in_bytes": combined + cur["replica"],
+            },
+            "total": {
+                "combined_coordinating_and_primary_in_bytes":
+                    self.coordinating_total.count
+                    + self.primary_total.count,
+                "coordinating_in_bytes": self.coordinating_total.count,
+                "primary_in_bytes": self.primary_total.count,
+                "replica_in_bytes": self.replica_total.count,
+                "coordinating_rejections":
+                    self.coordinating_rejections.count,
+                "primary_rejections": self.primary_rejections.count,
+                "replica_rejections": self.replica_rejections.count,
+            },
+            "limit_in_bytes": self.limit,
+            "replica_limit_in_bytes": self.replica_limit,
+        }}
+
+
+class SearchBackpressureService:
+    """Coordinator-side load shedding for the read path.
+
+    `admit(body, task)` is called after task registration and before any
+    fan-out. Under node duress it (a) cancels up to `cancel_max` of the
+    OLDEST cancellable search tasks that have run past
+    `stale_task_seconds` — freeing capacity that is already being wasted
+    on abandoned work — and (b) declines the incoming search with 429 if
+    it is expensive (aggregations/knn/rescore/suggest, or a deep
+    size+from page). Cheap searches are still admitted so the node stays
+    observable and health checks keep passing."""
+
+    SEARCH_TASK_PATTERNS = \
+        "indices:data/read/search*,indices:data/read/msearch*"
+
+    def __init__(self, settings=None, *, pressure: IndexingPressure = None,
+                 thread_pools=None, task_manager=None):
+        def opt(getter, key, default):
+            return getter(key, default) if settings is not None else default
+        get = getattr(settings, "get", None)
+        get_bool = getattr(settings, "get_bool", None)
+        get_int = getattr(settings, "get_int", None)
+        get_float = getattr(settings, "get_float", None)
+        self.enabled = opt(get_bool, "search.backpressure.enabled", True)
+        self.pressure_watermark = opt(
+            get_float, "search.backpressure.pressure_watermark", 0.9)
+        self.queue_watermark = opt(
+            get_float, "search.backpressure.queue_watermark", 0.9)
+        # consecutive saturated samples before queue depth counts as
+        # duress — one burst must not start cancelling searches
+        self.queue_checks = opt(
+            get_int, "search.backpressure.queue_checks", 3)
+        self.stale_task_seconds = opt(
+            get_float, "search.backpressure.stale_task_seconds", 10.0)
+        self.cancel_max = opt(
+            get_int, "search.backpressure.cancel_max", 2)
+        self.expensive_hits = opt(
+            get_int, "search.backpressure.expensive_hits", 10000)
+        del get, opt  # settings values are snapshotted at construction
+        self.pressure = pressure
+        self.thread_pools = thread_pools
+        self.task_manager = task_manager
+        self.shed = CounterMetric()
+        self.declined = CounterMetric()
+        self._queue_hot = 0
+        self._lock = threading.Lock()
+
+    # -- duress detection --------------------------------------------------
+
+    def under_duress(self) -> bool:
+        if self.pressure is not None and self.pressure.limit > 0:
+            if (self.pressure.combined_current()
+                    >= self.pressure_watermark * self.pressure.limit):
+                return True
+        pool = (self.thread_pools.get("search")
+                if self.thread_pools is not None else None)
+        if pool is not None and pool.queue_size > 0:
+            with pool._cv:
+                queued = pool.queued
+            with self._lock:
+                if queued >= self.queue_watermark * pool.queue_size:
+                    self._queue_hot += 1
+                else:
+                    self._queue_hot = 0
+                return self._queue_hot >= max(1, self.queue_checks)
+        return False
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, body: Optional[dict], task=None) -> None:
+        """Raise EsRejectedExecutionException (429) when this search
+        must be declined; also sheds stale tasks as a side effect of
+        observing duress."""
+        if not self.enabled:
+            return
+        if not self.under_duress():
+            return
+        self.shed_stale(exclude=task)
+        if self._is_expensive(body):
+            self.declined.inc()
+            tracing.add_event("search.backpressure.decline",
+                              reason="node under duress")
+            raise EsRejectedExecutionException(
+                "declining expensive search: node is under duress "
+                "(indexing pressure or search queue saturation); "
+                "retry with backoff")
+
+    def shed_stale(self, exclude=None) -> int:
+        """Cancel up to `cancel_max` of the oldest cancellable search
+        tasks past the staleness deadline; → number cancelled."""
+        if self.task_manager is None:
+            return 0
+        now = time.monotonic()
+        stale = [t for t in self.task_manager.list(
+                     self.SEARCH_TASK_PATTERNS)
+                 if t.cancellable and not t.cancelled and t is not exclude
+                 and now - t._start >= self.stale_task_seconds]
+        stale.sort(key=lambda t: t._start)
+        cancelled = 0
+        for t in stale[:max(0, self.cancel_max)]:
+            t.cancel("cancelled by search backpressure: node under "
+                     "duress and task ran past the staleness deadline")
+            self.shed.inc()
+            tracing.add_event("search.backpressure.shed",
+                              task=t.full_id, action=t.action,
+                              age_seconds=round(now - t._start, 3))
+            cancelled += 1
+        return cancelled
+
+    def _is_expensive(self, body: Optional[dict]) -> bool:
+        body = body or {}
+        if any(k in body for k in ("aggs", "aggregations", "knn",
+                                   "rescore", "suggest")):
+            return True
+        try:
+            size = int(body.get("size", 10) or 0)
+            frm = int(body.get("from", 0) or 0)
+        except (TypeError, ValueError):
+            return False
+        return size + frm > self.expensive_hits
+
+    def stats(self) -> Dict[str, Any]:
+        return {"enabled": self.enabled,
+                "cancellations": {"count": self.shed.count},
+                "declined": {"count": self.declined.count}}

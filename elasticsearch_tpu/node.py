@@ -119,6 +119,16 @@ class Node:
                     "search.tpu_serving.kernel.packed_sort", True))
         from elasticsearch_tpu.common.threadpool import ThreadPools
         self.thread_pools = ThreadPools(self.settings)
+        # overload protection: memory-accounted write admission shared
+        # by every replication stage, plus coordinator-side search load
+        # shedding (reference: IndexingPressure + search backpressure)
+        from elasticsearch_tpu.common.pressure import (
+            IndexingPressure, SearchBackpressureService)
+        self.indexing_pressure = IndexingPressure(self.settings)
+        self.search_backpressure = SearchBackpressureService(
+            self.settings, pressure=self.indexing_pressure,
+            thread_pools=self.thread_pools,
+            task_manager=self.task_manager)
         self.controller = RestController()
         self.controller.thread_pools = self.thread_pools
         # tracing: per-request root spans + propagation through the
@@ -384,6 +394,38 @@ class Node:
                 yield ("search.shard_failures",
                        {"index": index, "shard": shard}, counter)
         reg.add_collector(_search_failures)
+
+        reg.set_help("indexing_pressure.current_bytes",
+                     "In-flight write bytes held at a replication stage")
+        reg.set_help("indexing_pressure.stage_bytes",
+                     "Write bytes ever charged at a replication stage")
+        reg.set_help("indexing_pressure.rejections",
+                     "Write operations rejected by indexing pressure")
+        reg.set_help("search.backpressure.shed",
+                     "Stale search tasks cancelled under node duress")
+        reg.set_help("search.backpressure.declined",
+                     "Expensive searches declined under node duress")
+
+        def _pressure():
+            p = self.indexing_pressure
+            current = p.current()
+            totals = {"coordinating": (p.coordinating_total,
+                                       p.coordinating_rejections),
+                      "primary": (p.primary_total, p.primary_rejections),
+                      "replica": (p.replica_total, p.replica_rejections)}
+            for stage, (total, rejections) in totals.items():
+                lb = {"stage": stage}
+                yield ("indexing_pressure.current_bytes", lb,
+                       current[stage], "gauge")
+                yield ("indexing_pressure.stage_bytes", lb, total)
+                yield ("indexing_pressure.rejections", lb, rejections)
+            yield ("indexing_pressure.limit_bytes", {}, p.limit, "gauge")
+            yield ("indexing_pressure.replica_limit_bytes", {},
+                   p.replica_limit, "gauge")
+            sb = self.search_backpressure
+            yield ("search.backpressure.shed", {}, sb.shed)
+            yield ("search.backpressure.declined", {}, sb.declined)
+        reg.add_collector(_pressure)
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,

@@ -121,6 +121,14 @@ def register(controller: RestController, node) -> None:
             # the signal the hierarchy exists for
             out["nodes"][node.node_id]["breakers"] = \
                 node.breakers.stats()
+        if getattr(node, "indexing_pressure", None) is not None:
+            # per-stage current/total/rejection byte accounting
+            # (reference: the 7.9+ `indexing_pressure` stats section)
+            out["nodes"][node.node_id]["indexing_pressure"] = \
+                node.indexing_pressure.stats()
+        if getattr(node, "search_backpressure", None) is not None:
+            out["nodes"][node.node_id]["search_backpressure"] = \
+                node.search_backpressure.stats()
         return 200, out
 
     # ---------------- _cat ----------------

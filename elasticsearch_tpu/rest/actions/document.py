@@ -11,6 +11,7 @@ TransportShardBulkAction / TransportGetAction primary-phase analog."""
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 import uuid
@@ -18,13 +19,33 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common.errors import (DocumentMissingException,
                                              IllegalArgumentException,
-                                             EsException)
+                                             EsException,
+                                             EsRejectedExecutionException)
+from elasticsearch_tpu.common.pressure import operation_bytes
 from elasticsearch_tpu.rest.controller import (RestController, RestRequest,
                                                error_status)
 
 
 def _auto_id() -> str:
     return uuid.uuid4().hex[:20]
+
+
+def _coordinating_charge(node, source):
+    """Admission charge for one client write at the coordinating stage
+    (429 when over budget). No-op on node doubles without the tracker."""
+    pressure = getattr(node, "indexing_pressure", None)
+    if pressure is None:
+        return contextlib.nullcontext()
+    return pressure.coordinating(operation_bytes(source))
+
+
+def _primary_charge(node, source):
+    """Admission charge at the primary stage; skips the limit re-check
+    when this thread's coordinating charge already admitted the op."""
+    pressure = getattr(node, "indexing_pressure", None)
+    if pressure is None:
+        return contextlib.nullcontext()
+    return pressure.primary(operation_bytes(source))
 
 
 # ----------------------------------------------------------------------
@@ -49,41 +70,45 @@ def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
                    shard_num: Optional[int] = None) -> Tuple[int, Dict]:
     if not isinstance(body, dict):
         raise IllegalArgumentException("request body is required")
-    index = node.indices.resolve_write_index(index)
-    # cluster mode: the state applier creates local indices; a missing
-    # index here is a routing error, not an auto-create trigger
-    svc = (node.indices.index(index) if node.cluster is not None
-           else node.get_or_autocreate_index(index))
-    svc.check_write_block()
-    created_id = doc_id or _auto_id()
-    body, _pid = run_ingest_pipeline(node, svc, body, params)
-    if body is None:  # a drop processor fired: acknowledged, not indexed
-        return 200, {"_index": index, "_id": created_id,
-                     "_version": -1, "result": "noop",
-                     "_shards": {"total": 0, "successful": 0,
-                                 "failed": 0}}
-    if shard_num is None:
-        shard_num = svc.shard_for_id(created_id, params.get("routing"))
-    shard = svc.shard(shard_num)
-    kwargs = {"op_type": op_type} if op_type != "index" else {}
-    if params.get("if_seq_no") is not None:
-        kwargs["if_seq_no"] = int(params["if_seq_no"])
-    if params.get("if_primary_term") is not None:
-        kwargs["if_primary_term"] = int(params["if_primary_term"])
-    if params.get("version") is not None:
-        kwargs["version"] = int(params["version"])
-        kwargs["version_type"] = params.get("version_type", "internal")
-    result = shard.apply_index_on_primary(created_id, body, **kwargs)
-    node.replicate("index", index, shard_num, created_id, body, result)
-    if params.get("refresh") in ("", "true", "wait_for"):
-        shard.refresh()
-    status = 201 if result.created else 200
-    return status, {
-        "_index": index, "_id": result.doc_id,
-        "_version": result.version, "result": result.result,
-        "_seq_no": result.seq_no, "_primary_term": result.primary_term,
-        "_shards": {"total": 1, "successful": 1, "failed": 0},
-    }
+    # primary-stage bytes are held across apply AND replication — the
+    # ack means every copy has the op, so the memory is in flight that
+    # whole time
+    with _primary_charge(node, body):
+        index = node.indices.resolve_write_index(index)
+        # cluster mode: the state applier creates local indices; a missing
+        # index here is a routing error, not an auto-create trigger
+        svc = (node.indices.index(index) if node.cluster is not None
+               else node.get_or_autocreate_index(index))
+        svc.check_write_block()
+        created_id = doc_id or _auto_id()
+        body, _pid = run_ingest_pipeline(node, svc, body, params)
+        if body is None:  # a drop processor fired: acknowledged, not indexed
+            return 200, {"_index": index, "_id": created_id,
+                         "_version": -1, "result": "noop",
+                         "_shards": {"total": 0, "successful": 0,
+                                     "failed": 0}}
+        if shard_num is None:
+            shard_num = svc.shard_for_id(created_id, params.get("routing"))
+        shard = svc.shard(shard_num)
+        kwargs = {"op_type": op_type} if op_type != "index" else {}
+        if params.get("if_seq_no") is not None:
+            kwargs["if_seq_no"] = int(params["if_seq_no"])
+        if params.get("if_primary_term") is not None:
+            kwargs["if_primary_term"] = int(params["if_primary_term"])
+        if params.get("version") is not None:
+            kwargs["version"] = int(params["version"])
+            kwargs["version_type"] = params.get("version_type", "internal")
+        result = shard.apply_index_on_primary(created_id, body, **kwargs)
+        node.replicate("index", index, shard_num, created_id, body, result)
+        if params.get("refresh") in ("", "true", "wait_for"):
+            shard.refresh()
+        status = 201 if result.created else 200
+        return status, {
+            "_index": index, "_id": result.doc_id,
+            "_version": result.version, "result": result.result,
+            "_seq_no": result.seq_no, "_primary_term": result.primary_term,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+        }
 
 
 def exec_get_doc(node, index: str, doc_id: str, params,
@@ -102,16 +127,17 @@ def exec_get_doc(node, index: str, doc_id: str, params,
 
 def exec_delete_doc(node, index: str, doc_id: str, params,
                     shard_num: Optional[int] = None) -> Tuple[int, Dict]:
-    index = node.indices.resolve_write_index(index)
-    svc = node.indices.index(index)
-    svc.check_write_block()
-    if shard_num is None:
-        shard_num = svc.shard_for_id(doc_id, params.get("routing"))
-    shard = svc.shard(shard_num)
-    result = shard.apply_delete_on_primary(doc_id)
-    node.replicate("delete", index, shard_num, doc_id, None, result)
-    if params.get("refresh") in ("", "true", "wait_for"):
-        shard.refresh()
+    with _primary_charge(node, None):
+        index = node.indices.resolve_write_index(index)
+        svc = node.indices.index(index)
+        svc.check_write_block()
+        if shard_num is None:
+            shard_num = svc.shard_for_id(doc_id, params.get("routing"))
+        shard = svc.shard(shard_num)
+        result = shard.apply_delete_on_primary(doc_id)
+        node.replicate("delete", index, shard_num, doc_id, None, result)
+        if params.get("refresh") in ("", "true", "wait_for"):
+            shard.refresh()
     if not result.found:
         return 404, {"_index": index, "_id": doc_id,
                      "result": "not_found", "_version": result.version,
@@ -158,6 +184,13 @@ def exec_update_doc(node, index: str, doc_id: str, body, params,
     """_update: doc-merge, doc_as_upsert, and scripted updates
     (ctx._source mutation, ctx.op noop/delete, scripted_upsert) —
     reference: UpdateHelper#prepare."""
+    with _primary_charge(node, body):
+        return _exec_update_doc(node, index, doc_id, body, params,
+                                shard_num=shard_num)
+
+
+def _exec_update_doc(node, index: str, doc_id: str, body, params,
+                     shard_num: Optional[int] = None) -> Tuple[int, Dict]:
     index = node.indices.resolve_write_index(index)
     svc = node.indices.index(index)
     svc.check_write_block()
@@ -275,10 +308,23 @@ def parse_bulk_body(raw: str, default_index: Optional[str]
 
 
 def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
-                   refresh: bool = False) -> List[Dict[str, Any]]:
+                   refresh: bool = False,
+                   pressure_stage: str = "coordinating"
+                   ) -> List[Dict[str, Any]]:
     """Apply parsed bulk ops against LOCAL shards; returns response items
     in op order. Per-op failures become error items, never exceptions
     (reference: BulkItemResponse).
+
+    Admission is PER OP: each op charges its bytes against indexing
+    pressure before any work; a rejected op becomes a per-item 429 error
+    entry while its siblings still apply (reference: bulk item-level
+    EsRejectedExecutionException). `pressure_stage` names the stage the
+    caller is charging — "coordinating" for client-facing entry points,
+    "primary" when a remote coordinating node already admitted the ops
+    (checked against the shared limit), "primary_local" when this node's
+    own coordinating admission covers them (accounted, not re-checked).
+    Every admitted charge is released when the request finishes, through
+    failure paths included.
 
     Maximal runs of plain index ops (no CAS) group per shard and apply
     through the engine's batched path — one lock + one translog fsync per
@@ -288,21 +334,46 @@ def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
     their semantics."""
     items: List[Optional[Dict[str, Any]]] = [None] * len(ops)
     refresh_shards = set()
-    i = 0
-    while i < len(ops):
-        if _plain_index_op(ops[i]):
-            j = i
-            while j < len(ops) and _plain_index_op(ops[j]):
-                j += 1
-            _apply_index_run(node, ops, range(i, j), items, refresh_shards)
-            i = j
-        else:
-            items[i] = _apply_one_op(node, ops[i], refresh_shards)
-            i += 1
-    if refresh:
-        for shard in refresh_shards:
-            shard.refresh()
-    return items  # type: ignore[return-value]
+    pressure = getattr(node, "indexing_pressure", None)
+    releases: List[Any] = []
+    try:
+        if pressure is not None:
+            for pos, entry in enumerate(ops):
+                nbytes = operation_bytes(entry.get("source"))
+                try:
+                    if pressure_stage == "coordinating":
+                        releases.append(pressure.mark_coordinating(nbytes))
+                    elif pressure_stage == "primary":
+                        releases.append(pressure.mark_primary(nbytes))
+                    else:  # primary_local: admitted by this node already
+                        releases.append(pressure.mark_primary(
+                            nbytes, local_to_coordinating=True))
+                except EsRejectedExecutionException as exc:
+                    items[pos] = _bulk_error_item(
+                        entry["op"], entry.get("index"), entry.get("id"),
+                        exc)
+        i = 0
+        while i < len(ops):
+            if items[i] is not None:  # rejected at admission
+                i += 1
+            elif _plain_index_op(ops[i]):
+                j = i
+                while (j < len(ops) and items[j] is None
+                       and _plain_index_op(ops[j])):
+                    j += 1
+                _apply_index_run(node, ops, range(i, j), items,
+                                 refresh_shards)
+                i = j
+            else:
+                items[i] = _apply_one_op(node, ops[i], refresh_shards)
+                i += 1
+        if refresh:
+            for shard in refresh_shards:
+                shard.refresh()
+        return items  # type: ignore[return-value]
+    finally:
+        for release in releases:
+            release()
 
 
 def _plain_index_op(entry: Dict[str, Any]) -> bool:
@@ -517,30 +588,37 @@ def register(controller: RestController, node) -> None:
     def put_doc(req: RestRequest):
         op_type = ("create" if req.params.get("op_type") == "create"
                    else "index")
-        if node.cluster is not None:
-            return node.cluster.route_doc_op(
-                "index" if op_type == "index" else "create",
-                req.param("index"), req.param("id"), req.body, req.params)
-        return exec_index_doc(node, req.param("index"), req.param("id"),
-                              req.body, req.params, op_type=op_type)
+        with _coordinating_charge(node, req.body):
+            if node.cluster is not None:
+                return node.cluster.route_doc_op(
+                    "index" if op_type == "index" else "create",
+                    req.param("index"), req.param("id"), req.body,
+                    req.params)
+            return exec_index_doc(node, req.param("index"),
+                                  req.param("id"), req.body, req.params,
+                                  op_type=op_type)
 
     def create_doc(req: RestRequest):
         """op_type=create: 409 if the doc exists — enforced inside the
         engine's write lock so concurrent creates serialize (reference:
         version_conflict_engine_exception on op_type=create)."""
-        if node.cluster is not None:
-            return node.cluster.route_doc_op(
-                "create", req.param("index"), req.param("id"), req.body,
-                req.params)
-        return exec_index_doc(node, req.param("index"), req.param("id"),
-                              req.body, req.params, op_type="create")
+        with _coordinating_charge(node, req.body):
+            if node.cluster is not None:
+                return node.cluster.route_doc_op(
+                    "create", req.param("index"), req.param("id"),
+                    req.body, req.params)
+            return exec_index_doc(node, req.param("index"),
+                                  req.param("id"), req.body, req.params,
+                                  op_type="create")
 
     def post_doc(req: RestRequest):
-        if node.cluster is not None:
-            return node.cluster.route_doc_op(
-                "index", req.param("index"), None, req.body, req.params)
-        return exec_index_doc(node, req.param("index"), None, req.body,
-                              req.params)
+        with _coordinating_charge(node, req.body):
+            if node.cluster is not None:
+                return node.cluster.route_doc_op(
+                    "index", req.param("index"), None, req.body,
+                    req.params)
+            return exec_index_doc(node, req.param("index"), None,
+                                  req.body, req.params)
 
     def get_doc(req: RestRequest):
         if node.cluster is not None:
@@ -550,20 +628,22 @@ def register(controller: RestController, node) -> None:
                             req.params)
 
     def delete_doc(req: RestRequest):
-        if node.cluster is not None:
-            return node.cluster.route_doc_op(
-                "delete", req.param("index"), req.param("id"), None,
-                req.params)
-        return exec_delete_doc(node, req.param("index"), req.param("id"),
-                               req.params)
+        with _coordinating_charge(node, None):
+            if node.cluster is not None:
+                return node.cluster.route_doc_op(
+                    "delete", req.param("index"), req.param("id"), None,
+                    req.params)
+            return exec_delete_doc(node, req.param("index"),
+                                   req.param("id"), req.params)
 
     def update_doc(req: RestRequest):
-        if node.cluster is not None:
-            return node.cluster.route_doc_op(
-                "update", req.param("index"), req.param("id"), req.body,
-                req.params)
-        return exec_update_doc(node, req.param("index"), req.param("id"),
-                               req.body, req.params)
+        with _coordinating_charge(node, req.body):
+            if node.cluster is not None:
+                return node.cluster.route_doc_op(
+                    "update", req.param("index"), req.param("id"),
+                    req.body, req.params)
+            return exec_update_doc(node, req.param("index"),
+                                   req.param("id"), req.body, req.params)
 
     def mget(req: RestRequest):
         body = req.body or {}

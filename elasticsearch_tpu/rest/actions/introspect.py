@@ -375,7 +375,11 @@ def register(controller: RestController, node) -> None:
                      "total": len(spans), "spans": spans}
 
     def do_prometheus(req: RestRequest):
-        # text exposition (str payload → text/plain at the HTTP layer)
+        # text exposition (str payload → text/plain at the HTTP layer);
+        # the overload-protection families
+        # (es_tpu_indexing_pressure_*, es_tpu_search_backpressure_*)
+        # scrape here, mirroring the `indexing_pressure` and
+        # `search_backpressure` sections of _nodes/stats
         return 200, node.metrics.prometheus_text()
 
     controller.register("GET", "/_field_caps", do_field_caps)

@@ -48,6 +48,12 @@ def register(controller: RestController, node) -> None:
             description=f"indices[{req.param('index') or '_all'}]")
         try:
             body = req.body or {}
+            # load shedding before any fan-out: under node duress the
+            # oldest stale search tasks are cancelled and an expensive
+            # incoming search is declined with 429
+            backpressure = getattr(node, "search_backpressure", None)
+            if backpressure is not None:
+                backpressure.admit(body, task=task)
             if req.params.get("scroll"):
                 return 200, scroll_mod.start_scroll(
                     node, req.param("index"), body, req.params, task=task)
@@ -163,6 +169,12 @@ def register(controller: RestController, node) -> None:
                     index = header.get("index", default_index)
                     if isinstance(index, list):
                         index = ",".join(index)
+                    backpressure = getattr(node, "search_backpressure",
+                                           None)
+                    if backpressure is not None:
+                        # per item: a declined search is ITS 429 entry,
+                        # the sibling searches still run
+                        backpressure.admit(body, task=task)
                     item = _execute_search(index, body, {}, task)
                     item["status"] = 200
                     responses.append(item)

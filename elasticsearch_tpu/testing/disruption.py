@@ -269,3 +269,76 @@ def shard_fault(index: str, shard: Optional[int] = None,
         yield state
     finally:
         query_phase._FAULT_HOOKS.remove(hook)
+
+
+class LoadSpike(Scheme):
+    """Node-local overload injection: hold bytes of indexing pressure
+    and/or inflate an admission pool's occupancy until healed, so
+    overload is injectable like Partition/Delay are for the network.
+
+    `hold_bytes` charges the node's IndexingPressure at `stage` WITHOUT
+    an admission check (via the tracker's `hold` hook) — real traffic
+    then collides with the synthetic load and sheds with typed 429s.
+    `fill_active`/`fill_queue` inflate the named thread pool's
+    active/queued counters, driving queue-saturation duress and pool
+    rejections. A LoadSpike never intercepts sends (verdict: pass
+    through), so it composes with network schemes in one disruption
+    list. `heal()` releases everything and is idempotent."""
+
+    def __init__(self, node=None, *, hold_bytes: int = 0,
+                 stage: str = "coordinating", pool=None,
+                 fill_active: int = 0, fill_queue: int = 0):
+        self.node = node
+        self.hold_bytes = max(0, int(hold_bytes))
+        self.stage = stage
+        self.pool = pool
+        self.fill_active = max(0, int(fill_active))
+        self.fill_queue = max(0, int(fill_queue))
+        self._release: Optional[Callable[[], None]] = None
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        if self.node is not None and self.hold_bytes:
+            self._release = self.node.indexing_pressure.hold(
+                self.stage, self.hold_bytes)
+        if self.pool is not None:
+            with self.pool._cv:
+                self.pool.active += self.fill_active
+                self.pool.queued += self.fill_queue
+
+    def intercept(self, src, dst, action):
+        return None  # a resource spike, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+            started = self._started
+        if not started:
+            return
+        if self._release is not None:
+            self._release()
+            self._release = None
+        if self.pool is not None:
+            with self.pool._cv:
+                self.pool.active -= self.fill_active
+                self.pool.queued -= self.fill_queue
+                self.pool._cv.notify_all()
+
+
+@contextlib.contextmanager
+def load_spike(node=None, **kwargs) -> Iterator[LoadSpike]:
+    """Context-managed LoadSpike: applied on entry, healed on exit even
+    when the body's assertions fail."""
+    spike = LoadSpike(node, **kwargs)
+    spike.start()
+    try:
+        yield spike
+    finally:
+        spike.heal()

@@ -53,7 +53,12 @@ RETRYABLE_EXCEPTIONS = (
 
 def is_retryable(exc: BaseException) -> bool:
     if isinstance(exc, RemoteTransportException):
-        return False
+        # one remote application failure IS transient: a typed
+        # EsRejectedExecutionException means the peer is alive but
+        # shedding load (indexing pressure / bounded-queue pushback) —
+        # worth a backoff retry, still bounded by the policy deadline so
+        # retries cannot amplify the overload
+        return exc.error_type == "EsRejectedExecutionException"
     return isinstance(exc, RETRYABLE_EXCEPTIONS)
 
 
@@ -185,7 +190,10 @@ def send_with_retry(transport, address: Address, action: str,
         except Exception as e:  # noqa: BLE001 — gate below re-raises
             if not is_retryable(e):
                 raise
-            if hasattr(transport, "evict"):
+            if (hasattr(transport, "evict")
+                    and not isinstance(e, RemoteTransportException)):
+                # connection-class failures dial fresh next attempt; a
+                # remote 429 arrived over a healthy pooled connection
                 transport.evict(address)
             delay = policy.delay(attempt)
             attempt += 1

@@ -201,7 +201,13 @@ def test_required_families_are_present(node):
             "es_tpu_transport_retries_total",
             "es_tpu_search_shard_failures_total",
             "es_tpu_search_tpu_stage_seconds_total",
-            "es_tpu_search_tpu_stage_latency_seconds"):
+            "es_tpu_search_tpu_stage_latency_seconds",
+            "es_tpu_indexing_pressure_current_bytes",
+            "es_tpu_indexing_pressure_stage_bytes_total",
+            "es_tpu_indexing_pressure_rejections_total",
+            "es_tpu_indexing_pressure_limit_bytes",
+            "es_tpu_search_backpressure_shed_total",
+            "es_tpu_search_backpressure_declined_total"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
     # the failure we recorded in the fixture shows up labeled
     assert ('es_tpu_search_shard_failures_total'
@@ -277,7 +283,9 @@ def test_every_reachable_metric_object_is_registered(node):
         node.thread_pools,
         getattr(node, "breakers", None),
         node.tpu_search,
-        node.indices)
+        node.indices,
+        node.indexing_pressure,
+        node.search_backpressure)
     assert reachable, "traversal found no metric objects at all"
     registered = node.metrics.registered_objects()
     missing = [obj for oid, obj in reachable.items()

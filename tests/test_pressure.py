@@ -1,0 +1,428 @@
+"""Overload protection suite: indexing-pressure stage accounting and
+release (exception paths included), the coordinating/primary vs replica
+limit split, bulk partial 429s, replica pushback over the transport,
+stale-search shedding and expensive-search decline under duress, and the
+acceptance check — under an injected LoadSpike a node keeps answering
+with structured 429s, leaks no pressure bytes, and every op acked 2xx is
+durable afterwards."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.errors import EsRejectedExecutionException
+from elasticsearch_tpu.common.pressure import (IndexingPressure,
+                                               SearchBackpressureService,
+                                               operation_bytes)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.testing.disruption import LoadSpike, load_spike
+from elasticsearch_tpu.transport.retry import is_retryable
+from elasticsearch_tpu.transport.service import (ConnectTransportException,
+                                                 RemoteTransportException)
+
+from test_replication import _free_ports, _handle, _wait_green
+
+
+def _pressure(limit="1kb"):
+    return IndexingPressure(
+        Settings.of({"indexing_pressure.memory.limit": limit}))
+
+
+# ---------------------------------------------------------------------
+# stage accounting / release
+# ---------------------------------------------------------------------
+
+def test_coordinating_charges_against_combined_limit_and_releases():
+    p = _pressure("1kb")
+    r1 = p.mark_coordinating(400)
+    r2 = p.mark_coordinating(400)
+    assert p.current()["coordinating"] == 800
+    with pytest.raises(EsRejectedExecutionException):
+        p.mark_coordinating(400)   # 1200 > 1024
+    assert p.coordinating_rejections.count == 1
+    # a rejected op charges nothing
+    assert p.current()["coordinating"] == 800
+    r1()
+    r2()
+    assert p.current() == {"coordinating": 0, "primary": 0, "replica": 0}
+    # totals are monotonic: only ADMITTED bytes counted
+    assert p.coordinating_total.count == 800
+
+
+def test_primary_shares_the_coordinating_budget():
+    p = _pressure("1kb")
+    rc = p.mark_coordinating(700)
+    with pytest.raises(EsRejectedExecutionException):
+        p.mark_primary(400)        # combined 1100 > 1024
+    assert p.primary_rejections.count == 1
+    rc()
+
+
+def test_primary_local_to_coordinating_skips_the_recheck():
+    p = _pressure("1kb")
+    with p.coordinating(700):
+        # same thread, coordinating charge held: the op was already
+        # admitted once — account the primary bytes, don't re-reject
+        rp = p.mark_primary(700)
+        assert p.current()["primary"] == 700
+        rp()
+    # outside the coordinating scope the same charge IS checked
+    with pytest.raises(EsRejectedExecutionException):
+        p.mark_primary(1100)
+    assert p.current() == {"coordinating": 0, "primary": 0, "replica": 0}
+
+
+def test_replica_gets_headroom_over_client_traffic():
+    p = _pressure("1kb")
+    assert p.replica_limit == int(1024 * 1.5)
+    rc = p.mark_coordinating(1000)      # client edge nearly full
+    rr = p.mark_replica(1400)           # replica budget is separate+1.5x
+    assert p.current()["replica"] == 1400
+    with pytest.raises(EsRejectedExecutionException):
+        p.mark_replica(200)             # 1600 > 1536
+    assert p.replica_rejections.count == 1
+    rc()
+    rr()
+    assert p.current() == {"coordinating": 0, "primary": 0, "replica": 0}
+
+
+def test_context_managers_release_through_exceptions():
+    p = _pressure("1kb")
+    for cm in (p.coordinating, p.primary, p.replica):
+        with pytest.raises(RuntimeError):
+            with cm(300):
+                raise RuntimeError("operation failed mid-flight")
+    assert p.current() == {"coordinating": 0, "primary": 0, "replica": 0}
+
+
+def test_release_is_idempotent():
+    p = _pressure("1kb")
+    r = p.mark_coordinating(500)
+    r()
+    r()   # double release must not go negative
+    assert p.current()["coordinating"] == 0
+
+
+def test_hold_is_unchecked_and_not_counted_as_traffic():
+    p = _pressure("1kb")
+    release = p.hold("coordinating", 10_000)   # way past the limit: ok
+    assert p.current()["coordinating"] == 10_000
+    assert p.coordinating_total.count == 0     # synthetic, not traffic
+    with pytest.raises(EsRejectedExecutionException):
+        p.mark_coordinating(10)                # real traffic collides
+    release()
+    release()
+    assert p.current()["coordinating"] == 0
+
+
+def test_stats_shape_matches_the_reference_section():
+    p = _pressure("1kb")
+    r = p.mark_coordinating(100)
+    st = p.stats()["memory"]
+    assert st["current"]["coordinating_in_bytes"] == 100
+    assert st["current"]["combined_coordinating_and_primary_in_bytes"] == 100
+    assert st["current"]["all_in_bytes"] == 100
+    assert st["total"]["coordinating_in_bytes"] == 100
+    assert st["total"]["coordinating_rejections"] == 0
+    assert st["limit_in_bytes"] == 1024
+    r()
+
+
+def test_operation_bytes_never_throws():
+    assert operation_bytes(None) == 50
+    assert operation_bytes({"a": 1}) > 50
+    assert operation_bytes(b"xxxx") == 54
+    assert operation_bytes(object()) >= 50   # unserializable → overhead
+
+
+# ---------------------------------------------------------------------
+# transport retry classification
+# ---------------------------------------------------------------------
+
+def test_remote_rejection_is_retryable_other_remote_errors_are_not():
+    assert is_retryable(RemoteTransportException(
+        "EsRejectedExecutionException", "rejected execution"))
+    assert not is_retryable(RemoteTransportException(
+        "IllegalArgumentException", "bad request"))
+    assert is_retryable(ConnectTransportException("connect refused"))
+
+
+# ---------------------------------------------------------------------
+# single-node REST behavior
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def tiny_node(tmp_path):
+    n = Node(str(tmp_path / "data"), settings=Settings.of({
+        "search.tpu_serving.enabled": "false",
+        "indexing_pressure.memory.limit": "1kb",
+        "thread_pool.search.size": 2,
+        "thread_pool.search.queue_size": 2}))
+    s, b = _handle(n, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 1}}})
+    assert s == 200, b
+    yield n
+    n.close()
+
+
+def test_bulk_partial_rejection_is_per_item(tiny_node):
+    lines = []
+    for i in range(8):
+        lines.append(json.dumps({"index": {"_id": f"b{i}"}}))
+        lines.append(json.dumps({"title": "x" * 200}))
+    s, body = tiny_node.handle("POST", "/books/_bulk", {}, None,
+                               ("\n".join(lines) + "\n").encode())
+    assert s == 200
+    assert body["errors"] is True
+    statuses = [next(iter(it.values()))["status"] for it in body["items"]]
+    assert 201 in statuses and 429 in statuses, statuses
+    for it in body["items"]:
+        entry = next(iter(it.values()))
+        if entry["status"] == 429:
+            assert entry["error"]["type"] == "EsRejectedExecutionException"
+    # no bytes leaked once the request finished
+    assert tiny_node.indexing_pressure.current() == {
+        "coordinating": 0, "primary": 0, "replica": 0}
+    # every acked item is durable and readable
+    _handle(tiny_node, "POST", "/books/_refresh")
+    for it, st in zip(body["items"], statuses):
+        if st == 201:
+            doc_id = next(iter(it.values()))["_id"]
+            gs, gb = _handle(tiny_node, "GET", f"/books/_doc/{doc_id}")
+            assert gs == 200 and gb["found"] is True
+
+
+def test_single_doc_write_rejected_with_429_when_budget_exhausted(tiny_node):
+    with load_spike(tiny_node, hold_bytes=2048):
+        s, body = _handle(tiny_node, "PUT", "/books/_doc/big",
+                          body={"title": "hello"})
+        assert s == 429, body
+        assert body["error"]["type"] == "es_rejected_execution_exception"
+    # healed: the same write goes through and is readable
+    s, _ = _handle(tiny_node, "PUT", "/books/_doc/big",
+                   body={"title": "hello"})
+    assert s == 201
+    assert tiny_node.indexing_pressure.current() == {
+        "coordinating": 0, "primary": 0, "replica": 0}
+
+
+def test_duress_sheds_oldest_stale_search_and_declines_expensive(tiny_node):
+    # two stale cancellable searches, one fresh one — shed oldest first
+    old1 = tiny_node.task_manager.register("indices:data/read/search",
+                                           description="stale-1")
+    old2 = tiny_node.task_manager.register("indices:data/read/search",
+                                           description="stale-2")
+    fresh = tiny_node.task_manager.register("indices:data/read/search",
+                                            description="fresh")
+    old1._start -= 100.0
+    old2._start -= 50.0
+    with load_spike(tiny_node, hold_bytes=2048):
+        s, body = _handle(tiny_node, "POST", "/books/_search", body={
+            "query": {"match_all": {}},
+            "aggs": {"t": {"terms": {"field": "title"}}}})
+        assert s == 429, body
+        # cheap searches still pass: the node stays observable
+        s, _ = _handle(tiny_node, "POST", "/books/_search",
+                       body={"query": {"match_all": {}}})
+        assert s == 200
+    assert old1.cancelled and old2.cancelled    # oldest two (cancel_max)
+    assert not fresh.cancelled
+    assert tiny_node.search_backpressure.shed.count >= 2
+    assert tiny_node.search_backpressure.declined.count >= 1
+    for t in (old1, old2, fresh):
+        tiny_node.task_manager.unregister(t)
+
+
+def test_load_spike_pool_saturation_rejects_then_heals(tiny_node):
+    pool = tiny_node.thread_pools.get("search")
+    spike = LoadSpike(pool=pool, fill_active=pool.size,
+                      fill_queue=pool.queue_size)
+    spike.start()
+    try:
+        s, body = _handle(tiny_node, "POST", "/books/_search",
+                          body={"query": {"match_all": {}}})
+        assert s == 429, body
+        assert pool.rejected >= 1
+    finally:
+        spike.heal()
+        spike.heal()   # idempotent
+    s, _ = _handle(tiny_node, "POST", "/books/_search",
+                   body={"query": {"match_all": {}}})
+    assert s == 200
+    assert pool.active == 0 and pool.queued == 0
+
+
+def test_nodes_stats_exposes_the_indexing_pressure_section(tiny_node):
+    s, body = _handle(tiny_node, "GET", "/_nodes/stats")
+    assert s == 200
+    section = body["nodes"][tiny_node.node_id]["indexing_pressure"]
+    assert section["memory"]["limit_in_bytes"] == 1024
+    assert set(section["memory"]["current"]) >= {
+        "coordinating_in_bytes", "primary_in_bytes", "replica_in_bytes",
+        "combined_coordinating_and_primary_in_bytes", "all_in_bytes"}
+    sb = body["nodes"][tiny_node.node_id]["search_backpressure"]
+    assert sb["enabled"] is True
+
+
+def test_queue_saturation_needs_consecutive_checks():
+    pools_node = type("N", (), {})()   # minimal duck type
+    from elasticsearch_tpu.common.threadpool import ThreadPool
+
+    class Pools:
+        def __init__(self, pool):
+            self._pool = pool
+
+        def get(self, name):
+            return self._pool if name == "search" else None
+
+    pool = ThreadPool("search", 1, 10)
+    svc = SearchBackpressureService(
+        Settings.of({"search.backpressure.queue_checks": 2}),
+        thread_pools=Pools(pool))
+    with pool._cv:
+        pool.queued = 10
+    assert not svc.under_duress()     # first saturated sample: not yet
+    assert svc.under_duress()         # second consecutive one: duress
+    with pool._cv:
+        pool.queued = 0
+    assert not svc.under_duress()     # streak resets on a calm sample
+    del pools_node
+
+
+# ---------------------------------------------------------------------
+# cluster: replica pushback + acked-writes-never-lost under a LoadSpike
+# ---------------------------------------------------------------------
+
+def _make_pressure_cluster(tmp_path, names, limit="2kb"):
+    ports = _free_ports(len(names))
+    seeds = [("127.0.0.1", p) for p in ports]
+    nodes = []
+    for i, name in enumerate(names):
+        data = tmp_path / f"data-{name}"
+        data.mkdir(parents=True, exist_ok=True)
+        node = Node(str(data), node_name=name,
+                    settings=Settings.of({
+                        "search.tpu_serving.enabled": "false",
+                        "indexing_pressure.memory.limit": limit}))
+        node.start_cluster(transport_port=ports[i], seed_hosts=seeds,
+                           initial_master_nodes=list(names))
+        nodes.append(node)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(n.cluster.health()["number_of_nodes"] == len(names)
+               for n in nodes):
+            return nodes
+        time.sleep(0.2)
+    raise AssertionError("cluster did not form")
+
+
+@pytest.fixture
+def pressure_cluster(tmp_path):
+    nodes = _make_pressure_cluster(
+        tmp_path, ["press-0", "press-1", "press-2"])
+    yield nodes
+    for n in nodes:
+        try:
+            n.close()
+        except Exception:
+            pass
+
+
+def _copy_holders(nodes, index, shard):
+    state = nodes[0].cluster.applied_state()
+    primary = state.primary(index, shard)
+    replicas = [c for c in state.shard_copies(index, shard)
+                if not c.primary and c.node_id]
+    by_id = {n.node_id: n for n in nodes}
+    return (by_id[primary.node_id],
+            [by_id[c.node_id] for c in replicas if c.node_id in by_id])
+
+
+def test_saturated_replica_pushes_back_and_backoff_retry_recovers(
+        pressure_cluster):
+    nodes = pressure_cluster
+    s, b = _handle(nodes[0], "PUT", "/push", body={
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1}})
+    assert s == 200, b
+    _wait_green(nodes[0])
+    primary_node, replica_nodes = _copy_holders(nodes, "push", 0)
+    replica = replica_nodes[0]
+    # saturate the replica's 1.5x budget so the replica-stage admission
+    # rejects the fan-out with a typed 429 back to the primary...
+    spike = LoadSpike(replica, hold_bytes=replica.indexing_pressure
+                      .replica_limit, stage="replica")
+    spike.start()
+    # ...and lift the spike mid-backoff: the bounded retry must absorb
+    # the transient overload instead of failing the shard
+    healer = threading.Timer(0.4, spike.heal)
+    healer.daemon = True
+    healer.start()
+    retries_before = primary_node.cluster.transport.retry_count
+    try:
+        s, body = _handle(primary_node, "PUT", "/push/_doc/d1",
+                          body={"v": "pushback"})
+        assert s == 201, body
+    finally:
+        healer.cancel()
+        spike.heal()
+    assert primary_node.cluster.transport.retry_count > retries_before
+    # the replica applied the op (ack means every in-sync copy has it)
+    shard = replica.indices.index("push").shards.get(0)
+    assert shard is not None and shard.get("d1") is not None
+    # and nobody was failed out of the replication group
+    assert nodes[0].cluster.health()["status"] == "green"
+    for n in nodes:
+        assert n.indexing_pressure.current() == {
+            "coordinating": 0, "primary": 0, "replica": 0}
+
+
+def test_acked_writes_survive_a_load_spike(pressure_cluster):
+    nodes = pressure_cluster
+    s, b = _handle(nodes[0], "PUT", "/spike", body={
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1}})
+    assert s == 200, b
+    _wait_green(nodes[0])
+    entry_node = nodes[0]
+    limit = entry_node.indexing_pressure.limit
+    acked, rejected = [], []
+    # hold most of the coordinating budget: some ops admit, most shed
+    with load_spike(entry_node, hold_bytes=limit - 350,
+                    stage="coordinating"):
+        for batch in range(4):
+            lines = []
+            for i in range(6):
+                doc_id = f"s{batch}-{i}"
+                lines.append(json.dumps({"index": {"_id": doc_id}}))
+                lines.append(json.dumps({"v": "y" * 60, "id": doc_id}))
+            s, body = entry_node.handle(
+                "POST", "/spike/_bulk", {}, None,
+                ("\n".join(lines) + "\n").encode())
+            assert s == 200   # the node stays LIVE: structured 429s
+            for it in body["items"]:
+                e = next(iter(it.values()))
+                if e["status"] in (200, 201):
+                    acked.append(e["_id"])
+                else:
+                    assert e["status"] == 429, e
+                    assert (e["error"]["type"]
+                            == "EsRejectedExecutionException")
+                    rejected.append(e["_id"])
+        # the node still answers reads during the spike
+        s, _ = _handle(entry_node, "GET", "/_cluster/health")
+        assert s == 200
+    assert acked, "spike headroom admitted nothing"
+    assert rejected, "spike rejected nothing"
+    # no unreleased pressure bytes after drain, on ANY node
+    for n in nodes:
+        assert n.indexing_pressure.current() == {
+            "coordinating": 0, "primary": 0, "replica": 0}, n.node_name
+    # every op acked 2xx during the spike is durable and readable
+    _handle(entry_node, "POST", "/spike/_refresh")
+    for doc_id in acked:
+        gs, gb = _handle(nodes[1], "GET", f"/spike/_doc/{doc_id}")
+        assert gs == 200 and gb.get("found", True), (doc_id, gb)
