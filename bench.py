@@ -108,11 +108,16 @@ def main() -> None:
     # WHERE the slowest query's time went (0 disables entirely)
     trace_sample = float(os.environ.get("ES_TPU_BENCH_TRACE_SAMPLE",
                                         "0.05"))
+    # ES_TPU_BENCH_PROFILE=1: run the continuous host sampler through
+    # the load phase and emit the batch_wait decomposition + top folded
+    # stacks in the JSON (the attribution ledger for host-path PRs)
+    profile_on = _env("PROFILE", 0) == 1
     node = Node(tempfile.mkdtemp(prefix="es_tpu_bench_"),
                 settings=Settings.of({
                     "index": {"translog": {"durability": "async"}},
-                    "search": {"tracing": {
-                        "sample_rate": trace_sample}}}))
+                    "search": {
+                        "tracing": {"sample_rate": trace_sample},
+                        "profiler": {"enabled": profile_on}}}))
     t0 = time.perf_counter()  # bulk ingest + refresh-to-searchable
     idx = node.create_index(
         "bench", Settings.of({"index": {
@@ -253,6 +258,36 @@ def main() -> None:
         f"{qps:.1f} QPS (kernel-served: {st.get('served')}, "
         f"batches: {st.get('batches')})")
     log(f"stage breakdown: {st.get('stages')}")
+
+    # ---- batch_wait attribution + host flamegraph (PROFILE=1) ----
+    if profile_on:
+        stages = st.get("stages") or {}
+        legacy = stages.get("batch_wait", {})
+        split = {}
+        split_sum = 0.0
+        for part in ("queue", "window", "dispatch", "completion"):
+            s_part = stages.get(f"batch_wait.{part}")
+            if s_part:
+                split[part] = {"seconds": round(s_part["seconds"], 3),
+                               "count": s_part["count"],
+                               "p50_ms": s_part.get("p50_ms"),
+                               "p99_ms": s_part.get("p99_ms")}
+                split_sum += s_part["seconds"]
+        legacy_s = legacy.get("seconds", 0.0)
+        sampler = node.profiler.sampler
+        out["profile"] = {
+            "batch_wait_seconds": round(legacy_s, 3),
+            "batch_wait_split": split,
+            "split_sum_seconds": round(split_sum, 3),
+            "split_vs_total": (round(split_sum / legacy_s, 4)
+                               if legacy_s > 0 else None),
+            "sampler": sampler.stats(),
+            "top_stacks": [{"stack": line, "count": cnt}
+                           for line, cnt in sampler.folded(top=15)],
+        }
+        log(f"batch_wait attribution: total={legacy_s:.1f}s split_sum="
+            f"{split_sum:.1f}s ({out['profile']['split_vs_total']}) "
+            f"parts={ {p: v['seconds'] for p, v in split.items()} }")
 
     # ---- kernel-variant A/B (ES_TPU_BENCH_KERNEL_COMPARE=1): rerun a
     # short load phase once per device-kernel variant (packed single-key

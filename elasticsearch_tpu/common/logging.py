@@ -129,10 +129,19 @@ class SlowLog:
         if hit_level is None:
             return None
         import json
+
+        # every slowlog line carries the live trace id (or "-") so a
+        # slow line links to /_tpu/traces and the flamegraph's
+        # ?trace_id= sample filter (cold path: only slow queries pay)
+        from elasticsearch_tpu.common import tracing
+        span = tracing.current_span()
+        trace_id = span.trace_id if span is not None \
+            and getattr(span, "is_recording", False) else "-"
         msg = (f"[{self.index_name}][{shard}] took[{took_s * 1000:.1f}ms]"
                f", took_millis[{int(took_s * 1000)}]"
                f", total_hits[{total_hits if total_hits is not None else '-'}]"
                f", search_type[QUERY_THEN_FETCH]"
+               f", trace_id[{trace_id}]"
                f", source[{json.dumps(source or {}, sort_keys=True)[:1000]}]")
         getattr(self.logger, self._LOG_FN[hit_level])(msg)
         return hit_level

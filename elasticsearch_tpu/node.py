@@ -144,6 +144,24 @@ class Node:
                 "search.tracing.slow_threshold_ms", 3000.0),
             node_name=node_name)
         self.controller.tracer = self.tracer
+        # host/device profiling: continuous low-overhead flamegraph
+        # sampler + bounded device trace sessions (ISSUE 6). Constructed
+        # unconditionally so endpoints/metrics keep their shape; the
+        # sampler thread only spawns when search.profiler.enabled.
+        import os as _os
+
+        from elasticsearch_tpu.common.profiler import Profiler
+        self.profiler = Profiler(
+            enabled=self.settings.get_bool("search.profiler.enabled",
+                                           False),
+            hz=self.settings.get_float("search.profiler.hz", 20.0),
+            retention_s=self.settings.get_float(
+                "search.profiler.retention_s", 300.0),
+            device_dir=_os.path.join(data_path, "profile_sessions"))
+        if self.tpu_search is not None:
+            self.profiler.sampler.timeline_source = \
+                self.tpu_search.batcher.queue_depths
+        self.profiler.start()
         from elasticsearch_tpu.common.metrics import MetricsRegistry
         self.metrics = MetricsRegistry()
         self._register_metrics()
@@ -354,6 +372,13 @@ class Node:
                 warm = dict(svc._prewarm_progress)
             yield ("search.tpu.prewarm_total", nl, warm["total"], "gauge")
             yield ("search.tpu.prewarm_done", nl, warm["done"], "gauge")
+            depths = svc.batcher.queue_depths()
+            yield ("search.tpu.queue_pending", nl, depths["pending"],
+                   "gauge")
+            yield ("search.tpu.queue_inflight", nl, depths["inflight"],
+                   "gauge")
+            yield ("search.tpu.pack_queues", nl, depths["queues"],
+                   "gauge")
             from elasticsearch_tpu.search.tpu_service import (
                 KERNEL_CONFIG, KERNEL_VARIANT_COUNTS)
             yield ("search.tpu.kernel_packed_sort", nl,
@@ -426,6 +451,29 @@ class Node:
             yield ("search.backpressure.shed", {}, sb.shed)
             yield ("search.backpressure.declined", {}, sb.declined)
         reg.add_collector(_pressure)
+        reg.set_help("profiler.samples",
+                     "Host sampling-profiler stack samples collected")
+        reg.set_help("profiler.overhead_ratio",
+                     "Fraction of wall time the sampler thread is busy")
+
+        def _profiler():
+            # plain-int/float gauges (no metric objects): the family
+            # shape is stable whether or not the sampler is running
+            s = self.profiler.sampler
+            yield ("profiler.enabled", {}, 1 if s.running else 0, "gauge")
+            yield ("profiler.samples", {}, s.samples_total, "counter")
+            yield ("profiler.ticks", {}, s.ticks_total, "counter")
+            yield ("profiler.retained_samples", {}, len(s._samples),
+                   "gauge")
+            yield ("profiler.overhead_ratio", {},
+                   s.overhead_fraction(), "gauge")
+            dev = self.profiler.device
+            yield ("profiler.device_sessions", {}, dev.sessions_total,
+                   "counter")
+            yield ("profiler.device_active", {},
+                   1 if dev.info()["active"] else 0, "gauge")
+
+        reg.add_collector(_profiler)
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
@@ -527,6 +575,8 @@ class Node:
             self._syncer.cancel()
         if self.cluster is not None:
             self.cluster.close()
+        if self.profiler is not None:
+            self.profiler.close()
         if self.tpu_search is not None:
             self.tpu_search.close()
         ccs_client = getattr(self, "_ccs_transport", None)

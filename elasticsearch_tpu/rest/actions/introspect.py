@@ -191,32 +191,34 @@ def termvectors(node, index: str, doc_id: str,
 
 
 def hot_threads(node, params: Dict[str, str]) -> str:
-    """_nodes/hot_threads: sample every Python thread's stack N times,
-    rank by how often each top frame is seen (reference:
-    monitor/jvm/HotThreads — a text report, not JSON)."""
+    """_nodes/hot_threads: sample every Python thread's stack N times
+    with the profiler's frame walker and report each busy thread's most
+    common sampled stack — real stack dumps, not just queue counts
+    (reference: monitor/jvm/HotThreads — a text report, not JSON)."""
     import threading
-    import traceback
+
+    from elasticsearch_tpu.common.profiler import walk_frames
 
     snapshots = int(params.get("snapshots", 3))
     interval_s = 0.05
     threads = int(params.get("threads", 3))
     counts: Dict[str, int] = collections.Counter()
-    samples: Dict[str, List[str]] = {}
+    # per thread: how often each distinct stack was observed
+    stacks: Dict[str, collections.Counter] = {}
     names = {t.ident: t.name for t in threading.enumerate()}
     me = threading.get_ident()
-    for _ in range(snapshots):
+    for i in range(snapshots):
         for ident, frame in sys._current_frames().items():
             if ident == me:
                 continue
-            stack = traceback.extract_stack(frame)
+            stack = tuple(walk_frames(frame, 16))  # leaf-first
             if not stack:
                 continue
             key = names.get(ident, str(ident))
             counts[key] += 1
-            samples[key] = [
-                f"  {f.name} ({f.filename.rsplit('/', 1)[-1]}:"
-                f"{f.lineno})" for f in reversed(stack[-10:])]
-        time.sleep(interval_s)
+            stacks.setdefault(key, collections.Counter())[stack] += 1
+        if i + 1 < snapshots:
+            time.sleep(interval_s)
     lines = [f"::: {{{node.node_name}}}",
              f"   Hot threads at {time.strftime('%Y-%m-%dT%H:%M:%S')}, "
              f"interval={int(interval_s * 1000)}ms, busiestThreads="
@@ -225,7 +227,13 @@ def hot_threads(node, params: Dict[str, str]) -> str:
         share = 100.0 * cnt / max(snapshots, 1)
         lines.append(f"   {share:.1f}% sampled usage by thread "
                      f"'{name}'")
-        lines.extend(samples.get(name, []))
+        top = stacks.get(name, collections.Counter()).most_common(1)
+        if top:
+            stack, seen = top[0]
+            lines.append(f"     {seen}/{cnt} snapshots in:")
+            for fr in stack:
+                fname, _, func = fr.partition(":")
+                lines.append(f"       {func} ({fname})")
     # per-pool admission state rides along so stall diagnosis (is the
     # pool saturated or is one thread wedged?) is one call, not two
     pools = getattr(node, "thread_pools", None)
@@ -350,10 +358,14 @@ def register(controller: RestController, node) -> None:
         # the kernel-path breaker state — the production view of what
         # bench logs show offline
         tpu = getattr(node, "tpu_search", None)
+        profiler = getattr(node, "profiler", None)
         if tpu is None:
-            return 200, {"enabled": False}
-        out = {"enabled": True}
-        out.update(tpu.stats())
+            out: Dict[str, Any] = {"enabled": False}
+        else:
+            out = {"enabled": True}
+            out.update(tpu.stats())
+        if profiler is not None:
+            out["profiler"] = profiler.info()
         return 200, out
 
     def do_tpu_traces(req: RestRequest):
@@ -373,6 +385,49 @@ def register(controller: RestController, node) -> None:
         return 200, {"sample_rate": tracer.sample_rate,
                      "slow_threshold_ms": tracer.slow_threshold_ms,
                      "total": len(spans), "spans": spans}
+
+    def do_profile_flamegraph(req: RestRequest):
+        # folded stacks from the continuous host sampler. Default
+        # format is folded text (str payload → text/plain — paste
+        # straight into flamegraph.pl / speedscope); format=json returns
+        # structured stacks. ?trace_id= filters to samples taken while
+        # that trace was live on the sampled thread.
+        sampler = node.profiler.sampler
+        trace_id = req.params.get("trace_id") or None
+        pool = req.params.get("pool") or None
+        top = int(req.params.get("top", 0) or 0) or None
+        fmt = str(req.params.get("format", "folded")).lower()
+        if fmt == "json":
+            stacks = [{"stack": line.split(";"), "count": count}
+                      for line, count in sampler.folded(
+                          trace_id=trace_id, top=top, pool=pool)]
+            return 200, {"enabled": sampler.running,
+                         **sampler.stats(), "stacks": stacks}
+        if not sampler.running and not sampler.samples_total:
+            return 200, {"enabled": False,
+                         "reason": "search.profiler.enabled is false"}
+        return 200, sampler.folded_text(trace_id=trace_id, top=top,
+                                        pool=pool)
+
+    def do_profile_timeline(req: RestRequest):
+        # queue-depth / in-flight occupancy gauges sampled on the
+        # profiler's tick — batching behavior over time, not totals
+        sampler = node.profiler.sampler
+        limit = int(req.params.get("limit", 0) or 0)
+        return 200, {"enabled": sampler.running,
+                     "interval_s": round(1.0 / sampler.hz, 4),
+                     "points": sampler.timeline(limit=limit)}
+
+    def do_device_start(req: RestRequest):
+        name = req.params.get("name")
+        if name is None and isinstance(req.body, dict):
+            name = req.body.get("name")
+        out = node.profiler.device.start(name)
+        return (200 if out.get("started") else 409), out
+
+    def do_device_stop(req: RestRequest):
+        out = node.profiler.device.stop()
+        return (200 if out.get("stopped") else 409), out
 
     def do_prometheus(req: RestRequest):
         # text exposition (str payload → text/plain at the HTTP layer);
@@ -405,4 +460,12 @@ def register(controller: RestController, node) -> None:
                         do_alloc_explain)
     controller.register("GET", "/_tpu/stats", do_tpu_stats)
     controller.register("GET", "/_tpu/traces", do_tpu_traces)
+    controller.register("GET", "/_tpu/profile/flamegraph",
+                        do_profile_flamegraph)
+    controller.register("GET", "/_tpu/profile/timeline",
+                        do_profile_timeline)
+    controller.register("POST", "/_tpu/profile/device/start",
+                        do_device_start)
+    controller.register("POST", "/_tpu/profile/device/stop",
+                        do_device_stop)
     controller.register("GET", "/_prometheus/metrics", do_prometheus)

@@ -16,6 +16,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common import errors as es_errors
+from elasticsearch_tpu.common import profiler as _profiler
 from elasticsearch_tpu.common import tracing as _tracing
 
 
@@ -195,6 +196,14 @@ class RestController:
                 root=True)
             if not span.is_recording:
                 span = None
+        # profiler thread tags: the sampling profiler can't read this
+        # thread's locals, so publish (pool, trace_id) to its shared
+        # ident map. `active()` is a single set-emptiness check — the
+        # hot path pays nothing while the sampler is off.
+        if _profiler.active():
+            _profiler.tag_thread(
+                classify_pool(req.method, path) or "management",
+                span.trace_id if span is not None else None)
         try:
             if span is None:
                 if self.thread_pools is not None:
@@ -225,3 +234,5 @@ class RestController:
             if status == 500:
                 traceback.print_exc()
             return status, error_body(exc, status)
+        finally:
+            _profiler.untag_thread()

@@ -381,11 +381,13 @@ def search(indices: IndicesService, index_expr: Optional[str],
     profile = bool(body.get("profile"))
     if (tpu_search is not None and aggs is None and pinned is None
             and knn_wrap is None  # knn runs the two-phase planner path
-            and not profile  # profiling instruments the planner path
             and not alias_filters  # filtered aliases run the planner
             and not any(k in body for k in ("sort", "search_after",
                                             "highlight", "suggest",
                                             "rescore", "collapse"))):
+        # `profile: true` stays ON the kernel path (it used to force the
+        # reference scorer — profiling a path we never serve with): the
+        # response gains a TPU section next to the usual shard tree.
         try:
             fast = _search_fast(indices, names, query, tpu_search,
                                 size=size, from_=from_,
@@ -394,7 +396,7 @@ def search(indices: IndicesService, index_expr: Optional[str],
                                 version=bool(body.get("version")),
                                 seq_no_primary_term=bool(
                                     body.get("seq_no_primary_term")),
-                                ctx=ctx)
+                                ctx=ctx, profile=profile)
         except _NON_DEGRADABLE:
             raise
         except Exception:  # noqa: BLE001 — degrade to the planner path
@@ -728,12 +730,60 @@ def build_profile(query, shard_results, query_nanos, fetch_nanos
     return shards
 
 
+def _tpu_profile_section(tpu_search, sink: Dict[str, Any]
+                         ) -> Dict[str, Any]:
+    """The kernel-side profile story for one (index, query): what
+    try_search measured for THIS query (variant, plan-cache outcome,
+    host stage millis incl. the batch_wait split) reconciled with the
+    service-wide device-stage distributions from StageTimes (per-query
+    device time is not separable inside a shared train — the recent
+    ring percentiles are the honest view)."""
+    out = dict(sink)
+    stages = getattr(tpu_search, "stages", None)
+    if stages is not None:
+        snap = stages.snapshot()
+        out["device_stages"] = {
+            name: st for name, st in snap.items()
+            if "device_wait" in name or name == "batch_decode"}
+    return out
+
+
+def build_kernel_profile_shard(query, name: str, elapsed_s: float,
+                               tpu: Dict[str, Any]) -> Dict[str, Any]:
+    """One profile-tree shard entry for the kernel fast path, shaped
+    like the planner's `build_profile` entries so tooling that walks
+    `profile.shards` keeps working, plus the TPU section under "tpu"."""
+    qn = int(elapsed_s * 1e9)
+    return {
+        "id": f"[{name}][kernel]",
+        "searches": [{
+            "query": [{
+                "type": type(query).__name__,
+                "description": query.query_name(),
+                "time_in_nanos": qn,
+                "breakdown": {"score": qn, "build_scorer": 0,
+                              "next_doc": 0},
+            }],
+            "rewrite_time": 0,
+            "collector": [{
+                "name": "TpuKernelTopK",
+                "reason": "search_top_hits",
+                "time_in_nanos": qn,
+            }],
+        }],
+        "aggregations": [],
+        "fetch": {"type": "fetch", "description": "", "time_in_nanos": 0},
+        "tpu": tpu,
+    }
+
+
 def _search_fast(indices: IndicesService, names: List[str],
                  query: dsl.QueryNode, tpu_search, *, size: int, from_: int,
                  min_score, source, t0: float,
                  version: bool = False,
                  seq_no_primary_term: bool = False,
-                 ctx=None) -> Optional[Dict[str, Any]]:
+                 ctx=None, profile: bool = False
+                 ) -> Optional[Dict[str, Any]]:
     """Kernel-path query phase + columnar response assembly. Returns None
     when any target index's query can't lower (the whole request then
     runs on the planner so merge semantics stay uniform).
@@ -753,14 +803,17 @@ def _search_fast(indices: IndicesService, names: List[str],
         # consistent across paths (ADVICE r2 low #3)
         return None
     per_index = []
+    profile_entries: List[Dict[str, Any]] = []
     n_shards_total = 0
     for name in names:
         svc = indices.index(name)
         n_shards_total += len(svc.shards)
         q0 = time.perf_counter()
+        sink: Optional[Dict[str, Any]] = {} if profile else None
         res = tpu_search.try_search(
             svc, query, k=k,
-            timeout_s=ctx.remaining_s() if ctx is not None else None)
+            timeout_s=ctx.remaining_s() if ctx is not None else None,
+            profile_sink=sink)
         if res is None:
             return None
         q_elapsed = time.perf_counter() - q0
@@ -770,6 +823,10 @@ def _search_fast(indices: IndicesService, names: List[str],
                 q_elapsed, "kernel",
                 source={"query": query.query_name()},
                 total_hits=res.total_hits)
+        if profile:
+            profile_entries.append(build_kernel_profile_shard(
+                query, name, q_elapsed, _tpu_profile_section(
+                    tpu_search, sink or {})))
         per_index.append((name, svc, res))
 
     t_asm = time.perf_counter()
@@ -827,7 +884,7 @@ def _search_fast(indices: IndicesService, names: List[str],
     stages = getattr(tpu_search, "stages", None)
     if stages is not None:
         stages.add("assemble", time.perf_counter() - t_asm)
-    return {
+    out = {
         "took": int((time.perf_counter() - t0) * 1000),
         "timed_out": False,
         "_shards": {"total": n_shards_total, "successful": n_shards_total,
@@ -836,6 +893,12 @@ def _search_fast(indices: IndicesService, names: List[str],
                  "max_score": max_score,
                  "hits": hits_json},
     }
+    if profile:
+        out["profile"] = {
+            "shards": profile_entries,
+            "tpu": [e["tpu"] for e in profile_entries],
+        }
+    return out
 
 
 def _assemble_hits(name: str, resident, scores, rows, ords, source,
@@ -916,6 +979,7 @@ def search_shard_group(indices: IndicesService,
     group_query_nanos: Dict[Tuple[str, int], int] = {}
     group_fetch_nanos: Dict[Tuple[str, int], int] = {}
     group_profile_entries: List[Tuple] = []
+    fast_profile_entries: List[Dict[str, Any]] = []
     total = 0
     relation = "eq"
     for name, shard_nums in sorted(by_index.items()):
@@ -926,13 +990,16 @@ def search_shard_group(indices: IndicesService,
         if (tpu_search is not None and aggs is None and not sort_specs
                 and search_after is None and k > 0 and min_score is None
                 and group_knn is None
-                and not body.get("profile")
                 and not body.get("rescore") and not body.get("collapse")
                 and not (index_filters or {}).get(name)
                 and set(shard_nums) == set(svc.shards.keys())):
+            group_profile = bool(body.get("profile"))
+            sink: Optional[Dict[str, Any]] = {} if group_profile else None
+            q_fast0 = time.perf_counter()
             try:
                 res = tpu_search.try_search(svc, query, k=k,
-                                            timeout_s=ctx.remaining_s())
+                                            timeout_s=ctx.remaining_s(),
+                                            profile_sink=sink)
             except _NON_DEGRADABLE:
                 raise
             except Exception:  # noqa: BLE001 — degrade to planner
@@ -941,6 +1008,10 @@ def search_shard_group(indices: IndicesService,
                 res = None
             if res is not None:
                 used_fast = True
+                if group_profile:
+                    fast_profile_entries.append(build_kernel_profile_shard(
+                        query, name, time.perf_counter() - q_fast0,
+                        _tpu_profile_section(tpu_search, sink or {})))
                 total += res.total_hits
                 if getattr(res, "total_relation", "eq") == "gte":
                     relation = "gte"
@@ -1104,7 +1175,7 @@ def search_shard_group(indices: IndicesService,
     if body.get("profile"):
         out["profile_shards"] = build_profile(
             query, group_profile_entries, group_query_nanos,
-            group_fetch_nanos)
+            group_fetch_nanos) + fast_profile_entries
     if body.get("suggest") is not None:
         from elasticsearch_tpu.search.suggest import run_suggest
         # restrict to the group's ASSIGNED shards: unselected local
@@ -1232,8 +1303,11 @@ def merge_group_responses(groups: List[Dict[str, Any]],
                    else aggs.empty())
         out["aggregations"] = build_response(aggs, reduced)
     if body.get("profile"):
-        out["profile"] = {"shards": [
-            s for g in groups for s in g.get("profile_shards", [])]}
+        shards = [s for g in groups for s in g.get("profile_shards", [])]
+        out["profile"] = {"shards": shards}
+        tpu = [s["tpu"] for s in shards if "tpu" in s]
+        if tpu:
+            out["profile"]["tpu"] = tpu
     return out
 
 

@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from elasticsearch_tpu.common import profiler
 from elasticsearch_tpu.index.reader import ShardReader
 from elasticsearch_tpu.ops import bm25
 from elasticsearch_tpu.search import dsl
@@ -118,6 +119,9 @@ def execute_query(reader: ShardReader, query: dsl.QueryNode, *,
     from elasticsearch_tpu.search.aggregations import (AggregatorFactories,
                                                        SegmentAggContext)
 
+    # tag the thread's trace stage for the sampling profiler (no-op
+    # set-emptiness check while the sampler is off)
+    profiler.tag_stage("query_phase")
     if sort_specs:
         return _execute_sorted_query(reader, query, size=size, from_=from_,
                                      min_score=min_score, aggs=aggs,
@@ -277,6 +281,7 @@ def execute_fetch(reader: ShardReader, hits: List[ShardHit],
 
     `source`: True | False | list of field-name prefixes (the _source
     filtering contract of the reference's fetch sub-phases)."""
+    profiler.tag_stage("fetch_phase")
     by_name = {v.segment.name: v.segment for v in reader.views}
     out = []
     for hit in hits:

@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common import profiler, tracing
 from elasticsearch_tpu.common.metrics import LabeledCounters
 from elasticsearch_tpu.mapping.types import TextFieldType
 from elasticsearch_tpu.parallel import distributed as dist
@@ -507,6 +507,14 @@ class _Pending:
     # parent their launch/device spans under the FIRST traced query of
     # the train so a trace shows which batch served it
     trace_span: Any = None
+    # batch_wait decomposition marks (perf_counter). Workers stamp
+    # cycle/take/launched; the REQUEST thread reads them back after
+    # `future.result()` so the four sub-stages sum exactly to the
+    # legacy `batch_wait` measured on the same thread.
+    t_submit: float = 0.0
+    t_cycle: float = 0.0
+    t_take: float = 0.0
+    t_launched: float = 0.0
 
 
 def _batch_bucket(n: int, cap: int) -> int:
@@ -600,6 +608,7 @@ class _PackQueue:
                         # C minus in-flight, so gating on max_batch
                         # serializes trains when C ≈ max_batch)
                         pipeline_min = max(8, batcher.max_batch // 2)
+                        t_cycle = time.perf_counter()
                         while (len(self.pendings) < batcher.max_batch
                                and not self.closed):
                             now = time.monotonic()
@@ -624,6 +633,10 @@ class _PackQueue:
                             self.cv.wait(timeout=deadline - now)
                         taken = self.pendings[: batcher.max_batch]
                         self.pendings = self.pendings[batcher.max_batch:]
+                        t_take = time.perf_counter()
+                        for p in taken:
+                            p.t_cycle = t_cycle
+                            p.t_take = t_take
                 if retire:
                     # NEVER hold cv while taking the batcher lock
                     # (submit's get/create path holds it before us)
@@ -634,6 +647,7 @@ class _PackQueue:
                 trace_parent = next(
                     (p.trace_span for p in taken if p.trace_span), None)
                 try:
+                    profiler.tag_stage("batch_launch")
                     with tracing.span_under(trace_parent,
                                             "tpu.batch_launch",
                                             queries=len(taken)):
@@ -646,10 +660,15 @@ class _PackQueue:
                         if not p.future.done():
                             p.future.set_exception(exc)
                 else:
+                    t_launched = time.perf_counter()
+                    for p in taken:
+                        p.t_launched = t_launched
                     with self.cv:
                         self.n_inflight += 1
                     # blocks when PIPELINE_DEPTH batches are in flight
                     self.inflight.put((st, taken))
+                finally:
+                    profiler.tag_stage(None)
         finally:
             self.inflight.put(None)  # stop the completer
 
@@ -663,6 +682,7 @@ class _PackQueue:
             trace_parent = next(
                 (p.trace_span for p in taken if p.trace_span), None)
             try:
+                profiler.tag_stage("batch_finish")
                 with tracing.span_under(trace_parent, "tpu.batch_finish",
                                         queries=len(taken)):
                     results = finish_flat_batch(st)
@@ -673,6 +693,7 @@ class _PackQueue:
                 with self.cv:
                     self.n_inflight -= 1
                     self.cv.notify_all()
+                profiler.tag_stage(None)
                 continue
             with batcher._lock:
                 batcher.batches_executed += 1
@@ -682,6 +703,7 @@ class _PackQueue:
             with self.cv:  # batch finished — the worker may launch now
                 self.n_inflight -= 1
                 self.cv.notify_all()
+            profiler.tag_stage(None)
 
 
 class MicroBatcher:
@@ -725,10 +747,19 @@ class MicroBatcher:
 
     def submit(self, resident: ResidentPack, flat: FlatQuery,
                k: int) -> Future:
+        """The entry point the serving path (and fault-injection tests)
+        hook; the `_Pending` with its batch_wait decomposition marks
+        rides on the returned future as `.pending`."""
+        return self.submit_pending(resident, flat, k).future
+
+    def submit_pending(self, resident: ResidentPack, flat: FlatQuery,
+                       k: int) -> _Pending:
         fut: Future = Future()
         # capture on the REQUEST thread — the batch workers have no
         # request thread-local to read
-        pending = _Pending(flat, k, fut, tracing.current_span())
+        pending = _Pending(flat, k, fut, tracing.current_span(),
+                           t_submit=time.perf_counter())
+        fut.pending = pending  # type: ignore[attr-defined]
         while True:
             with self._lock:
                 if self._closed:
@@ -738,8 +769,19 @@ class MicroBatcher:
                     queue = _PackQueue(self, resident)
                     self._queues[id(resident)] = queue
             if queue.submit(pending):
-                return fut
+                return pending
             # raced the queue's idle retirement — loop and respawn
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Instantaneous queue gauges for the profiler timeline and the
+        metrics registry (lock-light: len/int reads are GIL-atomic)."""
+        with self._lock:
+            queues = list(self._queues.values())
+        return {
+            "queues": len(queues),
+            "pending": sum(len(q.pendings) for q in queues),
+            "inflight": sum(q.n_inflight for q in queues),
+        }
 
     # set by the owning TpuSearchService so batches reuse the mesh the
     # pack arrays were placed with (no per-batch mesh construction)
@@ -763,6 +805,7 @@ class FlatQueryResult:
     resident: Optional[ResidentPack] = None  # for the fetch phase
     total_relation: str = "eq"  # "gte" when block-max pruning stopped
                                 # counting (the reference's WAND behavior)
+    variant: Optional[str] = None  # kernel variant that produced this
     _hits: Optional[List[Tuple[float, int, str, int, str]]] = None
 
     @classmethod
@@ -1034,7 +1077,9 @@ def execute_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
 def _columnar_results(resident: ResidentPack, vals: np.ndarray,
                       gids: np.ndarray, totals: np.ndarray,
                       n_queries: int, relation_fn,
-                      k_cap: Optional[int] = None) -> List[FlatQueryResult]:
+                      k_cap: Optional[int] = None,
+                      variant: Optional[str] = None
+                      ) -> List[FlatQueryResult]:
     """Decode a whole batch's [B, k'] kernel output into columnar results
     with vectorized numpy — the only per-query work is slicing views.
     Sentinel lanes (score -inf / ordinal == d_pad / padding rows) are
@@ -1058,7 +1103,7 @@ def _columnar_results(resident: ResidentPack, vals: np.ndarray,
         out.append(FlatQueryResult(
             sc, rows[qi, :m], ords[qi, :m], int(totals[qi]),
             float(sc[0]) if m else None, resident=resident,
-            total_relation=relation_fn(qi)))
+            total_relation=relation_fn(qi), variant=variant))
     return out
 
 
@@ -1126,7 +1171,8 @@ def _finish_exact(launch: Dict[str, Any],
                    time.perf_counter() - t_dev)
     return _columnar_results(launch["resident"], vals, gids, totals,
                              launch["n"], lambda qi: "eq",
-                             k_cap=launch["k"])
+                             k_cap=launch["k"],
+                             variant=launch.get("variant"))
 
 
 def _execute_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
@@ -1235,7 +1281,8 @@ def _finish_pruned(launch: Dict[str, Any],
     # with scalar numpy reads — no per-hit Python
     decoded = _columnar_results(
         resident, vals, gids.astype(np.int64), totals, len(flats),
-        lambda qi: "gte" if beta[qi] > 0.0 else "eq")
+        lambda qi: "gte" if beta[qi] > 0.0 else "eq",
+        variant=launch.get("variant"))
     results: List[FlatQueryResult] = []
     invalid: List[int] = []
     for qi, res in enumerate(decoded):
@@ -1351,12 +1398,15 @@ class TpuSearchService:
 
     def try_search(self, index_service, query: dsl.QueryNode, *,
                    k: int,
-                   timeout_s: Optional[float] = None
+                   timeout_s: Optional[float] = None,
+                   profile_sink: Optional[Dict[str, Any]] = None
                    ) -> Optional[FlatQueryResult]:
         """Returns the kernel result, or None → caller uses the planner.
         k = from + size (top window the coordinator needs). timeout_s
         bounds the batch wait (a request deadline); the service cap
-        applies regardless."""
+        applies regardless. profile_sink (a `profile: true` search)
+        receives the kernel-side story: variant, plan-cache outcome,
+        and this query's per-stage host timings."""
         if k <= 0 or k > 10_000:
             self.fallback += 1
             return None
@@ -1396,11 +1446,16 @@ class TpuSearchService:
         if resident is None:
             # field has no postings anywhere → zero hits, kernel-free
             self.served += 1
+            if profile_sink is not None:
+                profile_sink["empty_pack"] = True
             return FlatQueryResult.empty()
+        plan_outcome = ("uncacheable" if cache_key is None
+                        else "hit" if cached is not None else "miss")
         if cache_key is not None:
             if cached is None:
                 self.plans.put(cache_key, (flat, resident.reader_key))
             elif cached_rk != resident.reader_key:
+                plan_outcome = "revalidated"
                 # the resident pack was rebuilt since this plan was
                 # cached (refresh/merge mid-traffic): re-lower so no
                 # plan ever runs against a pack it wasn't validated
@@ -1422,7 +1477,11 @@ class TpuSearchService:
         # (EnginePlugin seam contract — an engine swap preserves behavior).
         try:
             t_sub = time.perf_counter()
+            # go through submit() — the seam fault-injection tests hook —
+            # and read the decomposition marks back off the future (a
+            # mocked future simply has no marks: split degrades to None)
             fut = self.batcher.submit(resident, flat, k)
+            pending = getattr(fut, "pending", None)
             # the batch wait is bounded: the service cap (default 30s —
             # the FIRST batch on a signature pays XLA compile; if it
             # exceeds the cap the query plans instead and the compiled
@@ -1460,8 +1519,50 @@ class TpuSearchService:
             return None
         self._tripped = False  # a completed batch proves the path is live
         self.served += 1
-        self.stages.add("batch_wait", time.perf_counter() - t_sub)
+        t_done = time.perf_counter()
+        self.stages.add("batch_wait", t_done - t_sub)
+        split = self._record_batch_wait_split(pending, t_sub, t_done)
+        if profile_sink is not None:
+            profile_sink.update({
+                "variant": result.variant
+                or ("packed" if KERNEL_CONFIG["packed_sort"] else "ref"),
+                "plan_cache": plan_outcome,
+                "stages_ms": {
+                    "lower": round((t1 - t0) * 1e3, 4),
+                    "pack_get": round((t2 - t1) * 1e3, 4),
+                    "batch_wait": round((t_done - t_sub) * 1e3, 4),
+                },
+            })
+            if split:
+                profile_sink["stages_ms"]["batch_wait_split"] = {
+                    name: round(dt * 1e3, 4) for name, dt in split.items()}
         return result
+
+    def _record_batch_wait_split(self, pending, t_sub: float,
+                                 t_done: float) -> Optional[Dict[str, float]]:
+        """Decompose one query's batch_wait into queue (submit → the
+        worker's train cycle), window (batching window), dispatch
+        (host-side staging inside launch), and completion (device→host
+        + decode + callback). All four are measured from marks the
+        workers stamped on the `_Pending`, anchored to the same
+        request-thread clock as `batch_wait` — so the parts sum to the
+        aggregate exactly, by construction."""
+        if pending is None:
+            return None  # a mocked/foreign future carries no marks
+        t_c, t_t, t_l = pending.t_cycle, pending.t_take, pending.t_launched
+        if not t_t or not t_l:
+            return None  # launch path didn't stamp (shouldn't happen)
+        split = {
+            "queue": max(0.0, t_c - t_sub),
+            "window": max(0.0, t_t - max(t_sub, t_c)),
+            "dispatch": max(0.0, t_l - t_t),
+            "completion": max(0.0, t_done - t_l),
+        }
+        variant = "packed" if KERNEL_CONFIG["packed_sort"] else "ref"
+        for name, dt in split.items():
+            self.stages.add(f"batch_wait.{name}", dt)
+            self.stages.add(f"batch_wait.{name}.{variant}", dt)
+        return split
 
     def prewarm(self, index_service, field: str,
                 concurrency: Optional[int] = None) -> Dict[str, Any]:
@@ -1651,6 +1752,7 @@ class TpuSearchService:
                 "prewarm": prewarm,
                 "kernel": {"packed_sort": KERNEL_CONFIG["packed_sort"],
                            "variants": KERNEL_VARIANT_COUNTS.counts()},
+                "queue": self.batcher.queue_depths(),
                 "stages": self.stages.snapshot()}
 
     def close(self) -> None:

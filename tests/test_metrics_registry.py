@@ -207,7 +207,13 @@ def test_required_families_are_present(node):
             "es_tpu_indexing_pressure_rejections_total",
             "es_tpu_indexing_pressure_limit_bytes",
             "es_tpu_search_backpressure_shed_total",
-            "es_tpu_search_backpressure_declined_total"):
+            "es_tpu_search_backpressure_declined_total",
+            "es_tpu_profiler_enabled",
+            "es_tpu_profiler_samples_total",
+            "es_tpu_profiler_overhead_ratio",
+            "es_tpu_profiler_device_sessions_total",
+            "es_tpu_search_tpu_queue_pending",
+            "es_tpu_search_tpu_queue_inflight"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
     # the failure we recorded in the fixture shows up labeled
     assert ('es_tpu_search_shard_failures_total'

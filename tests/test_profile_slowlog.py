@@ -56,23 +56,47 @@ class TestProfile:
             "query": {"match_all": {}}})
         assert "profile" not in res
 
-    def test_profile_skips_kernel_path(self, node):
-        """Profiling instruments the planner: the kernel fast path must
-        never be consulted for a profiled query — asserted with a
-        sentinel tpu_search that fails the test if touched."""
-        self._seed(node)
-        from elasticsearch_tpu.search import coordinator
+    def test_profile_keeps_kernel_path(self, tmp_data_path):
+        """`profile: true` no longer exiles the query to the reference
+        scorer (PR 6): the kernel path serves it and the profile carries
+        a `tpu` section with the variant, plan-cache outcome and the
+        batch_wait decomposition instead of per-shard Lucene timings."""
+        from elasticsearch_tpu.search import tpu_service as svc_mod
 
-        class _Sentinel:
-            def try_search(self, *a, **k):
-                raise AssertionError(
-                    "profiled query must not take the kernel path")
-
-        res = coordinator.search(node.indices, "p", {
-            "query": {"match": {"msg": "profiled"}}, "profile": True},
-            {}, tpu_search=_Sentinel())
-        assert res["hits"]["total"]["value"] == 8
-        assert res["profile"]["shards"]
+        n = Node(str(tmp_data_path), settings=Settings.of({}))
+        try:
+            self._seed(n)
+            served_before = n.tpu_search.served
+            variants_before = dict(svc_mod.KERNEL_VARIANT_COUNTS.counts())
+            status, res = _handle(n, "POST", "/p/_search", body={
+                "query": {"match": {"msg": "profiled"}}, "profile": True})
+            assert status == 200, res
+            assert res["hits"]["total"]["value"] == 8
+            # the kernel actually served it — no silent fallback
+            assert n.tpu_search.served == served_before + 1
+            shards = res["profile"]["shards"]
+            assert len(shards) == 1 and shards[0]["id"] == "[p][kernel]"
+            assert shards[0]["searches"][0]["collector"][0]["name"] == \
+                "TpuKernelTopK"
+            tpu = shards[0]["tpu"]
+            assert tpu["variant"] in ("packed", "ref")
+            assert tpu["plan_cache"] in (
+                "hit", "miss", "revalidated", "uncacheable")
+            split = tpu["stages_ms"]["batch_wait_split"]
+            assert set(split) == {
+                "queue", "window", "dispatch", "completion"}
+            assert sum(split.values()) == pytest.approx(
+                tpu["stages_ms"]["batch_wait"], rel=0.05, abs=0.05)
+            assert res["profile"]["tpu"] == [tpu]
+            # taking the path counts against the served variant (keys
+            # are "kernel,variant" pairs)
+            after = dict(svc_mod.KERNEL_VARIANT_COUNTS.counts())
+            assert any(key.split(",")[1] == tpu["variant"]
+                       and count > variants_before.get(key, 0)
+                       for key, count in after.items()), \
+                (tpu["variant"], variants_before, after)
+        finally:
+            n.close()
 
 
 class TestSlowLog:
