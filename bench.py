@@ -117,7 +117,13 @@ def main() -> None:
                     "index": {"translog": {"durability": "async"}},
                     "search": {
                         "tracing": {"sample_rate": trace_sample},
-                        "profiler": {"enabled": profile_on}}}))
+                        "profiler": {"enabled": profile_on},
+                        # every closed-loop client can have one request
+                        # in flight per front — ring sized to match so
+                        # the rest_qps phase measures throughput, not
+                        # 429 churn
+                        "tpu_serving": {
+                            "front_slots": max(64, clients)}}}))
     t0 = time.perf_counter()  # bulk ingest + refresh-to-searchable
     idx = node.create_index(
         "bench", Settings.of({"index": {
@@ -335,6 +341,92 @@ def main() -> None:
             log(f"kernel_compare[{label}]: {nq} queries in {pdt:.1f}s, "
                 f"device {dev_ms_q} ms/query")
         tpu.set_kernel_packed_sort(original)
+
+    # ---- true end-to-end REST QPS over real HTTP sockets: the
+    # single-process server vs the multi-process serving front (ISSUE
+    # 7). Unlike the in-process `node.handle` loop above, this pays
+    # socket accept, HTTP parse, and response write — the costs the
+    # front processes exist to take off the batcher's interpreter.
+    # ES_TPU_BENCH_FRONTS=0 skips the phase. ----
+    n_fronts = _env("FRONTS", 2)
+    if n_fronts > 0:
+        import http.client
+
+        from elasticsearch_tpu.node import serve
+
+        def http_load_phase(ports, phase_seconds):
+            """Closed-loop keep-alive HTTP clients round-robined over
+            `ports` → (queries, dt, rejected_429s, errors)."""
+            stop_at = time.perf_counter() + phase_seconds
+            counts = [0] * clients
+            rejected = [0] * clients
+            herrors = []
+
+            def client(ci: int) -> None:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", ports[ci % len(ports)], timeout=120)
+                qi = ci
+                try:
+                    while time.perf_counter() < stop_at:
+                        body = json.dumps(
+                            query_bodies[qi % len(query_bodies)])
+                        conn.request(
+                            "POST", "/bench/_search", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        if resp.status == 429:
+                            # shedding under overload is expected — back
+                            # off briefly and keep driving
+                            rejected[ci] += 1
+                            time.sleep(0.005)
+                            continue
+                        if resp.status != 200:
+                            herrors.append(data[:300].decode(
+                                "utf-8", "replace"))
+                            return
+                        counts[ci] += 1
+                        qi += clients
+                except OSError as e:
+                    herrors.append(f"{type(e).__name__}: {e}")
+                finally:
+                    conn.close()
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(ci,))
+                       for ci in range(clients)]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            return (sum(counts), time.perf_counter() - t0,
+                    sum(rejected), herrors)
+
+        phase_s = max(2, seconds // 2)
+        server = serve(node, port=0)
+        base_port = server.server_address[1]
+        nq1, dt1, rej1, herr1 = http_load_phase([base_port], phase_s)
+        server.shutdown()
+        server.server_close()
+        single_qps = nq1 / dt1 if dt1 > 0 else 0.0
+        log(f"rest_qps single-process: {nq1} queries in {dt1:.1f}s = "
+            f"{single_qps:.1f} QPS ({rej1} x 429)")
+        front_ports = node.start_serving_fronts(count=n_fronts)
+        nq2, dt2, rej2, herr2 = http_load_phase(front_ports, phase_s)
+        front_qps = nq2 / dt2 if dt2 > 0 else 0.0
+        sup = node.serving_front
+        log(f"rest_qps {n_fronts} fronts: {nq2} queries in {dt2:.1f}s = "
+            f"{front_qps:.1f} QPS ({rej2} x 429, plan-memo hits: "
+            f"{sup.c_memo_hits.count})")
+        out["rest_qps"] = {
+            "single_process": round(single_qps, 2),
+            "fronts": round(front_qps, 2),
+            "front_processes": n_fronts,
+            "speedup": (round(front_qps / single_qps, 3)
+                        if single_qps > 0 else None),
+            "rejected_429": {"single": rej1, "fronts": rej2},
+            "plan_memo_hits": sup.c_memo_hits.count,
+        }
+        if herr1 or herr2:
+            out["rest_qps"]["errors"] = (herr1 + herr2)[:3]
 
     # ---- CPU oracle baseline on the same corpus/queries ----
     segments = []

@@ -382,6 +382,35 @@ class MetricsRegistry:
             return float(metric())
         return float(metric)
 
+    def export_snapshot(self) -> list:
+        """Resolve every sample into plain JSON-serializable rows
+        ``[name, labels, value, kind]`` for cross-process shipping (the
+        serving fronts publish these through their stats block; the
+        batcher re-emits them as collector rows with a ``process``
+        label). Composite metrics flatten: MeanMetric → ``.count`` /
+        ``.sum`` counters, SampleRing → per-quantile gauges + a
+        ``.count`` counter."""
+        rows = []
+        for name, labels, metric, kind in self._samples():
+            if isinstance(metric, SampleRing):
+                snap = metric.samples()
+                for p, val in percentiles(snap, SUMMARY_QUANTILES).items():
+                    rows.append([f"{name}.p{p:g}", labels, val, "gauge"])
+                rows.append([f"{name}.count", labels, len(snap),
+                             "counter"])
+            elif isinstance(metric, MeanMetric):
+                rows.append([f"{name}.count", labels, metric.count,
+                             "counter"])
+                rows.append([f"{name}.sum", labels, metric.sum,
+                             "counter"])
+            else:
+                try:
+                    rows.append([name, labels, self._value_of(metric),
+                                 kind])
+                except (TypeError, ValueError):
+                    continue
+        return rows
+
     def prometheus_text(self) -> str:
         """Standard text exposition: one # HELP / # TYPE per family, then
         its samples; families sorted by name for stable scrapes."""

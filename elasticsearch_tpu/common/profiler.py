@@ -129,7 +129,12 @@ class HostSampler:
     TIMELINE_POINTS = 4096
 
     def __init__(self, hz: float = 20.0, retention_s: float = 300.0,
-                 max_depth: int = 64):
+                 max_depth: int = 64, role: str = "batcher"):
+        # which process this sampler runs in ("batcher" or "front-N");
+        # folded lines stay role-free — the flamegraph merge prefixes
+        # roles only when serving fronts exist, so single-process
+        # output is byte-stable
+        self.role = role
         self.hz = max(0.5, min(250.0, float(hz)))
         self.retention_s = max(1.0, float(retention_s))
         self.max_depth = max_depth
@@ -270,6 +275,7 @@ class HostSampler:
     def stats(self) -> Dict[str, Any]:
         return {
             "running": self.running,
+            "role": self.role,
             "hz": self.hz,
             "retention_s": self.retention_s,
             "samples_total": self.samples_total,

@@ -162,6 +162,9 @@ class Node:
             self.profiler.sampler.timeline_source = \
                 self.tpu_search.batcher.queue_depths
         self.profiler.start()
+        # the multi-process serving front (started explicitly via
+        # start_serving_fronts(); None ⇒ single-process serving)
+        self.serving_front = None
         from elasticsearch_tpu.common.metrics import MetricsRegistry
         self.metrics = MetricsRegistry()
         self._register_metrics()
@@ -290,6 +293,39 @@ class Node:
             seed_hosts=seed_hosts,
             initial_master_names=initial_master_nodes)
         self.cluster.start()
+
+    def start_serving_fronts(self, *, host: str = "127.0.0.1",
+                             count: Optional[int] = None) -> list:
+        """Spawn the multi-process serving front: N HTTP front processes
+        handing plan-signed requests to this (batcher) process over
+        shared memory (serving/front.py). Returns the front HTTP ports;
+        [] when search.tpu_serving.front_processes is 0 (the default —
+        single-process serving via serve())."""
+        if self.serving_front is not None:
+            return self.serving_front.ports
+        n = count if count is not None else self.settings.get_int(
+            "search.tpu_serving.front_processes", 0)
+        if n <= 0:
+            return []
+        profile_hz = 0.0
+        if self.settings.get_bool("search.profiler.enabled", False):
+            profile_hz = self.settings.get_float(
+                "search.profiler.hz", 20.0)
+        from elasticsearch_tpu.serving.front import FrontSupervisor
+        self.serving_front = FrontSupervisor(
+            self, n, host=host,
+            slots=self.settings.get_int(
+                "search.tpu_serving.front_slots", 64),
+            slot_bytes=self.settings.get_int(
+                "search.tpu_serving.front_slot_bytes", 256 << 10),
+            timeout_s=self.settings.get_float(
+                "search.tpu_serving.front_timeout_seconds", 45.0),
+            wedge_timeout_s=self.settings.get_float(
+                "search.tpu_serving.front_wedge_timeout_seconds", 30.0),
+            profile_hz=profile_hz,
+            memo_size=self.settings.get_int(
+                "search.tpu_serving.plan_memo_size", 4096))
+        return self.serving_front.ports
 
     def replicate(self, op: str, index: str, shard_num: int, doc_id: str,
                   source, result) -> None:
@@ -474,6 +510,21 @@ class Node:
                    1 if dev.info()["active"] else 0, "gauge")
 
         reg.add_collector(_profiler)
+        reg.set_help("serving.fronts",
+                     "Serving front processes currently alive")
+        reg.set_help("serving.plan_memo.hits",
+                     "Batcher body parses skipped via plan-signature memo")
+        reg.set_help("serving.slots_reclaimed",
+                     "Shared-memory slots reclaimed from dead fronts")
+
+        def _serving():
+            # supervisor counters + every front's shm-published registry
+            # snapshot, each row tagged with its process role
+            sup = self.serving_front
+            if sup is None:
+                return
+            yield from sup.metric_rows()
+        reg.add_collector(_serving)
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
@@ -573,6 +624,10 @@ class Node:
             self._refresher.cancel()
         if self._syncer:
             self._syncer.cancel()
+        if self.serving_front is not None:
+            # fronts stop accepting before the device path tears down
+            self.serving_front.close()
+            self.serving_front = None
         if self.cluster is not None:
             self.cluster.close()
         if self.profiler is not None:
@@ -722,6 +777,10 @@ def main() -> None:
     node.start_refresher()
     server = serve(node, args.host, args.port)
     print(f"[{args.node_name}] listening on http://{args.host}:{args.port}")
+    front_ports = node.start_serving_fronts(host=args.host)
+    if front_ports:
+        print(f"[{args.node_name}] serving fronts on "
+              + ", ".join(f"http://{args.host}:{p}" for p in front_ports))
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

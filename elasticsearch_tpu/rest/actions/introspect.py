@@ -397,17 +397,41 @@ def register(controller: RestController, node) -> None:
         pool = req.params.get("pool") or None
         top = int(req.params.get("top", 0) or 0) or None
         fmt = str(req.params.get("format", "folded")).lower()
+        # multi-process merge: when serving fronts exist, every line is
+        # prefixed with its process role (batcher; / front-N;) and the
+        # fronts' shm-published folded stacks join the scrape. With no
+        # fronts the output stays byte-identical to single-process.
+        supervisor = getattr(node, "serving_front", None)
+        front_folded = supervisor.front_folded() if supervisor else {}
         if fmt == "json":
             stacks = [{"stack": line.split(";"), "count": count}
                       for line, count in sampler.folded(
                           trace_id=trace_id, top=top, pool=pool)]
+            if supervisor is not None:
+                for s in stacks:
+                    s["stack"].insert(0, sampler.role)
+                for role, folded in front_folded.items():
+                    for line in folded.splitlines():
+                        stack, _, count = line.rpartition(" ")
+                        if stack and count.isdigit():
+                            stacks.append(
+                                {"stack": [role] + stack.split(";"),
+                                 "count": int(count)})
             return 200, {"enabled": sampler.running,
                          **sampler.stats(), "stacks": stacks}
-        if not sampler.running and not sampler.samples_total:
+        if not sampler.running and not sampler.samples_total \
+                and not front_folded:
             return 200, {"enabled": False,
                          "reason": "search.profiler.enabled is false"}
-        return 200, sampler.folded_text(trace_id=trace_id, top=top,
-                                        pool=pool)
+        text = sampler.folded_text(trace_id=trace_id, top=top, pool=pool)
+        if supervisor is not None:
+            lines = [f"{sampler.role};{line}"
+                     for line in text.splitlines()]
+            for role, folded in front_folded.items():
+                lines.extend(f"{role};{line}"
+                             for line in folded.splitlines())
+            text = "\n".join(lines) + ("\n" if lines else "")
+        return 200, text
 
     def do_profile_timeline(req: RestRequest):
         # queue-depth / in-flight occupancy gauges sampled on the

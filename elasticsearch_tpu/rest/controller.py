@@ -123,6 +123,27 @@ def classify_pool(method: str, path: str) -> str:
     return ""
 
 
+def front_search_index(method: str, path: str,
+                       params: Optional[Dict[str, str]] = None
+                       ) -> Optional[str]:
+    """The target index when (method, path) is the serving-front fast
+    path — exactly ``/{index}/_search`` on a non-underscore index with
+    no scroll continuation — else None (the front then proxies the raw
+    request to the batcher's full dispatch). Import-light on purpose:
+    front processes route with this before any body parse."""
+    if method not in ("GET", "POST"):
+        return None
+    parts = path.strip("/").split("/")
+    if len(parts) != 2 or parts[1] != "_search":
+        return None
+    index = parts[0]
+    if not index or index.startswith("_"):
+        return None
+    if params and params.get("scroll"):
+        return None
+    return index
+
+
 class RestController:
     def __init__(self):
         self._root = _TrieNode()

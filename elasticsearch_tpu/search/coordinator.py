@@ -876,10 +876,15 @@ def _search_fast(indices: IndicesService, names: List[str],
                     name, res.resident, res.scores[sel], res.rows[sel],
                     res.ords[sel], source, version, seq_no_primary_term)
         cursors = {ii: 0 for ii in assembled}
-        hits_json = []
+        merged: List[Dict[str, Any]] = []
         for ii in win_tags.tolist():
-            hits_json.append(assembled[ii][cursors[ii]])
+            merged.append(assembled[ii][cursors[ii]])
             cursors[ii] += 1
+        # merged hits are materialized dicts, but their serialization
+        # still batches through the response splicer (SplicedHits wraps,
+        # dumps_response splices)
+        from elasticsearch_tpu.search.serializer import SplicedHits
+        hits_json = SplicedHits(merged)
         max_score = float(all_scores[order[0]]) if len(order) else None
     stages = getattr(tpu_search, "stages", None)
     if stages is not None:

@@ -1,0 +1,39 @@
+"""Wire-level plan signatures for the multi-process serving front.
+
+The serving fronts (``serving/front.py``) parse and canonicalize search
+bodies on their own cores, then hand the batcher a signature alongside
+the raw bytes; the batcher memoizes signature → parsed body so repeated
+query shapes never pay ``json.loads`` on the device-owning process, and
+the signature doubles as the stable half of the lowered-plan cache key
+(``tpu_service.plan_key`` adds the mapping generation).
+
+Deliberately import-light: front processes must never pull in JAX, so
+this module depends on nothing but the stdlib. ``planner.py`` re-exports
+it for batcher-side callers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+__all__ = ["canonical_body", "wire_plan_signature"]
+
+
+def canonical_body(body: Any) -> str:
+    """Key-order-insensitive canonical encoding of a query body: two
+    requests that differ only in JSON key order or whitespace sign the
+    same."""
+    return json.dumps(body, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def wire_plan_signature(index: str, body: Any) -> str:
+    """Stable signature of (target index, canonical body) — the unit the
+    front hands off and the batcher memoizes on."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(index.encode("utf-8", "replace"))
+    h.update(b"\x00")
+    h.update(canonical_body(body).encode("utf-8", "replace"))
+    return h.hexdigest()

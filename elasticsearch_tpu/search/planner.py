@@ -41,6 +41,11 @@ from elasticsearch_tpu.mapping.types import (
 from elasticsearch_tpu.ops import bm25, sparse
 from elasticsearch_tpu.ops.smallfloat import bm25_norm_cache
 from elasticsearch_tpu.search import dsl
+# re-exported for batcher-side callers; the implementation lives in the
+# import-light plan_sig module because the serving-front processes (which
+# must never pull in JAX) sign request bodies with the same function
+from elasticsearch_tpu.search.plan_sig import (  # noqa: F401
+    canonical_body, wire_plan_signature)
 
 MAX_SLOTS_PER_PASS = 32
 

@@ -1,0 +1,14 @@
+"""Multi-process serving front (ISSUE 7).
+
+N front processes own REST parse → DSL canonicalization → plan-signature
+lookup; ONE batcher process (the Node) owns the device. Requests hand
+off over a shared-memory slot arena (``serving.shm``) with a pipe
+doorbell; responses come back as envelope parts + splice columns that
+the front assembles with the C response splicer on its own core
+(``search/serializer.py`` + ``native/response_splice.c``), so neither
+REST dispatch nor per-hit serialization serializes on the batcher's GIL.
+"""
+
+from elasticsearch_tpu.serving.shm import SlotArena, StatsBlock  # noqa: F401
+
+__all__ = ["SlotArena", "StatsBlock"]

@@ -1,0 +1,606 @@
+"""The multi-process serving front: N front processes + 1 batcher.
+
+Front processes (spawned, and they NEVER import JAX — only the
+serializer/splicer, the plan-signature module, and controller error
+helpers) each run their own HTTP server and own the interpreter-bound
+half of a request: socket accept, URL/query parse, JSON body parse +
+canonical plan signature, and final response splicing through the C
+response splicer. The batcher — the existing Node process that owns the
+device — only sees a pickled request descriptor and answers with
+envelope parts + splice columns (``serializer.encode_wire_response``).
+
+Handoff per front is one ``SlotArena`` (shared-memory payload slots,
+front-owned free list) plus one duplex pipe doorbell that carries slot
+indices; payloads that outgrow a slot ride the pipe directly. A repeated
+query shape hits the batcher's signature→parsed-body memo, so the
+device-owning process never re-parses JSON for hot queries — that parse
+already happened on a front core.
+
+Crash resilience: the batcher's per-front receiver thread sees EOF when
+a front dies (SIGKILL included); it reclaims the front's in-flight
+slots, drops the orphaned work, and — unless a disruption scheme is
+holding respawn — relaunches the front on the same port. A wedged-alive
+front is detected by a stale stats-block heartbeat and killed into the
+same path.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing
+import os
+import pickle
+import queue
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from elasticsearch_tpu.serving.shm import SlotArena, StatsBlock
+
+logger = logging.getLogger("elasticsearch_tpu.serving")
+
+_READY_TIMEOUT_S = 20.0
+_PUBLISH_INTERVAL_S = 0.25
+
+
+def _free_port(host: str) -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# front process
+# ---------------------------------------------------------------------------
+
+class _FrontState:
+    """Everything one front process owns."""
+
+    def __init__(self, cfg: Dict[str, Any], conn):
+        self.cfg = cfg
+        self.conn = conn
+        self.role = cfg["role"]
+        self.arena = SlotArena(cfg["arena_name"], slots=cfg["slots"],
+                               slot_bytes=cfg["slot_bytes"])
+        self.stats = StatsBlock(cfg["stats_name"])
+        self.timeout_s = cfg.get("timeout_s", 45.0)
+        self.free: "queue.Queue[int]" = queue.Queue()
+        for i in range(cfg["slots"]):
+            self.free.put(i)
+        self.pending: Dict[int, "_Waiter"] = {}
+        self._send_lock = threading.Lock()
+        from elasticsearch_tpu.common.metrics import (CounterMetric,
+                                                      MetricsRegistry,
+                                                      SampleRing)
+        self.metrics = MetricsRegistry()
+        self.c_requests = self.metrics.register(
+            "serving.front.requests", CounterMetric(),
+            help="HTTP requests handled by this serving front")
+        self.c_fast = self.metrics.register(
+            "serving.front.fast_path", CounterMetric(),
+            help="Requests parsed + signed on the front (search fast path)")
+        self.c_proxied = self.metrics.register(
+            "serving.front.proxied", CounterMetric(),
+            help="Requests proxied raw to the batcher's full dispatch")
+        self.c_rejected = self.metrics.register(
+            "serving.front.rejected", CounterMetric(),
+            help="Requests 429'd because the slot ring was full")
+        self.c_parse_errors = self.metrics.register(
+            "serving.front.parse_errors", CounterMetric(),
+            help="Malformed JSON bodies 400'd on the front")
+        self.c_timeouts = self.metrics.register(
+            "serving.front.timeouts", CounterMetric(),
+            help="Requests that timed out waiting on the batcher")
+        self.c_overflow = self.metrics.register(
+            "serving.front.pipe_overflow", CounterMetric(),
+            help="Payloads that outgrew their shm slot and rode the pipe")
+        self.latency = SampleRing(512)
+        self.metrics.register("serving.front.latency_seconds", self.latency,
+                              help="Front-observed request latency")
+        self.sampler = None
+        if cfg.get("profile_hz"):
+            from elasticsearch_tpu.common.profiler import HostSampler
+            self.sampler = HostSampler(hz=cfg["profile_hz"],
+                                       retention_s=60.0)
+            self.sampler.role = self.role
+            self.sampler.start()
+
+    # -- batcher round trip -------------------------------------------
+
+    def roundtrip(self, wire_req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Ship one request to the batcher; None ⇒ ring full (429)."""
+        try:
+            slot = self.free.get_nowait()
+        except queue.Empty:
+            self.c_rejected.inc()
+            return None
+        waiter = _Waiter()
+        self.pending[slot] = waiter
+        data = pickle.dumps(wire_req, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._send_lock:
+            if self.arena.write(slot, data):
+                self.conn.send(("req", slot))
+            else:
+                self.c_overflow.inc()
+                self.conn.send(("reqx", slot, data))
+        if not waiter.event.wait(self.timeout_s):
+            # leave the slot un-freed: the batcher may still write to it
+            self.pending.pop(slot, None)
+            self.c_timeouts.inc()
+            return {"status": 503, "ctype": "json",
+                    "parts": ['{"error":{"type":"timeout_exception",'
+                              '"reason":"batcher did not answer in '
+                              f'{self.timeout_s}s"}},"status":503}}'],
+                    "columns": []}
+        return pickle.loads(waiter.data)
+
+    def recv_loop(self) -> None:
+        """Doorbell receiver: responses in, EOF ⇒ parent is gone."""
+        while True:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                os._exit(0)  # supervisor died or closed us — fold
+            if msg[0] == "resp":
+                slot = msg[1]
+                data = self.arena.read(slot)
+            elif msg[0] == "respx":
+                slot, data = msg[1], msg[2]
+            else:
+                continue
+            waiter = self.pending.pop(slot, None)
+            self.free.put(slot)
+            if waiter is not None:
+                waiter.data = data
+                waiter.event.set()
+
+    def publish_loop(self) -> None:
+        while True:
+            snapshot = {
+                "role": self.role,
+                "pid": os.getpid(),
+                "ts": time.time(),
+                "metrics": self.metrics.export_snapshot(),
+            }
+            if self.sampler is not None:
+                snapshot["folded"] = self.sampler.folded_text()
+            try:
+                self.stats.publish(snapshot)
+            except Exception:  # noqa: BLE001 — observability side channel
+                pass
+            time.sleep(_PUBLISH_INTERVAL_S)
+
+
+class _Waiter:
+    __slots__ = ("event", "data")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.data = b""
+
+
+class _FrontHandler(BaseHTTPRequestHandler):
+    state: _FrontState = None  # set per spawned process
+    protocol_version = "HTTP/1.1"
+
+    def _do(self):
+        from elasticsearch_tpu.common import profiler as _profiler
+        from elasticsearch_tpu.rest.controller import front_search_index
+        from elasticsearch_tpu.search.plan_sig import wire_plan_signature
+        state = self.state
+        t0 = time.perf_counter()
+        state.c_requests.inc()
+        if _profiler.active():
+            _profiler.tag_thread("front_http")
+        try:
+            parsed = urlparse(self.path)
+            params = {k: v[0] if v else "" for k, v in
+                      parse_qs(parsed.query,
+                               keep_blank_values=True).items()}
+            traceparent = self.headers.get("traceparent")
+            if traceparent:
+                params["traceparent"] = traceparent
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            wire_req = {"kind": "proxy", "method": self.command,
+                        "path": parsed.path, "params": params, "raw": raw}
+            index = front_search_index(self.command, parsed.path, params)
+            if index is not None:
+                # the front's half of the plan handoff: parse + sign
+                # here, on this core — the batcher memoizes sig → body
+                body = None
+                if raw.strip():
+                    import json as _json
+                    try:
+                        body = _json.loads(raw.decode("utf-8",
+                                                      errors="replace"))
+                    except _json.JSONDecodeError as e:
+                        state.c_parse_errors.inc()
+                        self._reply(400, "json", _json.dumps(
+                            {"error": {"type": "parsing_exception",
+                                       "reason": str(e)},
+                             "status": 400}).encode("utf-8"))
+                        return
+                wire_req["kind"] = "search"
+                wire_req["sig"] = wire_plan_signature(index, body)
+                state.c_fast.inc()
+            else:
+                state.c_proxied.inc()
+            wire = state.roundtrip(wire_req)
+            if wire is None:
+                self._reply(429, "json",
+                            b'{"error":{"type":'
+                            b'"es_rejected_execution_exception","reason":'
+                            b'"serving-front slot ring is full"},'
+                            b'"status":429}')
+                return
+            from elasticsearch_tpu.search.serializer import splice_wire
+            text = splice_wire(wire["parts"], wire["columns"])
+            self._reply(wire["status"], wire["ctype"],
+                        text.encode("utf-8"))
+        finally:
+            state.latency.add(time.perf_counter() - t0)
+            _profiler.untag_thread()
+
+    def _reply(self, status: int, ctype: str, data: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type",
+                         "application/json; charset=UTF-8"
+                         if ctype == "json"
+                         else "text/plain; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("X-elastic-product", "Elasticsearch-TPU")
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _do
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+def front_main(cfg: Dict[str, Any], conn) -> None:
+    """Spawned-process entry point. Anything fatal reports over the pipe
+    and exits; the supervisor decides whether to respawn."""
+    try:
+        state = _FrontState(cfg, conn)
+        handler = type("BoundFrontHandler", (_FrontHandler,),
+                       {"state": state})
+        server = ThreadingHTTPServer((cfg["host"], cfg["port"]), handler)
+        server.daemon_threads = True
+        threading.Thread(target=state.recv_loop, name="front-doorbell",
+                         daemon=True).start()
+        threading.Thread(target=state.publish_loop, name="front-stats",
+                         daemon=True).start()
+        conn.send(("ready", cfg["port"]))
+        server.serve_forever()
+    except Exception as exc:  # noqa: BLE001 — report, then fold
+        try:
+            conn.send(("fatal", f"{type(exc).__name__}: {exc}"))
+        except Exception:  # noqa: BLE001
+            pass
+        os._exit(1)
+
+
+# ---------------------------------------------------------------------------
+# batcher side
+# ---------------------------------------------------------------------------
+
+class _FrontHandle:
+    """Supervisor-side view of one front process."""
+
+    def __init__(self, index: int, port: int, arena: SlotArena,
+                 stats: StatsBlock):
+        self.index = index
+        self.port = port
+        self.arena = arena
+        self.stats = stats
+        self.proc = None
+        self.conn = None
+        self.dead = False
+        self.inflight: set = set()
+        self.send_lock = threading.Lock()
+
+    @property
+    def role(self) -> str:
+        return f"front-{self.index}"
+
+
+class FrontSupervisor:
+    """Spawns/supervises the serving fronts and bridges their requests
+    into the node's dispatch on a batcher-side worker pool."""
+
+    def __init__(self, node, n_fronts: int, *, host: str = "127.0.0.1",
+                 slots: int = 64, slot_bytes: int = 256 << 10,
+                 timeout_s: float = 45.0, wedge_timeout_s: float = 30.0,
+                 profile_hz: float = 0.0, memo_size: int = 4096):
+        from elasticsearch_tpu.common.metrics import CounterMetric
+        self.node = node
+        self.host = host
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        self.timeout_s = float(timeout_s)
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.profile_hz = float(profile_hz)
+        self._ctx = multiprocessing.get_context("spawn")
+        self._closed = False
+        self._lock = threading.Lock()
+        self.respawn_enabled = True
+        self._memo: Dict[str, Any] = {}
+        self._memo_order: List[str] = []
+        self._memo_size = int(memo_size)
+        self._memo_lock = threading.Lock()
+        self.c_requests = CounterMetric()
+        self.c_memo_hits = CounterMetric()
+        self.c_memo_misses = CounterMetric()
+        self.c_respawns = CounterMetric()
+        self.c_front_deaths = CounterMetric()
+        self.c_slots_reclaimed = CounterMetric()
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(4, 2 * n_fronts),
+            thread_name_prefix="front-bridge")
+        self.fronts: List[_FrontHandle] = []
+        for i in range(n_fronts):
+            arena = SlotArena(slots=self.slots, slot_bytes=self.slot_bytes,
+                              create=True)
+            stats = StatsBlock(create=True)
+            h = _FrontHandle(i, _free_port(host), arena, stats)
+            self.fronts.append(h)
+            self._spawn(h)
+        threading.Thread(target=self._watch_loop, name="front-supervisor",
+                         daemon=True).start()
+
+    @property
+    def ports(self) -> List[int]:
+        return [h.port for h in self.fronts]
+
+    # -- lifecycle ----------------------------------------------------
+
+    def _spawn(self, h: _FrontHandle) -> None:
+        cfg = {"role": h.role, "host": self.host, "port": h.port,
+               "arena_name": h.arena.name, "slots": self.slots,
+               "slot_bytes": self.slot_bytes,
+               "stats_name": h.stats.name, "timeout_s": self.timeout_s,
+               "profile_hz": self.profile_hz}
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        proc = self._ctx.Process(target=front_main, args=(cfg, child_conn),
+                                 name=h.role, daemon=True)
+        proc.start()
+        child_conn.close()
+        h.proc = proc
+        h.conn = parent_conn
+        h.dead = False
+        h.inflight = set()
+        if not parent_conn.poll(_READY_TIMEOUT_S):
+            raise RuntimeError(f"serving front {h.role} did not come up")
+        msg = parent_conn.recv()
+        if msg[0] != "ready":
+            raise RuntimeError(f"serving front {h.role} failed: {msg}")
+        threading.Thread(target=self._serve_front, args=(h,),
+                         name=f"front-bridge-{h.index}",
+                         daemon=True).start()
+        logger.info("serving front %s up on %s:%d (pid %d)", h.role,
+                    self.host, h.port, proc.pid)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.respawn_enabled = False
+        for h in self.fronts:
+            try:
+                h.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if h.proc is not None and h.proc.is_alive():
+                h.proc.terminate()
+                h.proc.join(timeout=5.0)
+                if h.proc.is_alive():
+                    h.proc.kill()
+                    h.proc.join(timeout=5.0)
+            h.arena.close()
+            h.arena.unlink()
+            h.stats.close()
+            h.stats.unlink()
+        self._executor.shutdown(wait=False)
+
+    # -- batcher bridge -----------------------------------------------
+
+    def _serve_front(self, h: _FrontHandle) -> None:
+        while not self._closed and not h.dead:
+            try:
+                msg = h.conn.recv()
+            except (EOFError, OSError):
+                break
+            if msg[0] == "req":
+                slot = msg[1]
+                data = h.arena.read(slot)
+            elif msg[0] == "reqx":
+                slot, data = msg[1], msg[2]
+            elif msg[0] == "fatal":
+                logger.error("serving front %s reported: %s", h.role,
+                             msg[1])
+                continue
+            else:
+                continue
+            h.inflight.add(slot)
+            self._executor.submit(self._execute, h, slot, data)
+        self._on_front_exit(h)
+
+    def _memo_body(self, sig: str, raw: bytes) -> Any:
+        with self._memo_lock:
+            body = self._memo.get(sig)
+        if body is not None:
+            self.c_memo_hits.inc()
+            # shallow copy: handlers treat bodies as read-only, but a
+            # top-level write must never poison the memo
+            return dict(body)
+        self.c_memo_misses.inc()
+        import json as _json
+        body = _json.loads(raw.decode("utf-8", "replace")) if raw.strip() \
+            else {}
+        if isinstance(body, dict):
+            with self._memo_lock:
+                if sig not in self._memo:
+                    self._memo[sig] = body
+                    self._memo_order.append(sig)
+                    if len(self._memo_order) > self._memo_size:
+                        self._memo.pop(self._memo_order.pop(0), None)
+            return dict(body)
+        return body
+
+    def _execute(self, h: _FrontHandle, slot: int, data: bytes) -> None:
+        self.c_requests.inc()
+        try:
+            req = pickle.loads(data)
+            if req["kind"] == "search":
+                body = self._memo_body(req["sig"], req["raw"])
+                status, payload = self.node.controller.dispatch(
+                    req["method"], req["path"], req["params"], body,
+                    req["raw"])
+            else:
+                status, payload = self.node.handle(
+                    req["method"], req["path"], req["params"], None,
+                    req["raw"])
+            wire = self._encode(status, payload)
+        except Exception as exc:  # noqa: BLE001 — bridge must answer
+            logger.exception("front-bridge execute failed")
+            import json as _json
+            wire = {"status": 500, "ctype": "json",
+                    "parts": [_json.dumps(
+                        {"error": {"type": type(exc).__name__,
+                                   "reason": str(exc)},
+                         "status": 500})],
+                    "columns": []}
+        out = pickle.dumps(wire, protocol=pickle.HIGHEST_PROTOCOL)
+        h.inflight.discard(slot)
+        with h.send_lock:
+            if h.dead:
+                return
+            try:
+                if h.arena.write(slot, out):
+                    h.conn.send(("resp", slot))
+                else:
+                    h.conn.send(("respx", slot, out))
+            except (OSError, BrokenPipeError):
+                pass  # front died mid-answer; exit path reclaims
+
+    @staticmethod
+    def _encode(status: int, payload: Any) -> Dict[str, Any]:
+        """Mirror node._Handler._do's payload shaping, but columnar:
+        hits blocks leave as splice columns for the front's C splicer."""
+        if isinstance(payload, dict) and "_cat" in payload \
+                and len(payload) == 1:
+            return {"status": status, "ctype": "text",
+                    "parts": [payload["_cat"]], "columns": []}
+        if isinstance(payload, str):
+            return {"status": status, "ctype": "text",
+                    "parts": [payload], "columns": []}
+        from elasticsearch_tpu.search.serializer import encode_wire_response
+        parts, columns = encode_wire_response(payload)
+        return {"status": status, "ctype": "json", "parts": parts,
+                "columns": columns}
+
+    # -- crash resilience ---------------------------------------------
+
+    def _on_front_exit(self, h: _FrontHandle) -> None:
+        with self._lock:
+            if self._closed or h.dead:
+                return
+            h.dead = True
+        reclaimed = len(h.inflight)
+        h.inflight.clear()
+        self.c_slots_reclaimed.inc(reclaimed)
+        self.c_front_deaths.inc()
+        logger.warning("serving front %s exited; reclaimed %d in-flight "
+                       "slot(s)", h.role, reclaimed)
+        try:
+            h.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if h.proc is not None:
+            h.proc.join(timeout=5.0)
+        if self.respawn_enabled:
+            self.ensure_front(h.index)
+
+    def ensure_front(self, index: int) -> None:
+        """Respawn front `index` if it is dead (same port, same arena —
+        the slot ring resets with the fresh process's free list)."""
+        h = self.fronts[index]
+        with self._lock:
+            if self._closed or not h.dead:
+                return
+        try:
+            self._spawn(h)
+            self.c_respawns.inc()
+        except Exception:  # noqa: BLE001 — the watch loop retries
+            logger.exception("respawn of front-%d failed", index)
+
+    def _watch_loop(self) -> None:
+        """Wedge detection: a front that is alive but has stopped
+        heartbeating gets killed into the normal EOF/reclaim path."""
+        while not self._closed:
+            time.sleep(1.0)
+            if self.wedge_timeout_s <= 0:
+                continue
+            now = time.time()
+            for h in self.fronts:
+                if h.dead or h.proc is None or not h.proc.is_alive():
+                    continue
+                snap = h.stats.read()
+                ts = (snap or {}).get("ts", 0)
+                if ts and now - ts > self.wedge_timeout_s:
+                    logger.warning("serving front %s wedged (last "
+                                   "heartbeat %.1fs ago); killing it",
+                                   h.role, now - ts)
+                    h.proc.kill()
+
+    # -- observability ------------------------------------------------
+
+    def metric_rows(self):
+        """Collector rows for the node registry: supervisor counters
+        plus every front's re-emitted registry snapshot, each row tagged
+        with its process role."""
+        alive = sum(1 for h in self.fronts
+                    if not h.dead and h.proc is not None
+                    and h.proc.is_alive())
+        yield ("serving.fronts", {}, alive, "gauge")
+        yield ("serving.front_processes", {}, len(self.fronts), "gauge")
+        yield ("serving.requests", {}, self.c_requests, "counter")
+        yield ("serving.plan_memo.hits", {}, self.c_memo_hits, "counter")
+        yield ("serving.plan_memo.misses", {}, self.c_memo_misses,
+               "counter")
+        yield ("serving.front_deaths", {}, self.c_front_deaths, "counter")
+        yield ("serving.front_respawns", {}, self.c_respawns, "counter")
+        yield ("serving.slots_reclaimed", {}, self.c_slots_reclaimed,
+               "counter")
+        for h in self.fronts:
+            snap = h.stats.read()
+            if not snap:
+                continue
+            for row in snap.get("metrics", []):
+                try:
+                    name, labels, value, kind = row
+                except (TypeError, ValueError):
+                    continue
+                labels = dict(labels or {})
+                labels["process"] = snap.get("role", h.role)
+                yield (name, labels, value, kind)
+
+    def front_folded(self) -> Dict[str, str]:
+        """role → folded profiler stacks, for the flamegraph merge."""
+        out: Dict[str, str] = {}
+        for h in self.fronts:
+            snap = h.stats.read()
+            if snap and snap.get("folded"):
+                out[snap.get("role", h.role)] = snap["folded"]
+        return out

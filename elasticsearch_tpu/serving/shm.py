@@ -1,0 +1,134 @@
+"""Shared-memory primitives for the multi-process serving front.
+
+Two single-purpose blocks per front process:
+
+``SlotArena`` — the request/response data plane. A SharedMemory segment
+split into fixed-size payload slots. Slot OWNERSHIP (who may write)
+transfers over the per-front pipe doorbell, never through shared state
+words: the sender writes ``[u32 length][payload]`` into a slot it owns,
+then sends the slot index down the pipe — the pipe syscall pair is the
+cross-process memory barrier, so the receiver always observes a fully
+written payload. A payload that outgrows the slot falls back to riding
+the pipe itself (slower, still correct), so slot sizing is a performance
+knob, not a correctness one.
+
+``StatsBlock`` — the observability side channel. A single-writer
+seqlock'd JSON snapshot (front publishes its metrics/heartbeat/folded
+profiler stacks; the batcher reads at scrape time). Writers bump the
+sequence word to odd, write, then publish even+length; a reader that
+sees an odd or changed sequence simply skips this scrape — staleness is
+fine, torn JSON is not.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from multiprocessing import shared_memory
+from typing import Any, Dict, Optional
+
+__all__ = ["SlotArena", "StatsBlock"]
+
+
+class SlotArena:
+    """Fixed-size payload slots in one SharedMemory segment."""
+
+    _LEN = struct.Struct("<I")
+
+    def __init__(self, name: Optional[str] = None, *, slots: int = 64,
+                 slot_bytes: int = 256 << 10, create: bool = False):
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        self._stride = self._LEN.size + self.slot_bytes
+        if create:
+            self.shm = shared_memory.SharedMemory(
+                create=True, size=self._stride * self.slots)
+        else:
+            self.shm = shared_memory.SharedMemory(name=name)
+
+    @property
+    def name(self) -> str:
+        return self.shm.name
+
+    def write(self, slot: int, data: bytes) -> bool:
+        """Write one payload into an owned slot; False when it doesn't
+        fit (the caller then ships the bytes over the pipe instead)."""
+        if len(data) > self.slot_bytes:
+            return False
+        off = slot * self._stride
+        self._LEN.pack_into(self.shm.buf, off, len(data))
+        self.shm.buf[off + 4: off + 4 + len(data)] = data
+        return True
+
+    def read(self, slot: int) -> bytes:
+        off = slot * self._stride
+        (length,) = self._LEN.unpack_from(self.shm.buf, off)
+        return bytes(self.shm.buf[off + 4: off + 4 + length])
+
+    def close(self) -> None:
+        try:
+            self.shm.close()
+        except OSError:
+            pass
+
+    def unlink(self) -> None:
+        try:
+            self.shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+class StatsBlock:
+    """Single-writer JSON snapshot with a seqlock header."""
+
+    _HDR = struct.Struct("<II")  # sequence, payload length
+
+    def __init__(self, name: Optional[str] = None, *, size: int = 512 << 10,
+                 create: bool = False):
+        if create:
+            self.shm = shared_memory.SharedMemory(create=True, size=size)
+            self._HDR.pack_into(self.shm.buf, 0, 0, 0)
+        else:
+            self.shm = shared_memory.SharedMemory(name=name)
+        self.capacity = self.shm.size - self._HDR.size
+
+    @property
+    def name(self) -> str:
+        return self.shm.name
+
+    def publish(self, obj: Dict[str, Any]) -> bool:
+        data = json.dumps(obj).encode("utf-8")
+        if len(data) > self.capacity:
+            return False
+        seq, _ = self._HDR.unpack_from(self.shm.buf, 0)
+        self._HDR.pack_into(self.shm.buf, 0, seq + 1, 0)  # odd: writing
+        off = self._HDR.size
+        self.shm.buf[off: off + len(data)] = data
+        self._HDR.pack_into(self.shm.buf, 0, seq + 2, len(data))
+        return True
+
+    def read(self) -> Optional[Dict[str, Any]]:
+        try:
+            seq1, length = self._HDR.unpack_from(self.shm.buf, 0)
+            if seq1 % 2 or not length or length > self.capacity:
+                return None
+            off = self._HDR.size
+            data = bytes(self.shm.buf[off: off + length])
+            seq2, _ = self._HDR.unpack_from(self.shm.buf, 0)
+            if seq2 != seq1:
+                return None  # torn — skip this scrape
+            return json.loads(data.decode("utf-8"))
+        except (ValueError, struct.error):
+            return None
+
+    def close(self) -> None:
+        try:
+            self.shm.close()
+        except OSError:
+            pass
+
+    def unlink(self) -> None:
+        try:
+            self.shm.unlink()
+        except FileNotFoundError:
+            pass

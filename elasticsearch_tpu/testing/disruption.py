@@ -342,3 +342,66 @@ def load_spike(node=None, **kwargs) -> Iterator[LoadSpike]:
         yield spike
     finally:
         spike.heal()
+
+
+class FrontKill(Scheme):
+    """Serving-front crash injection: SIGKILL front process `index` of
+    the node's FrontSupervisor and hold its respawn until healed, so
+    crash-resilience tests can assert the batcher's reclaim path (dead
+    front detected, in-flight shm slots reclaimed, siblings unaffected)
+    and then watch the heal-triggered respawn come back on the same
+    port. Like LoadSpike it never intercepts sends, so it composes with
+    network schemes in one disruption list."""
+
+    def __init__(self, node, index: int = 0):
+        self.node = node
+        self.index = index
+        self._started = False
+        self._lock = threading.Lock()
+        self.killed_pid: Optional[int] = None
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        sup = self.node.serving_front
+        if sup is None:
+            raise RuntimeError("FrontKill needs a node with serving "
+                               "fronts (start_serving_fronts first)")
+        # hold respawn so the window between kill and heal is observable
+        sup.respawn_enabled = False
+        handle = sup.fronts[self.index]
+        if handle.proc is not None and handle.proc.is_alive():
+            self.killed_pid = handle.proc.pid
+            handle.proc.kill()
+
+    def intercept(self, src, dst, action):
+        return None  # a process fault, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+            started = self._started
+        if not started:
+            return
+        sup = self.node.serving_front
+        if sup is None:
+            return
+        sup.respawn_enabled = True
+        sup.ensure_front(self.index)
+
+
+@contextlib.contextmanager
+def front_kill(node, index: int = 0) -> Iterator[FrontKill]:
+    """Context-managed FrontKill: the front dies on entry; on exit the
+    respawn hold lifts and the front is brought back (even when the
+    body's assertions fail)."""
+    scheme = FrontKill(node, index)
+    scheme.start()
+    try:
+        yield scheme
+    finally:
+        scheme.heal()

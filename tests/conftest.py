@@ -58,3 +58,57 @@ def tmp_data_path(tmp_path):
     p = tmp_path / "data"
     p.mkdir()
     return p
+
+
+# -- multiprocess test guard rails ------------------------------------
+#
+# Tests marked `multiprocess` spawn serving-front child processes. Two
+# failure modes would otherwise poison tier-1: a wedged child blocking
+# the parent forever (pipe recv with no timeout), and orphaned children
+# surviving a failed test to interfere with the next one. A SIGALRM
+# hard timeout bounds each marked test; orphan reaping happens at
+# MODULE teardown (after module-scoped node fixtures have closed their
+# supervisors — per-test reaping would kill fronts that legitimately
+# live across the tests of one module).
+
+MULTIPROCESS_TEST_TIMEOUT_S = int(
+    os.environ.get("ES_TPU_MULTIPROCESS_TEST_TIMEOUT_S", "120"))
+
+
+@pytest.fixture(autouse=True)
+def _multiprocess_timeout(request):
+    if request.node.get_closest_marker("multiprocess") is None:
+        yield
+        return
+    import signal
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"multiprocess test exceeded its "
+            f"{MULTIPROCESS_TEST_TIMEOUT_S}s hard timeout")
+
+    prior = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(MULTIPROCESS_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prior)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _multiprocess_orphan_reaper(request):
+    yield
+    mod_id = request.node.nodeid
+    marked = any(item.get_closest_marker("multiprocess") is not None
+                 for item in request.session.items
+                 if item.nodeid.startswith(mod_id))
+    if not marked:
+        return
+    import multiprocessing
+    for child in multiprocessing.active_children():
+        child.terminate()
+        child.join(timeout=5.0)
+        if child.is_alive():
+            child.kill()
+            child.join(timeout=5.0)

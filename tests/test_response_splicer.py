@@ -1,0 +1,193 @@
+"""Byte-identity of the C response splicer (native/response_splice.c)
+against the Python assembly path, across every response shape the
+serving front ships: metadata-only hits, stored-fields hits, partial
+`_shards` failures, multi-index merges, msearch nesting, and hostile
+ids. The Python `_py_splice` fallback must produce the same bytes as
+the native path, and both must equal plain json.dumps of the
+materialized hit dicts with compact separators."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.search import serializer
+from elasticsearch_tpu.search.serializer import (
+    SplicedHits, dumps_response, encode_wire_response,
+    hits_columns_from_dicts, splice_hits_bytes, splice_wire)
+
+EVIL_IDS = ['plain', 'has"quote', 'has,comma', 'has","both', 'back\\slash',
+            'unié中', 'tab\there', '{"j":1}', "'single'", '":","',
+            '[1,2]', 'curly}brace{']
+
+
+def _dumps_ref(hits):
+    return json.dumps(hits, separators=(",", ":"))
+
+
+def _meta_hits(ids, index="idx"):
+    return [{"_index": index, "_id": i, "_score": round(1.0 / (r + 1), 6)}
+            for r, i in enumerate(ids)]
+
+
+@pytest.fixture(params=["native", "python"])
+def splice_mode(request, monkeypatch):
+    """Run every parity case against both the native splicer and the
+    forced-Python fallback; skip the native leg if the .so won't build."""
+    if request.param == "python":
+        monkeypatch.setattr(serializer, "_SPLICE_FN", None)
+        monkeypatch.setattr(serializer, "_SPLICE_TRIED", True)
+    else:
+        monkeypatch.setattr(serializer, "_SPLICE_TRIED", False)
+        monkeypatch.delenv("ES_TPU_NO_NATIVE_SPLICE", raising=False)
+        if serializer._native_splice() is None:
+            pytest.skip("native splicer unavailable (no C toolchain)")
+    return request.param
+
+
+class TestSpliceParity:
+    def test_metadata_only_hits(self, splice_mode):
+        hits = _meta_hits(EVIL_IDS)
+        cols = hits_columns_from_dicts(hits)
+        assert cols is not None and cols.extras_json is None
+        assert splice_hits_bytes(cols) == _dumps_ref(hits)
+
+    def test_stored_fields_hits(self, splice_mode):
+        hits = []
+        for r, i in enumerate(EVIL_IDS):
+            hits.append({"_index": "idx", "_id": i, "_score": 0.5 * r,
+                         "_source": {"body": f"doc {i}", "rank": r,
+                                     "nested": {"a": [1, {"b": None}]}},
+                         "_version": r + 1,
+                         "_seq_no": r, "_primary_term": 1})
+        cols = hits_columns_from_dicts(hits)
+        assert cols is not None and cols.extras_json is not None
+        assert splice_hits_bytes(cols) == _dumps_ref(hits)
+
+    def test_mixed_extras_presence(self, splice_mode):
+        # some hits carry residual fields, some don't — the empty {}
+        # element must not emit a stray comma
+        hits = [{"_index": "idx", "_id": "a", "_score": 1.0},
+                {"_index": "idx", "_id": "b", "_score": 0.5,
+                 "_source": {"x": 1}},
+                {"_index": "idx", "_id": "c", "_score": None}]
+        cols = hits_columns_from_dicts(hits)
+        assert splice_hits_bytes(cols) == _dumps_ref(hits)
+
+    def test_multi_index_merge(self, splice_mode):
+        hits = []
+        for r in range(24):
+            hits.append({"_index": f"logs-{r % 3}", "_id": f"d{r}",
+                         "_score": 10.0 - r * 0.25})
+        cols = hits_columns_from_dicts(hits)
+        assert json.loads(cols.names_json) == ["logs-0", "logs-1", "logs-2"]
+        assert splice_hits_bytes(cols) == _dumps_ref(hits)
+
+    def test_null_scores_and_int_scores(self, splice_mode):
+        hits = [{"_index": "i", "_id": "a", "_score": None},
+                {"_index": "i", "_id": "b", "_score": 3},
+                {"_index": "i", "_id": "c", "_score": 0.1 + 0.2}]
+        cols = hits_columns_from_dicts(hits)
+        assert splice_hits_bytes(cols) == _dumps_ref(hits)
+
+    def test_empty_hits(self, splice_mode):
+        cols = hits_columns_from_dicts([])
+        assert splice_hits_bytes(cols) == "[]"
+
+    def test_large_block_forces_buffer_growth(self, splice_mode):
+        # ids much larger than the initial cap estimate would be only if
+        # the estimate were wrong — this guards the -1 retry path anyway
+        hits = _meta_hits([("x" * 200) + str(i) for i in range(500)])
+        cols = hits_columns_from_dicts(hits)
+        assert splice_hits_bytes(cols) == _dumps_ref(hits)
+
+    def test_non_canonical_key_order_declines(self, splice_mode):
+        hits = [{"_id": "a", "_index": "i", "_score": 1.0}]
+        assert hits_columns_from_dicts(hits) is None
+
+    def test_spliced_hits_wrapper(self, splice_mode):
+        hits = _meta_hits(EVIL_IDS, index="merged")
+        block = SplicedHits(hits)
+        assert block.to_json() == _dumps_ref(hits)
+        assert list(block) == hits and len(block) == len(hits)
+        # mutations flow through (what ccs does to _index)
+        block[0]["_index"] = "remote:merged"
+        assert json.loads(block.to_json())[0]["_index"] == "remote:merged"
+
+
+class TestWireEnvelope:
+    def _payload(self, hits, failed=0):
+        total = 3
+        shards = {"total": total, "successful": total - failed,
+                  "skipped": 0, "failed": failed}
+        if failed:
+            shards["failures"] = [{"shard": 0, "index": "idx",
+                                   "reason": {"type": "boom",
+                                              "reason": 'split "me"'}}]
+        return {"took": 7, "timed_out": False, "_shards": shards,
+                "hits": {"total": {"value": len(hits), "relation": "eq"},
+                         "max_score": 1.0,
+                         "hits": SplicedHits(list(hits))}}
+
+    def test_wire_round_trip_matches_dumps_response(self, splice_mode):
+        payload = self._payload(_meta_hits(EVIL_IDS))
+        parts, columns = encode_wire_response(payload)
+        assert len(parts) == len(columns) + 1 == 2
+        assert splice_wire(parts, columns) == dumps_response(payload)
+
+    def test_partial_shard_failures_envelope(self, splice_mode):
+        # the _shards failures section rides the envelope, not a column;
+        # placeholder splitting must not disturb it
+        payload = self._payload(_meta_hits(["a", "b"]), failed=1)
+        parts, columns = encode_wire_response(payload)
+        text = splice_wire(parts, columns)
+        assert text == dumps_response(payload)
+        parsed = json.loads(text)
+        assert parsed["_shards"]["failed"] == 1
+        assert parsed["_shards"]["failures"][0]["reason"]["reason"] \
+            == 'split "me"'
+
+    def test_msearch_nesting_multiple_blocks(self, splice_mode):
+        payload = {"took": 3, "responses": [
+            self._payload(_meta_hits(["a", "b"])),
+            self._payload([], failed=0),
+            self._payload(_meta_hits(EVIL_IDS, index="other")),
+        ]}
+        parts, columns = encode_wire_response(payload)
+        assert len(columns) == 3
+        assert splice_wire(parts, columns) == dumps_response(payload)
+
+    def test_payload_without_blocks_is_single_part(self, splice_mode):
+        payload = {"acknowledged": True}
+        parts, columns = encode_wire_response(payload)
+        assert columns == [] and json.loads(parts[0]) == payload
+
+    def test_non_columnable_block_renders_in_envelope(self, splice_mode):
+        # wrong leading key order → splice_columns() is None → the
+        # batcher renders it inline and the front still just joins parts
+        bad = SplicedHits([{"_id": "a", "_index": "i", "_score": 1.0}])
+        payload = {"hits": {"hits": bad}}
+        parts, columns = encode_wire_response(payload)
+        assert columns == []
+        assert json.loads(parts[0]) == {"hits": {"hits": [
+            {"_id": "a", "_index": "i", "_score": 1.0}]}}
+
+
+class TestNativePythonByteIdentity:
+    def test_native_equals_python_on_every_shape(self, monkeypatch):
+        monkeypatch.setattr(serializer, "_SPLICE_TRIED", False)
+        monkeypatch.delenv("ES_TPU_NO_NATIVE_SPLICE", raising=False)
+        if serializer._native_splice() is None:
+            pytest.skip("native splicer unavailable (no C toolchain)")
+        shapes = [
+            _meta_hits(EVIL_IDS),
+            _meta_hits([f"d{i}" for i in range(1000)]),
+            [{"_index": "a" * 100, "_id": '"', "_score": -0.0},
+             {"_index": "b", "_id": "", "_score": 1e-30}],
+            [{"_index": "i", "_id": "x", "_score": 2.5,
+              "_source": {"k": 'v,"w]'}, "_version": 9}],
+        ]
+        for hits in shapes:
+            cols = hits_columns_from_dicts(hits)
+            native = splice_hits_bytes(cols)
+            assert native == serializer._py_splice(cols)
+            assert native == _dumps_ref(hits)

@@ -1,0 +1,190 @@
+"""End-to-end tests for the multi-process serving front (ISSUE 7).
+
+Real spawned front processes + real HTTP over the front ports, asserting
+the front path is indistinguishable from in-process dispatch (modulo
+timing fields), the plan-signature memo engages, front metrics aggregate
+into the batcher's Prometheus scrape, and a SIGKILL'd front is detected,
+reclaimed, and respawned.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.testing.disruption import front_kill
+
+pytestmark = pytest.mark.multiprocess
+
+
+def _handle(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode("utf-8") if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+def _http(port, method, path, body=None, timeout=30.0):
+    """One HTTP request against a front port → (status, bytes)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        payload = None
+        headers = {}
+        if body is not None:
+            payload = body if isinstance(body, bytes) \
+                else json.dumps(body).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _wait(predicate, timeout=15.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(str(tmp_path_factory.mktemp("serving-front")),
+             settings=Settings.of({}))
+    for i, (t, y) in enumerate([("quick fox", 2001), ("lazy dog", 2005),
+                                ("quick dog", 2010), ("calm cat", 1999),
+                                ("quick cat", 2020)]):
+        _handle(n, "PUT", f"/lib/_doc/{i}", params={"refresh": "true"},
+                body={"title": t, "year": y})
+    ports = n.start_serving_fronts(count=2)
+    assert len(ports) == 2
+    yield n
+    n.close()
+
+
+QUERY = {"query": {"match": {"title": "quick"}}, "size": 3}
+
+
+def _strip_timing(raw: bytes) -> dict:
+    out = json.loads(raw)
+    out.pop("took", None)
+    return out
+
+
+class TestFrontParity:
+    def test_search_matches_in_process(self, node):
+        status, local = _handle(node, "POST", "/lib/_search", body=QUERY)
+        from elasticsearch_tpu.search.serializer import dumps_response
+        local_bytes = dumps_response(local).encode("utf-8")
+        for port in node.serving_front.ports:
+            st, raw = _http(port, "POST", "/lib/_search", body=QUERY)
+            assert st == 200, raw
+            assert _strip_timing(raw) == _strip_timing(local_bytes)
+            hits = json.loads(raw)["hits"]
+            assert hits["total"]["value"] == 3
+
+    def test_proxy_path_byte_identical(self, node):
+        # the root info payload has no timing fields — full byte parity
+        # through the proxy (non-search) front path
+        status, local = _handle(node, "GET", "/")
+        from elasticsearch_tpu.search.serializer import dumps_response
+        local_bytes = dumps_response(local).encode("utf-8")
+        st, raw = _http(node.serving_front.ports[0], "GET", "/")
+        assert st == 200
+        assert raw == local_bytes
+
+    def test_malformed_body_rejected_on_front(self, node):
+        st, raw = _http(node.serving_front.ports[0], "POST",
+                        "/lib/_search", body=b'{"query": {nope')
+        assert st == 400
+        err = json.loads(raw)
+        assert err["error"]["type"] == "parsing_exception"
+
+    def test_missing_endpoint_proxies_an_error(self, node):
+        # errors route through the proxy path exactly like in-process
+        status, local = _handle(node, "GET", "/_no_such_endpoint")
+        from elasticsearch_tpu.search.serializer import dumps_response
+        st, raw = _http(node.serving_front.ports[0], "GET",
+                        "/_no_such_endpoint")
+        assert st == status
+        assert raw == dumps_response(local).encode("utf-8")
+
+
+class TestPlanMemo:
+    def test_repeat_query_hits_memo(self, node):
+        sup = node.serving_front
+        base_hits = sup.c_memo_hits.count
+        body = {"query": {"match": {"title": "dog"}}, "size": 2}
+        port = sup.ports[0]
+        first = _http(port, "POST", "/lib/_search", body=body)
+        second = _http(port, "POST", "/lib/_search", body=body)
+        assert first[0] == second[0] == 200
+        assert _strip_timing(first[1]) == _strip_timing(second[1])
+        assert sup.c_memo_hits.count > base_hits
+
+    def test_memo_isolated_between_bodies(self, node):
+        port = node.serving_front.ports[0]
+        a = _http(port, "POST", "/lib/_search",
+                  body={"query": {"match": {"title": "cat"}}})
+        b = _http(port, "POST", "/lib/_search",
+                  body={"query": {"match": {"title": "fox"}}})
+        assert json.loads(a[1])["hits"]["total"]["value"] == 2
+        assert json.loads(b[1])["hits"]["total"]["value"] == 1
+
+
+class TestObservability:
+    def test_front_metrics_aggregate_into_scrape(self, node):
+        # drive one request so the front has non-zero counters, then
+        # wait for its stats block to publish
+        _http(node.serving_front.ports[0], "GET", "/")
+
+        def scraped():
+            _, text = _handle(node, "GET", "/_prometheus/metrics")
+            return 'process="front-0"' in text
+        assert _wait(scraped), "front rows never appeared in the scrape"
+        _, text = _handle(node, "GET", "/_prometheus/metrics")
+        assert "es_tpu_serving_front_requests_total" in text
+        assert 'process="front-1"' in text
+        assert "es_tpu_serving_fronts" in text
+
+    def test_supervisor_counters_present(self, node):
+        _, text = _handle(node, "GET", "/_prometheus/metrics")
+        assert "es_tpu_serving_plan_memo_hits_total" in text
+        assert "es_tpu_serving_requests_total" in text
+
+
+class TestFrontCrashResilience:
+    def test_kill_reclaim_respawn(self, node):
+        sup = node.serving_front
+        ports = sup.ports
+        deaths = sup.c_front_deaths.count
+        with front_kill(node, index=0) as scheme:
+            assert scheme.killed_pid is not None
+            # the batcher notices the EOF and marks the front dead
+            assert _wait(lambda: sup.fronts[0].dead
+                         or sup.c_front_deaths.count > deaths)
+            # the sibling front keeps serving while front-0 is down
+            st, raw = _http(ports[1], "POST", "/lib/_search", body=QUERY)
+            assert st == 200
+            assert json.loads(raw)["hits"]["total"]["value"] == 3
+            # respawn is held while the scheme is active
+            assert not sup.respawn_enabled
+        # heal lifts the hold: same port comes back and serves again
+        assert sup.respawn_enabled
+
+        def revived():
+            try:
+                st, _ = _http(ports[0], "GET", "/", timeout=2.0)
+                return st == 200
+            except OSError:
+                return False
+        assert _wait(revived, timeout=30.0), \
+            "killed front never respawned on its port"
+        assert sup.c_front_deaths.count > deaths
+        assert sup.c_respawns.count >= 1
