@@ -21,9 +21,11 @@ What runs:
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Env knobs: ES_TPU_BENCH_{DOCS,SHARDS,VOCAB,QUERIES,CLIENTS,K,SECONDS}.
 ES_TPU_BENCH_KERNEL_COMPARE=1 additionally reruns a short load phase once
-per device-kernel variant (packed single-key sort vs two-operand ref) and
-emits a "kernel_compare" block with per-variant device p50/p99 and
-device_ms_per_query (PERF.md round 8).
+per device-kernel variant (packed single-key sort vs two-operand ref vs
+compressed u16 resident streams) and emits a "kernel_compare" block with
+per-variant device p50/p99, device_ms_per_query, the resident pack's
+hbm_bytes_per_doc/compression_ratio, and the compressed phase's
+host-mirrored block-max skip rate (PERF.md rounds 8 and 11).
 
 Timing note: through the axon tunnel block_until_ready can return before
 remote execution finishes, but every REST response here materializes hit
@@ -295,52 +297,152 @@ def main() -> None:
             f"{split_sum:.1f}s ({out['profile']['split_vs_total']}) "
             f"parts={ {p: v['seconds'] for p, v in split.items()} }")
 
-    # ---- kernel-variant A/B (ES_TPU_BENCH_KERNEL_COMPARE=1): rerun a
+    # ---- kernel-variant A/B/C (ES_TPU_BENCH_KERNEL_COMPARE=1): rerun a
     # short load phase once per device-kernel variant (packed single-key
-    # sort vs two-operand ref, PERF.md round 8). Device time per variant
-    # comes from the variant-tagged stage rings — *_device_wait.packed
-    # only ever accumulates packed launches, so diffing (seconds, count)
-    # across the phase isolates each variant's device floor. ----
+    # sort vs two-operand ref vs compressed resident streams, PERF.md
+    # rounds 8/11). Device time per variant comes from the variant-tagged
+    # stage rings — *_device_wait.packed only ever accumulates packed
+    # launches, so diffing (seconds, count) across the phase isolates
+    # each variant's device floor. ----
     if _env("KERNEL_COMPARE", 0) == 1 and node.tpu_search is not None:
+        from elasticsearch_tpu.ops import sparse as _sparse
+        from elasticsearch_tpu.parallel import distributed as _dist
+
         tpu = node.tpu_search
+
+        def compressed_skip_rate(sample: int = 16, k_probe: int = 0):
+            """Host mirror of the kernel's block-max skip decision over a
+            sample of bench queries (the device-side mask isn't
+            observable from outside the jit): fraction of valid 128-lane
+            groups a totals-free launch at this k would eliminate."""
+            resident = tpu.packs._cache.get(("bench", "body"))
+            if resident is None or resident.comp_streams is None:
+                return None
+            streams = resident.comp_streams
+            qs = [corpus.query_text(qi).split()
+                  for qi in range(min(sample, len(corpus.queries)))]
+            batch = _dist.prepare_query_batch(resident.pack, qs,
+                                              compressed=streams)
+            kp = min(k_probe or k, batch.max_len)
+            blksz = _sparse.COMPRESSED_BLOCK
+            n_grp = (batch.max_len + blksz - 1) // blksz
+            skipped = valid_n = 0
+            for si in range(resident.pack.num_shards):
+                st, ln = batch.starts[si], batch.lengths[si]
+                w, sterm = batch.weights[si], batch.slot_terms[si]
+                code16, bmax = streams.flat_code16[si], streams.block_max[si]
+                blk = st // blksz
+                r, t = st.shape
+                bm = np.zeros((r, t, n_grp + 1), np.uint16)
+                for ri in range(r):
+                    for ti in range(t):
+                        s0 = min(int(blk[ri, ti]), bmax.size - (n_grp + 1))
+                        bm[ri, ti] = bmax[s0:s0 + n_grp + 1]
+                grp_code = np.maximum(bm[..., :-1],
+                                      bm[..., 1:]).astype(np.uint32)
+                ub = ((np.minimum(grp_code + 1, 0x7F80) << 16)
+                      .view(np.float32).reshape(grp_code.shape))
+                g_valid = ((np.arange(n_grp) * blksz)[None, None, :]
+                           < ln[:, :, None])
+                grp_ub = np.where(g_valid & (w[:, :, None] > 0),
+                                  w[:, :, None] * ub, 0.0)
+                slot_ub = grp_ub.max(axis=2)
+                eq = sterm[:, :, None] == sterm[:, None, :]
+                term_ub = np.where(eq, slot_ub[:, None, :], 0.0).max(axis=2)
+                tri = np.tril(np.ones((t, t), bool), k=-1)
+                first = ~np.any(eq & tri[None], axis=2)
+                others = (np.where(first, term_ub, 0.0)
+                          .sum(axis=1, keepdims=True) - term_ub)
+                thr = np.full(r, -np.inf, np.float32)
+                for ri in range(r):
+                    if int(batch.min_count[ri % batch.min_count.size]) > 1:
+                        continue
+                    for ti in range(t):
+                        n = int(ln[ri, ti])
+                        if n >= kp:
+                            s0 = int(st[ri, ti])
+                            q = w[ri, ti] * (
+                                (code16[s0:s0 + n].astype(np.uint32) << 16)
+                                .view(np.float32))
+                            thr[ri] = max(thr[ri],
+                                          np.partition(q, -kp)[-kp])
+                skip = (grp_ub + others[:, :, None]) < thr[:, None, None]
+                skipped += int((skip & g_valid).sum())
+                valid_n += int(g_valid.sum())
+            return round(skipped / valid_n, 4) if valid_n else 0.0
+
         original = tpu.kernel_packed_sort
+        original_comp = tpu.kernel_compressed_pack
         compare_s = max(2, seconds // 2)
         out["kernel_compare"] = {}
-        for label, enabled in (("packed", True), ("ref", False)):
-            tpu.set_kernel_packed_sort(enabled)
+        for label, packed_on, comp_on in (("packed", True, False),
+                                          ("ref", False, False),
+                                          ("compressed", True, True)):
+            tpu.set_kernel_packed_sort(packed_on)
+            if comp_on != tpu.kernel_compressed_pack:
+                # residency format is decided at BUILD time: flip the
+                # knob, then drop the pack so the phase's first search
+                # rebuilds it in the new format
+                tpu.set_kernel_compressed_pack(comp_on)
+                tpu.packs.invalidate("bench")
             before = tpu.stats().get("stages") or {}
             nq, pdt = load_phase(compare_s)
             after = tpu.stats().get("stages") or {}
             dev_s = 0.0
             stage_detail = {}
+            # compressed packs route every launch through the exact
+            # path, whose rings tag the per-launch variant — both the
+            # packable and the fallback-exact flavors belong to this
+            # phase's device time
+            suffixes = (("compressed", "compressed_exact") if comp_on
+                        else (label,))
             for base in ("batch_device_wait", "exact_device_wait",
                          "batch_dispatch", "exact_dispatch"):
-                name = f"{base}.{label}"
-                a, b = after.get(name), before.get(name)
-                if not a:
-                    continue
-                secs = a["seconds"] - (b["seconds"] if b else 0.0)
-                cnt = a["count"] - (b["count"] if b else 0)
-                if cnt <= 0:
-                    continue
-                if base.endswith("_device_wait"):
-                    dev_s += secs
-                entry = {"count": cnt,
-                         "ms_per_call": round(1000.0 * secs / cnt, 4)}
-                for pk in ("p50_ms", "p99_ms"):
-                    if pk in a:
-                        entry[pk] = a[pk]
-                stage_detail[name] = entry
+                for suffix in suffixes:
+                    name = f"{base}.{suffix}"
+                    a, b = after.get(name), before.get(name)
+                    if not a:
+                        continue
+                    secs = a["seconds"] - (b["seconds"] if b else 0.0)
+                    cnt = a["count"] - (b["count"] if b else 0)
+                    if cnt <= 0:
+                        continue
+                    if base.endswith("_device_wait"):
+                        dev_s += secs
+                    entry = {"count": cnt,
+                             "ms_per_call": round(1000.0 * secs / cnt, 4)}
+                    for pk in ("p50_ms", "p99_ms"):
+                        if pk in a:
+                            entry[pk] = a[pk]
+                    stage_detail[name] = entry
             dev_ms_q = round(1000.0 * dev_s / max(1, nq), 4)
-            out["kernel_compare"][label] = {
+            phase = {
                 "qps": round(nq / pdt, 2),
                 "queries": nq,
                 "device_ms_per_query": dev_ms_q,
                 "stages": stage_detail,
             }
+            det = (tpu.stats().get("pack_cache", {})
+                   .get("packs", {}).get("bench/body"))
+            if det:
+                phase["pack"] = {pk: det[pk] for pk in (
+                    "compressed", "hbm_bytes", "raw_bytes",
+                    "compression_ratio", "hbm_bytes_per_doc") if pk in det}
+            if comp_on:
+                phase["block_skip_rate"] = compressed_skip_rate()
+                # the deep-pruning regime: top-10 raises the threshold
+                # far above most blocks' maxima on long skewed postings
+                phase["block_skip_rate_k10"] = compressed_skip_rate(
+                    k_probe=10)
+            out["kernel_compare"][label] = phase
             log(f"kernel_compare[{label}]: {nq} queries in {pdt:.1f}s, "
-                f"device {dev_ms_q} ms/query")
+                f"device {dev_ms_q} ms/query"
+                + (f", skip_rate {phase.get('block_skip_rate')}"
+                   if comp_on else ""))
         tpu.set_kernel_packed_sort(original)
+        if tpu.kernel_compressed_pack != original_comp:
+            tpu.set_kernel_compressed_pack(original_comp)
+            tpu.packs.invalidate("bench")
 
     # ---- true end-to-end REST QPS over real HTTP sockets: the
     # single-process server vs the multi-process serving front (ISSUE

@@ -116,7 +116,14 @@ class Node:
                 # per-launch exact-f32 fallback when the pack/batch
                 # overflows the packed layout
                 packed_sort=self.settings.get_bool(
-                    "search.tpu_serving.kernel.packed_sort", True))
+                    "search.tpu_serving.kernel.packed_sort", True),
+                # compressed resident packs (PERF.md round 11): 16-bit
+                # impact/doc/rank streams + residual tables + block-max
+                # metadata; ~2.7x fewer HBM bytes/doc at identical
+                # result bits. Off by default until a TPU round burns it
+                # in; incompressible packs fall back to raw residency
+                compressed_pack=self.settings.get_bool(
+                    "search.tpu_serving.kernel.compressed_pack", False))
         from elasticsearch_tpu.common.threadpool import ThreadPools
         self.thread_pools = ThreadPools(self.settings)
         # overload protection: memory-accounted write admission shared
@@ -350,6 +357,12 @@ class Node:
                      "Transport sends retried after a retryable failure")
         reg.set_help("kernel.variant",
                      "Device-kernel launches by (kernel, variant)")
+        reg.set_help("pack.hbm_bytes",
+                     "Resident-pack HBM bytes by (index, field, "
+                     "component)")
+        reg.set_help("pack.compression_ratio",
+                     "Resident bytes / uncompressed-format bytes per "
+                     "(index, field) pack")
 
         def _threadpools():
             for name, pool in self.thread_pools.pools.items():
@@ -404,6 +417,22 @@ class Node:
             for key in ("hits", "misses", "stale_served"):
                 yield (f"search.pack_cache.{key}", nl, packs[key],
                        "counter")
+            # per-(index,field) resident-pack HBM breakdown: the
+            # compressed-pack capacity win, scrapeable. `component`
+            # splits the charge (resident = what the breaker holds,
+            # raw = the uncompressed-format equivalent, block_meta /
+            # residual = the pruning + exact-decode overheads).
+            for pk, det in packs.get("packs", {}).items():
+                index, _, field = pk.partition("/")
+                lb = {"index": index, "field": field}
+                for comp, key in (("resident", "hbm_bytes"),
+                                  ("raw", "raw_bytes"),
+                                  ("block_meta", "block_meta_bytes"),
+                                  ("residual", "residual_bytes")):
+                    yield ("pack.hbm_bytes", {**lb, "component": comp},
+                           det.get(key, 0), "gauge")
+                yield ("pack.compression_ratio", lb,
+                       det.get("compression_ratio", 1.0), "gauge")
             with svc._prewarm_lock:
                 warm = dict(svc._prewarm_progress)
             yield ("search.tpu.prewarm_total", nl, warm["total"], "gauge")
@@ -419,6 +448,8 @@ class Node:
                 KERNEL_CONFIG, KERNEL_VARIANT_COUNTS)
             yield ("search.tpu.kernel_packed_sort", nl,
                    1 if KERNEL_CONFIG["packed_sort"] else 0, "gauge")
+            yield ("search.tpu.kernel_compressed_pack", nl,
+                   1 if KERNEL_CONFIG["compressed_pack"] else 0, "gauge")
             # per-(kernel, variant) launch counts:
             # es_tpu_kernel_variant_total{kernel=...,variant=...}
             for labels, counter in KERNEL_VARIANT_COUNTS.items():

@@ -40,6 +40,30 @@ variant's exact order, so returned scores, doc ids, tie-breaks and totals
 are bit-identical to variant="ref". Requires packable() inputs (doc ids
 < 2**16, sane non-negative weights); the serving stack checks that at
 lowering time and falls back to "ref" otherwise.
+
+Compressed-pack variants (variant="compressed"/"compressed_exact", PR 8):
+the RESIDENT arrays themselves are quantized — three u16 streams
+(compress_flat): doc ids, monotone VALUE codes (impact_code16 of each
+impact — collisions between near-equal impacts are fine, the codes only
+feed lower bounds), and per-term RANK codes (1-based index of the
+posting's impact in its term's ascending distinct-impact table — 0 marks
+tombstone-zeroed postings). 6 bytes/posting replaces the 16 bytes of the
+doc-sorted (int32, f32) pair plus the impact-sorted copy. The exact-f32
+rescore survives the f32 arrays' removal by reading each term's small
+RESIDUAL TABLE (its sorted distinct positive impacts): the rank found at
+a candidate's posting position indexes the bit-exact f32 impact directly.
+"compressed" runs the packed single-key pipeline on the decoded
+lower-bound value codes and rescores through the residual tables;
+"compressed_exact" decodes every lane to exact f32 first and runs the
+reference pipeline — the automatic fallback when the batch weights break
+the monotone-lower-bound guarantee (packable()), exact for ANY weights.
+Alongside the streams, per-128-lane BLOCK MAX codes (block-max WAND /
+BM25S eager elimination) let the "compressed" kernel carry a running
+top-k threshold: a 128-lane group whose maximum possible weighted
+contribution (its block-max upper bound plus every other slot's window
+upper bound) cannot reach the k-th best lower bound already achieved is
+masked out before the sort. Skipping is gated to runs that don't return
+totals (a skipped doc is still a match) and rows with min_count ≤ 1.
 """
 
 from __future__ import annotations
@@ -65,7 +89,20 @@ PACKED_DOC_LIMIT = 1 << 16
 PACKED_WEIGHT_MIN = 1e-12
 PACKED_WEIGHT_MAX = 1e30
 
-KERNEL_VARIANTS = ("ref", "packed")
+KERNEL_VARIANTS = ("ref", "packed", "compressed", "compressed_exact")
+
+#: variants that read the compressed resident streams (16-bit doc ids +
+#: 16-bit impact codes + residual tables) instead of the raw pair
+COMPRESSED_VARIANTS = ("compressed", "compressed_exact")
+
+#: block-max metadata granularity: one max-impact code per this many
+#: postings lanes (the TPU lane width — a group of lanes the sort would
+#: load together anyway, and the future Pallas fused merge's tile unit)
+COMPRESSED_BLOCK = 128
+
+#: per-term rank codes are u16 with 0 reserved for "no impact", so a
+#: term may have at most this many distinct positive impact values
+COMPRESSED_RANK_LIMIT = (1 << 16) - 1
 
 
 def impact_code16(x: jax.Array) -> jax.Array:
@@ -81,6 +118,124 @@ def decode_code16(code: jax.Array) -> jax.Array:
     code equals `code` rounds down to this value (zero low bits)."""
     return jax.lax.bitcast_convert_type(
         (code << 16).astype(jnp.uint32), jnp.float32)
+
+
+def impact_code16_np(x: np.ndarray) -> np.ndarray:
+    """Host-side impact_code16: uint16 codes of non-negative f32s."""
+    flat = np.ascontiguousarray(x, dtype=np.float32)
+    return (flat.view(np.uint32) >> 16).astype(np.uint16)
+
+
+def decode_code16_np(code: np.ndarray) -> np.ndarray:
+    """Host-side decode_code16: lower-bound f32 of each uint16 code."""
+    return (np.asarray(code).astype(np.uint32) << 16).view(np.float32)
+
+
+def _posting_terms(row_starts: np.ndarray, n: int) -> np.ndarray:
+    """Term id per flat posting position. Positions past the last row
+    (the CHUNK_CAP slack tail) get the one-past-the-end id — they carry
+    impact 0 and never produce residual entries."""
+    rs = np.asarray(row_starts, dtype=np.int64)
+    counts = np.diff(rs)
+    terms = np.repeat(np.arange(counts.size, dtype=np.int64), counts)
+    if terms.size < n:
+        terms = np.concatenate(
+            [terms, np.full(n - terms.size, counts.size, dtype=np.int64)])
+    return terms[:n]
+
+
+def compress_reason(flat_docs: np.ndarray, flat_impact: np.ndarray,
+                    row_starts: np.ndarray, d_pad: int) -> Optional[str]:
+    """Why this shard's flats can NOT take the compressed resident
+    format — None means compressible. The gates guarantee the u16
+    streams lose nothing the kernel needs: doc ids (and the d_pad
+    sentinel) must fit 16 bits, every positive impact needs a nonzero
+    16-bit VALUE code (else it would vanish from quantized run totals),
+    and no term may exceed the 16-bit RANK space of distinct positive
+    impacts (else the exact-decode rank stream would overflow)."""
+    if d_pad >= PACKED_DOC_LIMIT:
+        return (f"d_pad {d_pad} does not fit the 16-bit doc stream "
+                f"(limit {PACKED_DOC_LIMIT})")
+    imp = np.asarray(flat_impact, dtype=np.float32)
+    if imp.size == 0:
+        return None
+    if not np.isfinite(imp).all() or bool((imp < 0).any()):
+        return "impacts must be finite and non-negative"
+    codes = impact_code16_np(imp)
+    pos = imp > 0
+    if bool((codes[pos] == 0).any()):
+        return "positive impact below the 16-bit code floor"
+    terms = _posting_terms(row_starts, imp.size)
+    t_p, v_p = terms[pos], imp[pos]
+    if t_p.size:
+        order = np.lexsort((v_p, t_p))
+        t_s, v_s = t_p[order], v_p[order]
+        first = np.ones(t_s.size, dtype=bool)
+        first[1:] = (t_s[1:] != t_s[:-1]) | (v_s[1:] != v_s[:-1])
+        per_term = np.bincount(t_s[first])
+        if per_term.size and int(per_term.max()) > COMPRESSED_RANK_LIMIT:
+            return (f"a term has more than {COMPRESSED_RANK_LIMIT} "
+                    f"distinct impacts (rank code overflow)")
+    return None
+
+
+def compress_flat(flat_docs: np.ndarray, flat_impact: np.ndarray,
+                  row_starts: np.ndarray, d_pad: int,
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                             np.ndarray, np.ndarray, np.ndarray]:
+    """Build one shard's compressed resident streams from its doc-sorted
+    flats. → (docs16 u16[P], code16 u16[P], rank16 u16[P],
+    block_max u16[NB+1], res_vals f32[RC], res_row_starts i64[n_rows+1]).
+
+    docs16/code16/rank16 replace the 16 resident bytes per posting with
+    6: code16 is the monotone VALUE code (lower bounds for the quantized
+    sort and block-max pruning; collisions between near-equal impacts
+    are harmless there), rank16 is the 1-based index of the posting's
+    impact in its term's ascending distinct-impact residual table (0 =
+    tombstone-zeroed posting) — injective by construction, so the exact
+    rescore recovers bit-exact f32 impacts from res_vals without a
+    resident f32 copy. block_max[j] is the max value code of the
+    128-lane-aligned block j, plus ONE zero slack entry so a slot
+    straddling the array edge can always slice n_grp+1 entries without
+    dynamic_slice clamping into earlier (wrong) blocks. Raises
+    ValueError when compress_reason() is non-None; callers gate first."""
+    reason = compress_reason(flat_docs, flat_impact, row_starts, d_pad)
+    if reason is not None:
+        raise ValueError(f"flats not compressible: {reason}")
+    docs = np.asarray(flat_docs)
+    imp = np.asarray(flat_impact, dtype=np.float32)
+    n = imp.size
+    docs16 = np.minimum(docs, d_pad).astype(np.uint16)
+    code16 = impact_code16_np(imp)
+
+    nb = (n + COMPRESSED_BLOCK - 1) // COMPRESSED_BLOCK
+    padded = np.zeros(nb * COMPRESSED_BLOCK, dtype=np.uint16)
+    padded[:n] = code16
+    block_max = np.concatenate(
+        [padded.reshape(nb, COMPRESSED_BLOCK).max(axis=1),
+         np.zeros(1, dtype=np.uint16)])
+
+    terms = _posting_terms(row_starts, n)
+    n_rows = np.asarray(row_starts).size - 1
+    pos = imp > 0
+    t_p, v_p = terms[pos], imp[pos]
+    order = np.lexsort((v_p, t_p))
+    t_s, v_s = t_p[order], v_p[order]
+    first = np.ones(t_s.size, dtype=bool)
+    if t_s.size:
+        first[1:] = (t_s[1:] != t_s[:-1]) | (v_s[1:] != v_s[:-1])
+    res_row_starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(np.bincount(t_s[first], minlength=n_rows),
+              out=res_row_starts[1:])
+    rank16 = np.zeros(n, dtype=np.uint16)
+    if t_s.size:
+        distinct_idx = np.cumsum(first) - 1
+        rank_sorted = distinct_idx - res_row_starts[t_s] + 1
+        rank_pos = np.empty(t_s.size, dtype=np.int64)
+        rank_pos[order] = rank_sorted
+        rank16[pos] = rank_pos.astype(np.uint16)
+    return (docs16, code16, rank16, block_max,
+            v_s[first].astype(np.float32), res_row_starts)
 
 
 def packable(d_pad: int, weights: Optional[np.ndarray] = None) -> bool:
@@ -143,6 +298,18 @@ def hierarchical_top_k(score: jax.Array, k: int, block: int = 4096,
     return vals, jnp.take_along_axis(p, pos2, axis=1)
 
 
+def _rank_decode(ranks: jax.Array, r_start: jax.Array, r_len: jax.Array,
+                 res_vals: jax.Array) -> jax.Array:
+    """Exact f32 impact of each posting from its per-term rank code:
+    rank r ≥ 1 indexes the term's ascending residual value table at
+    r_start + r − 1; rank 0 (padding or a tombstone-zeroed posting)
+    decodes to 0.0. ranks/r_start/r_len broadcast together (int32)."""
+    ok = (ranks > 0) & (ranks <= r_len)
+    at = r_start + jnp.maximum(ranks, 1) - 1
+    vals = jnp.take(res_vals, at, mode="fill", fill_value=0.0)
+    return jnp.where(ok, vals, 0.0)
+
+
 def segmented_run_sum(sk: jax.Array, sv: jax.Array,
                       t_window: int) -> jax.Array:
     """Inclusive per-run prefix sums over a key-sorted [R, L] pair via
@@ -167,8 +334,8 @@ def segmented_run_sum(sk: jax.Array, sv: jax.Array,
                                    "with_counts", "with_totals",
                                    "variant"))
 def sorted_merge_topk(
-    flat_docs: jax.Array,    # int32[P_flat] postings doc ids (pad = d_pad)
-    flat_impact: jax.Array,  # f32[P_flat] eager BM25 impacts
+    flat_docs: jax.Array,    # int32[P_flat] doc ids (u16 when compressed)
+    flat_impact: jax.Array,  # f32[P_flat] impacts (u16 codes when compressed)
     starts: jax.Array,       # int32[R, T] absolute offsets into flat arrays
     lengths: jax.Array,      # int32[R, T] chunk lengths (0 = empty slot)
     weights: jax.Array,      # f32[R, T] idf·(k1+1)·boost per slot
@@ -180,7 +347,14 @@ def sorted_merge_topk(
     t_window: int,           # static: T (slot count = max same-doc entries)
     with_counts: bool,       # static: evaluate min_count (msm/AND)
     with_totals: bool = False,  # static: also return matched-doc counts
-    variant: str = "ref",    # static: "ref" | "packed" (see module doc)
+    variant: str = "ref",    # static: one of KERNEL_VARIANTS (module doc)
+    flat_rank: Optional[jax.Array] = None,   # u16[P_flat] per-term ranks
+    res_starts: Optional[jax.Array] = None,  # int32[R,T] residual offsets
+    res_lens: Optional[jax.Array] = None,    # int32[R,T] residual lengths
+    res_vals: Optional[jax.Array] = None,    # f32[RC] residual exact f32s
+    block_max: Optional[jax.Array] = None,   # u16[NB+1] per-block max codes
+    blk_starts: Optional[jax.Array] = None,  # int32[R,T] slot block indices
+    slot_terms: Optional[jax.Array] = None,  # int32[R,T] term group id/slot
 ) -> Tuple[jax.Array, ...]:
     """→ (scores f32[R, k'], doc_ids int32[R, k'][, totals int32[R]]);
     empty lanes are (-inf, d_pad). k' = min(k, T·L_c). totals (when
@@ -188,14 +362,25 @@ def sorted_merge_topk(
     TotalHits value of the reference's query phase. variant="packed"
     computes the same outputs bit-for-bit via the single-key sort +
     hierarchical top-k + exact rescore pipeline; callers must have
-    checked packable() host-side."""
+    checked packable() host-side. The compressed variants read u16
+    doc/code streams plus residual tables (res_* operands required) and
+    are also bit-identical to "ref" on the same postings; "compressed"
+    additionally needs packable() weights, "compressed_exact" does not.
+    block_max/blk_starts enable the block-max skip (compressed only;
+    inert when with_totals or k > max_len)."""
     if variant not in KERNEL_VARIANTS:
         raise ValueError(f"unknown kernel variant {variant!r}")
     packed = variant == "packed"
-    if packed and d_pad >= PACKED_DOC_LIMIT:
+    compressed = variant in COMPRESSED_VARIANTS
+    if (packed or compressed) and d_pad >= PACKED_DOC_LIMIT:
         raise ValueError(
-            f"packed variant needs d_pad < {PACKED_DOC_LIMIT}, got "
+            f"variant {variant!r} needs d_pad < {PACKED_DOC_LIMIT}, got "
             f"{d_pad} — caller must fall back to variant='ref'")
+    if compressed and (flat_rank is None or res_starts is None
+                       or res_lens is None or res_vals is None):
+        raise ValueError(
+            "compressed variants need flat_rank/res_starts/res_lens/"
+            "res_vals — build them with compress_flat()")
     r, t_slots = starts.shape
     idx = jnp.arange(max_len, dtype=jnp.int32)
 
@@ -205,12 +390,92 @@ def sorted_merge_topk(
 
     docs, imps = jax.vmap(jax.vmap(slice_one))(starts)     # [R, T, L]
     valid = idx[None, None, :] < lengths[:, :, None]
-    docs = jnp.where(valid, docs, d_pad)
-    imp = jnp.where(valid, weights[:, :, None] * imps, 0.0)
+    if compressed:
+        docs = jnp.where(valid, docs.astype(jnp.int32), d_pad)
+        codes = jnp.where(valid, imps.astype(jnp.uint32), 0)
+        if variant == "compressed_exact":
+            # decode every lane to its exact f32 through the residual
+            # tables, then run the reference pipeline verbatim — exact
+            # for ANY weights (the automatic fallback variant)
+            def slice_rank(s):
+                return jax.lax.dynamic_slice(flat_rank, (s,), (max_len,))
+
+            ranks = jax.vmap(jax.vmap(slice_rank))(starts).astype(jnp.int32)
+            ranks = jnp.where(valid, ranks, 0)
+            lane_exact = _rank_decode(ranks, res_starts[:, :, None],
+                                      res_lens[:, :, None], res_vals)
+            imp = jnp.where(valid, weights[:, :, None] * lane_exact, 0.0)
+        else:
+            # lower-bound lane contributions from the decoded codes —
+            # the packed pipeline's quantized values, without ever
+            # materialising an f32 impact array in HBM
+            imp = jnp.where(
+                valid, weights[:, :, None] * decode_code16(codes), 0.0)
+    else:
+        docs = jnp.where(valid, docs, d_pad)
+        imp = jnp.where(valid, weights[:, :, None] * imps, 0.0)
 
     length = t_slots * max_len
     kk = min(k, length)
-    if packed:
+
+    do_skip = (variant == "compressed" and not with_totals
+               and block_max is not None and blk_starts is not None
+               and k <= max_len)
+    if do_skip:
+        # Block-max skip (device-side BMW/MaxScore). Threshold: within a
+        # slot, lanes are DISTINCT docs, so a slot's k-th largest lane
+        # value is a lower bound on the k-th best full score (each such
+        # doc's full score ≥ its lane; all contributions non-negative,
+        # and with min_count ≤ 1 every such doc is a real result).
+        # Upper bound per 128-lane group: an unaligned group spans ≤ 2
+        # aligned blocks, so max of two adjacent block codes; +1 on the
+        # code is an open upper bound of any impact in the block. A group
+        # is skipped only when its bound PLUS every other slot's window
+        # bound stays strictly below the threshold — any doc with full
+        # score ≥ thr therefore keeps all its lanes, and partially
+        # skipped docs score strictly below thr even after rescore, so
+        # results stay bit-identical (see module doc).
+        n_grp = (max_len + COMPRESSED_BLOCK - 1) // COMPRESSED_BLOCK
+
+        def bm_slice(bs):
+            return jax.lax.dynamic_slice(block_max, (bs,), (n_grp + 1,))
+
+        bm = jax.vmap(jax.vmap(bm_slice))(blk_starts)       # [R,T,G+1]
+        grp_code = jnp.maximum(bm[..., :-1], bm[..., 1:]).astype(jnp.uint32)
+        # clamp keeps the +1 from wrapping past the f32 space: anything
+        # at/above the max finite code decodes to +inf (never skipped)
+        ub = decode_code16(jnp.minimum(grp_code + 1, jnp.uint32(0x7F80)))
+        g_base = (jnp.arange(n_grp, dtype=jnp.int32)
+                  * COMPRESSED_BLOCK)[None, None, :]
+        g_valid = g_base < lengths[:, :, None]
+        w3 = weights[:, :, None]
+        grp_ub = jnp.where(g_valid & (w3 > 0), w3 * ub, 0.0)
+        slot_ub = jnp.max(grp_ub, axis=2)                    # [R,T]
+        if slot_terms is not None:
+            # a doc appears in at most ONE chunk of a term, so the
+            # other-slots bound groups chunks by term: max over a
+            # term's slots, sum over DISTINCT terms (MaxScore, not the
+            # hopeless sum-over-all-slots on chunked rows)
+            eq = slot_terms[:, :, None] == slot_terms[:, None, :]
+            term_ub = jnp.max(
+                jnp.where(eq, slot_ub[:, None, :], 0.0), axis=2)
+            tri = jnp.tril(jnp.ones((t_slots, t_slots), bool), k=-1)
+            first = ~jnp.any(eq & tri[None], axis=2)
+            others = (jnp.sum(jnp.where(first, term_ub, 0.0),
+                              axis=1, keepdims=True) - term_ub)
+        else:
+            others = jnp.sum(slot_ub, axis=1, keepdims=True) - slot_ub
+        kth = jax.lax.top_k(imp, kk)[0][..., kk - 1]         # [R,T]
+        enough = lengths >= kk
+        thr = jnp.max(jnp.where(enough, kth, NEG_INF), axis=1)  # [R]
+        if with_counts:
+            thr = jnp.where(min_count <= 1, thr, NEG_INF)
+        skip_grp = (grp_ub + others[:, :, None]) < thr[:, None, None]
+        lane_skip = skip_grp[:, :, idx // COMPRESSED_BLOCK]
+        docs = jnp.where(lane_skip, d_pad, docs)
+        imp = jnp.where(lane_skip, 0.0, imp)
+
+    if packed or variant == "compressed":
         # ONE uint32 sort key per lane: doc id high, impact code low —
         # half the sorted bytes of the (docs, imp) pair. Equal-doc lanes
         # stay contiguous (doc owns the high bits); padded lanes carry
@@ -233,7 +498,7 @@ def sorted_merge_topk(
     ok = run_end & (sk < d_pad) & (total > 0)
 
     cnt = None
-    if with_counts or packed:
+    if with_counts or packed or variant == "compressed":
         # clause count per doc = run length (each slot holds a doc at most
         # once: postings rows have unique docs, chunks of one term
         # partition its row). Runs are ≤ t_window long by the same
@@ -250,11 +515,14 @@ def sorted_merge_topk(
     totals = jnp.sum(ok, axis=1, dtype=jnp.int32) if with_totals else None
 
     score = jnp.where(ok, total, NEG_INF)
-    if packed:
+    if packed or variant == "compressed":
+        res = None
+        if variant == "compressed":
+            res = (res_starts, res_lens, res_vals, flat_rank)
         vals, hit_docs = _packed_rescore_topk(
             flat_docs, flat_impact, starts, lengths, weights,
             sk, score, cnt, kk, max_len=max_len, d_pad=d_pad,
-            t_window=t_window)
+            t_window=t_window, res=res)
     else:
         vals, pos = jax.lax.top_k(score, kk)
         hit_docs = jnp.take_along_axis(sk, pos, axis=1)
@@ -266,15 +534,24 @@ def sorted_merge_topk(
 
 def _packed_rescore_topk(flat_docs, flat_impact, starts, lengths, weights,
                          sk, score, cnt, kk, *, max_len: int, d_pad: int,
-                         t_window: int):
+                         t_window: int, res=None):
     """Candidate selection + exact-f32 rescore for the packed variant.
+    With res=(res_starts, res_lens, res_vals, flat_rank) the streams are
+    the compressed u16 doc/code pair and each matched position's exact
+    f32 comes from its rank code into the term's residual value table
+    instead of from a resident f32 array.
 
     Selection: hierarchical top-k over the QUANTIZED run totals, with
-    slack — every code is a lower bound within 2**-8 relative of its
-    lane, so any true top-kk doc ranks above quantized-rank kk + m
+    slack — a packed code is a lower bound within 2**-8 relative of
+    its lane, so any true top-kk doc ranks above quantized-rank kk + m
     unless m+1 other docs land inside that relative band of the
-    boundary; the slack makes the sweep-tested shapes exact in practice
-    while the width stays a small multiple of kk instead of T*L_c.
+    boundary. The compressed streams quantize TWICE (posting -> stored
+    code at build, then w*decode(code) -> key code at sort), doubling
+    the band to ~2**-7 and with it the number of docs a dense uniform
+    term can pack against the boundary (~df/128 vs ~df/256), so their
+    slack is doubled too. The slack makes the sweep-tested shapes
+    exact in practice while the width stays a small multiple of kk
+    instead of T*L_c.
 
     Rescore: each candidate's exact contribution per slot comes from a
     lower_bound binary search in that slot's doc-sorted chunk, then the
@@ -285,7 +562,8 @@ def _packed_rescore_topk(flat_docs, flat_impact, starts, lengths, weights,
     returned scores equal variant="ref" exactly, not just closely."""
     r, t_slots = starts.shape
     length = sk.shape[1]
-    kc = min(length, kk + max(kk, 64))
+    slack = max(2 * kk, 256) if res is not None else max(2 * kk, 128)
+    kc = min(length, kk + slack)
     a_vals, a_pos = hierarchical_top_k(score, kc)
     cand_docs = jnp.take_along_axis(sk, a_pos, axis=1)           # [R, kc]
     cand_cnt = jnp.take_along_axis(cnt, a_pos, axis=1).astype(jnp.int32)
@@ -306,7 +584,14 @@ def _packed_rescore_topk(flat_docs, flat_impact, starts, lengths, weights,
         hi = jnp.where(active & ~go, mid, hi)
     v = jnp.take(flat_docs, lo, mode="fill", fill_value=d_pad)
     found = (ln3 > 0) & (lo < end) & (v == target) & (target < d_pad)
-    imp_exact = jnp.take(flat_impact, lo, mode="fill", fill_value=0.0)
+    if res is None:
+        imp_exact = jnp.take(flat_impact, lo, mode="fill", fill_value=0.0)
+    else:
+        res_st, res_ln, r_vals, f_rank = res
+        rank_at = jnp.take(f_rank, lo, mode="fill",
+                           fill_value=0).astype(jnp.int32)
+        imp_exact = _rank_decode(rank_at, res_st[:, None, :],
+                                 res_ln[:, None, :], r_vals)
     contrib = jnp.where(found, weights[:, None, :] * imp_exact, 0.0)
 
     # compact matched slots to the front (stable ⇒ slot order preserved:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 from functools import lru_cache, partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -44,6 +45,15 @@ from elasticsearch_tpu.parallel.mesh import (DATA_AXIS, SHARD_AXIS,
                                              shard_map)
 
 NEG_INF = float("-inf")
+# One SPMD program enqueued on the shared device set at a time.
+# shard_map programs carry cross-device collectives; when two threads
+# (two services' batchers, or a batcher racing an abandoned wedged
+# launch) dispatch concurrently, the per-device rendezvous can
+# interleave in inconsistent order and wedge BOTH programs forever.
+# Dispatch is async and cheap — execution is serialized by the
+# hardware anyway — so holding this lock across enqueue costs nothing
+# in steady state while making cross-thread launches safe.
+DEVICE_DISPATCH_LOCK = threading.Lock()
 CHUNK_CAP = 4096  # max postings chunk per slot; flat arrays pad by this much
 FUSE_ROWS = 8     # max segment rows fused into one phase-A sort pool
 # phase-A gather/sort element budget per fused group (× ~8 bytes × a
@@ -201,6 +211,84 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
 
 
 @dataclasses.dataclass
+class CompressedStreams:
+    """Per-shard compressed resident streams (ops/sparse.compress_flat
+    stacked over shards): three u16 streams replace the 8-byte
+    doc-sorted pair AND the 8-byte impact-sorted copy at 6 bytes per
+    posting, plus per-128-lane block-max metadata and the per-term f32
+    residual tables the exact rescore reads ranks into. Shapes pad to
+    common widths so the whole set device_puts with one NamedSharding
+    over the "shards" axis."""
+
+    flat_docs16: np.ndarray   # u16[S, P_pad] doc ids (pad/sentinel = d_pad)
+    flat_code16: np.ndarray   # u16[S, P_pad] monotone impact value codes
+    flat_rank16: np.ndarray   # u16[S, P_pad] per-term residual ranks
+    block_max: np.ndarray     # u16[S, NBp] block-max codes (+1 slack entry)
+    res_vals: np.ndarray      # f32[S, RC_pad] residual tables, zero-padded
+    res_row_starts: List[np.ndarray]  # per shard: i64[n_rows+1]
+
+    def nbytes_device(self) -> int:
+        return (self.flat_docs16.nbytes + self.flat_code16.nbytes
+                + self.flat_rank16.nbytes + self.block_max.nbytes
+                + self.res_vals.nbytes)
+
+
+def compress_pack_reason(pack: StackedShardPack) -> Optional[str]:
+    """First reason any shard of this pack can NOT take the compressed
+    resident format (None = every shard compressible). Padding shard
+    rows hold only sentinel/zero lanes and are always compressible."""
+    for si in range(pack.num_shards):
+        rstart = (pack.row_starts[si] if si < len(pack.row_starts)
+                  else np.zeros(1, dtype=np.int64))
+        reason = sparse.compress_reason(
+            pack.flat_docs[si], pack.flat_impact[si], rstart, pack.d_pad)
+        if reason is not None:
+            return f"shard {si}: {reason}"
+    return None
+
+
+def build_compressed_streams(pack: StackedShardPack) -> CompressedStreams:
+    """Run compress_flat per shard row and stack to common widths.
+    Raises ValueError when compress_pack_reason() is non-None."""
+    s, p_pad = pack.flat_docs.shape
+    nbp = (p_pad + sparse.COMPRESSED_BLOCK - 1) // sparse.COMPRESSED_BLOCK + 1
+    docs16 = np.full((s, p_pad), min(pack.d_pad, (1 << 16) - 1),
+                     dtype=np.uint16)
+    code16 = np.zeros((s, p_pad), dtype=np.uint16)
+    rank16 = np.zeros((s, p_pad), dtype=np.uint16)
+    block_max = np.zeros((s, nbp), dtype=np.uint16)
+    res_parts: List[np.ndarray] = []
+    res_row_starts: List[np.ndarray] = []
+    for si in range(s):
+        rstart = (pack.row_starts[si] if si < len(pack.row_starts)
+                  else np.zeros(1, dtype=np.int64))
+        d16, c16, r16, bm, rv, rrs = sparse.compress_flat(
+            pack.flat_docs[si], pack.flat_impact[si], rstart, pack.d_pad)
+        docs16[si], code16[si], rank16[si] = d16, c16, r16
+        block_max[si, :bm.size] = bm
+        res_parts.append(rv)
+        res_row_starts.append(rrs)
+    rc_pad = _pad_to(max([rv.size for rv in res_parts] + [1]))
+    res_vals = np.zeros((s, rc_pad), dtype=np.float32)
+    for si, rv in enumerate(res_parts):
+        res_vals[si, :rv.size] = rv
+    return CompressedStreams(docs16, code16, rank16, block_max, res_vals,
+                             res_row_starts)
+
+
+def device_put_compressed(streams: CompressedStreams,
+                          mesh: Optional[Mesh] = None):
+    """Place the 5 compressed tensors in HBM (sharded over "shards"
+    when a mesh is given) — the compressed resident pack image."""
+    arrays = (streams.flat_docs16, streams.flat_code16,
+              streams.flat_rank16, streams.block_max, streams.res_vals)
+    if mesh is None:
+        return tuple(jax.device_put(a) for a in arrays)
+    sh = NamedSharding(mesh, P(SHARD_AXIS, None))
+    return tuple(jax.device_put(a, sh) for a in arrays)
+
+
+@dataclasses.dataclass
 class QueryBatch:
     """Chunked slot tensors for B queries × S shards (ops/sparse.plan_slots
     run over all (shard, query) rows so the static (T, L_c) bucket is
@@ -219,6 +307,12 @@ class QueryBatch:
     # β_r = Σ_t w_t · impact_t[prefix_cap] (0 when nothing truncated)
     tail_bounds: Optional[np.ndarray] = None  # f32[S, B]
     truncated: bool = False  # any slot shorter than its full postings row
+    # compressed-pack mode only (prepare_query_batch(compressed=...)):
+    # per-slot residual-table extents (shard-relative) and the slot→term
+    # group ids the kernel's block-max bound aggregates by
+    res_starts: Optional[np.ndarray] = None   # int32[S, B, T]
+    res_lens: Optional[np.ndarray] = None     # int32[S, B, T]
+    slot_terms: Optional[np.ndarray] = None   # int32[S, B, T]
 
 
 def build_impact_sorted(pack: StackedShardPack
@@ -309,14 +403,21 @@ def prepare_query_batch(pack: StackedShardPack,
                         prefix_cap: Optional[int] = None,
                         imp_impacts: Optional[np.ndarray] = None,
                         pad_t_slots: Optional[int] = None,
-                        pad_max_len: Optional[int] = None) -> QueryBatch:
+                        pad_max_len: Optional[int] = None,
+                        compressed: Optional[CompressedStreams] = None
+                        ) -> QueryBatch:
     """Host-side planning: vocab lookups, group-level idf, chunk splitting.
     min_counts[i] = required matched clauses (1 = OR, len(terms) = AND).
 
     prefix_cap (block-max mode): truncate each term's slots to its top
     `prefix_cap` impact entries — valid ONLY against the impact-sorted
     arrays (`build_impact_sorted`), whose host `imp_impacts` must be given
-    to read the tail bound at the truncation point."""
+    to read the tail bound at the truncation point.
+
+    compressed: the pack's CompressedStreams — fills the batch's
+    residual-table extents and slot→term ids so the compressed kernel
+    variants can decode exact f32 impacts and aggregate block-max
+    bounds per term."""
     if prefix_cap is not None and imp_impacts is None:
         raise ValueError("prefix_cap requires imp_impacts")
     b_real = len(queries)
@@ -381,13 +482,37 @@ def prepare_query_batch(pack: StackedShardPack,
     if pad_max_len is not None and pad_max_len > max_len:
         max_len = pad_max_len
     shape3 = (s, b, t_slots)
+    starts3 = starts_a.reshape(shape3)
+    lengths3 = lengths_a.reshape(shape3)
     mc = plan.min_count.reshape(s, b)[0].copy()
-    return QueryBatch(starts_a.reshape(shape3),
-                      lengths_a.reshape(shape3),
+    res_starts3 = res_lens3 = slot_terms3 = None
+    if compressed is not None:
+        # per-slot term row (the chunk's start always lies inside its
+        # term's postings row) → residual extents + term group ids; pad
+        # slots (start 0, length 0) resolve to row 0 harmlessly
+        res_starts3 = np.zeros(shape3, dtype=np.int32)
+        res_lens3 = np.zeros(shape3, dtype=np.int32)
+        slot_terms3 = np.zeros(shape3, dtype=np.int32)
+        for si in range(s):
+            rstart = pack.row_starts[si]
+            n_rows = rstart.size - 1
+            if n_rows <= 0:
+                continue
+            rr = np.searchsorted(rstart, starts3[si], side="right") - 1
+            rr = np.clip(rr, 0, n_rows - 1)
+            rrs = compressed.res_row_starts[si]
+            slot_terms3[si] = rr.astype(np.int32)
+            res_starts3[si] = rrs[rr].astype(np.int32)
+            res_lens3[si] = (rrs[rr + 1] - rrs[rr]).astype(np.int32)
+            zero = lengths3[si] == 0
+            res_lens3[si][zero] = 0
+    return QueryBatch(starts3, lengths3,
                       weights_a.reshape(shape3),
                       mc, max_len, t_slots, plan.window,
                       bool((mc > 1).any()),
-                      tail_bounds=tail_bounds, truncated=truncated)
+                      tail_bounds=tail_bounds, truncated=truncated,
+                      res_starts=res_starts3, res_lens=res_lens3,
+                      slot_terms=slot_terms3)
 
 
 # ---------------------------------------------------------------------------
@@ -397,25 +522,45 @@ def prepare_query_batch(pack: StackedShardPack,
 def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
                 *, max_len: int, d_pad: int, p_pad: int, k: int,
                 t_window: int, with_counts: bool, shard_offset,
-                variant: str = "ref"):
+                variant: str = "ref", comp=None):
     """Score this device's S_l shards × B queries and return per-query
     (vals, global ids) merged over the local shards.
 
     flat_docs/flat_impact: [S_l, P_pad]; starts/lengths/weights:
     [S_l, B, T] (starts relative to each shard's base); min_count [B].
     Also returns totals int32[B]: exact matched-doc count over the local
-    shards (the per-shard TotalHits partial)."""
+    shards (the per-shard TotalHits partial).
+
+    comp (compressed variants): (flat_rank [S_l, P_pad], block_max
+    [S_l, NBp], res_vals [S_l, RC_pad], res_starts/res_lens/slot_terms
+    [S_l, B, T]) — flattened here with per-shard offsets so the kernel's
+    flat indices stay shard-local."""
     s_l, b, t = starts.shape
     base = jnp.arange(s_l, dtype=jnp.int32) * p_pad
     starts_abs = starts + base[:, None, None]
     r = s_l * b
+    extra = {}
+    if comp is not None:
+        flat_rank, block_max, res_vals, res_starts, res_lens, slot_terms = comp
+        nbp = block_max.shape[1]
+        rcp = res_vals.shape[1]
+        sb = jnp.arange(s_l, dtype=jnp.int32)[:, None, None]
+        blk = starts // sparse.COMPRESSED_BLOCK + sb * nbp
+        extra = dict(flat_rank=flat_rank.reshape(-1),
+                     res_starts=(res_starts + sb * rcp).reshape(r, t),
+                     res_lens=res_lens.reshape(r, t),
+                     res_vals=res_vals.reshape(-1),
+                     block_max=block_max.reshape(-1),
+                     blk_starts=blk.reshape(r, t),
+                     slot_terms=slot_terms.reshape(r, t))
     vals, docs, totals = sparse.sorted_merge_topk(
         flat_docs.reshape(-1), flat_impact.reshape(-1),
         starts_abs.reshape(r, t), lengths.reshape(r, t),
         weights.reshape(r, t),
         jnp.tile(min_count, s_l),
         max_len=max_len, d_pad=d_pad, k=k, t_window=t_window,
-        with_counts=with_counts, with_totals=True, variant=variant)
+        with_counts=with_counts, with_totals=True, variant=variant,
+        **extra)
     k_l = vals.shape[1]
     vals = vals.reshape(s_l, b, k_l)
     docs = docs.reshape(s_l, b, k_l)
@@ -429,7 +574,7 @@ def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
 
 
 def _merge_topk(vals_b, gids_b, k: int, variant: str = "ref"):
-    if variant == "packed":
+    if variant in ("packed", "compressed"):
         top_vals, pos = sparse.hierarchical_top_k(
             vals_b, min(k, vals_b.shape[1]))
     else:
@@ -446,6 +591,23 @@ def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
     Used by the bench on one chip and as the compile-check entry point.
     lru_cached so repeated bucket signatures reuse the jitted step (and
     its XLA compile cache) instead of re-tracing per call."""
+
+    if variant in sparse.COMPRESSED_VARIANTS:
+        @jax.jit
+        def step(flat_docs, flat_impact, flat_rank, block_max, res_vals,
+                 starts, lengths, weights, res_starts, res_lens,
+                 slot_terms, min_count):
+            vals_b, gids_b, totals_b = _local_body(
+                flat_docs, flat_impact, starts, lengths, weights, min_count,
+                max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
+                t_window=t_window, with_counts=with_counts,
+                shard_offset=jnp.int64(0), variant=variant,
+                comp=(flat_rank, block_max, res_vals,
+                      res_starts, res_lens, slot_terms))
+            top_vals, top_ids = _merge_topk(vals_b, gids_b, k, variant)
+            return top_vals, top_ids, totals_b
+
+        return step
 
     @jax.jit
     def step(flat_docs, flat_impact, starts, lengths, weights, min_count):
@@ -471,14 +633,7 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
     signature) so the query path hits the jit cache instead of re-tracing
     every batch."""
 
-    def body(flat_docs, flat_impact, starts, lengths, weights, min_count):
-        s_l = flat_docs.shape[0]
-        my = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int64)
-        vals_b, gids_b, totals_b = _local_body(
-            flat_docs, flat_impact, starts, lengths, weights, min_count,
-            max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
-            t_window=t_window, with_counts=with_counts,
-            shard_offset=my * s_l, variant=variant)
+    def tail(vals_b, gids_b, totals_b):
         all_vals = jax.lax.all_gather(vals_b, SHARD_AXIS, axis=1, tiled=True)
         all_ids = jax.lax.all_gather(gids_b, SHARD_AXIS, axis=1, tiled=True)
         totals = jax.lax.psum(totals_b, SHARD_AXIS)  # TotalHits reduce
@@ -487,11 +642,44 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
 
     spec_post = P(SHARD_AXIS, None)
     spec_sbt = P(SHARD_AXIS, DATA_AXIS, None)
+    out_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
+
+    if variant in sparse.COMPRESSED_VARIANTS:
+        def body(flat_docs, flat_impact, flat_rank, block_max, res_vals,
+                 starts, lengths, weights, res_starts, res_lens,
+                 slot_terms, min_count):
+            s_l = flat_docs.shape[0]
+            my = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int64)
+            vals_b, gids_b, totals_b = _local_body(
+                flat_docs, flat_impact, starts, lengths, weights, min_count,
+                max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
+                t_window=t_window, with_counts=with_counts,
+                shard_offset=my * s_l, variant=variant,
+                comp=(flat_rank, block_max, res_vals,
+                      res_starts, res_lens, slot_terms))
+            return tail(vals_b, gids_b, totals_b)
+
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(spec_post,) * 5 + (spec_sbt,) * 6 + (P(DATA_AXIS),),
+            out_specs=out_specs)
+        return jax.jit(mapped)
+
+    def body(flat_docs, flat_impact, starts, lengths, weights, min_count):
+        s_l = flat_docs.shape[0]
+        my = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int64)
+        vals_b, gids_b, totals_b = _local_body(
+            flat_docs, flat_impact, starts, lengths, weights, min_count,
+            max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
+            t_window=t_window, with_counts=with_counts,
+            shard_offset=my * s_l, variant=variant)
+        return tail(vals_b, gids_b, totals_b)
+
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(spec_post, spec_post, spec_sbt, spec_sbt, spec_sbt,
                   P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)))
+        out_specs=out_specs)
     return jax.jit(mapped)
 
 
@@ -814,26 +1002,52 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
     gids int64 [B,k'], totals [B]) with no per-hit host decoding — the
     serving path decodes the whole batch vectorized (VERDICT r3 #1).
     materialize=False returns the jax arrays of the ASYNC dispatch
-    without blocking (pipelined serving; np.asarray them to wait)."""
+    without blocking (pipelined serving; np.asarray them to wait).
+
+    Compressed variants take a 5-tuple device_arrays (docs16, code16,
+    rank16, block_max, res_vals) from device_put_compressed and a batch
+    prepared with compressed=streams (res_starts/res_lens/slot_terms)."""
+    compressed = variant in sparse.COMPRESSED_VARIANTS
     if device_arrays is None:
-        device_arrays = device_put_pack(pack, mesh)
+        if compressed:
+            device_arrays = device_put_compressed(
+                build_compressed_streams(pack), mesh)
+        else:
+            device_arrays = device_put_pack(pack, mesh)
     if with_counts is None:
         with_counts = batch.need_counts
     if t_window is None:
         t_window = batch.window
     elif t_window < batch.window:
         raise ValueError(f"t_window={t_window} < needed {batch.window}")
-    flat_docs, flat_impact = device_arrays
     fn = make_distributed_search(
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
         k=k, t_window=t_window, with_counts=with_counts, variant=variant)
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     db = NamedSharding(mesh, P(DATA_AXIS))
-    vals, ids, totals = fn(flat_docs, flat_impact,
-                           jax.device_put(batch.starts, sbt),
-                           jax.device_put(batch.lengths, sbt),
-                           jax.device_put(batch.weights, sbt),
-                           jax.device_put(batch.min_count, db))
+    if compressed and batch.res_starts is None:
+        raise ValueError(
+            "compressed variant needs a batch prepared with "
+            "compressed= streams (res_starts/res_lens/slot_terms)")
+    with DEVICE_DISPATCH_LOCK:
+        if compressed:
+            docs16, code16, rank16, block_max, res_vals = device_arrays
+            vals, ids, totals = fn(docs16, code16, rank16, block_max,
+                                   res_vals,
+                                   jax.device_put(batch.starts, sbt),
+                                   jax.device_put(batch.lengths, sbt),
+                                   jax.device_put(batch.weights, sbt),
+                                   jax.device_put(batch.res_starts, sbt),
+                                   jax.device_put(batch.res_lens, sbt),
+                                   jax.device_put(batch.slot_terms, sbt),
+                                   jax.device_put(batch.min_count, db))
+        else:
+            flat_docs, flat_impact = device_arrays
+            vals, ids, totals = fn(flat_docs, flat_impact,
+                                   jax.device_put(batch.starts, sbt),
+                                   jax.device_put(batch.lengths, sbt),
+                                   jax.device_put(batch.weights, sbt),
+                                   jax.device_put(batch.min_count, db))
     if not materialize:
         return vals, ids, totals
     return np.asarray(vals), np.asarray(ids), np.asarray(totals)
@@ -1020,7 +1234,8 @@ def distributed_knn(pack: StackedVectorPack, queries: np.ndarray, k: int,
             vectors, live = device_arrays
         else:
             vectors, live = device_put_vector_pack(pack, mesh)
-        vals, gids = step(vectors, live, jnp.asarray(q))
+        with DEVICE_DISPATCH_LOCK:
+            vals, gids = step(vectors, live, jnp.asarray(q))
     else:
         vals, gids = _knn_local_body(
             jnp.asarray(pack.vectors), jnp.asarray(pack.live),

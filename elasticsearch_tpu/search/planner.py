@@ -52,7 +52,8 @@ MAX_SLOTS_PER_PASS = 32
 
 def choose_kernel_variant(d_pad: int,
                           weights: Optional[np.ndarray] = None,
-                          enabled: bool = True) -> str:
+                          enabled: bool = True,
+                          compressed: bool = False) -> str:
     """Pick the device-kernel variant for one lowered pack/batch.
 
     Lowering-time decision (PERF.md round 8): "packed" — the single
@@ -63,7 +64,20 @@ def choose_kernel_variant(d_pad: int,
     d_pad ≥ 2^16 chunk-local doc ids, non-finite/negative weights, or
     weight magnitudes outside [1e-12, 1e30] (where the monotone 16-bit
     impact code could turn a positive contribution into code 0 and
-    perturb TotalHits)."""
+    perturb TotalHits).
+
+    compressed=True (the resident pack holds only the 16-bit streams,
+    PERF.md round 11): the same packable() predicate decides between
+    "compressed" (quantized sort keys + block-max pruning, needs the
+    monotone lower-bound guarantee on weights) and "compressed_exact"
+    (per-lane residual-table decode then the exact-f32 pipeline — the
+    automatic fallback for weights that would violate the bound). A
+    compressed pack has no f32 posting copy, so "ref"/"packed" are not
+    reachable from it."""
+    if compressed:
+        if sparse.packable(d_pad, weights):
+            return "compressed"
+        return "compressed_exact"
     if enabled and sparse.packable(d_pad, weights):
         return "packed"
     return "ref"

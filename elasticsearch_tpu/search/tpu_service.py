@@ -324,6 +324,20 @@ class ResidentPack:
     # pack — a rebuild starts fresh, no invalidation protocol needed.
     slots_memo: Dict[Tuple[str, ...], int] = dataclasses.field(
         default_factory=dict)
+    # compressed resident format (PERF.md round 11): host-side 16-bit
+    # streams + residual tables. When set, device_arrays is the 5-tuple
+    # from device_put_compressed, there is no f32 posting copy on device
+    # and no impact-sorted copy at all (imp_host/imp_device_arrays stay
+    # None → every query routes to the exact kernel in a compressed
+    # variant)
+    comp_streams: Optional[dist.CompressedStreams] = None
+    # per-pack HBM accounting detail for /_tpu/stats and the Prometheus
+    # pack families: raw vs resident bytes, ratio, block metadata, docs
+    hbm_detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def compressed(self) -> bool:
+        return self.comp_streams is not None
 
     def resolve_ids(self, rows: np.ndarray, ords: np.ndarray) -> np.ndarray:
         """(pack row, local ordinal) → external _id, vectorized."""
@@ -359,9 +373,15 @@ class IndexPackCache:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            # per-(index,field) HBM breakdown: raw vs resident bytes,
+            # ratio, block metadata — the /_tpu/stats + Prometheus view
+            # of the compressed-pack capacity win
+            packs = {f"{idx}/{field}": dict(entry.hbm_detail)
+                     for (idx, field), entry in self._cache.items()}
             return {"resident": len(self._cache), "hits": self.hits,
                     "misses": self.misses,
-                    "stale_served": self.stale_served}
+                    "stale_served": self.stale_served,
+                    "packs": packs}
 
     @property
     def mesh(self):
@@ -446,20 +466,69 @@ class IndexPackCache:
         pack = dist.build_stacked_pack(segments, field, live_docs=live,
                                        k1=k1, b=b, pad_shards_to=s_pad,
                                        row_groups=groups)
-        imp_docs, imp_impacts = dist.build_impact_sorted(pack)
-        hbm = pack.nbytes_device() + imp_docs.nbytes + imp_impacts.nbytes
-        if self._breaker is not None:
-            self._breaker.add_estimate_bytes_and_maybe_break(
-                hbm, label=f"pack[{field}]")
-        try:
-            arrays = dist.device_put_pack(pack, self.mesh)
-            imp_arrays = dist.device_put_pack(
-                dataclasses.replace(pack, flat_docs=imp_docs,
-                                    flat_impact=imp_impacts), self.mesh)
-        except Exception:
-            if self._breaker is not None:  # undo the charge on HBM failure
-                self._breaker.release(hbm)
-            raise
+        # what the uncompressed resident image costs: doc-sorted pack +
+        # the impact-sorted copy (same two arrays re-ordered) — the
+        # baseline both /_tpu/stats' compression_ratio and the bench's
+        # hbm_bytes_per_doc compare against
+        raw_bytes = (pack.nbytes_device() + pack.flat_docs.nbytes
+                     + pack.flat_impact.nbytes)
+        n_docs = int(sum(len(ids) for ids in pack.shard_doc_ids))
+        streams = None
+        comp_reason = None
+        if KERNEL_CONFIG["compressed_pack"]:
+            comp_reason = dist.compress_pack_reason(pack)
+            if comp_reason is None:
+                streams = dist.build_compressed_streams(pack)
+            else:
+                logger.info("pack[%s] not compressible (%s); resident "
+                            "in raw format", field, comp_reason)
+        if streams is not None:
+            # compressed residency: the 16-bit streams + block metadata +
+            # residual tables are the WHOLE device image — no f32 copy,
+            # no impact-sorted copy, no pruned path
+            hbm = streams.nbytes_device()
+            if self._breaker is not None:
+                self._breaker.add_estimate_bytes_and_maybe_break(
+                    hbm, label=f"pack[{field}]")
+            try:
+                arrays = dist.device_put_compressed(streams, self.mesh)
+            except Exception:
+                if self._breaker is not None:
+                    self._breaker.release(hbm)
+                raise
+            imp_docs = imp_impacts = None
+            imp_arrays = None
+        else:
+            imp_docs, imp_impacts = dist.build_impact_sorted(pack)
+            hbm = (pack.nbytes_device() + imp_docs.nbytes
+                   + imp_impacts.nbytes)
+            if self._breaker is not None:
+                self._breaker.add_estimate_bytes_and_maybe_break(
+                    hbm, label=f"pack[{field}]")
+            try:
+                arrays = dist.device_put_pack(pack, self.mesh)
+                imp_arrays = dist.device_put_pack(
+                    dataclasses.replace(pack, flat_docs=imp_docs,
+                                        flat_impact=imp_impacts), self.mesh)
+            except Exception:
+                if self._breaker is not None:  # undo the charge on failure
+                    self._breaker.release(hbm)
+                raise
+        hbm_detail = {
+            "compressed": streams is not None,
+            "hbm_bytes": int(hbm),
+            "raw_bytes": int(raw_bytes),
+            "compression_ratio": (float(hbm) / raw_bytes if raw_bytes
+                                  else 1.0),
+            "block_meta_bytes": (int(streams.block_max.nbytes)
+                                 if streams is not None else 0),
+            "residual_bytes": (int(streams.res_vals.nbytes)
+                               if streams is not None else 0),
+            "docs": n_docs,
+            "hbm_bytes_per_doc": (float(hbm) / n_docs if n_docs else 0.0),
+        }
+        if comp_reason is not None:
+            hbm_detail["compress_reason"] = comp_reason
         # vectorized-resolution tables: row → owning shard, row → offset
         # into one concatenated external-id array (object dtype: fancy
         # indexing is C-speed, the per-hit Python lookup is gone)
@@ -476,10 +545,12 @@ class IndexPackCache:
             off += len(ids)
         return ResidentPack(pack, arrays, row_origin, reader_key, hbm,
                             readers={num: r for num, r in readers},
-                            imp_host=(imp_docs, imp_impacts),
+                            imp_host=(None if imp_docs is None
+                                      else (imp_docs, imp_impacts)),
                             imp_device_arrays=imp_arrays,
                             row_shard=row_shard, row_offset=row_offset,
-                            id_cat=id_cat, row_segments=row_segments)
+                            id_cat=id_cat, row_segments=row_segments,
+                            comp_streams=streams, hbm_detail=hbm_detail)
 
     def invalidate(self, index_name: str) -> None:
         evicted = []
@@ -865,7 +936,17 @@ _PRUNE_WINDOW = 8
 # (the setting is the ceiling, packability is the floor). Process-wide
 # because the jitted kernels and their prewarmed signatures are too
 # (`search.tpu_serving.kernel.packed_sort`).
-KERNEL_CONFIG = {"packed_sort": True}
+KERNEL_CONFIG = {"packed_sort": True,
+                 # compressed_pack=True builds RESIDENT packs in the
+                 # 16-bit stream format (PERF.md round 11): ~2.7× fewer
+                 # HBM bytes/doc, exact scores via residual tables,
+                 # device-side block-max pruning. Build-time: toggling
+                 # only affects packs built afterwards (the bench
+                 # invalidates between phases). Incompressible packs
+                 # (d_pad ≥ 2^16, non-finite impacts, > 65535 distinct
+                 # impacts per term) silently stay in the raw format
+                 # (`search.tpu_serving.kernel.compressed_pack`).
+                 "compressed_pack": False}
 
 #: per-(kernel, variant) launch counters → es_tpu_kernel_variant_total
 KERNEL_VARIANT_COUNTS = LabeledCounters("kernel", "variant")
@@ -877,7 +958,9 @@ def _choose_exact_variant(resident: ResidentPack, batch) -> str:
     axis and the prepared batch's slot weights)."""
     from elasticsearch_tpu.search.planner import choose_kernel_variant
     return choose_kernel_variant(resident.pack.d_pad, batch.weights,
-                                 enabled=KERNEL_CONFIG["packed_sort"])
+                                 enabled=KERNEL_CONFIG["packed_sort"],
+                                 compressed=resident.comp_streams
+                                 is not None)
 
 
 def _pruned_variant() -> str:
@@ -1127,17 +1210,24 @@ def _launch_exact(resident: ResidentPack, flats: Sequence[FlatQuery],
         boosts=[f.boost for f in flats],
         min_counts=[f.min_count for f in flats],
         pad_batch_to=_serving_bucket(len(flats)),
-        pad_max_len=dist.CHUNK_CAP)
+        pad_max_len=dist.CHUNK_CAP,
+        compressed=resident.comp_streams)
     t_pin = 8
     while t_pin < batch.t_slots:
         t_pin *= 2
     if t_pin > batch.t_slots:
         s, b, t = batch.starts.shape
         pad = ((0, 0), (0, 0), (0, t_pin - t))
+        extra = {}
+        if batch.res_starts is not None:
+            # zero-padded slots: length 0 ⇒ inert in grouping/rescore
+            extra = dict(res_starts=np.pad(batch.res_starts, pad),
+                         res_lens=np.pad(batch.res_lens, pad),
+                         slot_terms=np.pad(batch.slot_terms, pad))
         batch = _dc.replace(
             batch, starts=np.pad(batch.starts, pad),
             lengths=np.pad(batch.lengths, pad),
-            weights=np.pad(batch.weights, pad), t_slots=t_pin)
+            weights=np.pad(batch.weights, pad), t_slots=t_pin, **extra)
     k_kernel = 128 if k <= 128 else (1024 if k <= 1024
                                      else _batch_bucket(k, 16384))
     if variant is None:
@@ -1241,10 +1331,11 @@ def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     ops = dist.pack_pruned_operands(batch, t_starts, t_lengths, t_weights)
     t_disp = time.perf_counter()
-    packed = fn(
-        resident.imp_device_arrays[0], resident.imp_device_arrays[1],
-        resident.device_arrays[0], resident.device_arrays[1],
-        jax.device_put(ops, sbt))
+    with dist.DEVICE_DISPATCH_LOCK:
+        packed = fn(
+            resident.imp_device_arrays[0], resident.imp_device_arrays[1],
+            resident.device_arrays[0], resident.device_arrays[1],
+            jax.device_put(ops, sbt))
     t_dev = time.perf_counter()
     if stages is not None:
         stages.add("batch_prep", t_disp - t_prep)
@@ -1341,9 +1432,11 @@ class TpuSearchService:
                  plan_cache_size: int = 2048,
                  prewarm_concurrency: int = 4,
                  compile_cache_dir: Optional[str] = None,
-                 packed_sort: bool = True):
+                 packed_sort: bool = True,
+                 compressed_pack: bool = False):
         _ensure_compile_cache(compile_cache_dir)
         KERNEL_CONFIG["packed_sort"] = bool(packed_sort)
+        KERNEL_CONFIG["compressed_pack"] = bool(compressed_pack)
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.plans = PlanCache(max_entries=plan_cache_size)
         self.batch_timeout_s = batch_timeout_s
@@ -1382,6 +1475,16 @@ class TpuSearchService:
     @property
     def kernel_packed_sort(self) -> bool:
         return KERNEL_CONFIG["packed_sort"]
+
+    def set_kernel_compressed_pack(self, enabled: bool) -> None:
+        """Flip compressed-pack residency at runtime. BUILD-time: only
+        packs built after the flip change format — callers that need the
+        new format now (the bench's kernel_compare) also invalidate."""
+        KERNEL_CONFIG["compressed_pack"] = bool(enabled)
+
+    @property
+    def kernel_compressed_pack(self) -> bool:
+        return KERNEL_CONFIG["compressed_pack"]
 
     def invalidate_index(self, index_name: str) -> None:
         """Drop resident packs AND lowered plans of a deleted/closed
@@ -1648,12 +1751,21 @@ class TpuSearchService:
         # toggle, the bench A/B) and must never cold-compile inside the
         # batch completer. Pruned kernels never pack their gid keys, so
         # their "packed" variant differs only in the top-k reduction.
-        if KERNEL_CONFIG["packed_sort"]:
+        # compressed packs have no impact-sorted copy — the pruned table
+        # is unreachable, and the exact kernel runs the compressed pair
+        # (both reachable: per-launch weight fallback picks the exact
+        # decode variant)
+        if resident.comp_streams is not None:
+            pruned_variants: Tuple[str, ...] = ()
+        elif KERNEL_CONFIG["packed_sort"]:
             pruned_variants = ("packed", "ref")
         else:
             pruned_variants = ("ref",)
         from elasticsearch_tpu.ops import sparse as _sparse
-        if (KERNEL_CONFIG["packed_sort"]
+        if resident.comp_streams is not None:
+            exact_variants: Tuple[str, ...] = ("compressed",
+                                               "compressed_exact")
+        elif (KERNEL_CONFIG["packed_sort"]
                 and _sparse.packable(resident.pack.d_pad)):
             exact_variants = ("packed", "ref")
         else:
@@ -1751,6 +1863,8 @@ class TpuSearchService:
                 "pack_cache": self.packs.stats(),
                 "prewarm": prewarm,
                 "kernel": {"packed_sort": KERNEL_CONFIG["packed_sort"],
+                           "compressed_pack":
+                               KERNEL_CONFIG["compressed_pack"],
                            "variants": KERNEL_VARIANT_COUNTS.counts()},
                 "queue": self.batcher.queue_depths(),
                 "stages": self.stages.snapshot()}

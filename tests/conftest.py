@@ -96,6 +96,46 @@ def _multiprocess_timeout(request):
         signal.signal(signal.SIGALRM, prior)
 
 
+# -- compressed-pack slack guard --------------------------------------
+#
+# Tests marked `compressed_pack` drive the compressed kernel variants,
+# which (like every sorted_merge_topk variant) slice `max_len` lanes
+# from each slot start with dynamic_slice. dynamic_slice CLAMPS
+# out-of-bounds starts, so a corpus whose flat arrays lack CHUNK_CAP
+# slack past the last posting doesn't crash — it silently shifts the
+# last term's read window onto earlier postings and the parity assert
+# chases a phantom miscompare (the trap PR 4's make_flat NOTE
+# documents). Fail fast with the real cause instead.
+
+
+@pytest.fixture(autouse=True)
+def _compressed_pack_slack_guard(request, monkeypatch):
+    if request.node.get_closest_marker("compressed_pack") is None:
+        yield
+        return
+    from elasticsearch_tpu.ops import sparse as _sparse
+
+    real = _sparse.sorted_merge_topk
+
+    def checked(flat_docs, flat_impact, starts, lengths, weights,
+                min_count, *, max_len, **kw):
+        p = int(np.shape(flat_docs)[0])
+        worst = int(np.max(np.asarray(starts))) + max_len
+        if worst > p:
+            pytest.fail(
+                f"compressed-pack corpus lacks CHUNK_CAP slack: a slot "
+                f"start + max_len bucket reads to lane {worst} but the "
+                f"flats end at {p}. dynamic_slice would CLAMP the "
+                f"window onto earlier postings (silent wrong results) "
+                f"— pad the flat arrays by the max_len bucket "
+                f"(make_flat's slack covers chunk_cap=4096).")
+        return real(flat_docs, flat_impact, starts, lengths, weights,
+                    min_count, max_len=max_len, **kw)
+
+    monkeypatch.setattr(_sparse, "sorted_merge_topk", checked)
+    yield
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _multiprocess_orphan_reaper(request):
     yield

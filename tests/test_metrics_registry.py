@@ -213,8 +213,15 @@ def test_required_families_are_present(node):
             "es_tpu_profiler_overhead_ratio",
             "es_tpu_profiler_device_sessions_total",
             "es_tpu_search_tpu_queue_pending",
-            "es_tpu_search_tpu_queue_inflight"):
+            "es_tpu_search_tpu_queue_inflight",
+            "es_tpu_pack_hbm_bytes",
+            "es_tpu_pack_compression_ratio"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
+    # per-pack rows are labeled by index/field and carry the raw-vs-
+    # resident component split
+    assert 'es_tpu_pack_hbm_bytes{' in text
+    for comp in ("resident", "raw"):
+        assert (f'component="{comp}"' in text), f"missing component {comp}"
     # the failure we recorded in the fixture shows up labeled
     assert ('es_tpu_search_shard_failures_total'
             '{index="books",shard="1"} 1') in text

@@ -1,0 +1,109 @@
+"""HBM breaker accounting across the resident-pack lifecycle.
+
+The `hbm` breaker must end at EXACTLY zero after every pack is gone —
+a single leaked charge compounds across refresh cycles until the
+breaker trips on an empty device (the reference's breaker tests assert
+the same drain-to-zero invariant for request/fielddata). Exercised for
+both resident formats: the raw doc-sorted + impact-sorted image and
+the compressed u16 streams (multi-array charge, so a partial release
+would leave a nonzero remainder that this test catches).
+"""
+
+import threading
+
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreaker
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.tpu_service import TpuSearchService
+
+from test_tpu_serving import make_corpus, svc  # noqa: F401 (fixture)
+
+
+@pytest.mark.parametrize("compressed", [False, True],
+                         ids=["raw_pack", "compressed_pack"])
+def test_hbm_drains_to_zero_across_pack_lifecycle(svc, seeded_np,  # noqa: F811
+                                                  compressed):
+    idx = make_corpus(svc, seeded_np, name="acct", docs=80)
+    breaker = CircuitBreaker("hbm", 1 << 30)
+    tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0,
+                           breaker=breaker, compressed_pack=compressed)
+    try:
+        q = dsl.MatchQuery(field="body", query="alpha beta")
+
+        # -- build: exactly one pack charged, and the charge is the
+        # pack's own accounting of itself
+        assert breaker.used == 0
+        assert tpu.try_search(idx, q, k=10) is not None
+        detail = tpu.packs.stats()["packs"]["acct/body"]
+        assert detail["compressed"] is compressed
+        assert breaker.used == detail["hbm_bytes"] > 0
+        if compressed:
+            # the tentpole claim, at serving granularity: the streams
+            # cost at most half the raw image they replace
+            assert detail["hbm_bytes"] <= 0.5 * detail["raw_bytes"]
+
+        # -- rebuild under concurrent search: a refresh swaps the
+        # reader identity; racing searches either rebuild, wait, or
+        # serve the stale pack — whatever interleaving happens, the
+        # old charge must be released exactly once and only the new
+        # pack may remain charged
+        for i in range(80, 110):
+            shard = idx.shard(idx.shard_for_id(f"d{i}"))
+            shard.apply_index_on_primary(f"d{i}", {"body": "alpha gamma",
+                                                   "tag": "t0"})
+        idx.refresh()
+        errs = []
+
+        def hammer():
+            try:
+                for _ in range(3):
+                    tpu.try_search(idx, q, k=10)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        detail2 = tpu.packs.stats()["packs"]["acct/body"]
+        assert breaker.used == detail2["hbm_bytes"] > 0
+
+        # -- evict: the drain must be exact, not merely "close"
+        svc.delete_index("acct")
+        tpu.invalidate_index("acct")
+        assert tpu.packs.stats()["packs"] == {}
+        assert breaker.used == 0
+    finally:
+        tpu.close()
+
+
+def test_build_failure_refunds_charge(svc, seeded_np,  # noqa: F811
+                                      monkeypatch):
+    """A device_put that dies mid-build must refund the whole charge —
+    the compressed path places several arrays, so the refund has to be
+    the single pre-computed total, not a per-array unwind."""
+    from elasticsearch_tpu.parallel import distributed as dist
+
+    idx = make_corpus(svc, seeded_np, name="boom", docs=40)
+    breaker = CircuitBreaker("hbm", 1 << 30)
+    tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0,
+                           breaker=breaker, compressed_pack=True)
+    try:
+        monkeypatch.setattr(
+            dist, "device_put_compressed",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("hbm oom")))
+        q = dsl.MatchQuery(field="body", query="alpha")
+        with pytest.raises(RuntimeError, match="hbm oom"):
+            tpu.try_search(idx, q, k=5)
+        assert breaker.used == 0
+        # and the cache recovers once placement works again: exactly
+        # one fresh charge, no residue from the failed attempt
+        monkeypatch.undo()
+        assert tpu.try_search(idx, q, k=5) is not None
+        detail = tpu.packs.stats()["packs"]["boom/body"]
+        assert breaker.used == detail["hbm_bytes"] > 0
+    finally:
+        tpu.close()

@@ -48,16 +48,53 @@ def make_flat(rng, n_terms, d_pad, max_df, slack=4352):
     return flat_docs, flat_imp, extents
 
 
+def row_starts_of(ext, flat_len):
+    """make_flat extents (contiguous) → row_starts int64[n_terms+1]."""
+    rs = [pos for pos, _ in ext] + [ext[-1][0] + ext[-1][1]]
+    return np.asarray(rs, dtype=np.int64)
+
+
+def compressed_operands(flat_docs, flat_imp, ext, d_pad, plan):
+    """Compress the test corpus and derive the per-slot operands the
+    compressed variants need (mirrors prepare_query_batch)."""
+    rs = row_starts_of(ext, flat_docs.size)
+    reason = sparse.compress_reason(flat_docs, flat_imp, rs, d_pad)
+    assert reason is None, reason
+    docs16, code16, rank16, block_max, res_vals, res_rs = \
+        sparse.compress_flat(flat_docs, flat_imp, rs, d_pad)
+    rr = (np.searchsorted(rs, plan.starts, side="right") - 1).astype(
+        np.int32)
+    rr = np.clip(rr, 0, len(ext) - 1)
+    res_starts = res_rs[rr].astype(np.int32)
+    res_lens = (res_rs[rr + 1] - res_rs[rr]).astype(np.int32)
+    res_lens[plan.lengths == 0] = 0
+    blk = (plan.starts // sparse.COMPRESSED_BLOCK).astype(np.int32)
+    return (docs16, code16,
+            dict(flat_rank=jnp.asarray(rank16),
+                 res_starts=jnp.asarray(res_starts),
+                 res_lens=jnp.asarray(res_lens),
+                 res_vals=jnp.asarray(res_vals),
+                 block_max=jnp.asarray(block_max),
+                 blk_starts=jnp.asarray(blk),
+                 slot_terms=jnp.asarray(rr)))
+
+
 def run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k, chunk_cap=4096,
-               with_counts=False, with_totals=False, variant="ref"):
+               with_counts=False, with_totals=False, variant="ref",
+               ext=None):
     plan = sparse.plan_slots(rows, mins, chunk_cap=chunk_cap, lane=8)
+    extra = {}
+    if variant in sparse.COMPRESSED_VARIANTS:
+        assert ext is not None, "compressed run needs the term extents"
+        flat_docs, flat_imp, extra = compressed_operands(
+            flat_docs, flat_imp, ext, d_pad, plan)
     out = sparse.sorted_merge_topk(
         jnp.asarray(flat_docs), jnp.asarray(flat_imp),
         jnp.asarray(plan.starts), jnp.asarray(plan.lengths),
         jnp.asarray(plan.weights), jnp.asarray(plan.min_count),
         max_len=plan.max_len, d_pad=d_pad, k=k,
         t_window=plan.window, with_counts=with_counts,
-        with_totals=with_totals, variant=variant)
+        with_totals=with_totals, variant=variant, **extra)
     if with_totals:
         vals, docs, totals = out
         return np.asarray(vals), np.asarray(docs), np.asarray(totals)
@@ -167,23 +204,33 @@ def make_case(rng, *, tie_heavy=False):
              for t in range(n_terms)]]
     mc = int(rng.integers(1, n_terms + 1))  # OR → msm → AND
     k = int(rng.integers(1, 64))
-    return flat_docs, flat_imp, rows, [mc], d_pad, k
+    return flat_docs, flat_imp, rows, [mc], d_pad, k, ext
 
 
 def assert_variants_identical(flat_docs, flat_imp, rows, mins, d_pad, k,
-                              chunk_cap=4096):
-    """Bit-identical scores, doc ids, AND totals across variants."""
+                              ext=None, chunk_cap=4096):
+    """Bit-identical scores, doc ids, AND totals across variants. With
+    `ext` (term extents) the compressed pair joins the comparison —
+    the pruning-safety property IS this bitwise equality: a block-max
+    skip that dropped a true top-k doc would change docs/scores."""
     wc = any(m > 1 for m in mins)
     rv, rd, rt = run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k,
                             chunk_cap=chunk_cap, with_counts=wc,
                             with_totals=True, variant="ref")
-    pv, pd_, pt = run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k,
-                             chunk_cap=chunk_cap, with_counts=wc,
-                             with_totals=True, variant="packed")
-    # bitwise: view as uint32 so -inf/-0.0 compare exactly too
-    np.testing.assert_array_equal(rv.view(np.uint32), pv.view(np.uint32))
-    np.testing.assert_array_equal(rd, pd_)
-    np.testing.assert_array_equal(rt, pt)
+    others = ["packed"]
+    if ext is not None:
+        others += list(sparse.COMPRESSED_VARIANTS)
+    for variant in others:
+        pv, pd_, pt = run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k,
+                                 chunk_cap=chunk_cap, with_counts=wc,
+                                 with_totals=True, variant=variant,
+                                 ext=ext)
+        # bitwise: view as uint32 so -inf/-0.0 compare exactly too
+        np.testing.assert_array_equal(rv.view(np.uint32),
+                                      pv.view(np.uint32),
+                                      err_msg=variant)
+        np.testing.assert_array_equal(rd, pd_, err_msg=variant)
+        np.testing.assert_array_equal(rt, pt, err_msg=variant)
     return rv, rd, rt
 
 
@@ -201,11 +248,11 @@ class TestPackedParity:
     def test_parity_sweep(self, seeded_np):
         # the full sweep: random corpora × msm/AND × tie-heavy × chunking
         for i in range(40):
-            fd, fi, rows, mins, d_pad, k = make_case(
+            fd, fi, rows, mins, d_pad, k, ext = make_case(
                 seeded_np, tie_heavy=(i % 3 == 0))
             cap = 64 if i % 4 == 0 else 4096  # force chunk splitting too
             assert_variants_identical(fd, fi, rows, mins, d_pad, k,
-                                      chunk_cap=cap)
+                                      ext=ext, chunk_cap=cap)
 
     @pytest.mark.slow
     def test_parity_near_doc_limit(self, seeded_np):
@@ -214,7 +261,7 @@ class TestPackedParity:
         flat_docs, flat_imp, ext = make_flat(seeded_np, 3, d_pad, 3000)
         rows = [[(ext[t][0], ext[t][1], 1.0 + t, t) for t in range(3)]]
         assert_variants_identical(flat_docs, flat_imp, rows, [1],
-                                  d_pad, 50)
+                                  d_pad, 50, ext=ext)
 
     def test_tie_break_earliest_doc_id(self):
         # many docs at EXACTLY the same score: both variants must emit
@@ -222,13 +269,14 @@ class TestPackedParity:
         d_pad = 512
         docs = np.arange(7, 450, 7, dtype=np.int32)
         flat_docs = np.concatenate(
-            [docs, np.full(64, d_pad, dtype=np.int32)])
+            [docs, np.full(4160, d_pad, dtype=np.int32)])
         flat_imp = np.concatenate(
             [np.full(docs.size, 0.25, dtype=np.float32),
-             np.zeros(64, dtype=np.float32)])
+             np.zeros(4160, dtype=np.float32)])
         rows = [[(0, docs.size, 2.0, 0)]]
         rv, rd, _ = assert_variants_identical(
-            flat_docs, flat_imp, rows, [1], d_pad, 10)
+            flat_docs, flat_imp, rows, [1], d_pad, 10,
+            ext=[(0, docs.size)])
         np.testing.assert_array_equal(rd[0], docs[:10])
 
     def test_packed_rejects_doc_overflow(self, seeded_np):
@@ -302,8 +350,210 @@ class TestTotals:
         for variant in sparse.KERNEL_VARIANTS:
             _, _, totals = run_kernel(flat_docs, flat_imp, rows, mins,
                                       d_pad, k, with_counts=True,
-                                      with_totals=True, variant=variant)
+                                      with_totals=True, variant=variant,
+                                      ext=ext)
             assert totals.tolist() == [len(e) for e in expected]
+
+
+def host_skip_rate(plan, code16, block_max, blk, slot_terms, k):
+    """Numpy replica of the kernel's block-max skip decision (same
+    formula, same clamps) → fraction of valid 128-lane groups skipped.
+    The device mask isn't observable from outside the jit, so tests and
+    the bench measure engagement through this mirror."""
+    blksz = sparse.COMPRESSED_BLOCK
+    n_grp = (plan.max_len + blksz - 1) // blksz
+    r, t = plan.starts.shape
+    bm = np.zeros((r, t, n_grp + 1), np.uint16)
+    for ri in range(r):
+        for ti in range(t):
+            s = min(int(blk[ri, ti]), block_max.size - (n_grp + 1))
+            bm[ri, ti] = block_max[s:s + n_grp + 1]
+    grp_code = np.maximum(bm[..., :-1], bm[..., 1:]).astype(np.uint32)
+    ub = (np.minimum(grp_code + 1, 0x7F80) << 16).view(np.float32)
+    ub = ub.reshape(grp_code.shape)
+    g_valid = ((np.arange(n_grp) * blksz)[None, None, :]
+               < plan.lengths[:, :, None])
+    w3 = plan.weights[:, :, None]
+    grp_ub = np.where(g_valid & (w3 > 0), w3 * ub, 0.0)
+    slot_ub = grp_ub.max(axis=2)
+    eq = slot_terms[:, :, None] == slot_terms[:, None, :]
+    term_ub = np.where(eq, slot_ub[:, None, :], 0.0).max(axis=2)
+    tri = np.tril(np.ones((t, t), bool), k=-1)
+    first = ~np.any(eq & tri[None], axis=2)
+    others = (np.where(first, term_ub, 0.0).sum(axis=1, keepdims=True)
+              - term_ub)
+    thr = np.full(r, -np.inf, np.float32)
+    for ri in range(r):
+        for ti in range(t):
+            ln = int(plan.lengths[ri, ti])
+            if ln >= k:
+                s = int(plan.starts[ri, ti])
+                q = plan.weights[ri, ti] * (
+                    (code16[s:s + ln].astype(np.uint32) << 16)
+                    .view(np.float32))
+                thr[ri] = max(thr[ri], np.partition(q, -k)[-k])
+    skip = (grp_ub + others[:, :, None]) < thr[:, None, None]
+    return float((skip & g_valid).sum()) / max(1, int(g_valid.sum()))
+
+
+def make_heavy_flat(rng, d_pad, dfs, skew=3.0):
+    """Long skewed postings — the regime where block-max elimination has
+    something to eliminate (most blocks' maxima sit far below the k-th
+    best score)."""
+    docs_all, imps_all, ext = [], [], []
+    pos = 0
+    for df in dfs:
+        ds = np.sort(rng.choice(d_pad, size=df,
+                                replace=False)).astype(np.int32)
+        im = (rng.random(df).astype(np.float32) ** skew * 0.9
+              + 0.01).astype(np.float32)
+        docs_all.append(ds)
+        imps_all.append(im)
+        ext.append((pos, df))
+        pos += df
+    flat_docs = np.concatenate(
+        docs_all + [np.full(4352, d_pad, np.int32)])
+    flat_imp = np.concatenate(imps_all + [np.zeros(4352, np.float32)])
+    return flat_docs, flat_imp, ext
+
+
+@pytest.mark.compressed_pack
+class TestCompressedPack:
+    """Compressed resident streams: exact rank-table round-trip, the
+    compressibility gates, and the pruning-safety property — block-max
+    skipping must never drop a true top-k document (bitwise equality vs
+    the reference scorer IS that assertion)."""
+
+    def test_rank_stream_roundtrip_exact(self, seeded_np):
+        d_pad = 2000
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 5, d_pad, 600)
+        # tie-heavy quantization + tombstones: ranks must still decode
+        # every positive impact exactly
+        flat_imp = (np.ceil(flat_imp * 8.0) / 8.0).astype(np.float32)
+        flat_imp[ext[1][0]: ext[1][0] + ext[1][1]: 5] = 0.0
+        rs = row_starts_of(ext, flat_docs.size)
+        docs16, code16, rank16, block_max, res_vals, res_rs = \
+            sparse.compress_flat(flat_docs, flat_imp, rs, d_pad)
+        n_terms = len(ext)
+        terms = np.repeat(np.arange(n_terms), np.diff(rs))
+        terms = np.concatenate(
+            [terms, np.full(flat_imp.size - terms.size, n_terms - 1)])
+        at = res_rs[terms] + rank16.astype(np.int64) - 1
+        dec = np.where(rank16 > 0,
+                       res_vals[np.minimum(at, res_vals.size - 1)], 0.0)
+        np.testing.assert_array_equal(
+            dec.astype(np.float32),
+            np.where(flat_imp > 0, flat_imp, 0.0).astype(np.float32))
+        # doc stream: identical inside rows (pad lanes clamp to d_pad)
+        np.testing.assert_array_equal(
+            docs16[:rs[-1]].astype(np.int32), flat_docs[:rs[-1]])
+        # code stream: monotone lower bound of the exact impact
+        dec_code = (code16[:rs[-1]].astype(np.uint32) << 16) \
+            .view(np.float32)
+        assert (dec_code <= flat_imp[:rs[-1]]).all()
+
+    def test_compress_gates(self, seeded_np):
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 2, 500, 100)
+        rs = row_starts_of(ext, flat_docs.size)
+        assert sparse.compress_reason(flat_docs, flat_imp, rs, 500) is None
+        # doc axis past the 16-bit range
+        assert "doc" in sparse.compress_reason(
+            flat_docs, flat_imp, rs, sparse.PACKED_DOC_LIMIT)
+        # non-finite and negative impacts
+        bad = flat_imp.copy()
+        bad[3] = np.inf
+        assert sparse.compress_reason(flat_docs, bad, rs, 500)
+        bad = flat_imp.copy()
+        bad[3] = -0.25
+        assert sparse.compress_reason(flat_docs, bad, rs, 500)
+        # positive impact so small its 16-bit code floors to 0: the
+        # quantized total would silently drop the match
+        bad = flat_imp.copy()
+        bad[3] = 1e-41
+        assert "code" in sparse.compress_reason(flat_docs, bad, rs, 500)
+
+    def test_skip_engages_and_preserves_topk(self, seeded_np):
+        """Deterministic tier-1 core of the safety sweep: heavy skewed
+        postings where the host mirror shows a NONZERO skip-rate, and
+        the kernel output stays bit-identical to the reference."""
+        d_pad = 20000
+        flat_docs, flat_imp, ext = make_heavy_flat(
+            seeded_np, d_pad, [9000, 7000, 5000])
+        cases = [([0], [1.0], 10),
+                 ([0, 1], [5.0, 0.2], 10),
+                 ([0, 1, 2], [8.0, 0.1, 0.1], 16)]
+        engaged = 0.0
+        for tsel, ws, k in cases:
+            rows = [[(ext[t][0], ext[t][1], w, t)
+                     for t, w in zip(tsel, ws)]]
+            plan = sparse.plan_slots(rows, [1], chunk_cap=4096, lane=8)
+            _, code16, extra = compressed_operands(
+                flat_docs, flat_imp, ext, d_pad, plan)
+            engaged += host_skip_rate(
+                plan, np.asarray(code16), np.asarray(extra["block_max"]),
+                np.asarray(extra["blk_starts"]),
+                np.asarray(extra["slot_terms"]), k)
+            assert_variants_identical(flat_docs, flat_imp, rows, [1],
+                                      d_pad, k, ext=ext)
+        assert engaged > 0.0, "block-max skip never engaged"
+
+    @pytest.mark.slow
+    def test_pruning_safety_sweep(self, seeded_np):
+        """Randomized sweep: skewed/tie-heavy/chunked corpora × OR/msm/
+        AND × k — compressed results bitwise equal to the reference in
+        every trial, with the skip mirror engaging across the sweep."""
+        total_rate = 0.0
+        for i in range(15):
+            d_pad = int(seeded_np.integers(8000, 40000))
+            # every third trial is single-term + skewed + small k — the
+            # regime where skipping provably engages, so the engagement
+            # assert below holds for ANY suite seed
+            n_terms = 1 if i % 3 == 0 else int(seeded_np.integers(1, 5))
+            dfs = [int(seeded_np.integers(2000,
+                                          min(12000, d_pad - 1)))
+                   for _ in range(n_terms)]
+            flat_docs, flat_imp, ext = make_heavy_flat(
+                seeded_np, d_pad, dfs,
+                skew=1.0 if i % 3 == 1 else 3.0)
+            if i % 4 == 0:  # tie-heavy: quantized impacts
+                flat_imp = np.maximum(
+                    np.round(flat_imp * 8) / 8, 0.125).astype(np.float32)
+                flat_imp[row_starts_of(ext, 0)[-1]:] = 0.0
+            ws = [float(seeded_np.uniform(0.1, 6.0))
+                  for _ in range(n_terms)]
+            rows = [[(ext[t][0], ext[t][1], ws[t], t)
+                     for t in range(n_terms)]]
+            mc = int(seeded_np.integers(1, n_terms + 1))
+            k = (int(seeded_np.integers(5, 32)) if n_terms == 1
+                 else int(seeded_np.integers(1, 100)))
+            cap = 1024 if i % 5 == 0 else 4096
+            assert_variants_identical(flat_docs, flat_imp, rows, [mc],
+                                      d_pad, k, ext=ext, chunk_cap=cap)
+            if mc == 1:
+                plan = sparse.plan_slots(rows, [1], chunk_cap=cap,
+                                         lane=8)
+                _, code16, extra = compressed_operands(
+                    flat_docs, flat_imp, ext, d_pad, plan)
+                total_rate += host_skip_rate(
+                    plan, np.asarray(code16),
+                    np.asarray(extra["block_max"]),
+                    np.asarray(extra["blk_starts"]),
+                    np.asarray(extra["slot_terms"]), k)
+        assert total_rate > 0.0
+
+    def test_compressed_requires_operands(self, seeded_np):
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 2, 400, 80)
+        rows = [[(ext[t][0], ext[t][1], 1.0, t) for t in range(2)]]
+        plan = sparse.plan_slots(rows, [1], chunk_cap=4096, lane=8)
+        with pytest.raises(ValueError, match="compressed"):
+            sparse.sorted_merge_topk(
+                jnp.asarray(flat_docs.astype(np.uint16)),
+                jnp.asarray(flat_imp.astype(np.uint16)),
+                jnp.asarray(plan.starts), jnp.asarray(plan.lengths),
+                jnp.asarray(plan.weights), jnp.asarray(plan.min_count),
+                max_len=plan.max_len, d_pad=400, k=5,
+                t_window=plan.window, with_counts=False,
+                variant="compressed")
 
 
 class TestHierarchicalTopK:
