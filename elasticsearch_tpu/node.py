@@ -123,7 +123,15 @@ class Node:
                 # result bits. Off by default until a TPU round burns it
                 # in; incompressible packs fall back to raw residency
                 compressed_pack=self.settings.get_bool(
-                    "search.tpu_serving.kernel.compressed_pack", False))
+                    "search.tpu_serving.kernel.compressed_pack", False),
+                # supervision: dispatches overdue past this deadline are
+                # failed typed and trip batcher recovery (0 disables)
+                launch_deadline_ms=self.settings.get_float(
+                    "search.tpu_serving.launch_deadline_ms", 120_000.0))
+            # recovery's eager re-residency resolves index names through
+            # the live indices service
+            self.tpu_search.index_resolver = \
+                lambda name: self.indices.indices.get(name)
         from elasticsearch_tpu.common.threadpool import ThreadPools
         self.thread_pools = ThreadPools(self.settings)
         # overload protection: memory-accounted write admission shared
@@ -166,8 +174,10 @@ class Node:
                 "search.profiler.retention_s", 300.0),
             device_dir=_os.path.join(data_path, "profile_sessions"))
         if self.tpu_search is not None:
+            # read through the service each tick: supervision may swap
+            # the batcher object on recovery
             self.profiler.sampler.timeline_source = \
-                self.tpu_search.batcher.queue_depths
+                lambda: self.tpu_search.batcher.queue_depths()
         self.profiler.start()
         # the multi-process serving front (started explicitly via
         # start_serving_fronts(); None ⇒ single-process serving)
@@ -331,7 +341,13 @@ class Node:
                 "search.tpu_serving.front_wedge_timeout_seconds", 30.0),
             profile_hz=profile_hz,
             memo_size=self.settings.get_int(
-                "search.tpu_serving.plan_memo_size", 4096))
+                "search.tpu_serving.plan_memo_size", 4096),
+            hb_interval_s=self.settings.get_float(
+                "search.tpu_serving.batcher_heartbeat_seconds", 1.0),
+            batcher_stale_s=self.settings.get_float(
+                "search.tpu_serving.batcher_stale_seconds", 5.0),
+            orphan_grace_s=self.settings.get_float(
+                "search.tpu_serving.front_orphan_grace_seconds", 10.0))
         return self.serving_front.ports
 
     def replicate(self, op: str, index: str, shard_num: int, doc_id: str,
@@ -462,6 +478,25 @@ class Node:
                 if ring is not None:
                     yield ("search.tpu.stage_latency_seconds", lb, ring,
                            "summary")
+            # batcher supervision: launch watchdog + wedge/crash
+            # recovery (metric OBJECTS yield so the completeness
+            # traversal sees them as registered)
+            wd = svc.watchdog
+            yield ("watchdog.launches", nl, wd.c_launches, "counter")
+            yield ("watchdog.wedges", nl, wd.c_wedges, "counter")
+            yield ("watchdog.inflight", nl, wd.inflight(), "gauge")
+            yield ("watchdog.deadline_ms", nl,
+                   round(wd.deadline_s * 1e3, 1), "gauge")
+            sup = svc.supervisor
+            from elasticsearch_tpu.search.tpu_service import \
+                _SUPERVISION_STATES
+            yield ("recovery.recoveries", nl, sup.c_recoveries, "counter")
+            yield ("recovery.degraded_served", nl, sup.c_degraded_served,
+                   "counter")
+            yield ("recovery.state", nl,
+                   _SUPERVISION_STATES.get(sup.state, -1), "gauge")
+            yield ("recovery.last_duration_seconds", nl,
+                   sup.last_duration_s, "gauge")
         reg.add_collector(_tpu)
 
         def _transport():

@@ -669,6 +669,11 @@ def search(indices: IndicesService, index_expr: Optional[str],
     if body.get("suggest") is not None:
         from elasticsearch_tpu.search.suggest import run_suggest
         out["suggest"] = run_suggest(indices, names, body["suggest"])
+    if (tpu_search is not None
+            and getattr(tpu_search, "degraded_active", False)):
+        # batcher down/recovering: this answer came from the planner
+        # while the kernel path recovers — clients see it typed
+        out["degraded"] = True
     return out
 
 

@@ -44,7 +44,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from elasticsearch_tpu.common import profiler, tracing
-from elasticsearch_tpu.common.metrics import LabeledCounters
+from elasticsearch_tpu.common.metrics import CounterMetric, LabeledCounters
 from elasticsearch_tpu.mapping.types import TextFieldType
 from elasticsearch_tpu.parallel import distributed as dist
 from elasticsearch_tpu.parallel.mesh import SHARD_AXIS, make_mesh
@@ -564,6 +564,24 @@ class IndexPackCache:
             for entry in evicted:
                 self.on_evict(entry)
 
+    def invalidate_all(self) -> List[Tuple[str, str]]:
+        """Crash-recovery drop of EVERY resident pack (the batcher
+        supervisor's respawn path): each pack's full charge is released,
+        so afterwards the `hbm` breaker reads EXACTLY zero — the same
+        drain-to-zero invariant the per-index lifecycle tests assert.
+        Returns the dropped (index, field) keys so recovery can
+        re-attain residency eagerly."""
+        with self._lock:
+            entries = list(self._cache.items())
+            self._cache.clear()
+            for _key, entry in entries:
+                if self._breaker is not None:
+                    self._breaker.release(entry.hbm_bytes)
+        if self.on_evict is not None:
+            for _key, entry in entries:
+                self.on_evict(entry)
+        return [key for key, _entry in entries]
+
 
 # ---------------------------------------------------------------------------
 # micro-batching
@@ -719,13 +737,24 @@ class _PackQueue:
                     (p.trace_span for p in taken if p.trace_span), None)
                 try:
                     profiler.tag_stage("batch_launch")
-                    with tracing.span_under(trace_parent,
-                                            "tpu.batch_launch",
-                                            queries=len(taken)):
-                        st = launch_flat_batch(
-                            self.resident, [p.flat for p in taken],
-                            k=max(p.k for p in taken), mesh=batcher.mesh,
-                            stages=batcher.stages)
+                    # deadline-stamped dispatch: if this launch wedges,
+                    # the watchdog fails `taken` typed and trips the
+                    # supervisor instead of hanging the micro-batcher
+                    wd = batcher.watchdog
+                    token = (wd.begin("launch", taken)
+                             if wd is not None else None)
+                    try:
+                        with tracing.span_under(trace_parent,
+                                                "tpu.batch_launch",
+                                                queries=len(taken)):
+                            st = launch_flat_batch(
+                                self.resident, [p.flat for p in taken],
+                                k=max(p.k for p in taken),
+                                mesh=batcher.mesh,
+                                stages=batcher.stages)
+                    finally:
+                        if wd is not None:
+                            wd.end(token)
                 except Exception as exc:  # noqa: BLE001 — per query
                     for p in taken:
                         if not p.future.done():
@@ -754,9 +783,17 @@ class _PackQueue:
                 (p.trace_span for p in taken if p.trace_span), None)
             try:
                 profiler.tag_stage("batch_finish")
-                with tracing.span_under(trace_parent, "tpu.batch_finish",
-                                        queries=len(taken)):
-                    results = finish_flat_batch(st)
+                wd = batcher.watchdog
+                token = (wd.begin("finish", taken)
+                         if wd is not None else None)
+                try:
+                    with tracing.span_under(trace_parent,
+                                            "tpu.batch_finish",
+                                            queries=len(taken)):
+                        results = finish_flat_batch(st)
+                finally:
+                    if wd is not None:
+                        wd.end(token)
             except Exception as exc:  # noqa: BLE001 — per query
                 for p in taken:
                     if not p.future.done():
@@ -770,7 +807,10 @@ class _PackQueue:
                 batcher.batches_executed += 1
                 batcher.queries_executed += len(taken)
             for p, res in zip(taken, results):
-                p.future.set_result(res)
+                # the watchdog may have failed this future already (an
+                # overdue launch that eventually returned)
+                if not p.future.done():
+                    p.future.set_result(res)
             with self.cv:  # batch finished — the worker may launch now
                 self.n_inflight -= 1
                 self.cv.notify_all()
@@ -805,6 +845,24 @@ class MicroBatcher:
         with self._lock:
             if self._queues.get(id(queue.resident)) is queue:
                 del self._queues[id(queue.resident)]
+
+    def fail_pending(self, exc: BaseException) -> int:
+        """Fail every not-yet-launched query with `exc` (typed batcher
+        death): the supervisor calls this before detaching a dead or
+        wedged batcher so no request waits out the full batch timeout.
+        Queries already taken into a launch are the watchdog's to fail."""
+        with self._lock:
+            queues = list(self._queues.values())
+        failed = 0
+        for q in queues:
+            with q.cv:
+                pendings, q.pendings = q.pendings, []
+                q.cv.notify_all()
+            for p in pendings:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+                    failed += 1
+        return failed
 
     def retire_pack(self, resident: ResidentPack) -> None:
         """Called when the pack cache evicts/replaces a pack: drop its
@@ -858,6 +916,9 @@ class MicroBatcher:
     # pack arrays were placed with (no per-batch mesh construction)
     mesh = None
     stages: Optional[StageTimes] = None
+    # launch watchdog (None = unmonitored): workers stamp a deadline on
+    # every device dispatch through it
+    watchdog: Optional["LaunchWatchdog"] = None
 
 
 @dataclasses.dataclass
@@ -1047,6 +1108,9 @@ def launch_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
     executes on device (double-buffered serving; VERDICT r3 #1d)."""
     if mesh is None:
         mesh = make_mesh(shape=(1, _n_local_devices()))
+    # fault seam: DeviceWedge blocks here — BEFORE any lock or device
+    # work — so a "wedged" launch holds nothing the watchdog needs
+    _dispatch_fault_point()
     pruned_idx = [i for i, f in enumerate(flats)
                   if f.min_count == 1 and k <= PRUNE_MAX_K
                   and len(f.terms) <= PRUNE_MAX_TERMS
@@ -1420,6 +1484,250 @@ def _n_local_devices() -> int:
 
 
 # ---------------------------------------------------------------------------
+# batcher supervision: launch watchdog + wedge/crash recovery
+# ---------------------------------------------------------------------------
+
+class DeviceWedgedError(RuntimeError):
+    """A device dispatch exceeded its launch deadline (or the batcher
+    was torn down underneath a queued query). Typed so try_search can
+    decline to the planner without tripping the generic error path."""
+
+
+# fault-injection seam: DeviceWedge appends a blocking callable here;
+# launch_flat_batch calls through before doing ANY device work, so a
+# wedged launch holds no locks the watchdog or supervisor need
+DISPATCH_FAULT_HOOKS: List[Any] = []
+
+
+def _dispatch_fault_point() -> None:
+    for hook in list(DISPATCH_FAULT_HOOKS):
+        hook()
+
+
+class LaunchWatchdog:
+    """Deadline-stamps every device dispatch. Workers bracket each
+    launch/finish with begin()/end(); a scan thread fails any dispatch
+    still open past `deadline_ms` with a typed DeviceWedgedError and
+    fires `on_wedge` — a wedged SPMD launch trips supervision within
+    the deadline instead of hanging the micro-batcher until the batch
+    timeout. deadline_ms <= 0 disables monitoring (no scan thread)."""
+
+    def __init__(self, deadline_ms: float = 120_000.0, on_wedge=None):
+        self.deadline_s = max(0.0, float(deadline_ms)) / 1e3
+        self.on_wedge = on_wedge
+        self.c_launches = CounterMetric()
+        self.c_wedges = CounterMetric()
+        self.last_wedge: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+        self._entries: Dict[int, Dict[str, Any]] = {}
+        self._next_token = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.deadline_s > 0:
+            self._thread = threading.Thread(target=self._scan_loop,
+                                            daemon=True,
+                                            name="tpu-launch-watchdog")
+            self._thread.start()
+
+    def begin(self, label: str, pendings) -> Optional[int]:
+        """Open a monitored dispatch; returns the token end() takes
+        (None when monitoring is off). The pendings list is what the
+        scan thread fails if the dispatch goes overdue."""
+        if self.deadline_s <= 0:
+            return None
+        self.c_launches.inc()
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._entries[token] = {"label": label, "t0": time.monotonic(),
+                                    "pendings": list(pendings)}
+        return token
+
+    def end(self, token: Optional[int]) -> None:
+        if token is None:
+            return
+        with self._lock:
+            self._entries.pop(token, None)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _scan_loop(self) -> None:
+        # scan often enough that detection lands within the deadline
+        # even for sub-second deadlines (the chaos tests run ~300ms)
+        interval = max(0.01, min(0.25, self.deadline_s / 4))
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            overdue = []
+            with self._lock:
+                for token in [t for t, e in self._entries.items()
+                              if now - e["t0"] > self.deadline_s]:
+                    overdue.append(self._entries.pop(token))
+            for e in overdue:
+                age_ms = (now - e["t0"]) * 1e3
+                self.c_wedges.inc()
+                self.last_wedge = {"label": e["label"],
+                                   "age_ms": round(age_ms, 1),
+                                   "queries": len(e["pendings"])}
+                exc = DeviceWedgedError(
+                    f"device dispatch ({e['label']}) exceeded its "
+                    f"{self.deadline_s * 1e3:.0f}ms launch deadline "
+                    f"after {age_ms:.0f}ms")
+                for p in e["pendings"]:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+                if self.on_wedge is not None:
+                    try:
+                        self.on_wedge(e["label"], age_ms)
+                    except Exception:  # noqa: BLE001 — scan must survive
+                        logger.exception("watchdog on_wedge failed")
+
+    def stats(self) -> Dict[str, Any]:
+        return {"deadline_ms": round(self.deadline_s * 1e3, 1),
+                "launches": self.c_launches.count,
+                "wedges": self.c_wedges.count,
+                "inflight": self.inflight(),
+                "last_wedge": self.last_wedge}
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+# recovery.state gauge encoding (Prometheus can't carry strings)
+_SUPERVISION_STATES = {"serving": 0, "down": 1, "recovering": 2}
+
+
+class BatcherSupervisor:
+    """Crash/wedge recovery for the device-owning batcher. trigger()
+    tears the current batcher down — queued queries fail typed, every
+    resident pack drops so the HBM breaker drains to EXACTLY zero (the
+    lifecycle invariant) — and flips the service to degraded planner
+    serving. maybe_recover() respawns a fresh MicroBatcher
+    single-flight and eagerly re-attains residency for every dropped
+    pack through IndexPackCache (re-charging the breaker), after which
+    the kernel path resumes."""
+
+    def __init__(self, svc: "TpuSearchService"):
+        self.svc = svc
+        self.state = "serving"
+        self.c_recoveries = CounterMetric()
+        self.c_degraded_served = CounterMetric()
+        self.last_reason: Optional[str] = None
+        self.last_duration_s = 0.0
+        # disruption schemes hold recovery open so tests can observe
+        # the degraded window; heal() lifts the hold and recovers
+        self.hold_recovery = False
+        self._lock = threading.Lock()
+        self._dropped_keys: List[Tuple[str, str]] = []
+        self._recover_thread: Optional[threading.Thread] = None
+
+    @property
+    def degraded_active(self) -> bool:
+        return self.state != "serving"
+
+    def trigger(self, reason: str) -> None:
+        """Batcher is dead or wedged: tear it down and go degraded.
+        Idempotent while already down/recovering."""
+        with self._lock:
+            self.last_reason = reason
+            if self.state != "serving":
+                return
+            self.state = "down"
+        logger.error("batcher supervision tripped (%s): serving degraded "
+                     "planner results while recovering", reason)
+        self._tear_down(reason)
+        self.maybe_recover()
+
+    def _tear_down(self, reason: str) -> None:
+        svc = self.svc
+        old = svc.batcher
+        exc = DeviceWedgedError(f"batcher down: {reason}")
+        try:
+            old.fail_pending(exc)
+        except Exception:  # noqa: BLE001 — teardown must complete
+            logger.exception("failing pending queries during teardown")
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001
+            logger.exception("closing dead batcher")
+        dropped = svc.packs.invalidate_all()
+        with self._lock:
+            self._dropped_keys = dropped
+
+    def maybe_recover(self) -> None:
+        with self._lock:
+            # single-flight: only the caller that flips down→recovering
+            # spawns the thread (a live-thread check would race the
+            # window between releasing this lock and t.start())
+            if self.state != "down" or self.hold_recovery:
+                return
+            self.state = "recovering"
+            t = threading.Thread(target=self._recover, daemon=True,
+                                 name="batcher-recovery")
+            self._recover_thread = t
+        t.start()
+
+    def _recover(self) -> None:
+        svc = self.svc
+        t0 = time.monotonic()
+        try:
+            old = svc.batcher
+            fresh = MicroBatcher(window_s=old.window_s,
+                                 max_batch=old.max_batch)
+            # counters carry over so scrape monotonicity survives respawn
+            fresh.batches_executed = old.batches_executed
+            fresh.queries_executed = old.queries_executed
+            fresh.mesh = svc.packs.mesh
+            fresh.stages = svc.stages
+            fresh.watchdog = svc.watchdog
+            svc.batcher = fresh
+            svc.packs.on_evict = fresh.retire_pack
+            # eager re-residency: rebuild every dropped pack through the
+            # cache (re-charging the breaker) before traffic returns —
+            # jit caches live on module functions, so no recompile
+            with self._lock:
+                keys = list(self._dropped_keys)
+            resolver = svc.index_resolver
+            rebuilt = 0
+            if resolver is not None:
+                for index_name, field in keys:
+                    try:
+                        index_service = resolver(index_name)
+                    except Exception:  # noqa: BLE001 — index may be gone
+                        index_service = None
+                    if index_service is None:
+                        continue
+                    try:
+                        if svc.packs.get(index_service, field) is not None:
+                            rebuilt += 1
+                    except Exception:  # noqa: BLE001 — best effort
+                        logger.exception("re-attaining residency for %s/%s",
+                                         index_name, field)
+            with self._lock:
+                self.state = "serving"
+                self.last_duration_s = time.monotonic() - t0
+            self.c_recoveries.inc()
+            svc._tripped = False
+            logger.warning("batcher recovered in %.2fs (%d/%d packs "
+                           "re-resident)", self.last_duration_s, rebuilt,
+                           len(keys))
+        except Exception:  # noqa: BLE001 — stay degraded, stay alive
+            with self._lock:
+                self.state = "down"
+            logger.exception("batcher recovery failed; staying degraded")
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self.state,
+                    "state_code": _SUPERVISION_STATES.get(self.state, -1),
+                    "recoveries": self.c_recoveries.count,
+                    "degraded_served": self.c_degraded_served.count,
+                    "last_reason": self.last_reason,
+                    "last_duration_seconds": round(self.last_duration_s, 4)}
+
+
+# ---------------------------------------------------------------------------
 # the service
 # ---------------------------------------------------------------------------
 
@@ -1433,7 +1741,8 @@ class TpuSearchService:
                  prewarm_concurrency: int = 4,
                  compile_cache_dir: Optional[str] = None,
                  packed_sort: bool = True,
-                 compressed_pack: bool = False):
+                 compressed_pack: bool = False,
+                 launch_deadline_ms: float = 120_000.0):
         _ensure_compile_cache(compile_cache_dir)
         KERNEL_CONFIG["packed_sort"] = bool(packed_sort)
         KERNEL_CONFIG["compressed_pack"] = bool(compressed_pack)
@@ -1447,6 +1756,16 @@ class TpuSearchService:
         self.batcher.mesh = self.packs.mesh
         self.stages = StageTimes()
         self.batcher.stages = self.stages
+        # supervision: the watchdog deadline-stamps every dispatch and
+        # trips the supervisor on a wedge; the supervisor respawns the
+        # batcher and re-attains pack residency
+        self.watchdog = LaunchWatchdog(deadline_ms=launch_deadline_ms,
+                                       on_wedge=self._on_wedge)
+        self.batcher.watchdog = self.watchdog
+        self.supervisor = BatcherSupervisor(self)
+        # set by the node: index name → live IndexService (recovery's
+        # eager re-residency path); None = rebuild lazily on traffic
+        self.index_resolver = None
         self.served = 0      # queries answered by the kernel path
         self.fallback = 0    # queries declined to the planner path
         self.timeouts = 0    # kernel waits that hit the deadline
@@ -1465,6 +1784,24 @@ class TpuSearchService:
         self._prewarm_lock = threading.Lock()
         self._prewarm_progress: Dict[str, Any] = {
             "state": "idle", "total": 0, "done": 0, "seconds": 0.0}
+
+    def _on_wedge(self, label: str, age_ms: float) -> None:
+        """Watchdog callback (scan thread): an overdue dispatch means
+        the device path is wedged — trip supervision."""
+        self.last_error = (f"device_wedged: {label} overdue "
+                           f"after {age_ms:.0f}ms")
+        self.supervisor.trigger(f"device wedge ({label}, {age_ms:.0f}ms)")
+
+    @property
+    def degraded_active(self) -> bool:
+        """True while the batcher is down or recovering: queries serve
+        through the planner path with a degraded marker."""
+        return self.supervisor.degraded_active
+
+    def kill(self, reason: str = "killed") -> None:
+        """Simulate batcher-process death (BatcherKill disruption, ops
+        drills): tears down the batcher exactly as a wedge trip does."""
+        self.supervisor.trigger(reason)
 
     def set_kernel_packed_sort(self, enabled: bool) -> None:
         """Flip the packed-sort kernel variant at runtime (the bench's
@@ -1518,6 +1855,14 @@ class TpuSearchService:
             # traffic routes to the planner instead of stalling behind a
             # cold compile (the 8.8M-doc first-train stall + breaker trip)
             self.fallback += 1
+            return None
+        if self.supervisor.degraded_active:
+            # batcher down or recovering: degraded-mode serving — the
+            # planner answers (with a degraded marker) instead of
+            # queueing behind a dead batcher
+            self.fallback += 1
+            self.supervisor.c_degraded_served.inc()
+            self.supervisor.maybe_recover()
             return None
         t0 = time.perf_counter()
         pkey = plan_key(query)
@@ -1614,6 +1959,12 @@ class TpuSearchService:
             self.last_error = "timeout waiting for kernel batch"
             logger.error("tpu kernel batch timed out; tripping kernel "
                          "breaker (probe every %.0fs)", self.probe_cooldown_s)
+            return None
+        except DeviceWedgedError as exc:
+            # typed wedge/teardown failure: the watchdog/supervisor
+            # already handled the batcher — just degrade this query
+            self.fallback += 1
+            self.last_error = f"device_wedged: {exc}"
             return None
         except Exception as exc:  # noqa: BLE001 — degrade, never 500
             self.fallback += 1
@@ -1867,9 +2218,12 @@ class TpuSearchService:
                                KERNEL_CONFIG["compressed_pack"],
                            "variants": KERNEL_VARIANT_COUNTS.counts()},
                 "queue": self.batcher.queue_depths(),
+                "supervision": self.supervisor.stats(),
+                "watchdog": self.watchdog.stats(),
                 "stages": self.stages.snapshot()}
 
     def close(self) -> None:
+        self.watchdog.close()
         self.batcher.close()
 
 

@@ -76,6 +76,18 @@ class _FrontState:
             self.free.put(i)
         self.pending: Dict[int, "_Waiter"] = {}
         self._send_lock = threading.Lock()
+        # batcher-death direction: heartbeat staleness (or pipe EOF)
+        # flips batcher_down — requests fast-fail typed 503 +
+        # Retry-After, pendings' slots quarantine until the resync
+        # handshake returns them (the batcher may still write to them)
+        self.hb_stale_s = float(cfg.get("hb_stale_s", 0.0))
+        self.orphan_grace_s = float(cfg.get("orphan_grace_s", 10.0))
+        self.last_hb = time.monotonic()
+        self.batcher_down = False
+        self._down_lock = threading.Lock()
+        self._resync_sent = False
+        self.quarantined: set = set()
+        self._q_lock = threading.Lock()
         from elasticsearch_tpu.common.metrics import (CounterMetric,
                                                       MetricsRegistry,
                                                       SampleRing)
@@ -101,6 +113,13 @@ class _FrontState:
         self.c_overflow = self.metrics.register(
             "serving.front.pipe_overflow", CounterMetric(),
             help="Payloads that outgrew their shm slot and rode the pipe")
+        self.c_batcher_stalls = self.metrics.register(
+            "serving.front.batcher_stalls", CounterMetric(),
+            help="Times this front declared the batcher down "
+                 "(stale heartbeat or pipe EOF)")
+        self.c_batcher_down = self.metrics.register(
+            "serving.front.batcher_down_503", CounterMetric(),
+            help="Requests answered typed 503 while the batcher was down")
         self.latency = SampleRing(512)
         self.metrics.register("serving.front.latency_seconds", self.latency,
                               help="Front-observed request latency")
@@ -114,8 +133,59 @@ class _FrontState:
 
     # -- batcher round trip -------------------------------------------
 
+    def _batcher_down_wire(self) -> Dict[str, Any]:
+        return {"status": 503, "ctype": "json",
+                "headers": {"Retry-After": "1"},
+                "parts": ['{"error":{"type":"batcher_unavailable_'
+                          'exception","reason":"the device-owning '
+                          'batcher process is down or unresponsive; '
+                          'retry shortly"},"status":503}'],
+                "columns": []}
+
+    def _enter_batcher_down(self, reason: str) -> None:
+        """Flip to batcher-down: every pending waiter fails typed NOW
+        (no hanging out the full request timeout), and their slots move
+        to quarantine — the batcher may still write to them, so they
+        rejoin the free list only after the resync handshake. New
+        requests fast-fail in roundtrip without consuming slots, so the
+        free list can never deadlock on a dead batcher."""
+        with self._down_lock:
+            if self.batcher_down:
+                return
+            self.batcher_down = True
+            self._resync_sent = False
+        self.c_batcher_stalls.inc()
+        logger.warning("front %s: batcher down (%s); answering typed 503 "
+                       "until it returns", self.role, reason)
+        data = pickle.dumps(self._batcher_down_wire(),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        while self.pending:
+            try:
+                slot, waiter = self.pending.popitem()
+            except KeyError:
+                break
+            with self._q_lock:
+                self.quarantined.add(slot)
+            waiter.data = data
+            waiter.event.set()
+
+    def monitor_loop(self) -> None:
+        """Batcher staleness detector: no heartbeat (nor any other pipe
+        traffic) for hb_stale_s ⇒ the batcher is wedged or dead."""
+        interval = max(0.05, min(0.5, self.hb_stale_s / 4))
+        while True:
+            time.sleep(interval)
+            if (not self.batcher_down
+                    and time.monotonic() - self.last_hb > self.hb_stale_s):
+                self._enter_batcher_down(
+                    f"no batcher heartbeat for {self.hb_stale_s}s")
+
     def roundtrip(self, wire_req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         """Ship one request to the batcher; None ⇒ ring full (429)."""
+        if self.batcher_down:
+            # typed fast-fail: no slot consumed, no doorbell sent
+            self.c_batcher_down.inc()
+            return self._batcher_down_wire()
         try:
             slot = self.free.get_nowait()
         except queue.Empty:
@@ -123,6 +193,14 @@ class _FrontState:
             return None
         waiter = _Waiter()
         self.pending[slot] = waiter
+        if self.batcher_down:
+            # raced the down transition after the fast-fail check: fail
+            # typed and quarantine the slot, same as the sweep would
+            self.pending.pop(slot, None)
+            with self._q_lock:
+                self.quarantined.add(slot)
+            self.c_batcher_down.inc()
+            return self._batcher_down_wire()
         data = pickle.dumps(wire_req, protocol=pickle.HIGHEST_PROTOCOL)
         with self._send_lock:
             if self.arena.write(slot, data):
@@ -142,20 +220,56 @@ class _FrontState:
         return pickle.loads(waiter.data)
 
     def recv_loop(self) -> None:
-        """Doorbell receiver: responses in, EOF ⇒ parent is gone."""
+        """Doorbell receiver: responses in, EOF ⇒ the batcher is gone.
+        A SIGKILL'd batcher lands here: every queued request answers
+        typed 503 immediately (not a hang), then this front serves
+        503 + Retry-After for orphan_grace_s — covering clients that
+        retry against a supervisor about to respawn — and folds."""
         while True:
             try:
                 msg = self.conn.recv()
             except (EOFError, OSError):
-                os._exit(0)  # supervisor died or closed us — fold
-            if msg[0] == "resp":
+                self._enter_batcher_down("batcher pipe EOF")
+                time.sleep(self.orphan_grace_s)
+                os._exit(0)
+            self.last_hb = time.monotonic()
+            kind = msg[0]
+            if kind == "hb":
+                if self.batcher_down:
+                    # the batcher is back: ask it to drop stale epochs
+                    # before we return quarantined slots to the ring
+                    with self._send_lock:
+                        if not self._resync_sent:
+                            self._resync_sent = True
+                            try:
+                                self.conn.send(("reset",))
+                            except (OSError, BrokenPipeError):
+                                self._resync_sent = False
+                continue
+            if kind == "reset_ok":
+                with self._q_lock:
+                    stale, self.quarantined = self.quarantined, set()
+                for slot in stale:
+                    self.free.put(slot)
+                with self._down_lock:
+                    self.batcher_down = False
+                    self._resync_sent = False
+                logger.warning("front %s: batcher back; resync returned "
+                               "%d quarantined slot(s)", self.role,
+                               len(stale))
+                continue
+            if kind == "resp":
                 slot = msg[1]
                 data = self.arena.read(slot)
-            elif msg[0] == "respx":
+            elif kind == "respx":
                 slot, data = msg[1], msg[2]
             else:
                 continue
             waiter = self.pending.pop(slot, None)
+            with self._q_lock:
+                # answered after all: un-quarantine before the single
+                # free below (reset_ok must not free it a second time)
+                self.quarantined.discard(slot)
             self.free.put(slot)
             if waiter is not None:
                 waiter.data = data
@@ -244,12 +358,13 @@ class _FrontHandler(BaseHTTPRequestHandler):
             from elasticsearch_tpu.search.serializer import splice_wire
             text = splice_wire(wire["parts"], wire["columns"])
             self._reply(wire["status"], wire["ctype"],
-                        text.encode("utf-8"))
+                        text.encode("utf-8"), wire.get("headers"))
         finally:
             state.latency.add(time.perf_counter() - t0)
             _profiler.untag_thread()
 
-    def _reply(self, status: int, ctype: str, data: bytes) -> None:
+    def _reply(self, status: int, ctype: str, data: bytes,
+               headers: Optional[Dict[str, str]] = None) -> None:
         self.send_response(status)
         self.send_header("Content-Type",
                          "application/json; charset=UTF-8"
@@ -257,6 +372,8 @@ class _FrontHandler(BaseHTTPRequestHandler):
                          else "text/plain; charset=UTF-8")
         self.send_header("Content-Length", str(len(data)))
         self.send_header("X-elastic-product", "Elasticsearch-TPU")
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)
@@ -280,6 +397,10 @@ def front_main(cfg: Dict[str, Any], conn) -> None:
                          daemon=True).start()
         threading.Thread(target=state.publish_loop, name="front-stats",
                          daemon=True).start()
+        if state.hb_stale_s > 0:
+            threading.Thread(target=state.monitor_loop,
+                             name="front-batcher-monitor",
+                             daemon=True).start()
         conn.send(("ready", cfg["port"]))
         server.serve_forever()
     except Exception as exc:  # noqa: BLE001 — report, then fold
@@ -308,6 +429,10 @@ class _FrontHandle:
         self.dead = False
         self.inflight: set = set()
         self.send_lock = threading.Lock()
+        # bumped by the resync handshake: answers computed for an older
+        # epoch are dropped (their slots already rejoined the front's
+        # free list — writing would corrupt a new request)
+        self.epoch = 0
 
     @property
     def role(self) -> str:
@@ -321,7 +446,9 @@ class FrontSupervisor:
     def __init__(self, node, n_fronts: int, *, host: str = "127.0.0.1",
                  slots: int = 64, slot_bytes: int = 256 << 10,
                  timeout_s: float = 45.0, wedge_timeout_s: float = 30.0,
-                 profile_hz: float = 0.0, memo_size: int = 4096):
+                 profile_hz: float = 0.0, memo_size: int = 4096,
+                 hb_interval_s: float = 1.0, batcher_stale_s: float = 5.0,
+                 orphan_grace_s: float = 10.0):
         from elasticsearch_tpu.common.metrics import CounterMetric
         self.node = node
         self.host = host
@@ -330,6 +457,12 @@ class FrontSupervisor:
         self.timeout_s = float(timeout_s)
         self.wedge_timeout_s = float(wedge_timeout_s)
         self.profile_hz = float(profile_hz)
+        self.hb_interval_s = float(hb_interval_s)
+        self.batcher_stale_s = float(batcher_stale_s)
+        self.orphan_grace_s = float(orphan_grace_s)
+        # True ⇒ simulate batcher death for the fronts (BatcherKill):
+        # no heartbeats, doorbells dropped, answers suppressed
+        self._paused = False
         self._ctx = multiprocessing.get_context("spawn")
         self._closed = False
         self._lock = threading.Lock()
@@ -344,6 +477,7 @@ class FrontSupervisor:
         self.c_respawns = CounterMetric()
         self.c_front_deaths = CounterMetric()
         self.c_slots_reclaimed = CounterMetric()
+        self.c_resyncs = CounterMetric()
         self._executor = ThreadPoolExecutor(
             max_workers=max(4, 2 * n_fronts),
             thread_name_prefix="front-bridge")
@@ -357,6 +491,9 @@ class FrontSupervisor:
             self._spawn(h)
         threading.Thread(target=self._watch_loop, name="front-supervisor",
                          daemon=True).start()
+        if self.hb_interval_s > 0:
+            threading.Thread(target=self._hb_loop, name="front-heartbeat",
+                             daemon=True).start()
 
     @property
     def ports(self) -> List[int]:
@@ -369,7 +506,11 @@ class FrontSupervisor:
                "arena_name": h.arena.name, "slots": self.slots,
                "slot_bytes": self.slot_bytes,
                "stats_name": h.stats.name, "timeout_s": self.timeout_s,
-               "profile_hz": self.profile_hz}
+               "profile_hz": self.profile_hz,
+               # the front only monitors staleness when heartbeats flow
+               "hb_stale_s": (self.batcher_stale_s
+                              if self.hb_interval_s > 0 else 0.0),
+               "orphan_grace_s": self.orphan_grace_s}
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         proc = self._ctx.Process(target=front_main, args=(cfg, child_conn),
                                  name=h.role, daemon=True)
@@ -419,21 +560,39 @@ class FrontSupervisor:
         while not self._closed and not h.dead:
             try:
                 msg = h.conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
+                # TypeError: a racing close() nulled the pipe handle
+                # under this blocked recv (multiprocessing wart)
                 break
             if msg[0] == "req":
                 slot = msg[1]
                 data = h.arena.read(slot)
             elif msg[0] == "reqx":
                 slot, data = msg[1], msg[2]
+            elif msg[0] == "reset":
+                # the front declared us down and failed its pendings:
+                # bump the epoch (in-flight answers for old slots drop
+                # instead of corrupting re-issued ones) and ack so the
+                # front returns its quarantined slots to the free list
+                with h.send_lock:
+                    h.epoch += 1
+                    h.inflight.clear()
+                    self.c_resyncs.inc()
+                    try:
+                        h.conn.send(("reset_ok",))
+                    except (OSError, BrokenPipeError):
+                        pass
+                continue
             elif msg[0] == "fatal":
                 logger.error("serving front %s reported: %s", h.role,
                              msg[1])
                 continue
             else:
                 continue
+            if self._paused:
+                continue  # simulated-dead batcher drops doorbells
             h.inflight.add(slot)
-            self._executor.submit(self._execute, h, slot, data)
+            self._executor.submit(self._execute, h, slot, data, h.epoch)
         self._on_front_exit(h)
 
     def _memo_body(self, sig: str, raw: bytes) -> Any:
@@ -458,7 +617,8 @@ class FrontSupervisor:
             return dict(body)
         return body
 
-    def _execute(self, h: _FrontHandle, slot: int, data: bytes) -> None:
+    def _execute(self, h: _FrontHandle, slot: int, data: bytes,
+                 epoch: int = 0) -> None:
         self.c_requests.inc()
         try:
             req = pickle.loads(data)
@@ -484,7 +644,7 @@ class FrontSupervisor:
         out = pickle.dumps(wire, protocol=pickle.HIGHEST_PROTOCOL)
         h.inflight.discard(slot)
         with h.send_lock:
-            if h.dead:
+            if h.dead or self._paused or h.epoch != epoch:
                 return
             try:
                 if h.arena.write(slot, out):
@@ -545,6 +705,34 @@ class FrontSupervisor:
         except Exception:  # noqa: BLE001 — the watch loop retries
             logger.exception("respawn of front-%d failed", index)
 
+    def pause(self) -> None:
+        """Simulate batcher death for the fronts (BatcherKill drills):
+        heartbeats stop, doorbells drop, in-flight answers suppress —
+        fronts detect staleness within batcher_stale_s, fail their
+        pendings typed, and resync when resume() restores heartbeats."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def _hb_loop(self) -> None:
+        """Batcher liveness beacon: fronts flag the batcher down when
+        this goes quiet for batcher_stale_s."""
+        while not self._closed:
+            time.sleep(self.hb_interval_s)
+            if self._paused or self._closed:
+                continue
+            for h in self.fronts:
+                if h.dead or h.conn is None:
+                    continue
+                with h.send_lock:
+                    if h.dead:
+                        continue
+                    try:
+                        h.conn.send(("hb",))
+                    except (OSError, BrokenPipeError):
+                        pass  # exit path handles the dead front
+
     def _watch_loop(self) -> None:
         """Wedge detection: a front that is alive but has stopped
         heartbeating gets killed into the normal EOF/reclaim path."""
@@ -583,6 +771,7 @@ class FrontSupervisor:
         yield ("serving.front_respawns", {}, self.c_respawns, "counter")
         yield ("serving.slots_reclaimed", {}, self.c_slots_reclaimed,
                "counter")
+        yield ("serving.batcher_resyncs", {}, self.c_resyncs, "counter")
         for h in self.fronts:
             snap = h.stats.read()
             if not snap:

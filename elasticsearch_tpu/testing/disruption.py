@@ -405,3 +405,142 @@ def front_kill(node, index: int = 0) -> Iterator[FrontKill]:
         yield scheme
     finally:
         scheme.heal()
+
+
+class DeviceWedge(Scheme):
+    """Device-wedge injection: blocks every SPMD dispatch inside
+    `launch_flat_batch` (via the DISPATCH_FAULT_HOOKS seam — BEFORE any
+    lock or device work) until healed. The launch watchdog detects the
+    overdue dispatch within `launch_deadline_ms`, fails its queries
+    typed, and trips the batcher supervisor; with `hold_recovery`
+    (default) the degraded window stays open for the test to observe —
+    heal() releases the wedge, lifts the hold, and lets recovery run.
+    Never intercepts sends, so it composes with FrontKill/LoadSpike."""
+
+    def __init__(self, node=None, *, service=None, hold_recovery=True):
+        self.service = service if service is not None \
+            else getattr(node, "tpu_search", None)
+        self.hold_recovery = bool(hold_recovery)
+        self._release = threading.Event()
+        self._hook: Optional[Callable[[], None]] = None
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        if self.service is None:
+            raise RuntimeError("DeviceWedge needs a TpuSearchService "
+                               "(pass node= or service=)")
+        from elasticsearch_tpu.search import tpu_service as _tpu
+        if self.hold_recovery:
+            self.service.supervisor.hold_recovery = True
+        release = self._release
+
+        def hook() -> None:
+            release.wait()
+
+        self._hook = hook
+        _tpu.DISPATCH_FAULT_HOOKS.append(hook)
+
+    def intercept(self, src, dst, action):
+        return None  # a device fault, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+            started = self._started
+        if not started:
+            return
+        from elasticsearch_tpu.search import tpu_service as _tpu
+        if self._hook is not None:
+            try:
+                _tpu.DISPATCH_FAULT_HOOKS.remove(self._hook)
+            except ValueError:
+                pass
+            self._hook = None
+        self._release.set()  # unblock the wedged worker thread
+        if self.service is not None:
+            self.service.supervisor.hold_recovery = False
+            self.service.supervisor.maybe_recover()
+
+
+@contextlib.contextmanager
+def device_wedge(node=None, **kwargs) -> Iterator[DeviceWedge]:
+    """Context-managed DeviceWedge: dispatches wedge on entry; on exit
+    the wedge releases and recovery runs (even on assertion failure)."""
+    scheme = DeviceWedge(node, **kwargs)
+    scheme.start()
+    try:
+        yield scheme
+    finally:
+        scheme.heal()
+
+
+class BatcherKill(Scheme):
+    """Batcher-death injection: tears the device-owning batcher down
+    through the supervision path (`TpuSearchService.kill`) and — when
+    the node runs serving fronts — pauses the FrontSupervisor bridge so
+    the fronts experience a dead batcher (no heartbeats, dropped
+    doorbells) and answer typed 503 + Retry-After. heal() resumes the
+    bridge (fronts resync their quarantined slots) and lets the
+    supervisor respawn the batcher, which re-attains pack residency.
+    Composes with FrontKill/DeviceWedge/LoadSpike in one scheme list."""
+
+    def __init__(self, node=None, *, service=None, pause_fronts=True):
+        self.node = node
+        self.service = service if service is not None \
+            else getattr(node, "tpu_search", None)
+        self.pause_fronts = bool(pause_fronts)
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        if self.service is None:
+            raise RuntimeError("BatcherKill needs a TpuSearchService "
+                               "(pass node= or service=)")
+        sup = getattr(self.node, "serving_front", None)
+        if self.pause_fronts and sup is not None:
+            sup.pause()
+        # hold recovery so the degraded window is observable until heal
+        self.service.supervisor.hold_recovery = True
+        self.service.kill("BatcherKill disruption")
+
+    def intercept(self, src, dst, action):
+        return None  # a process fault, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+            started = self._started
+        if not started:
+            return
+        if self.service is not None:
+            self.service.supervisor.hold_recovery = False
+            self.service.supervisor.maybe_recover()
+        sup = getattr(self.node, "serving_front", None)
+        if self.pause_fronts and sup is not None:
+            sup.resume()
+
+
+@contextlib.contextmanager
+def batcher_kill(node=None, **kwargs) -> Iterator[BatcherKill]:
+    """Context-managed BatcherKill: the batcher dies on entry; on exit
+    recovery runs and the front bridge resumes (even when the body's
+    assertions fail)."""
+    scheme = BatcherKill(node, **kwargs)
+    scheme.start()
+    try:
+        yield scheme
+    finally:
+        scheme.heal()

@@ -77,14 +77,17 @@ MULTIPROCESS_TEST_TIMEOUT_S = int(
 
 @pytest.fixture(autouse=True)
 def _multiprocess_timeout(request):
-    if request.node.get_closest_marker("multiprocess") is None:
+    # supervision tests (watchdog/recovery/chaos) park threads in fault
+    # hooks and spawn recovery threads — same wedge risk, same guard
+    if (request.node.get_closest_marker("multiprocess") is None
+            and request.node.get_closest_marker("supervision") is None):
         yield
         return
     import signal
 
     def _alarm(signum, frame):
         raise TimeoutError(
-            f"multiprocess test exceeded its "
+            f"multiprocess/supervision test exceeded its "
             f"{MULTIPROCESS_TEST_TIMEOUT_S}s hard timeout")
 
     prior = signal.signal(signal.SIGALRM, _alarm)
@@ -141,6 +144,7 @@ def _multiprocess_orphan_reaper(request):
     yield
     mod_id = request.node.nodeid
     marked = any(item.get_closest_marker("multiprocess") is not None
+                 or item.get_closest_marker("supervision") is not None
                  for item in request.session.items
                  if item.nodeid.startswith(mod_id))
     if not marked:

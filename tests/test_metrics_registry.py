@@ -215,7 +215,15 @@ def test_required_families_are_present(node):
             "es_tpu_search_tpu_queue_pending",
             "es_tpu_search_tpu_queue_inflight",
             "es_tpu_pack_hbm_bytes",
-            "es_tpu_pack_compression_ratio"):
+            "es_tpu_pack_compression_ratio",
+            "es_tpu_watchdog_launches_total",
+            "es_tpu_watchdog_wedges_total",
+            "es_tpu_watchdog_inflight",
+            "es_tpu_watchdog_deadline_ms",
+            "es_tpu_recovery_recoveries_total",
+            "es_tpu_recovery_degraded_served_total",
+            "es_tpu_recovery_state",
+            "es_tpu_recovery_last_duration_seconds"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
     # per-pack rows are labeled by index/field and carry the raw-vs-
     # resident component split
@@ -306,3 +314,22 @@ def test_every_reachable_metric_object_is_registered(node):
     assert not missing, (
         "metric objects reachable from stats trees but invisible to the "
         f"registry: {[(type(m).__name__, m) for m in missing]}")
+
+
+def test_supervision_counters_reachable_and_registered(node):
+    """ISSUE 10: the watchdog/recovery counters hang off tpu_search via
+    the supervisor and watchdog objects — the completeness traversal
+    must reach them AND the scrape collector must register them (a new
+    supervision counter can't silently dodge the scrape)."""
+    svc = node.tpu_search
+    supervision = [svc.watchdog.c_launches, svc.watchdog.c_wedges,
+                   svc.supervisor.c_recoveries,
+                   svc.supervisor.c_degraded_served]
+    reachable = _reachable_metrics(svc)
+    for obj in supervision:
+        assert id(obj) in reachable, \
+            f"traversal never reached {obj!r} from tpu_search"
+    registered = node.metrics.registered_objects()
+    for obj in supervision:
+        assert id(obj) in registered, \
+            f"supervision counter {obj!r} missing from the registry"

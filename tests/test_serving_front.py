@@ -188,3 +188,120 @@ class TestFrontCrashResilience:
             "killed front never respawned on its port"
         assert sup.c_front_deaths.count > deaths
         assert sup.c_respawns.count >= 1
+
+def _http_full(port, method, path, body=None, timeout=30.0):
+    """Like _http but also returns the response headers."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        payload = None
+        headers = {}
+        if body is not None:
+            payload = body if isinstance(body, bytes) \
+                else json.dumps(body).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+class TestBatcherDownFront:
+    """ISSUE 10: fronts survive a dead/stale batcher — typed 503 with
+    Retry-After (never a hang, never a leaked slot), then the resync
+    handshake restores serving when the batcher returns."""
+
+    def test_stale_batcher_typed_503_then_resync(self, node):
+        sup = node.serving_front
+        port = sup.ports[0]
+        resyncs = sup.c_resyncs.count
+        sup.pause()  # heartbeats stop; doorbells drop — batcher "dead"
+        try:
+            # the first request rides the staleness window: the front
+            # fails it typed when batcher_stale_s expires — a bounded
+            # wait, not the 45s front timeout and not a hang
+            t0 = time.monotonic()
+            st, headers, raw = _http_full(port, "POST", "/lib/_search",
+                                          body=QUERY, timeout=30.0)
+            waited = time.monotonic() - t0
+            assert st == 503
+            assert headers.get("Retry-After") == "1"
+            err = json.loads(raw)["error"]
+            assert err["type"] == "batcher_unavailable_exception"
+            assert waited < 20.0
+            # subsequent requests fast-fail with the same typed shape
+            st2, headers2, raw2 = _http_full(port, "POST", "/lib/_search",
+                                             body=QUERY, timeout=10.0)
+            assert st2 == 503
+            assert headers2.get("Retry-After") == "1"
+            assert json.loads(raw2)["error"]["type"] == \
+                "batcher_unavailable_exception"
+        finally:
+            sup.resume()
+
+        # heartbeats resume → front resyncs (quarantined slots rejoin
+        # the ring) → the same port serves 200 again, no slot leak
+        def healthy():
+            try:
+                st, _, _ = _http_full(port, "POST", "/lib/_search",
+                                      body=QUERY, timeout=5.0)
+                return st == 200
+            except OSError:
+                return False
+        assert _wait(healthy, timeout=30.0), \
+            "front never resynced after the batcher came back"
+        assert sup.c_resyncs.count > resyncs
+        # the slot ring survived the quarantine cycle: a burst larger
+        # than any leak tolerance still completes
+        for _ in range(8):
+            st, raw = _http(port, "POST", "/lib/_search", body=QUERY)
+            assert st == 200
+
+
+class TestOrphanGrace:
+    """A front whose batcher pipe hits EOF serves 503 + Retry-After for
+    front_orphan_grace_seconds (clients retry against the respawning
+    supervisor) and then folds instead of lingering as an orphan."""
+
+    @pytest.fixture()
+    def grace_node(self, tmp_path):
+        n = Node(str(tmp_path / "data"), settings=Settings.of({
+            "search.tpu_serving.batcher_heartbeat_seconds": 0.25,
+            "search.tpu_serving.batcher_stale_seconds": 1.0,
+            "search.tpu_serving.front_orphan_grace_seconds": 3.0,
+        }))
+        _handle(n, "PUT", "/lib/_doc/0", params={"refresh": "true"},
+                body={"title": "quick fox", "year": 2001})
+        ports = n.start_serving_fronts(count=1)
+        assert len(ports) == 1
+        yield n
+        n.close()
+
+    def test_eof_grace_then_exit(self, grace_node):
+        sup = grace_node.serving_front
+        h = sup.fronts[0]
+        port = sup.ports[0]
+        st, _ = _http(port, "GET", "/")
+        assert st == 200
+
+        sup.respawn_enabled = False  # observe the orphan, don't heal it
+        sup.pause()                  # quiet the hb writer first
+        h.conn.close()               # front's recv sees EOF
+
+        # within the grace window: typed 503, not connection-refused
+        def graced():
+            try:
+                st, headers, raw = _http_full(port, "POST", "/lib/_search",
+                                              body=QUERY, timeout=2.0)
+            except OSError:
+                return False
+            return (st == 503
+                    and headers.get("Retry-After") == "1"
+                    and json.loads(raw)["error"]["type"]
+                    == "batcher_unavailable_exception")
+        assert _wait(graced, timeout=2.5, interval=0.05), \
+            "orphaned front did not serve typed 503 during its grace"
+
+        # after the grace: the orphan folds on its own
+        assert _wait(lambda: not h.proc.is_alive(), timeout=20.0), \
+            "orphaned front outlived its grace period"

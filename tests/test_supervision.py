@@ -1,0 +1,370 @@
+"""Batcher supervision: launch watchdog, wedge detection, crash
+recovery with pack re-residency, and degraded-mode serving (ISSUE 10).
+
+The device-owning path gets a supervision layer: every dispatch is
+deadline-stamped by a watchdog; an overdue (wedged) launch fails its
+queries typed within `launch_deadline_ms` and trips the supervisor,
+which tears the batcher down (HBM breaker drains to EXACTLY zero — the
+pack-lifecycle invariant), serves degraded planner results meanwhile,
+then respawns a fresh batcher that eagerly re-attains residency.
+"""
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreaker
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.tpu_service import (DeviceWedgedError,
+                                                  LaunchWatchdog,
+                                                  TpuSearchService)
+from elasticsearch_tpu.testing.disruption import (BatcherKill, DeviceWedge,
+                                                  batcher_kill, device_wedge)
+
+from test_tpu_serving import make_corpus, svc  # noqa: F401 (fixture)
+
+pytestmark = pytest.mark.supervision
+
+
+def _wait(predicate, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _service(breaker=None, **kw):
+    kw.setdefault("window_s", 0.0)
+    kw.setdefault("batch_timeout_s", 300.0)
+    return TpuSearchService(breaker=breaker, **kw)
+
+
+# ---------------------------------------------------------------------
+# watchdog unit behavior
+# ---------------------------------------------------------------------
+
+class _FakePending:
+    def __init__(self):
+        self.future = Future()
+
+
+class TestLaunchWatchdog:
+    def test_overdue_dispatch_fails_typed_within_deadline(self):
+        wedges = []
+        wd = LaunchWatchdog(deadline_ms=120.0,
+                            on_wedge=lambda lb, age: wedges.append((lb, age)))
+        try:
+            p = _FakePending()
+            t0 = time.monotonic()
+            wd.begin("launch", [p])
+            with pytest.raises(DeviceWedgedError, match="launch deadline"):
+                p.future.result(timeout=5.0)
+            detected = time.monotonic() - t0
+            # detection = deadline + one scan interval (+ scheduling
+            # slack) — the acceptance bound is "within launch_deadline_ms"
+            # scale, not multiples of it
+            assert detected < 1.0
+            assert _wait(lambda: wedges, timeout=2.0)
+            assert wedges[0][0] == "launch" and wedges[0][1] >= 120.0
+            assert wd.c_wedges.count == 1
+            assert wd.inflight() == 0
+            assert wd.stats()["last_wedge"]["label"] == "launch"
+        finally:
+            wd.close()
+
+    def test_completed_dispatch_never_trips(self):
+        wd = LaunchWatchdog(deadline_ms=100.0)
+        try:
+            p = _FakePending()
+            token = wd.begin("launch", [p])
+            wd.end(token)
+            time.sleep(0.3)
+            assert wd.c_wedges.count == 0
+            assert not p.future.done()
+            assert wd.c_launches.count == 1
+        finally:
+            wd.close()
+
+    def test_disabled_watchdog_is_inert(self):
+        wd = LaunchWatchdog(deadline_ms=0.0)
+        assert wd.begin("launch", [_FakePending()]) is None
+        wd.end(None)
+        assert wd._thread is None
+        wd.close()
+
+
+# ---------------------------------------------------------------------
+# device wedge → typed failure, degraded serving, recovery
+# ---------------------------------------------------------------------
+
+class TestDeviceWedge:
+    def test_wedge_detected_degrades_and_recovers(self, svc,  # noqa: F811
+                                                  seeded_np):
+        idx = make_corpus(svc, seeded_np, name="wedge", docs=60)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = _service(breaker=breaker, launch_deadline_ms=30_000.0)
+        tpu.index_resolver = lambda name: idx if name == "wedge" else None
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha beta")
+            # warm: pack residency + kernel compile happen OUTSIDE the
+            # wedge window (first-compile must not false-trip)
+            assert tpu.try_search(idx, q, k=10) is not None
+            charged = breaker.used
+            assert charged > 0
+            # tighten the deadline now that the path is warm
+            tpu.watchdog.deadline_s = 0.3
+
+            with device_wedge(service=tpu) as wedge:
+                t0 = time.monotonic()
+                # the wedged query fails typed and falls back (None),
+                # it does NOT hang out the 300s batch timeout
+                assert tpu.try_search(idx, q, k=10) is None
+                assert time.monotonic() - t0 < 5.0
+                assert _wait(lambda: tpu.supervisor.state == "down")
+                # teardown (on the watchdog scan thread) drains the
+                # breaker to EXACTLY zero — wait for it to finish, then
+                # the zero is exact, not approximate
+                assert _wait(lambda: breaker.used == 0)
+                assert breaker.used == 0
+                assert tpu.packs.stats()["packs"] == {}
+                assert tpu.watchdog.c_wedges.count >= 1
+                assert "device_wedged" in (tpu.last_error or "")
+                # degraded-mode serving while held down: planner
+                # declines are typed and counted
+                assert tpu.degraded_active
+                assert tpu.try_search(idx, q, k=10) is None
+                assert tpu.supervisor.c_degraded_served.count >= 1
+                st = tpu.stats()
+                assert st["supervision"]["state"] == "down"
+                assert st["watchdog"]["wedges"] >= 1
+                assert wedge.hold_recovery
+                # widen the deadline again so the released launch's
+                # replay can't spuriously re-trip during recovery
+                tpu.watchdog.deadline_s = 30.0
+
+            # heal: wedge released, recovery respawns the batcher and
+            # EAGERLY re-attains residency (no query needed)
+            assert _wait(lambda: tpu.supervisor.state == "serving")
+            assert _wait(lambda: "wedge/body" in tpu.packs.stats()["packs"])
+            assert breaker.used == \
+                tpu.packs.stats()["packs"]["wedge/body"]["hbm_bytes"] > 0
+            assert tpu.supervisor.c_recoveries.count >= 1
+            # and the kernel path serves again
+            assert tpu.try_search(idx, q, k=10) is not None
+            assert not tpu.degraded_active
+        finally:
+            tpu.close()
+
+
+# ---------------------------------------------------------------------
+# batcher kill → teardown, counter carry-over, eager re-residency
+# ---------------------------------------------------------------------
+
+class TestBatcherKill:
+    def test_kill_recovery_preserves_counters_and_residency(
+            self, svc, seeded_np):  # noqa: F811
+        idx = make_corpus(svc, seeded_np, name="kill", docs=60)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = _service(breaker=breaker)
+        tpu.index_resolver = lambda name: idx if name == "kill" else None
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha")
+            assert tpu.try_search(idx, q, k=10) is not None
+            batches_before = tpu.batcher.batches_executed
+            assert batches_before >= 1
+            old_batcher = tpu.batcher
+
+            with batcher_kill(service=tpu):
+                assert tpu.supervisor.state == "down"
+                assert breaker.used == 0
+                assert tpu.try_search(idx, q, k=10) is None  # degraded
+
+            assert _wait(lambda: tpu.supervisor.state == "serving")
+            assert tpu.batcher is not old_batcher
+            # scrape monotonicity: executed-batch counters carry over
+            assert tpu.batcher.batches_executed >= batches_before
+            # eager re-residency re-charged the breaker
+            assert _wait(lambda: breaker.used > 0)
+            assert "kill/body" in tpu.packs.stats()["packs"]
+            assert tpu.try_search(idx, q, k=10) is not None
+            assert tpu.stats()["supervision"]["recoveries"] == 1
+        finally:
+            tpu.close()
+
+    def test_queued_queries_fail_typed_not_hang(self, svc,  # noqa: F811
+                                                seeded_np):
+        """Queries already queued when the batcher dies must answer
+        typed immediately, not wait out the batch timeout."""
+        idx = make_corpus(svc, seeded_np, name="killq", docs=40)
+        tpu = _service(window_s=5.0)  # wide window: queries sit queued
+        tpu.index_resolver = lambda name: idx if name == "killq" else None
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha")
+            assert tpu.try_search(idx, q, k=10) is not None
+            results = []
+
+            def query():
+                t0 = time.monotonic()
+                r = tpu.try_search(idx, q, k=10)
+                results.append((r, time.monotonic() - t0))
+
+            t = threading.Thread(target=query)
+            t.start()
+            # let the query join the (wide) batch window, then kill
+            time.sleep(0.3)
+            kill = BatcherKill(service=tpu)
+            kill.start()
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "queued query hung through the kill"
+            r, dt = results[0]
+            assert r is None and dt < 5.0
+            assert "batcher down" in (tpu.last_error or "") \
+                or "device_wedged" in (tpu.last_error or "")
+            kill.heal()
+            assert _wait(lambda: tpu.supervisor.state == "serving")
+        finally:
+            tpu.close()
+
+
+# ---------------------------------------------------------------------
+# DEVICE_DISPATCH_LOCK contention (satellite: PR 8's documented risk)
+# ---------------------------------------------------------------------
+
+class TestDispatchLockContention:
+    def test_racing_dispatches_serialize_correctly(self, svc,  # noqa: F811
+                                                   seeded_np):
+        """Two threads racing SPMD dispatch (distinct packs → distinct
+        launch workers) serialize on DEVICE_DISPATCH_LOCK and both
+        complete with correct per-query results."""
+        idx_a = make_corpus(svc, seeded_np, name="race_a", docs=60)
+        idx_b = make_corpus(svc, seeded_np, name="race_b", docs=60)
+        tpu = _service()
+        try:
+            qb = dsl.MatchQuery(field="body", query="alpha beta")
+            # warm both packs (two resident packs → two pack queues)
+            rb = tpu.try_search(idx_a, qb, k=10)
+            rt = tpu.try_search(idx_b, qb, k=10)
+            assert rb is not None and rt is not None
+            out = {}
+
+            def run(name, idx):
+                out[name] = tpu.try_search(idx, qb, k=10)
+
+            threads = [threading.Thread(target=run, args=("b", idx_a)),
+                       threading.Thread(target=run, args=("t", idx_b))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert all(not t.is_alive() for t in threads)
+            assert out["b"] is not None and out["t"] is not None
+            # raced results match the unraced ones
+            assert list(out["b"].scores) == list(rb.scores)
+            assert list(out["t"].scores) == list(rt.scores)
+        finally:
+            tpu.close()
+
+    def test_slow_lock_holder_surfaces_as_dispatch_wait(
+            self, svc, seeded_np):  # noqa: F811
+        """A deliberately-slow DEVICE_DISPATCH_LOCK holder shows up in
+        the profiler's batch_wait split as `dispatch` time — a visible
+        stall attribution, not a silent gap."""
+        from elasticsearch_tpu.parallel import distributed as dist
+
+        idx = make_corpus(svc, seeded_np, name="lockhold", docs=60)
+        tpu = _service()
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha beta")
+            assert tpu.try_search(idx, q, k=10) is not None  # warm
+
+            hold_s = 0.4
+            held = threading.Event()
+
+            def holder():
+                with dist.DEVICE_DISPATCH_LOCK:
+                    held.set()
+                    time.sleep(hold_s)
+
+            th = threading.Thread(target=holder)
+            th.start()
+            assert held.wait(5.0)
+            sink = {}
+            r = tpu.try_search(idx, q, k=10, profile_sink=sink)
+            th.join()
+            assert r is not None
+            split = sink["stages_ms"]["batch_wait_split"]
+            # the stall is attributed to dispatch (launch-side), not
+            # smeared into queue/window
+            assert split["dispatch"] >= hold_s * 1e3 * 0.5
+        finally:
+            tpu.close()
+
+
+# ---------------------------------------------------------------------
+# full-node: degraded marker, /_tpu/stats, Prometheus families
+# ---------------------------------------------------------------------
+
+def _do(node, method, path, body=None, **params):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path,
+                       {k: str(v) for k, v in params.items()}, None, raw)
+
+
+@pytest.fixture()
+def node(tmp_path):
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    n = Node(str(tmp_path / "data"), settings=Settings.of({}))
+    status, _ = _do(n, "PUT", "/lib", body={
+        "settings": {"index": {"number_of_shards": 2}},
+        "mappings": {"properties": {"title": {"type": "text"}}}})
+    assert status == 200
+    for i in range(8):
+        _do(n, "PUT", f"/lib/_doc/{i}", body={"title": f"gamma doc {i}"})
+    _do(n, "POST", "/lib/_refresh")
+    yield n
+    n.close()
+
+
+class TestDegradedServing:
+    def test_degraded_marker_stats_and_metrics(self, node):
+        body = {"query": {"match": {"title": "gamma"}}}
+        status, resp = _do(node, "POST", "/lib/_search", body=body)
+        assert status == 200 and "degraded" not in resp
+
+        with batcher_kill(node):
+            # while down: the planner answers, marked degraded
+            status, resp = _do(node, "POST", "/lib/_search", body=body)
+            assert status == 200
+            assert resp["degraded"] is True
+            assert resp["hits"]["total"]["value"] > 0
+            # recovery state is visible in /_tpu/stats
+            status, st = _do(node, "GET", "/_tpu/stats")
+            assert status == 200
+            assert st["supervision"]["state"] == "down"
+            assert st["supervision"]["degraded_served"] >= 1
+            assert st["watchdog"]["deadline_ms"] > 0
+
+        assert _wait(lambda: node.tpu_search.supervisor.state == "serving")
+        status, resp = _do(node, "POST", "/lib/_search", body=body)
+        assert status == 200 and "degraded" not in resp
+        # supervision families are scrapeable with live values
+        _, text = _do(node, "GET", "/_prometheus/metrics")
+        for family in ("es_tpu_watchdog_launches_total",
+                       "es_tpu_watchdog_wedges_total",
+                       "es_tpu_watchdog_inflight",
+                       "es_tpu_recovery_recoveries_total",
+                       "es_tpu_recovery_degraded_served_total",
+                       "es_tpu_recovery_state"):
+            assert f"# TYPE {family} " in text, f"missing {family}"
+        rec = [l for l in text.splitlines()
+               if l.startswith("es_tpu_recovery_recoveries_total")]
+        assert rec and float(rec[0].rsplit(" ", 1)[1]) >= 1
+        state = [l for l in text.splitlines()
+                 if l.startswith("es_tpu_recovery_state")]
+        assert state and float(state[0].rsplit(" ", 1)[1]) == 0  # serving
