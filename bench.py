@@ -114,18 +114,25 @@ def main() -> None:
     # the load phase and emit the batch_wait decomposition + top folded
     # stacks in the JSON (the attribution ledger for host-path PRs)
     profile_on = _env("PROFILE", 0) == 1
+    node_settings = {
+        "index": {"translog": {"durability": "async"}},
+        "search": {
+            "tracing": {"sample_rate": trace_sample},
+            "profiler": {"enabled": profile_on},
+            # every closed-loop client can have one request
+            # in flight per front — ring sized to match so
+            # the rest_qps phase measures throughput, not
+            # 429 churn
+            "tpu_serving": {
+                "front_slots": max(64, clients)}}}
+    if _env("SLO", 0) == 1:
+        # weighted tenants for the SLO phase; the default tenant keeps a
+        # 1/4 share = exactly the search pool size, so the single-tenant
+        # phases above never hit the carve
+        node_settings["tenancy"] = {"weight": {"victim": 2,
+                                               "aggressor": 1}}
     node = Node(tempfile.mkdtemp(prefix="es_tpu_bench_"),
-                settings=Settings.of({
-                    "index": {"translog": {"durability": "async"}},
-                    "search": {
-                        "tracing": {"sample_rate": trace_sample},
-                        "profiler": {"enabled": profile_on},
-                        # every closed-loop client can have one request
-                        # in flight per front — ring sized to match so
-                        # the rest_qps phase measures throughput, not
-                        # 429 churn
-                        "tpu_serving": {
-                            "front_slots": max(64, clients)}}}))
+                settings=Settings.of(node_settings))
     t0 = time.perf_counter()  # bulk ingest + refresh-to-searchable
     idx = node.create_index(
         "bench", Settings.of({"index": {
@@ -529,6 +536,49 @@ def main() -> None:
         }
         if herr1 or herr2:
             out["rest_qps"]["errors"] = (herr1 + herr2)[:3]
+
+    # ---- multi-tenant SLO phase (ES_TPU_BENCH_SLO=1): sustained
+    # mixed-tenant read/write traffic with one aggressor at max rate and
+    # a BatcherKill cycle mid-run; emits per-tenant
+    # {p50,p99,qps,rejects,lost_acks}. Like the warmup phase, the key is
+    # ALWAYS populated — a stalled or crashed run still reports. ----
+    if _env("SLO", 0) == 1:
+        from elasticsearch_tpu.testing.disruption import batcher_kill
+        from elasticsearch_tpu.testing.slo import run_slo
+        slo_s = _env("SLO_SECONDS", max(4, seconds // 2))
+        out["slo"] = {"error": None}
+        try:
+            def slo_chaos():
+                if node.tpu_search is None:
+                    return
+                time.sleep(slo_s * 0.3)
+                with batcher_kill(node):
+                    time.sleep(min(1.5, slo_s * 0.2))
+                # the rest of the run covers the recovery window
+
+            slo = run_slo(
+                node, index="bench", duration_s=slo_s,
+                search_body=query_bodies[0],
+                ports=(front_ports if n_fronts > 0
+                       and node.serving_front is not None else None),
+                tenants=[
+                    {"tenant": "victim", "readers": 2, "writers": 1,
+                     "think_time_s": 0.005},
+                    {"tenant": "aggressor", "readers": 4,
+                     "aggressor": True},
+                ],
+                during=slo_chaos)
+            slo["error"] = None
+            out["slo"] = slo
+            vic = slo["tenants"].get("victim", {})
+            agg = slo["tenants"].get("aggressor", {})
+            log(f"slo: victim p50={vic.get('p50_ms')}ms "
+                f"p99={vic.get('p99_ms')}ms qps={vic.get('qps')} "
+                f"lost_acks={vic.get('lost_acks')}; aggressor "
+                f"qps={agg.get('qps')} rejects={agg.get('rejects')}")
+        except Exception as e:  # noqa: BLE001 — the phase must emit
+            out["slo"]["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+            log(f"slo phase failed: {out['slo']['error']}")
 
     # ---- CPU oracle baseline on the same corpus/queries ----
     segments = []

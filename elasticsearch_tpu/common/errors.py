@@ -123,6 +123,19 @@ class EsRejectedExecutionException(EsException):
     status = 429
 
 
+class TenantThrottledException(EsRejectedExecutionException):
+    """A per-tenant admission quota rejected the request: THIS tenant is
+    over its weighted share of a node budget while other tenants keep
+    passing. Carries the tenant id and a Retry-After hint so the REST
+    layer can emit the backoff header."""
+
+    def __init__(self, reason: str, *, tenant: str,
+                 retry_after_s: float = 1.0, **md: Any):
+        super().__init__(reason, tenant=tenant, **md)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
 class TaskCancelledException(EsException):
     status = 400
 

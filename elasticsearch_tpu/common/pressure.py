@@ -38,8 +38,9 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from elasticsearch_tpu.common import tracing
-from elasticsearch_tpu.common.errors import EsRejectedExecutionException
+from elasticsearch_tpu.common import tenancy, tracing
+from elasticsearch_tpu.common.errors import (EsRejectedExecutionException,
+                                             TenantThrottledException)
 from elasticsearch_tpu.common.metrics import CounterMetric
 from elasticsearch_tpu.common.units import ByteSizeValue
 
@@ -86,6 +87,11 @@ class IndexingPressure:
         self._lock = threading.Lock()
         self._current: Dict[str, int] = {s: 0 for s in STAGES}
         self._tls = threading.local()
+        # set by the node: TenantQuotaService carving this limit into
+        # weighted per-tenant shares at the coordinating stage (None ⇒
+        # no tenant accounting; primary/replica stages are never
+        # tenant-checked — identity doesn't cross replication hops)
+        self.tenants = None
         self.coordinating_total = CounterMetric()
         self.primary_total = CounterMetric()
         self.replica_total = CounterMetric()
@@ -106,8 +112,31 @@ class IndexingPressure:
         if rejected:
             self._reject("coordinating", self.coordinating_rejections,
                          nbytes, combined, self.limit)
+        # tenant share second: when BOTH budgets are exhausted the
+        # node-level reject wins (an unconfigured node, whose lone
+        # tenant's cap equals the whole limit, keeps answering the
+        # pre-tenancy es_rejected error). A tenant reject must give the
+        # node charge back. Composing here — the single choke point
+        # every write admission flows through — means every release
+        # path the callers already guarantee (context managers, bulk
+        # `releases` lists, exception unwinds) releases the tenant
+        # charge too.
+        release_tenant = None
+        if self.tenants is not None:
+            try:
+                release_tenant = self.tenants.charge_write(nbytes)
+            except Exception:
+                self._releaser("coordinating", nbytes)()
+                raise
         self.coordinating_total.inc(nbytes)
-        return self._releaser("coordinating", nbytes)
+        release_node = self._releaser("coordinating", nbytes)
+        if release_tenant is None:
+            return release_node
+
+        def release() -> None:
+            release_node()
+            release_tenant()
+        return release
 
     def mark_primary(self, nbytes: int, *,
                      local_to_coordinating: Optional[bool] = None
@@ -292,6 +321,9 @@ class SearchBackpressureService:
         self.pressure = pressure
         self.thread_pools = thread_pools
         self.task_manager = task_manager
+        # set by the node: TenantQuotaService — under duress the
+        # dominant tenant is shed/declined first (None ⇒ oldest-first)
+        self.tenants = None
         self.shed = CounterMetric()
         self.declined = CounterMetric()
         self._queue_hot = 0
@@ -328,6 +360,27 @@ class SearchBackpressureService:
         if not self.under_duress():
             return
         self.shed_stale(exclude=task)
+        # duress + tenancy: the tenant responsible for the most of its
+        # own share is declined outright (even cheap searches) while it
+        # stays over that share — other tenants keep the normal
+        # cheap-searches-pass behavior. Single-tenant nodes never hit
+        # this: the default tenant's share is the whole budget and
+        # admission would have 429'd at the cap already.
+        quotas = self.tenants
+        if quotas is not None and quotas.enabled:
+            tenant = tenancy.current_tenant()
+            if (tenant == quotas.dominant_tenant()
+                    and quotas.over_share(tenant)):
+                self.declined.inc()
+                quotas.search_rejections.inc(tenant)
+                tracing.add_event(
+                    "search.backpressure.decline",
+                    reason="dominant tenant under duress", tenant=tenant)
+                raise TenantThrottledException(
+                    f"declining search for dominant tenant [{tenant}]: "
+                    "node is under duress and this tenant holds the "
+                    "largest fraction of its own admission share; "
+                    "retry with backoff", tenant=tenant)
         if self._is_expensive(body):
             self.declined.inc()
             tracing.add_event("search.backpressure.decline",
@@ -347,7 +400,16 @@ class SearchBackpressureService:
                      self.SEARCH_TASK_PATTERNS)
                  if t.cancellable and not t.cancelled and t is not exclude
                  and now - t._start >= self.stale_task_seconds]
-        stale.sort(key=lambda t: t._start)
+        # the dominant tenant's stale tasks go first — it is the one
+        # wasting the most of its own share — then oldest-first within
+        # each group (degenerates to plain oldest-first when tenancy is
+        # unwired or everything belongs to one tenant)
+        dominant = (self.tenants.dominant_tenant()
+                    if self.tenants is not None else None)
+        stale.sort(key=lambda t: (
+            0 if (dominant is not None
+                  and getattr(t, "tenant", None) == dominant) else 1,
+            t._start))
         cancelled = 0
         for t in stale[:max(0, self.cancel_max)]:
             t.cancel("cancelled by search backpressure: node under "

@@ -1,0 +1,321 @@
+"""Per-tenant QoS: weighted admission shares over the node's budgets.
+
+The north star is many users on one node; PR 5 (indexing pressure,
+search backpressure) and PR 10 (batcher supervision) protect the NODE
+from overload, but nothing stops one noisy tenant from eating the whole
+budget while every other tenant eats the 429s. This module carves the
+existing budgets into weighted per-tenant shares:
+
+  * tenant identity rides the `X-Tenant-Id` header (or the `tenant_id`
+    param) into REST dispatch, which binds it to the request thread;
+    everything downstream — indexing-pressure charges, search
+    admission, batch-lane composition, task stamping — reads the
+    thread-local instead of threading a parameter through every call
+    signature. Requests without a tenant belong to `_default`.
+  * search admission: each tenant may hold at most its weighted share
+    of `tenancy.search_slots` concurrent searches (the read-side
+    concurrency budget; defaults to a multiple of the search pool so a
+    single-tenant node never notices the carve).
+  * write admission: each tenant may hold at most its weighted share of
+    `indexing_pressure.memory.limit` in-flight coordinating bytes. The
+    charge composes with the node-level check inside
+    `IndexingPressure.mark_coordinating`, so every release path the
+    pressure accounting already guarantees covers the tenant charge
+    too — that is what makes the zero-drain chaos tests hold.
+
+Both carves are in-flight accounting (grant + idempotent release), not
+rate tokens — matching the pressure semantics and keeping "all counters
+drain to zero after chaos" assertable. Rejections raise the typed
+`TenantThrottledException` (429) with a Retry-After hint.
+
+Weights come from flat settings keys `tenancy.weight.<tenant>`; tenants
+without a configured weight collectively share one `default_weight`
+slice, so adding a weight never silently zeroes unconfigured tenants.
+With NO tenancy settings at all the default tenant's share is 1.0 —
+full budget, zero behavior change.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Dict, Optional
+
+from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             TenantThrottledException)
+from elasticsearch_tpu.common.metrics import LabeledCounters
+
+DEFAULT_TENANT = "_default"
+TENANT_HEADER = "X-Tenant-Id"
+TENANT_PARAM = "tenant_id"
+
+WEIGHT_PREFIX = "tenancy.weight."
+
+_TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+_tls = threading.local()
+
+
+def current_tenant() -> str:
+    """The tenant bound to this request thread (REST dispatch binds it)."""
+    return getattr(_tls, "tenant", DEFAULT_TENANT)
+
+
+def bind_tenant(tenant: Optional[str]) -> str:
+    """Bind `tenant` to this thread; → the prior binding. Callers must
+    restore the prior binding in a finally — front supervisors and the
+    thread pools reuse request threads across tenants."""
+    prev = getattr(_tls, "tenant", DEFAULT_TENANT)
+    _tls.tenant = tenant if tenant else DEFAULT_TENANT
+    return prev
+
+
+def resolve_tenant(value) -> str:
+    """Validate a wire-supplied tenant id; missing/empty → default."""
+    if value is None:
+        return DEFAULT_TENANT
+    value = str(value).strip()
+    if not value:
+        return DEFAULT_TENANT
+    if value != DEFAULT_TENANT and not _TENANT_RE.match(value):
+        raise IllegalArgumentException(
+            f"invalid tenant id [{value[:80]}]: must match "
+            "[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+    return value
+
+
+class _TenantState:
+    __slots__ = ("search_inflight", "write_bytes")
+
+    def __init__(self):
+        self.search_inflight = 0
+        self.write_bytes = 0
+
+
+class TenantQuotaService:
+    """Weighted in-flight admission quotas, one instance per node.
+
+    Wired onto `IndexingPressure.tenants` (write carve),
+    `SearchBackpressureService.tenants` (dominant-tenant shedding) and
+    `MicroBatcher.tenants` (weighted round-robin lanes)."""
+
+    def __init__(self, settings=None, *, write_limit_bytes: int = 0,
+                 search_slots: int = 32):
+        def opt(getter, key, default):
+            return getter(key, default) if settings is not None else default
+        get_bool = getattr(settings, "get_bool", None)
+        get_int = getattr(settings, "get_int", None)
+        get_float = getattr(settings, "get_float", None)
+        self.enabled = opt(get_bool, "tenancy.enabled", True)
+        self.default_weight = max(
+            1e-6, opt(get_float, "tenancy.default_weight", 1.0))
+        # read-side concurrency budget being carved; 0 → use the
+        # node-derived default (a multiple of the search pool size, so
+        # the default tenant's share always exceeds what the pool can
+        # run concurrently and an unconfigured node behaves as before)
+        self.search_slots = (opt(get_int, "tenancy.search_slots", 0)
+                             or max(1, int(search_slots)))
+        # write-side byte budget being carved (the indexing-pressure
+        # limit); <= 0 disables the write carve, like the pressure limit
+        self.write_limit = max(0, int(write_limit_bytes))
+        self.weights: Dict[str, float] = {}
+        if settings is not None:
+            for key, value in settings.get_as_dict().items():
+                if not key.startswith(WEIGHT_PREFIX):
+                    continue
+                name = key[len(WEIGHT_PREFIX):]
+                try:
+                    self.weights[name] = max(1e-6, float(value))
+                except (TypeError, ValueError):
+                    raise IllegalArgumentException(
+                        f"[{key}] must be a positive number, "
+                        f"got [{value}]")
+        # unconfigured tenants (including `_default`) collectively get
+        # one default_weight slice of the total
+        self.total_weight = sum(self.weights.values()) + self.default_weight
+        self._lock = threading.Lock()
+        self._states: Dict[str, _TenantState] = {}
+        self.search_admitted = LabeledCounters("tenant")
+        self.search_rejections = LabeledCounters("tenant")
+        self.write_bytes_total = LabeledCounters("tenant")
+        self.write_rejections = LabeledCounters("tenant")
+        # the es_tpu_tenant_* families must exist from the first scrape,
+        # not only after the first admission/rejection
+        for family in (self.search_admitted, self.search_rejections,
+                       self.write_bytes_total, self.write_rejections):
+            family.child(DEFAULT_TENANT)
+        self._state(DEFAULT_TENANT)
+
+    def _state(self, tenant: str) -> _TenantState:
+        state = self._states.get(tenant)
+        if state is None:
+            with self._lock:
+                state = self._states.setdefault(tenant, _TenantState())
+        return state
+
+    # -- share math --------------------------------------------------------
+
+    def weight(self, tenant: str) -> float:
+        return self.weights.get(tenant, self.default_weight)
+
+    def share(self, tenant: str) -> float:
+        return self.weight(tenant) / self.total_weight
+
+    def search_cap(self, tenant: str) -> int:
+        return max(1, int(round(self.share(tenant) * self.search_slots)))
+
+    def write_cap_bytes(self, tenant: str) -> int:
+        """0 → write carve disabled (no indexing-pressure limit)."""
+        if self.write_limit <= 0:
+            return 0
+        return max(1, int(self.share(tenant) * self.write_limit))
+
+    # -- admission ---------------------------------------------------------
+
+    def admit_search(self, tenant: Optional[str] = None
+                     ) -> Callable[[], None]:
+        """Grant one search admission slot to `tenant` (thread-bound
+        tenant when None) or raise the typed 429; → IDEMPOTENT release."""
+        tenant = tenant or current_tenant()
+        if not self.enabled:
+            return lambda: None
+        cap = self.search_cap(tenant)
+        state = self._state(tenant)
+        with self._lock:
+            inflight = state.search_inflight
+            rejected = inflight >= cap
+            if not rejected:
+                state.search_inflight += 1
+        if rejected:
+            self.search_rejections.inc(tenant)
+            tracing.add_event("tenant.search.reject", tenant=tenant,
+                              inflight=inflight, cap=cap)
+            raise TenantThrottledException(
+                f"tenant [{tenant}] exceeded its search admission share "
+                f"[inflight={inflight}, cap={cap}, "
+                f"weight={self.weight(tenant):g}/{self.total_weight:g}]; "
+                "retry with backoff", tenant=tenant)
+        self.search_admitted.inc(tenant)
+        return self._search_releaser(state)
+
+    def _search_releaser(self, state: _TenantState) -> Callable[[], None]:
+        done = {"released": False}
+
+        def release() -> None:
+            with self._lock:
+                if done["released"]:
+                    return
+                done["released"] = True
+                state.search_inflight -= 1
+        return release
+
+    def charge_write(self, nbytes: int, tenant: Optional[str] = None
+                     ) -> Callable[[], None]:
+        """Charge `nbytes` against `tenant`'s share of the coordinating
+        write budget or raise the typed 429; → IDEMPOTENT release."""
+        tenant = tenant or current_tenant()
+        nbytes = max(0, int(nbytes))
+        if not self.enabled:
+            return lambda: None
+        cap = self.write_cap_bytes(tenant)
+        state = self._state(tenant)
+        with self._lock:
+            current = state.write_bytes
+            rejected = 0 < cap < current + nbytes
+            if not rejected:
+                state.write_bytes += nbytes
+        if rejected:
+            self.write_rejections.inc(tenant)
+            tracing.add_event("tenant.write.reject", tenant=tenant,
+                              operation_bytes=nbytes, current_bytes=current,
+                              cap_bytes=cap)
+            raise TenantThrottledException(
+                f"tenant [{tenant}] exceeded its indexing-pressure share "
+                f"[current_bytes={current}, operation_bytes={nbytes}, "
+                f"cap_bytes={cap}, "
+                f"weight={self.weight(tenant):g}/{self.total_weight:g}]; "
+                "retry with backoff", tenant=tenant)
+        self.write_bytes_total.inc(tenant, n=nbytes)
+        return self._write_releaser(state, nbytes)
+
+    def _write_releaser(self, state: _TenantState, nbytes: int
+                        ) -> Callable[[], None]:
+        done = {"released": False}
+
+        def release() -> None:
+            with self._lock:
+                if done["released"]:
+                    return
+                done["released"] = True
+                state.write_bytes -= nbytes
+        return release
+
+    # -- duress integration ------------------------------------------------
+
+    def _ratio(self, tenant: str, state: _TenantState) -> float:
+        ratio = state.search_inflight / max(1, self.search_cap(tenant))
+        cap = self.write_cap_bytes(tenant)
+        if cap > 0:
+            ratio = max(ratio, state.write_bytes / cap)
+        return ratio
+
+    def dominant_tenant(self) -> Optional[str]:
+        """The tenant using the largest fraction of its own shares right
+        now (None when nothing is in flight) — the one the backpressure
+        service sheds/declines first under duress."""
+        with self._lock:
+            snap = list(self._states.items())
+        best, best_ratio = None, 0.0
+        for tenant, state in snap:
+            ratio = self._ratio(tenant, state)
+            if ratio > best_ratio:
+                best, best_ratio = tenant, ratio
+        return best
+
+    def over_share(self, tenant: str) -> bool:
+        """True when `tenant` holds at least its full share of some
+        budget — the decline-under-duress trigger (never fires for a
+        tenant comfortably inside its carve)."""
+        state = self._states.get(tenant)
+        if state is None:
+            return False
+        with self._lock:
+            return self._ratio(tenant, state) >= 1.0
+
+    # -- views -------------------------------------------------------------
+
+    def usage(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant in-flight snapshot (the zero-drain assertion)."""
+        with self._lock:
+            return {t: {"search_inflight": s.search_inflight,
+                        "write_bytes": s.write_bytes}
+                    for t, s in self._states.items()}
+
+    def stats(self) -> Dict[str, object]:
+        """The `_nodes/stats` `tenants` section."""
+        rejections = self.search_rejections.counts()
+        write_rejections = self.write_rejections.counts()
+        admitted = self.search_admitted.counts()
+        out: Dict[str, object] = {
+            "enabled": self.enabled,
+            "default_weight": self.default_weight,
+            "search_slots": self.search_slots,
+            "write_limit_in_bytes": self.write_limit,
+        }
+        tenants = {}
+        with self._lock:
+            snap = list(self._states.items())
+        for tenant, state in snap:
+            tenants[tenant] = {
+                "weight": self.weight(tenant),
+                "search_cap": self.search_cap(tenant),
+                "search_inflight": state.search_inflight,
+                "search_admitted": admitted.get(tenant, 0),
+                "search_rejections": rejections.get(tenant, 0),
+                "write_cap_in_bytes": self.write_cap_bytes(tenant),
+                "write_bytes_in_flight": state.write_bytes,
+                "write_rejections": write_rejections.get(tenant, 0),
+            }
+        out["tenants"] = tenants
+        return out

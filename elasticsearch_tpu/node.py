@@ -144,6 +144,23 @@ class Node:
             self.settings, pressure=self.indexing_pressure,
             thread_pools=self.thread_pools,
             task_manager=self.task_manager)
+        # per-tenant QoS: weighted shares carved from the SAME budgets
+        # the node-level guards enforce. The default search budget is a
+        # multiple of the search pool, so an unconfigured node (every
+        # request the default tenant, share 1.0) behaves exactly as
+        # before the carve existed.
+        from elasticsearch_tpu.common.tenancy import TenantQuotaService
+        search_pool = self.thread_pools.get("search")
+        self.tenants = TenantQuotaService(
+            self.settings,
+            write_limit_bytes=self.indexing_pressure.limit,
+            search_slots=max(
+                32, 4 * (search_pool.size if search_pool is not None
+                         else 8)))
+        self.indexing_pressure.tenants = self.tenants
+        self.search_backpressure.tenants = self.tenants
+        if self.tpu_search is not None:
+            self.tpu_search.batcher.tenants = self.tenants
         self.controller = RestController()
         self.controller.thread_pools = self.thread_pools
         # tracing: per-request root spans + propagation through the
@@ -553,6 +570,42 @@ class Node:
             yield ("search.backpressure.shed", {}, sb.shed)
             yield ("search.backpressure.declined", {}, sb.declined)
         reg.add_collector(_pressure)
+
+        reg.set_help("tenant.search_inflight",
+                     "Searches a tenant currently holds admission for")
+        reg.set_help("tenant.search_admitted",
+                     "Searches admitted under a tenant's share")
+        reg.set_help("tenant.search_rejections",
+                     "Searches 429'd by a tenant's admission share")
+        reg.set_help("tenant.write_bytes_inflight",
+                     "In-flight coordinating write bytes held per tenant")
+        reg.set_help("tenant.write_bytes",
+                     "Coordinating write bytes ever charged per tenant")
+        reg.set_help("tenant.write_rejections",
+                     "Writes 429'd by a tenant's indexing-pressure share")
+        reg.set_help("tenant.weight", "Configured tenant admission weight")
+
+        def _tenants():
+            tq = self.tenants
+            for tenant, use in tq.usage().items():
+                lb = {"tenant": tenant}
+                yield ("tenant.search_inflight", lb,
+                       use["search_inflight"], "gauge")
+                yield ("tenant.write_bytes_inflight", lb,
+                       use["write_bytes"], "gauge")
+                yield ("tenant.weight", lb, tq.weight(tenant), "gauge")
+                yield ("tenant.search_cap", lb, tq.search_cap(tenant),
+                       "gauge")
+                yield ("tenant.write_cap_bytes", lb,
+                       tq.write_cap_bytes(tenant), "gauge")
+            for family, name in (
+                    (tq.search_admitted, "tenant.search_admitted"),
+                    (tq.search_rejections, "tenant.search_rejections"),
+                    (tq.write_bytes_total, "tenant.write_bytes"),
+                    (tq.write_rejections, "tenant.write_rejections")):
+                for labels, metric in family.items():
+                    yield (name, labels, metric)
+        reg.add_collector(_tenants)
         reg.set_help("profiler.samples",
                      "Host sampling-profiler stack samples collected")
         reg.set_help("profiler.overhead_ratio",
@@ -737,10 +790,17 @@ class _Handler(BaseHTTPRequestHandler):
         traceparent = self.headers.get("traceparent")
         if traceparent:
             params["traceparent"] = traceparent
+        # tenant identity arrives the same way (header wins; the
+        # controller validates and binds it to the dispatch thread)
+        tenant = self.headers.get("X-Tenant-Id")
+        if tenant:
+            params["tenant_id"] = tenant
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b""
         status, payload = self.node.handle(self.command, parsed.path, params,
                                            None, raw)
+        extra_headers = (payload.pop("_headers", None)
+                         if isinstance(payload, dict) else None)
         if isinstance(payload, dict) and "_cat" in payload and len(payload) == 1:
             data = payload["_cat"].encode("utf-8")
             ctype = "text/plain; charset=UTF-8"
@@ -759,6 +819,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.send_header("X-elastic-product", "Elasticsearch-TPU")
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)

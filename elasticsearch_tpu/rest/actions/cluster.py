@@ -129,6 +129,9 @@ def register(controller: RestController, node) -> None:
         if getattr(node, "search_backpressure", None) is not None:
             out["nodes"][node.node_id]["search_backpressure"] = \
                 node.search_backpressure.stats()
+        if getattr(node, "tenants", None) is not None:
+            # per-tenant QoS: weights, caps, in-flight and rejections
+            out["nodes"][node.node_id]["tenants"] = node.tenants.stats()
         return 200, out
 
     # ---------------- _cat ----------------

@@ -46,11 +46,16 @@ def register(controller: RestController, node) -> None:
         task = node.task_manager.register(
             "indices:data/read/search",
             description=f"indices[{req.param('index') or '_all'}]")
+        release_quota = None
         try:
             body = req.body or {}
-            # load shedding before any fan-out: under node duress the
-            # oldest stale search tasks are cancelled and an expensive
-            # incoming search is declined with 429
+            # per-tenant carve first (a 429 here is THIS tenant over its
+            # concurrency share — other tenants keep passing), then node
+            # duress: under it the oldest stale search tasks are
+            # cancelled and an expensive incoming search declined
+            quotas = getattr(node, "tenants", None)
+            if quotas is not None:
+                release_quota = quotas.admit_search()
             backpressure = getattr(node, "search_backpressure", None)
             if backpressure is not None:
                 backpressure.admit(body, task=task)
@@ -60,6 +65,8 @@ def register(controller: RestController, node) -> None:
             return 200, _execute_search(req.param("index"), body,
                                         req.params, task)
         finally:
+            if release_quota is not None:
+                release_quota()
             node.task_manager.unregister(task)
 
     def scroll_page(req: RestRequest):
@@ -160,7 +167,14 @@ def register(controller: RestController, node) -> None:
             description=f"[{len(lines) // 2}] searches")
         responses = []
         default_index = req.param("index")
+        release_quota = None
         try:
+            # one admission slot covers the whole msearch (its items run
+            # sequentially on this thread — charging per item would let
+            # one request hold N slots)
+            quotas = getattr(node, "tenants", None)
+            if quotas is not None:
+                release_quota = quotas.admit_search()
             for i in range(0, len(lines), 2):
                 task.ensure_not_cancelled()
                 try:
@@ -190,6 +204,8 @@ def register(controller: RestController, node) -> None:
                     item["status"] = status
                     responses.append(item)
         finally:
+            if release_quota is not None:
+                release_quota()
             node.task_manager.unregister(task)
         return 200, {"took": sum(r.get("took", 0) for r in responses),
                      "responses": responses}

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common import errors as es_errors
 from elasticsearch_tpu.common import profiler as _profiler
+from elasticsearch_tpu.common import tenancy as _tenancy
 from elasticsearch_tpu.common import tracing as _tracing
 
 
@@ -93,6 +94,24 @@ def error_body(exc: Exception, status: int) -> Dict[str, Any]:
     snake = snake.replace("_exception", "_exception")
     cause = {"type": snake, "reason": str(exc)}
     return {"error": {"root_cause": [cause], **cause}, "status": status}
+
+
+def rejection_headers(exc: Exception, status: int
+                      ) -> Optional[Dict[str, str]]:
+    """Backoff headers for overload/unavailable answers: every 429/503
+    carries `Retry-After` so clients across all rejection paths
+    (pressure, backpressure, tenant quota, degraded serving) back off
+    the same way. Rides the payload as a reserved `_headers` key —
+    dispatch returns (status, body) with no header channel — which the
+    HTTP edges (node handler, front wire encoder) pop and emit."""
+    if status not in (429, 503):
+        return None
+    retry_after = getattr(exc, "retry_after_s", 1.0)
+    try:
+        retry_after = max(1, int(round(float(retry_after))))
+    except (TypeError, ValueError):
+        retry_after = 1
+    return {"Retry-After": str(retry_after)}
 
 
 _SEARCH_SUFFIXES = ("_search", "_msearch", "_count", "_search_shards",
@@ -206,6 +225,14 @@ class RestController:
         # header or query param — the caller's sampling decision wins),
         # else open a locally-sampled root span
         traceparent = params.pop("traceparent", None)
+        # tenant identity: validated here at the admission boundary and
+        # bound to the request thread — pressure charges, search quota,
+        # batch lanes and task stamping all read the thread-local
+        try:
+            tenant = _tenancy.resolve_tenant(
+                params.pop(_tenancy.TENANT_PARAM, None))
+        except es_errors.IllegalArgumentException as exc:
+            return 400, error_body(exc, 400)
         req = RestRequest(method.upper(), path, params, body, raw_body)
         span = None
         tracer = self.tracer
@@ -225,6 +252,7 @@ class RestController:
             _profiler.tag_thread(
                 classify_pool(req.method, path) or "management",
                 span.trace_id if span is not None else None)
+        prev_tenant = _tenancy.bind_tenant(tenant)
         try:
             if span is None:
                 if self.thread_pools is not None:
@@ -254,6 +282,11 @@ class RestController:
             status = error_status(exc)
             if status == 500:
                 traceback.print_exc()
-            return status, error_body(exc, status)
+            payload = error_body(exc, status)
+            headers = rejection_headers(exc, status)
+            if headers:
+                payload["_headers"] = headers
+            return status, payload
         finally:
+            _tenancy.bind_tenant(prev_tenant)
             _profiler.untag_thread()

@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from elasticsearch_tpu.common import profiler, tracing
+from elasticsearch_tpu.common import profiler, tenancy, tracing
 from elasticsearch_tpu.common.metrics import CounterMetric, LabeledCounters
 from elasticsearch_tpu.mapping.types import TextFieldType
 from elasticsearch_tpu.parallel import distributed as dist
@@ -604,6 +604,11 @@ class _Pending:
     t_cycle: float = 0.0
     t_take: float = 0.0
     t_launched: float = 0.0
+    # owning tenant (stamped on the request thread): batch composition
+    # takes weighted round-robin across tenant lanes so one tenant's
+    # burst can't monopolize batch slots ahead of tenants already
+    # waiting — the starved lane's cost would show up as batch_wait.queue
+    tenant: str = tenancy.DEFAULT_TENANT
 
 
 def _batch_bucket(n: int, cap: int) -> int:
@@ -611,6 +616,59 @@ def _batch_bucket(n: int, cap: int) -> int:
     while b < n:
         b *= 2
     return min(b, cap)
+
+
+def _take_fair(pendings: List[_Pending], cap: int,
+               weight_of) -> Tuple[List[_Pending], List[_Pending]]:
+    """Compose one batch train of up to `cap` queries from `pendings`
+    by weighted round-robin across tenant lanes → (taken, remaining).
+
+    Each waiting tenant gets a quota proportional to its weight (never
+    below 1 slot, so no lane starves); lanes are drained one query at a
+    time in rotation, FIFO within a lane. Leftover capacity after every
+    quota is met is filled ignoring quotas — a full train always beats
+    strict proportionality (padding is already paid). The overwhelmingly
+    common single-tenant case returns a plain slice."""
+    if len(pendings) <= cap:
+        return pendings, []
+    first = pendings[0].tenant
+    if all(p.tenant == first for p in pendings):
+        return pendings[:cap], pendings[cap:]
+    lanes: Dict[str, List[_Pending]] = {}
+    order: List[str] = []
+    for p in pendings:
+        lane = lanes.get(p.tenant)
+        if lane is None:
+            lanes[p.tenant] = lane = []
+            order.append(p.tenant)
+        lane.append(p)
+    weights = {t: max(1e-6, float(weight_of(t))) for t in order}
+    total = sum(weights.values())
+    quota = {t: max(1, int(cap * weights[t] / total)) for t in order}
+    taken: List[_Pending] = []
+    cursor = {t: 0 for t in order}
+    enforce_quota = True
+    while len(taken) < cap:
+        progressed = False
+        for t in order:
+            if len(taken) >= cap:
+                break
+            i = cursor[t]
+            if i >= len(lanes[t]) or (enforce_quota and i >= quota[t]):
+                continue
+            taken.append(lanes[t][i])
+            cursor[t] = i + 1
+            progressed = True
+        if not progressed:
+            if enforce_quota:
+                enforce_quota = False
+                continue
+            break
+    taken_ids = {id(p) for p in taken}
+    # remainder keeps the original arrival order (lane concatenation
+    # would distort the next train's rotation and the queue-wait marks)
+    remaining = [p for p in pendings if id(p) not in taken_ids]
+    return taken, remaining
 
 
 class _PackQueue:
@@ -720,8 +778,9 @@ class _PackQueue:
                                     0.05, batcher.window_s)
                                 continue
                             self.cv.wait(timeout=deadline - now)
-                        taken = self.pendings[: batcher.max_batch]
-                        self.pendings = self.pendings[batcher.max_batch:]
+                        taken, self.pendings = _take_fair(
+                            self.pendings, batcher.max_batch,
+                            batcher.tenant_weight)
                         t_take = time.perf_counter()
                         for p in taken:
                             p.t_cycle = t_cycle
@@ -887,7 +946,8 @@ class MicroBatcher:
         # capture on the REQUEST thread — the batch workers have no
         # request thread-local to read
         pending = _Pending(flat, k, fut, tracing.current_span(),
-                           t_submit=time.perf_counter())
+                           t_submit=time.perf_counter(),
+                           tenant=tenancy.current_tenant())
         fut.pending = pending  # type: ignore[attr-defined]
         while True:
             with self._lock:
@@ -919,6 +979,15 @@ class MicroBatcher:
     # launch watchdog (None = unmonitored): workers stamp a deadline on
     # every device dispatch through it
     watchdog: Optional["LaunchWatchdog"] = None
+    # set by the node: TenantQuotaService supplying lane weights for
+    # fair batch composition (None ⇒ equal weights)
+    tenants = None
+
+    def tenant_weight(self, tenant: str) -> float:
+        quotas = self.tenants
+        if quotas is None:
+            return 1.0
+        return quotas.weight(tenant)
 
 
 @dataclasses.dataclass
@@ -1681,6 +1750,9 @@ class BatcherSupervisor:
             fresh.mesh = svc.packs.mesh
             fresh.stages = svc.stages
             fresh.watchdog = svc.watchdog
+            # quota enforcement and fair lanes stay active through the
+            # degraded → recovering → serving transitions
+            fresh.tenants = old.tenants
             svc.batcher = fresh
             svc.packs.on_evict = fresh.retire_pack
             # eager re-residency: rebuild every dropped pack through the

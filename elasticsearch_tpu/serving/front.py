@@ -47,6 +47,26 @@ _READY_TIMEOUT_S = 20.0
 _PUBLISH_INTERVAL_S = 0.25
 
 
+def _rejection_json(error_type: str, reason: str, status: int) -> str:
+    """Front-local rejection body in the controller's error shape
+    (root_cause + type/reason + status) — every rejection path across
+    the node answers the same structure, whether the batcher process
+    was reachable or not. Hand-rolled: fronts stay import-light."""
+    import json as _json
+    cause = _json.dumps({"type": error_type, "reason": reason})
+    return ('{"error":{"root_cause":[%s],"type":%s,"reason":%s},'
+            '"status":%d}' % (cause, _json.dumps(error_type),
+                              _json.dumps(reason), status))
+
+
+#: ring-exhausted 429: this front's slot ring has no free slot — the
+#: same backoff contract (Retry-After + structured body) as every other
+#: rejection
+RING_FULL_BODY: bytes = _rejection_json(
+    "es_rejected_execution_exception",
+    "serving-front slot ring is full", 429).encode()
+
+
 def _free_port(host: str) -> int:
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -136,10 +156,10 @@ class _FrontState:
     def _batcher_down_wire(self) -> Dict[str, Any]:
         return {"status": 503, "ctype": "json",
                 "headers": {"Retry-After": "1"},
-                "parts": ['{"error":{"type":"batcher_unavailable_'
-                          'exception","reason":"the device-owning '
-                          'batcher process is down or unresponsive; '
-                          'retry shortly"},"status":503}'],
+                "parts": [_rejection_json(
+                    "batcher_unavailable_exception",
+                    "the device-owning batcher process is down or "
+                    "unresponsive; retry shortly", 503)],
                 "columns": []}
 
     def _enter_batcher_down(self, reason: str) -> None:
@@ -213,9 +233,11 @@ class _FrontState:
             self.pending.pop(slot, None)
             self.c_timeouts.inc()
             return {"status": 503, "ctype": "json",
-                    "parts": ['{"error":{"type":"timeout_exception",'
-                              '"reason":"batcher did not answer in '
-                              f'{self.timeout_s}s"}},"status":503}}'],
+                    "headers": {"Retry-After": "1"},
+                    "parts": [_rejection_json(
+                        "timeout_exception",
+                        "batcher did not answer in "
+                        f"{self.timeout_s}s", 503)],
                     "columns": []}
         return pickle.loads(waiter.data)
 
@@ -321,6 +343,12 @@ class _FrontHandler(BaseHTTPRequestHandler):
             traceparent = self.headers.get("traceparent")
             if traceparent:
                 params["traceparent"] = traceparent
+            # tenant identity rides the wire descriptor as a param; the
+            # batcher-side dispatch validates and binds it (mirrors the
+            # in-process node handler)
+            tenant = self.headers.get("X-Tenant-Id")
+            if tenant:
+                params["tenant_id"] = tenant
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b""
             wire_req = {"kind": "proxy", "method": self.command,
@@ -349,11 +377,8 @@ class _FrontHandler(BaseHTTPRequestHandler):
                 state.c_proxied.inc()
             wire = state.roundtrip(wire_req)
             if wire is None:
-                self._reply(429, "json",
-                            b'{"error":{"type":'
-                            b'"es_rejected_execution_exception","reason":'
-                            b'"serving-front slot ring is full"},'
-                            b'"status":429}')
+                self._reply(429, "json", RING_FULL_BODY,
+                            {"Retry-After": "1"})
                 return
             from elasticsearch_tpu.search.serializer import splice_wire
             text = splice_wire(wire["parts"], wire["columns"])
@@ -658,6 +683,11 @@ class FrontSupervisor:
     def _encode(status: int, payload: Any) -> Dict[str, Any]:
         """Mirror node._Handler._do's payload shaping, but columnar:
         hits blocks leave as splice columns for the front's C splicer."""
+        headers = None
+        if isinstance(payload, dict):
+            # dispatch-attached response headers (Retry-After on
+            # 429/503) ride the wire so the front emits them
+            headers = payload.pop("_headers", None)
         if isinstance(payload, dict) and "_cat" in payload \
                 and len(payload) == 1:
             return {"status": status, "ctype": "text",
@@ -667,8 +697,11 @@ class FrontSupervisor:
                     "parts": [payload], "columns": []}
         from elasticsearch_tpu.search.serializer import encode_wire_response
         parts, columns = encode_wire_response(payload)
-        return {"status": status, "ctype": "json", "parts": parts,
+        wire = {"status": status, "ctype": "json", "parts": parts,
                 "columns": columns}
+        if headers:
+            wire["headers"] = headers
+        return wire
 
     # -- crash resilience ---------------------------------------------
 

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from elasticsearch_tpu.common import tenancy
 from elasticsearch_tpu.common.errors import (ResourceNotFoundException,
                                              TaskCancelledException)
 
@@ -54,6 +55,10 @@ class Task:
         # cross-node task tree (reference: TaskId parent linkage; the
         # _tasks API shows children under ?parent_task_id=)
         self.parent_task_id = parent_task_id
+        # owning tenant, read from the binding REST dispatch installed
+        # on this request thread — lets search backpressure shed the
+        # dominant tenant's tasks first under duress
+        self.tenant = tenancy.current_tenant()
         self.start_time_millis = int(time.time() * 1000)
         self._start = time.monotonic()
         self._cancelled = threading.Event()
@@ -92,6 +97,8 @@ class Task:
         }
         if self.parent_task_id is not None:
             out["parent_task_id"] = self.parent_task_id
+        if self.tenant != tenancy.DEFAULT_TENANT:
+            out["tenant"] = self.tenant
         return out
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 import contextlib
 import random
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Iterator, Optional, Set, Tuple
 
@@ -539,6 +540,140 @@ def batcher_kill(node=None, **kwargs) -> Iterator[BatcherKill]:
     recovery runs and the front bridge resumes (even when the body's
     assertions fail)."""
     scheme = BatcherKill(node, **kwargs)
+    scheme.start()
+    try:
+        yield scheme
+    finally:
+        scheme.heal()
+
+
+class TenantFlood(Scheme):
+    """Noisy-neighbor injection: drives ONE tenant at max rate through
+    the real REST dispatch until healed — the aggressor half of every
+    multi-tenant QoS test and of the SLO harness. Requests go through
+    `node.handle` with the flood tenant bound (or over HTTP with the
+    `X-Tenant-Id` header when `port` is given), so they hit the same
+    admission carve, backpressure, and batch lanes as real traffic.
+    Per-status tallies are kept for assertions (`statuses[429]` is the
+    aggressor's typed-rejection count). Never intercepts sends, so it
+    composes with LoadSpike/FrontKill/BatcherKill in one scheme list."""
+
+    def __init__(self, node=None, *, tenant: str = "flood", threads: int = 4,
+                 method: str = "POST", path: str = "/_search",
+                 body: Optional[dict] = None,
+                 params: Optional[Dict[str, str]] = None,
+                 port: Optional[int] = None, host: str = "127.0.0.1",
+                 reject_backoff_s: float = 0.001):
+        self.node = node
+        self.tenant = tenant
+        self.n_threads = max(1, int(threads))
+        self.method = method
+        self.path = path
+        self.body = body if body is not None else {"query": {"match_all": {}}}
+        self.params = dict(params or {})
+        self.port = port
+        self.host = host
+        # a throttled flood re-issues almost immediately, but yields for
+        # a moment after each 429 — an in-process flood otherwise burns
+        # the interpreter lock spinning through rejected dispatches and
+        # the test measures GIL starvation instead of admission fairness
+        self.reject_backoff_s = max(0.0, float(reject_backoff_s))
+        self.statuses: Dict[int, int] = {}
+        self.errors: list = []
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._tally_lock = threading.Lock()
+        self._started = False
+        self._lock = threading.Lock()
+
+    def _tally(self, status: int) -> None:
+        with self._tally_lock:
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+
+    def _run_inprocess(self) -> None:
+        params = dict(self.params)
+        params["tenant_id"] = self.tenant
+        while not self._stop.is_set():
+            try:
+                status, _payload = self.node.handle(
+                    self.method, self.path, dict(params),
+                    dict(self.body))
+                self._tally(status)
+                if status == 429 and self.reject_backoff_s:
+                    time.sleep(self.reject_backoff_s)
+            except Exception as e:  # noqa: BLE001 — surfaced via errors
+                self.errors.append(e)
+
+    def _run_http(self) -> None:
+        import http.client
+        import json as _json
+        data = _json.dumps(self.body)
+        headers = {"Content-Type": "application/json",
+                   "X-Tenant-Id": self.tenant}
+        while not self._stop.is_set():
+            try:
+                conn = http.client.HTTPConnection(self.host, self.port,
+                                                  timeout=10.0)
+                try:
+                    while not self._stop.is_set():
+                        conn.request(self.method, self.path, data, headers)
+                        resp = conn.getresponse()
+                        resp.read()
+                        self._tally(resp.status)
+                        if resp.status == 429 and self.reject_backoff_s:
+                            time.sleep(self.reject_backoff_s)
+                finally:
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 — reconnect (the
+                # flooded server may drop/churn connections under kill
+                # schemes; that is not a flood failure)
+                if not self._stop.is_set():
+                    self.errors.append(e)
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        if self.port is None and self.node is None:
+            raise RuntimeError("TenantFlood needs a node (in-process) "
+                               "or a port (HTTP)")
+        target = self._run_http if self.port is not None \
+            else self._run_inprocess
+        self._threads = [
+            threading.Thread(target=target, daemon=True,
+                             name=f"tenant-flood-{self.tenant}-{i}")
+            for i in range(self.n_threads)]
+        for t in self._threads:
+            t.start()
+
+    def intercept(self, src, dst, action):
+        return None  # a load fault, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+            started = self._started
+        self._stop.set()
+        if not started:
+            return
+        for t in self._threads:
+            t.join(timeout=10.0)
+
+    @property
+    def requests(self) -> int:
+        with self._tally_lock:
+            return sum(self.statuses.values())
+
+
+@contextlib.contextmanager
+def tenant_flood(node=None, **kwargs) -> Iterator[TenantFlood]:
+    """Context-managed TenantFlood: the flood starts on entry and its
+    client threads are stopped and joined on exit (even when the body's
+    assertions fail)."""
+    scheme = TenantFlood(node, **kwargs)
     scheme.start()
     try:
         yield scheme

@@ -1,0 +1,217 @@
+"""Sustained-load SLO harness (ROADMAP item 1): mixed-tenant read/write
+traffic with per-tenant latency/reject/lost-ack accounting.
+
+Seeded from the chaos-supervision harness, generalized three ways:
+
+  * traffic is TENANT-SHAPED — each entry in `tenants` runs its own
+    closed-loop readers/writers with its tenant id bound (in-process
+    through `node.handle`, or over HTTP with the `X-Tenant-Id` header
+    when `ports` is given), so an `aggressor` tenant saturates ITS
+    admission share while victims stay inside theirs;
+  * disruptions compose — `during` runs on the driver thread while
+    traffic flows, so callers open `tenant_flood` / `batcher_kill` /
+    `load_spike` / `device_wedge` windows mid-run;
+  * results ALWAYS come back — per-tenant p50/p99/qps, reject counts,
+    error samples, and lost acked writes (acked doc ids re-read at the
+    end; the engine get sees live docs regardless of refresh timing),
+    with partial numbers even when the run aborts. Status codes split
+    three ways: 429 is a reject (quota/backpressure doing its job),
+    503 is unavailable (degraded windows), anything else non-2xx is an
+    error — SLO runs assert errors == 0, not rejects == 0.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common import tenancy
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
+    return ordered[idx]
+
+
+class _TenantTraffic:
+    """One tenant's closed-loop traffic threads + tallies."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.tenant = spec["tenant"]
+        self.readers = int(spec.get("readers", 0))
+        self.writers = int(spec.get("writers", 0))
+        # aggressor: zero think time — run as fast as admission allows
+        self.aggressor = bool(spec.get("aggressor", False))
+        self.think_time_s = (0.0 if self.aggressor
+                             else float(spec.get("think_time_s", 0.005)))
+        self.lock = threading.Lock()
+        self.latencies: List[float] = []
+        self.reads = 0
+        self.writes = 0          # acked only
+        self.rejects = 0         # 429
+        self.unavailable = 0     # 503
+        self.errors: List[str] = []
+        self.acked_ids: List[str] = []
+
+    def tally(self, status: int, latency_s: Optional[float]) -> None:
+        with self.lock:
+            if status == 429:
+                self.rejects += 1
+            elif status == 503:
+                self.unavailable += 1
+            elif 200 <= status < 300:
+                if latency_s is not None:
+                    self.latencies.append(latency_s)
+
+    def result(self, duration_s: float, lost: List[str]) -> Dict[str, Any]:
+        with self.lock:
+            lat = list(self.latencies)
+            return {
+                "reads": self.reads,
+                "writes_acked": self.writes,
+                "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+                "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+                "qps": round(len(lat) / max(1e-9, duration_s), 1),
+                "rejects": self.rejects,
+                "unavailable": self.unavailable,
+                "errors": self.errors[:3],
+                "error_count": len(self.errors),
+                "lost_acks": len(lost),
+                "lost_ack_ids": lost[:5],
+            }
+
+
+def run_slo(node, *, index: str, duration_s: float,
+            tenants: List[Dict[str, Any]],
+            search_body: Optional[dict] = None,
+            ports: Optional[List[int]] = None,
+            during: Optional[Callable[[], None]] = None,
+            join_timeout_s: float = 20.0) -> Dict[str, Any]:
+    """Drive mixed-tenant traffic against `index` for `duration_s`;
+    → {"tenants": {name: {p50_ms, p99_ms, qps, rejects, lost_acks,
+    ...}}, "duration_s", "hung_threads", "aborted"}.
+
+    `tenants` entries: {"tenant", "readers", "writers", "think_time_s",
+    "aggressor"}. With `ports`, traffic goes over HTTP round-robin
+    (serving fronts or the node server); otherwise in-process through
+    `node.handle`. `during()` runs once on the driver thread while
+    traffic flows — compose disruption windows there. Always returns
+    (partial results on abort; the caller asserts, this reports)."""
+    specs = [_TenantTraffic(dict(s)) for s in tenants]
+    body = search_body or {"query": {"match_all": {}}, "size": 5}
+    stop = threading.Event()
+    out: Dict[str, Any] = {"duration_s": 0.0, "hung_threads": [],
+                           "aborted": None, "tenants": {}}
+
+    def _request(tenant: str, method: str, path: str,
+                 req_body: Any) -> int:
+        if ports:
+            import http.client
+            import json as _json
+            port = ports[hash(threading.get_ident()) % len(ports)]
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=15.0)
+            try:
+                conn.request(method, path,
+                             _json.dumps(req_body) if req_body is not None
+                             else None,
+                             {"Content-Type": "application/json",
+                              "X-Tenant-Id": tenant})
+                resp = conn.getresponse()
+                resp.read()
+                return resp.status
+            finally:
+                conn.close()
+        status, _payload = node.handle(
+            method, path, {tenancy.TENANT_PARAM: tenant},
+            dict(req_body) if isinstance(req_body, dict) else req_body)
+        return status
+
+    def reader(traffic: _TenantTraffic) -> None:
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                status = _request(traffic.tenant, "POST",
+                                  f"/{index}/_search", body)
+                traffic.tally(status, time.monotonic() - t0)
+                with traffic.lock:
+                    traffic.reads += 1
+                    if status not in (429, 503) and not 200 <= status < 300:
+                        traffic.errors.append(f"read status {status}")
+            except Exception as e:  # noqa: BLE001 — surfaced in result
+                with traffic.lock:
+                    traffic.errors.append(f"read {type(e).__name__}: {e}")
+            if traffic.think_time_s:
+                time.sleep(traffic.think_time_s)
+
+    def writer(traffic: _TenantTraffic, seq: int) -> None:
+        i = 0
+        while not stop.is_set():
+            doc_id = f"slo-{traffic.tenant}-{seq}-{i}"
+            try:
+                status = _request(
+                    traffic.tenant, "PUT", f"/{index}/_doc/{doc_id}",
+                    {"body": "alpha omega", "tenant": traffic.tenant})
+                traffic.tally(status, None)
+                with traffic.lock:
+                    if 200 <= status < 300:
+                        # the ack: this doc must be readable at the end
+                        traffic.writes += 1
+                        traffic.acked_ids.append(doc_id)
+                    elif status not in (429, 503):
+                        traffic.errors.append(f"write status {status}")
+            except Exception as e:  # noqa: BLE001 — surfaced in result
+                with traffic.lock:
+                    traffic.errors.append(f"write {type(e).__name__}: {e}")
+            i += 1
+            time.sleep(max(0.002, traffic.think_time_s))
+
+    threads: List[threading.Thread] = []
+    for traffic in specs:
+        threads += [threading.Thread(
+            target=reader, args=(traffic,), daemon=True,
+            name=f"slo-read-{traffic.tenant}-{i}")
+            for i in range(traffic.readers)]
+        threads += [threading.Thread(
+            target=writer, args=(traffic, i), daemon=True,
+            name=f"slo-write-{traffic.tenant}-{i}")
+            for i in range(traffic.writers)]
+
+    t_start = time.monotonic()
+    try:
+        for t in threads:
+            t.start()
+        deadline = t_start + duration_s
+        if during is not None:
+            during()
+        while time.monotonic() < deadline and not stop.is_set():
+            time.sleep(0.02)
+    except Exception as e:  # noqa: BLE001 — partial results still emit
+        out["aborted"] = f"{type(e).__name__}: {e}"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=join_timeout_s)
+        out["duration_s"] = round(time.monotonic() - t_start, 3)
+        out["hung_threads"] = [t.name for t in threads if t.is_alive()]
+        # lost-ack audit: every acked doc must be readable in-process
+        # (verification correctness is independent of the wire mode)
+        for traffic in specs:
+            with traffic.lock:
+                acked = list(traffic.acked_ids)
+            lost = []
+            for doc_id in acked:
+                try:
+                    status, _ = node.handle("GET",
+                                            f"/{index}/_doc/{doc_id}")
+                    if status != 200:
+                        lost.append(doc_id)
+                except Exception:  # noqa: BLE001 — count as lost
+                    lost.append(doc_id)
+            out["tenants"][traffic.tenant] = traffic.result(
+                out["duration_s"], lost)
+    return out

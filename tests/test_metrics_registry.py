@@ -223,7 +223,16 @@ def test_required_families_are_present(node):
             "es_tpu_recovery_recoveries_total",
             "es_tpu_recovery_degraded_served_total",
             "es_tpu_recovery_state",
-            "es_tpu_recovery_last_duration_seconds"):
+            "es_tpu_recovery_last_duration_seconds",
+            "es_tpu_tenant_search_inflight",
+            "es_tpu_tenant_search_cap",
+            "es_tpu_tenant_search_admitted_total",
+            "es_tpu_tenant_search_rejections_total",
+            "es_tpu_tenant_write_bytes_inflight",
+            "es_tpu_tenant_write_cap_bytes",
+            "es_tpu_tenant_write_bytes_total",
+            "es_tpu_tenant_write_rejections_total",
+            "es_tpu_tenant_weight"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
     # per-pack rows are labeled by index/field and carry the raw-vs-
     # resident component split
@@ -306,7 +315,8 @@ def test_every_reachable_metric_object_is_registered(node):
         node.tpu_search,
         node.indices,
         node.indexing_pressure,
-        node.search_backpressure)
+        node.search_backpressure,
+        node.tenants)
     assert reachable, "traversal found no metric objects at all"
     registered = node.metrics.registered_objects()
     missing = [obj for oid, obj in reachable.items()
@@ -333,3 +343,30 @@ def test_supervision_counters_reachable_and_registered(node):
     for obj in supervision:
         assert id(obj) in registered, \
             f"supervision counter {obj!r} missing from the registry"
+
+
+def test_tenant_counters_reachable_and_registered(node):
+    """ISSUE 13: the per-tenant admission counters hang off the quota
+    service — the completeness traversal must reach them AND the tenant
+    collector must register them, per labeled child, so a new tenant
+    lane can't silently dodge the scrape."""
+    from elasticsearch_tpu.common.tenancy import DEFAULT_TENANT
+    tq = node.tenants
+    per_tenant = [fam.child(DEFAULT_TENANT)
+                  for fam in (tq.search_admitted, tq.search_rejections,
+                              tq.write_bytes_total, tq.write_rejections)]
+    reachable = _reachable_metrics(tq)
+    for obj in per_tenant:
+        assert id(obj) in reachable, \
+            f"traversal never reached {obj!r} from node.tenants"
+    # force a scrape so the collector has run, then every child must be
+    # visible to the registry
+    do(node, "GET", "/_prometheus/metrics")
+    registered = node.metrics.registered_objects()
+    for obj in per_tenant:
+        assert id(obj) in registered, \
+            f"tenant counter {obj!r} missing from the registry"
+    # the default-tenant rows themselves are labeled in the exposition
+    _, text = do(node, "GET", "/_prometheus/metrics")
+    assert ('es_tpu_tenant_search_admitted_total'
+            f'{{tenant="{DEFAULT_TENANT}"}}') in text

@@ -1,0 +1,534 @@
+"""Per-tenant QoS suite (ISSUE 13): tenant resolution and binding,
+weighted share math, the search/write admission carves and their
+release-on-every-exit-path guarantee, the uniform 429 contract across
+ALL rejection paths (Retry-After + structured body), weighted
+round-robin batch lanes, dominant-tenant-first shedding under duress,
+and the acceptance check — a flooding aggressor tenant gets typed 429s
+while a victim tenant keeps its latency and error budget, with every
+counter draining to zero after the flood heals."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             TenantThrottledException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.tenancy import (DEFAULT_TENANT,
+                                              TenantQuotaService,
+                                              bind_tenant, current_tenant,
+                                              resolve_tenant)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search.tpu_service import _take_fair
+from elasticsearch_tpu.testing.disruption import (LoadSpike, TenantFlood,
+                                                  load_spike, tenant_flood)
+
+from test_replication import _handle
+
+
+def _quotas(weights=None, *, slots=8, write_limit=1024, **extra):
+    cfg = dict(extra)
+    if weights:
+        cfg["tenancy"] = {"weight": dict(weights)}
+    return TenantQuotaService(Settings.of(cfg), write_limit_bytes=write_limit,
+                              search_slots=slots)
+
+
+# ---------------------------------------------------------------------
+# tenant resolution + thread binding
+# ---------------------------------------------------------------------
+
+def test_resolve_tenant_defaults_and_validates():
+    assert resolve_tenant(None) == DEFAULT_TENANT
+    assert resolve_tenant("") == DEFAULT_TENANT
+    assert resolve_tenant("  ") == DEFAULT_TENANT
+    assert resolve_tenant("team-a.prod_1") == "team-a.prod_1"
+    assert resolve_tenant(DEFAULT_TENANT) == DEFAULT_TENANT
+    for bad in ("-leading-dash", "has space", "a" * 65, "semi;colon"):
+        with pytest.raises(IllegalArgumentException):
+            resolve_tenant(bad)
+
+
+def test_bind_tenant_restores_and_is_thread_local():
+    assert current_tenant() == DEFAULT_TENANT
+    prev = bind_tenant("alpha")
+    try:
+        assert current_tenant() == "alpha"
+        seen = {}
+
+        def other():
+            seen["tenant"] = current_tenant()
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert seen["tenant"] == DEFAULT_TENANT   # binding never leaks
+    finally:
+        bind_tenant(prev)
+    assert current_tenant() == DEFAULT_TENANT
+
+
+# ---------------------------------------------------------------------
+# weighted share math
+# ---------------------------------------------------------------------
+
+def test_weighted_shares_carve_the_budgets():
+    tq = _quotas({"victim": 3, "aggressor": 1}, slots=8, write_limit=1024)
+    # total = 3 + 1 + default_weight(1); unconfigured tenants share the
+    # default slice instead of being silently zeroed
+    assert tq.total_weight == pytest.approx(5.0)
+    assert tq.share("victim") == pytest.approx(0.6)
+    assert tq.search_cap("victim") == 5
+    assert tq.search_cap("aggressor") == 2
+    assert tq.search_cap("never-configured") == 2
+    assert tq.write_cap_bytes("victim") == int(0.6 * 1024)
+    # no tenancy config at all → the default tenant owns the full budget
+    plain = TenantQuotaService(None, write_limit_bytes=1024, search_slots=8)
+    assert plain.share(DEFAULT_TENANT) == pytest.approx(1.0)
+    assert plain.search_cap(DEFAULT_TENANT) == 8
+    assert plain.write_cap_bytes(DEFAULT_TENANT) == 1024
+
+
+def test_bad_weight_setting_is_rejected_at_construction():
+    with pytest.raises(IllegalArgumentException):
+        _quotas({"oops": "not-a-number"})
+
+
+def test_zero_write_limit_disables_the_write_carve():
+    tq = _quotas({"a": 1}, write_limit=0)
+    assert tq.write_cap_bytes("a") == 0
+    release = tq.charge_write(10**9, "a")   # no cap → never rejected
+    release()
+    assert tq.usage()["a"]["write_bytes"] == 0
+
+
+# ---------------------------------------------------------------------
+# admission carves: grant, reject, idempotent release
+# ---------------------------------------------------------------------
+
+def test_search_admission_caps_per_tenant_and_releases():
+    tq = _quotas({"small": 1}, slots=4)     # cap(small)=2, cap(default)=2
+    r1 = tq.admit_search("small")
+    r2 = tq.admit_search("small")
+    with pytest.raises(TenantThrottledException) as ei:
+        tq.admit_search("small")
+    assert ei.value.tenant == "small"
+    assert ei.value.status == 429
+    # other tenants are untouched by small's saturation
+    tq.admit_search(DEFAULT_TENANT)()
+    r1()
+    r1()                                    # idempotent: no double-release
+    tq.admit_search("small")()              # freed slot is reusable
+    r2()
+    usage = tq.usage()
+    assert usage["small"]["search_inflight"] == 0
+    assert tq.search_rejections.counts() == {"small": 1, DEFAULT_TENANT: 0}
+
+
+def test_write_charge_caps_per_tenant_and_releases():
+    tq = _quotas({"small": 1}, slots=4, write_limit=1024)  # cap(small)=512
+    r = tq.charge_write(400, "small")
+    with pytest.raises(TenantThrottledException):
+        tq.charge_write(200, "small")       # 600 > 512
+    tq.charge_write(200, DEFAULT_TENANT)()  # other tenant still admitted
+    r()
+    r()
+    assert tq.usage()["small"]["write_bytes"] == 0
+    assert tq.write_rejections.counts()["small"] == 1
+
+
+def test_admission_uses_the_thread_bound_tenant_when_unspecified():
+    tq = _quotas({"bound": 1}, slots=4)
+    prev = bind_tenant("bound")
+    try:
+        release = tq.admit_search()
+        assert tq.usage()["bound"]["search_inflight"] == 1
+        release()
+    finally:
+        bind_tenant(prev)
+
+
+# ---------------------------------------------------------------------
+# weighted round-robin batch lanes
+# ---------------------------------------------------------------------
+
+def _pendings(*tenants):
+    return [SimpleNamespace(tenant=t) for t in tenants]
+
+
+def test_take_fair_single_tenant_fast_path_is_arrival_order():
+    ps = _pendings(*(["a"] * 12))
+    taken, rest = _take_fair(ps, 8, lambda t: 1.0)
+    assert taken == ps[:8] and rest == ps[8:]
+
+
+def test_take_fair_splits_the_train_by_weight():
+    ps = _pendings(*(["a"] * 20 + ["b"] * 20))
+    weights = {"a": 3.0, "b": 1.0}
+    taken, rest = _take_fair(ps, 8, weights.get)
+    assert len(taken) == 8
+    by = {"a": 0, "b": 0}
+    for p in taken:
+        by[p.tenant] += 1
+    # quota = max(1, int(cap * w / total)): 6 for a, 2 for b — tenant b
+    # rides every train instead of starving behind a's backlog
+    assert by == {"a": 6, "b": 2}
+    # the remainder keeps arrival order for the next train
+    taken_ids = {id(p) for p in taken}
+    assert rest == [p for p in ps if id(p) not in taken_ids]
+
+
+def test_take_fair_fills_the_train_when_a_lane_runs_dry():
+    ps = _pendings(*(["a"] * 2 + ["b"] * 20))
+    taken, _rest = _take_fair(ps, 8, lambda t: 1.0)
+    # a's lane has only 2 queued; the train still leaves full (fairness
+    # never costs device utilization)
+    assert len(taken) == 8
+    assert sum(1 for p in taken if p.tenant == "a") == 2
+
+
+def test_take_fair_no_split_needed_returns_everything():
+    ps = _pendings("a", "b", "a")
+    taken, rest = _take_fair(ps, 8, lambda t: 1.0)
+    assert taken == ps and rest == []
+
+
+# ---------------------------------------------------------------------
+# REST-integrated behavior on a live node
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def qos_node(tmp_path):
+    n = Node(str(tmp_path / "data"), settings=Settings.of({
+        "search.tpu_serving.enabled": "false",
+        "indexing_pressure.memory.limit": "1kb",
+        "thread_pool.search.size": 2,
+        "thread_pool.search.queue_size": 2,
+        "tenancy": {"search_slots": 4, "weight": {"small": 0.2}}}))
+    s, b = _handle(n, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 1}}})
+    assert s == 200, b
+    s, _ = _handle(n, "PUT", "/books/_doc/seed", body={"title": "hello"})
+    assert s == 201
+    yield n
+    n.close()
+
+
+def test_invalid_tenant_id_is_a_400_not_a_500(qos_node):
+    s, body = qos_node.handle("POST", "/books/_search",
+                              {"tenant_id": "bad tenant!"},
+                              {"query": {"match_all": {}}})
+    assert s == 400
+    assert body["error"]["type"] == "illegal_argument_exception"
+    assert "invalid tenant id" in body["error"]["reason"]
+
+
+def test_tenant_write_quota_rejects_small_tenant_while_default_passes(
+        qos_node):
+    # cap(small) = 0.2/1.2 of 1kb ≈ 170b; cap(default) ≈ 853b
+    doc = {"title": "x" * 300}
+    s, body = qos_node.handle("PUT", "/books/_doc/w1",
+                              {"tenant_id": "small"}, doc)
+    assert s == 429, body
+    assert body["error"]["type"] == "tenant_throttled_exception"
+    s, _ = qos_node.handle("PUT", "/books/_doc/w1", {}, dict(doc))
+    assert s == 201                      # default tenant: same write passes
+    usage = qos_node.tenants.usage()
+    assert all(u["write_bytes"] == 0 for u in usage.values()), usage
+
+
+def test_tenant_section_in_nodes_stats(qos_node):
+    qos_node.handle("POST", "/books/_search", {"tenant_id": "small"},
+                    {"query": {"match_all": {}}})
+    s, body = _handle(qos_node, "GET", "/_nodes/stats")
+    assert s == 200
+    section = body["nodes"][qos_node.node_id]["tenants"]
+    assert section["enabled"] is True
+    assert section["search_slots"] == 4
+    small = section["tenants"]["small"]
+    assert small["search_cap"] == 1
+    assert small["search_admitted"] >= 1
+    assert small["search_inflight"] == 0
+
+
+# ---------------------------------------------------------------------
+# satellite: the uniform 429 contract across every rejection path
+# ---------------------------------------------------------------------
+
+def _provoke(node, scenario):
+    """Trigger one rejection path; → (status, body) with state healed."""
+    if scenario == "pressure_write":
+        with load_spike(node, hold_bytes=2048):
+            return _handle(node, "PUT", "/books/_doc/big",
+                           body={"title": "hello"})
+    if scenario == "pool_saturation":
+        pool = node.thread_pools.get("search")
+        spike = LoadSpike(pool=pool, fill_active=pool.size,
+                          fill_queue=pool.queue_size)
+        spike.start()
+        try:
+            return _handle(node, "POST", "/books/_search",
+                           body={"query": {"match_all": {}}})
+        finally:
+            spike.heal()
+    if scenario == "backpressure_decline":
+        with load_spike(node, hold_bytes=2048):
+            return _handle(node, "POST", "/books/_search", body={
+                "query": {"match_all": {}},
+                "aggs": {"t": {"terms": {"field": "title"}}}})
+    if scenario == "tenant_search_quota":
+        release = node.tenants.admit_search("small")   # cap(small) = 1
+        try:
+            return node.handle("POST", "/books/_search",
+                               {"tenant_id": "small"},
+                               {"query": {"match_all": {}}})
+        finally:
+            release()
+    if scenario == "tenant_write_quota":
+        return node.handle("PUT", "/books/_doc/big429",
+                           {"tenant_id": "small"}, {"title": "x" * 300})
+    raise AssertionError(scenario)
+
+
+@pytest.mark.parametrize("scenario", [
+    "pressure_write", "pool_saturation", "backpressure_decline",
+    "tenant_search_quota", "tenant_write_quota"])
+def test_every_rejection_path_shares_the_429_contract(qos_node, scenario):
+    status, body = _provoke(qos_node, scenario)
+    assert status == 429, (scenario, body)
+    # backoff header rides the payload for the HTTP edges to emit
+    assert body["_headers"]["Retry-After"] == "1", (scenario, body)
+    err = body["error"]
+    assert isinstance(err["root_cause"], list) and err["root_cause"]
+    assert err["root_cause"][0]["type"] == err["type"]
+    assert err["root_cause"][0]["reason"] == err["reason"]
+    assert err["reason"]
+    assert body["status"] == 429
+    # healed: nothing in flight afterwards
+    assert qos_node.indexing_pressure.current() == {
+        "coordinating": 0, "primary": 0, "replica": 0}
+    usage = qos_node.tenants.usage()
+    assert all(u["search_inflight"] == 0 and u["write_bytes"] == 0
+               for u in usage.values()), (scenario, usage)
+
+
+def test_front_rejection_bodies_share_the_429_contract():
+    # the serving front hand-rolls its rejection wire bodies (it cannot
+    # import the controller) — they must parse to the SAME shape
+    from elasticsearch_tpu.serving.front import (RING_FULL_BODY,
+                                                 _rejection_json)
+    cases = [
+        (json.loads(RING_FULL_BODY.decode()), 429,
+         "es_rejected_execution_exception"),
+        (json.loads(_rejection_json(
+            "batcher_unavailable_exception", "batcher is down", 503)),
+         503, "batcher_unavailable_exception"),
+        (json.loads(_rejection_json(
+            "timeout_exception", "batcher did not answer", 503)),
+         503, "timeout_exception"),
+    ]
+    for body, status, etype in cases:
+        err = body["error"]
+        assert isinstance(err["root_cause"], list) and err["root_cause"]
+        assert err["root_cause"][0]["type"] == err["type"] == etype
+        assert err["root_cause"][0]["reason"] == err["reason"]
+        assert body["status"] == status
+
+
+def test_retry_after_header_is_emitted_on_the_wire(tmp_path):
+    # over real HTTP the reserved _headers key is POPPED and becomes an
+    # actual response header — clients never see the internal channel
+    import http.client
+
+    from elasticsearch_tpu.node import serve
+
+    from test_replication import _free_ports
+    port = _free_ports(1)[0]
+    n = Node(str(tmp_path / "data"), settings=Settings.of({
+        "search.tpu_serving.enabled": "false",
+        "indexing_pressure.memory.limit": "1kb",
+        "tenancy": {"search_slots": 4, "weight": {"small": 0.2}}}))
+    server = None
+    try:
+        server = serve(n, port=port)
+        s, _ = _handle(n, "PUT", "/books", body={
+            "settings": {"index": {"number_of_shards": 1}}})
+        assert s == 200
+        release = n.tenants.admit_search("small")
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10.0)
+            conn.request("POST", "/books/_search",
+                         json.dumps({"query": {"match_all": {}}}),
+                         {"Content-Type": "application/json",
+                          "X-Tenant-Id": "small"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            assert resp.status == 429
+            assert resp.getheader("Retry-After") == "1"
+            body = json.loads(raw)
+            assert body["error"]["type"] == "tenant_throttled_exception"
+            assert "_headers" not in body
+            conn.close()
+        finally:
+            release()
+    finally:
+        if server is not None:
+            server.shutdown()
+        n.close()
+
+
+# ---------------------------------------------------------------------
+# duress: the dominant tenant is shed first / declined outright
+# ---------------------------------------------------------------------
+
+def test_shed_prefers_the_dominant_tenants_stale_tasks(qos_node):
+    tm = qos_node.task_manager
+    hog_young = tm.register("indices:data/read/search",
+                            description="hog-young")
+    def_old = tm.register("indices:data/read/search", description="def-old")
+    def_oldest = tm.register("indices:data/read/search",
+                             description="def-oldest")
+    hog_young.tenant = "small"
+    hog_young._start -= 20.0
+    def_old._start -= 50.0
+    def_oldest._start -= 100.0
+    release = qos_node.tenants.admit_search("small")   # ratio 1/1 → dominant
+    try:
+        assert qos_node.tenants.dominant_tenant() == "small"
+        cancelled = qos_node.search_backpressure.shed_stale()
+        assert cancelled == 2                          # cancel_max
+        # without tenancy the oldest two (both default) would go; with a
+        # dominant tenant its stale task is first despite being youngest
+        assert hog_young.cancelled
+        assert def_oldest.cancelled
+        assert not def_old.cancelled
+    finally:
+        release()
+        for t in (hog_young, def_old, def_oldest):
+            tm.unregister(t)
+
+
+def test_duress_declines_the_dominant_tenant_even_for_cheap_searches(
+        qos_node):
+    release = qos_node.tenants.admit_search("small")
+    try:
+        with load_spike(qos_node, hold_bytes=2048):
+            # cheap search, but `small` holds its full share while the
+            # node is under duress → typed 429
+            s, body = qos_node.handle("POST", "/books/_search",
+                                      {"tenant_id": "small"},
+                                      {"query": {"match_all": {}}})
+            assert s == 429, body
+            assert body["error"]["type"] == "tenant_throttled_exception"
+            # a tenant inside its share keeps cheap-search admission
+            s, _ = _handle(qos_node, "POST", "/books/_search",
+                           body={"query": {"match_all": {}}})
+            assert s == 200
+    finally:
+        release()
+
+
+# ---------------------------------------------------------------------
+# satellite: no quota leaks on error exit paths
+# ---------------------------------------------------------------------
+
+def test_quota_drains_on_error_exit_paths(qos_node):
+    # search against a missing index: admission granted, handler raises
+    s, _ = qos_node.handle("POST", "/nope/_search", {"tenant_id": "small"},
+                           {"query": {"match_all": {}}})
+    assert s == 404
+    # write that fails validation after the pressure+tenant charge
+    s, _ = qos_node.handle("PUT", "/books/_doc/bad", {"tenant_id": "small"},
+                           "not json at all")
+    assert s >= 400
+    # msearch with a broken line (admission covers the whole request)
+    s, _ = qos_node.handle("POST", "/books/_msearch",
+                           {"tenant_id": "small"}, None,
+                           b'{"index": "books"}\n{"query": {"bogus": {}}}\n')
+    usage = qos_node.tenants.usage()
+    assert all(u["search_inflight"] == 0 and u["write_bytes"] == 0
+               for u in usage.values()), usage
+    assert qos_node.indexing_pressure.current() == {
+        "coordinating": 0, "primary": 0, "replica": 0}
+
+
+def test_quota_drains_under_concurrent_flood(qos_node):
+    with tenant_flood(qos_node, tenant="small", threads=3,
+                      path="/books/_search") as flood:
+        time.sleep(0.4)
+    assert flood.statuses, "flood produced no traffic"
+    assert not flood.errors, flood.errors[:3]
+    usage = qos_node.tenants.usage()
+    assert all(u["search_inflight"] == 0 and u["write_bytes"] == 0
+               for u in usage.values()), usage
+
+
+# ---------------------------------------------------------------------
+# acceptance: noisy neighbor — victim SLO holds while aggressor is
+# throttled, and everything drains afterwards
+# ---------------------------------------------------------------------
+
+def _victim_pass(node, n=40):
+    lat, errors = [], []
+    for _ in range(n):
+        t0 = time.monotonic()
+        s, body = node.handle("POST", "/books/_search",
+                              {"tenant_id": "victim"},
+                              {"query": {"match_all": {}}})
+        lat.append(time.monotonic() - t0)
+        if s != 200:
+            errors.append((s, body))
+    lat.sort()
+    return lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1) + 0.5))], errors
+
+
+@pytest.fixture
+def nn_node(tmp_path):
+    n = Node(str(tmp_path / "data"), settings=Settings.of({
+        "search.tpu_serving.enabled": "false",
+        "thread_pool.search.size": 8,
+        "tenancy": {"search_slots": 8,
+                    "weight": {"victim": 3, "aggressor": 0.2}}}))
+    s, b = _handle(n, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 1}}})
+    assert s == 200, b
+    for i in range(20):
+        _handle(n, "PUT", f"/books/_doc/{i}", body={"title": f"doc {i}"})
+    _handle(n, "POST", "/books/_refresh")
+    yield n
+    n.close()
+
+
+def test_noisy_neighbor_victim_slo_holds(nn_node):
+    solo_p99, solo_errors = _victim_pass(nn_node)
+    assert not solo_errors
+    flood = TenantFlood(nn_node, tenant="aggressor", threads=4,
+                        path="/books/_search")
+    flood.start()
+    try:
+        time.sleep(0.2)                      # let the flood saturate
+        contended_p99, contended_errors = _victim_pass(nn_node)
+    finally:
+        flood.heal()
+    # the victim saw zero errors and kept its latency budget: within 2x
+    # of the solo baseline (floored — solo p99 on an empty box is
+    # sub-millisecond and scheduler noise alone can double it)
+    assert not contended_errors, contended_errors[:3]
+    assert contended_p99 <= max(2 * solo_p99, 0.050), \
+        (contended_p99, solo_p99)
+    # the aggressor was throttled with TYPED rejections, not errors
+    assert flood.statuses.get(429, 0) > 0, flood.statuses
+    assert flood.statuses.get(200, 0) > 0, flood.statuses   # cap, not ban
+    assert set(flood.statuses) <= {200, 429}, flood.statuses
+    assert not flood.errors, flood.errors[:3]
+    # quiescent afterwards: every grant was released
+    usage = nn_node.tenants.usage()
+    assert all(u["search_inflight"] == 0 and u["write_bytes"] == 0
+               for u in usage.values()), usage
+    rejections = nn_node.tenants.search_rejections.counts()
+    assert rejections.get("victim", 0) == 0, rejections
