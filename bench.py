@@ -543,16 +543,23 @@ def main() -> None:
     # {p50,p99,qps,rejects,lost_acks}. Like the warmup phase, the key is
     # ALWAYS populated — a stalled or crashed run still reports. ----
     if _env("SLO", 0) == 1:
-        from elasticsearch_tpu.testing.disruption import batcher_kill
+        from elasticsearch_tpu.testing.disruption import (batcher_kill,
+                                                          device_loss)
         from elasticsearch_tpu.testing.slo import run_slo
         slo_s = _env("SLO_SECONDS", max(4, seconds // 2))
+        # ES_TPU_BENCH_SLO_DEVICE_LOSS=1 swaps the mid-run disruption
+        # from a batcher kill to a chip-loss drill (quarantine → N-1
+        # remesh); the emitted degraded_fraction / time_at_n_minus_1_s
+        # measure the window either way
+        drill_device = _env("SLO_DEVICE_LOSS", 0) == 1
         out["slo"] = {"error": None}
         try:
             def slo_chaos():
                 if node.tpu_search is None:
                     return
                 time.sleep(slo_s * 0.3)
-                with batcher_kill(node):
+                window = (device_loss if drill_device else batcher_kill)
+                with window(node):
                     time.sleep(min(1.5, slo_s * 0.2))
                 # the rest of the run covers the recovery window
 
@@ -572,10 +579,13 @@ def main() -> None:
             out["slo"] = slo
             vic = slo["tenants"].get("victim", {})
             agg = slo["tenants"].get("aggressor", {})
+            deg = slo.get("degraded", {})
             log(f"slo: victim p50={vic.get('p50_ms')}ms "
                 f"p99={vic.get('p99_ms')}ms qps={vic.get('qps')} "
                 f"lost_acks={vic.get('lost_acks')}; aggressor "
-                f"qps={agg.get('qps')} rejects={agg.get('rejects')}")
+                f"qps={agg.get('qps')} rejects={agg.get('rejects')}; "
+                f"degraded_fraction={deg.get('degraded_fraction')} "
+                f"time_at_n_minus_1={deg.get('time_at_n_minus_1_s')}s")
         except Exception as e:  # noqa: BLE001 — the phase must emit
             out["slo"]["error"] = f"{type(e).__name__}: {str(e)[:300]}"
             log(f"slo phase failed: {out['slo']['error']}")

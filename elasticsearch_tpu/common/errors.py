@@ -136,6 +136,23 @@ class TenantThrottledException(EsRejectedExecutionException):
         self.retry_after_s = retry_after_s
 
 
+class PackShedException(EsException):
+    """A partial-mesh recovery shed this index's resident pack: the
+    surviving devices' HBM headroom cannot hold it, so kernel serving
+    for the index is suspended until a fuller mesh readmits it. Carries
+    a Retry-After hint (the REST layer emits the backoff header) and
+    the degraded topology so clients can tell load-shedding from
+    capacity loss."""
+
+    status = 503
+
+    def __init__(self, reason: str, *, index: str,
+                 retry_after_s: float = 5.0, **md: Any):
+        super().__init__(reason, index=index, **md)
+        self.index = index
+        self.retry_after_s = retry_after_s
+
+
 class TaskCancelledException(EsException):
     status = 400
 

@@ -127,7 +127,35 @@ class Node:
                 # supervision: dispatches overdue past this deadline are
                 # failed typed and trip batcher recovery (0 disables)
                 launch_deadline_ms=self.settings.get_float(
-                    "search.tpu_serving.launch_deadline_ms", 120_000.0))
+                    "search.tpu_serving.launch_deadline_ms", 120_000.0),
+                # device fault domains: wedge attribution → micro-probe
+                # quarantine → partial-mesh N-1 serving → flap-damped
+                # reintroduction
+                device_health={
+                    "enabled": self.settings.get_bool(
+                        "search.tpu_serving.device_health.enabled", True),
+                    "suspect_after": self.settings.get_int(
+                        "search.tpu_serving.device_health.suspect_after",
+                        2),
+                    "probe_deadline_ms": self.settings.get_float(
+                        "search.tpu_serving.device_health"
+                        ".probe_deadline_ms", 5_000.0),
+                    "reprobe_interval_seconds": self.settings.get_float(
+                        "search.tpu_serving.device_health"
+                        ".reprobe_interval_seconds", 30.0),
+                    "hold_down_seconds": self.settings.get_float(
+                        "search.tpu_serving.device_health"
+                        ".hold_down_seconds", 60.0),
+                    "reintroduce_after": self.settings.get_int(
+                        "search.tpu_serving.device_health"
+                        ".reintroduce_after", 3),
+                    "drain_window_seconds": self.settings.get_float(
+                        "search.tpu_serving.device_health"
+                        ".drain_window_seconds", 2.0),
+                    "shed_retry_after_seconds": self.settings.get_float(
+                        "search.tpu_serving.device_health"
+                        ".shed_retry_after_seconds", 5.0),
+                })
             # recovery's eager re-residency resolves index names through
             # the live indices service
             self.tpu_search.index_resolver = \
@@ -514,6 +542,36 @@ class Node:
                    _SUPERVISION_STATES.get(sup.state, -1), "gauge")
             yield ("recovery.last_duration_seconds", nl,
                    sup.last_duration_s, "gauge")
+            # device fault domains: per-device health state plus the
+            # quarantine/probe/remesh lifecycle (metric OBJECTS yield
+            # for the completeness traversal, same as the watchdog's)
+            yield ("device.mesh_active", nl, sup.mesh_device_count,
+                   "gauge")
+            yield ("device.mesh_total", nl, sup.full_device_count,
+                   "gauge")
+            yield ("device.remeshes", nl, sup.c_remeshes, "counter")
+            yield ("device.remesh_duration_seconds", nl,
+                   sup.last_remesh_duration_s, "gauge")
+            yield ("device.shed_packs", nl, len(svc.shed_keys()),
+                   "gauge")
+            health = svc.health
+            if health is not None:
+                yield ("device.probes", nl, health.c_probes, "counter")
+                yield ("device.probe_failures", nl,
+                       health.c_probe_failures, "counter")
+                yield ("device.quarantines", nl, health.c_quarantines,
+                       "counter")
+                yield ("device.reintroductions", nl,
+                       health.c_reintroductions, "counter")
+                # es_tpu_device_health_state{device=} 0=healthy,
+                # 1=suspect, 2=quarantined
+                for dev_id, code in health.state_codes().items():
+                    yield ("device.health_state",
+                           {"device": str(dev_id)}, code, "gauge")
+                # es_tpu_device_wedges_total{device=}: attributable
+                # wedge counts per chip
+                for labels, counter in health.c_device_wedges.items():
+                    yield ("device.wedges", labels, counter)
         reg.add_collector(_tpu)
 
         def _transport():

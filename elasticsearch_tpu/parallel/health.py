@@ -1,0 +1,291 @@
+"""Per-device fault domains: health scoring, quarantine, reintroduction.
+
+Reference analog: a departed node is a first-class cluster event — the
+master notices the lost ping, reroutes shards onto survivors, and a
+returning node is readmitted only after it proves healthy (SURVEY.md
+§2.3 P1 topology; cluster-coordination north star). Our analog of a
+node is one mesh device. This registry turns anonymous launch wedges
+into per-device evidence:
+
+  * `record_wedge(device_ids, label)` scores every device implicated in
+    an overdue dispatch (a wedged SPMD launch implicates the WHOLE mesh
+    — attribution is a suspicion, not a verdict);
+  * suspects crossing `suspect_after` are confirmed with deadline-
+    bounded SINGLE-device micro-probe launches — a tiny device_put +
+    reduce that cannot rendezvous with other chips, so a healthy
+    survivor answers fast while a dead chip hangs past the probe
+    deadline;
+  * devices that fail their probe are QUARANTINED (the supervisor then
+    rebuilds the mesh over the survivors — partial-mesh N-1 serving);
+  * a background loop keeps probing quarantined devices; after a
+    flap-damping hold-down, `reintroduce_after` CONSECUTIVE healthy
+    probes readmit the device (the supervisor then schedules a
+    drain-window full-mesh recovery). A failed reprobe resets both the
+    streak and the hold-down stamp, so a flapping chip stays out.
+
+Thread-safety: `record_wedge` runs on the watchdog scan thread and
+probes synchronously (bounded by `probe_deadline_ms` per suspect), so
+the supervisor's recovery — triggered after it — always sees the
+post-probe quarantine set.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from elasticsearch_tpu.common.metrics import CounterMetric, LabeledCounters
+
+logger = logging.getLogger("elasticsearch_tpu.parallel.health")
+
+# device.health_state gauge encoding (Prometheus can't carry strings)
+_DEVICE_STATES = {"healthy": 0, "suspect": 1, "quarantined": 2}
+
+# fault-injection seam (DeviceLoss / FlakyDevice): hooks see a device id
+# and return True to force the micro-probe to FAIL, False to force it to
+# pass, None for no opinion — first non-None verdict wins. Probing a
+# simulated-dead chip must not touch the real (healthy) host device.
+PROBE_FAULT_HOOKS: List[Callable[[int], Optional[bool]]] = []
+
+
+def _probe_verdict(device_id: int) -> Optional[bool]:
+    for hook in list(PROBE_FAULT_HOOKS):
+        v = hook(device_id)
+        if v is not None:
+            return v
+    return None
+
+
+class DeviceHealthRegistry:
+    """Scores wedges per device, confirms suspects with micro-probes,
+    quarantines failures, and readmits after flap-damped reprobes."""
+
+    def __init__(self, devices: Optional[Iterable[Any]] = None, *,
+                 suspect_after: int = 2,
+                 probe_deadline_ms: float = 5_000.0,
+                 reprobe_interval_s: float = 30.0,
+                 hold_down_s: float = 60.0,
+                 reintroduce_after: int = 3,
+                 on_quarantine: Optional[Callable[[int], None]] = None,
+                 on_reintroduce: Optional[Callable[[int], None]] = None):
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        self._devices: Dict[int, Any] = {int(d.id): d for d in devices}
+        self.suspect_after = max(1, int(suspect_after))
+        self.probe_deadline_s = max(0.05, float(probe_deadline_ms)) / 1e3
+        self.reprobe_interval_s = max(0.01, float(reprobe_interval_s))
+        self.hold_down_s = max(0.0, float(hold_down_s))
+        self.reintroduce_after = max(1, int(reintroduce_after))
+        self.on_quarantine = on_quarantine
+        self.on_reintroduce = on_reintroduce
+        self._lock = threading.Lock()
+        self._state: Dict[int, str] = {i: "healthy" for i in self._devices}
+        self._wedge_score: Dict[int, int] = {i: 0 for i in self._devices}
+        self._last_label: Dict[int, Optional[str]] = \
+            {i: None for i in self._devices}
+        self._quarantined_at: Dict[int, float] = {}
+        self._healthy_streak: Dict[int, int] = {}
+        self.c_probes = CounterMetric()
+        self.c_probe_failures = CounterMetric()
+        self.c_quarantines = CounterMetric()
+        self.c_reintroductions = CounterMetric()
+        # per-device wedge attribution: es_tpu_device_wedges_total{device=}
+        self.c_device_wedges = LabeledCounters("device")
+        self._stop = threading.Event()
+        self._reprobe_thread: Optional[threading.Thread] = None
+
+    # -- topology queries ---------------------------------------------
+
+    def device_ids(self) -> List[int]:
+        return sorted(self._devices)
+
+    def active_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(i for i, s in self._state.items()
+                          if s != "quarantined")
+
+    def active_devices(self) -> List[Any]:
+        """Surviving devices in id order — the partial-mesh build set."""
+        return [self._devices[i] for i in self.active_ids()]
+
+    def quarantined_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(i for i, s in self._state.items()
+                          if s == "quarantined")
+
+    def state_codes(self) -> Dict[int, int]:
+        with self._lock:
+            return {i: _DEVICE_STATES.get(s, -1)
+                    for i, s in sorted(self._state.items())}
+
+    # -- wedge attribution → suspicion → probe confirmation -----------
+
+    def record_wedge(self, device_ids: Iterable[int],
+                     label: str = "") -> List[int]:
+        """Score every implicated device; probe-confirm the ones that
+        cross `suspect_after`, quarantining confirmed failures. Returns
+        the ids quarantined BY THIS CALL (synchronous, so the caller's
+        subsequent recovery sees the updated active set)."""
+        suspects: List[int] = []
+        with self._lock:
+            for raw in device_ids:
+                i = int(raw)
+                if i not in self._state:
+                    continue
+                self.c_device_wedges.inc(str(i))
+                self._last_label[i] = label or None
+                if self._state[i] == "quarantined":
+                    continue
+                self._wedge_score[i] = self._wedge_score.get(i, 0) + 1
+                if self._wedge_score[i] >= self.suspect_after:
+                    self._state[i] = "suspect"
+                    suspects.append(i)
+        quarantined: List[int] = []
+        for i in suspects:
+            if self.probe(i):
+                with self._lock:
+                    if self._state.get(i) == "suspect":
+                        self._state[i] = "healthy"
+                        self._wedge_score[i] = 0
+            else:
+                if self._quarantine(i, reason=f"probe failed after "
+                                    f"wedge ({label or 'dispatch'})"):
+                    quarantined.append(i)
+        return quarantined
+
+    def probe(self, device_id: int) -> bool:
+        """Deadline-bounded single-device micro-probe: device_put a tiny
+        array onto JUST this device and reduce it — no collective, no
+        rendezvous, so the answer reflects this chip alone. True =
+        healthy (completed within the deadline)."""
+        self.c_probes.inc()
+        forced = _probe_verdict(device_id)
+        if forced is not None:
+            ok = not forced
+        else:
+            ok = self._real_probe(device_id)
+        if not ok:
+            self.c_probe_failures.inc()
+        return ok
+
+    def _real_probe(self, device_id: int) -> bool:
+        device = self._devices.get(device_id)
+        if device is None:
+            return False
+        done: Dict[str, bool] = {}
+
+        def run() -> None:
+            try:
+                import jax
+                import numpy as np
+                x = jax.device_put(np.arange(8, dtype=np.float32), device)
+                # block_until_ready via the float(): a wedged chip hangs
+                # here past the deadline instead of answering
+                done["ok"] = float(x.sum()) == 28.0
+            except Exception:  # noqa: BLE001 — a throwing probe is a fail
+                logger.exception("device %s micro-probe raised", device_id)
+                done["ok"] = False
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"device-probe-{device_id}")
+        t.start()
+        t.join(self.probe_deadline_s)
+        return bool(done.get("ok", False))
+
+    def _quarantine(self, device_id: int, reason: str) -> bool:
+        with self._lock:
+            if self._state.get(device_id) == "quarantined":
+                return False
+            self._state[device_id] = "quarantined"
+            self._quarantined_at[device_id] = time.monotonic()
+            self._healthy_streak[device_id] = 0
+            self._wedge_score[device_id] = 0
+        self.c_quarantines.inc()
+        logger.error("device %s QUARANTINED (%s); serving continues on "
+                     "%d survivor(s)", device_id, reason,
+                     len(self.active_ids()))
+        self._ensure_reprobe_thread()
+        if self.on_quarantine is not None:
+            try:
+                self.on_quarantine(device_id)
+            except Exception:  # noqa: BLE001 — registry must survive
+                logger.exception("on_quarantine callback failed")
+        return True
+
+    # -- background reintroduction ------------------------------------
+
+    def _ensure_reprobe_thread(self) -> None:
+        with self._lock:
+            if (self._reprobe_thread is not None
+                    and self._reprobe_thread.is_alive()):
+                return
+            self._reprobe_thread = threading.Thread(
+                target=self._reprobe_loop, daemon=True,
+                name="device-reprobe")
+        self._reprobe_thread.start()
+
+    def _reprobe_loop(self) -> None:
+        while not self._stop.wait(self.reprobe_interval_s):
+            for i in self.quarantined_ids():
+                with self._lock:
+                    held_since = self._quarantined_at.get(i, 0.0)
+                if time.monotonic() - held_since < self.hold_down_s:
+                    continue  # flap damping: no readmit inside hold-down
+                if self.probe(i):
+                    with self._lock:
+                        streak = self._healthy_streak.get(i, 0) + 1
+                        self._healthy_streak[i] = streak
+                    if streak >= self.reintroduce_after:
+                        self._reintroduce(i)
+                else:
+                    # a failed reprobe resets the streak AND re-stamps
+                    # the hold-down: a flapping chip never oscillates
+                    # the mesh
+                    with self._lock:
+                        self._healthy_streak[i] = 0
+                        self._quarantined_at[i] = time.monotonic()
+
+    def _reintroduce(self, device_id: int) -> None:
+        with self._lock:
+            if self._state.get(device_id) != "quarantined":
+                return
+            self._state[device_id] = "healthy"
+            self._wedge_score[device_id] = 0
+            self._healthy_streak.pop(device_id, None)
+            self._quarantined_at.pop(device_id, None)
+        self.c_reintroductions.inc()
+        logger.warning("device %s reintroduced after %d consecutive "
+                       "healthy probe(s)", device_id,
+                       self.reintroduce_after)
+        if self.on_reintroduce is not None:
+            try:
+                self.on_reintroduce(device_id)
+            except Exception:  # noqa: BLE001 — registry must survive
+                logger.exception("on_reintroduce callback failed")
+
+    # -- observability / lifecycle ------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            states = {str(i): s for i, s in sorted(self._state.items())}
+            scores = {str(i): n for i, n in sorted(self._wedge_score.items())
+                      if n}
+        return {"total": len(self._devices),
+                "active": sum(1 for s in states.values()
+                              if s != "quarantined"),
+                "states": states,
+                "wedge_scores": scores,
+                "quarantined": self.quarantined_ids(),
+                "probes": self.c_probes.count,
+                "probe_failures": self.c_probe_failures.count,
+                "quarantines": self.c_quarantines.count,
+                "reintroductions": self.c_reintroductions.count,
+                "suspect_after": self.suspect_after,
+                "hold_down_seconds": self.hold_down_s,
+                "reintroduce_after": self.reintroduce_after}
+
+    def close(self) -> None:
+        self._stop.set()

@@ -306,6 +306,23 @@ def search(indices: IndicesService, index_expr: Optional[str],
         names, alias_filters = list(names_override), {}
     else:
         names, alias_filters = resolve_targets(indices, index_expr)
+    # partial-mesh shed check: an index whose resident pack was shed
+    # for N-1 HBM headroom answers a TYPED 503 + Retry-After (load
+    # shedding, not failure) until a fuller mesh readmits the pack
+    if tpu_search is not None:
+        shed_info = getattr(tpu_search, "shed_info", None)
+        if callable(shed_info):
+            for name in names:
+                info = shed_info(name)
+                if info:
+                    from elasticsearch_tpu.common.errors import \
+                        PackShedException
+                    raise PackShedException(
+                        f"index [{name}] shed from device residency "
+                        f"during partial-mesh recovery; retry after "
+                        f"capacity returns", index=name,
+                        retry_after_s=float(
+                            info.get("retry_after_s", 5.0)))
     query, aggs, body = parse_search_body(body)
     ctx = SearchContext(parse_timeout_s(body, params), task)
     size = int(params.get("size", body.get("size", 10)))
@@ -406,6 +423,9 @@ def search(indices: IndicesService, index_expr: Optional[str],
                            "the planner", exc_info=True)
             fast = None
         if fast is not None:
+            # N-1 serving: even kernel-served answers carry the
+            # structured degraded reason while the mesh is partial
+            _stamp_degraded(fast, tpu_search)
             return fast
 
     # ---- query phase: every shard of every target index ----
@@ -669,12 +689,24 @@ def search(indices: IndicesService, index_expr: Optional[str],
     if body.get("suggest") is not None:
         from elasticsearch_tpu.search.suggest import run_suggest
         out["suggest"] = run_suggest(indices, names, body["suggest"])
-    if (tpu_search is not None
-            and getattr(tpu_search, "degraded_active", False)):
-        # batcher down/recovering: this answer came from the planner
-        # while the kernel path recovers — clients see it typed
-        out["degraded"] = True
+    _stamp_degraded(out, tpu_search)
     return out
+
+
+def _stamp_degraded(out: Dict[str, Any], tpu_search) -> None:
+    """Mark answers produced while the kernel path is degraded —
+    batcher down/recovering (planner served this) or serving on a
+    partial mesh (N-1 capacity) — with a structured reason clients
+    can type against (reference: a yellow cluster keeps answering,
+    and says so)."""
+    if tpu_search is None:
+        return
+    info = getattr(tpu_search, "degraded_info", None)
+    if info is None and getattr(tpu_search, "degraded_active", False):
+        info = {"reason": "recovering"}
+    if info:
+        out["degraded"] = True
+        out["degraded_reason"] = dict(info)
 
 
 def _collapse_key(reader, hit, field: str):

@@ -370,6 +370,12 @@ class IndexPackCache:
         self.hits = 0          # lookups served by the current pack
         self.misses = 0        # lookups that (re)built a pack
         self.stale_served = 0  # lookups served stale during a rebuild
+        # warmth (last-access stamp) and last-known HBM cost per key.
+        # Both SURVIVE invalidate_all: partial-mesh recovery orders
+        # re-residency warmest-first and projects bytes against the
+        # shrunken headroom before rebuilding anything.
+        self._heat: Dict[Tuple[str, str], float] = {}
+        self._last_bytes: Dict[Tuple[str, str], int] = {}
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -389,6 +395,24 @@ class IndexPackCache:
             self._mesh = make_mesh(shape=(1, _n_local_devices()))
         return self._mesh
 
+    def set_mesh(self, mesh) -> None:
+        """Re-target future builds at a different mesh (partial-mesh
+        recovery). Only sound on an EMPTY cache — existing packs were
+        placed with the old sharding — so callers invalidate first."""
+        with self._lock:
+            if self._cache:
+                raise RuntimeError("set_mesh on a non-empty pack cache; "
+                                   "invalidate_all first")
+            self._mesh = mesh
+
+    def heat_of(self, key: Tuple[str, str]) -> float:
+        with self._lock:
+            return self._heat.get(key, 0.0)
+
+    def bytes_of(self, key: Tuple[str, str]) -> int:
+        with self._lock:
+            return self._last_bytes.get(key, 0)
+
     def get(self, index_service, field: str) -> Optional[ResidentPack]:
         readers = []
         for shard_num, shard in sorted(index_service.shards.items()):
@@ -396,6 +420,7 @@ class IndexPackCache:
         reader_key = tuple(id(r) for _, r in readers)
         key = (index_service.name, field)
         with self._lock:
+            self._heat[key] = time.monotonic()
             entry = self._cache.get(key)
             if entry is not None and entry.reader_key == reader_key:
                 self.hits += 1
@@ -433,6 +458,7 @@ class IndexPackCache:
                     if old is not None and self._breaker is not None:
                         self._breaker.release(old.hbm_bytes)
                     self._cache[key] = entry
+                    self._last_bytes[key] = int(entry.hbm_bytes)
             if old is not None and self.on_evict is not None:
                 self.on_evict(old)
             return entry
@@ -560,6 +586,11 @@ class IndexPackCache:
                 if self._breaker is not None:
                     self._breaker.release(entry.hbm_bytes)
                 evicted.append(entry)
+            # deliberate eviction forgets the key entirely (unlike
+            # invalidate_all, whose keys recovery re-attains)
+            for key in [k for k in self._heat if k[0] == index_name]:
+                self._heat.pop(key, None)
+                self._last_bytes.pop(key, None)
         if self.on_evict is not None:
             for entry in evicted:
                 self.on_evict(entry)
@@ -800,7 +831,9 @@ class _PackQueue:
                     # the watchdog fails `taken` typed and trips the
                     # supervisor instead of hanging the micro-batcher
                     wd = batcher.watchdog
-                    token = (wd.begin("launch", taken)
+                    token = (wd.begin("launch", taken,
+                                      devices=_mesh_device_ids(
+                                          batcher.mesh))
                              if wd is not None else None)
                     try:
                         with tracing.span_under(trace_parent,
@@ -843,7 +876,8 @@ class _PackQueue:
             try:
                 profiler.tag_stage("batch_finish")
                 wd = batcher.watchdog
-                token = (wd.begin("finish", taken)
+                token = (wd.begin("finish", taken,
+                                  devices=_mesh_device_ids(batcher.mesh))
                          if wd is not None else None)
                 try:
                     with tracing.span_under(trace_parent,
@@ -1179,7 +1213,7 @@ def launch_flat_batch(resident: ResidentPack, flats: Sequence[FlatQuery],
         mesh = make_mesh(shape=(1, _n_local_devices()))
     # fault seam: DeviceWedge blocks here — BEFORE any lock or device
     # work — so a "wedged" launch holds nothing the watchdog needs
-    _dispatch_fault_point()
+    _dispatch_fault_point(mesh)
     pruned_idx = [i for i, f in enumerate(flats)
                   if f.min_count == 1 and k <= PRUNE_MAX_K
                   and len(f.terms) <= PRUNE_MAX_TERMS
@@ -1562,15 +1596,27 @@ class DeviceWedgedError(RuntimeError):
     decline to the planner without tripping the generic error path."""
 
 
-# fault-injection seam: DeviceWedge appends a blocking callable here;
-# launch_flat_batch calls through before doing ANY device work, so a
-# wedged launch holds no locks the watchdog or supervisor need
+# fault-injection seam: DeviceWedge/DeviceLoss append a blocking
+# callable here; launch_flat_batch calls through before doing ANY
+# device work, so a "wedged" launch holds no locks the watchdog or
+# supervisor need. Hooks receive the launch mesh so device-scoped
+# faults (DeviceLoss) only fire for launches touching the lost chip.
 DISPATCH_FAULT_HOOKS: List[Any] = []
 
 
-def _dispatch_fault_point() -> None:
+def _dispatch_fault_point(mesh=None) -> None:
     for hook in list(DISPATCH_FAULT_HOOKS):
-        hook()
+        hook(mesh)
+
+
+def _mesh_device_ids(mesh) -> Tuple[int, ...]:
+    """Device ids a launch on `mesh` implicates — watchdog attribution."""
+    if mesh is None:
+        return ()
+    try:
+        return tuple(int(d.id) for d in mesh.devices.flat)
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        return ()
 
 
 class LaunchWatchdog:
@@ -1598,10 +1644,13 @@ class LaunchWatchdog:
                                             name="tpu-launch-watchdog")
             self._thread.start()
 
-    def begin(self, label: str, pendings) -> Optional[int]:
+    def begin(self, label: str, pendings,
+              devices: Tuple[int, ...] = ()) -> Optional[int]:
         """Open a monitored dispatch; returns the token end() takes
         (None when monitoring is off). The pendings list is what the
-        scan thread fails if the dispatch goes overdue."""
+        scan thread fails if the dispatch goes overdue. `devices` is
+        the launch's mesh device-id set — a wedge carries it so health
+        scoring can attribute the fault per chip."""
         if self.deadline_s <= 0:
             return None
         self.c_launches.inc()
@@ -1609,7 +1658,8 @@ class LaunchWatchdog:
             token = self._next_token
             self._next_token += 1
             self._entries[token] = {"label": label, "t0": time.monotonic(),
-                                    "pendings": list(pendings)}
+                                    "pendings": list(pendings),
+                                    "devices": tuple(devices)}
         return token
 
     def end(self, token: Optional[int]) -> None:
@@ -1636,9 +1686,11 @@ class LaunchWatchdog:
             for e in overdue:
                 age_ms = (now - e["t0"]) * 1e3
                 self.c_wedges.inc()
-                self.last_wedge = {"label": e["label"],
-                                   "age_ms": round(age_ms, 1),
-                                   "queries": len(e["pendings"])}
+                wedge = {"label": e["label"],
+                         "age_ms": round(age_ms, 1),
+                         "devices": list(e.get("devices", ())),
+                         "queries": len(e["pendings"])}
+                self.last_wedge = wedge
                 exc = DeviceWedgedError(
                     f"device dispatch ({e['label']}) exceeded its "
                     f"{self.deadline_s * 1e3:.0f}ms launch deadline "
@@ -1648,7 +1700,9 @@ class LaunchWatchdog:
                         p.future.set_exception(exc)
                 if self.on_wedge is not None:
                     try:
-                        self.on_wedge(e["label"], age_ms)
+                        # full attribution dict: label, age_ms, the
+                        # launch's device-id set, query count
+                        self.on_wedge(wedge)
                     except Exception:  # noqa: BLE001 — scan must survive
                         logger.exception("watchdog on_wedge failed")
 
@@ -1682,8 +1736,20 @@ class BatcherSupervisor:
         self.state = "serving"
         self.c_recoveries = CounterMetric()
         self.c_degraded_served = CounterMetric()
+        self.c_remeshes = CounterMetric()
         self.last_reason: Optional[str] = None
         self.last_duration_s = 0.0
+        self.last_remesh_duration_s = 0.0
+        # device topology of the batcher currently serving: recovery
+        # rebuilds the mesh over the health registry's survivors, so
+        # these shrink to N-1 on quarantine and restore on readmission
+        self._mesh_ids: Tuple[int, ...] = _mesh_device_ids(svc.batcher.mesh)
+        self.full_device_count = len(self._mesh_ids)
+        self.mesh_device_count = len(self._mesh_ids)
+        # breaker bytes observed after EVERY teardown drain — the chaos
+        # suite asserts each entry is exactly zero (the invalidate_all
+        # exact-zero invariant extended across remeshes)
+        self.teardown_breaker_bytes: List[int] = []
         # disruption schemes hold recovery open so tests can observe
         # the degraded window; heal() lifts the hold and recovers
         self.hold_recovery = False
@@ -1721,6 +1787,13 @@ class BatcherSupervisor:
         except Exception:  # noqa: BLE001
             logger.exception("closing dead batcher")
         dropped = svc.packs.invalidate_all()
+        breaker = svc.packs._breaker
+        if breaker is not None:
+            # drain audit: invalidate_all released every pack's charge,
+            # so this MUST read zero — recorded so the chaos suite can
+            # assert the invariant held across every remesh
+            self.teardown_breaker_bytes.append(
+                int(getattr(breaker, "used", 0)))
         with self._lock:
             self._dropped_keys = dropped
 
@@ -1742,12 +1815,44 @@ class BatcherSupervisor:
         t0 = time.monotonic()
         try:
             old = svc.batcher
+            # partial-mesh topology: rebuild over the health registry's
+            # surviving devices. With every device healthy this is the
+            # original full mesh (same jax.Mesh — jit caches keyed on
+            # it stay hot); with quarantines it's a fresh N-k grid
+            # (factorize_2d handles odd counts: 7 → 1×7).
+            health = svc.health
+            full_ids = _mesh_device_ids(svc.full_mesh)
+            active = health.active_devices() if health is not None else None
+            if active is not None and not active:
+                with self._lock:
+                    self.state = "down"
+                logger.error("every device is quarantined; staying on "
+                             "degraded planner serving")
+                return
+            if active is None or len(active) == len(full_ids):
+                mesh = svc.full_mesh
+                mesh_ids = full_ids
+            else:
+                mesh = make_mesh(devices=active)
+                mesh_ids = tuple(int(d.id) for d in active)
+            remeshed = tuple(sorted(mesh_ids)) != tuple(
+                sorted(self._mesh_ids))
+            # anything rebuilt since teardown (a racing prewarm) was
+            # placed on the OLD mesh — drop it and fold its keys in so
+            # set_mesh sees an empty cache and re-residency covers it
+            stragglers = svc.packs.invalidate_all()
+            with self._lock:
+                for key in stragglers:
+                    if key not in self._dropped_keys:
+                        self._dropped_keys.append(key)
+                keys = list(self._dropped_keys)
+            svc.packs.set_mesh(mesh)
             fresh = MicroBatcher(window_s=old.window_s,
                                  max_batch=old.max_batch)
             # counters carry over so scrape monotonicity survives respawn
             fresh.batches_executed = old.batches_executed
             fresh.queries_executed = old.queries_executed
-            fresh.mesh = svc.packs.mesh
+            fresh.mesh = mesh
             fresh.stages = svc.stages
             fresh.watchdog = svc.watchdog
             # quota enforcement and fair lanes stay active through the
@@ -1755,15 +1860,38 @@ class BatcherSupervisor:
             fresh.tenants = old.tenants
             svc.batcher = fresh
             svc.packs.on_evict = fresh.retire_pack
-            # eager re-residency: rebuild every dropped pack through the
-            # cache (re-charging the breaker) before traffic returns —
-            # jit caches live on module functions, so no recompile
-            with self._lock:
-                keys = list(self._dropped_keys)
+            # HBM headroom: a partial mesh has proportionally less HBM
+            # than the breaker limit was sized for — admit re-residency
+            # warmest-first against the shrunken budget and SHED the
+            # coldest packs (typed 503 + Retry-After) instead of
+            # overcommitting the survivors
+            keys.sort(key=svc.packs.heat_of, reverse=True)
+            breaker = svc.packs._breaker
+            budget = None
+            if (breaker is not None and full_ids
+                    and len(mesh_ids) < len(full_ids)):
+                budget = int(getattr(breaker, "limit", 0)
+                             * len(mesh_ids) / len(full_ids))
+            rebuild: List[Tuple[str, str]] = []
+            shed: List[Tuple[str, str]] = []
+            projected = 0
+            for key in keys:
+                est = svc.packs.bytes_of(key)
+                if budget is not None and rebuild \
+                        and projected + est > budget:
+                    shed.append(key)
+                    continue
+                projected += est
+                rebuild.append(key)
+            svc.set_shed(shed)
+            # eager re-residency: rebuild every admitted pack through
+            # the cache (re-charging the breaker) before traffic
+            # returns — jit caches live on module functions, so a
+            # full-mesh respawn pays no recompile
             resolver = svc.index_resolver
             rebuilt = 0
             if resolver is not None:
-                for index_name, field in keys:
+                for index_name, field in rebuild:
                     try:
                         index_service = resolver(index_name)
                     except Exception:  # noqa: BLE001 — index may be gone
@@ -1779,15 +1907,56 @@ class BatcherSupervisor:
             with self._lock:
                 self.state = "serving"
                 self.last_duration_s = time.monotonic() - t0
+                self._mesh_ids = mesh_ids
+                self.mesh_device_count = len(mesh_ids)
+                if remeshed:
+                    self.last_remesh_duration_s = self.last_duration_s
+            if remeshed:
+                self.c_remeshes.inc()
             self.c_recoveries.inc()
             svc._tripped = False
-            logger.warning("batcher recovered in %.2fs (%d/%d packs "
-                           "re-resident)", self.last_duration_s, rebuilt,
-                           len(keys))
+            logger.warning("batcher recovered in %.2fs on %d/%d device(s) "
+                           "(%d/%d packs re-resident, %d shed)",
+                           self.last_duration_s, len(mesh_ids),
+                           len(full_ids) or len(mesh_ids), rebuilt,
+                           len(rebuild), len(shed))
+            # a device readmitted (or lost) while this recovery ran:
+            # converge onto the now-current active set
+            if health is not None:
+                want = tuple(sorted(health.active_ids()))
+                if want != tuple(sorted(mesh_ids)):
+                    self.trigger("device set changed during recovery")
         except Exception:  # noqa: BLE001 — stay degraded, stay alive
             with self._lock:
                 self.state = "down"
             logger.exception("batcher recovery failed; staying degraded")
+
+    def schedule_full_remesh(self, reason: str) -> None:
+        """A quarantined device proved healthy again: recover onto the
+        restored device set inside a DRAIN WINDOW — wait (bounded by
+        `svc.drain_window_s`) for pending/in-flight work to drain so
+        the remesh interrupts as little traffic as possible, then
+        trigger a respawn that maps onto the registry's active set."""
+        def run() -> None:
+            svc = self.svc
+            deadline = time.monotonic() + max(0.0, svc.drain_window_s)
+            while time.monotonic() < deadline:
+                depths = svc.batcher.queue_depths()
+                wd = svc.watchdog
+                if (depths["pending"] == 0 and depths["inflight"] == 0
+                        and (wd is None or wd.inflight() == 0)):
+                    break
+                time.sleep(0.02)
+            health = svc.health
+            want = (tuple(sorted(health.active_ids()))
+                    if health is not None else ())
+            with self._lock:
+                have = tuple(sorted(self._mesh_ids))
+            if want == have:
+                return  # already serving on this device set
+            self.trigger(reason)
+        threading.Thread(target=run, daemon=True,
+                         name="device-full-remesh").start()
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -1796,7 +1965,12 @@ class BatcherSupervisor:
                     "recoveries": self.c_recoveries.count,
                     "degraded_served": self.c_degraded_served.count,
                     "last_reason": self.last_reason,
-                    "last_duration_seconds": round(self.last_duration_s, 4)}
+                    "last_duration_seconds": round(self.last_duration_s, 4),
+                    "remeshes": self.c_remeshes.count,
+                    "last_remesh_duration_seconds":
+                        round(self.last_remesh_duration_s, 4),
+                    "mesh_devices": self.mesh_device_count,
+                    "mesh_devices_full": self.full_device_count}
 
 
 # ---------------------------------------------------------------------------
@@ -1814,7 +1988,8 @@ class TpuSearchService:
                  compile_cache_dir: Optional[str] = None,
                  packed_sort: bool = True,
                  compressed_pack: bool = False,
-                 launch_deadline_ms: float = 120_000.0):
+                 launch_deadline_ms: float = 120_000.0,
+                 device_health: Optional[Dict[str, Any]] = None):
         _ensure_compile_cache(compile_cache_dir)
         KERNEL_CONFIG["packed_sort"] = bool(packed_sort)
         KERNEL_CONFIG["compressed_pack"] = bool(compressed_pack)
@@ -1826,11 +2001,42 @@ class TpuSearchService:
         # pack eviction retires the pack's batch queue immediately
         self.packs.on_evict = self.batcher.retire_pack
         self.batcher.mesh = self.packs.mesh
+        # the healthy-topology mesh: partial-mesh recovery shrinks
+        # packs.mesh/batcher.mesh, full-mesh recovery restores THIS
+        self.full_mesh = self.packs.mesh
         self.stages = StageTimes()
         self.batcher.stages = self.stages
+        # device fault domains: per-device wedge scoring, micro-probe
+        # quarantine, and flap-damped reintroduction (disable with
+        # device_health={"enabled": False})
+        hcfg = dict(device_health or {})
+        self.health: Optional["DeviceHealthRegistry"] = None
+        self.drain_window_s = float(hcfg.get("drain_window_seconds", 2.0))
+        if hcfg.get("enabled", True):
+            from elasticsearch_tpu.parallel.health import \
+                DeviceHealthRegistry
+            self.health = DeviceHealthRegistry(
+                list(self.full_mesh.devices.flat),
+                suspect_after=int(hcfg.get("suspect_after", 2)),
+                probe_deadline_ms=float(
+                    hcfg.get("probe_deadline_ms", 5_000.0)),
+                reprobe_interval_s=float(
+                    hcfg.get("reprobe_interval_seconds", 30.0)),
+                hold_down_s=float(hcfg.get("hold_down_seconds", 60.0)),
+                reintroduce_after=int(hcfg.get("reintroduce_after", 3)),
+                on_quarantine=self._on_device_quarantine,
+                on_reintroduce=self._on_device_reintroduced)
+        # packs shed during a partial-mesh recovery: (index, field) →
+        # shed info; try_search declines them and the coordinator
+        # answers a typed 503 + Retry-After instead of silently
+        # rebuilding into HBM the survivors don't have
+        self._shed: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._shed_lock = threading.Lock()
+        self.shed_retry_after_s = float(
+            hcfg.get("shed_retry_after_seconds", 5.0))
         # supervision: the watchdog deadline-stamps every dispatch and
         # trips the supervisor on a wedge; the supervisor respawns the
-        # batcher and re-attains pack residency
+        # batcher (over the surviving devices) and re-attains residency
         self.watchdog = LaunchWatchdog(deadline_ms=launch_deadline_ms,
                                        on_wedge=self._on_wedge)
         self.batcher.watchdog = self.watchdog
@@ -1857,18 +2063,90 @@ class TpuSearchService:
         self._prewarm_progress: Dict[str, Any] = {
             "state": "idle", "total": 0, "done": 0, "seconds": 0.0}
 
-    def _on_wedge(self, label: str, age_ms: float) -> None:
+    def _on_wedge(self, wedge: Dict[str, Any]) -> None:
         """Watchdog callback (scan thread): an overdue dispatch means
-        the device path is wedged — trip supervision."""
+        the device path is wedged — score the implicated devices
+        (probing suspects synchronously, so recovery sees the updated
+        quarantine set), then trip supervision."""
+        label = wedge.get("label", "?")
+        age_ms = float(wedge.get("age_ms", 0.0))
         self.last_error = (f"device_wedged: {label} overdue "
                            f"after {age_ms:.0f}ms")
+        if self.health is not None:
+            try:
+                self.health.record_wedge(wedge.get("devices", ()),
+                                         label=label)
+            except Exception:  # noqa: BLE001 — supervision must trip
+                logger.exception("device health scoring failed")
         self.supervisor.trigger(f"device wedge ({label}, {age_ms:.0f}ms)")
+
+    def _on_device_quarantine(self, device_id: int) -> None:
+        """Health-registry callback: a confirmed-bad chip left the
+        active set — respawn onto the survivors (idempotent while a
+        wedge-triggered teardown is already in flight)."""
+        self.supervisor.trigger(f"device {device_id} quarantined")
+
+    def _on_device_reintroduced(self, device_id: int) -> None:
+        """Health-registry callback: a quarantined chip passed its
+        consecutive-healthy-probe bar — schedule a drain-window
+        recovery back onto the fuller mesh."""
+        self.supervisor.schedule_full_remesh(
+            f"device {device_id} reintroduced")
 
     @property
     def degraded_active(self) -> bool:
         """True while the batcher is down or recovering: queries serve
         through the planner path with a degraded marker."""
         return self.supervisor.degraded_active
+
+    @property
+    def degraded_info(self) -> Optional[Dict[str, Any]]:
+        """Structured degraded reason for responses/fronts/stats: None
+        at full health; {"reason": "partial_mesh"|"recovering"|..,
+        "devices": n, "devices_total": m} otherwise."""
+        sup = self.supervisor
+        total = sup.full_device_count
+        if sup.degraded_active:
+            return {"reason": sup.state if sup.state != "down"
+                    else "batcher_down",
+                    "devices": sup.mesh_device_count,
+                    "devices_total": total}
+        if sup.mesh_device_count < total:
+            return {"reason": "partial_mesh",
+                    "devices": sup.mesh_device_count,
+                    "devices_total": total}
+        return None
+
+    # -- shed packs (N-1 HBM headroom) ---------------------------------
+
+    def set_shed(self, keys: List[Tuple[str, str]],
+                 retry_after_s: Optional[float] = None) -> None:
+        """Replace the shed set (supervisor recovery): every listed
+        (index, field) answers typed 503 + Retry-After until a fuller
+        mesh re-admits it. An empty list clears the state."""
+        retry = (self.shed_retry_after_s if retry_after_s is None
+                 else float(retry_after_s))
+        with self._shed_lock:
+            self._shed = {tuple(k): {"retry_after_s": retry,
+                                     "since": time.monotonic()}
+                          for k in keys}
+        if keys:
+            logger.error("HBM headroom on the partial mesh cannot hold "
+                         "%d pack(s): %s shed (503 + Retry-After %.0fs)",
+                         len(keys), sorted(keys), retry)
+
+    def shed_keys(self) -> List[Tuple[str, str]]:
+        with self._shed_lock:
+            return sorted(self._shed)
+
+    def shed_info(self, index_name: str) -> Optional[Dict[str, Any]]:
+        """Shed metadata when ANY field of `index_name` is shed (the
+        coordinator's typed-503 check), else None."""
+        with self._shed_lock:
+            for (idx, field), info in self._shed.items():
+                if idx == index_name:
+                    return {"index": idx, "field": field, **info}
+        return None
 
     def kill(self, reason: str = "killed") -> None:
         """Simulate batcher-process death (BatcherKill disruption, ops
@@ -1959,6 +2237,14 @@ class TpuSearchService:
                 self.fallback += 1
                 return None
         t1 = time.perf_counter()
+        with self._shed_lock:
+            is_shed = (index_service.name, flat.field) in self._shed
+        if is_shed:
+            # the partial mesh shed this pack: never rebuild it here
+            # (that would overcommit the survivors' HBM) — the
+            # coordinator answers the typed 503 + Retry-After
+            self.fallback += 1
+            return None
         resident = self.packs.get(index_service, flat.field)
         t2 = time.perf_counter()
         self.stages.add("lower", t1 - t0)
@@ -2292,10 +2578,30 @@ class TpuSearchService:
                 "queue": self.batcher.queue_depths(),
                 "supervision": self.supervisor.stats(),
                 "watchdog": self.watchdog.stats(),
+                "devices": self.device_stats(),
                 "stages": self.stages.snapshot()}
+
+    def device_stats(self) -> Dict[str, Any]:
+        """The /_tpu/stats `devices` block: health registry view plus
+        the supervisor's mesh topology and shed set."""
+        sup = self.supervisor
+        out: Dict[str, Any] = {
+            "mesh_devices": sup.mesh_device_count,
+            "mesh_devices_full": sup.full_device_count,
+            "remeshes": sup.c_remeshes.count,
+            "last_remesh_duration_seconds":
+                round(sup.last_remesh_duration_s, 4),
+            "shed_packs": [f"{i}/{f}" for i, f in self.shed_keys()],
+            "degraded": self.degraded_info,
+        }
+        if self.health is not None:
+            out["health"] = self.health.stats()
+        return out
 
     def close(self) -> None:
         self.watchdog.close()
+        if self.health is not None:
+            self.health.close()
         self.batcher.close()
 
 

@@ -104,6 +104,10 @@ class _FrontState:
         self.orphan_grace_s = float(cfg.get("orphan_grace_s", 10.0))
         self.last_hb = time.monotonic()
         self.batcher_down = False
+        # structured degraded reason carried on the heartbeat (None at
+        # full health): fronts surface partial-mesh / recovering state
+        # in their stats snapshots and 503 bodies
+        self.degraded_info: Optional[Dict[str, Any]] = None
         self._down_lock = threading.Lock()
         self._resync_sent = False
         self.quarantined: set = set()
@@ -154,12 +158,17 @@ class _FrontState:
     # -- batcher round trip -------------------------------------------
 
     def _batcher_down_wire(self) -> Dict[str, Any]:
+        reason = ("the device-owning batcher process is down or "
+                  "unresponsive; retry shortly")
+        info = self.degraded_info
+        if info:
+            reason += (f" (degraded: {info.get('reason')}, "
+                       f"{info.get('devices')}/{info.get('devices_total')}"
+                       f" devices)")
         return {"status": 503, "ctype": "json",
                 "headers": {"Retry-After": "1"},
                 "parts": [_rejection_json(
-                    "batcher_unavailable_exception",
-                    "the device-owning batcher process is down or "
-                    "unresponsive; retry shortly", 503)],
+                    "batcher_unavailable_exception", reason, 503)],
                 "columns": []}
 
     def _enter_batcher_down(self, reason: str) -> None:
@@ -257,6 +266,9 @@ class _FrontState:
             self.last_hb = time.monotonic()
             kind = msg[0]
             if kind == "hb":
+                # the beacon carries the batcher's structured degraded
+                # reason (None ⇒ full mesh, all healthy)
+                self.degraded_info = msg[1] if len(msg) > 1 else None
                 if self.batcher_down:
                     # the batcher is back: ask it to drop stale epochs
                     # before we return quarantined slots to the ring
@@ -304,6 +316,7 @@ class _FrontState:
                 "pid": os.getpid(),
                 "ts": time.time(),
                 "metrics": self.metrics.export_snapshot(),
+                "degraded": self.degraded_info,
             }
             if self.sampler is not None:
                 snapshot["folded"] = self.sampler.folded_text()
@@ -755,6 +768,15 @@ class FrontSupervisor:
             time.sleep(self.hb_interval_s)
             if self._paused or self._closed:
                 continue
+            # the beacon doubles as the degraded-reason channel: fronts
+            # learn partial-mesh topology without another pipe message
+            degraded = None
+            svc = getattr(self.node, "tpu_search", None)
+            if svc is not None:
+                try:
+                    degraded = svc.degraded_info
+                except Exception:  # noqa: BLE001 — beacon must not die
+                    degraded = None
             for h in self.fronts:
                 if h.dead or h.conn is None:
                     continue
@@ -762,7 +784,7 @@ class FrontSupervisor:
                     if h.dead:
                         continue
                     try:
-                        h.conn.send(("hb",))
+                        h.conn.send(("hb", degraded))
                     except (OSError, BrokenPipeError):
                         pass  # exit path handles the dead front
 

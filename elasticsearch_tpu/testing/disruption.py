@@ -440,7 +440,7 @@ class DeviceWedge(Scheme):
             self.service.supervisor.hold_recovery = True
         release = self._release
 
-        def hook() -> None:
+        def hook(mesh=None) -> None:
             release.wait()
 
         self._hook = hook
@@ -475,6 +475,207 @@ def device_wedge(node=None, **kwargs) -> Iterator[DeviceWedge]:
     """Context-managed DeviceWedge: dispatches wedge on entry; on exit
     the wedge releases and recovery runs (even on assertion failure)."""
     scheme = DeviceWedge(node, **kwargs)
+    scheme.start()
+    try:
+        yield scheme
+    finally:
+        scheme.heal()
+
+
+class DeviceLoss(Scheme):
+    """Permanent single-chip death: every SPMD dispatch whose mesh
+    contains the lost device parks (the launch watchdog fails it typed
+    and attributes the wedge), and the device's health micro-probes are
+    forced to FAIL — so the registry confirms the suspect, quarantines
+    the chip, and the supervisor remeshes onto the N-1 survivors.
+    Launches on meshes that EXCLUDE the lost device pass through
+    untouched: N-1 serving works while the fault is still active.
+    heal() removes both hooks and releases parked launches — reprobes
+    then pass, and after the flap-damping hold-down + consecutive
+    healthy probes the device is reintroduced (full-mesh recovery).
+    Never intercepts sends, so it composes with the other schemes."""
+
+    def __init__(self, node=None, *, service=None, device_id=None):
+        self.service = service if service is not None \
+            else getattr(node, "tpu_search", None)
+        self.device_id = device_id
+        self._release = threading.Event()
+        self._dispatch_hook: Optional[Callable] = None
+        self._probe_hook: Optional[Callable] = None
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        if self.service is None:
+            raise RuntimeError("DeviceLoss needs a TpuSearchService "
+                               "(pass node= or service=)")
+        from elasticsearch_tpu.parallel import health as _health
+        from elasticsearch_tpu.search import tpu_service as _tpu
+        if self.device_id is None:
+            # default victim: the highest-id device of the full mesh
+            ids = _tpu._mesh_device_ids(self.service.full_mesh)
+            if not ids:
+                raise RuntimeError("DeviceLoss: service has no devices")
+            self.device_id = max(ids)
+        lost = int(self.device_id)
+        release = self._release
+
+        def dispatch_hook(mesh=None) -> None:
+            # only launches that would touch the dead chip wedge; a
+            # partial mesh excluding it dispatches normally
+            if mesh is None or lost in _tpu._mesh_device_ids(mesh):
+                release.wait()
+
+        def probe_hook(device_id: int) -> Optional[bool]:
+            return True if int(device_id) == lost else None
+
+        self._dispatch_hook = dispatch_hook
+        self._probe_hook = probe_hook
+        _tpu.DISPATCH_FAULT_HOOKS.append(dispatch_hook)
+        _health.PROBE_FAULT_HOOKS.append(probe_hook)
+
+    def intercept(self, src, dst, action):
+        return None  # a device fault, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+            started = self._started
+        if not started:
+            return
+        from elasticsearch_tpu.parallel import health as _health
+        from elasticsearch_tpu.search import tpu_service as _tpu
+        for hooks, hook in ((_tpu.DISPATCH_FAULT_HOOKS,
+                             self._dispatch_hook),
+                            (_health.PROBE_FAULT_HOOKS,
+                             self._probe_hook)):
+            if hook is not None:
+                try:
+                    hooks.remove(hook)
+                except ValueError:
+                    pass
+        self._dispatch_hook = self._probe_hook = None
+        self._release.set()  # unblock any parked launch worker
+        # reintroduction is the health registry's reprobe loop's job —
+        # DeviceLoss does NOT force a recovery here
+
+
+@contextlib.contextmanager
+def device_loss(node=None, **kwargs) -> Iterator[DeviceLoss]:
+    """Context-managed DeviceLoss: the chip dies on entry (quarantine +
+    N-1 remesh follow via supervision); on exit the chip heals and the
+    reprobe loop reintroduces it (even when the body's asserts fail)."""
+    scheme = DeviceLoss(node, **kwargs)
+    scheme.start()
+    try:
+        yield scheme
+    finally:
+        scheme.heal()
+
+
+class FlakyDevice(Scheme):
+    """Intermittent single-chip fault: each dispatch touching the chip
+    wedges with probability `wedge_rate`, and each micro-probe of it
+    fails with probability `probe_fail_rate` — the flap-damping case.
+    A flaky chip should cross the suspect threshold, fail a probe
+    eventually, and then STAY quarantined through the hold-down even
+    when some reprobes pass (consecutive-healthy-probe bar). Seeded
+    rng so tests are reproducible."""
+
+    def __init__(self, node=None, *, service=None, device_id=None,
+                 wedge_rate: float = 1.0, probe_fail_rate: float = 0.5,
+                 seed: int = 0):
+        import random
+        self.service = service if service is not None \
+            else getattr(node, "tpu_search", None)
+        self.device_id = device_id
+        self.wedge_rate = float(wedge_rate)
+        self.probe_fail_rate = float(probe_fail_rate)
+        self._rng = random.Random(seed)
+        self._release = threading.Event()
+        self._dispatch_hook: Optional[Callable] = None
+        self._probe_hook: Optional[Callable] = None
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        if self.service is None:
+            raise RuntimeError("FlakyDevice needs a TpuSearchService "
+                               "(pass node= or service=)")
+        from elasticsearch_tpu.parallel import health as _health
+        from elasticsearch_tpu.search import tpu_service as _tpu
+        if self.device_id is None:
+            ids = _tpu._mesh_device_ids(self.service.full_mesh)
+            if not ids:
+                raise RuntimeError("FlakyDevice: service has no devices")
+            self.device_id = max(ids)
+        flaky = int(self.device_id)
+        release = self._release
+        rng = self._rng
+        rng_lock = threading.Lock()
+
+        def dispatch_hook(mesh=None) -> None:
+            if mesh is not None and flaky not in \
+                    _tpu._mesh_device_ids(mesh):
+                return
+            with rng_lock:
+                wedge = rng.random() < self.wedge_rate
+            if wedge:
+                release.wait()
+
+        def probe_hook(device_id: int) -> Optional[bool]:
+            if int(device_id) != flaky:
+                return None
+            with rng_lock:
+                return rng.random() < self.probe_fail_rate
+
+        self._dispatch_hook = dispatch_hook
+        self._probe_hook = probe_hook
+        _tpu.DISPATCH_FAULT_HOOKS.append(dispatch_hook)
+        _health.PROBE_FAULT_HOOKS.append(probe_hook)
+
+    def intercept(self, src, dst, action):
+        return None  # a device fault, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+            started = self._started
+        if not started:
+            return
+        from elasticsearch_tpu.parallel import health as _health
+        from elasticsearch_tpu.search import tpu_service as _tpu
+        for hooks, hook in ((_tpu.DISPATCH_FAULT_HOOKS,
+                             self._dispatch_hook),
+                            (_health.PROBE_FAULT_HOOKS,
+                             self._probe_hook)):
+            if hook is not None:
+                try:
+                    hooks.remove(hook)
+                except ValueError:
+                    pass
+        self._dispatch_hook = self._probe_hook = None
+        self._release.set()
+
+
+@contextlib.contextmanager
+def flaky_device(node=None, **kwargs) -> Iterator[FlakyDevice]:
+    """Context-managed FlakyDevice: intermittent wedges/probe failures
+    on entry; fully healed on exit (reintroduction follows via the
+    reprobe loop)."""
+    scheme = FlakyDevice(node, **kwargs)
     scheme.start()
     try:
         yield scheme

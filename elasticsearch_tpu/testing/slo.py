@@ -107,6 +107,25 @@ def run_slo(node, *, index: str, duration_s: float,
     out: Dict[str, Any] = {"duration_s": 0.0, "hung_threads": [],
                            "aborted": None, "tenants": {}}
 
+    # degraded sampler: poll the kernel path's structured degraded state
+    # so chip-loss drills are measurable — degraded_fraction (any
+    # degraded reason active) and time at N-1 (partial mesh) land next
+    # to the per-tenant latencies in the result
+    samples = {"total": 0, "degraded": 0, "partial": 0}
+    svc = getattr(node, "tpu_search", None)
+
+    def degraded_sampler() -> None:
+        while not stop.wait(0.02):
+            try:
+                info = svc.degraded_info
+            except Exception:  # noqa: BLE001 — sampling is best-effort
+                continue
+            samples["total"] += 1
+            if info is not None:
+                samples["degraded"] += 1
+                if info.get("reason") == "partial_mesh":
+                    samples["partial"] += 1
+
     def _request(tenant: str, method: str, path: str,
                  req_body: Any) -> int:
         if ports:
@@ -182,6 +201,11 @@ def run_slo(node, *, index: str, duration_s: float,
             for i in range(traffic.writers)]
 
     t_start = time.monotonic()
+    sampler = None
+    if svc is not None and hasattr(svc, "degraded_info"):
+        sampler = threading.Thread(target=degraded_sampler, daemon=True,
+                                   name="slo-degraded-sampler")
+        sampler.start()
     try:
         for t in threads:
             t.start()
@@ -198,6 +222,15 @@ def run_slo(node, *, index: str, duration_s: float,
             t.join(timeout=join_timeout_s)
         out["duration_s"] = round(time.monotonic() - t_start, 3)
         out["hung_threads"] = [t.name for t in threads if t.is_alive()]
+        if sampler is not None:
+            sampler.join(timeout=2.0)
+            total = max(1, samples["total"])
+            out["degraded"] = {
+                "samples": samples["total"],
+                "degraded_fraction": round(samples["degraded"] / total, 4),
+                "time_at_n_minus_1_s": round(
+                    samples["partial"] / total * out["duration_s"], 3),
+            }
         # lost-ack audit: every acked doc must be readable in-process
         # (verification correctness is independent of the wire mode)
         for traffic in specs:

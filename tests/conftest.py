@@ -78,9 +78,11 @@ MULTIPROCESS_TEST_TIMEOUT_S = int(
 @pytest.fixture(autouse=True)
 def _multiprocess_timeout(request):
     # supervision tests (watchdog/recovery/chaos) park threads in fault
-    # hooks and spawn recovery threads — same wedge risk, same guard
+    # hooks and spawn recovery threads — same wedge risk, same guard;
+    # device_loss tests additionally park probe/reprobe threads
     if (request.node.get_closest_marker("multiprocess") is None
-            and request.node.get_closest_marker("supervision") is None):
+            and request.node.get_closest_marker("supervision") is None
+            and request.node.get_closest_marker("device_loss") is None):
         yield
         return
     import signal
@@ -145,6 +147,7 @@ def _multiprocess_orphan_reaper(request):
     mod_id = request.node.nodeid
     marked = any(item.get_closest_marker("multiprocess") is not None
                  or item.get_closest_marker("supervision") is not None
+                 or item.get_closest_marker("device_loss") is not None
                  for item in request.session.items
                  if item.nodeid.startswith(mod_id))
     if not marked:

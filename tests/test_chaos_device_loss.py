@@ -1,0 +1,294 @@
+"""Device-loss chaos (ISSUE 14 acceptance): kill one chip under live
+mixed traffic — the health registry must confirm and quarantine it, the
+supervisor must remesh onto the N-1 survivors (1×7) and keep serving
+the kernel path with the structured `partial_mesh` degraded reason,
+and after the chip heals the reprobe loop must reintroduce it and a
+drain-window recovery must re-attain the full mesh. Throughout: ZERO
+lost acked writes, ZERO hung requests, the HBM breaker draining to
+EXACTLY zero across every remesh, and monotone counters.
+
+Two tiers: a deterministic single-cycle run in tier-1, and a
+`slow`-marked sustained run (repeated loss/reintroduction cycles,
+plus a flaky-chip hold-down cycle) for the full gate.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreaker
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.tpu_service import TpuSearchService
+from elasticsearch_tpu.testing.disruption import device_loss, flaky_device
+
+from test_tpu_serving import make_corpus, svc  # noqa: F401 (fixture)
+
+pytestmark = pytest.mark.device_loss
+
+
+def _wait(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _loss_service(breaker, idx, name):
+    """Service tuned for fast fault-domain cycling: one wedge suffices
+    to suspect, probes answer in ms (forced hooks / healthy CPU), and
+    reintroduction needs 2 consecutive healthy probes after a 0.3s
+    hold-down."""
+    tpu = TpuSearchService(
+        window_s=0.0, batch_timeout_s=120.0, breaker=breaker,
+        launch_deadline_ms=30_000.0,
+        device_health={"suspect_after": 1,
+                       "probe_deadline_ms": 1_500.0,
+                       "reprobe_interval_seconds": 0.15,
+                       "hold_down_seconds": 0.3,
+                       "reintroduce_after": 2,
+                       "drain_window_seconds": 1.0})
+    tpu.index_resolver = lambda n: idx if n == name else None
+    return tpu
+
+
+def _prime_partial_mesh(tpu, idx, q):
+    """Warm the N-1 (1×7) kernel signature OUTSIDE the measured chaos
+    window — first-compile on a fresh partial mesh is a warm-up cost
+    exactly like the full-mesh warm, and JAX interns meshes (same
+    device subset → the same Mesh object), so every later remesh onto
+    the survivors hits this compile cache. Quarantine the victim via
+    the registry, serve one query at N-1 under the wide (un-tightened)
+    watchdog deadline, then let the reprobe loop reintroduce it."""
+    from elasticsearch_tpu.parallel.health import PROBE_FAULT_HOOKS
+
+    full = tpu.supervisor.full_device_count
+    victim = max(tpu.health.device_ids())
+    hook = lambda i: True if int(i) == victim else None  # noqa: E731
+    PROBE_FAULT_HOOKS.append(hook)
+    try:
+        assert tpu.health.record_wedge([victim], label="prime") == [victim]
+        assert _wait(lambda: tpu.supervisor.state == "serving"
+                     and tpu.supervisor.mesh_device_count == full - 1)
+        # the 1×7 compile happens here, unbounded by the chaos deadline
+        assert _wait(lambda: tpu.try_search(idx, q, k=10) is not None,
+                     timeout=120.0, interval=0.1), \
+            "priming query never served on the partial mesh"
+    finally:
+        PROBE_FAULT_HOOKS.remove(hook)
+    # reprobes pass now → hold-down → reintroduction → full mesh
+    assert _wait(lambda: tpu.supervisor.state == "serving"
+                 and tpu.supervisor.mesh_device_count == full), \
+        "priming cycle never re-attained the full mesh"
+
+
+def _run_device_loss_chaos(svc, seeded_np, *, name, cycles,  # noqa: F811
+                           readers=2, p99_bound_s=30.0):
+    idx = make_corpus(svc, seeded_np, name=name, docs=60)
+    breaker = CircuitBreaker("hbm", 1 << 30)
+    tpu = _loss_service(breaker, idx, name)
+    try:
+        q = dsl.MatchQuery(field="body", query="alpha beta")
+        assert tpu.try_search(idx, q, k=10) is not None  # warm full mesh
+        full = tpu.supervisor.full_device_count
+        assert full == 8
+        _prime_partial_mesh(tpu, idx, q)  # warm the 1×7 signature too
+        prior_quarantines = tpu.health.c_quarantines.count
+        prior_reintroductions = tpu.health.c_reintroductions.count
+        # post-warm: tightened wedge detection. The deadline must stay
+        # ABOVE a healthy hot launch — on a loaded CPU host a cached
+        # 8-virtual-device launch runs ~4s wall — so 10s detects a
+        # parked (dead-chip) dispatch without tripping on healthy ones
+        tpu.watchdog.deadline_s = 10.0
+
+        stop = threading.Event()
+        acked = []
+        latencies = []
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                doc_id = f"w{i}"
+                try:
+                    shard = idx.shard(idx.shard_for_id(doc_id))
+                    shard.apply_index_on_primary(
+                        doc_id, {"body": "alpha omega", "tag": "t0"})
+                    acked.append(doc_id)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(("write", e))
+                i += 1
+                time.sleep(0.01)
+
+        def reader():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    # None is fine (degraded/declined → planner would
+                    # serve); an exception or a hang is not
+                    tpu.try_search(idx, q, k=10)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(("read", e))
+                latencies.append(time.monotonic() - t0)
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=writer, name="chaos-writer")]
+        threads += [threading.Thread(target=reader, name=f"chaos-reader-{i}")
+                    for i in range(readers)]
+        for t in threads:
+            t.start()
+
+        try:
+            for cycle in range(cycles):
+                with device_loss(service=tpu) as loss:
+                    victim = int(loss.device_id)
+                    # live traffic wedges on the dead chip → watchdog
+                    # attributes → probe confirms → quarantine → the
+                    # supervisor remeshes onto the N-1 survivors
+                    assert _wait(
+                        lambda: tpu.supervisor.state == "serving"
+                        and tpu.supervisor.mesh_device_count == full - 1
+                    ), f"cycle {cycle}: never remeshed to N-1"
+                    assert victim in tpu.health.quarantined_ids()
+                    info = tpu.degraded_info
+                    assert info is not None
+                    assert info["reason"] == "partial_mesh"
+                    assert info["devices"] == full - 1
+                    assert info["devices_total"] == full
+                    # SUSTAINED N-1 serving while the chip is still
+                    # dead: the kernel path answers on the 1×7 mesh
+                    assert _wait(
+                        lambda: tpu.try_search(idx, q, k=10) is not None,
+                        timeout=60.0
+                    ), f"cycle {cycle}: kernel path never served at N-1"
+                    assert tpu.supervisor.mesh_device_count == full - 1
+
+                # heal: reprobes pass → hold-down → 2 consecutive
+                # healthy probes → reintroduction → drain-window
+                # recovery back onto the full mesh
+                assert _wait(
+                    lambda: tpu.supervisor.state == "serving"
+                    and tpu.supervisor.mesh_device_count == full,
+                    timeout=60.0
+                ), f"cycle {cycle}: never re-attained the full mesh"
+                assert tpu.health.quarantined_ids() == []
+                assert tpu.health.c_reintroductions.count >= \
+                    prior_reintroductions + cycle + 1
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=15.0)
+
+        # quiesce: widen the deadline so post-heal replays can't re-trip
+        tpu.watchdog.deadline_s = 30.0
+        assert _wait(lambda: tpu.supervisor.state == "serving")
+
+        # ZERO hung requests, zero traffic errors
+        hung = [t.name for t in threads if t.is_alive()]
+        assert not hung, f"hung traffic threads: {hung}"
+        assert not errors, f"traffic errors under chaos: {errors[:3]}"
+
+        # ZERO lost acked writes
+        assert acked, "writer made no progress under chaos"
+        lost = [d for d in acked
+                if idx.shard(idx.shard_for_id(d)).get(d) is None]
+        assert not lost, f"lost {len(lost)} acked writes: {lost[:5]}"
+
+        # the pack-lifecycle invariant held across EVERY remesh: each
+        # teardown drained the HBM breaker to exactly zero
+        audits = list(tpu.supervisor.teardown_breaker_bytes)
+        assert len(audits) >= 2 * cycles
+        assert all(b == 0 for b in audits), \
+            f"breaker not exactly zero after teardown: {audits}"
+
+        # monotone counters: each cycle is ≥ one N-1 remesh + one
+        # full-mesh remesh, each with its recovery
+        assert tpu.supervisor.c_remeshes.count >= 2 * cycles
+        assert tpu.supervisor.c_recoveries.count >= 2 * cycles
+        assert tpu.health.c_quarantines.count >= \
+            prior_quarantines + cycles
+        assert tpu.health.c_reintroductions.count >= \
+            prior_reintroductions + cycles
+        assert tpu.health.c_probes.count >= tpu.health.c_probe_failures.count
+
+        # bounded p99: wedged queries fail typed at the watchdog
+        # deadline, declined queries answer instantly
+        assert latencies
+        p99 = float(np.percentile(np.asarray(latencies), 99))
+        assert p99 < p99_bound_s, f"p99 {p99:.2f}s breached the bound"
+
+        # fully recovered: full mesh, kernel serving, breaker re-charged
+        idx.refresh()
+        assert _wait(lambda: tpu.try_search(idx, q, k=10) is not None)
+        assert tpu.supervisor.mesh_device_count == full
+        assert tpu.degraded_info is None
+        assert breaker.used > 0
+        return {"reads": len(latencies), "writes": len(acked), "p99": p99}
+    finally:
+        tpu.close()
+
+
+def test_device_loss_short_tier1(svc, seeded_np):  # noqa: F811
+    """Deterministic short run (tier-1): one kill → N-1 →
+    reintroduction cycle over live mixed traffic."""
+    out = _run_device_loss_chaos(svc, seeded_np, name="devloss1", cycles=1)
+    # modest floors: each read blocks behind a multi-second CPU launch
+    assert out["reads"] > 5 and out["writes"] > 5
+
+
+@pytest.mark.slow
+def test_device_loss_sustained(svc, seeded_np):  # noqa: F811
+    """Sustained run (the ISSUE 14 acceptance run): repeated
+    loss/reintroduction cycles over minutes of mixed traffic."""
+    out = _run_device_loss_chaos(svc, seeded_np, name="devloss2", cycles=4)
+    assert out["reads"] > 20 and out["writes"] > 50
+
+
+@pytest.mark.slow
+def test_flaky_device_stays_quarantined_through_hold_down(
+        svc, seeded_np):  # noqa: F811
+    """A flapping chip (probes pass ~half the time) must cross the
+    suspect threshold, quarantine, and then STAY out through the
+    hold-down — the consecutive-healthy-probe bar plus the failed-
+    reprobe hold-down re-stamp keep the mesh from oscillating."""
+    idx = make_corpus(svc, seeded_np, name="flaky", docs=60)
+    breaker = CircuitBreaker("hbm", 1 << 30)
+    tpu = _loss_service(breaker, idx, "flaky")
+    try:
+        q = dsl.MatchQuery(field="body", query="alpha beta")
+        assert tpu.try_search(idx, q, k=10) is not None
+        full = tpu.supervisor.full_device_count
+        _prime_partial_mesh(tpu, idx, q)  # warm the 1×7 signature
+        prior_reintroductions = tpu.health.c_reintroductions.count
+        # flap damping under test: long hold-down relative to the run
+        tpu.health.hold_down_s = 5.0
+        tpu.watchdog.deadline_s = 10.0
+        with flaky_device(service=tpu, wedge_rate=1.0,
+                          probe_fail_rate=0.5, seed=7) as flaky:
+            victim = int(flaky.device_id)
+            # drive wedges until a probe failure confirms the flake
+            # (each 50/50 acquittal costs one detection+recovery round)
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline and \
+                    victim not in tpu.health.quarantined_ids():
+                tpu.try_search(idx, q, k=10)
+                time.sleep(0.05)
+            assert victim in tpu.health.quarantined_ids()
+            assert _wait(lambda: tpu.supervisor.state == "serving"
+                         and tpu.supervisor.mesh_device_count == full - 1)
+            # some reprobes pass (rate 0.5) — but inside the hold-down
+            # none of them may readmit the flapping chip
+            time.sleep(1.0)
+            assert victim in tpu.health.quarantined_ids()
+            assert tpu.health.c_reintroductions.count == \
+                prior_reintroductions
+        # healed: drop the hold-down so reintroduction can proceed
+        tpu.health.hold_down_s = 0.2
+        assert _wait(lambda: tpu.supervisor.mesh_device_count == full,
+                     timeout=30.0)
+        assert tpu.health.quarantined_ids() == []
+    finally:
+        tpu.close()

@@ -224,6 +224,16 @@ def test_required_families_are_present(node):
             "es_tpu_recovery_degraded_served_total",
             "es_tpu_recovery_state",
             "es_tpu_recovery_last_duration_seconds",
+            "es_tpu_device_mesh_active",
+            "es_tpu_device_mesh_total",
+            "es_tpu_device_remeshes_total",
+            "es_tpu_device_remesh_duration_seconds",
+            "es_tpu_device_shed_packs",
+            "es_tpu_device_probes_total",
+            "es_tpu_device_probe_failures_total",
+            "es_tpu_device_quarantines_total",
+            "es_tpu_device_reintroductions_total",
+            "es_tpu_device_health_state",
             "es_tpu_tenant_search_inflight",
             "es_tpu_tenant_search_cap",
             "es_tpu_tenant_search_admitted_total",

@@ -56,12 +56,11 @@ class _FakePending:
 class TestLaunchWatchdog:
     def test_overdue_dispatch_fails_typed_within_deadline(self):
         wedges = []
-        wd = LaunchWatchdog(deadline_ms=120.0,
-                            on_wedge=lambda lb, age: wedges.append((lb, age)))
+        wd = LaunchWatchdog(deadline_ms=120.0, on_wedge=wedges.append)
         try:
             p = _FakePending()
             t0 = time.monotonic()
-            wd.begin("launch", [p])
+            wd.begin("launch", [p], devices=(0, 3))
             with pytest.raises(DeviceWedgedError, match="launch deadline"):
                 p.future.result(timeout=5.0)
             detected = time.monotonic() - t0
@@ -70,10 +69,14 @@ class TestLaunchWatchdog:
             # scale, not multiples of it
             assert detected < 1.0
             assert _wait(lambda: wedges, timeout=2.0)
-            assert wedges[0][0] == "launch" and wedges[0][1] >= 120.0
+            assert wedges[0]["label"] == "launch"
+            assert wedges[0]["age_ms"] >= 120.0
+            # attribution: the wedge carries the launch's device set
+            assert wedges[0]["devices"] == [0, 3]
             assert wd.c_wedges.count == 1
             assert wd.inflight() == 0
             assert wd.stats()["last_wedge"]["label"] == "launch"
+            assert wd.stats()["last_wedge"]["devices"] == [0, 3]
         finally:
             wd.close()
 
@@ -228,6 +231,69 @@ class TestBatcherKill:
             kill.heal()
             assert _wait(lambda: tpu.supervisor.state == "serving")
         finally:
+            tpu.close()
+
+
+# ---------------------------------------------------------------------
+# tenant QoS × partial-mesh recovery (ISSUE 14 satellite)
+# ---------------------------------------------------------------------
+
+class TestQosPartialMesh:
+    def test_tenant_lanes_and_admission_survive_partial_mesh_respawn(
+            self, svc, seeded_np):  # noqa: F811
+        """Quarantining a device respawns the batcher on the N-1 mesh;
+        the tenant QoS wiring (quota service, lane weights, admission
+        carves) must ride through that respawn unchanged."""
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.common.tenancy import (TenantQuotaService,
+                                                      bind_tenant)
+        from elasticsearch_tpu.parallel.health import PROBE_FAULT_HOOKS
+
+        idx = make_corpus(svc, seeded_np, name="qosmesh", docs=60)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = _service(breaker=breaker, device_health={
+            "suspect_after": 1, "probe_deadline_ms": 2_000.0,
+            # park reintroduction: this test holds the mesh at N-1
+            "reprobe_interval_seconds": 3_600.0,
+            "hold_down_seconds": 3_600.0})
+        tpu.index_resolver = lambda name: idx if name == "qosmesh" else None
+        quotas = TenantQuotaService(
+            Settings.of({"tenancy": {"weight": {"gold": 3.0,
+                                                "bronze": 1.0}}}),
+            search_slots=8)
+        tpu.batcher.tenants = quotas
+        victim = max(tpu.health.device_ids())
+        hook = lambda i: True if i == victim else None  # noqa: E731
+        PROBE_FAULT_HOOKS.append(hook)
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha beta")
+            assert tpu.try_search(idx, q, k=10) is not None  # warm
+            assert tpu.supervisor.mesh_device_count == 8
+            # one wedge suffices (suspect_after=1); the forced-fail
+            # probe confirms, quarantines, and trips the supervisor
+            assert tpu.health.record_wedge([victim],
+                                           label="launch") == [victim]
+            assert _wait(lambda: tpu.supervisor.state == "serving"
+                         and tpu.supervisor.mesh_device_count == 7)
+            # the QoS wiring survived the respawn onto the smaller mesh
+            assert tpu.batcher.tenants is quotas
+            assert tpu.batcher.tenant_weight("gold") == pytest.approx(3.0)
+            assert tpu.batcher.tenant_weight("bronze") == pytest.approx(1.0)
+            # structured degraded contract: partial mesh, 7/8 devices
+            info = tpu.degraded_info
+            assert info == {"reason": "partial_mesh",
+                            "devices": 7, "devices_total": 8}
+            # tenant-bound queries still serve on the kernel path at N-1
+            prev = bind_tenant("gold")
+            try:
+                assert tpu.try_search(idx, q, k=10) is not None
+            finally:
+                bind_tenant(prev)
+            # admission carves still grant/release per tenant
+            quotas.admit_search("bronze")()
+            assert tpu.supervisor.stats()["remeshes"] >= 1
+        finally:
+            PROBE_FAULT_HOOKS.remove(hook)
             tpu.close()
 
 
