@@ -131,6 +131,24 @@ def main() -> None:
         # phases above never hit the carve
         node_settings["tenancy"] = {"weight": {"victim": 2,
                                                "aggressor": 1}}
+    if _env("SLO_DEVICE_LOSS", 0) == 1:
+        # the chip-loss drill runs under replicated pack placement so it
+        # PROVES zero-shed failover: each pack on R=2 distinct
+        # fault-domain groups — losing a chip fails its group over to
+        # the surviving replica instead of shedding
+        node_settings["search"]["tpu_serving"]["placement"] = {
+            "groups": _env("PLACEMENT_GROUPS", 2),
+            "replicas": _env("PLACEMENT_REPLICAS", 2)}
+        # detection must land INSIDE the drill window (default deadline
+        # is 120s — the loss would heal before the watchdog ever calls
+        # it wedged): deadline above a hot CPU launch (~4s), one wedge
+        # suffices to probe, and the probe verdict is forced by the
+        # DeviceLoss scheme anyway
+        node_settings["search"]["tpu_serving"]["launch_deadline_ms"] = \
+            _env("LAUNCH_DEADLINE_MS", 8000)
+        node_settings["search"]["tpu_serving"]["device_health"] = {
+            "suspect_after": 1, "reprobe_interval_seconds": 2,
+            "hold_down_seconds": 5}
     node = Node(tempfile.mkdtemp(prefix="es_tpu_bench_"),
                 settings=Settings.of(node_settings))
     t0 = time.perf_counter()  # bulk ingest + refresh-to-searchable
@@ -560,7 +578,12 @@ def main() -> None:
                 time.sleep(slo_s * 0.3)
                 window = (device_loss if drill_device else batcher_kill)
                 with window(node):
-                    time.sleep(min(1.5, slo_s * 0.2))
+                    # the device drill must hold the fault PAST the
+                    # launch deadline + probe round trip or quarantine
+                    # (and therefore the failover being proven) never
+                    # fires; the batcher kill is detected instantly
+                    time.sleep(min(12.0, slo_s * 0.5) if drill_device
+                               else min(1.5, slo_s * 0.2))
                 # the rest of the run covers the recovery window
 
             slo = run_slo(
@@ -586,6 +609,27 @@ def main() -> None:
                 f"qps={agg.get('qps')} rejects={agg.get('rejects')}; "
                 f"degraded_fraction={deg.get('degraded_fraction')} "
                 f"time_at_n_minus_1={deg.get('time_at_n_minus_1_s')}s")
+            if drill_device and node.tpu_search is not None:
+                # the zero-shed proof: under replicated placement the
+                # chip-loss window must fail over (failovers > 0,
+                # packs_shed == 0); under groups=1 these report the
+                # legacy shed path for comparison
+                pl = node.tpu_search.placement
+                slo["placement"] = {
+                    "groups": pl.num_groups if pl is not None else 1,
+                    "replicas": pl.replicas if pl is not None else 1,
+                    "failovers": (pl.c_failovers.count
+                                  if pl is not None else 0),
+                    "replacements": (pl.c_replacements.count
+                                     if pl is not None else 0),
+                    "packs_shed": (pl.c_shed.count if pl is not None
+                                   else len(node.tpu_search.shed_keys())),
+                }
+                log(f"slo device-loss drill: "
+                    f"failovers={slo['placement']['failovers']} "
+                    f"packs_shed={slo['placement']['packs_shed']} "
+                    f"(groups={slo['placement']['groups']} "
+                    f"replicas={slo['placement']['replicas']})")
         except Exception as e:  # noqa: BLE001 — the phase must emit
             out["slo"]["error"] = f"{type(e).__name__}: {str(e)[:300]}"
             log(f"slo phase failed: {out['slo']['error']}")

@@ -10,12 +10,16 @@ count). The HBM watermark decider hook exists but is node-attr driven.
 
 from __future__ import annotations
 
+import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.cluster.state import (INITIALIZING, STARTED,
                                              UNASSIGNED, ClusterState,
                                              ShardRouting)
+from elasticsearch_tpu.common.metrics import CounterMetric
+
+DEFAULT_MAX_RETRIES = 5  # reference: index.allocation.max_retries
 
 
 def _fresh_aid() -> str:
@@ -30,6 +34,69 @@ class AllocationService:
         # watermark_check(node_id) -> bool (False = don't allocate there);
         # the HBM-watermark decider seam (SURVEY §7.2.7)
         self.watermark_check = watermark_check
+        # bounded allocation retries (reference: UnassignedInfo failed
+        # allocation counts + MaxRetryAllocationDecider): a shard copy
+        # that keeps failing on open — a corrupt store, most notably —
+        # re-places with exponential backoff up to
+        # `index.allocation.max_retries`, then stays unassigned (red/
+        # yellow, visible) instead of crash-looping the applier
+        self.failed_allocations: Dict[Tuple[str, int], int] = {}
+        self._retry_at: Dict[Tuple[str, int], float] = {}
+        self.retry_backoff_base_s = 0.5
+        self.c_failed_allocations = CounterMetric()
+
+    # ---------------- bounded retry bookkeeping ----------------
+
+    def record_failed_allocation(self, index: str, shard: int) -> int:
+        """A copy of [index][shard] failed to allocate/open: bump its
+        failure streak, stamp the exponential-backoff deadline, and
+        return the streak."""
+        key = (index, int(shard))
+        n = self.failed_allocations.get(key, 0) + 1
+        self.failed_allocations[key] = n
+        self.c_failed_allocations.inc()
+        self._retry_at[key] = time.monotonic() + min(
+            self.retry_backoff_base_s * (2 ** (n - 1)), 30.0)
+        return n
+
+    def reset_allocation_failures(self, index: str, shard: int) -> None:
+        """A copy started: the streak is over (manual `_reroute` after
+        fixing the store goes through here too)."""
+        key = (index, int(shard))
+        self.failed_allocations.pop(key, None)
+        self._retry_at.pop(key, None)
+
+    @staticmethod
+    def _max_retries(meta) -> int:
+        max_retries = DEFAULT_MAX_RETRIES
+        if meta is not None:
+            try:
+                max_retries = int(dict(meta.settings).get(
+                    "index.allocation.max_retries", DEFAULT_MAX_RETRIES))
+            except (TypeError, ValueError):
+                pass
+        return max_retries
+
+    def allocation_exhausted(self, index: str, shard: int, meta) -> bool:
+        """True when [index][shard]'s failure streak has used up
+        index.allocation.max_retries: no further automatic placement
+        (the copy stays unassigned and visible — red/yellow — until a
+        manual reroute or a shard-started resets the streak)."""
+        key = (index, int(shard))
+        return (self.failed_allocations.get(key, 0)
+                >= self._max_retries(meta))
+
+    def _allocation_throttled(self, index: str, shard: int,
+                              meta) -> bool:
+        """True when [index][shard] must NOT be re-placed right now:
+        either its failure streak exhausted index.allocation.max_retries
+        or its backoff window is still open."""
+        key = (index, int(shard))
+        if not self.failed_allocations.get(key, 0):
+            return False
+        if self.allocation_exhausted(index, shard, meta):
+            return True
+        return time.monotonic() < self._retry_at.get(key, 0.0)
 
     def reroute(self, state: ClusterState) -> ClusterState:
         if not state.indices:
@@ -116,6 +183,9 @@ class AllocationService:
             for idx, shards in sorted(routing.items()):
                 meta = state.indices.get(idx)
                 for s, copies in sorted(shards.items()):
+                    if self._allocation_throttled(idx, s, meta):
+                        continue  # stays unassigned (yellow/red) until
+                        # the backoff lapses or the streak is reset
                     taken = {c.node_id for c in copies if c.node_id}
                     for i, c in enumerate(copies):
                         if c.node_id is not None:

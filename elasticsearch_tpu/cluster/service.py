@@ -471,8 +471,9 @@ class ClusterService:
                     # reopen from the local store (primary) or catch up
                     # from the primary (replica; idempotent replay)
                     if copy.primary:
-                        svc.create_shard(shard_num, primary=True,
-                                         allocation_id=copy.allocation_id)
+                        if self._open_primary_shard(
+                                svc, name, shard_num, copy) is None:
+                            continue
                         self._write_shard_state(svc, shard_num,
                                                 copy.allocation_id,
                                                 primary=True)
@@ -484,9 +485,9 @@ class ClusterService:
                         or copy.allocation_id in self._started_sent:
                     continue
                 if copy.primary:
-                    if shard is None:
-                        svc.create_shard(shard_num, primary=True,
-                                         allocation_id=copy.allocation_id)
+                    if shard is None and self._open_primary_shard(
+                            svc, name, shard_num, copy) is None:
+                        continue
                     self._write_shard_state(svc, shard_num,
                                             copy.allocation_id,
                                             primary=True)
@@ -497,6 +498,35 @@ class ClusterService:
                 else:
                     self._start_replica_recovery(name, shard_num, copy,
                                                  state)
+
+    def _open_primary_shard(self, svc, name: str, shard_num: int, copy):
+        """Open a primary copy from the local store, failing it TYPED
+        on a corrupt store instead of letting CorruptIndexException
+        kill the state applier: the copy is reported shard-failed to
+        the master, whose reroute promotes/reassigns it — bounded by
+        `index.allocation.max_retries` with backoff (reference: a
+        corrupted shard fails its copy and the MaxRetryAllocationDecider
+        stops the crash-loop; `failed_allocations` surfaces the streak
+        in `_nodes/stats`)."""
+        from elasticsearch_tpu.index.store import CorruptIndexException
+        try:
+            return svc.create_shard(shard_num, primary=True,
+                                    allocation_id=copy.allocation_id)
+        except CorruptIndexException as exc:
+            logger.error("[%s] corrupt store opening %s[%d]: %s — "
+                         "failing the shard copy",
+                         self.local_node.name, name, shard_num, exc)
+            # a partially-constructed copy must not linger
+            broken = svc.shards.pop(shard_num, None)
+            if broken is not None:
+                try:
+                    broken.close()
+                except EsException:
+                    pass
+            self._send_to_master(ACTION_SHARD_FAILED, {
+                "index": name, "shard": shard_num,
+                "allocation_id": copy.allocation_id})
+            return None
 
     @staticmethod
     def _write_shard_state(svc, shard_num: int, allocation_id: str,
@@ -573,6 +603,12 @@ class ClusterService:
                     or node.node_id not in state.nodes
                     or aid not in (meta.in_sync.get(str(shard_num)) or [])):
                 return state  # raced another assignment — ignore
+            if self.allocation.allocation_exhausted(index, shard_num, meta):
+                # a corrupt store would otherwise crash-loop through
+                # store-found → open → CorruptIndexException → failed →
+                # store-found forever; after max_retries the copy stays
+                # unassigned (red, visible) until a manual reroute
+                return state
             routing = {idx: {s: list(c) for s, c in sh.items()}
                        for idx, sh in state.routing.items()}
             copies = routing[index][shard_num]
@@ -1050,6 +1086,10 @@ class ClusterService:
         def update(state: ClusterState) -> ClusterState:
             return AllocationService.shard_started(state, index, shard, aid)
 
+        # a started copy ends its failed-allocation streak (the bounded
+        # max_retries counter guards crash-looping opens, not recoveries
+        # that eventually succeed)
+        self.allocation.reset_allocation_failures(index, shard)
         self._run_master_update(update,
                                 source=f"shard-started[{index}][{shard}]")
         return {"acknowledged": True}
@@ -2304,6 +2344,10 @@ class ClusterService:
         def update(state: ClusterState) -> ClusterState:
             return AllocationService.shard_failed(state, index, shard, aid)
 
+        # bump the bounded-retry streak (backoff, then max_retries cap)
+        # BEFORE rerouting, so the reroute this update triggers already
+        # sees the throttle
+        self.allocation.record_failed_allocation(index, shard)
         self._run_master_update(update,
                                 source=f"shard-failed[{index}][{shard}]")
         return {"acknowledged": True}

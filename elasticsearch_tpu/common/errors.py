@@ -106,6 +106,20 @@ class EngineClosedException(EsException):
     status = 503
 
 
+class TranslogDurabilityException(EsException):
+    """An OSError (ENOSPC/EIO) while appending or fsyncing the translog:
+    the durability policy cannot be honored for this operation, so it is
+    NEVER acked. 503 + Retry-After — the write is safe to retry once the
+    disk recovers (nothing was acknowledged)."""
+
+    status = 503
+
+    def __init__(self, reason: str, *, retry_after_s: float = 5.0,
+                 **md: Any):
+        super().__init__(reason, **md)
+        self.retry_after_s = retry_after_s
+
+
 class CircuitBreakingException(EsException):
     """Reference: common/breaker/CircuitBreakingException — request rejected
     by memory accounting before OOM."""

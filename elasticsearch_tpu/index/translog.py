@@ -29,10 +29,17 @@ import threading
 import zlib
 from typing import Callable, Dict, Iterator, List, Optional
 
-from elasticsearch_tpu.common.errors import TranslogCorruptedException
+from elasticsearch_tpu.common.errors import (TranslogCorruptedException,
+                                             TranslogDurabilityException)
 
 MAGIC = b"ESTPUTL1"
 _HDR = struct.Struct("<II")  # len, crc
+
+# fault-injection seam (testing/disruption.py DiskFull): each hook is
+# called with the translog path at the top of every durable write
+# (append / batch append / sync) and may raise OSError to simulate
+# ENOSPC / EIO. Same pattern as tpu_service.DISPATCH_FAULT_HOOKS.
+WRITE_FAULT_HOOKS: List[Callable[[str], None]] = []
 
 
 @dataclasses.dataclass
@@ -151,19 +158,29 @@ class Translog:
             self._file.flush()
             os.fsync(self._file.fileno())
 
+    def _check_write_faults(self) -> None:
+        for hook in list(WRITE_FAULT_HOOKS):
+            hook(self.path)  # may raise OSError
+
     def add(self, op: TranslogOp) -> None:
         payload = json.dumps(op.to_dict(), separators=(",", ":")).encode("utf-8")
         rec = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:
-            self._file.write(rec)
-            if op.seq_no > self.checkpoint.max_seq_no:
-                self.checkpoint.max_seq_no = op.seq_no
-            if self.durability == self.DURABILITY_REQUEST:
-                self._file.flush()
-                os.fsync(self._file.fileno())
-                self._write_checkpoint(self.checkpoint)
-            else:
-                self._unsynced += 1
+            try:
+                self._check_write_faults()
+                self._file.write(rec)
+                if op.seq_no > self.checkpoint.max_seq_no:
+                    self.checkpoint.max_seq_no = op.seq_no
+                if self.durability == self.DURABILITY_REQUEST:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                    self._write_checkpoint(self.checkpoint)
+                else:
+                    self._unsynced += 1
+            except OSError as e:
+                raise TranslogDurabilityException(
+                    f"translog append failed ({e}): durability cannot be "
+                    f"honored, operation not acknowledged") from e
 
     def add_batch(self, ops) -> None:
         """Append a whole bulk's ops with ONE write and (under
@@ -180,24 +197,36 @@ class Translog:
         payload = json.dumps(dicts, separators=(",", ":")).encode("utf-8")
         rec = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:
-            self._file.write(rec)
-            mx = max(d["seq_no"] for d in dicts)
-            if mx > self.checkpoint.max_seq_no:
-                self.checkpoint.max_seq_no = mx
-            if self.durability == self.DURABILITY_REQUEST:
-                self._file.flush()
-                os.fsync(self._file.fileno())
-                self._write_checkpoint(self.checkpoint)
-            else:
-                self._unsynced += len(ops)
+            try:
+                self._check_write_faults()
+                self._file.write(rec)
+                mx = max(d["seq_no"] for d in dicts)
+                if mx > self.checkpoint.max_seq_no:
+                    self.checkpoint.max_seq_no = mx
+                if self.durability == self.DURABILITY_REQUEST:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                    self._write_checkpoint(self.checkpoint)
+                else:
+                    self._unsynced += len(ops)
+            except OSError as e:
+                raise TranslogDurabilityException(
+                    f"translog batch append failed ({e}): durability "
+                    f"cannot be honored, bulk not acknowledged") from e
 
     def sync(self) -> None:
         """Flush+fsync pending ops (async durability timer / pre-commit)."""
         with self._lock:
-            self._file.flush()
-            os.fsync(self._file.fileno())
-            self._write_checkpoint(self.checkpoint)
-            self._unsynced = 0
+            try:
+                self._check_write_faults()
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._write_checkpoint(self.checkpoint)
+                self._unsynced = 0
+            except OSError as e:
+                raise TranslogDurabilityException(
+                    f"translog sync failed ({e}): durability cannot be "
+                    f"honored") from e
 
     def rollover(self) -> int:
         """Start a new generation (reference: Translog#rollGeneration —

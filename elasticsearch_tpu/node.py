@@ -155,6 +155,17 @@ class Node:
                     "shed_retry_after_seconds": self.settings.get_float(
                         "search.tpu_serving.device_health"
                         ".shed_retry_after_seconds", 5.0),
+                },
+                # pack-replica placement across device fault domains:
+                # groups=1 (the default) keeps today's whole-mesh serving
+                # byte-identical; groups>1 partitions the mesh and places
+                # each resident pack on `replicas` distinct groups so a
+                # chip loss fails over instead of shedding
+                placement={
+                    "groups": self.settings.get_int(
+                        "search.tpu_serving.placement.groups", 1),
+                    "replicas": self.settings.get_int(
+                        "search.tpu_serving.placement.replicas", 1),
                 })
             # recovery's eager re-residency resolves index names through
             # the live indices service
@@ -572,6 +583,30 @@ class Node:
                 # wedge counts per chip
                 for labels, counter in health.c_device_wedges.items():
                     yield ("device.wedges", labels, counter)
+            pl = svc.placement
+            if pl is not None:
+                # es_tpu_placement_*: fault-domain placement — group
+                # inventory, replica failovers vs. shed (the drill's
+                # zero-shed proof reads these two counters)
+                yield ("placement.groups", nl, pl.num_groups, "gauge")
+                yield ("placement.replicas", nl, pl.replicas, "gauge")
+                yield ("placement.devices_active", nl,
+                       pl.devices_active(), "gauge")
+                yield ("placement.failovers", nl, pl.c_failovers,
+                       "counter")
+                yield ("placement.replacements", nl, pl.c_replacements,
+                       "counter")
+                yield ("placement.packs_shed", nl, pl.c_shed, "counter")
+                for g in pl.groups():
+                    gl = {"group": str(g.gid)}
+                    yield ("placement.group_devices", gl,
+                           len(g.active_ids), "gauge")
+                    cache = svc.group_caches.get(g.gid)
+                    yield ("placement.group_packs", gl,
+                           len(cache.resident_keys())
+                           if cache is not None else 0, "gauge")
+                    yield ("placement.group_hbm_bytes", gl,
+                           g.breaker.used, "gauge")
         reg.add_collector(_tpu)
 
         def _transport():

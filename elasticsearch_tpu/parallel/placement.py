@@ -1,0 +1,378 @@
+"""Device placement layer: fault-domain groups + R-way pack replicas.
+
+Reference analog: shard allocation across nodes with replica copies in
+distinct fault domains (`cluster.routing.allocation.awareness`) — a lost
+node's shards keep serving from in-sync replicas on survivors, and the
+allocator only re-assigns copies that have no live replica left. Here
+the "node" is a GROUP of mesh devices (a fault domain): the full device
+list is partitioned into `groups` contiguous device groups, each with
+its own sub-mesh, and every resident pack is placed onto `replicas`
+DISTINCT groups (anti-affinity is structural — one replica per group).
+
+The service is pure bookkeeping + policy; it owns no device arrays:
+
+  * `place(key, ...)` picks up to R healthy groups for a new pack,
+    fullest-headroom-first under each group's HBM budget (the shared
+    node `hbm` breaker is partitioned into per-group views so one
+    group's residency cannot overcommit another group's chips);
+  * `route(key)` returns the least-loaded healthy replica group for a
+    launch — the per-pack micro-batch queues then become per-GROUP
+    lanes, because each (pack, group) replica is its own queue;
+  * `on_device_lost(id)` shrinks ONE group's active set and rebuilds
+    only that group's mesh over its survivors — the other groups'
+    meshes (and their jit caches) are untouched;
+  * the serving layer consults `groups_of(key)` on failure: a key with
+    a live replica elsewhere FAILS OVER (no shed); only a key whose
+    last replica died is re-placed, and only when no group has headroom
+    does it shed with a typed 503.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from elasticsearch_tpu.common.errors import CircuitBreakingException
+from elasticsearch_tpu.common.metrics import CounterMetric
+from elasticsearch_tpu.parallel.mesh import make_mesh
+
+logger = logging.getLogger("elasticsearch_tpu.parallel.placement")
+
+
+class GroupBreaker:
+    """Per-group HBM accounting view over the node's shared `hbm`
+    breaker. Charges pass through to the parent (real HBM is still
+    globally bounded), while the group-local counter enforces this
+    group's slice of the budget — and supports the per-group
+    exact-zero drain audit after a group teardown."""
+
+    def __init__(self, name: str, parent: Optional[Any],
+                 limit_bytes: Optional[int]):
+        self.name = name
+        self._parent = parent
+        self.limit = int(limit_bytes) if limit_bytes is not None else None
+        self._used = 0
+        self._trips = 0
+        self._lock = threading.Lock()
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def trip_count(self) -> int:
+        return self._trips
+
+    def add_estimate_bytes_and_maybe_break(self, bytes_wanted: int,
+                                           label: str = "") -> None:
+        with self._lock:
+            new_used = self._used + bytes_wanted
+            if (bytes_wanted > 0 and self.limit is not None
+                    and new_used > self.limit):
+                self._trips += 1
+                raise CircuitBreakingException(
+                    f"[{self.name}] data for [{label}] would be "
+                    f"[{new_used}/{self.limit}] bytes, which is larger "
+                    f"than this placement group's limit",
+                    bytes_wanted=bytes_wanted, byte_limit=self.limit)
+            self._used = new_used
+        if self._parent is not None and bytes_wanted > 0:
+            try:
+                self._parent.add_estimate_bytes_and_maybe_break(
+                    bytes_wanted, label=label)
+            except CircuitBreakingException:
+                with self._lock:
+                    self._used -= bytes_wanted
+                raise
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._used -= nbytes
+        if self._parent is not None:
+            self._parent.release(nbytes)
+
+    def headroom(self) -> Optional[int]:
+        if self.limit is None:
+            return None
+        return self.limit - self._used
+
+    def stats(self) -> Dict[str, Any]:
+        return {"limit_size_in_bytes": self.limit,
+                "estimated_size_in_bytes": self._used,
+                "tripped": self._trips}
+
+
+@dataclasses.dataclass
+class DeviceGroup:
+    """One fault domain: a fixed membership of devices, a live mesh
+    over the currently-active members, and this group's HBM budget."""
+
+    gid: int
+    devices: Tuple[Any, ...]            # full membership (never shrinks)
+    device_ids: Tuple[int, ...]
+    mesh: Any                           # mesh over active members
+    active_ids: Tuple[int, ...]
+    breaker: Optional[GroupBreaker] = None
+
+    @property
+    def alive(self) -> bool:
+        return len(self.active_ids) > 0
+
+    @property
+    def degraded(self) -> bool:
+        return len(self.active_ids) < len(self.device_ids)
+
+    def active_devices(self) -> List[Any]:
+        return [d for d in self.devices
+                if int(d.id) in set(self.active_ids)]
+
+
+class PlacementService:
+    """The placement table: (index, field) key → replica group ids,
+    plus group topology/health/load bookkeeping. Thread-safe; all
+    mutation happens under one lock (placement changes are rare — the
+    hot path is `route`, a dict lookup + a min over ≤R ints)."""
+
+    def __init__(self, devices: Sequence[Any], groups: int,
+                 replicas: int, breaker: Optional[Any] = None):
+        devices = list(devices)
+        if groups < 1 or groups > len(devices):
+            raise ValueError(
+                f"placement.groups={groups} with {len(devices)} devices")
+        self.replicas = max(1, min(int(replicas), groups))
+        self._lock = threading.Lock()
+        self._groups: Dict[int, DeviceGroup] = {}
+        # contiguous partition: device order is ICI-adjacency order, so
+        # a fault domain is a physically-adjacent slice of the mesh
+        base = len(devices) // groups
+        extra = len(devices) % groups
+        start = 0
+        total_limit = getattr(breaker, "limit", None) \
+            if breaker is not None else None
+        for gid in range(groups):
+            n = base + (1 if gid < extra else 0)
+            members = tuple(devices[start:start + n])
+            start += n
+            ids = tuple(int(d.id) for d in members)
+            limit = (int(total_limit * n / len(devices))
+                     if total_limit is not None else None)
+            gb = GroupBreaker(f"hbm.group{gid}", breaker, limit)
+            self._groups[gid] = DeviceGroup(
+                gid=gid, devices=members, device_ids=ids,
+                mesh=make_mesh(devices=list(members)), active_ids=ids,
+                breaker=gb)
+        self._table: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+        self._load: Dict[int, int] = {gid: 0 for gid in self._groups}
+        self.c_failovers = CounterMetric()
+        self.c_replacements = CounterMetric()
+        self.c_shed = CounterMetric()
+        # (gid, breaker bytes observed after a group drain): the chaos
+        # suite asserts every entry is exactly zero — the invalidate_all
+        # exact-zero invariant held PER GROUP across the event
+        self.drain_audit: List[Tuple[int, int]] = []
+
+    # -- topology ------------------------------------------------------
+
+    @property
+    def num_groups(self) -> int:
+        return len(self._groups)
+
+    def group(self, gid: int) -> DeviceGroup:
+        return self._groups[gid]
+
+    def groups(self) -> List[DeviceGroup]:
+        return [self._groups[g] for g in sorted(self._groups)]
+
+    def group_of_device(self, device_id: int) -> Optional[int]:
+        for g in self._groups.values():
+            if int(device_id) in g.device_ids:
+                return g.gid
+        return None
+
+    def devices_total(self) -> int:
+        return sum(len(g.device_ids) for g in self._groups.values())
+
+    def devices_active(self) -> int:
+        return sum(len(g.active_ids) for g in self._groups.values())
+
+    def healthy_gids(self) -> List[int]:
+        return [g.gid for g in self.groups() if g.alive]
+
+    # -- device lifecycle ----------------------------------------------
+
+    def on_device_lost(self, device_id: int) -> Optional[int]:
+        """Shrink the owning group's active set and remesh JUST that
+        group over its survivors (None mesh when nothing survives).
+        Returns the affected gid, or None when the device is unknown or
+        already out."""
+        with self._lock:
+            gid = self.group_of_device(device_id)
+            if gid is None:
+                return None
+            g = self._groups[gid]
+            if int(device_id) not in g.active_ids:
+                return None
+            g.active_ids = tuple(i for i in g.active_ids
+                                 if i != int(device_id))
+            survivors = g.active_devices()
+            g.mesh = make_mesh(devices=survivors) if survivors else None
+            logger.error(
+                "placement group %d lost device %d; %d/%d member(s) "
+                "remain", gid, device_id, len(g.active_ids),
+                len(g.device_ids))
+            return gid
+
+    def on_device_restored(self, device_id: int) -> Optional[int]:
+        """Readmit a device into its group and remesh the group back
+        toward full membership. Returns the gid, or None when nothing
+        changed."""
+        with self._lock:
+            gid = self.group_of_device(device_id)
+            if gid is None:
+                return None
+            g = self._groups[gid]
+            if int(device_id) in g.active_ids:
+                return None
+            g.active_ids = tuple(i for i in g.device_ids
+                                 if i in set(g.active_ids)
+                                 or i == int(device_id))
+            g.mesh = make_mesh(devices=g.active_devices())
+            logger.warning(
+                "placement group %d readmitted device %d; %d/%d "
+                "member(s) active", gid, device_id, len(g.active_ids),
+                len(g.device_ids))
+            return gid
+
+    # -- the placement table -------------------------------------------
+
+    def groups_of(self, key: Tuple[str, str]) -> Tuple[int, ...]:
+        with self._lock:
+            return self._table.get(tuple(key), ())
+
+    def keys(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return sorted(self._table)
+
+    def set_groups(self, key: Tuple[str, str],
+                   gids: Sequence[int]) -> None:
+        with self._lock:
+            if gids:
+                self._table[tuple(key)] = tuple(gids)
+            else:
+                self._table.pop(tuple(key), None)
+
+    def drop_replica(self, key: Tuple[str, str], gid: int) -> None:
+        with self._lock:
+            key = tuple(key)
+            have = self._table.get(key)
+            if have is None:
+                return
+            left = tuple(g for g in have if g != gid)
+            if left:
+                self._table[key] = left
+            else:
+                self._table.pop(key, None)
+
+    def add_replica(self, key: Tuple[str, str], gid: int) -> None:
+        with self._lock:
+            key = tuple(key)
+            have = self._table.get(key, ())
+            if gid not in have:
+                self._table[key] = have + (gid,)
+
+    def forget(self, key: Tuple[str, str]) -> None:
+        with self._lock:
+            self._table.pop(tuple(key), None)
+
+    def place(self, key: Tuple[str, str], est_bytes: int = 0,
+              want: Optional[int] = None,
+              exclude: Sequence[int] = ()) -> List[int]:
+        """Choose up to `want` (default `replicas`) DISTINCT healthy
+        groups for `key`, fullest-headroom-first; a group must fit
+        `est_bytes` inside its budget to qualify (est 0 — an unbuilt
+        pack — always qualifies; the build's own breaker charge is the
+        backstop). Records the choice in the table. Returns the chosen
+        gids, [] when no group qualifies."""
+        want = self.replicas if want is None else max(1, int(want))
+        skip = set(exclude)
+        with self._lock:
+            have = list(self._table.get(tuple(key), ()))
+            candidates = []
+            for g in self.groups():
+                if not g.alive or g.gid in skip or g.gid in have:
+                    continue
+                head = (g.breaker.headroom() if g.breaker is not None
+                        else None)
+                if head is not None and est_bytes > 0 \
+                        and est_bytes > head:
+                    continue
+                # sort: most headroom first (None = unlimited sorts
+                # first), then least load, then gid for determinism
+                candidates.append(
+                    ((0 if head is None else 1, -(head or 0),
+                      self._load.get(g.gid, 0), g.gid), g.gid))
+            candidates.sort()
+            chosen = have + [gid for _rank, gid in
+                             candidates[:max(0, want - len(have))]]
+            if chosen:
+                self._table[tuple(key)] = tuple(chosen)
+            return chosen
+
+    def route(self, key: Tuple[str, str]) -> Optional[int]:
+        """Least-loaded healthy replica group for a launch of `key`,
+        or None when every replica group is down."""
+        with self._lock:
+            gids = self._table.get(tuple(key), ())
+            live = [g for g in gids if self._groups[g].alive]
+            if not live:
+                return None
+            return min(live, key=lambda g: (self._load.get(g, 0), g))
+
+    # -- load accounting (in-flight submissions per group) -------------
+
+    def note_submit(self, gid: int) -> None:
+        with self._lock:
+            self._load[gid] = self._load.get(gid, 0) + 1
+
+    def note_done(self, gid: int) -> None:
+        with self._lock:
+            self._load[gid] = max(0, self._load.get(gid, 0) - 1)
+
+    # -- audits / observability ----------------------------------------
+
+    def record_drain(self, gid: int, breaker_bytes: int) -> None:
+        self.drain_audit.append((int(gid), int(breaker_bytes)))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            table = {f"{i}/{f}": list(gids)
+                     for (i, f), gids in sorted(self._table.items())}
+            groups = {}
+            for g in self.groups():
+                groups[str(g.gid)] = {
+                    "devices": list(g.device_ids),
+                    "active": list(g.active_ids),
+                    "alive": g.alive,
+                    "degraded": g.degraded,
+                    "load": self._load.get(g.gid, 0),
+                    "hbm": (g.breaker.stats()
+                            if g.breaker is not None else None),
+                }
+        return {"groups": groups,
+                "replicas": self.replicas,
+                "placements": table,
+                "failovers": self.c_failovers.count,
+                "replacements": self.c_replacements.count,
+                "shed": self.c_shed.count,
+                "drain_audit": [list(t) for t in self.drain_audit],
+                "devices_active": self.devices_active(),
+                "devices_total": self.devices_total()}
+
+    # timestamps for failover stamps (kept here so the serving layer
+    # doesn't need its own clock discipline)
+    @staticmethod
+    def now() -> float:
+        return time.monotonic()

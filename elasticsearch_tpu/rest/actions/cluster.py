@@ -132,6 +132,18 @@ def register(controller: RestController, node) -> None:
         if getattr(node, "tenants", None) is not None:
             # per-tenant QoS: weights, caps, in-flight and rejections
             out["nodes"][node.node_id]["tenants"] = node.tenants.stats()
+        # bounded-retry allocation visibility: total shard-copy
+        # allocation failures (corrupt store opens, failed recoveries)
+        # plus the currently-throttled streaks per [index][shard]
+        alloc = getattr(getattr(node, "cluster", None), "allocation", None)
+        out["nodes"][node.node_id]["allocations"] = {
+            "failed_allocations":
+                alloc.c_failed_allocations.count if alloc else 0,
+            "failed_streaks":
+                {f"{i}[{s}]": n for (i, s), n in
+                 sorted(alloc.failed_allocations.items())} if alloc
+                else {},
+        }
         return 200, out
 
     # ---------------- _cat ----------------

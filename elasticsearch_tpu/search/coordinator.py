@@ -425,7 +425,7 @@ def search(indices: IndicesService, index_expr: Optional[str],
         if fast is not None:
             # N-1 serving: even kernel-served answers carry the
             # structured degraded reason while the mesh is partial
-            _stamp_degraded(fast, tpu_search)
+            _stamp_degraded(fast, tpu_search, names)
             return fast
 
     # ---- query phase: every shard of every target index ----
@@ -689,19 +689,36 @@ def search(indices: IndicesService, index_expr: Optional[str],
     if body.get("suggest") is not None:
         from elasticsearch_tpu.search.suggest import run_suggest
         out["suggest"] = run_suggest(indices, names, body["suggest"])
-    _stamp_degraded(out, tpu_search)
+    _stamp_degraded(out, tpu_search, names)
     return out
 
 
-def _stamp_degraded(out: Dict[str, Any], tpu_search) -> None:
+def _stamp_degraded(out: Dict[str, Any], tpu_search,
+                    names: Optional[List[str]] = None) -> None:
     """Mark answers produced while the kernel path is degraded —
     batcher down/recovering (planner served this) or serving on a
     partial mesh (N-1 capacity) — with a structured reason clients
     can type against (reference: a yellow cluster keeps answering,
-    and says so)."""
+    and says so). A target index whose pack is being served by a
+    surviving placement replica group carries the more specific
+    `failed_over` reason — degraded but ANSWERED, the opposite of
+    `shed` (which never reaches here: shed indexes 503 up front)."""
     if tpu_search is None:
         return
-    info = getattr(tpu_search, "degraded_info", None)
+    info = None
+    if names:
+        failover_info = getattr(tpu_search, "failover_info", None)
+        if callable(failover_info):
+            for name in names:
+                fo = failover_info(name)
+                if fo:
+                    info = {"reason": "failed_over",
+                            "index": fo.get("index"),
+                            "from_group": fo.get("from_group"),
+                            "to_group": fo.get("to_group")}
+                    break
+    if info is None:
+        info = getattr(tpu_search, "degraded_info", None)
     if info is None and getattr(tpu_search, "degraded_active", False):
         info = {"reason": "recovering"}
     if info:

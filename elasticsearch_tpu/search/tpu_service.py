@@ -334,6 +334,12 @@ class ResidentPack:
     # per-pack HBM accounting detail for /_tpu/stats and the Prometheus
     # pack families: raw vs resident bytes, ratio, block metadata, docs
     hbm_detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # placement (fault-domain) residency: when this pack is one replica
+    # of an R-way placement, the group's sub-mesh its arrays live on —
+    # launches MUST use it (a strict subset of the full mesh). None =
+    # single-group serving, launches use the batcher's mesh unchanged.
+    group_mesh: Optional[Any] = None
+    group_id: Optional[int] = None
 
     @property
     def compressed(self) -> bool:
@@ -354,11 +360,15 @@ class IndexPackCache:
     "segments or live-docs changed". HBM bytes are charged to the `hbm`
     breaker before device placement and released on eviction."""
 
-    def __init__(self, mesh=None, breaker=None):
+    def __init__(self, mesh=None, breaker=None, group_id=None):
         self._mesh = mesh
         self._lock = threading.Lock()
         self._cache: Dict[Tuple[str, str], ResidentPack] = {}
         self._breaker = breaker
+        # fault-domain placement: a group-scoped cache stamps its id and
+        # sub-mesh onto every pack it builds so launches route to the
+        # group's devices (None = the classic whole-mesh cache)
+        self.group_id = group_id
         # per-key build serialization: a refresh-triggered rebuild of one
         # (index, field) pack must not block fast-path lookups of every
         # other key on the node (ADVICE r2 low #4)
@@ -408,6 +418,20 @@ class IndexPackCache:
     def heat_of(self, key: Tuple[str, str]) -> float:
         with self._lock:
             return self._heat.get(key, 0.0)
+
+    def peek(self, key: Tuple[str, str]) -> Optional[ResidentPack]:
+        """Current resident for `key` without building (placement's
+        live-replica check)."""
+        with self._lock:
+            return self._cache.get(tuple(key))
+
+    def resident_keys(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return sorted(self._cache)
+
+    def residents(self) -> List[ResidentPack]:
+        with self._lock:
+            return list(self._cache.values())
 
     def bytes_of(self, key: Tuple[str, str]) -> int:
         with self._lock:
@@ -576,7 +600,10 @@ class IndexPackCache:
                             imp_device_arrays=imp_arrays,
                             row_shard=row_shard, row_offset=row_offset,
                             id_cat=id_cat, row_segments=row_segments,
-                            comp_streams=streams, hbm_detail=hbm_detail)
+                            comp_streams=streams, hbm_detail=hbm_detail,
+                            group_mesh=(self.mesh if self.group_id
+                                        is not None else None),
+                            group_id=self.group_id)
 
     def invalidate(self, index_name: str) -> None:
         evicted = []
@@ -741,6 +768,13 @@ class _PackQueue:
             self.cv.notify_all()
             return True
 
+    def launch_mesh(self):
+        """The mesh this queue's launches run on: the resident's
+        placement-group sub-mesh when the pack is group-placed, else
+        the batcher-wide mesh (single-group serving, unchanged)."""
+        return getattr(self.resident, "group_mesh", None) \
+            or self.batcher.mesh
+
     def close(self) -> None:
         with self.cv:
             self.closed = True
@@ -831,9 +865,9 @@ class _PackQueue:
                     # the watchdog fails `taken` typed and trips the
                     # supervisor instead of hanging the micro-batcher
                     wd = batcher.watchdog
+                    mesh = self.launch_mesh()
                     token = (wd.begin("launch", taken,
-                                      devices=_mesh_device_ids(
-                                          batcher.mesh))
+                                      devices=_mesh_device_ids(mesh))
                              if wd is not None else None)
                     try:
                         with tracing.span_under(trace_parent,
@@ -842,7 +876,7 @@ class _PackQueue:
                             st = launch_flat_batch(
                                 self.resident, [p.flat for p in taken],
                                 k=max(p.k for p in taken),
-                                mesh=batcher.mesh,
+                                mesh=mesh,
                                 stages=batcher.stages)
                     finally:
                         if wd is not None:
@@ -877,7 +911,8 @@ class _PackQueue:
                 profiler.tag_stage("batch_finish")
                 wd = batcher.watchdog
                 token = (wd.begin("finish", taken,
-                                  devices=_mesh_device_ids(batcher.mesh))
+                                  devices=_mesh_device_ids(
+                                      self.launch_mesh()))
                          if wd is not None else None)
                 try:
                     with tracing.span_under(trace_parent,
@@ -955,6 +990,28 @@ class MicroBatcher:
                 if not p.future.done():
                     p.future.set_exception(exc)
                     failed += 1
+        return failed
+
+    def fail_pack_pending(self, resident: ResidentPack,
+                          exc: BaseException) -> int:
+        """Fail ONE pack's not-yet-launched queries typed and retire
+        its queue (group failover: the pack's home group lost a device
+        — waiting queries must not launch onto, or wait out a deadline
+        against, the dead chip; the caller re-routes retries to a
+        surviving replica group)."""
+        with self._lock:
+            queue = self._queues.pop(id(resident), None)
+        if queue is None:
+            return 0
+        with queue.cv:
+            pendings, queue.pendings = queue.pendings, []
+            queue.closed = True
+            queue.cv.notify_all()
+        failed = 0
+        for p in pendings:
+            if not p.future.done():
+                p.future.set_exception(exc)
+                failed += 1
         return failed
 
     def retire_pack(self, resident: ResidentPack) -> None:
@@ -1794,6 +1851,14 @@ class BatcherSupervisor:
             # assert the invariant held across every remesh
             self.teardown_breaker_bytes.append(
                 int(getattr(breaker, "used", 0)))
+        if svc.placement is not None:
+            # full teardown under placement drains every group cache
+            # too, with the SAME exact-zero audit per group
+            for gid, cache in sorted(svc.group_caches.items()):
+                cache.invalidate_all()
+                gb = svc.placement.group(gid).breaker
+                if gb is not None:
+                    svc.placement.record_drain(gid, int(gb.used))
         with self._lock:
             self._dropped_keys = dropped
 
@@ -1814,6 +1879,9 @@ class BatcherSupervisor:
         svc = self.svc
         t0 = time.monotonic()
         try:
+            if svc.placement is not None:
+                self._recover_placement(t0)
+                return
             old = svc.batcher
             # partial-mesh topology: rebuild over the health registry's
             # surviving devices. With every device healthy this is the
@@ -1931,6 +1999,70 @@ class BatcherSupervisor:
                 self.state = "down"
             logger.exception("batcher recovery failed; staying degraded")
 
+    def _recover_placement(self, t0: float) -> None:
+        """Full-teardown recovery under fault-domain placement: respawn
+        the batcher and remesh EACH group over its own survivors (a
+        group's mesh never spans another group's devices), then
+        eagerly re-attain residency for every placed replica. Group-
+        scoped failover (one quarantined chip) never comes through
+        here — it runs without a teardown at all."""
+        svc = self.svc
+        pl = svc.placement
+        old = svc.batcher
+        health = svc.health
+        active = (set(health.active_ids()) if health is not None
+                  else None)
+        for gid, cache in sorted(svc.group_caches.items()):
+            # stragglers built since teardown were placed on the old
+            # group mesh — drop them before remeshing
+            cache.invalidate_all()
+            if active is not None:
+                g = pl.group(gid)
+                for i in g.active_ids:
+                    if i not in active:
+                        pl.on_device_lost(i)
+                for i in g.device_ids:
+                    if i in active and i not in pl.group(gid).active_ids:
+                        pl.on_device_restored(i)
+            g = pl.group(gid)
+            if g.alive:
+                cache.set_mesh(g.mesh)
+        fresh = MicroBatcher(window_s=old.window_s,
+                             max_batch=old.max_batch)
+        fresh.batches_executed = old.batches_executed
+        fresh.queries_executed = old.queries_executed
+        fresh.mesh = svc.full_mesh
+        fresh.stages = svc.stages
+        fresh.watchdog = svc.watchdog
+        fresh.tenants = old.tenants
+        svc.batcher = fresh
+        svc.packs.on_evict = fresh.retire_pack
+        # eager re-residency of every placed replica (lazy rebuild on
+        # first traffic when no resolver is wired)
+        for key in pl.keys():
+            for gid in pl.groups_of(key):
+                if (pl.group(gid).alive
+                        and svc.group_caches[gid].peek(key) is None):
+                    svc._eager_rebuild(key, gid)
+        mesh_ids = tuple(sorted(i for g in pl.groups()
+                                for i in g.active_ids))
+        with self._lock:
+            self.state = "serving"
+            self.last_duration_s = time.monotonic() - t0
+            remeshed = mesh_ids != tuple(sorted(self._mesh_ids))
+            self._mesh_ids = mesh_ids
+            self.mesh_device_count = len(mesh_ids)
+            if remeshed:
+                self.last_remesh_duration_s = self.last_duration_s
+        if remeshed:
+            self.c_remeshes.inc()
+        self.c_recoveries.inc()
+        svc._tripped = False
+        logger.warning("batcher recovered in %.2fs over %d placement "
+                       "group(s), %d/%d device(s)", self.last_duration_s,
+                       pl.num_groups, len(mesh_ids),
+                       self.full_device_count)
+
     def schedule_full_remesh(self, reason: str) -> None:
         """A quarantined device proved healthy again: recover onto the
         restored device set inside a DRAIN WINDOW — wait (bounded by
@@ -1989,7 +2121,8 @@ class TpuSearchService:
                  packed_sort: bool = True,
                  compressed_pack: bool = False,
                  launch_deadline_ms: float = 120_000.0,
-                 device_health: Optional[Dict[str, Any]] = None):
+                 device_health: Optional[Dict[str, Any]] = None,
+                 placement: Optional[Dict[str, Any]] = None):
         _ensure_compile_cache(compile_cache_dir)
         KERNEL_CONFIG["packed_sort"] = bool(packed_sort)
         KERNEL_CONFIG["compressed_pack"] = bool(compressed_pack)
@@ -2034,6 +2167,37 @@ class TpuSearchService:
         self._shed_lock = threading.Lock()
         self.shed_retry_after_s = float(
             hcfg.get("shed_retry_after_seconds", 5.0))
+        # pack-replica placement across device fault domains: partition
+        # the mesh into `placement.groups` device groups and place each
+        # pack's shard groups onto `placement.replicas` of them — a
+        # quarantined chip then FAILS ITS GROUP OVER to a surviving
+        # replica group instead of shedding. groups=1 (the default)
+        # keeps the classic whole-mesh path byte-identical: placement
+        # is None and every existing seam behaves exactly as before.
+        pcfg = dict(placement or {})
+        self.placement = None
+        self.group_caches: Dict[int, "IndexPackCache"] = {}
+        n_groups = int(pcfg.get("groups", 1))
+        if n_groups > 1:
+            from elasticsearch_tpu.parallel.placement import \
+                PlacementService
+            self.placement = PlacementService(
+                list(self.full_mesh.devices.flat), n_groups,
+                int(pcfg.get("replicas", 1)), breaker=breaker)
+            for g in self.placement.groups():
+                cache = IndexPackCache(mesh=g.mesh, breaker=g.breaker,
+                                       group_id=g.gid)
+                # route through self.batcher so a supervisor respawn
+                # re-targets eviction at the live batcher automatically
+                cache.on_evict = \
+                    lambda r: self.batcher.retire_pack(r)
+                self.group_caches[g.gid] = cache
+        # (index, field) keys currently served by a surviving replica
+        # group because their home group lost a device — the coordinator
+        # stamps these responses `failed_over` (degraded but answered,
+        # NEVER shed while any replica lives)
+        self._failed_over: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._placement_lock = threading.RLock()
         # supervision: the watchdog deadline-stamps every dispatch and
         # trips the supervisor on a wedge; the supervisor respawns the
         # batcher (over the surviving devices) and re-attains residency
@@ -2078,18 +2242,36 @@ class TpuSearchService:
                                          label=label)
             except Exception:  # noqa: BLE001 — supervision must trip
                 logger.exception("device health scoring failed")
+        if self.placement is not None and wedge.get("devices"):
+            # group-attributed wedge under placement: any confirmed-bad
+            # chip already failed its group over (the quarantine
+            # callback ran synchronously inside record_wedge) — the
+            # batcher itself is healthy, so a full teardown would
+            # needlessly drop every OTHER group's residency. A wedge
+            # whose probes all passed was transient: the watchdog
+            # failed its cohort typed and serving continues.
+            return
         self.supervisor.trigger(f"device wedge ({label}, {age_ms:.0f}ms)")
 
     def _on_device_quarantine(self, device_id: int) -> None:
         """Health-registry callback: a confirmed-bad chip left the
-        active set — respawn onto the survivors (idempotent while a
-        wedge-triggered teardown is already in flight)."""
+        active set. With placement, fail over ONLY the chip's group;
+        classic path: respawn the whole batcher onto the survivors
+        (idempotent while a wedge-triggered teardown is in flight)."""
+        if self.placement is not None:
+            self._group_failover(device_id,
+                                 f"device {device_id} quarantined")
+            return
         self.supervisor.trigger(f"device {device_id} quarantined")
 
     def _on_device_reintroduced(self, device_id: int) -> None:
         """Health-registry callback: a quarantined chip passed its
         consecutive-healthy-probe bar — schedule a drain-window
-        recovery back onto the fuller mesh."""
+        recovery back onto the fuller mesh (placement: remesh only
+        the chip's group and restore full placement)."""
+        if self.placement is not None:
+            self._schedule_group_restore(device_id)
+            return
         self.supervisor.schedule_full_remesh(
             f"device {device_id} reintroduced")
 
@@ -2111,6 +2293,13 @@ class TpuSearchService:
                     else "batcher_down",
                     "devices": sup.mesh_device_count,
                     "devices_total": total}
+        if self.placement is not None:
+            active = self.placement.devices_active()
+            p_total = self.placement.devices_total()
+            if active < p_total:
+                return {"reason": "partial_mesh",
+                        "devices": active,
+                        "devices_total": p_total}
         if sup.mesh_device_count < total:
             return {"reason": "partial_mesh",
                     "devices": sup.mesh_device_count,
@@ -2147,6 +2336,245 @@ class TpuSearchService:
                 if idx == index_name:
                     return {"index": idx, "field": field, **info}
         return None
+
+    def add_shed(self, keys: List[Tuple[str, str]],
+                 retry_after_s: Optional[float] = None) -> None:
+        """Add keys to the shed set without replacing it (placement
+        failover sheds ONLY packs whose every replica is lost)."""
+        retry = (self.shed_retry_after_s if retry_after_s is None
+                 else float(retry_after_s))
+        with self._shed_lock:
+            for k in keys:
+                self._shed[tuple(k)] = {"retry_after_s": retry,
+                                        "since": time.monotonic()}
+        if keys:
+            logger.error("no placement group can hold %d pack(s): %s "
+                         "shed (503 + Retry-After %.0fs)",
+                         len(keys), sorted(tuple(k) for k in keys), retry)
+
+    def remove_shed(self, key: Tuple[str, str]) -> None:
+        with self._shed_lock:
+            self._shed.pop(tuple(key), None)
+
+    # -- fault-domain placement (pack replicas across device groups) ---
+
+    def failover_info(self, index_name: str) -> Optional[Dict[str, Any]]:
+        """Failover metadata when ANY field of `index_name` is being
+        served by a surviving replica group (the coordinator's
+        `failed_over` degraded stamp), else None."""
+        with self._placement_lock:
+            for (idx, field), info in self._failed_over.items():
+                if idx == index_name:
+                    return {"index": idx, "field": field, **info}
+        return None
+
+    def _bytes_hint(self, key: Tuple[str, str]) -> int:
+        """Best-known HBM cost of `key` across every group cache (0
+        when never built — placement then admits and the build's own
+        breaker charge is the backstop)."""
+        return max((c.bytes_of(key) for c in self.group_caches.values()),
+                   default=0)
+
+    def _grouped_get(self, index_service,
+                     field: str) -> Tuple[Optional[ResidentPack],
+                                          Optional[int]]:
+        """Placement-routed pack lookup: resolve (or create) the key's
+        replica placement, route to the least-loaded healthy replica
+        group, and serve from THAT group's cache. Replicas on the
+        other placed groups build lazily (first access) and refresh
+        whenever the routed copy observed newer readers — so a
+        failover target is at most one refresh behind, and its own
+        `get` re-validates against the live readers anyway."""
+        pl = self.placement
+        key = (index_service.name, field)
+        with self._placement_lock:
+            gids = pl.groups_of(key)
+            if not gids:
+                gids = tuple(pl.place(key,
+                                      est_bytes=self._bytes_hint(key)))
+        if not gids:
+            return None, None
+        gid = pl.route(key)
+        if gid is None:
+            return None, None
+        resident = self.group_caches[gid].get(index_service, field)
+        if resident is None:
+            return None, gid
+        # replica maintenance: the OTHER placed groups build/refresh
+        # toward the routed copy's reader snapshot
+        for g in gids:
+            if g == gid or not pl.group(g).alive:
+                continue
+            cache = self.group_caches[g]
+            peek = cache.peek(key)
+            if peek is not None and peek.reader_key == resident.reader_key:
+                continue
+            try:
+                cache.get(index_service, field)
+            except Exception:  # noqa: BLE001 — a replica build failing
+                # (group breaker full, transient) must not fail the
+                # routed query; the key simply has one fewer warm copy
+                logger.warning("replica build for %s on group %d failed",
+                               key, g, exc_info=True)
+        return resident, gid
+
+    def _group_failover(self, device_id: int, reason: str) -> None:
+        """A chip in one placement group was quarantined: fail over
+        that group's packs to their surviving replica groups, remesh
+        ONLY the affected group over its survivors, re-place only what
+        has no live replica, and shed (typed 503) only packs whose
+        every replica is lost."""
+        pl = self.placement
+        with self._placement_lock:
+            gid = pl.on_device_lost(device_id)
+            if gid is None:
+                return
+            group = pl.group(gid)
+            cache = self.group_caches[gid]
+            exc = DeviceWedgedError(
+                f"placement group {gid} lost device {device_id} "
+                f"({reason})")
+            # queued queries on this group's replicas must not wait out
+            # a deadline against the dead chip — fail them typed; the
+            # NEXT request routes to a surviving replica group
+            for resident in cache.residents():
+                self.batcher.fail_pack_pending(resident, exc)
+            dropped = cache.invalidate_all()
+            if group.breaker is not None:
+                # per-group exact-zero drain audit (the chaos suite
+                # asserts every entry is exactly zero)
+                pl.record_drain(gid, int(group.breaker.used))
+            if group.alive:
+                # remesh ONLY the affected group: the other groups'
+                # meshes (and their jit caches) are untouched
+                cache.set_mesh(group.mesh)
+            heat = {key: cache.heat_of(key) for key in dropped}
+            failed_over: List[Tuple[Tuple[str, str], int]] = []
+            orphans: List[Tuple[str, str]] = []
+            for key in dropped:
+                pl.drop_replica(key, gid)
+                live = [g for g in pl.groups_of(key) if pl.group(g).alive]
+                built = [g for g in live
+                         if self.group_caches[g].peek(key) is not None]
+                if live:
+                    failed_over.append((key, (built or live)[0]))
+                else:
+                    orphans.append(key)
+            now = time.monotonic()
+            for key, to_gid in failed_over:
+                pl.c_failovers.inc()
+                self._failed_over[key] = {
+                    "reason": "failed_over", "from_group": gid,
+                    "to_group": to_gid, "device": int(device_id),
+                    "since": now}
+            # re-place ONLY what has no live replica, warmest-first
+            # under per-group headroom; what fits nowhere is shed
+            orphans.sort(key=lambda k: heat.get(k, 0.0), reverse=True)
+            shed: List[Tuple[str, str]] = []
+            for key in orphans:
+                placed = pl.place(key, est_bytes=self._bytes_hint(key),
+                                  want=1)
+                if placed:
+                    pl.c_replacements.inc()
+                    self._eager_rebuild(key, placed[-1])
+                else:
+                    pl.c_shed.inc()
+                    shed.append(key)
+        if shed:
+            self.add_shed(shed)
+        logger.error("placement failover for group %d (%s): %d pack(s) "
+                     "failed over, %d re-placed, %d shed",
+                     gid, reason, len(failed_over),
+                     len(orphans) - len(shed), len(shed))
+
+    def _eager_rebuild(self, key: Tuple[str, str], gid: int) -> None:
+        """Best-effort eager re-residency of `key` on group `gid`
+        through the index resolver; without a resolver (or on any
+        build failure) the placement entry stands and the next access
+        rebuilds lazily."""
+        resolver = self.index_resolver
+        if resolver is None:
+            return
+        index_name, field = key
+        try:
+            index_service = resolver(index_name)
+        except Exception:  # noqa: BLE001 — index may be gone
+            index_service = None
+        if index_service is None:
+            return
+        try:
+            self.group_caches[gid].get(index_service, field)
+        except Exception:  # noqa: BLE001 — lazy rebuild remains
+            logger.exception("re-attaining residency for %s/%s on "
+                             "group %d", index_name, field, gid)
+
+    def _schedule_group_restore(self, device_id: int) -> None:
+        """Reintroduction under placement: wait out a drain window
+        (bounded by `drain_window_s`) so the remesh interrupts as
+        little in-flight work as possible, then restore the chip's
+        group to full membership and the table to full placement."""
+        def run() -> None:
+            deadline = time.monotonic() + max(0.0, self.drain_window_s)
+            while time.monotonic() < deadline:
+                depths = self.batcher.queue_depths()
+                wd = self.watchdog
+                if (depths["pending"] == 0 and depths["inflight"] == 0
+                        and (wd is None or wd.inflight() == 0)):
+                    break
+                time.sleep(0.02)
+            try:
+                self._group_restore(device_id)
+            except Exception:  # noqa: BLE001 — restore must not die
+                logger.exception("placement group restore failed")
+        threading.Thread(target=run, daemon=True,
+                         name="placement-group-restore").start()
+
+    def _group_restore(self, device_id: int) -> None:
+        pl = self.placement
+        with self._placement_lock:
+            gid = pl.on_device_restored(device_id)
+            if gid is None:
+                return
+            group = pl.group(gid)
+            cache = self.group_caches[gid]
+            # packs resident on the group's PARTIAL mesh drop (their
+            # arrays were placed with the old sharding) and rebuild on
+            # the restored mesh — exact-zero drain per group, audited
+            exc = DeviceWedgedError(
+                f"placement group {gid} remeshing after device "
+                f"{device_id} readmission")
+            for resident in cache.residents():
+                self.batcher.fail_pack_pending(resident, exc)
+            cache.invalidate_all()
+            if group.breaker is not None:
+                pl.record_drain(gid, int(group.breaker.used))
+            cache.set_mesh(group.mesh)
+            # return to FULL placement: shed keys re-admit first
+            # (they've been answering 503s), then every short placement
+            # tops back up to R replicas
+            for key in self.shed_keys():
+                if pl.place(key, est_bytes=self._bytes_hint(key)):
+                    self.remove_shed(key)
+                    pl.c_replacements.inc()
+            for key in pl.keys():
+                if len(pl.groups_of(key)) < pl.replicas:
+                    pl.place(key, est_bytes=self._bytes_hint(key))
+            # failover stamps clear once a key's placement is whole
+            # again (bounded by how many healthy groups exist)
+            target = min(pl.replicas, len(pl.healthy_gids()))
+            for key in list(self._failed_over):
+                live = [g for g in pl.groups_of(key)
+                        if pl.group(g).alive]
+                if len(live) >= target:
+                    self._failed_over.pop(key, None)
+            # eager re-residency of everything placed on this group
+            for key in pl.keys():
+                if gid in pl.groups_of(key) and cache.peek(key) is None:
+                    self._eager_rebuild(key, gid)
+        logger.warning("placement group %d restored after device %d "
+                       "readmission (%d/%d devices active)", gid,
+                       device_id, pl.devices_active(),
+                       pl.devices_total())
 
     def kill(self, reason: str = "killed") -> None:
         """Simulate batcher-process death (BatcherKill disruption, ops
@@ -2245,7 +2673,16 @@ class TpuSearchService:
             # coordinator answers the typed 503 + Retry-After
             self.fallback += 1
             return None
-        resident = self.packs.get(index_service, flat.field)
+        route_gid: Optional[int] = None
+        if self.placement is not None:
+            resident, route_gid = self._grouped_get(index_service,
+                                                    flat.field)
+            if resident is None and route_gid is None:
+                # no healthy replica group right now — planner serves
+                self.fallback += 1
+                return None
+        else:
+            resident = self.packs.get(index_service, flat.field)
         t2 = time.perf_counter()
         self.stages.add("lower", t1 - t0)
         self.stages.add("pack_get", t2 - t1)
@@ -2287,6 +2724,12 @@ class TpuSearchService:
             # and read the decomposition marks back off the future (a
             # mocked future simply has no marks: split degrades to None)
             fut = self.batcher.submit(resident, flat, k)
+            if route_gid is not None:
+                # per-group load accounting: route() balances launches
+                # across a key's replica groups by in-flight count
+                self.placement.note_submit(route_gid)
+                fut.add_done_callback(
+                    lambda _f, g=route_gid: self.placement.note_done(g))
             pending = getattr(fut, "pending", None)
             # the batch wait is bounded: the service cap (default 30s —
             # the FIRST batch on a signature pays XLA compile; if it
@@ -2400,12 +2843,29 @@ class TpuSearchService:
                                       "done": 0, "seconds": 0.0}
         self._warming = True
         try:
-            resident = self.packs.get(index_service, field)
+            replicas: List[ResidentPack] = []
+            if self.placement is not None:
+                # warm the copies serving will actually use: the routed
+                # replica plus every other placed replica (a failover
+                # target that is resident-but-cold would compile on its
+                # first post-failover hit — exactly the stall the warmer
+                # exists to prevent). The legacy full-mesh cache is NOT
+                # touched: nothing serves from it under placement.
+                resident, _gid = self._grouped_get(index_service, field)
+                if resident is not None:
+                    key = (index_service.name, field)
+                    for g in self.placement.groups_of(key):
+                        peek = self.group_caches[g].peek(key)
+                        if peek is not None and peek is not resident:
+                            replicas.append(peek)
+            else:
+                resident = self.packs.get(index_service, field)
             t_pack = time.perf_counter() - t0
             compiled: List[Dict[str, Any]] = []
             if resident is not None:
-                self._compile_signatures(resident, field, compiled,
-                                         workers)
+                for r in [resident] + replicas:
+                    self._compile_signatures(r, field, compiled,
+                                             workers)
             return {"pack_seconds": round(t_pack, 2),
                     "compiled": compiled,
                     "total_seconds": round(time.perf_counter() - t0, 2)}
@@ -2433,6 +2893,10 @@ class TpuSearchService:
                             workers: int) -> None:
         from concurrent.futures import ThreadPoolExecutor
 
+        # a placement-group replica compiles against its group's
+        # sub-mesh — warming it on the full mesh would populate a jit
+        # cache serving never reads
+        mesh = getattr(resident, "group_mesh", None) or self.packs.mesh
         terms = []
         for v in resident.pack.vocabs:
             if v:
@@ -2496,7 +2960,7 @@ class TpuSearchService:
                              lambda b_bucket=b_bucket, k=k, slots=slots,
                              cap=cap, variant=variant: _execute_pruned(
                                  resident, [flat] * b_bucket, k,
-                                 self.packs.mesh,
+                                 mesh,
                                  prefix_cap=cap or PREFIX_CAP2,
                                  full_slots=slots, variant=variant)))
         # exact kernel (msm/AND tier 1, OR tier 3) at its common
@@ -2511,9 +2975,9 @@ class TpuSearchService:
                              lambda b_bucket=b_bucket, k=k,
                              variant=variant: _execute_exact(
                                  resident, [flat_and] * b_bucket, k,
-                                 self.packs.mesh, variant=variant)))
+                                 mesh, variant=variant)))
         with self._prewarm_lock:
-            self._prewarm_progress["total"] = len(jobs)
+            self._prewarm_progress["total"] += len(jobs)
         # prewarm is BEST-EFFORT per signature: one kernel that the
         # backend cannot compile at this pack's shapes (observed: the
         # compile helper dying on the exact kernel at MS-MARCO scale)
@@ -2596,6 +3060,16 @@ class TpuSearchService:
         }
         if self.health is not None:
             out["health"] = self.health.stats()
+        if self.placement is not None:
+            placement = self.placement.stats()
+            with self._placement_lock:
+                placement["failed_over"] = {
+                    f"{i}/{f}": dict(info)
+                    for (i, f), info in self._failed_over.items()}
+            placement["group_packs"] = {
+                str(gid): cache.resident_keys()
+                for gid, cache in sorted(self.group_caches.items())}
+            out["placement"] = placement
         return out
 
     def close(self) -> None:

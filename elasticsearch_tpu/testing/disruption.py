@@ -880,3 +880,75 @@ def tenant_flood(node=None, **kwargs) -> Iterator[TenantFlood]:
         yield scheme
     finally:
         scheme.heal()
+
+
+class DiskFull(Scheme):
+    """Disk-fault injection for the write path: every translog append /
+    batch append / sync raises OSError(ENOSPC) via the
+    `index/translog.WRITE_FAULT_HOOKS` seam until healed. The translog
+    converts it to the typed 503 `TranslogDurabilityException` — the
+    write is NEVER acked, which is exactly what the test asserts (a
+    full disk must refuse, not lie). `path_prefix` scopes the fault to
+    translogs under one directory (one index / one shard); default
+    faults every translog in-process. Not a network fault — composes
+    with the transport schemes."""
+
+    def __init__(self, path_prefix: Optional[str] = None, *,
+                 errno_code: Optional[int] = None):
+        import errno
+        self.path_prefix = path_prefix
+        self.errno_code = errno.ENOSPC if errno_code is None else errno_code
+        self._hook: Optional[Callable[[str], None]] = None
+        self._started = False
+        self._lock = threading.Lock()
+        self.faults = 0  # writes refused so far
+        self._tally_lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self.healed:
+                return
+            self._started = True
+        import os as _os
+        from elasticsearch_tpu.index import translog as _translog
+        prefix = self.path_prefix
+        code = self.errno_code
+
+        def hook(path: str) -> None:
+            if prefix is not None and not path.startswith(prefix):
+                return
+            with self._tally_lock:
+                self.faults += 1
+            raise OSError(code, _os.strerror(code))
+
+        self._hook = hook
+        _translog.WRITE_FAULT_HOOKS.append(hook)
+
+    def intercept(self, src, dst, action):
+        return None  # a disk fault, not a network fault
+
+    def heal(self) -> None:
+        with self._lock:
+            if self.healed:
+                return
+            super().heal()
+        from elasticsearch_tpu.index import translog as _translog
+        if self._hook is not None:
+            try:
+                _translog.WRITE_FAULT_HOOKS.remove(self._hook)
+            except ValueError:
+                pass
+            self._hook = None
+
+
+@contextlib.contextmanager
+def disk_full(path_prefix: Optional[str] = None, **kwargs
+              ) -> Iterator[DiskFull]:
+    """Context-managed DiskFull: translog writes fail with ENOSPC inside
+    the body and recover on exit (even when assertions fail)."""
+    scheme = DiskFull(path_prefix, **kwargs)
+    scheme.start()
+    try:
+        yield scheme
+    finally:
+        scheme.heal()

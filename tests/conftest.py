@@ -79,10 +79,12 @@ MULTIPROCESS_TEST_TIMEOUT_S = int(
 def _multiprocess_timeout(request):
     # supervision tests (watchdog/recovery/chaos) park threads in fault
     # hooks and spawn recovery threads — same wedge risk, same guard;
-    # device_loss tests additionally park probe/reprobe threads
+    # device_loss/placement tests additionally park probe/reprobe and
+    # group-restore threads
     if (request.node.get_closest_marker("multiprocess") is None
             and request.node.get_closest_marker("supervision") is None
-            and request.node.get_closest_marker("device_loss") is None):
+            and request.node.get_closest_marker("device_loss") is None
+            and request.node.get_closest_marker("placement") is None):
         yield
         return
     import signal
@@ -148,6 +150,7 @@ def _multiprocess_orphan_reaper(request):
     marked = any(item.get_closest_marker("multiprocess") is not None
                  or item.get_closest_marker("supervision") is not None
                  or item.get_closest_marker("device_loss") is not None
+                 or item.get_closest_marker("placement") is not None
                  for item in request.session.items
                  if item.nodeid.startswith(mod_id))
     if not marked:

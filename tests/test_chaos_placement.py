@@ -1,0 +1,419 @@
+"""Pack-replica placement chaos (ISSUE 16 acceptance): 8-device
+dryrun with `placement.groups=2, placement.replicas=2` — kill one chip
+under live mixed read/write traffic and the victim's shard groups must
+keep serving through the SURVIVING replica group: **zero pack sheds,
+zero lost acked writes, zero hung requests**, responses stamped
+`failed_over` (never `shed`), the per-group HBM breakers auditing to
+exactly zero across the event, and reintroduction returning the table
+to full R-way placement.
+
+Also the last-replica path (the ONLY time placement sheds): with
+single-device groups and R=1, killing the home group orphans the pack;
+when no surviving group has headroom it sheds with a typed 503, and
+the restored group re-admits it.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreaker
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.tpu_service import TpuSearchService
+from elasticsearch_tpu.testing.disruption import device_loss
+
+from test_tpu_serving import make_corpus, svc  # noqa: F401 (fixture)
+
+pytestmark = pytest.mark.placement
+
+
+def _wait(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _placement_service(breaker, idx, name, *, groups=2, replicas=2):
+    """Service with fault-domain placement and fast health cycling:
+    one wedge suffices to suspect, probes answer in ms, reintroduction
+    needs 2 consecutive healthy probes after a 0.3s hold-down, and the
+    group-restore drain window is short."""
+    tpu = TpuSearchService(
+        window_s=0.0, batch_timeout_s=120.0, breaker=breaker,
+        launch_deadline_ms=30_000.0,
+        device_health={"suspect_after": 1,
+                       "probe_deadline_ms": 1_500.0,
+                       "reprobe_interval_seconds": 0.15,
+                       "hold_down_seconds": 0.3,
+                       "reintroduce_after": 2,
+                       "drain_window_seconds": 0.3},
+        placement={"groups": groups, "replicas": replicas})
+    tpu.index_resolver = lambda n: idx if n == name else None
+    return tpu
+
+
+def _ids(res):
+    return list(res.resident.resolve_ids(res.rows, res.ords))
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _dead_chip(tpu, victim):
+    """Deterministically quarantine `victim` through the health
+    registry (probe forced to fail) — the same synchronous callback
+    chain a watchdog-attributed wedge takes, minus the deadline wait.
+    The probe hook stays installed for the body (the chip stays dead,
+    reprobes keep failing); on exit it heals and the reprobe loop
+    reintroduces it."""
+    from elasticsearch_tpu.parallel.health import PROBE_FAULT_HOOKS
+
+    hook = lambda i: True if int(i) == victim else None  # noqa: E731
+    PROBE_FAULT_HOOKS.append(hook)
+    try:
+        assert tpu.health.record_wedge([victim], label="test") == [victim]
+        yield
+    finally:
+        PROBE_FAULT_HOOKS.remove(hook)
+
+
+class TestPlacementServing:
+    def test_replicated_serving_and_parity(self, svc, seeded_np):  # noqa: F811
+        """R=2 placement serves through routing; BOTH replica groups
+        hold the pack after first traffic, and a query routed to either
+        group returns identical results."""
+        name = "placed1"
+        idx = make_corpus(svc, seeded_np, name=name, docs=60)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = _placement_service(breaker, idx, name)
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha beta")
+            res = tpu.try_search(idx, q, k=10)
+            assert res is not None and len(res) > 0
+            pl = tpu.placement
+            key = (name, "body")
+            assert set(pl.groups_of(key)) == {0, 1}
+            # replica maintenance built the sibling copy too
+            assert all(tpu.group_caches[g].peek(key) is not None
+                       for g in (0, 1))
+            # per-group HBM accounting: both groups charged, sum equals
+            # the parent's total
+            g_used = [pl.group(g).breaker.used for g in (0, 1)]
+            assert all(u > 0 for u in g_used)
+            assert sum(g_used) == breaker.used
+            # route to group 0, then load it so routing flips to group
+            # 1 — identical answers from either replica
+            assert pl.route(key) == 0
+            ids_g0 = _ids(res)
+            pl.note_submit(0)
+            assert pl.route(key) == 1
+            res1 = tpu.try_search(idx, q, k=10)
+            pl.note_done(0)
+            assert res1 is not None
+            assert _ids(res1) == ids_g0
+            assert np.allclose(res1.scores, res.scores)
+            # observability: stats carry the placement block
+            stats = tpu.device_stats()
+            assert stats["placement"]["replicas"] == 2
+            assert stats["placement"]["devices_active"] == 8
+        finally:
+            tpu.close()
+
+    def test_chip_loss_fails_over_without_shedding(self, svc,  # noqa: F811
+                                                   seeded_np):
+        """Quarantining a chip fails its group's packs over to the
+        surviving replica group: serving continues, `failed_over` is
+        stamped, nothing sheds, no full-batcher teardown happens, and
+        reintroduction restores full placement and clears the stamp."""
+        name = "placed2"
+        idx = make_corpus(svc, seeded_np, name=name, docs=60)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = _placement_service(breaker, idx, name)
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha beta")
+            res = tpu.try_search(idx, q, k=10)
+            assert res is not None
+            baseline_ids = _ids(res)
+            pl = tpu.placement
+            key = (name, "body")
+            recoveries_before = tpu.supervisor.c_recoveries.count
+
+            victim = 0  # a group-0 member: the routed home group
+            with _dead_chip(tpu, victim):
+                # the quarantine callback ran the group failover
+                # synchronously: group 0 lost the chip, its replica
+                # dropped, the stamp points at the survivor
+                assert pl.devices_active() == 7
+                assert pl.groups_of(key) == (1,)
+                info = tpu.failover_info(name)
+                assert info is not None
+                assert info["from_group"] == 0 and info["to_group"] == 1
+                assert tpu.shed_keys() == []
+                assert pl.c_failovers.count == 1
+                assert pl.c_shed.count == 0
+                # degraded but ANSWERING — through the surviving replica
+                res2 = tpu.try_search(idx, q, k=10)
+                assert res2 is not None
+                assert _ids(res2) == baseline_ids
+                assert tpu.degraded_info == {"reason": "partial_mesh",
+                                             "devices": 7,
+                                             "devices_total": 8}
+                # group failover is NOT a batcher teardown: no
+                # supervisor recovery ran
+                assert tpu.supervisor.c_recoveries.count == \
+                    recoveries_before
+                assert tpu.supervisor.state == "serving"
+                # per-group exact-zero drain audit for the failed group
+                assert (0, 0) in pl.drain_audit
+
+            # heal: reprobes pass → hold-down → reintroduction →
+            # drain-window group restore → full placement again
+            assert _wait(lambda: pl.devices_active() == 8, timeout=30.0)
+            assert _wait(lambda: len(pl.groups_of(key)) == 2,
+                         timeout=10.0)
+            assert _wait(lambda: tpu.failover_info(name) is None,
+                         timeout=10.0)
+            assert tpu.health.quarantined_ids() == []
+            assert all(b == 0 for _g, b in pl.drain_audit)
+            res3 = tpu.try_search(idx, q, k=10)
+            assert res3 is not None and _ids(res3) == baseline_ids
+        finally:
+            tpu.close()
+
+    def test_last_replica_loss_sheds_then_readmits(self, svc,  # noqa: F811
+                                                   seeded_np):
+        """R=1 over single-device groups: killing the home group
+        orphans the pack. With zero headroom everywhere else it SHEDS
+        (typed 503 via shed_info, the only time placement sheds), and
+        the restored group re-admits it."""
+        name = "placed3"
+        idx = make_corpus(svc, seeded_np, name=name, docs=60)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = _placement_service(breaker, idx, name, groups=8,
+                                 replicas=1)
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha beta")
+            assert tpu.try_search(idx, q, k=10) is not None
+            pl = tpu.placement
+            key = (name, "body")
+            (home,) = pl.groups_of(key)
+            # strangle every OTHER group so the orphan fits nowhere
+            limits = {}
+            for g in pl.groups():
+                if g.gid != home:
+                    limits[g.gid] = g.breaker.limit
+                    g.breaker.limit = 0
+            victim = pl.group(home).device_ids[0]
+            with _dead_chip(tpu, victim):
+                assert not pl.group(home).alive
+                assert pl.groups_of(key) == ()
+                assert (name, "body") in tpu.shed_keys()
+                assert tpu.shed_info(name) is not None
+                assert tpu.failover_info(name) is None
+                assert pl.c_shed.count == 1
+                # a shed pack declines the kernel path (coordinator
+                # answers the typed 503 + Retry-After)
+                assert tpu.try_search(idx, q, k=10) is None
+
+            # restore headroom + heal the chip: the group-restore path
+            # re-admits shed keys first
+            for gid, lim in limits.items():
+                pl.group(gid).breaker.limit = lim
+            assert _wait(lambda: pl.devices_active() == 8, timeout=30.0)
+            assert _wait(lambda: tpu.shed_keys() == [], timeout=10.0)
+            assert pl.groups_of(key) != ()
+            assert pl.c_replacements.count >= 1
+            assert _wait(lambda: tpu.try_search(idx, q, k=10) is not None,
+                         timeout=30.0)
+        finally:
+            tpu.close()
+
+    def test_full_teardown_recovers_all_groups(self, svc,  # noqa: F811
+                                               seeded_np):
+        """A batcher kill under placement takes the supervisor's FULL
+        teardown: every group cache drains (exact-zero audit per
+        group), the respawned batcher re-attains residency on every
+        placed replica, and serving resumes."""
+        name = "placed4"
+        idx = make_corpus(svc, seeded_np, name=name, docs=60)
+        breaker = CircuitBreaker("hbm", 1 << 30)
+        tpu = _placement_service(breaker, idx, name)
+        try:
+            q = dsl.MatchQuery(field="body", query="alpha beta")
+            res = tpu.try_search(idx, q, k=10)
+            assert res is not None
+            ids_before = _ids(res)
+            pl = tpu.placement
+            key = (name, "body")
+            audits_before = len(pl.drain_audit)
+
+            tpu.kill("placement full-teardown drill")
+            assert _wait(lambda: tpu.supervisor.state == "serving",
+                         timeout=60.0)
+            # both groups drained and audited to exactly zero
+            new_audits = pl.drain_audit[audits_before:]
+            assert {g for g, _b in new_audits} == {0, 1}
+            assert all(b == 0 for _g, b in new_audits)
+            # recovery re-attained BOTH replicas eagerly
+            assert all(tpu.group_caches[g].peek(key) is not None
+                       for g in (0, 1))
+            res2 = tpu.try_search(idx, q, k=10)
+            assert res2 is not None and _ids(res2) == ids_before
+        finally:
+            tpu.close()
+
+
+def _run_placement_chaos(svc, seeded_np, *, name, readers=2,  # noqa: F811
+                         p99_bound_s=30.0):
+    """The acceptance drill: 8 devices, groups=2, R=2 — kill one chip
+    under live mixed traffic; zero sheds, zero lost acked writes, zero
+    hung requests, failover-stamped serving throughout, exact-zero
+    per-group breaker audits, reintroduction → full placement."""
+    idx = make_corpus(svc, seeded_np, name=name, docs=60)
+    breaker = CircuitBreaker("hbm", 1 << 30)
+    tpu = _placement_service(breaker, idx, name)
+    try:
+        q = dsl.MatchQuery(field="body", query="alpha beta")
+        assert tpu.try_search(idx, q, k=10) is not None  # warm both groups
+        pl = tpu.placement
+        key = (name, "body")
+        assert set(pl.groups_of(key)) == {0, 1}
+        # post-warm: tightened wedge detection, ABOVE a healthy hot
+        # launch (~4s on a loaded CPU host) so only a parked dispatch
+        # trips it
+        tpu.watchdog.deadline_s = 10.0
+
+        stop = threading.Event()
+        acked = []
+        latencies = []
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                doc_id = f"w{i}"
+                try:
+                    shard = idx.shard(idx.shard_for_id(doc_id))
+                    shard.apply_index_on_primary(
+                        doc_id, {"body": "alpha omega", "tag": "t0"})
+                    acked.append(doc_id)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(("write", e))
+                i += 1
+                time.sleep(0.01)
+
+        def reader():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    # None is fine (declined → planner would serve); an
+                    # exception or a hang is not
+                    tpu.try_search(idx, q, k=10)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(("read", e))
+                latencies.append(time.monotonic() - t0)
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=writer, name="chaos-writer")]
+        threads += [threading.Thread(target=reader,
+                                     name=f"chaos-reader-{i}")
+                    for i in range(readers)]
+        for t in threads:
+            t.start()
+
+        try:
+            with device_loss(service=tpu) as loss:
+                victim = int(loss.device_id)
+                vic_gid = pl.group_of_device(victim)
+                sur_gid = 1 - vic_gid
+                # live traffic wedges on the dead chip → watchdog
+                # attributes → probe confirms → quarantine → the
+                # GROUP fails over (no full-batcher teardown)
+                assert _wait(
+                    lambda: victim in tpu.health.quarantined_ids()
+                    and pl.devices_active() == 7, timeout=60.0), \
+                    "chip loss never failed its group over"
+                assert pl.groups_of(key) == (sur_gid,)
+                info = tpu.failover_info(name)
+                assert info is not None
+                assert info["from_group"] == vic_gid
+                assert info["to_group"] == sur_gid
+                # ZERO sheds while a replica lives
+                assert tpu.shed_keys() == []
+                assert pl.c_shed.count == 0
+                assert pl.c_failovers.count >= 1
+                # SUSTAINED serving through the surviving replica group
+                # while the chip is still dead
+                assert _wait(
+                    lambda: tpu.try_search(idx, q, k=10) is not None,
+                    timeout=60.0), "survivor group never served"
+                assert tpu.degraded_info == {"reason": "partial_mesh",
+                                             "devices": 7,
+                                             "devices_total": 8}
+                # the batcher stayed UP: failover is group-scoped
+                assert tpu.supervisor.state == "serving"
+
+            # heal: reprobes pass → hold-down → reintroduction →
+            # drain-window group restore → full R-way placement
+            assert _wait(lambda: pl.devices_active() == 8,
+                         timeout=60.0), "chip never reintroduced"
+            assert _wait(lambda: len(pl.groups_of(key)) == 2,
+                         timeout=30.0), "placement never topped up to R"
+            assert _wait(lambda: tpu.failover_info(name) is None,
+                         timeout=10.0)
+            assert tpu.health.quarantined_ids() == []
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=15.0)
+
+        # quiesce: widen the deadline so post-heal replays can't re-trip
+        tpu.watchdog.deadline_s = 30.0
+
+        # ZERO hung requests, zero traffic errors
+        hung = [t.name for t in threads if t.is_alive()]
+        assert not hung, f"hung traffic threads: {hung}"
+        assert not errors, f"traffic errors under chaos: {errors[:3]}"
+
+        # ZERO lost acked writes
+        assert acked, "writer made no progress under chaos"
+        lost = [d for d in acked
+                if idx.shard(idx.shard_for_id(d)).get(d) is None]
+        assert not lost, f"lost {len(lost)} acked writes: {lost[:5]}"
+
+        # per-group exact-zero breaker audits across the event (the
+        # failover drain and the restore drain both recorded)
+        assert len(pl.drain_audit) >= 2
+        assert all(b == 0 for _g, b in pl.drain_audit), \
+            f"group breaker not exactly zero: {pl.drain_audit}"
+
+        # bounded p99: wedged queries fail typed at the watchdog
+        # deadline, declined queries answer instantly
+        assert latencies
+        p99 = float(np.percentile(np.asarray(latencies), 99))
+        assert p99 < p99_bound_s, f"p99 {p99:.2f}s breached the bound"
+
+        # fully recovered: full placement, kernel serving, replicas on
+        # both groups again
+        idx.refresh()
+        assert _wait(lambda: tpu.try_search(idx, q, k=10) is not None,
+                     timeout=60.0)
+        assert pl.c_shed.count == 0, "the drill must be zero-shed"
+        assert breaker.used > 0
+        return {"reads": len(latencies), "writes": len(acked),
+                "p99": p99}
+    finally:
+        tpu.close()
+
+
+def test_placement_chaos_tier1(svc, seeded_np):  # noqa: F811
+    """Deterministic single-kill drill (tier-1): chip loss under live
+    mixed traffic → failover, zero sheds, full recovery."""
+    out = _run_placement_chaos(svc, seeded_np, name="plchaos1")
+    assert out["reads"] > 5 and out["writes"] > 5

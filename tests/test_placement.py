@@ -1,0 +1,378 @@
+"""Device placement layer (ISSUE 16): fault-domain groups, R-way
+anti-affine pack replicas, headroom-aware placement, least-loaded
+routing, and the per-group HBM accounting view.
+
+Also the mesh-construction coverage the placement layer makes load-
+bearing: `factorize_2d`/`make_mesh` over GROUP-SIZED device subsets
+(1, 2, 3, 5 devices) — odd small meshes are now the common case, not
+the N-1 corner.
+"""
+
+import jax
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreaker
+from elasticsearch_tpu.common.errors import CircuitBreakingException
+from elasticsearch_tpu.parallel.mesh import (DATA_AXIS, SHARD_AXIS,
+                                             factorize_2d, make_mesh)
+from elasticsearch_tpu.parallel.placement import (GroupBreaker,
+                                                  PlacementService)
+
+pytestmark = pytest.mark.placement
+
+
+def _devices():
+    return list(jax.devices())
+
+
+# -- partition topology ------------------------------------------------
+
+
+class TestPartition:
+    def test_even_partition(self):
+        pl = PlacementService(_devices(), groups=2, replicas=2)
+        assert pl.num_groups == 2
+        sizes = [len(g.device_ids) for g in pl.groups()]
+        assert sizes == [4, 4]
+        # contiguous, disjoint, covering
+        all_ids = [i for g in pl.groups() for i in g.device_ids]
+        assert all_ids == sorted(set(all_ids))
+        assert len(all_ids) == 8
+
+    def test_uneven_partition_spreads_remainder(self):
+        pl = PlacementService(_devices(), groups=3, replicas=1)
+        sizes = [len(g.device_ids) for g in pl.groups()]
+        assert sizes == [3, 3, 2]
+        assert pl.devices_total() == 8
+
+    def test_single_device_groups(self):
+        pl = PlacementService(_devices(), groups=8, replicas=2)
+        assert all(len(g.device_ids) == 1 for g in pl.groups())
+
+    def test_bad_group_count_rejected(self):
+        with pytest.raises(ValueError):
+            PlacementService(_devices(), groups=0, replicas=1)
+        with pytest.raises(ValueError):
+            PlacementService(_devices(), groups=9, replicas=1)
+
+    def test_replicas_clamped_to_groups(self):
+        pl = PlacementService(_devices(), groups=2, replicas=5)
+        assert pl.replicas == 2
+
+    def test_each_group_has_its_own_mesh(self):
+        pl = PlacementService(_devices(), groups=2, replicas=2)
+        meshes = [g.mesh for g in pl.groups()]
+        assert meshes[0] is not meshes[1]
+        for g, mesh in zip(pl.groups(), meshes):
+            ids = sorted(int(d.id) for d in mesh.devices.flat)
+            assert tuple(ids) == g.device_ids
+
+    def test_group_of_device(self):
+        pl = PlacementService(_devices(), groups=2, replicas=2)
+        assert pl.group_of_device(0) == 0
+        assert pl.group_of_device(7) == 1
+        assert pl.group_of_device(99) is None
+
+
+# -- placement + routing -----------------------------------------------
+
+
+class TestPlaceAndRoute:
+    def test_place_picks_distinct_groups(self):
+        pl = PlacementService(_devices(), groups=4, replicas=2)
+        gids = pl.place(("idx", "body"))
+        assert len(gids) == 2
+        assert len(set(gids)) == 2
+        assert tuple(gids) == pl.groups_of(("idx", "body"))
+
+    def test_place_is_anti_affine_structurally(self):
+        # one replica per group: placing R=4 on 4 groups uses them all
+        pl = PlacementService(_devices(), groups=4, replicas=4)
+        gids = pl.place(("idx", "body"))
+        assert sorted(gids) == [0, 1, 2, 3]
+
+    def test_place_keeps_existing_replicas(self):
+        pl = PlacementService(_devices(), groups=4, replicas=2)
+        pl.set_groups(("idx", "body"), [3])
+        gids = pl.place(("idx", "body"))
+        assert gids[0] == 3 and len(gids) == 2 and gids[1] != 3
+
+    def test_place_respects_headroom(self):
+        breaker = CircuitBreaker("hbm", 800)
+        pl = PlacementService(_devices(), groups=2, replicas=2,
+                              breaker=breaker)
+        # each group gets half the budget (400); a 300-byte pack fits
+        # one copy per group, a 500-byte pack fits nowhere
+        assert len(pl.place(("a", "f"), est_bytes=300)) == 2
+        assert pl.place(("b", "f"), est_bytes=500) == []
+
+    def test_place_prefers_headroom_then_load(self):
+        breaker = CircuitBreaker("hbm", 1000)
+        pl = PlacementService(_devices(), groups=2, replicas=1,
+                              breaker=breaker)
+        # charge group 0 so group 1 has more headroom
+        pl.group(0).breaker.add_estimate_bytes_and_maybe_break(
+            200, label="warm")
+        assert pl.place(("a", "f"), est_bytes=10) == [1]
+
+    def test_route_least_loaded(self):
+        pl = PlacementService(_devices(), groups=2, replicas=2)
+        key = ("idx", "body")
+        pl.place(key)
+        assert pl.route(key) == 0  # tie → lowest gid
+        pl.note_submit(0)
+        assert pl.route(key) == 1
+        pl.note_done(0)
+        assert pl.route(key) == 0
+
+    def test_route_skips_dead_groups(self):
+        pl = PlacementService(_devices(), groups=8, replicas=2)
+        key = ("idx", "body")
+        gids = pl.place(key)
+        dead = gids[0]
+        for did in pl.group(dead).device_ids:
+            pl.on_device_lost(did)
+        assert not pl.group(dead).alive
+        assert pl.route(key) == gids[1]
+
+    def test_route_none_when_every_replica_dead(self):
+        pl = PlacementService(_devices(), groups=8, replicas=1)
+        key = ("idx", "body")
+        (gid,) = pl.place(key)
+        pl.on_device_lost(pl.group(gid).device_ids[0])
+        assert pl.route(key) is None
+
+    def test_drop_and_add_replica(self):
+        pl = PlacementService(_devices(), groups=4, replicas=2)
+        key = ("idx", "body")
+        g0, g1 = pl.place(key)
+        pl.drop_replica(key, g0)
+        assert pl.groups_of(key) == (g1,)
+        pl.add_replica(key, g0)
+        assert set(pl.groups_of(key)) == {g0, g1}
+        pl.drop_replica(key, g0)
+        pl.drop_replica(key, g1)
+        assert pl.groups_of(key) == ()
+
+
+# -- device lifecycle --------------------------------------------------
+
+
+class TestDeviceLifecycle:
+    def test_lost_device_shrinks_only_its_group(self):
+        pl = PlacementService(_devices(), groups=2, replicas=2)
+        other_mesh = pl.group(1).mesh
+        gid = pl.on_device_lost(0)
+        assert gid == 0
+        assert len(pl.group(0).active_ids) == 3
+        assert pl.group(0).degraded and pl.group(0).alive
+        # the untouched group keeps its exact mesh object (jit caches)
+        assert pl.group(1).mesh is other_mesh
+        assert pl.devices_active() == 7
+
+    def test_group_death_and_restore(self):
+        pl = PlacementService(_devices(), groups=8, replicas=1)
+        assert pl.on_device_lost(3) == 3
+        assert not pl.group(3).alive
+        assert pl.group(3).mesh is None
+        assert pl.healthy_gids() == [0, 1, 2, 4, 5, 6, 7]
+        assert pl.on_device_restored(3) == 3
+        assert pl.group(3).alive and pl.group(3).mesh is not None
+        assert pl.devices_active() == 8
+
+    def test_idempotent_lifecycle_events(self):
+        pl = PlacementService(_devices(), groups=2, replicas=2)
+        assert pl.on_device_lost(0) == 0
+        assert pl.on_device_lost(0) is None       # already out
+        assert pl.on_device_lost(99) is None      # unknown
+        assert pl.on_device_restored(0) == 0
+        assert pl.on_device_restored(0) is None   # already in
+
+    def test_stats_shape(self):
+        pl = PlacementService(_devices(), groups=2, replicas=2,
+                              breaker=CircuitBreaker("hbm", 1 << 20))
+        pl.place(("idx", "body"))
+        pl.on_device_lost(7)
+        s = pl.stats()
+        assert s["replicas"] == 2
+        assert s["devices_active"] == 7
+        assert s["devices_total"] == 8
+        assert s["placements"]["idx/body"] == [0, 1]
+        assert s["groups"]["1"]["degraded"] is True
+        assert s["groups"]["0"]["hbm"]["estimated_size_in_bytes"] == 0
+
+
+# -- per-group HBM accounting ------------------------------------------
+
+
+class TestGroupBreaker:
+    def test_enforces_group_limit(self):
+        gb = GroupBreaker("g0", None, 100)
+        gb.add_estimate_bytes_and_maybe_break(60, label="a")
+        with pytest.raises(CircuitBreakingException):
+            gb.add_estimate_bytes_and_maybe_break(50, label="b")
+        assert gb.used == 60 and gb.trip_count == 1
+
+    def test_charges_pass_through_to_parent(self):
+        parent = CircuitBreaker("hbm", 1000)
+        gb = GroupBreaker("g0", parent, 500)
+        gb.add_estimate_bytes_and_maybe_break(200, label="a")
+        assert parent.used == 200 and gb.used == 200
+        gb.release(200)
+        assert parent.used == 0 and gb.used == 0
+
+    def test_parent_trip_rolls_back_group_charge(self):
+        parent = CircuitBreaker("hbm", 100)
+        gb = GroupBreaker("g0", parent, 500)
+        with pytest.raises(CircuitBreakingException):
+            gb.add_estimate_bytes_and_maybe_break(200, label="a")
+        assert gb.used == 0
+
+    def test_headroom(self):
+        gb = GroupBreaker("g0", None, 100)
+        assert gb.headroom() == 100
+        gb.add_estimate_bytes_and_maybe_break(30, label="a")
+        assert gb.headroom() == 70
+        assert GroupBreaker("g1", None, None).headroom() is None
+
+
+# -- group-sized meshes (satellite: odd small subsets are now common) --
+
+
+class TestGroupSizedMeshes:
+    @pytest.mark.parametrize("n,expect", [
+        (1, (1, 1)), (2, (1, 2)), (3, (1, 3)), (4, (2, 2)),
+        (5, (1, 5)), (6, (2, 3)), (7, (1, 7)), (8, (2, 4)),
+    ])
+    def test_factorize_2d(self, n, expect):
+        data, shards = factorize_2d(n)
+        assert (data, shards) == expect
+        assert data * shards == n
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 5])
+    def test_make_mesh_over_subset(self, n):
+        devs = _devices()[:n]
+        mesh = make_mesh(devices=devs)
+        assert mesh.axis_names == (DATA_AXIS, SHARD_AXIS)
+        assert mesh.devices.size == n
+        assert sorted(int(d.id) for d in mesh.devices.flat) == \
+            sorted(int(d.id) for d in devs)
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 5])
+    def test_make_mesh_subset_from_the_tail(self, n):
+        # fault domains are contiguous SLICES, not prefixes — a group
+        # over devices [8-n, 8) must mesh exactly like a prefix does
+        devs = _devices()[-n:]
+        mesh = make_mesh(devices=devs)
+        assert mesh.devices.size == n
+        assert mesh.shape[DATA_AXIS] * mesh.shape[SHARD_AXIS] == n
+
+    @pytest.mark.parametrize("n", [2, 3, 5])
+    def test_subset_mesh_runs_a_collective(self, n):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = make_mesh(devices=_devices()[:n])
+        x = jax.device_put(
+            jnp.arange(mesh.shape[SHARD_AXIS], dtype=jnp.float32),
+            NamedSharding(mesh, PartitionSpec(SHARD_AXIS)))
+        assert float(jnp.sum(x)) == sum(range(mesh.shape[SHARD_AXIS]))
+
+    def test_bad_shape_rejected(self):
+        with pytest.raises(ValueError):
+            make_mesh(devices=_devices()[:3], shape=(2, 2))
+
+
+# -- prewarm under placement -------------------------------------------
+
+
+class TestPrewarmUnderPlacement:
+    """The warmer must warm what serving actually uses: under placement
+    the routed replica AND every other placed replica, each compiled
+    against its own group sub-mesh — never the legacy full-mesh cache
+    (nothing serves from it when placement is on)."""
+
+    def _corpus(self, tmp_path):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.indices.service import IndicesService
+        svc = IndicesService(str(tmp_path))
+        idx = svc.create_index(
+            "lib", Settings.of({"index": {"number_of_shards": 1}}),
+            {"properties": {"body": {"type": "text"}}})
+        shard = idx.shard(0)
+        for i in range(8):
+            shard.apply_index_on_primary(
+                f"d{i}", {"body": f"alpha beta gamma doc{i}"})
+        idx.refresh()
+        return svc, idx
+
+    def test_prewarm_warms_every_replica_on_its_group_mesh(
+            self, tmp_path, monkeypatch):
+        from elasticsearch_tpu.search import tpu_service as svc_mod
+        from elasticsearch_tpu.search.tpu_service import TpuSearchService
+
+        seen_meshes = []
+
+        def fake_pruned(resident, flats, k, mesh, **kw):
+            seen_meshes.append(mesh)
+            return [], []
+
+        def fake_exact(resident, flats, k, mesh, **kw):
+            seen_meshes.append(mesh)
+            return []
+
+        monkeypatch.setattr(svc_mod, "_execute_pruned", fake_pruned)
+        monkeypatch.setattr(svc_mod, "_execute_exact", fake_exact)
+        svc, idx = self._corpus(tmp_path)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0,
+                               placement={"groups": 2, "replicas": 2})
+        try:
+            warm = tpu.prewarm(idx, "body", concurrency=2)
+            assert warm["compiled"], "signature table must not be empty"
+            assert not any(e.get("error") for e in warm["compiled"])
+            prog = tpu.stats()["prewarm"]
+            assert prog["state"] == "done"
+            # the total accumulates across BOTH replica compiles
+            assert prog["done"] == prog["total"] == len(warm["compiled"])
+            key = ("lib", "body")
+            # both placed replicas are resident; the legacy whole-mesh
+            # cache stays empty
+            placed = tpu.placement.groups_of(key)
+            assert len(placed) == 2
+            for gid in placed:
+                assert tpu.group_caches[gid].peek(key) is not None
+            assert tpu.packs.peek(key) is None
+            # every recorded compile ran against a GROUP sub-mesh, and
+            # both groups' meshes were warmed
+            group_meshes = {id(tpu.placement.group(g).mesh)
+                            for g in placed}
+            assert {id(m) for m in seen_meshes} == group_meshes
+            for m in seen_meshes:
+                assert len(list(m.devices.flat)) == 4
+        finally:
+            tpu.close()
+            svc.close()
+
+    def test_prewarm_without_placement_uses_full_mesh(
+            self, tmp_path, monkeypatch):
+        from elasticsearch_tpu.search import tpu_service as svc_mod
+        from elasticsearch_tpu.search.tpu_service import TpuSearchService
+
+        seen_meshes = []
+        monkeypatch.setattr(
+            svc_mod, "_execute_pruned",
+            lambda r, f, k, mesh, **kw: seen_meshes.append(mesh)
+            or ([], []))
+        monkeypatch.setattr(
+            svc_mod, "_execute_exact",
+            lambda r, f, k, mesh, **kw: seen_meshes.append(mesh) or [])
+        svc, idx = self._corpus(tmp_path)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
+        try:
+            warm = tpu.prewarm(idx, "body", concurrency=2)
+            assert warm["compiled"]
+            assert tpu.packs.peek(("lib", "body")) is not None
+            assert {id(m) for m in seen_meshes} == {id(tpu.packs.mesh)}
+        finally:
+            tpu.close()
+        svc.close()

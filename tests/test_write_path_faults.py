@@ -1,0 +1,328 @@
+"""Write-path fault robustness (the fault-domain PR's satellites):
+
+  * a corrupt store on shard open fails the copy TYPED and allocation
+    retries are BOUNDED (reference: MaxRetryAllocationDecider +
+    UnassignedInfo failed-allocation counts) — never a crash-looping
+    state applier;
+  * translog ENOSPC/EIO raises the typed 503
+    `TranslogDurabilityException` — a full disk refuses, it never acks;
+  * the uniform backoff contract: EVERY typed 429/503 rejection carries
+    an integral `Retry-After` header through the one shared funnel
+    (`rest/controller.rejection_headers`).
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import AllocationService
+from elasticsearch_tpu.cluster.state import (INITIALIZING, STARTED,
+                                             UNASSIGNED, ClusterState,
+                                             DiscoveryNode, IndexMeta,
+                                             ShardRouting)
+from elasticsearch_tpu.common.errors import (CircuitBreakingException,
+                                             ClusterBlockException,
+                                             EngineClosedException,
+                                             EsException,
+                                             EsRejectedExecutionException,
+                                             NoShardAvailableActionException,
+                                             PackShedException,
+                                             TenantThrottledException,
+                                             TranslogDurabilityException)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import EngineConfig, InternalEngine
+from elasticsearch_tpu.index.store import CorruptIndexException
+from elasticsearch_tpu.index.translog import Translog, TranslogOp
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.rest.controller import rejection_headers
+from elasticsearch_tpu.testing.disruption import DiskFull, disk_full
+
+MAPPING = {"properties": {"title": {"type": "text"}}}
+
+
+def make_engine(path, **kw):
+    ms = MapperService(Settings.EMPTY, MAPPING)
+    return InternalEngine(EngineConfig(path=str(path), mapper=ms, **kw))
+
+
+# ---------------------------------------------------------------------
+# corrupt store on open → typed failure, not an applier crash
+# ---------------------------------------------------------------------
+
+
+class TestCorruptStoreOnOpen:
+    def test_corrupted_segment_raises_typed_on_reopen(self, tmp_path):
+        e = make_engine(tmp_path / "e")
+        e.index("1", {"title": "persisted fox"})
+        e.index("2", {"title": "persisted dog"})
+        e.flush()
+        e.close()
+        seg_dir = tmp_path / "e" / "segments"
+        npz = next(p for p in os.listdir(seg_dir) if p.endswith(".npz"))
+        blob = bytearray((seg_dir / npz).read_bytes())
+        blob[len(blob) // 2] ^= 0xFF  # flip a byte mid-file
+        (seg_dir / npz).write_bytes(bytes(blob))
+
+        with pytest.raises(CorruptIndexException) as ei:
+            make_engine(tmp_path / "e")
+        # typed: an EsException the shard-failure path can report
+        assert isinstance(ei.value, EsException)
+        assert "checksum" in str(ei.value)
+
+    def test_open_primary_shard_fails_copy_typed(self):
+        """ClusterService._open_primary_shard converts a corrupt store
+        into a shard-failed report to the master (and drops the
+        partially-constructed copy) instead of letting the exception
+        kill the state applier."""
+        from elasticsearch_tpu.cluster.service import (ACTION_SHARD_FAILED,
+                                                       ClusterService)
+
+        class _Shard:
+            closed = False
+
+            def close(self):
+                self.closed = True
+
+        broken = _Shard()
+
+        class _Svc:
+            def __init__(self):
+                self.shards = {}
+
+            def create_shard(self, num, primary, allocation_id):
+                self.shards[num] = broken  # partially constructed
+                raise CorruptIndexException(
+                    "segment [s0] npz checksum mismatch")
+
+        sent = []
+        fake = SimpleNamespace(
+            local_node=SimpleNamespace(name="n1"),
+            _send_to_master=lambda action, payload: sent.append(
+                (action, payload)))
+        svc = _Svc()
+        copy = ShardRouting("lib", 0, "n1", True, INITIALIZING, "aid1")
+        out = ClusterService._open_primary_shard(fake, svc, "lib", 0, copy)
+        assert out is None
+        assert svc.shards == {} and broken.closed
+        assert sent == [(ACTION_SHARD_FAILED,
+                         {"index": "lib", "shard": 0,
+                          "allocation_id": "aid1"})]
+
+
+class TestBoundedAllocationRetries:
+    def _meta(self, **settings):
+        return IndexMeta(name="lib", uuid="u1", settings=settings,
+                         mapping=None, number_of_shards=1,
+                         number_of_replicas=0)
+
+    def _state(self, meta):
+        node = DiscoveryNode("n1", "n1", "127.0.0.1", 9300)
+        return ClusterState(cluster_uuid="c", term=1, version=1,
+                            master_node_id="n1", nodes={"n1": node},
+                            indices={"lib": meta}, routing={})
+
+    def test_streak_records_counts_and_resets(self):
+        alloc = AllocationService()
+        assert alloc.record_failed_allocation("lib", 0) == 1
+        assert alloc.record_failed_allocation("lib", 0) == 2
+        assert alloc.c_failed_allocations.count == 2
+        assert alloc.failed_allocations[("lib", 0)] == 2
+        alloc.reset_allocation_failures("lib", 0)
+        assert ("lib", 0) not in alloc.failed_allocations
+        # reset is what shard-started runs: the streak restarts from 1
+        assert alloc.record_failed_allocation("lib", 0) == 1
+
+    def test_max_retries_honors_index_setting(self):
+        alloc = AllocationService()
+        meta = self._meta(**{"index.allocation.max_retries": 2})
+        alloc.record_failed_allocation("lib", 0)
+        assert not alloc.allocation_exhausted("lib", 0, meta)
+        alloc.record_failed_allocation("lib", 0)
+        assert alloc.allocation_exhausted("lib", 0, meta)
+        # default cap is 5
+        assert not alloc.allocation_exhausted("lib", 0, self._meta())
+
+    def test_backoff_window_blocks_then_lapses(self):
+        alloc = AllocationService()
+        meta = self._meta()
+        alloc.record_failed_allocation("lib", 0)
+        # inside the exponential-backoff window: no re-placement
+        assert alloc._allocation_throttled("lib", 0, meta)
+        # window lapsed (simulated): placement resumes
+        alloc._retry_at[("lib", 0)] = 0.0
+        assert not alloc._allocation_throttled("lib", 0, meta)
+
+    def test_reroute_skips_exhausted_shard_until_reset(self):
+        alloc = AllocationService()
+        meta = self._meta(**{"index.allocation.max_retries": 2})
+        state = self._state(meta)
+
+        # healthy: reroute places the unassigned primary
+        placed = alloc.reroute(state)
+        copy = placed.routing["lib"][0][0]
+        assert copy.node_id == "n1" and copy.state == INITIALIZING
+
+        # exhausted streak: the copy STAYS unassigned (red, visible)
+        alloc.record_failed_allocation("lib", 0)
+        alloc.record_failed_allocation("lib", 0)
+        stuck = alloc.reroute(state)
+        assert stuck.routing["lib"][0][0].node_id is None
+
+        # reset (shard-started / manual reroute) resumes placement
+        alloc.reset_allocation_failures("lib", 0)
+        healed = alloc.reroute(state)
+        assert healed.routing["lib"][0][0].node_id == "n1"
+
+
+# ---------------------------------------------------------------------
+# translog ENOSPC → typed 503, never acked
+# ---------------------------------------------------------------------
+
+
+class TestTranslogDiskFull:
+    def test_append_refuses_typed_and_recovers(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add(TranslogOp("index", 0, 1, doc_id="a", source={"x": 1}))
+        with disk_full() as fault:
+            with pytest.raises(TranslogDurabilityException) as ei:
+                tl.add(TranslogOp("index", 1, 1, doc_id="b",
+                                  source={"x": 2}))
+            assert ei.value.status == 503
+            assert ei.value.retry_after_s >= 1.0
+            assert fault.faults == 1
+        # disk recovered: the same op goes through
+        tl.add(TranslogOp("index", 1, 1, doc_id="b", source={"x": 2}))
+        tl.close()
+        # only durable (ackable) ops are on disk
+        tl2 = Translog(str(tmp_path / "tl"))
+        assert [op.doc_id for op in tl2.snapshot()] == ["a", "b"]
+        tl2.close()
+
+    def test_batch_and_sync_paths_refuse_typed(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"),
+                      durability=Translog.DURABILITY_ASYNC)
+        tl.add(TranslogOp("index", 0, 1, doc_id="a", source={}))
+        with disk_full():
+            with pytest.raises(TranslogDurabilityException):
+                tl.add_batch([TranslogOp("index", 1, 1, doc_id="b",
+                                         source={})])
+            with pytest.raises(TranslogDurabilityException):
+                tl.sync()
+        tl.sync()  # healed
+        tl.close()
+
+    def test_fault_scoped_by_path_prefix(self, tmp_path):
+        sick = Translog(str(tmp_path / "sick"))
+        well = Translog(str(tmp_path / "well"))
+        with disk_full(str(tmp_path / "sick")):
+            with pytest.raises(TranslogDurabilityException):
+                sick.add(TranslogOp("index", 0, 1, doc_id="a", source={}))
+            well.add(TranslogOp("index", 0, 1, doc_id="a", source={}))
+        sick.close()
+        well.close()
+
+    def test_engine_write_never_acks_on_full_disk(self, tmp_path):
+        """durability=request: the ack implies the op is fsync'd — on
+        ENOSPC the engine must raise (503) and a later retry of the
+        SAME op must succeed once the disk recovers."""
+        e = make_engine(tmp_path / "e")
+        e.index("1", {"title": "before the fault"})
+        with disk_full():
+            with pytest.raises(TranslogDurabilityException):
+                e.index("2", {"title": "refused write"})
+        r = e.index("2", {"title": "retried write"})
+        assert r.doc_id == "2"
+        e.close()
+        # everything acked — and only what was acked as "2" — replays
+        e2 = make_engine(tmp_path / "e")
+        assert e2.get("2")["_source"]["title"] == "retried write"
+        assert e2.get("1") is not None
+        e2.close()
+
+
+# ---------------------------------------------------------------------
+# the uniform Retry-After contract
+# ---------------------------------------------------------------------
+
+_REJECTIONS = [
+    TenantThrottledException("tenant t0 over its weighted share",
+                             tenant="t0", retry_after_s=2.0),
+    EsRejectedExecutionException("search queue full"),
+    CircuitBreakingException("parent breaker tripped", 100, 10),
+    PackShedException("pack shed for N-1 headroom", index="lib",
+                      retry_after_s=5.0),
+    TranslogDurabilityException("disk full"),
+    EngineClosedException("engine closed during recovery"),
+    NoShardAvailableActionException("no started copy of [lib][0]"),
+    ClusterBlockException("no master"),
+]
+
+
+class TestRetryAfterContract:
+    @pytest.mark.parametrize(
+        "exc", _REJECTIONS, ids=[type(e).__name__ for e in _REJECTIONS])
+    def test_every_typed_rejection_carries_integral_retry_after(self, exc):
+        assert exc.status in (429, 503)
+        headers = rejection_headers(exc, exc.status)
+        assert headers is not None
+        value = headers["Retry-After"]
+        assert value == str(int(value))  # integral per RFC 9110 §10.2.3
+        assert int(value) >= 1
+
+    def test_batcher_unavailable_wire_carries_integral_retry_after(self):
+        """The front's batcher-down answer is built as wire parts (it
+        never raises through dispatch) but must honor the same
+        contract."""
+        from elasticsearch_tpu.serving.front import _FrontState
+
+        wire = _FrontState._batcher_down_wire(
+            SimpleNamespace(degraded_info=None))
+        assert wire["status"] == 503
+        value = wire["headers"]["Retry-After"]
+        assert value == str(int(value)) and int(value) >= 1
+
+    def test_fractional_hint_rounds_to_integral(self):
+        exc = PackShedException("x", index="i", retry_after_s=2.4)
+        assert rejection_headers(exc, 503) == {"Retry-After": "2"}
+        exc = TenantThrottledException("x", tenant="t", retry_after_s=0.2)
+        assert rejection_headers(exc, 429) == {"Retry-After": "1"}
+
+    def test_garbage_hint_falls_back_to_one(self):
+        exc = EsRejectedExecutionException("q")
+        exc.retry_after_s = "soon"
+        assert rejection_headers(exc, 429) == {"Retry-After": "1"}
+
+    def test_success_and_client_errors_carry_no_header(self):
+        exc = EsRejectedExecutionException("q")
+        assert rejection_headers(exc, 200) is None
+        assert rejection_headers(exc, 400) is None
+
+    def test_disk_full_rejection_rides_rest_dispatch(self, tmp_path):
+        """End to end: a write during ENOSPC answers typed 503 with the
+        Retry-After header riding the payload's _headers channel, and
+        the SAME write succeeds after the disk recovers."""
+        from elasticsearch_tpu.node import Node
+
+        n = Node(str(tmp_path / "data"),
+                 settings=Settings.of({"search.tpu_serving.enabled":
+                                       "false"}))
+        try:
+            status, _ = n.handle("PUT", "/lib", {}, None, json.dumps(
+                {"settings": {"index": {"number_of_shards": 1}},
+                 "mappings": MAPPING}).encode())
+            assert status == 200
+            doc = json.dumps({"title": "durable fox"}).encode()
+            with disk_full():
+                status, body = n.handle("PUT", "/lib/_doc/1", {}, None,
+                                        doc)
+                assert status == 503
+                assert (body["error"]["type"]
+                        == "translog_durability_exception")
+                assert body["_headers"]["Retry-After"] == str(
+                    int(body["_headers"]["Retry-After"]))
+            status, body = n.handle("PUT", "/lib/_doc/1", {}, None, doc)
+            assert status in (200, 201), body
+        finally:
+            n.close()
