@@ -22,10 +22,11 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Env knobs: ES_TPU_BENCH_{DOCS,SHARDS,VOCAB,QUERIES,CLIENTS,K,SECONDS}.
 ES_TPU_BENCH_KERNEL_COMPARE=1 additionally reruns a short load phase once
 per device-kernel variant (packed single-key sort vs two-operand ref vs
-compressed u16 resident streams) and emits a "kernel_compare" block with
-per-variant device p50/p99, device_ms_per_query, the resident pack's
-hbm_bytes_per_doc/compression_ratio, and the compressed phase's
-host-mirrored block-max skip rate (PERF.md rounds 8 and 11).
+compressed u16 resident streams vs the fused Pallas kernel) and emits a
+"kernel_compare" block with per-variant device p50/p99,
+device_ms_per_query, the resident pack's hbm_bytes_per_doc /
+hbm_bytes_per_posting / compression_ratio, and the compressed phases'
+host-mirrored block-max skip rate (PERF.md rounds 8, 11 and 12).
 
 Timing note: through the axon tunnel block_until_ready can return before
 remote execution finishes, but every REST response here materializes hit
@@ -398,12 +399,16 @@ def main() -> None:
 
         original = tpu.kernel_packed_sort
         original_comp = tpu.kernel_compressed_pack
+        original_pallas = tpu.kernel_pallas
         compare_s = max(2, seconds // 2)
         out["kernel_compare"] = {}
-        for label, packed_on, comp_on in (("packed", True, False),
-                                          ("ref", False, False),
-                                          ("compressed", True, True)):
+        for label, packed_on, comp_on, pallas_on in (
+                ("packed", True, False, False),
+                ("ref", False, False, False),
+                ("compressed", True, True, False),
+                ("pallas", True, True, True)):
             tpu.set_kernel_packed_sort(packed_on)
+            tpu.set_kernel_pallas(pallas_on)
             if comp_on != tpu.kernel_compressed_pack:
                 # residency format is decided at BUILD time: flip the
                 # knob, then drop the pack so the phase's first search
@@ -418,9 +423,15 @@ def main() -> None:
             # compressed packs route every launch through the exact
             # path, whose rings tag the per-launch variant — both the
             # packable and the fallback-exact flavors belong to this
-            # phase's device time
-            suffixes = (("compressed", "compressed_exact") if comp_on
-                        else (label,))
+            # phase's device time (the pallas phase also counts its
+            # "compressed" launches: the typed fallback when Pallas is
+            # unavailable in this jaxlib)
+            if pallas_on:
+                suffixes = ("pallas", "compressed", "compressed_exact")
+            elif comp_on:
+                suffixes = ("compressed", "compressed_exact")
+            else:
+                suffixes = (label,)
             for base in ("batch_device_wait", "exact_device_wait",
                          "batch_dispatch", "exact_dispatch"):
                 for suffix in suffixes:
@@ -452,7 +463,9 @@ def main() -> None:
             if det:
                 phase["pack"] = {pk: det[pk] for pk in (
                     "compressed", "hbm_bytes", "raw_bytes",
-                    "compression_ratio", "hbm_bytes_per_doc") if pk in det}
+                    "compression_ratio", "hbm_bytes_per_doc",
+                    "doc_delta", "doc_base_bytes", "postings",
+                    "hbm_bytes_per_posting") if pk in det}
             if comp_on:
                 phase["block_skip_rate"] = compressed_skip_rate()
                 # the deep-pruning regime: top-10 raises the threshold
@@ -465,6 +478,7 @@ def main() -> None:
                 + (f", skip_rate {phase.get('block_skip_rate')}"
                    if comp_on else ""))
         tpu.set_kernel_packed_sort(original)
+        tpu.set_kernel_pallas(original_pallas)
         if tpu.kernel_compressed_pack != original_comp:
             tpu.set_kernel_compressed_pack(original_comp)
             tpu.packs.invalidate("bench")

@@ -119,11 +119,19 @@ class Node:
                     "search.tpu_serving.kernel.packed_sort", True),
                 # compressed resident packs (PERF.md round 11): 16-bit
                 # impact/doc/rank streams + residual tables + block-max
-                # metadata; ~2.7x fewer HBM bytes/doc at identical
-                # result bits. Off by default until a TPU round burns it
-                # in; incompressible packs fall back to raw residency
+                # metadata + delta doc stream; ~3x fewer HBM bytes/doc
+                # at identical result bits. Default ON since PR 15 (see
+                # README "kernel variants" for the real-chip soak
+                # status); incompressible packs fall back to raw
+                # residency
                 compressed_pack=self.settings.get_bool(
-                    "search.tpu_serving.kernel.compressed_pack", False),
+                    "search.tpu_serving.kernel.compressed_pack", True),
+                # fused Pallas merge kernel (PR 15): the whole compressed
+                # hot loop as one kernel — off by default until the
+                # Mosaic soak on real chips lands; bit-identical and
+                # typed-fallback-gated wherever it is enabled
+                pallas=self.settings.get_bool(
+                    "search.tpu_serving.kernel.pallas", False),
                 # supervision: dispatches overdue past this deadline are
                 # failed typed and trip batcher recovery (0 disables)
                 launch_deadline_ms=self.settings.get_float(
@@ -500,11 +508,18 @@ class Node:
                 for comp, key in (("resident", "hbm_bytes"),
                                   ("raw", "raw_bytes"),
                                   ("block_meta", "block_meta_bytes"),
-                                  ("residual", "residual_bytes")):
+                                  ("residual", "residual_bytes"),
+                                  ("doc_base", "doc_base_bytes")):
                     yield ("pack.hbm_bytes", {**lb, "component": comp},
                            det.get(key, 0), "gauge")
                 yield ("pack.compression_ratio", lb,
                        det.get("compression_ratio", 1.0), "gauge")
+                # the bytes-war scoreboard (PR 15 acceptance: compressed
+                # + delta packs sit at ≤ 6 B/posting)
+                yield ("pack.hbm_bytes_per_posting", lb,
+                       det.get("hbm_bytes_per_posting", 0.0), "gauge")
+                yield ("pack.doc_delta", lb,
+                       1 if det.get("doc_delta") else 0, "gauge")
             with svc._prewarm_lock:
                 warm = dict(svc._prewarm_progress)
             yield ("search.tpu.prewarm_total", nl, warm["total"], "gauge")
@@ -522,6 +537,8 @@ class Node:
                    1 if KERNEL_CONFIG["packed_sort"] else 0, "gauge")
             yield ("search.tpu.kernel_compressed_pack", nl,
                    1 if KERNEL_CONFIG["compressed_pack"] else 0, "gauge")
+            yield ("search.tpu.kernel_pallas", nl,
+                   1 if KERNEL_CONFIG["pallas"] else 0, "gauge")
             # per-(kernel, variant) launch counts:
             # es_tpu_kernel_variant_total{kernel=...,variant=...}
             for labels, counter in KERNEL_VARIANT_COUNTS.items():

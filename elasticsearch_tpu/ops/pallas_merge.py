@@ -1,0 +1,163 @@
+"""Fused Pallas spelling of the compressed merge kernel (PR 15).
+
+One pl.pallas_call carries the whole hot loop the XLA variants spread
+over separate ops: phase-A posting gather from the compressed u16/u8
+resident streams, the packed single-key merge sort, the block-max skip
+branch (the running top-k threshold lives INSIDE the kernel instead of
+a separate masking pass) and per-block top-k selection + exact rescore.
+The kernel grids over rows — each program instance owns one (query ×
+shard) row's slot table, while the flat posting streams stay resident
+in device memory and are sliced per slot inside the kernel, so the
+intermediate sorted-operand materialisation between gather and merge
+never round-trips through HBM.
+
+Dispatch is backend-aware: on TPU the kernel compiles through Mosaic;
+everywhere else it runs under interpret=True, which executes the exact
+same trace the XLA "compressed" variant lowers from — the parity sweep
+(tests/test_sparse_kernel.py) pins variant="pallas" bit-identical to
+variant="ref" on CPU by construction. Real-chip soak is still pending
+(README "kernel variants"): Mosaic support for lax.sort/top_k inside a
+kernel varies by jaxlib generation, so serving keeps the variant behind
+the `search.tpu_serving.kernel.pallas` knob with the same typed
+fallback gates (planner.choose_kernel_variant) as the other variants,
+and falls back to the plain core if Pallas itself is unavailable.
+
+Operands, outputs, gates and semantics match
+sparse.sorted_merge_topk(variant="compressed") exactly; see ops/sparse.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops import sparse
+
+try:  # pragma: no cover - exercised by presence, not by a branch test
+    from jax.experimental import pallas as pl
+    _PALLAS_IMPORT_ERROR = None
+except Exception as _e:  # pallas missing from this jaxlib build
+    pl = None
+    _PALLAS_IMPORT_ERROR = _e
+
+#: names and order of the optional operands the kernel may receive after
+#: the six required ones; absent operands are simply not passed
+_OPTIONAL_OPERANDS = ("flat_rank", "res_starts", "res_lens", "res_vals",
+                      "block_max", "blk_starts", "slot_terms",
+                      "doc_bases", "dbs_starts", "dlo_starts")
+
+
+def available() -> bool:
+    """May variant="pallas" run in this process? False routes the
+    planner (and direct callers) to the plain compressed core — the
+    same typed-fallback style as the d_pad/weight gates."""
+    return pl is not None
+
+
+def fused_merge_topk(
+    flat_docs: jax.Array,
+    flat_impact: jax.Array,
+    starts: jax.Array,
+    lengths: jax.Array,
+    weights: jax.Array,
+    min_count: jax.Array,
+    *,
+    max_len: int,
+    d_pad: int,
+    k: int,
+    t_window: int,
+    with_counts: bool,
+    with_totals: bool = False,
+    flat_rank: Optional[jax.Array] = None,
+    res_starts: Optional[jax.Array] = None,
+    res_lens: Optional[jax.Array] = None,
+    res_vals: Optional[jax.Array] = None,
+    block_max: Optional[jax.Array] = None,
+    blk_starts: Optional[jax.Array] = None,
+    slot_terms: Optional[jax.Array] = None,
+    doc_bases: Optional[jax.Array] = None,
+    dbs_starts: Optional[jax.Array] = None,
+    dlo_starts: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, ...]:
+    """sorted_merge_topk(variant="pallas"): the compressed pipeline as
+    one row-gridded Pallas kernel. Returns (scores, doc_ids[, totals])
+    bit-identical to variant="compressed" on the same operands."""
+    core_kw = dict(
+        max_len=max_len, d_pad=d_pad, k=k, t_window=t_window,
+        with_counts=with_counts, with_totals=with_totals,
+        variant="compressed")
+    optional = {
+        "flat_rank": flat_rank, "res_starts": res_starts,
+        "res_lens": res_lens, "res_vals": res_vals,
+        "block_max": block_max, "blk_starts": blk_starts,
+        "slot_terms": slot_terms, "doc_bases": doc_bases,
+        "dbs_starts": dbs_starts, "dlo_starts": dlo_starts}
+    if pl is None:
+        # typed fallback — never error: the plain core computes the
+        # same bits this kernel would
+        return sparse._merge_topk_core(
+            flat_docs, flat_impact, starts, lengths, weights, min_count,
+            **core_kw, **optional)
+
+    r, t_slots = starts.shape
+    kk = min(k, t_slots * max_len)
+
+    #: [R, T]-shaped operands are row-blocked (one program instance per
+    #: row); flat streams/tables are whole-array blocks every instance
+    #: reads through (resident, sliced per slot inside the kernel)
+    per_row = {"starts", "lengths", "weights", "res_starts", "res_lens",
+               "blk_starts", "slot_terms", "dbs_starts", "dlo_starts"}
+
+    names = ["flat_docs", "flat_impact", "starts", "lengths", "weights",
+             "min_count"]
+    operands = [flat_docs, flat_impact, starts, lengths, weights,
+                min_count]
+    for name in _OPTIONAL_OPERANDS:
+        if optional[name] is not None:
+            names.append(name)
+            operands.append(optional[name])
+
+    def spec_for(name, arr):
+        if name == "min_count":
+            return pl.BlockSpec((1,), lambda i: (i,))
+        if name in per_row:
+            return pl.BlockSpec((1, arr.shape[1]), lambda i: (i, 0))
+        shape = arr.shape
+        return pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+
+    in_specs = [spec_for(n, a) for n, a in zip(names, operands)]
+    out_shape = [jax.ShapeDtypeStruct((r, kk), jnp.float32),
+                 jax.ShapeDtypeStruct((r, kk), jnp.int32)]
+    out_specs = [pl.BlockSpec((1, kk), lambda i: (i, 0)),
+                 pl.BlockSpec((1, kk), lambda i: (i, 0))]
+    if with_totals:
+        out_shape.append(jax.ShapeDtypeStruct((r,), jnp.int32))
+        out_specs.append(pl.BlockSpec((1,), lambda i: (i,)))
+
+    def kernel(*refs):
+        in_refs = refs[:len(names)]
+        out_refs = refs[len(names):]
+        vals = dict(zip(names, (ref[...] for ref in in_refs)))
+        extras = {name: vals.get(name) for name in _OPTIONAL_OPERANDS}
+        out = sparse._merge_topk_core(
+            vals["flat_docs"], vals["flat_impact"], vals["starts"],
+            vals["lengths"], vals["weights"], vals["min_count"],
+            **core_kw, **extras)
+        for ref, val in zip(out_refs, out):
+            ref[...] = val
+
+    # real kernel on TPU, interpret elsewhere: the interpreter executes
+    # the same jax trace the XLA variant compiles, so CPU parity is
+    # bitwise by construction rather than by tolerance
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(r,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+    return tuple(out)

@@ -62,8 +62,31 @@ BM25S eager elimination) let the "compressed" kernel carry a running
 top-k threshold: a 128-lane group whose maximum possible weighted
 contribution (its block-max upper bound plus every other slot's window
 upper bound) cannot reach the k-th best lower bound already achieved is
-masked out before the sort. Skipping is gated to runs that don't return
-totals (a skipped doc is still a match) and rows with min_count ≤ 1.
+masked out before the sort. Skipping applies to rows with min_count ≤ 1;
+totals-returning launches (a skipped doc is still a match) get their
+exact TotalHits from a dedicated PRE-skip count sort — one u32 key of
+(doc id << 1 | positive-code bit) — so track_total_hits queries ride
+the skip path too instead of forcing full evaluation.
+
+Delta doc stream (PR 15, the last of the bytes war): when every aligned
+128-lane block of a pack's doc stream spans ≤ 255 doc ids
+(delta_doc_reason), the resident u16 doc stream is replaced by a u8
+DELTA stream plus one u16 per-block BASE (the block's minimum doc id),
+decoded in-kernel: lane doc = base[(dlo + lane) // 128] + delta. That
+takes the doc stream from 2 B to ~1.02 B per posting — resident packs
+drop under 6 B/posting. The exact-rescore binary search decodes the
+same way through per-slot (dbs, dlo) block cursors, so results remain
+bit-identical; shards whose streams overflow the u8 span keep the plain
+u16 doc format (typed per-pack gate, like compress_reason).
+
+Pallas fused variant (variant="pallas"): the whole hot loop — phase-A
+posting gather from the compressed streams, packed single-key merge,
+block-max skip branch and per-block top-k — as ONE Pallas kernel
+(ops/pallas_merge.py), gridded per row, carrying the running top-k
+threshold inside the kernel instead of a separate masking pass. On
+non-TPU backends it runs under interpret=True and is bit-identical to
+variant="compressed" by construction; unsupported shapes fall back
+typed through planner.choose_kernel_variant like every other gate.
 """
 
 from __future__ import annotations
@@ -89,11 +112,14 @@ PACKED_DOC_LIMIT = 1 << 16
 PACKED_WEIGHT_MIN = 1e-12
 PACKED_WEIGHT_MAX = 1e30
 
-KERNEL_VARIANTS = ("ref", "packed", "compressed", "compressed_exact")
+KERNEL_VARIANTS = ("ref", "packed", "compressed", "compressed_exact",
+                   "pallas")
 
 #: variants that read the compressed resident streams (16-bit doc ids +
-#: 16-bit impact codes + residual tables) instead of the raw pair
-COMPRESSED_VARIANTS = ("compressed", "compressed_exact")
+#: 16-bit impact codes + residual tables) instead of the raw pair;
+#: "pallas" is the fused-kernel spelling of "compressed" (same operands,
+#: same packable() requirement, bit-identical results)
+COMPRESSED_VARIANTS = ("compressed", "compressed_exact", "pallas")
 
 #: block-max metadata granularity: one max-impact code per this many
 #: postings lanes (the TPU lane width — a group of lanes the sort would
@@ -238,6 +264,71 @@ def compress_flat(flat_docs: np.ndarray, flat_impact: np.ndarray,
             v_s[first].astype(np.float32), res_row_starts)
 
 
+#: widest doc-id span an aligned 128-lane block may cover and still take
+#: the u8 delta encoding (delta = doc − block min must fit one byte)
+DELTA_DOC_SPAN = (1 << 8) - 1
+
+
+def delta_doc_reason(flat_docs: np.ndarray, row_starts: np.ndarray,
+                     ) -> Optional[str]:
+    """Why this shard's doc stream can NOT take the per-block delta
+    encoding — None means every aligned COMPRESSED_BLOCK-lane block of
+    REAL postings (positions before row_starts[-1]; the slack tail is
+    never decoded) spans ≤ DELTA_DOC_SPAN doc ids, so doc − block_min
+    fits the u8 delta field. Blocks straddling a row boundary mix two
+    terms' doc ids; the min-base covers that case (deltas are measured
+    against the block minimum, not the first lane)."""
+    rs = np.asarray(row_starts, dtype=np.int64)
+    total = int(rs[-1]) if rs.size else 0
+    if total == 0:
+        return None
+    docs = np.asarray(flat_docs[:total], dtype=np.int64)
+    nb = (total + COMPRESSED_BLOCK - 1) // COMPRESSED_BLOCK
+    pad = nb * COMPRESSED_BLOCK - total
+    mx = np.concatenate([docs, np.full(pad, -1, dtype=np.int64)])
+    mn = np.concatenate([docs, np.full(pad, 1 << 30, dtype=np.int64)])
+    span = (mx.reshape(nb, COMPRESSED_BLOCK).max(axis=1)
+            - mn.reshape(nb, COMPRESSED_BLOCK).min(axis=1))
+    worst = int(span.max())
+    if worst > DELTA_DOC_SPAN:
+        return (f"a {COMPRESSED_BLOCK}-lane block spans {worst} doc ids "
+                f"(u8 delta limit {DELTA_DOC_SPAN})")
+    return None
+
+
+def delta_encode_docs(flat_docs: np.ndarray, row_starts: np.ndarray,
+                      n_bases: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Build one shard's delta doc stream: → (docs8 u8[P], bases
+    u16[n_bases]). bases[j] is the minimum doc id of aligned block j
+    (zero for blocks past the real postings — never decoded, see
+    delta_doc_reason); docs8[p] = doc − bases[p // 128] for real
+    positions, zero in the slack tail. n_bases must leave the kernel's
+    slice slack past the last real block (callers size it
+    ceil(P / 128) + 2). Raises ValueError when delta_doc_reason() is
+    non-None; callers gate first."""
+    reason = delta_doc_reason(flat_docs, row_starts)
+    if reason is not None:
+        raise ValueError(f"doc stream not delta-encodable: {reason}")
+    docs = np.asarray(flat_docs, dtype=np.int64)
+    rs = np.asarray(row_starts, dtype=np.int64)
+    total = int(rs[-1]) if rs.size else 0
+    nb = (total + COMPRESSED_BLOCK - 1) // COMPRESSED_BLOCK
+    if n_bases < nb:
+        raise ValueError(f"n_bases {n_bases} < {nb} real blocks")
+    bases = np.zeros(n_bases, dtype=np.uint16)
+    docs8 = np.zeros(docs.size, dtype=np.uint8)
+    if total:
+        pad = nb * COMPRESSED_BLOCK - total
+        mn = np.concatenate(
+            [docs[:total], np.full(pad, 1 << 30, dtype=np.int64)]
+        ).reshape(nb, COMPRESSED_BLOCK).min(axis=1)
+        bases[:nb] = mn.astype(np.uint16)
+        docs8[:total] = (docs[:total]
+                         - np.repeat(mn, COMPRESSED_BLOCK)[:total]
+                         ).astype(np.uint8)
+    return docs8, bases
+
+
 def packable(d_pad: int, weights: Optional[np.ndarray] = None) -> bool:
     """Host-side lowering-time check: may the packed-key variant serve
     this (pack, batch)? False routes the batch to the exact-f32
@@ -334,7 +425,8 @@ def segmented_run_sum(sk: jax.Array, sv: jax.Array,
                                    "with_counts", "with_totals",
                                    "variant"))
 def sorted_merge_topk(
-    flat_docs: jax.Array,    # int32[P_flat] doc ids (u16 when compressed)
+    flat_docs: jax.Array,    # int32[P_flat] doc ids (u16 when compressed,
+                             # u8 deltas when doc_bases is given)
     flat_impact: jax.Array,  # f32[P_flat] impacts (u16 codes when compressed)
     starts: jax.Array,       # int32[R, T] absolute offsets into flat arrays
     lengths: jax.Array,      # int32[R, T] chunk lengths (0 = empty slot)
@@ -355,6 +447,9 @@ def sorted_merge_topk(
     block_max: Optional[jax.Array] = None,   # u16[NB+1] per-block max codes
     blk_starts: Optional[jax.Array] = None,  # int32[R,T] slot block indices
     slot_terms: Optional[jax.Array] = None,  # int32[R,T] term group id/slot
+    doc_bases: Optional[jax.Array] = None,   # u16[NBD] delta block bases
+    dbs_starts: Optional[jax.Array] = None,  # int32[R,T] slot base indices
+    dlo_starts: Optional[jax.Array] = None,  # int32[R,T] slot offset % 128
 ) -> Tuple[jax.Array, ...]:
     """→ (scores f32[R, k'], doc_ids int32[R, k'][, totals int32[R]]);
     empty lanes are (-inf, d_pad). k' = min(k, T·L_c). totals (when
@@ -366,8 +461,12 @@ def sorted_merge_topk(
     doc/code streams plus residual tables (res_* operands required) and
     are also bit-identical to "ref" on the same postings; "compressed"
     additionally needs packable() weights, "compressed_exact" does not.
-    block_max/blk_starts enable the block-max skip (compressed only;
-    inert when with_totals or k > max_len)."""
+    variant="pallas" runs the "compressed" pipeline as one fused Pallas
+    kernel (interpret-mode off-TPU) — same operands, same bits.
+    block_max/blk_starts enable the block-max skip (compressed/pallas;
+    inert when k > max_len; with_totals launches get exact totals from
+    the pre-skip count sort). doc_bases/dbs_starts/dlo_starts switch the
+    doc stream to the u8-delta format (delta_encode_docs)."""
     if variant not in KERNEL_VARIANTS:
         raise ValueError(f"unknown kernel variant {variant!r}")
     packed = variant == "packed"
@@ -381,6 +480,42 @@ def sorted_merge_topk(
         raise ValueError(
             "compressed variants need flat_rank/res_starts/res_lens/"
             "res_vals — build them with compress_flat()")
+    if doc_bases is not None and (dbs_starts is None or dlo_starts is None):
+        raise ValueError(
+            "delta doc stream needs dbs_starts/dlo_starts alongside "
+            "doc_bases")
+    kw = dict(
+        max_len=max_len, d_pad=d_pad, k=k, t_window=t_window,
+        with_counts=with_counts, with_totals=with_totals,
+        flat_rank=flat_rank, res_starts=res_starts, res_lens=res_lens,
+        res_vals=res_vals, block_max=block_max, blk_starts=blk_starts,
+        slot_terms=slot_terms, doc_bases=doc_bases,
+        dbs_starts=dbs_starts, dlo_starts=dlo_starts)
+    if variant == "pallas":
+        from elasticsearch_tpu.ops import pallas_merge
+        return pallas_merge.fused_merge_topk(
+            flat_docs, flat_impact, starts, lengths, weights, min_count,
+            **kw)
+    return _merge_topk_core(
+        flat_docs, flat_impact, starts, lengths, weights, min_count,
+        variant=variant, **kw)
+
+
+def _merge_topk_core(
+    flat_docs, flat_impact, starts, lengths, weights, min_count, *,
+    max_len: int, d_pad: int, k: int, t_window: int, with_counts: bool,
+    with_totals: bool, variant: str, flat_rank=None, res_starts=None,
+    res_lens=None, res_vals=None, block_max=None, blk_starts=None,
+    slot_terms=None, doc_bases=None, dbs_starts=None, dlo_starts=None,
+) -> Tuple[jax.Array, ...]:
+    """The merge pipeline proper — sorted_merge_topk after validation.
+    Shared verbatim by the XLA variants and the Pallas fused kernel
+    (which calls it per grid row on its block values under
+    interpret=True off-TPU), so parity across dispatch styles holds by
+    construction. `variant` here is one of ref/packed/compressed/
+    compressed_exact; the pallas wrapper passes "compressed"."""
+    packed = variant == "packed"
+    compressed = variant in COMPRESSED_VARIANTS
     r, t_slots = starts.shape
     idx = jnp.arange(max_len, dtype=jnp.int32)
 
@@ -391,7 +526,25 @@ def sorted_merge_topk(
     docs, imps = jax.vmap(jax.vmap(slice_one))(starts)     # [R, T, L]
     valid = idx[None, None, :] < lengths[:, :, None]
     if compressed:
-        docs = jnp.where(valid, docs.astype(jnp.int32), d_pad)
+        if doc_bases is not None:
+            # delta doc stream: lane doc = per-block u16 base + u8
+            # delta. A slot window straddles at most max_len // 128 + 1
+            # aligned blocks from its (dbs, dlo) cursor; slice one extra
+            # so dynamic_slice never clamps (builders leave the slack)
+            nb_slice = max_len // COMPRESSED_BLOCK + 2
+
+            def base_slice(bs):
+                return jax.lax.dynamic_slice(doc_bases, (bs,), (nb_slice,))
+
+            bases = jax.vmap(jax.vmap(base_slice))(dbs_starts)
+            lane_blk = ((dlo_starts[:, :, None] + idx[None, None, :])
+                        // COMPRESSED_BLOCK)
+            lane_base = jnp.take_along_axis(
+                bases.astype(jnp.int32), lane_blk, axis=2)
+            docs = jnp.where(
+                valid, lane_base + docs.astype(jnp.int32), d_pad)
+        else:
+            docs = jnp.where(valid, docs.astype(jnp.int32), d_pad)
         codes = jnp.where(valid, imps.astype(jnp.uint32), 0)
         if variant == "compressed_exact":
             # decode every lane to its exact f32 through the residual
@@ -418,9 +571,31 @@ def sorted_merge_topk(
     length = t_slots * max_len
     kk = min(k, length)
 
-    do_skip = (variant == "compressed" and not with_totals
+    do_skip = (variant == "compressed"
                and block_max is not None and blk_starts is not None
                and k <= max_len)
+    skip_totals = None
+    if do_skip and with_totals:
+        # exact TotalHits from the PRE-skip lanes: a skipped doc is
+        # still a match, so totals cannot come from the post-skip sort.
+        # One auxiliary u32 sort of (doc << 1 | positive-code bit) plus
+        # the same run machinery counts exactly the docs the unskipped
+        # pipeline would have counted — total > 0 there means "some
+        # lane's decoded code is positive", which is precisely the
+        # positive-code bit OR'd over the run
+        posb = (impact_code16(imp) > 0).astype(jnp.uint32)
+        ckey = jax.lax.sort(
+            ((docs.astype(jnp.uint32) << 1) | posb).reshape(r, length))
+        cdoc = (ckey >> 1).astype(jnp.int32)
+        cpos = (ckey & 1).astype(jnp.float32)
+        c_end = jnp.concatenate(
+            [cdoc[:, :-1] != cdoc[:, 1:], jnp.ones((r, 1), bool)], axis=1)
+        c_ok = c_end & (cdoc < d_pad) & (
+            segmented_run_sum(cdoc, cpos, t_window) > 0)
+        if with_counts:
+            c_cnt = segmented_run_sum(cdoc, jnp.ones_like(cpos), t_window)
+            c_ok = c_ok & (c_cnt >= min_count[:, None].astype(jnp.float32))
+        skip_totals = jnp.sum(c_ok, axis=1, dtype=jnp.int32)
     if do_skip:
         # Block-max skip (device-side BMW/MaxScore). Threshold: within a
         # slot, lanes are DISTINCT docs, so a slot's k-th largest lane
@@ -511,18 +686,27 @@ def sorted_merge_topk(
     # totals BEFORE candidate selection: the count is a property of the
     # full sorted axis, and computing it here keeps every downstream
     # top-k shape (full-width or hierarchical) from being able to drop
-    # or truncate it
-    totals = jnp.sum(ok, axis=1, dtype=jnp.int32) if with_totals else None
+    # or truncate it. When the block-max skip ran, the pre-skip count
+    # sort already produced the exact value
+    if not with_totals:
+        totals = None
+    elif skip_totals is not None:
+        totals = skip_totals
+    else:
+        totals = jnp.sum(ok, axis=1, dtype=jnp.int32)
 
     score = jnp.where(ok, total, NEG_INF)
     if packed or variant == "compressed":
         res = None
         if variant == "compressed":
             res = (res_starts, res_lens, res_vals, flat_rank)
+        delta = None
+        if doc_bases is not None:
+            delta = (doc_bases, dbs_starts, dlo_starts)
         vals, hit_docs = _packed_rescore_topk(
             flat_docs, flat_impact, starts, lengths, weights,
             sk, score, cnt, kk, max_len=max_len, d_pad=d_pad,
-            t_window=t_window, res=res)
+            t_window=t_window, res=res, delta=delta)
     else:
         vals, pos = jax.lax.top_k(score, kk)
         hit_docs = jnp.take_along_axis(sk, pos, axis=1)
@@ -534,7 +718,7 @@ def sorted_merge_topk(
 
 def _packed_rescore_topk(flat_docs, flat_impact, starts, lengths, weights,
                          sk, score, cnt, kk, *, max_len: int, d_pad: int,
-                         t_window: int, res=None):
+                         t_window: int, res=None, delta=None):
     """Candidate selection + exact-f32 rescore for the packed variant.
     With res=(res_starts, res_lens, res_vals, flat_rank) the streams are
     the compressed u16 doc/code pair and each matched position's exact
@@ -559,7 +743,13 @@ def _packed_rescore_topk(flat_docs, flat_impact, starts, lengths, weights,
     value order the reference's stable doc sort produces) and summed by
     the SAME log-step guarded scan over the same run length, so the
     f32 rounding tree is bit-identical to segmented_run_sum's and the
-    returned scores equal variant="ref" exactly, not just closely."""
+    returned scores equal variant="ref" exactly, not just closely.
+
+    With delta=(doc_bases, dbs_starts, dlo_starts) the doc stream holds
+    u8 block deltas (delta_encode_docs) and every random access decodes
+    through the slot's block cursor: doc(pos) = bases[dbs + (dlo + pos −
+    start) // 128] + delta[pos]. Positions outside the slot's window
+    decode to d_pad, which also keeps the lo == end probe conservative."""
     r, t_slots = starts.shape
     length = sk.shape[1]
     slack = max(2 * kk, 256) if res is not None else max(2 * kk, 128)
@@ -575,14 +765,31 @@ def _packed_rescore_topk(flat_docs, flat_impact, starts, lengths, weights,
     end = lo + ln3
     hi = end
     target = cand_docs[:, :, None]
+    if delta is None:
+        def doc_at(pos):
+            return jnp.take(flat_docs, pos, mode="fill", fill_value=d_pad)
+    else:
+        d_bases, dbs, dlo = delta
+        st3 = starts[:, None, :]
+        dbs3 = dbs[:, None, :]
+        dlo3 = dlo[:, None, :]
+
+        def doc_at(pos):
+            jrel = pos - st3
+            bidx = dbs3 + (dlo3 + jrel) // COMPRESSED_BLOCK
+            base = jnp.take(d_bases, bidx, mode="fill",
+                            fill_value=0).astype(jnp.int32)
+            dd = jnp.take(flat_docs, pos, mode="fill",
+                          fill_value=0).astype(jnp.int32)
+            return jnp.where((jrel >= 0) & (jrel < ln3), base + dd, d_pad)
     for _ in range(max(1, int(max_len).bit_length())):
         active = lo < hi
         mid = (lo + hi) >> 1
-        v = jnp.take(flat_docs, mid, mode="fill", fill_value=d_pad)
+        v = doc_at(mid)
         go = v < target
         lo = jnp.where(active & go, mid + 1, lo)
         hi = jnp.where(active & ~go, mid, hi)
-    v = jnp.take(flat_docs, lo, mode="fill", fill_value=d_pad)
+    v = doc_at(lo)
     found = (ln3 > 0) & (lo < end) & (v == target) & (target < d_pad)
     if res is None:
         imp_exact = jnp.take(flat_impact, lo, mode="fill", fill_value=0.0)

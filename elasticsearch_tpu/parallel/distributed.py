@@ -218,7 +218,14 @@ class CompressedStreams:
     posting, plus per-128-lane block-max metadata and the per-term f32
     residual tables the exact rescore reads ranks into. Shapes pad to
     common widths so the whole set device_puts with one NamedSharding
-    over the "shards" axis."""
+    over the "shards" axis.
+
+    Delta-doc mode (PR 15): when every shard passes
+    sparse.delta_doc_reason, the resident doc stream is the u8 DELTA
+    stream (flat_docs8) plus per-aligned-block u16 bases (doc_bases) —
+    ~1.02 B/posting instead of 2 — and flat_docs16 stays host-only
+    (never placed). The kernel decodes lane docs and the rescore's
+    random accesses through (doc_bases, dbs, dlo) cursors."""
 
     flat_docs16: np.ndarray   # u16[S, P_pad] doc ids (pad/sentinel = d_pad)
     flat_code16: np.ndarray   # u16[S, P_pad] monotone impact value codes
@@ -226,9 +233,21 @@ class CompressedStreams:
     block_max: np.ndarray     # u16[S, NBp] block-max codes (+1 slack entry)
     res_vals: np.ndarray      # f32[S, RC_pad] residual tables, zero-padded
     res_row_starts: List[np.ndarray]  # per shard: i64[n_rows+1]
+    flat_docs8: Optional[np.ndarray] = None  # u8[S, P_pad] block deltas
+    doc_bases: Optional[np.ndarray] = None   # u16[S, NBD] block min doc ids
+
+    @property
+    def delta(self) -> bool:
+        return self.doc_bases is not None
 
     def nbytes_device(self) -> int:
-        return (self.flat_docs16.nbytes + self.flat_code16.nbytes
+        """Exactly the bytes device_put_compressed places — the HBM
+        breaker's estimate and hbm_detail's resident accounting. In
+        delta mode the u16 doc stream is replaced by the u8 deltas plus
+        the per-block base column."""
+        doc_stream = (self.flat_docs8.nbytes + self.doc_bases.nbytes
+                      if self.delta else self.flat_docs16.nbytes)
+        return (doc_stream + self.flat_code16.nbytes
                 + self.flat_rank16.nbytes + self.block_max.nbytes
                 + self.res_vals.nbytes)
 
@@ -247,16 +266,45 @@ def compress_pack_reason(pack: StackedShardPack) -> Optional[str]:
     return None
 
 
-def build_compressed_streams(pack: StackedShardPack) -> CompressedStreams:
+def delta_pack_reason(pack: StackedShardPack) -> Optional[str]:
+    """First reason any shard's doc stream can NOT take the u8 delta
+    encoding (None = the whole pack is delta-eligible). The delta gate
+    is per PACK — the stacked device tensors need one uniform format —
+    and failing shards keep the plain u16 doc stream for all."""
+    for si in range(pack.num_shards):
+        rstart = (pack.row_starts[si] if si < len(pack.row_starts)
+                  else np.zeros(1, dtype=np.int64))
+        reason = sparse.delta_doc_reason(pack.flat_docs[si], rstart)
+        if reason is not None:
+            return f"shard {si}: {reason}"
+    return None
+
+
+def build_compressed_streams(pack: StackedShardPack,
+                             delta: Optional[bool] = None
+                             ) -> CompressedStreams:
     """Run compress_flat per shard row and stack to common widths.
-    Raises ValueError when compress_pack_reason() is non-None."""
+    Raises ValueError when compress_pack_reason() is non-None.
+
+    delta=None auto-detects (delta_pack_reason); True forces the u8
+    delta doc stream (raises if ineligible), False keeps the plain u16
+    doc stream."""
     s, p_pad = pack.flat_docs.shape
     nbp = (p_pad + sparse.COMPRESSED_BLOCK - 1) // sparse.COMPRESSED_BLOCK + 1
+    if delta is None:
+        delta = delta_pack_reason(pack) is None
     docs16 = np.full((s, p_pad), min(pack.d_pad, (1 << 16) - 1),
                      dtype=np.uint16)
     code16 = np.zeros((s, p_pad), dtype=np.uint16)
     rank16 = np.zeros((s, p_pad), dtype=np.uint16)
     block_max = np.zeros((s, nbp), dtype=np.uint16)
+    # the kernel slices max_len // 128 + 2 base entries from any slot's
+    # block cursor; +2 slack past the last real block keeps that
+    # dynamic_slice clamp-free (mirrors block_max's +1 slack entry)
+    nbd = ((p_pad + sparse.COMPRESSED_BLOCK - 1) // sparse.COMPRESSED_BLOCK
+           + 2)
+    docs8 = np.zeros((s, p_pad), dtype=np.uint8) if delta else None
+    doc_bases = np.zeros((s, nbd), dtype=np.uint16) if delta else None
     res_parts: List[np.ndarray] = []
     res_row_starts: List[np.ndarray] = []
     for si in range(s):
@@ -266,6 +314,10 @@ def build_compressed_streams(pack: StackedShardPack) -> CompressedStreams:
             pack.flat_docs[si], pack.flat_impact[si], rstart, pack.d_pad)
         docs16[si], code16[si], rank16[si] = d16, c16, r16
         block_max[si, :bm.size] = bm
+        if delta:
+            d8, db = sparse.delta_encode_docs(
+                pack.flat_docs[si], rstart, nbd)
+            docs8[si], doc_bases[si] = d8[:p_pad], db
         res_parts.append(rv)
         res_row_starts.append(rrs)
     rc_pad = _pad_to(max([rv.size for rv in res_parts] + [1]))
@@ -273,15 +325,24 @@ def build_compressed_streams(pack: StackedShardPack) -> CompressedStreams:
     for si, rv in enumerate(res_parts):
         res_vals[si, :rv.size] = rv
     return CompressedStreams(docs16, code16, rank16, block_max, res_vals,
-                             res_row_starts)
+                             res_row_starts, flat_docs8=docs8,
+                             doc_bases=doc_bases)
 
 
 def device_put_compressed(streams: CompressedStreams,
                           mesh: Optional[Mesh] = None):
-    """Place the 5 compressed tensors in HBM (sharded over "shards"
-    when a mesh is given) — the compressed resident pack image."""
-    arrays = (streams.flat_docs16, streams.flat_code16,
-              streams.flat_rank16, streams.block_max, streams.res_vals)
+    """Place the compressed tensors in HBM (sharded over "shards" when
+    a mesh is given) — the compressed resident pack image. Plain mode
+    places 5 arrays (docs16 first); delta mode places 6 with the u8
+    delta stream in the doc slot plus the base column appended — the
+    tuple LENGTH is the format discriminator downstream."""
+    if streams.delta:
+        arrays = (streams.flat_docs8, streams.flat_code16,
+                  streams.flat_rank16, streams.block_max,
+                  streams.res_vals, streams.doc_bases)
+    else:
+        arrays = (streams.flat_docs16, streams.flat_code16,
+                  streams.flat_rank16, streams.block_max, streams.res_vals)
     if mesh is None:
         return tuple(jax.device_put(a) for a in arrays)
     sh = NamedSharding(mesh, P(SHARD_AXIS, None))
@@ -533,15 +594,20 @@ def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
 
     comp (compressed variants): (flat_rank [S_l, P_pad], block_max
     [S_l, NBp], res_vals [S_l, RC_pad], res_starts/res_lens/slot_terms
-    [S_l, B, T]) — flattened here with per-shard offsets so the kernel's
-    flat indices stay shard-local."""
+    [S_l, B, T], doc_bases [S_l, NBD] or None) — flattened here with
+    per-shard offsets so the kernel's flat indices stay shard-local.
+    With doc_bases present (delta doc stream) flat_docs carries u8
+    deltas and each slot's base cursor (dbs = shard-relative start //
+    128 offset into the flattened bases, dlo = start % 128) is derived
+    here — the kernel can't recover either from the absolute starts."""
     s_l, b, t = starts.shape
     base = jnp.arange(s_l, dtype=jnp.int32) * p_pad
     starts_abs = starts + base[:, None, None]
     r = s_l * b
     extra = {}
     if comp is not None:
-        flat_rank, block_max, res_vals, res_starts, res_lens, slot_terms = comp
+        (flat_rank, block_max, res_vals, res_starts, res_lens,
+         slot_terms, doc_bases) = comp
         nbp = block_max.shape[1]
         rcp = res_vals.shape[1]
         sb = jnp.arange(s_l, dtype=jnp.int32)[:, None, None]
@@ -553,6 +619,14 @@ def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
                      block_max=block_max.reshape(-1),
                      blk_starts=blk.reshape(r, t),
                      slot_terms=slot_terms.reshape(r, t))
+        if doc_bases is not None:
+            nbd = doc_bases.shape[1]
+            dbs = starts // sparse.COMPRESSED_BLOCK + sb * nbd
+            extra.update(doc_bases=doc_bases.reshape(-1),
+                         dbs_starts=dbs.reshape(r, t),
+                         dlo_starts=(starts
+                                     % sparse.COMPRESSED_BLOCK
+                                     ).reshape(r, t))
     vals, docs, totals = sparse.sorted_merge_topk(
         flat_docs.reshape(-1), flat_impact.reshape(-1),
         starts_abs.reshape(r, t), lengths.reshape(r, t),
@@ -574,7 +648,7 @@ def _local_body(flat_docs, flat_impact, starts, lengths, weights, min_count,
 
 
 def _merge_topk(vals_b, gids_b, k: int, variant: str = "ref"):
-    if variant in ("packed", "compressed"):
+    if variant in ("packed", "compressed", "pallas"):
         top_vals, pos = sparse.hierarchical_top_k(
             vals_b, min(k, vals_b.shape[1]))
     else:
@@ -596,14 +670,14 @@ def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
         @jax.jit
         def step(flat_docs, flat_impact, flat_rank, block_max, res_vals,
                  starts, lengths, weights, res_starts, res_lens,
-                 slot_terms, min_count):
+                 slot_terms, min_count, doc_bases=None):
             vals_b, gids_b, totals_b = _local_body(
                 flat_docs, flat_impact, starts, lengths, weights, min_count,
                 max_len=max_len, d_pad=d_pad, p_pad=p_pad, k=k,
                 t_window=t_window, with_counts=with_counts,
                 shard_offset=jnp.int64(0), variant=variant,
                 comp=(flat_rank, block_max, res_vals,
-                      res_starts, res_lens, slot_terms))
+                      res_starts, res_lens, slot_terms, doc_bases))
             top_vals, top_ids = _merge_topk(vals_b, gids_b, k, variant)
             return top_vals, top_ids, totals_b
 
@@ -626,7 +700,8 @@ def make_local_search(*, max_len: int, d_pad: int, p_pad: int, k: int,
 def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
                             p_pad: int, k: int, t_window: int,
                             with_counts: bool = False,
-                            variant: str = "ref"):
+                            variant: str = "ref",
+                            delta: bool = False):
     """SPMD search step over a (data, shards) mesh: local sorted-merge
     per device, then all_gather over "shards" + final top-k on device
     (SURVEY.md §5.8: the P3 reduce rides ICI). lru_cached by (mesh, bucket
@@ -645,9 +720,13 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
     out_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
 
     if variant in sparse.COMPRESSED_VARIANTS:
+        # delta mode appends the per-block doc-base column as a 6th
+        # postings-sharded operand (the static `delta` flag keys the
+        # lru cache so plain and delta packs get distinct programs)
         def body(flat_docs, flat_impact, flat_rank, block_max, res_vals,
                  starts, lengths, weights, res_starts, res_lens,
-                 slot_terms, min_count):
+                 slot_terms, min_count, *maybe_bases):
+            doc_bases = maybe_bases[0] if delta else None
             s_l = flat_docs.shape[0]
             my = jax.lax.axis_index(SHARD_AXIS).astype(jnp.int64)
             vals_b, gids_b, totals_b = _local_body(
@@ -656,13 +735,13 @@ def make_distributed_search(mesh: Mesh, *, max_len: int, d_pad: int,
                 t_window=t_window, with_counts=with_counts,
                 shard_offset=my * s_l, variant=variant,
                 comp=(flat_rank, block_max, res_vals,
-                      res_starts, res_lens, slot_terms))
+                      res_starts, res_lens, slot_terms, doc_bases))
             return tail(vals_b, gids_b, totals_b)
 
+        in_specs = ((spec_post,) * 5 + (spec_sbt,) * 6 + (P(DATA_AXIS),)
+                    + ((spec_post,) if delta else ()))
         mapped = shard_map(
-            body, mesh=mesh,
-            in_specs=(spec_post,) * 5 + (spec_sbt,) * 6 + (P(DATA_AXIS),),
-            out_specs=out_specs)
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         return jax.jit(mapped)
 
     def body(flat_docs, flat_impact, starts, lengths, weights, min_count):
@@ -736,7 +815,8 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
                        t_terms: int, search_iters: Optional[int] = None,
                        c_local: Optional[int] = None,
                        with_rescore: bool = True,
-                       variant: str = "ref"):
+                       variant: str = "ref",
+                       pack_keys: bool = False):
     """Block-max serving step, ONE fused launch (SURVEY.md §5.7/§7.3#3):
 
       phase A  candidate generation over impact-sorted postings prefixes
@@ -752,7 +832,17 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
     Returns (exact_vals [B,k_out], gids [B,k_out], totals [B],
     cutoff [B], beta [B]); the caller checks the WAND validity bound
     `exact_kth ≥ (cutoff if full else 0) + beta` host-side with its
-    actual k and falls back to the exact kernel when it fails."""
+    actual k and falls back to the exact kernel when it fails.
+
+    pack_keys=True (variant="packed" + rescore tiers only) packs each
+    phase-A lane's GROUP-RELATIVE gid and 16-bit impact code into ONE
+    u32 sort key when the group's gid range fits 16 bits — halving the
+    sort operands like the exact packed kernel. The caller must have
+    verified sparse.packable(d_pad, t_weights) host-side; phase-A run
+    totals become quantized LOWER bounds (match counts stay exact, and
+    phase B re-scores exactly), so the returned cutoff is inflated by
+    the quantization slack to keep the host validity check conservative.
+    Groups whose gid range overflows 16 bits keep the two-operand sort."""
     if search_iters is None:
         # a postings row is at most d_pad docs long
         search_iters = max(1, math.ceil(math.log2(d_pad + 1)))
@@ -814,6 +904,12 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
         idx = jnp.arange(max_len, dtype=jnp.int32)
         width = g * t * max_len
         k_dev = min(c_local, width)
+        # single-key sort applies only when a group's relative gid range
+        # (g rows × (d_pad+1) ords) fits the 16 high bits of a u32 key;
+        # the no-rescore tier is excluded — ITS phase-A totals ARE the
+        # returned scores, and quantizing them would change results
+        use_pack = (pack_keys and variant == "packed" and with_rescore
+                    and g * (d_pad + 1) <= sparse.PACKED_DOC_LIMIT)
 
         def slice_one(s):
             return (jax.lax.dynamic_slice(flat_imp_docs, (s,), (max_len,)),
@@ -825,12 +921,30 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
             valid = idx[None, None, :] < f_lengths[:, :, None]
             # gid key: row·(d_pad+1)+doc — distinct docs across rows
             # never merge; padded lanes carry impact 0, drop via total>0
-            gid = (f_rows[:, :, None] * (d_pad + 1)
-                   + jnp.where(valid, docs, d_pad))
             imp = jnp.where(valid, f_weights[:, :, None] * imps, 0.0)
-            sk, sv = jax.lax.sort(
-                [gid.reshape(b, width), imp.reshape(b, width)],
-                num_keys=1)
+            if use_pack:
+                # group-relative gid in the high 16 bits, impact code in
+                # the low 16: ONE u32 sort operand. Padded rows (zeros
+                # from grouped()) clamp to grel 0 / doc d_pad — the
+                # first row's sentinel run, impact 0, dropped by total>0
+                # exactly like the two-operand path. The group's first
+                # slot is never a pad row, so f_rows[0, 0] is the
+                # group's base row.
+                row0 = f_rows[0, 0]
+                grel = jnp.maximum(f_rows - row0, 0)
+                gid_p = (grel[:, :, None] * (d_pad + 1)
+                         + jnp.where(valid, docs, d_pad)).astype(jnp.uint32)
+                key = (gid_p << 16) | sparse.impact_code16(imp)
+                skp = jax.lax.sort(key.reshape(b, width))
+                sk = ((skp >> 16).astype(jnp.int32)
+                      + row0 * (d_pad + 1))
+                sv = sparse.decode_code16(skp & 0xFFFF)
+            else:
+                gid = (f_rows[:, :, None] * (d_pad + 1)
+                       + jnp.where(valid, docs, d_pad))
+                sk, sv = jax.lax.sort(
+                    [gid.reshape(b, width), imp.reshape(b, width)],
+                    num_keys=1)
             total = sparse.segmented_run_sum(sk, sv, t_window)
             run_end = jnp.concatenate(
                 [sk[:, :-1] != sk[:, 1:], jnp.ones((b, 1), bool)],
@@ -838,10 +952,11 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
             ok = run_end & (total > 0.0)
             score = jnp.where(ok, total, NEG_INF)
             totals_g = jnp.sum(ok, axis=1).astype(jnp.int32)
-            # gid keys span row·(d_pad+1)+doc — far beyond the 16-bit
-            # packed-key range — so the pruned path only takes the
-            # hierarchical top-k half of the packed variant; selection
-            # and tie-breaks are provably identical to lax.top_k
+            # when the single-key sort doesn't apply (gid range overflows
+            # 16 bits, no-rescore tier, or pack_keys off) the pruned path
+            # still takes the hierarchical top-k half of the packed
+            # variant; selection and tie-breaks are provably identical
+            # to lax.top_k
             if variant == "packed":
                 vals_g, pos = sparse.hierarchical_top_k(score, k_dev)
             else:
@@ -937,6 +1052,16 @@ def make_pruned_search(mesh: Mesh, *, max_len: int, d_pad: int, p_pad: int,
         # (≤ cand_vals[:, -1]) or at its row's local top-c_local
         # (≤ row_cut) — the effective cutoff is the max of the two
         cutoff = jnp.maximum(cand_vals[:, -1], row_cut)
+        if use_pack:
+            # packed phase-A totals are quantized LOWER bounds (16-bit
+            # code truncation keeps ≤7 mantissa bits, relative error
+            # < 2^-7 per lane, hence < 2^-7 on the sum of lower bounds);
+            # a cut doc's TRUE phase-A score may exceed its quantized
+            # score by that factor, so inflate the cutoff to keep the
+            # host WAND validity check conservative (-inf = pool not
+            # full stays -inf)
+            cutoff = jnp.where(cutoff > 0.0, cutoff * (1.0 + 2.0 ** -6),
+                               cutoff)
         beta = jax.lax.pmax(jnp.max(tail_bound, axis=0), SHARD_AXIS)
         # ONE packed f32 output [B, 2k+3]: every extra output array is a
         # separate device→host fetch (~100ms through the axon tunnel), so
@@ -1005,8 +1130,10 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
     without blocking (pipelined serving; np.asarray them to wait).
 
     Compressed variants take a 5-tuple device_arrays (docs16, code16,
-    rank16, block_max, res_vals) from device_put_compressed and a batch
-    prepared with compressed=streams (res_starts/res_lens/slot_terms)."""
+    rank16, block_max, res_vals) from device_put_compressed — or the
+    6-tuple delta form (docs8, code16, rank16, block_max, res_vals,
+    doc_bases); tuple length selects the format — and a batch prepared
+    with compressed=streams (res_starts/res_lens/slot_terms)."""
     compressed = variant in sparse.COMPRESSED_VARIANTS
     if device_arrays is None:
         if compressed:
@@ -1020,9 +1147,11 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
         t_window = batch.window
     elif t_window < batch.window:
         raise ValueError(f"t_window={t_window} < needed {batch.window}")
+    delta = compressed and len(device_arrays) == 6
     fn = make_distributed_search(
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
-        k=k, t_window=t_window, with_counts=with_counts, variant=variant)
+        k=k, t_window=t_window, with_counts=with_counts, variant=variant,
+        delta=delta)
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
     db = NamedSharding(mesh, P(DATA_AXIS))
     if compressed and batch.res_starts is None:
@@ -1031,8 +1160,15 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
             "compressed= streams (res_starts/res_lens/slot_terms)")
     with DEVICE_DISPATCH_LOCK:
         if compressed:
-            docs16, code16, rank16, block_max, res_vals = device_arrays
-            vals, ids, totals = fn(docs16, code16, rank16, block_max,
+            if delta:
+                (flat_docs, code16, rank16, block_max, res_vals,
+                 doc_bases) = device_arrays
+                bases = (doc_bases,)
+            else:
+                flat_docs, code16, rank16, block_max, res_vals = \
+                    device_arrays
+                bases = ()
+            vals, ids, totals = fn(flat_docs, code16, rank16, block_max,
                                    res_vals,
                                    jax.device_put(batch.starts, sbt),
                                    jax.device_put(batch.lengths, sbt),
@@ -1040,7 +1176,8 @@ def distributed_search_raw(pack: StackedShardPack, batch: QueryBatch,
                                    jax.device_put(batch.res_starts, sbt),
                                    jax.device_put(batch.res_lens, sbt),
                                    jax.device_put(batch.slot_terms, sbt),
-                                   jax.device_put(batch.min_count, db))
+                                   jax.device_put(batch.min_count, db),
+                                   *bases)
         else:
             flat_docs, flat_impact = device_arrays
             vals, ids, totals = fn(flat_docs, flat_impact,

@@ -53,7 +53,8 @@ MAX_SLOTS_PER_PASS = 32
 def choose_kernel_variant(d_pad: int,
                           weights: Optional[np.ndarray] = None,
                           enabled: bool = True,
-                          compressed: bool = False) -> str:
+                          compressed: bool = False,
+                          pallas: bool = False) -> str:
     """Pick the device-kernel variant for one lowered pack/batch.
 
     Lowering-time decision (PERF.md round 8): "packed" — the single
@@ -73,9 +74,21 @@ def choose_kernel_variant(d_pad: int,
     (per-lane residual-table decode then the exact-f32 pipeline — the
     automatic fallback for weights that would violate the bound). A
     compressed pack has no f32 posting copy, so "ref"/"packed" are not
-    reachable from it."""
+    reachable from it.
+
+    pallas=True (PR 15): prefer the fused Pallas spelling of the
+    compressed pipeline — one kernel for gather, merge, in-kernel
+    block-max skip and top-k, bit-identical to "compressed". It has the
+    same packable() requirement, so the fallback chain stays typed:
+    pallas unavailable (jaxlib without the pallas extra) or weights not
+    packable → the same "compressed"/"compressed_exact" choice as
+    pallas=False. Never errors."""
     if compressed:
         if sparse.packable(d_pad, weights):
+            if pallas:
+                from elasticsearch_tpu.ops import pallas_merge
+                if pallas_merge.available():
+                    return "pallas"
             return "compressed"
         return "compressed_exact"
     if enabled and sparse.packable(d_pad, weights):

@@ -46,6 +46,7 @@ import numpy as np
 from elasticsearch_tpu.common import profiler, tenancy, tracing
 from elasticsearch_tpu.common.metrics import CounterMetric, LabeledCounters
 from elasticsearch_tpu.mapping.types import TextFieldType
+from elasticsearch_tpu.ops import sparse
 from elasticsearch_tpu.parallel import distributed as dist
 from elasticsearch_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 from elasticsearch_tpu.search import dsl
@@ -326,10 +327,10 @@ class ResidentPack:
         default_factory=dict)
     # compressed resident format (PERF.md round 11): host-side 16-bit
     # streams + residual tables. When set, device_arrays is the 5-tuple
-    # from device_put_compressed, there is no f32 posting copy on device
-    # and no impact-sorted copy at all (imp_host/imp_device_arrays stay
-    # None → every query routes to the exact kernel in a compressed
-    # variant)
+    # from device_put_compressed (6-tuple with the delta doc stream's
+    # base column, PR 15), there is no f32 posting copy on device and no
+    # impact-sorted copy at all (imp_host/imp_device_arrays stay None →
+    # every query routes to the exact kernel in a compressed variant)
     comp_streams: Optional[dist.CompressedStreams] = None
     # per-pack HBM accounting detail for /_tpu/stats and the Prometheus
     # pack families: raw vs resident bytes, ratio, block metadata, docs
@@ -564,6 +565,7 @@ class IndexPackCache:
                 if self._breaker is not None:  # undo the charge on failure
                     self._breaker.release(hbm)
                 raise
+        n_postings = int(sum(int(rs[-1]) for rs in pack.row_starts))
         hbm_detail = {
             "compressed": streams is not None,
             "hbm_bytes": int(hbm),
@@ -574,8 +576,18 @@ class IndexPackCache:
                                  if streams is not None else 0),
             "residual_bytes": (int(streams.res_vals.nbytes)
                                if streams is not None else 0),
+            # delta doc stream (PR 15): u8 block-relative deltas + u16
+            # per-block bases instead of the u16 doc stream — the bytes
+            # the "≤ 6 B/posting" acceptance is accounted against
+            "doc_delta": streams is not None and streams.delta,
+            "doc_base_bytes": (int(streams.doc_bases.nbytes)
+                               if streams is not None and streams.delta
+                               else 0),
             "docs": n_docs,
             "hbm_bytes_per_doc": (float(hbm) / n_docs if n_docs else 0.0),
+            "postings": n_postings,
+            "hbm_bytes_per_posting": (float(hbm) / n_postings
+                                      if n_postings else 0.0),
         }
         if comp_reason is not None:
             hbm_detail["compress_reason"] = comp_reason
@@ -1161,13 +1173,21 @@ KERNEL_CONFIG = {"packed_sort": True,
                  # compressed_pack=True builds RESIDENT packs in the
                  # 16-bit stream format (PERF.md round 11): ~2.7× fewer
                  # HBM bytes/doc, exact scores via residual tables,
-                 # device-side block-max pruning. Build-time: toggling
-                 # only affects packs built afterwards (the bench
-                 # invalidates between phases). Incompressible packs
-                 # (d_pad ≥ 2^16, non-finite impacts, > 65535 distinct
-                 # impacts per term) silently stay in the raw format
-                 # (`search.tpu_serving.kernel.compressed_pack`).
-                 "compressed_pack": False}
+                 # device-side block-max pruning. Default ON since PR 15
+                 # (two rounds of parity sweeps + the SLO harness behind
+                 # it; real-chip soak tracked in README). Build-time:
+                 # toggling only affects packs built afterwards (the
+                 # bench invalidates between phases). Incompressible
+                 # packs (d_pad ≥ 2^16, non-finite impacts, > 65535
+                 # distinct impacts per term) silently stay in the raw
+                 # format (`search.tpu_serving.kernel.compressed_pack`).
+                 "compressed_pack": True,
+                 # pallas=True serves compressed packs through the fused
+                 # Pallas kernel (ops/pallas_merge) when available —
+                 # bit-identical to "compressed", same typed fallbacks.
+                 # Off by default until the real-chip Mosaic soak lands
+                 # (`search.tpu_serving.kernel.pallas`).
+                 "pallas": False}
 
 #: per-(kernel, variant) launch counters → es_tpu_kernel_variant_total
 KERNEL_VARIANT_COUNTS = LabeledCounters("kernel", "variant")
@@ -1181,14 +1201,17 @@ def _choose_exact_variant(resident: ResidentPack, batch) -> str:
     return choose_kernel_variant(resident.pack.d_pad, batch.weights,
                                  enabled=KERNEL_CONFIG["packed_sort"],
                                  compressed=resident.comp_streams
-                                 is not None)
+                                 is not None,
+                                 pallas=KERNEL_CONFIG["pallas"])
 
 
 def _pruned_variant() -> str:
-    """The pruned kernel sorts shard-offset gid keys (way past 16 bits)
-    so it never packs — its "packed" variant is the hierarchical top-k
-    half only, which is unconditionally safe. Setting-gated so the
-    bench can A/B it."""
+    """Under variant="packed" the pruned kernel always takes the
+    hierarchical top-k half (unconditionally safe); whether a launch
+    ALSO packs (gid, impact code) into one sort key is a separate
+    per-launch gate (pack_keys in _launch_pruned: the group's gid range
+    must fit 16 bits and the batch weights must be packable).
+    Setting-gated so the bench can A/B it."""
     return "packed" if KERNEL_CONFIG["packed_sort"] else "ref"
 
 
@@ -1544,12 +1567,18 @@ def _launch_pruned(resident: ResidentPack, flats: Sequence[FlatQuery],
         variant = _pruned_variant()
     KERNEL_VARIANT_COUNTS.inc("full" if full_slots is not None
                               else "pruned", variant)
+    # single-key phase-A sort (PR 15): only when the batch's slot AND
+    # rescore-term weights keep the 16-bit impact code monotone — the
+    # group-size fit check is static inside make_pruned_search
+    pack_keys = (variant == "packed" and with_rescore
+                 and sparse.packable(pack.d_pad, batch.weights)
+                 and sparse.packable(pack.d_pad, t_weights))
     fn = dist.make_pruned_search(
         mesh, max_len=batch.max_len, d_pad=pack.d_pad, p_pad=pack.p_pad,
         c_cand=k_cand, k_out=k_out,
         t_window=max(_PRUNE_WINDOW, batch.window),
         t_terms=PRUNE_MAX_TERMS, with_rescore=with_rescore,
-        variant=variant)
+        variant=variant, pack_keys=pack_keys)
     from jax.sharding import NamedSharding, PartitionSpec as P
     from elasticsearch_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
     sbt = NamedSharding(mesh, P(SHARD_AXIS, DATA_AXIS, None))
@@ -2119,13 +2148,15 @@ class TpuSearchService:
                  prewarm_concurrency: int = 4,
                  compile_cache_dir: Optional[str] = None,
                  packed_sort: bool = True,
-                 compressed_pack: bool = False,
+                 compressed_pack: bool = True,
+                 pallas: bool = False,
                  launch_deadline_ms: float = 120_000.0,
                  device_health: Optional[Dict[str, Any]] = None,
                  placement: Optional[Dict[str, Any]] = None):
         _ensure_compile_cache(compile_cache_dir)
         KERNEL_CONFIG["packed_sort"] = bool(packed_sort)
         KERNEL_CONFIG["compressed_pack"] = bool(compressed_pack)
+        KERNEL_CONFIG["pallas"] = bool(pallas)
         self.packs = IndexPackCache(mesh=mesh, breaker=breaker)
         self.plans = PlanCache(max_entries=plan_cache_size)
         self.batch_timeout_s = batch_timeout_s
@@ -2601,6 +2632,17 @@ class TpuSearchService:
     def kernel_compressed_pack(self) -> bool:
         return KERNEL_CONFIG["compressed_pack"]
 
+    def set_kernel_pallas(self, enabled: bool) -> None:
+        """Flip the fused-Pallas serving variant at runtime (launch-time:
+        the next lowering pass picks it up; choose_kernel_variant still
+        falls back to "compressed" when Pallas is unavailable or the
+        batch isn't packable)."""
+        KERNEL_CONFIG["pallas"] = bool(enabled)
+
+    @property
+    def kernel_pallas(self) -> bool:
+        return KERNEL_CONFIG["pallas"]
+
     def invalidate_index(self, index_name: str) -> None:
         """Drop resident packs AND lowered plans of a deleted/closed
         index (releases HBM breaker bytes and pinned readers)."""
@@ -2938,6 +2980,10 @@ class TpuSearchService:
         if resident.comp_streams is not None:
             exact_variants: Tuple[str, ...] = ("compressed",
                                                "compressed_exact")
+            if KERNEL_CONFIG["pallas"]:
+                from elasticsearch_tpu.ops import pallas_merge
+                if pallas_merge.available():
+                    exact_variants = ("pallas",) + exact_variants
         elif (KERNEL_CONFIG["packed_sort"]
                 and _sparse.packable(resident.pack.d_pad)):
             exact_variants = ("packed", "ref")
@@ -3038,6 +3084,7 @@ class TpuSearchService:
                 "kernel": {"packed_sort": KERNEL_CONFIG["packed_sort"],
                            "compressed_pack":
                                KERNEL_CONFIG["compressed_pack"],
+                           "pallas": KERNEL_CONFIG["pallas"],
                            "variants": KERNEL_VARIANT_COUNTS.counts()},
                 "queue": self.batcher.queue_depths(),
                 "supervision": self.supervisor.stats(),

@@ -235,7 +235,12 @@ class TestColdStartGrace:
                             lambda *a, **kw: ([], []))
         monkeypatch.setattr(svc_mod, "_execute_exact",
                             lambda *a, **kw: [])
-        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
+        # raw-format pack on purpose: this test pins the round-8 warm
+        # table (packed + ref, pruned-path signatures included); the
+        # compressed default routes everything to the exact variants
+        # and has no pruned tier to warm
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0,
+                               compressed_pack=False)
         try:
             warm = tpu.prewarm(idx, "body", concurrency=3)
             assert not tpu._warming  # cleared even on the happy path
@@ -263,6 +268,9 @@ class TestColdStartGrace:
             assert not any(e.get("error") for e in warm["compiled"])
         finally:
             tpu.close()
+            # the knob is process-global; restore the default for the
+            # rest of the suite
+            svc_mod.KERNEL_CONFIG["compressed_pack"] = True
 
     def test_prewarm_async_sets_done_state(self, svc, seeded_np,
                                            monkeypatch):

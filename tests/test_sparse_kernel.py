@@ -56,7 +56,10 @@ def row_starts_of(ext, flat_len):
 
 def compressed_operands(flat_docs, flat_imp, ext, d_pad, plan):
     """Compress the test corpus and derive the per-slot operands the
-    compressed variants need (mirrors prepare_query_batch)."""
+    compressed variants need (mirrors prepare_query_batch). When the
+    doc stream passes the per-block delta gate the operands switch to
+    the u8 delta format, exactly as device residency does — so small
+    d_pad corpora route the parity sweeps through the delta decode."""
     rs = row_starts_of(ext, flat_docs.size)
     reason = sparse.compress_reason(flat_docs, flat_imp, rs, d_pad)
     assert reason is None, reason
@@ -69,14 +72,26 @@ def compressed_operands(flat_docs, flat_imp, ext, d_pad, plan):
     res_lens = (res_rs[rr + 1] - res_rs[rr]).astype(np.int32)
     res_lens[plan.lengths == 0] = 0
     blk = (plan.starts // sparse.COMPRESSED_BLOCK).astype(np.int32)
-    return (docs16, code16,
-            dict(flat_rank=jnp.asarray(rank16),
+    extra = dict(flat_rank=jnp.asarray(rank16),
                  res_starts=jnp.asarray(res_starts),
                  res_lens=jnp.asarray(res_lens),
                  res_vals=jnp.asarray(res_vals),
                  block_max=jnp.asarray(block_max),
                  blk_starts=jnp.asarray(blk),
-                 slot_terms=jnp.asarray(rr)))
+                 slot_terms=jnp.asarray(rr))
+    doc_stream = docs16
+    if sparse.delta_doc_reason(flat_docs, rs) is None:
+        nbd = (flat_docs.size + sparse.COMPRESSED_BLOCK - 1) \
+            // sparse.COMPRESSED_BLOCK + 2
+        docs8, bases = sparse.delta_encode_docs(flat_docs, rs, nbd)
+        extra.update(
+            doc_bases=jnp.asarray(bases),
+            dbs_starts=jnp.asarray(
+                (plan.starts // sparse.COMPRESSED_BLOCK).astype(np.int32)),
+            dlo_starts=jnp.asarray(
+                (plan.starts % sparse.COMPRESSED_BLOCK).astype(np.int32)))
+        doc_stream = docs8
+    return (doc_stream, code16, extra)
 
 
 def run_kernel(flat_docs, flat_imp, rows, mins, d_pad, k, chunk_cap=4096,
@@ -554,6 +569,177 @@ class TestCompressedPack:
                 max_len=plan.max_len, d_pad=400, k=5,
                 t_window=plan.window, with_counts=False,
                 variant="compressed")
+
+    def test_delta_requires_cursor_operands(self, seeded_np):
+        # doc_bases without its slot cursors must be a typed error, not
+        # a silent wrong decode
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 2, 250, 80)
+        rows = [[(ext[t][0], ext[t][1], 1.0, t) for t in range(2)]]
+        plan = sparse.plan_slots(rows, [1], chunk_cap=4096, lane=8)
+        docs8, code16, extra = compressed_operands(
+            flat_docs, flat_imp, ext, 250, plan)
+        assert "doc_bases" in extra  # d_pad=250 corpus is delta-eligible
+        extra.pop("dbs_starts")
+        with pytest.raises(ValueError, match="dbs_starts"):
+            sparse.sorted_merge_topk(
+                jnp.asarray(docs8), jnp.asarray(code16),
+                jnp.asarray(plan.starts), jnp.asarray(plan.lengths),
+                jnp.asarray(plan.weights), jnp.asarray(plan.min_count),
+                max_len=plan.max_len, d_pad=250, k=5,
+                t_window=plan.window, with_counts=False,
+                variant="compressed", **extra)
+
+    def test_totals_served_through_skip_path(self, seeded_np):
+        """ISSUE 17 satellite: with_totals no longer forces the
+        block-max skip off. On this corpus the host mirror shows a
+        NONZERO skip rate (it was forced to an unskipped launch
+        before), and the totals from the skipping variant are exact —
+        bit-identical to the reference and to the oracle count,
+        courtesy of the pre-skip count sort."""
+        d_pad = 20000
+        flat_docs, flat_imp, ext = make_heavy_flat(
+            seeded_np, d_pad, [9000, 7000])
+        rows = [[(ext[0][0], ext[0][1], 1.0, 0)]]
+        k = 10
+        plan = sparse.plan_slots(rows, [1], chunk_cap=4096, lane=8)
+        _, code16, extra = compressed_operands(
+            flat_docs, flat_imp, ext, d_pad, plan)
+        rate = host_skip_rate(
+            plan, np.asarray(code16), np.asarray(extra["block_max"]),
+            np.asarray(extra["blk_starts"]),
+            np.asarray(extra["slot_terms"]), k)
+        assert rate > 0.0, "corpus must engage the skip for this test"
+        rv, rd, rt = run_kernel(flat_docs, flat_imp, rows, [1], d_pad,
+                                k, with_totals=True, variant="ref")
+        cv, cd, ct = run_kernel(flat_docs, flat_imp, rows, [1], d_pad,
+                                k, with_totals=True,
+                                variant="compressed", ext=ext)
+        np.testing.assert_array_equal(rv.view(np.uint32),
+                                      cv.view(np.uint32))
+        np.testing.assert_array_equal(rd, cd)
+        np.testing.assert_array_equal(rt, ct)
+        exp = brute_force(rows, flat_docs, flat_imp, d_pad, [1])[0]
+        assert ct.tolist() == [len(exp)]
+
+
+class TestDeltaDocStream:
+    """Per-block delta doc encoding (u16 docs → u8 delta + u16 block
+    base): exact roundtrip, the span gate, and full-kernel parity when
+    the operands take the delta format."""
+
+    def test_roundtrip_exact(self, seeded_np):
+        d_pad = 256  # any 128-lane block trivially spans ≤ 255 ids
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 4, d_pad, 200)
+        rs = row_starts_of(ext, flat_docs.size)
+        assert sparse.delta_doc_reason(flat_docs, rs) is None
+        nbd = (flat_docs.size + sparse.COMPRESSED_BLOCK - 1) \
+            // sparse.COMPRESSED_BLOCK + 2
+        docs8, bases = sparse.delta_encode_docs(flat_docs, rs, nbd)
+        assert docs8.dtype == np.uint8 and bases.dtype == np.uint16
+        total = int(rs[-1])
+        pos = np.arange(total)
+        dec = (bases[pos // sparse.COMPRESSED_BLOCK].astype(np.int64)
+               + docs8[:total])
+        np.testing.assert_array_equal(dec, flat_docs[:total])
+        # slack tail encodes to zeros (never decoded by the kernel)
+        assert not docs8[total:].any()
+
+    def test_gate_rejects_wide_blocks(self):
+        # stride-4 doc ids: every full 128-lane block spans 508 > 255
+        d_pad = 4096
+        docs = np.arange(0, d_pad, 4, dtype=np.int32)
+        flat_docs = np.concatenate(
+            [docs, np.full(4352, d_pad, dtype=np.int32)])
+        rs = np.array([0, docs.size], dtype=np.int64)
+        reason = sparse.delta_doc_reason(flat_docs, rs)
+        assert reason is not None and "span" in reason
+        with pytest.raises(ValueError, match="delta"):
+            sparse.delta_encode_docs(flat_docs, rs, 1024)
+
+    def test_gate_ignores_slack_tail(self):
+        # real postings are tight; the d_pad-sentinel tail would blow
+        # the span if the gate (wrongly) looked at it
+        d_pad = 4096
+        docs = np.arange(100, 180, dtype=np.int32)
+        flat_docs = np.concatenate(
+            [docs, np.full(4352, d_pad, dtype=np.int32)])
+        rs = np.array([0, docs.size], dtype=np.int64)
+        assert sparse.delta_doc_reason(flat_docs, rs) is None
+
+    @pytest.mark.compressed_pack
+    def test_delta_parity_all_variants(self, seeded_np):
+        """A delta-eligible corpus pushes every compressed variant
+        (incl. pallas) through the in-kernel u8 decode; results must
+        stay bit-identical to the reference, chunked or not."""
+        d_pad = 256
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 5, d_pad, 200)
+        rs = row_starts_of(ext, flat_docs.size)
+        assert sparse.delta_doc_reason(flat_docs, rs) is None
+        ws = [1.3, 0.7, 2.2, 0.4, 1.9]
+        rows = [[(ext[t][0], ext[t][1], ws[t], t) for t in range(5)]]
+        for mc in (1, 3):
+            assert_variants_identical(flat_docs, flat_imp, rows, [mc],
+                                      d_pad, 40, ext=ext)
+        # tiny chunks: slot cursors land on arbitrary (dbs, dlo) splits
+        assert_variants_identical(flat_docs, flat_imp, rows, [1],
+                                  d_pad, 40, ext=ext, chunk_cap=64)
+
+
+@pytest.mark.pallas
+class TestPallasKernel:
+    """variant="pallas" dispatch seams. Bitwise parity itself rides the
+    5-variant sweeps above ("pallas" is in COMPRESSED_VARIANTS); these
+    pin the availability gate and the typed fallback."""
+
+    def test_pallas_in_variant_tuples(self):
+        assert "pallas" in sparse.KERNEL_VARIANTS
+        assert "pallas" in sparse.COMPRESSED_VARIANTS
+
+    def test_interpret_mode_selected_off_tpu(self):
+        import jax
+        from elasticsearch_tpu.ops import pallas_merge
+        # tier-1 runs on the CPU mesh: the wrapper must self-select
+        # interpret mode (a compiled Mosaic call would just fail here)
+        assert jax.default_backend() != "tpu"
+        assert isinstance(pallas_merge.available(), bool)
+
+    def test_fallback_without_pallas_bit_identical(self, seeded_np,
+                                                   monkeypatch):
+        """With pallas unavailable the wrapper must fall back to the
+        plain compressed core — never error — and compute the same
+        bits."""
+        from elasticsearch_tpu.ops import pallas_merge
+        monkeypatch.setattr(pallas_merge, "pl", None)
+        assert not pallas_merge.available()
+        d_pad = 300
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 3, d_pad, 150)
+        rows = [[(ext[t][0], ext[t][1], 1.0 + t, t) for t in range(3)]]
+        # k=23 keeps this trace distinct from any cached pallas jit of
+        # the same shapes, so the fallback branch genuinely traces
+        pv, pd_, pt = run_kernel(flat_docs, flat_imp, rows, [1], d_pad,
+                                 23, with_totals=True, variant="pallas",
+                                 ext=ext)
+        rv, rd, rt = run_kernel(flat_docs, flat_imp, rows, [1], d_pad,
+                                23, with_totals=True, variant="ref")
+        np.testing.assert_array_equal(rv.view(np.uint32),
+                                      pv.view(np.uint32))
+        np.testing.assert_array_equal(rd, pd_)
+        np.testing.assert_array_equal(rt, pt)
+
+    def test_pallas_totals_and_counts(self, seeded_np):
+        d_pad = 500
+        flat_docs, flat_imp, ext = make_flat(seeded_np, 3, d_pad, 200)
+        rows = [[(ext[t][0], ext[t][1], 1.5, t) for t in range(3)]]
+        rv, rd, rt = run_kernel(flat_docs, flat_imp, rows, [2], d_pad,
+                                30, with_counts=True, with_totals=True,
+                                variant="ref")
+        pv, pd_, pt = run_kernel(flat_docs, flat_imp, rows, [2], d_pad,
+                                 30, with_counts=True, with_totals=True,
+                                 variant="pallas", ext=ext)
+        np.testing.assert_array_equal(rv.view(np.uint32),
+                                      pv.view(np.uint32))
+        np.testing.assert_array_equal(rd, pd_)
+        np.testing.assert_array_equal(rt, pt)
 
 
 class TestHierarchicalTopK:

@@ -535,7 +535,10 @@ class TestBlockMaxPruning:
         from elasticsearch_tpu.parallel import distributed as dist
         idx = self._dense_corpus(svc, seeded_np, docs=100)
         from elasticsearch_tpu.search.tpu_service import TpuSearchService
-        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0)
+        # the impact-sorted copy only exists in the RAW resident format
+        # (compressed packs route everything to the exact kernel)
+        tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0,
+                               compressed_pack=False)
         try:
             resident = tpu.packs.get(idx, "body")
             pack = resident.pack
@@ -553,6 +556,8 @@ class TestBlockMaxPruning:
                         pack.flat_docs[si, a:b].tolist()
         finally:
             tpu.close()
+            from elasticsearch_tpu.search.tpu_service import KERNEL_CONFIG
+            KERNEL_CONFIG["compressed_pack"] = True
 
 
 def test_grouped_phase_a_many_segments(svc, seeded_np):
@@ -596,6 +601,26 @@ class TestKernelVariant:
         # setting off → fallback regardless of packability
         assert choose_kernel_variant(1000, ok_w, enabled=False) == "ref"
 
+    def test_choose_kernel_variant_compressed_and_pallas(self):
+        from elasticsearch_tpu.ops import pallas_merge
+        from elasticsearch_tpu.search.planner import choose_kernel_variant
+        ok_w = np.array([0.5, 2.0], dtype=np.float32)
+        # compressed pack: packable weights → quantized-sort variant,
+        # hostile weights → decode-everything exact variant (no "ref" —
+        # a compressed pack has no raw f32 image to fall back to)
+        assert choose_kernel_variant(1000, ok_w,
+                                     compressed=True) == "compressed"
+        assert choose_kernel_variant(
+            1000, np.array([1e31]), compressed=True) == "compressed_exact"
+        # pallas rides the compressed gate and its own availability
+        want = "pallas" if pallas_merge.available() else "compressed"
+        assert choose_kernel_variant(1000, ok_w, compressed=True,
+                                     pallas=True) == want
+        # hostile weights beat the pallas request (exact path first)
+        assert choose_kernel_variant(
+            1000, np.array([-1.0]), compressed=True,
+            pallas=True) == "compressed_exact"
+
     @staticmethod
     def _moved(before, after, variant):
         """Launch-counter keys ("kernel,variant") that incremented."""
@@ -615,8 +640,10 @@ class TestKernelVariant:
                 "size": 20, "_source": False}
         slow = coordinator.search(svc, "corpus", dict(body),
                                   tpu_search=None)
+        # packed/ref are only reachable from the RAW resident format
+        # (compressed packs serve the compressed variant pair)
         tpu = TpuSearchService(window_s=0.0, batch_timeout_s=300.0,
-                               packed_sort=True)
+                               packed_sort=True, compressed_pack=False)
         try:
             for expect in ("packed", "ref"):
                 before = dict(svc_mod.KERNEL_VARIANT_COUNTS.counts())
@@ -637,6 +664,7 @@ class TestKernelVariant:
                 assert tpu.kernel_packed_sort is False
         finally:
             tpu.close()
-            # the knob is process-global (jit cache + prewarm are too):
-            # restore the default for the rest of the suite
+            # the knobs are process-global (jit cache + prewarm are too):
+            # restore the defaults for the rest of the suite
             svc_mod.KERNEL_CONFIG["packed_sort"] = True
+            svc_mod.KERNEL_CONFIG["compressed_pack"] = True
