@@ -712,4 +712,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "compare":
+        # regression gate: diff the two newest BENCH_r*.json rounds
+        from elasticsearch_tpu.benchmark.compare import main as _compare
+        sys.exit(_compare(sys.argv[2:]))
     main()

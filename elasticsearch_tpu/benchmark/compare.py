@@ -1,0 +1,130 @@
+"""Bench regression gate: diff the newest ``BENCH_r*.json`` against its
+predecessor and fail (exit 1) on a >15% regression in any per-stage
+p99 latency or any kernel-variant ``device_ms_per_query``.
+
+Usage::
+
+    python bench.py compare [old.json new.json]
+    python -m elasticsearch_tpu.benchmark.compare [old.json new.json]
+
+With no arguments the two newest numbered rounds in the repo root are
+compared (suffix variants like ``BENCH_r05_scale.json`` are skipped —
+they measure a different configuration). Metrics present in only one of
+the two rounds are ignored: old rounds predate per-stage percentiles
+and the kernel-compare block, and a gate must not fail on a metric that
+was never measured twice.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: fail the gate when new/old exceeds this on any compared metric
+THRESHOLD = 0.15
+
+_ROUND = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def find_rounds(root: str) -> List[str]:
+    """Numbered round files, oldest → newest by round number."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        m = _ROUND.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    return [path for _n, path in sorted(out)]
+
+
+def _load(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"compare: cannot read {path}: {exc}", file=sys.stderr)
+        return None
+    parsed = doc.get("parsed")
+    return parsed if isinstance(parsed, dict) else None
+
+
+def collect_metrics(parsed: Dict[str, Any]) -> Dict[str, float]:
+    """The gated metrics of one round, flat-keyed:
+    ``stage.<name>.p99_ms`` and ``kernel.<variant>.device_ms_per_query``
+    (lower is better for every one of them)."""
+    out: Dict[str, float] = {}
+    for stage, rec in (parsed.get("stages") or {}).items():
+        if isinstance(rec, dict) and isinstance(
+                rec.get("p99_ms"), (int, float)):
+            out[f"stage.{stage}.p99_ms"] = float(rec["p99_ms"])
+    for variant, rec in (parsed.get("kernel_compare") or {}).items():
+        if isinstance(rec, dict) and isinstance(
+                rec.get("device_ms_per_query"), (int, float)):
+            out[f"kernel.{variant}.device_ms_per_query"] = \
+                float(rec["device_ms_per_query"])
+    return out
+
+
+def diff(old: Dict[str, float],
+         new: Dict[str, float]) -> List[Tuple[str, float, float, float]]:
+    """→ [(metric, old, new, ratio-1)] for every metric in BOTH rounds."""
+    rows = []
+    for key in sorted(set(old) & set(new)):
+        o, n = old[key], new[key]
+        change = (n / o - 1.0) if o > 0 else 0.0
+        rows.append((key, o, n, change))
+    return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "compare":  # tolerate bench.py-style argv
+        argv = argv[1:]
+    if len(argv) >= 2:
+        old_path, new_path = argv[0], argv[1]
+    else:
+        root = argv[0] if argv else os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        rounds = find_rounds(root)
+        if len(rounds) < 2:
+            print(f"compare: fewer than two BENCH_r*.json rounds under "
+                  f"{root}; nothing to gate")
+            return 0
+        old_path, new_path = rounds[-2], rounds[-1]
+    old_parsed, new_parsed = _load(old_path), _load(new_path)
+    if old_parsed is None or new_parsed is None:
+        print("compare: missing/unparseable bench round(s); "
+              "nothing to gate")
+        return 0
+    rows = diff(collect_metrics(old_parsed), collect_metrics(new_parsed))
+    if not rows:
+        print(f"compare: no metrics shared by {os.path.basename(old_path)}"
+              f" and {os.path.basename(new_path)}; nothing to gate")
+        return 0
+    regressions = []
+    print(f"compare: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} "
+          f"(gate: +{THRESHOLD:.0%} on p99/device-ms)")
+    for key, o, n, change in rows:
+        mark = ""
+        if change > THRESHOLD:
+            mark = "  << REGRESSION"
+            regressions.append(key)
+        print(f"  {key:48s} {o:10.3f} -> {n:10.3f}  "
+              f"({change:+.1%}){mark}")
+    if regressions:
+        print(f"compare: FAIL — {len(regressions)} metric(s) regressed "
+              f"beyond {THRESHOLD:.0%}: {', '.join(regressions)}")
+        return 1
+    print(f"compare: OK — {len(rows)} metric(s) within {THRESHOLD:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,7 +45,7 @@ from elasticsearch_tpu.common.errors import (EsException,
                                              NoShardAvailableActionException,
                                              shard_failure_entry)
 from elasticsearch_tpu.common.pressure import operation_bytes
-from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common import events, tracing
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.translog import write_atomic
 from elasticsearch_tpu.transport.retry import (RetryPolicy, is_retryable,
@@ -1687,6 +1687,8 @@ class ClusterService:
                 for key in targets:
                     retry_q.pop(key, None)
                 absorb(group, node_id)
+                events.emit("shard.failover", severity="warning",
+                            node=node_id, shards=len(targets))
                 logger.info("failover: %d shard(s) retried on [%s]",
                             len(targets), node_id)
 
@@ -2008,6 +2010,9 @@ class ClusterService:
             self._send_to_master(ACTION_SHARD_STARTED, {
                 "index": index, "shard": shard_num,
                 "allocation_id": copy.allocation_id})
+            events.emit("replica.recovered", index=index,
+                        shard=shard_num, source=primary.node_id,
+                        node=self.local_node.name)
             logger.info("[%s] recovered replica %s[%d] from %s",
                         self.local_node.name, index, shard_num,
                         primary.node_id)
@@ -2290,6 +2295,8 @@ class ClusterService:
         commits the shard-failed update (reference: the primary fails
         the shard via the master and only then responds). If the master
         can't be reached the write must not be acked either."""
+        events.emit("replica.failed", severity="error", index=index,
+                    shard=shard, node=copy.node_id, error=str(exc))
         logger.warning("[%s] failing replica %s[%d] on %s: %s",
                        self.local_node.name, index, shard, copy.node_id,
                        exc)
@@ -2340,6 +2347,8 @@ class ClusterService:
     def _handle_shard_failed(self, payload, from_node) -> Dict[str, Any]:
         index, shard = payload["index"], int(payload["shard"])
         aid = payload["allocation_id"]
+        events.emit("shard.failed", severity="error", index=index,
+                    shard=shard, allocation_id=aid)
 
         def update(state: ClusterState) -> ClusterState:
             return AllocationService.shard_failed(state, index, shard, aid)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from elasticsearch_tpu.common import events
 from elasticsearch_tpu.common.errors import CircuitBreakingException
 
 
@@ -44,6 +45,10 @@ class CircuitBreaker:
             new_used = self._used + bytes_wanted
             if bytes_wanted > 0 and new_used * self.overhead > self.limit:
                 self._trips += 1
+                events.emit("breaker.trip", severity="error",
+                            breaker=self.name, label=label,
+                            bytes_wanted=int(bytes_wanted),
+                            used=int(self._used), limit=int(self.limit))
                 raise CircuitBreakingException(
                     f"[{self.name}] data for [{label}] would be [{new_used}/"
                     f"{self.limit}] bytes, which is larger than the limit",
@@ -109,6 +114,9 @@ class HierarchyCircuitBreakerService:
         if total > self.total_limit:
             with self._parent_lock:
                 self._parent_trips += 1
+            events.emit("breaker.trip", severity="error",
+                        breaker="parent", label=label, used=int(total),
+                        limit=int(self.total_limit))
             raise CircuitBreakingException(
                 f"[parent] data for [{label}] would be [{total}/{self.total_limit}]"
                 " bytes, which is larger than the limit",

@@ -1,0 +1,465 @@
+"""Process-wide flight recorder: a causal event journal for the
+serving stack.
+
+Every failure-owning subsystem (watchdog, device health, placement,
+supervisor, breaker, backpressure, tenancy, fronts, translog, pack
+residency) emits typed, monotonically-sequenced structured events —
+``{seq, ts, type, severity, trace_id?, tenant?, attrs}`` — into one
+bounded in-memory ring with best-effort JSONL rotation on disk under
+``<data_path>/flight/``. When a wedge, quarantine, batcher death, or
+pack shed fires, an **incident snapshot** (the last N events plus
+registered stats sources) is captured into a retention-capped incident
+directory so a chaos drill or production wedge leaves a self-contained
+post-mortem artifact.
+
+Design constraints (BM25S discipline — the journal must cost nothing
+when nothing interesting happens):
+
+- ``emit()``/``incident()`` at module level are a single global-read
+  no-op when no recorder is installed (library code never needs a node).
+- Events are emitted from state-transition sites only, never from the
+  per-query hot path.
+- The ring is a plain list under one short-held lock; disk writes are
+  line-buffered appends with byte-based rotation and a file-count cap.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.metrics import CounterMetric, LabeledCounters
+
+logger = logging.getLogger("elasticsearch_tpu.events")
+
+#: incident triggers pre-seeded as zero-valued counter children so the
+#: ``es_tpu_incidents_total`` family renders before any incident fires
+INCIDENT_TRIGGERS = ("wedge", "quarantine", "batcher_death", "pack_shed")
+
+_ID_SAFE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def _jsonable(value: Any, depth: int = 0) -> Any:
+    """Best-effort conversion to JSON-encodable structure (events carry
+    device-id tuples, numpy scalars, exception objects...)."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if depth >= 6:
+        return str(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set, frozenset)):
+        seq = sorted(value, key=str) if isinstance(
+            value, (set, frozenset)) else value
+        return [_jsonable(v, depth + 1) for v in seq]
+    item = getattr(value, "item", None)  # numpy scalars
+    if callable(item):
+        try:
+            return _jsonable(value.item(), depth + 1)
+        except Exception:  # noqa: BLE001 — repr fallback below
+            pass
+    return str(value)
+
+
+class FlightRecorder:
+    """Bounded ring of structured events + on-disk JSONL journal +
+    retention-capped incident snapshots."""
+
+    def __init__(self, dir_path: Optional[str] = None, *,
+                 max_events: int = 4096,
+                 disk_retention: int = 4,
+                 max_file_bytes: int = 4 * 1024 * 1024,
+                 incident_dir: Optional[str] = None,
+                 snapshot_events: int = 256,
+                 incident_retention: int = 16,
+                 incident_debounce_s: float = 5.0,
+                 incident_settle_s: float = 1.0):
+        self.dir_path = dir_path
+        self.max_events = max(16, int(max_events))
+        self.disk_retention = max(1, int(disk_retention))
+        self.max_file_bytes = max(4096, int(max_file_bytes))
+        self.snapshot_events = max(1, int(snapshot_events))
+        self.incident_retention = max(1, int(incident_retention))
+        self.incident_debounce_s = float(incident_debounce_s)
+        # incidents snapshot *after* a settle window so the causal
+        # cascade that follows the trigger (wedge → quarantine →
+        # remesh → failover) lands inside the artifact
+        self.incident_settle_s = float(incident_settle_s)
+        if incident_dir is None and dir_path is not None:
+            incident_dir = os.path.join(dir_path, "incidents")
+        self.incident_dir = incident_dir
+
+        self._lock = threading.Lock()
+        self._ring: List[Dict[str, Any]] = []
+        self._seq = 0
+        self._fh = None
+        self._fh_bytes = 0
+        self._file_index = 0
+
+        self._inc_lock = threading.Lock()
+        self._inc_seq = 0
+        self._last_incident: Dict[str, float] = {}
+        self._pending_incidents: Dict[str, Dict[str, Any]] = {}
+        self._inc_timers: Dict[str, threading.Timer] = {}
+        self._mem_incidents: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
+        self._sources: List[Tuple[str, Callable[[], Any]]] = []
+
+        # ``es_tpu_events_total{type}`` / ``es_tpu_incidents_total{trigger}``
+        self.c_events = LabeledCounters("type")
+        self.c_incidents = LabeledCounters("trigger")
+        for trigger in INCIDENT_TRIGGERS:
+            self.c_incidents.child(trigger)
+        self.c_dropped = CounterMetric()
+
+        if dir_path is not None:
+            try:
+                os.makedirs(dir_path, exist_ok=True)
+                existing = self._journal_files()
+                if existing:
+                    self._file_index = int(
+                        existing[-1].rsplit("-", 1)[1].split(".")[0])
+                self._open_journal()
+            except OSError:
+                logger.exception("flight journal unavailable under %s "
+                                 "(events stay in-memory)", dir_path)
+                self._fh = None
+        if self.incident_dir is not None:
+            try:
+                os.makedirs(self.incident_dir, exist_ok=True)
+            except OSError:
+                logger.exception("incident dir unavailable: %s",
+                                 self.incident_dir)
+                self.incident_dir = None
+
+    # -- journal files --------------------------------------------------
+
+    def _journal_files(self) -> List[str]:
+        try:
+            names = [n for n in os.listdir(self.dir_path)
+                     if n.startswith("events-") and n.endswith(".jsonl")]
+        except OSError:
+            return []
+        return sorted(names)
+
+    def _open_journal(self) -> None:
+        path = os.path.join(self.dir_path,
+                            f"events-{self._file_index:06d}.jsonl")
+        self._fh = open(path, "a", encoding="utf-8")
+        self._fh_bytes = self._fh.tell()
+
+    def _rotate_locked(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        self._file_index += 1
+        self._open_journal()
+        keep = self.disk_retention
+        for name in self._journal_files()[:-keep] if keep else []:
+            try:
+                os.unlink(os.path.join(self.dir_path, name))
+            except OSError:
+                pass
+
+    # -- emission -------------------------------------------------------
+
+    def emit(self, etype: str, severity: str = "info",
+             trace_id: Optional[str] = None, tenant: Optional[str] = None,
+             **attrs: Any) -> int:
+        """Record one event; returns its sequence number. Never raises."""
+        if trace_id is None:
+            trace_id = _current_trace_id()
+        if tenant is None:
+            tenant = _current_tenant()
+        event: Dict[str, Any] = {"seq": 0, "ts": round(time.time(), 6),
+                                 "type": etype, "severity": severity}
+        if trace_id:
+            event["trace_id"] = trace_id
+        if tenant:
+            event["tenant"] = tenant
+        if attrs:
+            event["attrs"] = _jsonable(attrs)
+        try:
+            line = None
+            with self._lock:
+                self._seq += 1
+                event["seq"] = self._seq
+                self._ring.append(event)
+                if len(self._ring) > self.max_events:
+                    del self._ring[:len(self._ring) - self.max_events]
+                if self._fh is not None:
+                    line = json.dumps(event, separators=(",", ":"),
+                                      default=str) + "\n"
+                    try:
+                        self._fh.write(line)
+                        self._fh.flush()
+                        self._fh_bytes += len(line)
+                        if self._fh_bytes >= self.max_file_bytes:
+                            self._rotate_locked()
+                    except OSError:
+                        self.c_dropped.inc()
+        except Exception:  # noqa: BLE001 — the recorder must never fail
+            self.c_dropped.inc()         # its caller (these are failure
+            return 0                     # paths already)
+        self.c_events.inc(etype)
+        return event["seq"]
+
+    # -- queries --------------------------------------------------------
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def ring_len(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def events(self, etype: Optional[str] = None,
+               severity: Optional[str] = None,
+               since_seq: Optional[int] = None,
+               trace_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               limit: int = 256) -> List[Dict[str, Any]]:
+        """Filtered view of the ring, oldest-first, capped to the most
+        recent ``limit`` matches."""
+        with self._lock:
+            snap = list(self._ring)
+        out = []
+        for e in snap:
+            if since_seq is not None and e["seq"] <= since_seq:
+                continue
+            if etype is not None and e["type"] != etype:
+                continue
+            if severity is not None and e["severity"] != severity:
+                continue
+            if trace_id is not None and e.get("trace_id") != trace_id:
+                continue
+            if tenant is not None and e.get("tenant") != tenant:
+                continue
+            out.append(e)
+        if limit and limit > 0:
+            out = out[-int(limit):]
+        return out
+
+    # -- incident snapshots ---------------------------------------------
+
+    def add_snapshot_source(self, name: str,
+                            fn: Callable[[], Any]) -> None:
+        """Register a callable whose (JSON-sanitized) return value is
+        embedded in every incident snapshot under ``sources[name]``."""
+        self._sources.append((name, fn))
+
+    def incident(self, trigger: str, **attrs: Any) -> Optional[str]:
+        """Open an incident: emits an ``incident.open`` event now, then
+        captures the snapshot after the settle window (debounced
+        per-trigger). Returns the incident id, or None when debounced."""
+        now = time.monotonic()
+        with self._inc_lock:
+            last = self._last_incident.get(trigger)
+            if last is not None and now - last < self.incident_debounce_s:
+                return None
+            self._last_incident[trigger] = now
+            self._inc_seq += 1
+            slug = _ID_SAFE.sub("_", trigger) or "incident"
+            inc_id = f"inc-{self._inc_seq:06d}-{slug}"
+            self._pending_incidents[inc_id] = {
+                "id": inc_id, "trigger": trigger, "ts": time.time(),
+                "attrs": _jsonable(attrs)}
+        self.emit("incident.open", severity="error", incident_id=inc_id,
+                  trigger=trigger, **attrs)
+        if self.incident_settle_s > 0:
+            t = threading.Timer(self.incident_settle_s,
+                                self._finalize_incident, args=(inc_id,))
+            t.daemon = True
+            with self._inc_lock:
+                self._inc_timers[inc_id] = t
+            t.start()
+        else:
+            self._finalize_incident(inc_id)
+        return inc_id
+
+    def flush_incidents(self) -> None:
+        """Capture every pending incident snapshot immediately (tests,
+        shutdown); pending settle timers are cancelled."""
+        with self._inc_lock:
+            pending = list(self._pending_incidents)
+        for inc_id in pending:
+            self._finalize_incident(inc_id)
+
+    def _finalize_incident(self, inc_id: str) -> None:
+        with self._inc_lock:
+            meta = self._pending_incidents.pop(inc_id, None)
+            timer = self._inc_timers.pop(inc_id, None)
+        if timer is not None:
+            timer.cancel()  # no-op when this call IS the timer firing
+        if meta is None:
+            return  # already captured (flush raced the timer)
+        try:
+            snapshot = dict(meta)
+            snapshot["events"] = self.events(limit=self.snapshot_events)
+            sources: Dict[str, Any] = {}
+            for name, fn in list(self._sources):
+                try:
+                    sources[name] = _jsonable(fn())
+                except Exception as exc:  # noqa: BLE001 — partial
+                    sources[name] = {"error": str(exc)}  # snapshot > none
+            snapshot["sources"] = sources
+            self._store_incident(inc_id, snapshot)
+            self.c_incidents.inc(meta["trigger"])
+            logger.error("incident snapshot captured: %s (%d events)",
+                         inc_id, len(snapshot["events"]))
+        except Exception:  # noqa: BLE001 — never fail the trigger path
+            self.c_dropped.inc()
+            logger.exception("incident snapshot failed: %s", inc_id)
+
+    def _store_incident(self, inc_id: str,
+                        snapshot: Dict[str, Any]) -> None:
+        if self.incident_dir is None:
+            with self._inc_lock:
+                self._mem_incidents[inc_id] = snapshot
+                while len(self._mem_incidents) > self.incident_retention:
+                    self._mem_incidents.popitem(last=False)
+            return
+        path = os.path.join(self.incident_dir, inc_id + ".json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(snapshot, fh, separators=(",", ":"), default=str)
+        os.replace(tmp, path)
+        names = sorted(n for n in os.listdir(self.incident_dir)
+                       if n.startswith("inc-") and n.endswith(".json"))
+        for name in names[:-self.incident_retention]:
+            try:
+                os.unlink(os.path.join(self.incident_dir, name))
+            except OSError:
+                pass
+
+    def list_incidents(self) -> List[Dict[str, Any]]:
+        """Newest-first incident summaries: id, trigger, ts, events."""
+        out: List[Dict[str, Any]] = []
+        if self.incident_dir is None:
+            with self._inc_lock:
+                snaps = list(self._mem_incidents.values())
+            for snap in snaps:
+                out.append({"id": snap["id"], "trigger": snap["trigger"],
+                            "ts": snap["ts"],
+                            "events": len(snap.get("events", ()))})
+        else:
+            try:
+                names = sorted(n for n in os.listdir(self.incident_dir)
+                               if n.startswith("inc-")
+                               and n.endswith(".json"))
+            except OSError:
+                names = []
+            for name in names:
+                snap = self.get_incident(name[:-len(".json")])
+                if snap is not None:
+                    out.append({"id": snap.get("id", name[:-5]),
+                                "trigger": snap.get("trigger", "?"),
+                                "ts": snap.get("ts", 0.0),
+                                "events": len(snap.get("events", ()))})
+        out.reverse()
+        return out
+
+    def get_incident(self, inc_id: str) -> Optional[Dict[str, Any]]:
+        if _ID_SAFE.search(inc_id):
+            return None  # path-safe ids only
+        if self.incident_dir is None:
+            with self._inc_lock:
+                return self._mem_incidents.get(inc_id)
+        path = os.path.join(self.incident_dir, inc_id + ".json")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            ring = len(self._ring)
+            seq = self._seq
+        return {"last_seq": seq, "ring_events": ring,
+                "max_events": self.max_events,
+                "dropped": self.c_dropped.count,
+                "incidents": self.c_incidents.counts()}
+
+    def close(self) -> None:
+        self.flush_incidents()
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# ---------------------------------------------------------------------------
+# module-level facade: a single global-read no-op when no recorder is
+# installed, so every subsystem can emit unconditionally
+# ---------------------------------------------------------------------------
+
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def set_recorder(recorder: Optional[FlightRecorder]) -> None:
+    global _RECORDER
+    _RECORDER = recorder
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def emit(etype: str, severity: str = "info",
+         trace_id: Optional[str] = None, tenant: Optional[str] = None,
+         **attrs: Any) -> int:
+    rec = _RECORDER
+    if rec is None:
+        return 0
+    return rec.emit(etype, severity=severity, trace_id=trace_id,
+                    tenant=tenant, **attrs)
+
+
+def incident(trigger: str, **attrs: Any) -> Optional[str]:
+    rec = _RECORDER
+    if rec is None:
+        return None
+    return rec.incident(trigger, **attrs)
+
+
+# -- context stamping (deferred imports: tenancy/tracing import this
+#    module, so the facade must load without touching them) -------------
+
+_tracing_mod = None
+_tenancy_mod = None
+
+
+def _current_trace_id() -> Optional[str]:
+    global _tracing_mod
+    if _tracing_mod is None:
+        from elasticsearch_tpu.common import tracing as _tracing_mod_
+        _tracing_mod = _tracing_mod_
+    span = _tracing_mod.current_span()
+    return span.trace_id if span is not None else None
+
+
+def _current_tenant() -> Optional[str]:
+    global _tenancy_mod
+    if _tenancy_mod is None:
+        try:
+            from elasticsearch_tpu.common import tenancy as _tenancy_mod_
+            _tenancy_mod = _tenancy_mod_
+        except Exception:  # noqa: BLE001 — optional subsystem
+            return None
+    tenant = _tenancy_mod.current_tenant()
+    return tenant if tenant != _tenancy_mod.DEFAULT_TENANT else None

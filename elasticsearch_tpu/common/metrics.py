@@ -134,7 +134,8 @@ class SampleRing:
     batch over-counts wall time), so stats report recent-percentile views
     alongside the running totals."""
 
-    __slots__ = ("_buf", "_size", "_next", "_count", "_lock")
+    __slots__ = ("_buf", "_size", "_next", "_count", "_lock",
+                 "_added", "_ex_id", "_ex_value", "_ex_at")
 
     def __init__(self, size: int = 512):
         self._buf = [0.0] * size
@@ -142,13 +143,37 @@ class SampleRing:
         self._next = 0
         self._count = 0
         self._lock = threading.Lock()
+        # exemplar: trace_id of the slowest sample still inside the
+        # retained window — the metrics→trace pivot for /_tpu/stats
+        self._added = 0
+        self._ex_id = None
+        self._ex_value = 0.0
+        self._ex_at = 0
 
-    def add(self, sample: float) -> None:
+    def add(self, sample: float, exemplar: str = None) -> None:
         with self._lock:
             self._buf[self._next] = sample
             self._next = (self._next + 1) % self._size
             if self._count < self._size:
                 self._count += 1
+            self._added += 1
+            if exemplar is not None and (
+                    self._ex_id is None
+                    or sample >= self._ex_value
+                    or self._added - self._ex_at > self._size):
+                self._ex_id = exemplar
+                self._ex_value = sample
+                self._ex_at = self._added
+
+    @property
+    def exemplar_trace_id(self):
+        """trace_id of the slowest recent traced sample (None when no
+        traced sample landed inside the retained window)."""
+        with self._lock:
+            if (self._ex_id is not None
+                    and self._added - self._ex_at > self._size):
+                return None  # aged out of the ring
+            return self._ex_id
 
     def samples(self) -> list:
         with self._lock:
@@ -211,6 +236,9 @@ def stats_to_xcontent(stats: Dict[str, Any]) -> Dict[str, Any]:
             out[k] = v.value
         elif isinstance(v, SampleRing):
             out[k] = {f"p{p:g}": val for p, val in v.percentiles().items()}
+            exemplar = v.exemplar_trace_id
+            if exemplar is not None:
+                out[k]["exemplar_trace_id"] = exemplar
         elif isinstance(v, dict):
             out[k] = stats_to_xcontent(v)
         else:

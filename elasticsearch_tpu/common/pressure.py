@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from elasticsearch_tpu.common import tenancy, tracing
+from elasticsearch_tpu.common import events, tenancy, tracing
 from elasticsearch_tpu.common.errors import (EsRejectedExecutionException,
                                              TenantThrottledException)
 from elasticsearch_tpu.common.metrics import CounterMetric
@@ -177,6 +177,9 @@ class IndexingPressure:
         tracing.add_event("indexing_pressure.reject", stage=stage,
                           operation_bytes=nbytes, current_bytes=current,
                           limit_bytes=limit)
+        events.emit("indexing_pressure.reject", severity="warning",
+                    stage=stage, operation_bytes=nbytes,
+                    current_bytes=current, limit_bytes=limit)
         raise EsRejectedExecutionException(
             f"rejected execution of {stage} operation "
             f"[current_{stage}_bytes={current}, operation_bytes={nbytes}, "
@@ -376,6 +379,9 @@ class SearchBackpressureService:
                 tracing.add_event(
                     "search.backpressure.decline",
                     reason="dominant tenant under duress", tenant=tenant)
+                events.emit("backpressure.decline", severity="warning",
+                            tenant=tenant,
+                            reason="dominant tenant under duress")
                 raise TenantThrottledException(
                     f"declining search for dominant tenant [{tenant}]: "
                     "node is under duress and this tenant holds the "
@@ -385,6 +391,8 @@ class SearchBackpressureService:
             self.declined.inc()
             tracing.add_event("search.backpressure.decline",
                               reason="node under duress")
+            events.emit("backpressure.decline", severity="warning",
+                        reason="expensive search under duress")
             raise EsRejectedExecutionException(
                 "declining expensive search: node is under duress "
                 "(indexing pressure or search queue saturation); "
@@ -418,6 +426,9 @@ class SearchBackpressureService:
             tracing.add_event("search.backpressure.shed",
                               task=t.full_id, action=t.action,
                               age_seconds=round(now - t._start, 3))
+            events.emit("backpressure.shed", severity="warning",
+                        task=t.full_id, action=t.action,
+                        age_seconds=round(now - t._start, 3))
             cancelled += 1
         return cancelled
 

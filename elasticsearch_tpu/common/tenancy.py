@@ -41,7 +41,7 @@ import re
 import threading
 from typing import Callable, Dict, Optional
 
-from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common import events, tracing
 from elasticsearch_tpu.common.errors import (IllegalArgumentException,
                                              TenantThrottledException)
 from elasticsearch_tpu.common.metrics import LabeledCounters
@@ -191,6 +191,9 @@ class TenantQuotaService:
             self.search_rejections.inc(tenant)
             tracing.add_event("tenant.search.reject", tenant=tenant,
                               inflight=inflight, cap=cap)
+            events.emit("tenant.throttle", severity="warning",
+                        tenant=tenant, kind="search",
+                        inflight=inflight, cap=cap)
             raise TenantThrottledException(
                 f"tenant [{tenant}] exceeded its search admission share "
                 f"[inflight={inflight}, cap={cap}, "
@@ -230,6 +233,10 @@ class TenantQuotaService:
             tracing.add_event("tenant.write.reject", tenant=tenant,
                               operation_bytes=nbytes, current_bytes=current,
                               cap_bytes=cap)
+            events.emit("tenant.throttle", severity="warning",
+                        tenant=tenant, kind="write",
+                        operation_bytes=nbytes, current_bytes=current,
+                        cap_bytes=cap)
             raise TenantThrottledException(
                 f"tenant [{tenant}] exceeded its indexing-pressure share "
                 f"[current_bytes={current}, operation_bytes={nbytes}, "

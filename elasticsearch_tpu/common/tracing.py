@@ -252,6 +252,9 @@ class Tracer:
             key=lambda s: -s["duration_ms"])[:8]
         breakdown = ", ".join(f"{s['name']}={s['duration_ms']:.1f}ms"
                               for s in children) or "no child spans"
+        tenant = span.attributes.get("tenant")
+        if tenant:
+            breakdown = f"tenant=[{tenant}] {breakdown}"
         slowlog.warning(
             "slow trace [%s] [%s] took %.1fms (threshold %.0fms): %s",
             span.trace_id, span.name, span.duration_ms,
@@ -259,8 +262,11 @@ class Tracer:
 
     def spans(self, trace_id: Optional[str] = None,
               min_duration_ms: float = 0.0,
-              limit: int = 200) -> List[Dict[str, Any]]:
-        """Finished spans, NEWEST first. limit=0 → no cap."""
+              limit: int = 200,
+              tenant: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Finished spans, NEWEST first. limit=0 → no cap. A tenant
+        filter matches the `tenant` attribute root spans are stamped
+        with (per-tenant slow-query forensics)."""
         with self._lock:
             snap = list(self._spans)
         out = []
@@ -269,6 +275,9 @@ class Tracer:
                 continue
             if min_duration_ms and (span.duration_ms or 0.0) \
                     < min_duration_ms:
+                continue
+            if tenant is not None and \
+                    span.attributes.get("tenant") != tenant:
                 continue
             out.append(span.to_dict())
             if limit and len(out) >= limit:

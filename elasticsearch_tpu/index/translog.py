@@ -29,6 +29,7 @@ import threading
 import zlib
 from typing import Callable, Dict, Iterator, List, Optional
 
+from elasticsearch_tpu.common import events
 from elasticsearch_tpu.common.errors import (TranslogCorruptedException,
                                              TranslogDurabilityException)
 
@@ -178,6 +179,8 @@ class Translog:
                 else:
                     self._unsynced += 1
             except OSError as e:
+                events.emit("translog.write_fault", severity="error",
+                            op="append", path=self.path, error=str(e))
                 raise TranslogDurabilityException(
                     f"translog append failed ({e}): durability cannot be "
                     f"honored, operation not acknowledged") from e
@@ -210,6 +213,9 @@ class Translog:
                 else:
                     self._unsynced += len(ops)
             except OSError as e:
+                events.emit("translog.write_fault", severity="error",
+                            op="batch_append", path=self.path,
+                            error=str(e))
                 raise TranslogDurabilityException(
                     f"translog batch append failed ({e}): durability "
                     f"cannot be honored, bulk not acknowledged") from e
@@ -224,6 +230,8 @@ class Translog:
                 self._write_checkpoint(self.checkpoint)
                 self._unsynced = 0
             except OSError as e:
+                events.emit("translog.write_fault", severity="error",
+                            op="sync", path=self.path, error=str(e))
                 raise TranslogDurabilityException(
                     f"translog sync failed ({e}): durability cannot be "
                     f"honored") from e

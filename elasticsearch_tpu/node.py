@@ -243,6 +243,27 @@ class Node:
             self.profiler.sampler.timeline_source = \
                 lambda: self.tpu_search.batcher.queue_depths()
         self.profiler.start()
+        # flight recorder: process-wide causal event journal + incident
+        # snapshots (ISSUE 18). Installed as the module-level recorder so
+        # every subsystem's events.emit() lands here; off ⇒ near-free.
+        from elasticsearch_tpu.common import events as _events
+        self.flight_recorder = None
+        if self.settings.get_bool("search.flight_recorder.enabled", True):
+            self.flight_recorder = _events.FlightRecorder(
+                _os.path.join(data_path, "flight"),
+                max_events=self.settings.get_int(
+                    "search.flight_recorder.max_events", 4096),
+                disk_retention=self.settings.get_int(
+                    "search.flight_recorder.disk_retention", 4),
+                incident_dir=self.settings.get(
+                    "search.flight_recorder.incident_dir",
+                    _os.path.join(data_path, "flight", "incidents")),
+                snapshot_events=self.settings.get_int(
+                    "search.flight_recorder.snapshot_events", 256))
+            _events.set_recorder(self.flight_recorder)
+            self._wire_snapshot_sources()
+            _events.emit("node.start", node=node_name,
+                         node_id=self.node_id)
         # the multi-process serving front (started explicitly via
         # start_serving_fronts(); None ⇒ single-process serving)
         self.serving_front = None
@@ -257,6 +278,33 @@ class Node:
         self._refresher: Optional[threading.Timer] = None
         self._syncer: Optional[threading.Timer] = None
         self._closed = False
+
+    def _wire_snapshot_sources(self) -> None:
+        """Attach bounded context captures to the flight recorder:
+        incident snapshots embed serving stats, degraded-mesh info and
+        (when the sampler is live) the hottest folded stacks."""
+        rec = self.flight_recorder
+
+        def _tpu_stats():
+            if self.tpu_search is None:
+                return None
+            return self.tpu_search.stats()
+
+        def _degraded():
+            if self.tpu_search is None:
+                return None
+            return self.tpu_search.degraded_info()
+
+        def _stacks():
+            s = self.profiler.sampler
+            if not s.running:
+                return None
+            return [{"stack": stack, "count": count}
+                    for stack, count in s.folded(top=15)]
+
+        rec.add_snapshot_source("tpu_stats", _tpu_stats)
+        rec.add_snapshot_source("degraded_info", _degraded)
+        rec.add_snapshot_source("profile_stacks", _stacks)
 
     def _ingest_state_path(self) -> str:
         import os
@@ -739,6 +787,24 @@ class Node:
                    1 if dev.info()["active"] else 0, "gauge")
 
         reg.add_collector(_profiler)
+        reg.set_help("events",
+                     "Flight-recorder events emitted, by event type")
+        reg.set_help("incidents",
+                     "Incident snapshots captured, by trigger")
+        reg.set_help("events.dropped",
+                     "Flight-recorder events lost to emit failures")
+
+        def _events():
+            rec = self.flight_recorder
+            if rec is None:
+                return
+            for labels, metric in rec.c_events.items():
+                yield ("events", labels, metric, "counter")
+            for labels, metric in rec.c_incidents.items():
+                yield ("incidents", labels, metric, "counter")
+            yield ("events.dropped", {}, rec.c_dropped, "counter")
+            yield ("events.ring_size", {}, rec.ring_len(), "gauge")
+        reg.add_collector(_events)
         reg.set_help("serving.fronts",
                      "Serving front processes currently alive")
         reg.set_help("serving.plan_memo.hits",
@@ -863,6 +929,11 @@ class Node:
             self.profiler.close()
         if self.tpu_search is not None:
             self.tpu_search.close()
+        if self.flight_recorder is not None:
+            from elasticsearch_tpu.common import events as _events
+            if _events.get_recorder() is self.flight_recorder:
+                _events.set_recorder(None)
+            self.flight_recorder.close()
         ccs_client = getattr(self, "_ccs_transport", None)
         if ccs_client is not None:
             ccs_client.close()

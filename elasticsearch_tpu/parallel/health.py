@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+from elasticsearch_tpu.common import events
 from elasticsearch_tpu.common.metrics import CounterMetric, LabeledCounters
 
 logger = logging.getLogger("elasticsearch_tpu.parallel.health")
@@ -169,6 +170,8 @@ class DeviceHealthRegistry:
             ok = self._real_probe(device_id)
         if not ok:
             self.c_probe_failures.inc()
+            events.emit("device.probe_failed", severity="warning",
+                        device=int(device_id))
         return ok
 
     def _real_probe(self, device_id: int) -> bool:
@@ -204,6 +207,11 @@ class DeviceHealthRegistry:
             self._healthy_streak[device_id] = 0
             self._wedge_score[device_id] = 0
         self.c_quarantines.inc()
+        events.emit("device.quarantine", severity="error",
+                    device=int(device_id), reason=reason,
+                    active=self.active_ids())
+        events.incident("quarantine", device=int(device_id),
+                        reason=reason)
         logger.error("device %s QUARANTINED (%s); serving continues on "
                      "%d survivor(s)", device_id, reason,
                      len(self.active_ids()))
@@ -257,6 +265,9 @@ class DeviceHealthRegistry:
             self._healthy_streak.pop(device_id, None)
             self._quarantined_at.pop(device_id, None)
         self.c_reintroductions.inc()
+        events.emit("device.reintroduce", severity="warning",
+                    device=int(device_id),
+                    healthy_probes=self.reintroduce_after)
         logger.warning("device %s reintroduced after %d consecutive "
                        "healthy probe(s)", device_id,
                        self.reintroduce_after)

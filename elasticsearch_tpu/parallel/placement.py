@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from elasticsearch_tpu.common import events
 from elasticsearch_tpu.common.errors import CircuitBreakingException
 from elasticsearch_tpu.common.metrics import CounterMetric
 from elasticsearch_tpu.parallel.mesh import make_mesh
@@ -223,6 +224,10 @@ class PlacementService:
                 "placement group %d lost device %d; %d/%d member(s) "
                 "remain", gid, device_id, len(g.active_ids),
                 len(g.device_ids))
+            events.emit("placement.device_lost", severity="error",
+                        group=gid, device=int(device_id),
+                        active=list(g.active_ids),
+                        members=list(g.device_ids))
             return gid
 
     def on_device_restored(self, device_id: int) -> Optional[int]:
@@ -244,6 +249,10 @@ class PlacementService:
                 "placement group %d readmitted device %d; %d/%d "
                 "member(s) active", gid, device_id, len(g.active_ids),
                 len(g.device_ids))
+            events.emit("placement.device_restored", severity="warning",
+                        group=gid, device=int(device_id),
+                        active=list(g.active_ids),
+                        members=list(g.device_ids))
             return gid
 
     # -- the placement table -------------------------------------------
@@ -345,6 +354,9 @@ class PlacementService:
 
     def record_drain(self, gid: int, breaker_bytes: int) -> None:
         self.drain_audit.append((int(gid), int(breaker_bytes)))
+        events.emit("hbm.drain",
+                    severity="info" if breaker_bytes == 0 else "error",
+                    group=int(gid), bytes=int(breaker_bytes))
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

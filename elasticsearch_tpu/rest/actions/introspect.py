@@ -14,7 +14,8 @@ from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import (DocumentMissingException,
                                              IllegalArgumentException,
-                                             IndexNotFoundException)
+                                             IndexNotFoundException,
+                                             ResourceNotFoundException)
 from elasticsearch_tpu.rest.controller import RestController, RestRequest
 
 # field types that aggregate via doc-values columns
@@ -375,13 +376,18 @@ def register(controller: RestController, node) -> None:
         if tracer is None:
             return 200, {"sample_rate": 0.0, "total": 0, "spans": []}
         trace_id = req.params.get("trace_id")
+        tenant = req.params.get("tenant") or None
         min_ms = float(req.params.get("min_duration_ms", 0) or 0)
         limit = int(req.params.get("limit", 200) or 200)
         if trace_id:
             spans = [s for s in tracer.trace(trace_id)
-                     if (s["duration_ms"] or 0) >= min_ms]
+                     if (s["duration_ms"] or 0) >= min_ms
+                     and (tenant is None
+                          or s.get("attributes", {}).get("tenant")
+                          == tenant)]
         else:
-            spans = tracer.spans(min_duration_ms=min_ms, limit=limit)
+            spans = tracer.spans(min_duration_ms=min_ms, limit=limit,
+                                 tenant=tenant)
         return 200, {"sample_rate": tracer.sample_rate,
                      "slow_threshold_ms": tracer.slow_threshold_ms,
                      "total": len(spans), "spans": spans}
@@ -453,6 +459,44 @@ def register(controller: RestController, node) -> None:
         out = node.profiler.device.stop()
         return (200 if out.get("stopped") else 409), out
 
+    def do_tpu_events(req: RestRequest):
+        # the flight-recorder query surface: filtered view of the
+        # bounded event ring (oldest-first; causal order by seq)
+        from elasticsearch_tpu.common import events as ev
+        rec = ev.get_recorder()
+        if rec is None:
+            return 200, {"enabled": False, "events": []}
+        since = req.params.get("since_seq")
+        out = rec.events(
+            etype=req.params.get("type") or None,
+            severity=req.params.get("severity") or None,
+            since_seq=int(since) if since else None,
+            trace_id=req.params.get("trace_id") or None,
+            tenant=req.params.get("tenant") or None,
+            limit=int(req.params.get("limit", 256) or 256))
+        return 200, {"enabled": True, "last_seq": rec.last_seq,
+                     "dropped": rec.c_dropped.count,
+                     "total": len(out), "events": out}
+
+    def do_tpu_incidents(req: RestRequest):
+        from elasticsearch_tpu.common import events as ev
+        rec = ev.get_recorder()
+        if rec is None:
+            return 200, {"enabled": False, "incidents": []}
+        incidents = rec.list_incidents()
+        return 200, {"enabled": True, "total": len(incidents),
+                     "incidents": incidents}
+
+    def do_tpu_incident_get(req: RestRequest):
+        from elasticsearch_tpu.common import events as ev
+        rec = ev.get_recorder()
+        inc_id = req.param("incident_id")
+        snap = rec.get_incident(inc_id) if rec is not None else None
+        if snap is None:
+            raise ResourceNotFoundException(
+                f"no such incident [{inc_id}]")
+        return 200, snap
+
     def do_prometheus(req: RestRequest):
         # text exposition (str payload → text/plain at the HTTP layer);
         # the overload-protection families
@@ -484,6 +528,10 @@ def register(controller: RestController, node) -> None:
                         do_alloc_explain)
     controller.register("GET", "/_tpu/stats", do_tpu_stats)
     controller.register("GET", "/_tpu/traces", do_tpu_traces)
+    controller.register("GET", "/_tpu/events", do_tpu_events)
+    controller.register("GET", "/_tpu/incidents", do_tpu_incidents)
+    controller.register("GET", "/_tpu/incidents/{incident_id}",
+                        do_tpu_incident_get)
     controller.register("GET", "/_tpu/profile/flamegraph",
                         do_profile_flamegraph)
     controller.register("GET", "/_tpu/profile/timeline",

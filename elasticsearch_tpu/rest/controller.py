@@ -244,6 +244,11 @@ class RestController:
                 root=True)
             if not span.is_recording:
                 span = None
+            elif tenant != _tenancy.DEFAULT_TENANT:
+                # tenant-stamped root spans make /_tpu/traces?tenant=
+                # and the slowlog attribution work; the default tenant
+                # stays unstamped so single-tenant traces are unchanged
+                span.set_attribute("tenant", tenant)
         # profiler thread tags: the sampling profiler can't read this
         # thread's locals, so publish (pool, trace_id) to its shared
         # ident map. `active()` is a single set-emptiness check — the

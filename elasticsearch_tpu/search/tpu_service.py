@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from elasticsearch_tpu.common import profiler, tenancy, tracing
+from elasticsearch_tpu.common import events, profiler, tenancy, tracing
 from elasticsearch_tpu.common.metrics import CounterMetric, LabeledCounters
 from elasticsearch_tpu.mapping.types import TextFieldType
 from elasticsearch_tpu.ops import sparse
@@ -83,7 +83,11 @@ class StageTimes:
             ring = self._rings.get(stage)
             if ring is None:
                 ring = self._rings[stage] = self._ring_cls(self.RING_SIZE)
-        ring.add(dt / n if n > 1 else dt)
+        # stage exemplar: the ring remembers the trace_id of its slowest
+        # recent traced sample (the metrics→trace pivot in /_tpu/stats)
+        span = tracing.current_span()
+        ring.add(dt / n if n > 1 else dt,
+                 exemplar=span.trace_id if span is not None else None)
         # the same dt the stats ring keeps also lands on the active trace
         # (no-op — one thread-local read — when the request isn't traced)
         tracing.record_stage("tpu." + stage, dt, n=n)
@@ -103,6 +107,11 @@ class StageTimes:
                 out[s]["p50_ms"] = round(pcts[50.0] * 1000.0, 3)
                 out[s]["p95_ms"] = round(pcts[95.0] * 1000.0, 3)
                 out[s]["p99_ms"] = round(pcts[99.0] * 1000.0, 3)
+            # metrics→trace pivot: the slowest recent traced sample's
+            # trace_id (key absent when nothing traced is in-window)
+            exemplar = ring.exemplar_trace_id
+            if exemplar is not None:
+                out[s]["exemplar_trace_id"] = exemplar
         return out
 
     def metrics_view(self) -> List[Tuple[str, float, int, Any]]:
@@ -270,11 +279,18 @@ class PlanCache:
             for k in stale:
                 del self._entries[k]
             self.invalidations += len(stale)
+        if stale:
+            events.emit("plan_cache.invalidate", index=index_name,
+                        entries=len(stale))
 
     def clear(self) -> None:
         with self._lock:
-            self.invalidations += len(self._entries)
+            dropped = len(self._entries)
+            self.invalidations += dropped
             self._entries.clear()
+        if dropped:
+            events.emit("plan_cache.invalidate", entries=dropped,
+                        reason="clear")
 
     def __len__(self) -> int:
         with self._lock:
@@ -484,6 +500,12 @@ class IndexPackCache:
                         self._breaker.release(old.hbm_bytes)
                     self._cache[key] = entry
                     self._last_bytes[key] = int(entry.hbm_bytes)
+            if entry is not None:
+                events.emit("pack.build", index=key[0], field=key[1],
+                            hbm_bytes=int(entry.hbm_bytes),
+                            compressed=entry.compressed,
+                            rebuild=old is not None,
+                            group=self.group_id)
             if old is not None and self.on_evict is not None:
                 self.on_evict(old)
             return entry
@@ -630,6 +652,11 @@ class IndexPackCache:
             for key in [k for k in self._heat if k[0] == index_name]:
                 self._heat.pop(key, None)
                 self._last_bytes.pop(key, None)
+        if evicted:
+            events.emit("pack.evict", index=index_name,
+                        packs=len(evicted),
+                        hbm_bytes=sum(int(e.hbm_bytes) for e in evicted),
+                        group=self.group_id)
         if self.on_evict is not None:
             for entry in evicted:
                 self.on_evict(entry)
@@ -1775,7 +1802,13 @@ class LaunchWatchdog:
                 wedge = {"label": e["label"],
                          "age_ms": round(age_ms, 1),
                          "devices": list(e.get("devices", ())),
-                         "queries": len(e["pendings"])}
+                         "queries": len(e["pendings"]),
+                         # launch attribution: trace ids of the traced
+                         # requests riding the wedged dispatch
+                         "trace_ids": [p.trace_span.trace_id
+                                       for p in e["pendings"]
+                                       if getattr(p, "trace_span", None)
+                                       is not None]}
                 self.last_wedge = wedge
                 exc = DeviceWedgedError(
                     f"device dispatch ({e['label']}) exceeded its "
@@ -1857,6 +1890,9 @@ class BatcherSupervisor:
             self.state = "down"
         logger.error("batcher supervision tripped (%s): serving degraded "
                      "planner results while recovering", reason)
+        events.emit("supervisor.state", severity="error",
+                    from_state="serving", to_state="down", reason=reason)
+        events.incident("batcher_death", reason=reason)
         self._tear_down(reason)
         self.maybe_recover()
 
@@ -1880,6 +1916,11 @@ class BatcherSupervisor:
             # assert the invariant held across every remesh
             self.teardown_breaker_bytes.append(
                 int(getattr(breaker, "used", 0)))
+            events.emit("hbm.drain",
+                        severity=("info" if self.teardown_breaker_bytes[-1]
+                                  == 0 else "error"),
+                        bytes=self.teardown_breaker_bytes[-1],
+                        packs_dropped=len(dropped), reason=reason)
         if svc.placement is not None:
             # full teardown under placement drains every group cache
             # too, with the SAME exact-zero audit per group
@@ -1902,6 +1943,8 @@ class BatcherSupervisor:
             t = threading.Thread(target=self._recover, daemon=True,
                                  name="batcher-recovery")
             self._recover_thread = t
+        events.emit("supervisor.state", severity="warning",
+                    from_state="down", to_state="recovering")
         t.start()
 
     def _recover(self) -> None:
@@ -1923,6 +1966,9 @@ class BatcherSupervisor:
             if active is not None and not active:
                 with self._lock:
                     self.state = "down"
+                events.emit("supervisor.state", severity="error",
+                            from_state="recovering", to_state="down",
+                            reason="every device quarantined")
                 logger.error("every device is quarantined; staying on "
                              "degraded planner serving")
                 return
@@ -1934,6 +1980,10 @@ class BatcherSupervisor:
                 mesh_ids = tuple(int(d.id) for d in active)
             remeshed = tuple(sorted(mesh_ids)) != tuple(
                 sorted(self._mesh_ids))
+            if remeshed:
+                events.emit("remesh.begin", severity="warning",
+                            from_devices=sorted(self._mesh_ids),
+                            to_devices=sorted(mesh_ids))
             # anything rebuilt since teardown (a racing prewarm) was
             # placed on the OLD mesh — drop it and fold its keys in so
             # set_mesh sees an empty cache and re-residency covers it
@@ -2010,7 +2060,16 @@ class BatcherSupervisor:
                     self.last_remesh_duration_s = self.last_duration_s
             if remeshed:
                 self.c_remeshes.inc()
+                events.emit("remesh.end", severity="warning",
+                            devices=sorted(mesh_ids),
+                            devices_total=len(full_ids) or len(mesh_ids),
+                            duration_s=round(self.last_duration_s, 4))
             self.c_recoveries.inc()
+            events.emit("supervisor.state", from_state="recovering",
+                        to_state="serving",
+                        duration_s=round(self.last_duration_s, 4),
+                        devices=len(mesh_ids), rebuilt=rebuilt,
+                        shed=len(shed))
             svc._tripped = False
             logger.warning("batcher recovered in %.2fs on %d/%d device(s) "
                            "(%d/%d packs re-resident, %d shed)",
@@ -2026,6 +2085,9 @@ class BatcherSupervisor:
         except Exception:  # noqa: BLE001 — stay degraded, stay alive
             with self._lock:
                 self.state = "down"
+            events.emit("supervisor.state", severity="error",
+                        from_state="recovering", to_state="down",
+                        reason="recovery failed")
             logger.exception("batcher recovery failed; staying degraded")
 
     def _recover_placement(self, t0: float) -> None:
@@ -2085,7 +2147,16 @@ class BatcherSupervisor:
                 self.last_remesh_duration_s = self.last_duration_s
         if remeshed:
             self.c_remeshes.inc()
+            events.emit("remesh.end", severity="warning",
+                        devices=sorted(mesh_ids),
+                        devices_total=self.full_device_count,
+                        duration_s=round(self.last_duration_s, 4),
+                        placement_groups=pl.num_groups)
         self.c_recoveries.inc()
+        events.emit("supervisor.state", from_state="recovering",
+                    to_state="serving",
+                    duration_s=round(self.last_duration_s, 4),
+                    devices=len(mesh_ids))
         svc._tripped = False
         logger.warning("batcher recovered in %.2fs over %d placement "
                        "group(s), %d/%d device(s)", self.last_duration_s,
@@ -2267,6 +2338,13 @@ class TpuSearchService:
         age_ms = float(wedge.get("age_ms", 0.0))
         self.last_error = (f"device_wedged: {label} overdue "
                            f"after {age_ms:.0f}ms")
+        events.emit("watchdog.wedge", severity="error", label=label,
+                    age_ms=age_ms, devices=wedge.get("devices", ()),
+                    queries=wedge.get("queries", 0),
+                    trace_ids=wedge.get("trace_ids", ()))
+        events.incident("wedge", label=label, age_ms=age_ms,
+                        devices=wedge.get("devices", ()),
+                        trace_ids=wedge.get("trace_ids", ()))
         if self.health is not None:
             try:
                 self.health.record_wedge(wedge.get("devices", ()),
@@ -2354,6 +2432,11 @@ class TpuSearchService:
             logger.error("HBM headroom on the partial mesh cannot hold "
                          "%d pack(s): %s shed (503 + Retry-After %.0fs)",
                          len(keys), sorted(keys), retry)
+            events.emit("pack.shed", severity="error",
+                        keys=sorted(keys), retry_after_s=retry,
+                        reason="partial_mesh_headroom")
+            events.incident("pack_shed", keys=sorted(keys),
+                            reason="partial_mesh_headroom")
 
     def shed_keys(self) -> List[Tuple[str, str]]:
         with self._shed_lock:
@@ -2382,6 +2465,12 @@ class TpuSearchService:
             logger.error("no placement group can hold %d pack(s): %s "
                          "shed (503 + Retry-After %.0fs)",
                          len(keys), sorted(tuple(k) for k in keys), retry)
+            events.emit("pack.shed", severity="error",
+                        keys=sorted(tuple(k) for k in keys),
+                        retry_after_s=retry, reason="no_replica_group")
+            events.incident("pack_shed",
+                            keys=sorted(tuple(k) for k in keys),
+                            reason="no_replica_group")
 
     def remove_shed(self, key: Tuple[str, str]) -> None:
         with self._shed_lock:
@@ -2513,6 +2602,10 @@ class TpuSearchService:
                     shed.append(key)
         if shed:
             self.add_shed(shed)
+        events.emit("placement.failover", severity="error", group=gid,
+                    device=int(device_id), reason=reason,
+                    failed_over=[k for k, _g in failed_over],
+                    replaced=len(orphans) - len(shed), shed=len(shed))
         logger.error("placement failover for group %d (%s): %d pack(s) "
                      "failed over, %d re-placed, %d shed",
                      gid, reason, len(failed_over),
@@ -2602,6 +2695,10 @@ class TpuSearchService:
             for key in pl.keys():
                 if gid in pl.groups_of(key) and cache.peek(key) is None:
                     self._eager_rebuild(key, gid)
+        events.emit("placement.restore", severity="warning", group=gid,
+                    device=int(device_id),
+                    devices_active=pl.devices_active(),
+                    devices_total=pl.devices_total())
         logger.warning("placement group %d restored after device %d "
                        "readmission (%d/%d devices active)", gid,
                        device_id, pl.devices_active(),

@@ -39,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from elasticsearch_tpu.common import events
 from elasticsearch_tpu.serving.shm import SlotArena, StatsBlock
 
 logger = logging.getLogger("elasticsearch_tpu.serving")
@@ -727,6 +728,8 @@ class FrontSupervisor:
         h.inflight.clear()
         self.c_slots_reclaimed.inc(reclaimed)
         self.c_front_deaths.inc()
+        events.emit("front.exit", severity="error", role=h.role,
+                    slots_reclaimed=reclaimed)
         logger.warning("serving front %s exited; reclaimed %d in-flight "
                        "slot(s)", h.role, reclaimed)
         try:
@@ -748,6 +751,7 @@ class FrontSupervisor:
         try:
             self._spawn(h)
             self.c_respawns.inc()
+            events.emit("front.respawn", severity="warning", role=h.role)
         except Exception:  # noqa: BLE001 — the watch loop retries
             logger.exception("respawn of front-%d failed", index)
 
@@ -805,6 +809,9 @@ class FrontSupervisor:
                     logger.warning("serving front %s wedged (last "
                                    "heartbeat %.1fs ago); killing it",
                                    h.role, now - ts)
+                    events.emit("front.wedged", severity="error",
+                                role=h.role,
+                                stale_s=round(now - ts, 2))
                     h.proc.kill()
 
     # -- observability ------------------------------------------------

@@ -18,6 +18,8 @@ import time
 import numpy as np
 import pytest
 
+from elasticsearch_tpu.common import events as events_mod
+from elasticsearch_tpu.common import tracing
 from elasticsearch_tpu.common.breaker import CircuitBreaker
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.tpu_service import TpuSearchService
@@ -35,6 +37,43 @@ def _wait(predicate, timeout=30.0, interval=0.02):
             return True
         time.sleep(interval)
     return predicate()
+
+
+def _first_seq(evs, etype):
+    for e in evs:
+        if e["type"] == etype:
+            return e["seq"]
+    return None
+
+
+def _assert_causal_chain(rec, since_seq, chain):
+    """The flight recorder captured the drill's causal chain — every
+    event type in `chain` present after `since_seq`, first occurrences
+    in causal (seq) order — both in the live ring and inside the
+    wedge-triggered incident snapshot."""
+    rec.flush_incidents()
+    evs = rec.events(since_seq=since_seq, limit=0)
+    seqs = [_first_seq(evs, t) for t in chain]
+    assert all(s is not None for s in seqs), \
+        f"missing chain events {chain}: got {sorted({e['type'] for e in evs})}"
+    assert seqs == sorted(seqs), \
+        f"chain out of causal order: {list(zip(chain, seqs))}"
+    # launch attribution: the wedge event names the traces it parked
+    wedge = next(e for e in evs if e["type"] == "watchdog.wedge")
+    assert wedge.get("attrs", {}).get("trace_ids"), \
+        "wedge event carries no launch trace attribution"
+    # the incident snapshot is a self-contained post-mortem: the same
+    # ordered chain rides inside it
+    incs = [i for i in rec.list_incidents() if i["trigger"] == "wedge"]
+    assert incs, "no wedge-triggered incident snapshot captured"
+    snap = rec.get_incident(incs[0]["id"])
+    assert snap is not None and snap["trigger"] == "wedge"
+    inside = [e for e in snap["events"] if e["seq"] > since_seq]
+    in_seqs = [_first_seq(inside, t) for t in chain]
+    assert all(s is not None for s in in_seqs), \
+        f"incident snapshot missing chain events: {chain}"
+    assert in_seqs == sorted(in_seqs)
+    assert "sources" in snap
 
 
 def _loss_service(breaker, idx, name):
@@ -90,12 +129,21 @@ def _run_device_loss_chaos(svc, seeded_np, *, name, cycles,  # noqa: F811
     idx = make_corpus(svc, seeded_np, name=name, docs=60)
     breaker = CircuitBreaker("hbm", 1 << 30)
     tpu = _loss_service(breaker, idx, name)
+    # flight recorder on for the whole drill (memory-only; snapshots
+    # flushed explicitly so the full cascade lands inside the artifact)
+    rec = events_mod.FlightRecorder(incident_debounce_s=0.0,
+                                    incident_settle_s=600.0)
+    events_mod.set_recorder(rec)
+    # always-on tracer: reader queries run under root spans so wedge
+    # events are launch-attributed (trace_ids)
+    tracer = tracing.Tracer(sample_rate=1.0, max_spans=512)
     try:
         q = dsl.MatchQuery(field="body", query="alpha beta")
         assert tpu.try_search(idx, q, k=10) is not None  # warm full mesh
         full = tpu.supervisor.full_device_count
         assert full == 8
         _prime_partial_mesh(tpu, idx, q)  # warm the 1×7 signature too
+        chaos_seq0 = rec.last_seq  # the priming cycle's events end here
         prior_quarantines = tpu.health.c_quarantines.count
         prior_reintroductions = tpu.health.c_reintroductions.count
         # post-warm: tightened wedge detection. The deadline must stay
@@ -126,12 +174,16 @@ def _run_device_loss_chaos(svc, seeded_np, *, name, cycles,  # noqa: F811
         def reader():
             while not stop.is_set():
                 t0 = time.monotonic()
+                span = tracer.start_span("chaos-read", root=True)
                 try:
                     # None is fine (degraded/declined → planner would
                     # serve); an exception or a hang is not
-                    tpu.try_search(idx, q, k=10)
+                    with tracing.use_span(span):
+                        tpu.try_search(idx, q, k=10)
                 except Exception as e:  # noqa: BLE001 — surfaced below
                     errors.append(("read", e))
+                finally:
+                    span.end()
                 latencies.append(time.monotonic() - t0)
                 time.sleep(0.002)
 
@@ -214,6 +266,15 @@ def _run_device_loss_chaos(svc, seeded_np, *, name, cycles,  # noqa: F811
             prior_reintroductions + cycles
         assert tpu.health.c_probes.count >= tpu.health.c_probe_failures.count
 
+        # the flight recorder journaled the drill causally: wedge →
+        # quarantine → remesh, in seq order, with trace attribution on
+        # the wedge, and a self-contained incident snapshot (ISSUE 18)
+        _assert_causal_chain(rec, chaos_seq0,
+                             ("watchdog.wedge", "device.quarantine",
+                              "remesh.end"))
+        assert _first_seq(rec.events(since_seq=chaos_seq0, limit=0),
+                          "device.reintroduce") is not None
+
         # bounded p99: wedged queries fail typed at the watchdog
         # deadline, declined queries answer instantly
         assert latencies
@@ -228,6 +289,7 @@ def _run_device_loss_chaos(svc, seeded_np, *, name, cycles,  # noqa: F811
         assert breaker.used > 0
         return {"reads": len(latencies), "writes": len(acked), "p99": p99}
     finally:
+        events_mod.set_recorder(None)
         tpu.close()
 
 

@@ -19,6 +19,8 @@ import time
 import numpy as np
 import pytest
 
+from elasticsearch_tpu.common import events as events_mod
+from elasticsearch_tpu.common import tracing
 from elasticsearch_tpu.common.breaker import CircuitBreaker
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.tpu_service import TpuSearchService
@@ -278,12 +280,19 @@ def _run_placement_chaos(svc, seeded_np, *, name, readers=2,  # noqa: F811
     idx = make_corpus(svc, seeded_np, name=name, docs=60)
     breaker = CircuitBreaker("hbm", 1 << 30)
     tpu = _placement_service(breaker, idx, name)
+    # flight recorder on for the drill (memory-only; snapshots flushed
+    # explicitly so the whole cascade lands inside the artifact)
+    rec = events_mod.FlightRecorder(incident_debounce_s=0.0,
+                                    incident_settle_s=600.0)
+    events_mod.set_recorder(rec)
+    tracer = tracing.Tracer(sample_rate=1.0, max_spans=512)
     try:
         q = dsl.MatchQuery(field="body", query="alpha beta")
         assert tpu.try_search(idx, q, k=10) is not None  # warm both groups
         pl = tpu.placement
         key = (name, "body")
         assert set(pl.groups_of(key)) == {0, 1}
+        chaos_seq0 = rec.last_seq
         # post-warm: tightened wedge detection, ABOVE a healthy hot
         # launch (~4s on a loaded CPU host) so only a parked dispatch
         # trips it
@@ -311,12 +320,16 @@ def _run_placement_chaos(svc, seeded_np, *, name, readers=2,  # noqa: F811
         def reader():
             while not stop.is_set():
                 t0 = time.monotonic()
+                span = tracer.start_span("chaos-read", root=True)
                 try:
                     # None is fine (declined → planner would serve); an
                     # exception or a hang is not
-                    tpu.try_search(idx, q, k=10)
+                    with tracing.use_span(span):
+                        tpu.try_search(idx, q, k=10)
                 except Exception as e:  # noqa: BLE001 — surfaced below
                     errors.append(("read", e))
+                finally:
+                    span.end()
                 latencies.append(time.monotonic() - t0)
                 time.sleep(0.002)
 
@@ -393,6 +406,39 @@ def _run_placement_chaos(svc, seeded_np, *, name, readers=2,  # noqa: F811
         assert all(b == 0 for _g, b in pl.drain_audit), \
             f"group breaker not exactly zero: {pl.drain_audit}"
 
+        # the flight recorder journaled the drill causally: wedge →
+        # quarantine → group failover, in seq order, and the wedge's
+        # incident snapshot holds the same ordered chain (ISSUE 18)
+        rec.flush_incidents()
+        chain = ("watchdog.wedge", "device.quarantine",
+                 "placement.failover")
+        evs = rec.events(since_seq=chaos_seq0, limit=0)
+
+        def first_seq(events, etype):
+            for e in events:
+                if e["type"] == etype:
+                    return e["seq"]
+            return None
+
+        seqs = [first_seq(evs, t) for t in chain]
+        assert all(s is not None for s in seqs), \
+            f"missing {chain}: got {sorted({e['type'] for e in evs})}"
+        assert seqs == sorted(seqs), \
+            f"chain out of causal order: {list(zip(chain, seqs))}"
+        wedge_ev = next(e for e in evs if e["type"] == "watchdog.wedge")
+        assert wedge_ev.get("attrs", {}).get("trace_ids"), \
+            "wedge event carries no launch trace attribution"
+        # the group restore after reintroduction journaled too
+        assert first_seq(evs, "placement.restore") is not None
+        incs = [i for i in rec.list_incidents()
+                if i["trigger"] == "wedge"]
+        assert incs, "no wedge-triggered incident snapshot captured"
+        snap = rec.get_incident(incs[0]["id"])
+        inside = [e for e in snap["events"] if e["seq"] > chaos_seq0]
+        in_seqs = [first_seq(inside, t) for t in chain]
+        assert all(s is not None for s in in_seqs)
+        assert in_seqs == sorted(in_seqs)
+
         # bounded p99: wedged queries fail typed at the watchdog
         # deadline, declined queries answer instantly
         assert latencies
@@ -409,6 +455,7 @@ def _run_placement_chaos(svc, seeded_np, *, name, readers=2,  # noqa: F811
         return {"reads": len(latencies), "writes": len(acked),
                 "p99": p99}
     finally:
+        events_mod.set_recorder(None)
         tpu.close()
 
 

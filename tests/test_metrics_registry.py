@@ -242,7 +242,11 @@ def test_required_families_are_present(node):
             "es_tpu_tenant_write_cap_bytes",
             "es_tpu_tenant_write_bytes_total",
             "es_tpu_tenant_write_rejections_total",
-            "es_tpu_tenant_weight"):
+            "es_tpu_tenant_weight",
+            "es_tpu_events_total",
+            "es_tpu_incidents_total",
+            "es_tpu_events_dropped_total",
+            "es_tpu_events_ring_size"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
     # per-pack rows are labeled by index/field and carry the raw-vs-
     # resident component split
@@ -380,3 +384,27 @@ def test_tenant_counters_reachable_and_registered(node):
     _, text = do(node, "GET", "/_prometheus/metrics")
     assert ('es_tpu_tenant_search_admitted_total'
             f'{{tenant="{DEFAULT_TENANT}"}}') in text
+
+
+def test_flight_recorder_counters_reachable_and_registered(node):
+    """ISSUE 18: the flight recorder's per-type event counters and
+    per-trigger incident counters must be visible to the scrape, per
+    labeled child — a new event type can't silently dodge it."""
+    rec = node.flight_recorder
+    assert rec is not None
+    # node construction emitted node.start, so at least one typed child
+    # exists and every pre-seeded incident trigger renders at zero
+    _, text = do(node, "GET", "/_prometheus/metrics")
+    assert 'es_tpu_events_total{type="node.start"} 1' in text
+    for trigger in ("wedge", "quarantine", "batcher_death", "pack_shed"):
+        assert f'es_tpu_incidents_total{{trigger="{trigger}"}} 0' in text
+    reachable = _reachable_metrics(rec)
+    registered = node.metrics.registered_objects()
+    children = ([m for _l, m in rec.c_events.items()]
+                + [m for _l, m in rec.c_incidents.items()]
+                + [rec.c_dropped])
+    for obj in children:
+        assert id(obj) in reachable, \
+            f"traversal never reached {obj!r} from the recorder"
+        assert id(obj) in registered, \
+            f"recorder counter {obj!r} missing from the registry"
