@@ -26,6 +26,9 @@ from typing import Any, Dict, List, Optional, Tuple
 #: fail the gate when new/old exceeds this on any compared metric
 THRESHOLD = 0.15
 
+#: metrics where MORE is better — the regression ratio inverts
+HIGHER_IS_BETTER = ("rest_qps.",)
+
 _ROUND = re.compile(r"^BENCH_r(\d+)\.json$")
 
 
@@ -68,18 +71,53 @@ def collect_metrics(parsed: Dict[str, Any]) -> Dict[str, float]:
                 rec.get("device_ms_per_query"), (int, float)):
             out[f"kernel.{variant}.device_ms_per_query"] = \
                 float(rec["device_ms_per_query"])
+    rest = parsed.get("rest_qps")
+    if isinstance(rest, dict):
+        for field in ("single_process", "fronts"):
+            if isinstance(rest.get(field), (int, float)):
+                out[f"rest_qps.{field}"] = float(rest[field])
     return out
+
+
+def _worse_is(key: str, o: float, n: float) -> float:
+    """Regression magnitude, sign-normalized so positive = worse: ratio
+    growth for latency-style metrics, ratio shrink for throughput."""
+    if o <= 0:
+        return 0.0
+    change = n / o - 1.0
+    if key.startswith(HIGHER_IS_BETTER):
+        return -change
+    return change
 
 
 def diff(old: Dict[str, float],
          new: Dict[str, float]) -> List[Tuple[str, float, float, float]]:
-    """→ [(metric, old, new, ratio-1)] for every metric in BOTH rounds."""
+    """→ [(metric, old, new, worse-fraction)] for every metric in BOTH
+    rounds (positive worse-fraction = regression, any metric kind)."""
     rows = []
     for key in sorted(set(old) & set(new)):
         o, n = old[key], new[key]
-        change = (n / o - 1.0) if o > 0 else 0.0
-        rows.append((key, o, n, change))
+        rows.append((key, o, n, _worse_is(key, o, n)))
     return rows
+
+
+def skipped_notes(old: Dict[str, float],
+                  new: Dict[str, float]) -> List[str]:
+    """Human-readable notes for metrics measured in only one round —
+    rounds legitimately differ in kernel-variant sets (a variant gated
+    off) and in whether the rest_qps phase ran at all; the gate skips
+    them with a note instead of failing on a KeyError or a phantom
+    regression."""
+    notes = []
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    if only_old:
+        notes.append(f"skipped {len(only_old)} metric(s) only in the "
+                     f"old round: {', '.join(only_old)}")
+    if only_new:
+        notes.append(f"skipped {len(only_new)} metric(s) only in the "
+                     f"new round: {', '.join(only_new)}")
+    return notes
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -102,22 +140,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("compare: missing/unparseable bench round(s); "
               "nothing to gate")
         return 0
-    rows = diff(collect_metrics(old_parsed), collect_metrics(new_parsed))
+    old_metrics = collect_metrics(old_parsed)
+    new_metrics = collect_metrics(new_parsed)
+    rows = diff(old_metrics, new_metrics)
+    notes = skipped_notes(old_metrics, new_metrics)
     if not rows:
         print(f"compare: no metrics shared by {os.path.basename(old_path)}"
               f" and {os.path.basename(new_path)}; nothing to gate")
+        for note in notes:
+            print(f"compare: note — {note}")
         return 0
     regressions = []
     print(f"compare: {os.path.basename(old_path)} -> "
           f"{os.path.basename(new_path)} "
-          f"(gate: +{THRESHOLD:.0%} on p99/device-ms)")
-    for key, o, n, change in rows:
+          f"(gate: {THRESHOLD:.0%} worse on p99/device-ms/qps)")
+    for key, o, n, worse in rows:
         mark = ""
-        if change > THRESHOLD:
+        if worse > THRESHOLD:
             mark = "  << REGRESSION"
             regressions.append(key)
         print(f"  {key:48s} {o:10.3f} -> {n:10.3f}  "
-              f"({change:+.1%}){mark}")
+              f"(worse {worse:+.1%}){mark}")
+    for note in notes:
+        print(f"compare: note — {note}")
     if regressions:
         print(f"compare: FAIL — {len(regressions)} metric(s) regressed "
               f"beyond {THRESHOLD:.0%}: {', '.join(regressions)}")

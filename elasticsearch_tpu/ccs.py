@@ -164,9 +164,13 @@ def maybe_cross_cluster(node, index_expr: Optional[str],
     n_clusters = len(remote_exprs) + (1 if local_expr else 0)
     if local_expr:
         from elasticsearch_tpu.search import coordinator
+        from elasticsearch_tpu.search import merge as merge_mod
         if node.cluster is not None:
-            local = node.cluster.route_search(local_expr, sub_body,
-                                              params, task=task)
+            # the federated reducer rewrites _index/_shards on this
+            # dict — the local leg must merge inline, never defer
+            with merge_mod.deferring(False):
+                local = node.cluster.route_search(local_expr, sub_body,
+                                                  params, task=task)
         else:
             local = coordinator.search(
                 node.indices, local_expr, sub_body, params,

@@ -1697,6 +1697,16 @@ class ClusterService:
             successful = sum(g.get("shards", 0) for g in groups)
             check(failures, successful,
                   coord.allow_partial_results(params))
+        # off-interpreter merge: when the dispatch opted in (serving
+        # front or node merge pool owns the reduce) and the body is
+        # defer-eligible, hand back the columnar descriptor instead of
+        # merging on this interpreter — the batcher's steady-state work
+        # ends at the columns handoff
+        from elasticsearch_tpu.search import merge as merge_mod
+        if merge_mod.defer_active() and merge_mod.can_defer(body):
+            return merge_mod.DeferredMerge(merge_mod.build_descriptor(
+                groups, body, params, t0, failed_shards=knn_failed,
+                failures=failures))
         return coord.merge_group_responses(groups, body, params, t0,
                                            failed_shards=knn_failed,
                                            failures=failures)

@@ -267,6 +267,18 @@ class Node:
         # the multi-process serving front (started explicitly via
         # start_serving_fronts(); None ⇒ single-process serving)
         self.serving_front = None
+        # off-interpreter coordinator merge: deferred k-way merges run
+        # on the serving fronts when they exist, else on this node-local
+        # worker pool; merge_pool_size=0 (the default) keeps the merge
+        # inline on the dispatch thread
+        from elasticsearch_tpu.search import merge as _merge
+        self.merge_stats = _merge.MergeStats()
+        self.merge_pool = None
+        _pool_size = self.settings.get_int(
+            "search.tpu_serving.merge_pool_size", 0)
+        if _pool_size > 0:
+            self.merge_pool = _merge.MergePool(_pool_size,
+                                               stats=self.merge_stats)
         from elasticsearch_tpu.common.metrics import MetricsRegistry
         self.metrics = MetricsRegistry()
         self._register_metrics()
@@ -302,9 +314,20 @@ class Node:
             return [{"stack": stack, "count": count}
                     for stack, count in s.folded(top=15)]
 
+        def _merge_pool():
+            # merge-pool state rides every incident snapshot (a batcher
+            # death with a backed-up merge queue is a different story
+            # than one with an idle pool)
+            pool = getattr(self, "merge_pool", None)
+            if pool is not None:
+                return pool.status()
+            stats = getattr(self, "merge_stats", None)
+            return stats.to_dict() if stats is not None else None
+
         rec.add_snapshot_source("tpu_stats", _tpu_stats)
         rec.add_snapshot_source("degraded_info", _degraded)
         rec.add_snapshot_source("profile_stacks", _stacks)
+        rec.add_snapshot_source("merge_pool", _merge_pool)
 
     def _ingest_state_path(self) -> str:
         import os
@@ -820,6 +843,33 @@ class Node:
                 return
             yield from sup.metric_rows()
         reg.add_collector(_serving)
+        reg.set_help("merge.merges",
+                     "Deferred k-way merges completed (pool or inline)")
+        reg.set_help("merge.queue_depth",
+                     "Merge-pool jobs queued and not yet picked up")
+        reg.set_help("merge.latency",
+                     "Merge execution seconds (k-way reduce only)")
+        reg.set_help("merge.worker_restarts",
+                     "Merge-pool workers respawned after dying")
+        reg.set_help("merge.fallbacks",
+                     "Pool merges that fell back to an inline merge")
+
+        def _merge():
+            # always present (zero-valued without a pool) so the
+            # es_tpu_merge_* families never vanish from a scrape
+            stats = self.merge_stats
+            pool = self.merge_pool
+            yield ("merge.merges", {}, stats.merges, "counter")
+            yield ("merge.inline_merges", {}, stats.inline, "counter")
+            yield ("merge.fallbacks", {}, stats.fallbacks, "counter")
+            yield ("merge.worker_restarts", {}, stats.worker_restarts,
+                   "counter")
+            yield ("merge.latency", {}, stats.latency, "summary")
+            yield ("merge.queue_depth", {},
+                   pool.queue_depth() if pool is not None else 0, "gauge")
+            yield ("merge.pool_size", {},
+                   pool.size if pool is not None else 0, "gauge")
+        reg.add_collector(_merge)
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
@@ -923,6 +973,9 @@ class Node:
             # fronts stop accepting before the device path tears down
             self.serving_front.close()
             self.serving_front = None
+        if self.merge_pool is not None:
+            self.merge_pool.close()
+            self.merge_pool = None
         if self.cluster is not None:
             self.cluster.close()
         if self.profiler is not None:
@@ -955,7 +1008,28 @@ class Node:
                 except json.JSONDecodeError as e:
                     return 400, {"error": {"type": "parsing_exception",
                                            "reason": str(e)}, "status": 400}
-        return self.controller.dispatch(method, path, params, body, raw_body)
+        pool = self.merge_pool
+        if pool is None:
+            return self.controller.dispatch(method, path, params, body,
+                                            raw_body)
+        # merge pool active: the dispatch may hand back a deferred
+        # k-way merge descriptor; resolve it off this interpreter
+        from elasticsearch_tpu.search import merge as merge_mod
+        with merge_mod.deferring(True):
+            status, payload = self.controller.dispatch(
+                method, path, params, body, raw_body)
+        if isinstance(payload, merge_mod.DeferredMerge):
+            payload = pool.merge(payload.descriptor)
+        return status, payload
+
+    def merge_status(self) -> Dict[str, Any]:
+        """The /_tpu/stats merge block: where deferred merges run and
+        what they cost."""
+        pool = self.merge_pool
+        if pool is not None:
+            return {"mode": "pool", **pool.status()}
+        mode = "front" if self.serving_front is not None else "inline"
+        return {"mode": mode, **self.merge_stats.to_dict()}
 
 
 class _Handler(BaseHTTPRequestHandler):

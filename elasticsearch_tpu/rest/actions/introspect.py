@@ -365,6 +365,11 @@ def register(controller: RestController, node) -> None:
         else:
             out = {"enabled": True}
             out.update(tpu.stats())
+        merge_status = getattr(node, "merge_status", None)
+        if merge_status is not None:
+            # where deferred k-way merges run (inline / front / pool)
+            # and what they cost
+            out["merge"] = merge_status()
         if profiler is not None:
             out["profiler"] = profiler.info()
         return 200, out

@@ -189,7 +189,11 @@ def register(controller: RestController, node) -> None:
                         # per item: a declined search is ITS 429 entry,
                         # the sibling searches still run
                         backpressure.admit(body, task=task)
-                    item = _execute_search(index, body, {}, task)
+                    # item dicts are annotated below — never defer the
+                    # merge of an msearch item past this loop
+                    from elasticsearch_tpu.search import merge as merge_mod
+                    with merge_mod.deferring(False):
+                        item = _execute_search(index, body, {}, task)
                     item["status"] = 200
                     responses.append(item)
                 except Exception as exc:  # noqa: BLE001 — per item

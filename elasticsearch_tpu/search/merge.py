@@ -1,0 +1,467 @@
+"""Off-interpreter coordinator merge: a columnar, heap-based k-way merge
+of `search_shard_group` partials that reproduces
+`coordinator.merge_group_responses` byte-identically — without importing
+the device stack, so it can run on serving-front processes or a small
+node-local worker pool instead of the batcher's interpreter.
+
+Mechanics:
+
+  * `route_search` finishes its fan-out/failover with the per-group
+    partials in hand. When deferral is active (the serving front's
+    dispatch context, or a node-local merge pool) and the body is
+    defer-eligible, it returns a `DeferredMerge` carrying a JSON-safe
+    descriptor instead of merging inline — the batcher's per-request
+    steady-state work stays doorbell → plan memo → device launch →
+    columns handoff.
+  * `merge_descriptor` is the reduce: per-group runs arrive pre-sorted
+    by `(sort_key, _index, __shard, rank)` (the shard-group local
+    pre-merge ordering), so a `heapq.merge` with the group position as
+    final tie-break replays exactly the stable global sort the
+    in-process path gets from `merged.sort(key=t[:4])`, with early exit
+    once the `from+size` window is full.
+  * Aggregation-bearing bodies never defer: partial aggregates travel
+    as pickled reducer state whose classes import the device stack —
+    those merges stay on the batcher, which is the pre-existing path.
+
+Deferral is opt-in per dispatch via a contextvar (`deferring(True)`),
+so transport handlers, CCS federation, msearch item assembly and scroll
+continuations — all of which post-process the merged dict — keep the
+inline path untouched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import heapq
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.metrics import CounterMetric, SampleRing
+from elasticsearch_tpu.search import sort_keys
+
+DESCRIPTOR_VERSION = 1
+
+_DEFER: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "es_tpu_merge_defer", default=False)
+
+
+def defer_active() -> bool:
+    return _DEFER.get()
+
+
+@contextlib.contextmanager
+def deferring(enabled: bool):
+    """Scope deferral to one dispatch: handlers run on the calling
+    thread (thread pools here are admission gates, not executors), so a
+    contextvar set around `controller.dispatch` reaches `route_search`."""
+    token = _DEFER.set(bool(enabled))
+    try:
+        yield
+    finally:
+        _DEFER.reset(token)
+
+
+def can_defer(body: Optional[Dict[str, Any]]) -> bool:
+    """Aggregations reduce through pickled aggregator state whose
+    classes live behind the device stack — they merge on the batcher."""
+    body = body or {}
+    return not (body.get("aggs") or body.get("aggregations"))
+
+
+class DeferredMerge:
+    """A merge the coordinator handed off instead of performing: the
+    JSON-safe descriptor plus nothing else. Boundaries resolve it — the
+    serving supervisor ships it to the front that owns the reply, and
+    `node.handle` routes it through the node's merge pool."""
+
+    __slots__ = ("descriptor",)
+
+    def __init__(self, descriptor: Dict[str, Any]):
+        self.descriptor = descriptor
+
+    def resolve(self) -> Dict[str, Any]:
+        return merge_descriptor(self.descriptor)
+
+
+def build_descriptor(groups: List[Dict[str, Any]],
+                     body: Optional[Dict[str, Any]],
+                     params: Optional[Dict[str, str]],
+                     t0: float,
+                     failed_shards: int = 0,
+                     failures: Optional[List[Dict[str, Any]]] = None
+                     ) -> Dict[str, Any]:
+    """Everything `merge_group_responses` reads, as one JSON-safe dict.
+    `t0` is a perf_counter stamp — CLOCK_MONOTONIC on this platform, so
+    `took` computed in another process on the same host stays honest."""
+    return {"v": DESCRIPTOR_VERSION,
+            "groups": groups,
+            "body": body or {},
+            "params": params or {},
+            "t0": float(t0),
+            "failed_shards": int(failed_shards),
+            "failures": list(failures or [])}
+
+
+# ---------------------------------------------------------------------------
+# the reduce — byte-identical port of coordinator.merge_group_responses
+# ---------------------------------------------------------------------------
+
+def _group_run(gi: int, g: Dict[str, Any], sort_specs) -> List[tuple]:
+    """One group's merge entries `(key, _index, __shard, rank, gi, doc)`
+    — `rank` resets per group, exactly the in-process enumerate."""
+    run = []
+    for rank, doc in enumerate(g["hits"]):
+        if sort_specs:
+            key = sort_keys.sort_key(sort_specs, doc.get("sort") or [])
+        else:
+            key = -(doc.get("_score") or 0.0)
+        run.append((key, doc.get("_index", ""), doc.pop("__shard", 0),
+                    rank, gi, doc))
+    return run
+
+
+def _entry_key(t: tuple) -> tuple:
+    return t[:4]
+
+
+def merge_descriptor(desc: Dict[str, Any]) -> Dict[str, Any]:
+    """K-way columnar merge of shard-group partials → one reference-
+    shaped _search response, byte-identical to
+    `coordinator.merge_group_responses` over the same inputs."""
+    groups: List[Dict[str, Any]] = desc["groups"]
+    body: Dict[str, Any] = desc.get("body") or {}
+    params: Dict[str, Any] = desc.get("params") or {}
+    t0 = desc.get("t0")
+    failures = list(desc.get("failures") or [])
+    n_failed = int(desc.get("failed_shards", 0)) + len(failures)
+    size = int(params.get("size", body.get("size", 10)))
+    from_ = int(params.get("from", body.get("from", 0)))
+    sort_specs = sort_keys.parse_sort(body.get("sort"))
+
+    total = 0
+    relation = "eq"
+    n_shards = n_failed
+    n_skipped = 0
+    timed_out = False
+    runs: List[List[tuple]] = []
+    for gi, g in enumerate(groups):
+        total += g["total"]
+        n_shards += g.get("shards", 0)
+        n_skipped += g.get("skipped", 0)
+        if g.get("timed_out"):
+            timed_out = True
+        if g.get("relation") == "gte":
+            relation = "gte"
+        run = _group_run(gi, g, sort_specs)
+        # shard groups pre-sort their hits by (key, index, shard, rank);
+        # heapq.merge requires it, so verify — an unsorted run (foreign
+        # group producer) falls back to an explicit per-run sort, which
+        # is still exactly the in-process stable order since `rank` is
+        # unique within a group
+        for i in range(1, len(run)):
+            if _entry_key(run[i - 1]) > _entry_key(run[i]):
+                run.sort(key=_entry_key)
+                break
+        runs.append(run)
+
+    # stable across runs: heapq.merge resolves key ties by iterable
+    # position = group order, same as the in-process stable global sort
+    merged_iter = heapq.merge(*runs, key=_entry_key)
+
+    collapse_field = (body.get("collapse") or {}).get("field") \
+        if body.get("collapse") else None
+    window: List[Dict[str, Any]] = []
+    want = from_ + size
+    if collapse_field:
+        seen_keys = set()
+        picked: List[Dict[str, Any]] = []
+        if want > 0:
+            for entry in merged_iter:
+                doc = entry[5]
+                key_vals = (doc.get("fields") or {}).get(collapse_field)
+                if key_vals:
+                    if key_vals[0] in seen_keys:
+                        continue
+                    seen_keys.add(key_vals[0])
+                picked.append(doc)
+                if len(picked) >= want:
+                    break
+        window = picked[from_: want]
+    else:
+        if want > 0:
+            for pos, entry in enumerate(merged_iter):
+                if pos >= from_:
+                    window.append(entry[5])
+                if pos + 1 >= want:
+                    break
+
+    any_hits = any(g["hits"] for g in groups)
+    if sort_specs:
+        only_score = all(s.field == "_score" for s in sort_specs)
+        max_score = None
+        if only_score and any_hits:
+            max_score = max((d.get("_score") or float("-inf")
+                             for g in groups for d in g["hits"]),
+                            default=None)
+        if not only_score:
+            for doc in window:
+                doc["_score"] = None
+    else:
+        max_score = max((g.get("max_score") for g in groups
+                         if g.get("max_score") is not None),
+                        default=None)
+
+    shards_json: Dict[str, Any] = {"total": n_shards,
+                                   "successful": n_shards - n_failed,
+                                   "skipped": n_skipped,
+                                   "failed": n_failed}
+    if failures:
+        shards_json["failures"] = failures
+    out: Dict[str, Any] = {
+        "took": int((time.perf_counter() - t0) * 1000),
+        "timed_out": timed_out,
+        "_shards": shards_json,
+        "hits": {"total": {"value": total, "relation": relation},
+                 "max_score": max_score,
+                 "hits": window},
+    }
+
+    if body.get("suggest") is not None:
+        from elasticsearch_tpu.search.suggest import (merge_suggest,
+                                                      parse_suggest)
+        specs = parse_suggest(body["suggest"])
+        out["suggest"] = merge_suggest(
+            specs, [g.get("suggest") for g in groups
+                    if g.get("suggest") is not None])
+
+    if body.get("profile"):
+        shards = [s for g in groups for s in g.get("profile_shards", [])]
+        out["profile"] = {"shards": shards}
+        tpu = [s["tpu"] for s in shards if "tpu" in s]
+        if tpu:
+            out["profile"]["tpu"] = tpu
+    return out
+
+
+# ---------------------------------------------------------------------------
+# node-local merge pool
+# ---------------------------------------------------------------------------
+
+class MergeStats:
+    """The merge families, registered on the node whether or not a pool
+    exists — inline resolutions and pool resolutions both record here,
+    so `es_tpu_merge_*` never disappears from a scrape."""
+
+    def __init__(self):
+        self.merges = CounterMetric()          # merges completed (any path)
+        self.inline = CounterMetric()          # … of which ran inline
+        self.fallbacks = CounterMetric()       # pool gave up → inline
+        self.worker_restarts = CounterMetric()
+        self.latency = SampleRing(512)         # merge execution seconds
+
+    def record(self, seconds: float, inline: bool = False) -> None:
+        self.merges.inc()
+        if inline:
+            self.inline.inc()
+        self.latency.add(seconds)
+
+    def to_dict(self) -> Dict[str, Any]:
+        pcts = self.latency.percentiles()
+        return {"merges": self.merges.count,
+                "inline": self.inline.count,
+                "fallbacks": self.fallbacks.count,
+                "worker_restarts": self.worker_restarts.count,
+                "latency_ms": {f"p{int(k)}": round(v * 1000.0, 3)
+                               for k, v in pcts.items()}}
+
+
+def merge_inline(descriptor: Dict[str, Any],
+                 stats: Optional[MergeStats] = None) -> Dict[str, Any]:
+    t = time.perf_counter()
+    out = merge_descriptor(descriptor)
+    if stats is not None:
+        stats.record(time.perf_counter() - t, inline=True)
+    return out
+
+
+def _pool_worker_main(conn) -> None:
+    """Merge-pool worker loop: recv pickled descriptor → merge → send
+    (response, merge_seconds). EOF ⇒ parent closed us; exit quietly."""
+    import pickle
+    while True:
+        try:
+            job = conn.recv_bytes()
+        except (EOFError, OSError):
+            return
+        try:
+            desc = pickle.loads(job)
+            t = time.perf_counter()
+            out = merge_descriptor(desc)
+            conn.send(("ok", out, time.perf_counter() - t))
+        except Exception as exc:  # noqa: BLE001 — report, don't die
+            try:
+                conn.send(("err", f"{type(exc).__name__}: {exc}", 0.0))
+            except (OSError, ValueError):
+                return
+
+
+class _Job:
+    __slots__ = ("data", "event", "result", "attempts")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.event = threading.Event()
+        self.result: Any = None
+        self.attempts = 0
+
+
+class MergePool:
+    """A small pool of spawn-context worker processes performing the
+    k-way merge off the batcher's interpreter when no serving fronts
+    exist to absorb it (`front_processes == 0`). Failure policy: a dead
+    worker is respawned and the job retried once; a second failure (or
+    timeout) falls back to an inline merge so a broken pool degrades to
+    exactly the pre-pool behavior."""
+
+    HIGH_WATER = int(os.environ.get("ES_TPU_MERGE_BACKLOG_HIGH_WATER", "32"))
+    BACKLOG_DEBOUNCE_S = 5.0
+    JOB_TIMEOUT_S = float(os.environ.get("ES_TPU_MERGE_JOB_TIMEOUT_S", "30"))
+
+    def __init__(self, size: int, stats: Optional[MergeStats] = None):
+        import multiprocessing
+        self.size = max(1, int(size))
+        self.stats = stats if stats is not None else MergeStats()
+        self._ctx = multiprocessing.get_context("spawn")
+        self._queue: "queue.Queue[Optional[_Job]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._last_backlog_emit = 0.0
+        self._workers: List[Any] = []
+        self._threads: List[threading.Thread] = []
+        for i in range(self.size):
+            self._workers.append(self._spawn(i))
+            t = threading.Thread(target=self._drive, args=(i,),
+                                 name=f"es-tpu-merge-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- workers ----------------------------------------------------------
+
+    def _spawn(self, i: int):
+        parent, child = self._ctx.Pipe()
+        proc = self._ctx.Process(target=_pool_worker_main, args=(child,),
+                                 name=f"es-tpu-merge-worker-{i}",
+                                 daemon=True)
+        proc.start()
+        child.close()
+        return {"proc": proc, "conn": parent}
+
+    def _respawn(self, i: int, reason: str) -> None:
+        from elasticsearch_tpu.common import events
+        old = self._workers[i]
+        pid = getattr(old["proc"], "pid", None)
+        try:
+            old["conn"].close()
+        except OSError:
+            pass
+        if old["proc"].is_alive():
+            old["proc"].terminate()
+        old["proc"].join(timeout=5.0)
+        events.emit("merge.worker_exit", severity="warning",
+                    worker=i, pid=pid, reason=reason)
+        self.stats.worker_restarts.inc()
+        self._workers[i] = self._spawn(i)
+        events.emit("merge.worker_respawn", severity="info", worker=i,
+                    pid=self._workers[i]["proc"].pid)
+
+    def _drive(self, i: int) -> None:
+        """One manager thread per worker: pull a job, round-trip it over
+        the worker's pipe, respawn + retry-once on worker death."""
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            worker = self._workers[i]
+            try:
+                worker["conn"].send_bytes(job.data)
+                if not worker["conn"].poll(self.JOB_TIMEOUT_S):
+                    raise TimeoutError("merge worker timed out")
+                status, payload, seconds = worker["conn"].recv()
+            except Exception as exc:  # noqa: BLE001 — supervise
+                if self._closed:
+                    job.result = ("dead", None, 0.0)
+                    job.event.set()
+                    continue
+                self._respawn(i, f"{type(exc).__name__}: {exc}")
+                job.attempts += 1
+                if job.attempts < 2:
+                    self._queue.put(job)
+                else:
+                    job.result = ("dead", None, 0.0)
+                    job.event.set()
+                continue
+            job.result = (status, payload, seconds)
+            job.event.set()
+
+    # -- submission -------------------------------------------------------
+
+    def merge(self, descriptor: Dict[str, Any]) -> Dict[str, Any]:
+        import pickle
+        if self._closed:
+            return merge_inline(descriptor, self.stats)
+        depth = self._queue.qsize()
+        if depth >= self.HIGH_WATER:
+            now = time.monotonic()
+            if now - self._last_backlog_emit >= self.BACKLOG_DEBOUNCE_S:
+                self._last_backlog_emit = now
+                from elasticsearch_tpu.common import events
+                events.emit("merge.backlog", severity="warning",
+                            depth=depth, high_water=self.HIGH_WATER,
+                            pool_size=self.size)
+        job = _Job(pickle.dumps(descriptor, protocol=4))
+        self._queue.put(job)
+        if not job.event.wait(self.JOB_TIMEOUT_S * 2):
+            self.stats.fallbacks.inc()
+            return merge_inline(descriptor, self.stats)
+        status, payload, seconds = job.result
+        if status != "ok":
+            self.stats.fallbacks.inc()
+            return merge_inline(descriptor, self.stats)
+        self.stats.record(seconds)
+        return payload
+
+    # -- introspection ----------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def status(self) -> Dict[str, Any]:
+        return {"pool_size": self.size,
+                "queue_depth": self.queue_depth(),
+                "workers": [{"worker": i,
+                             "pid": w["proc"].pid,
+                             "alive": w["proc"].is_alive()}
+                            for i, w in enumerate(self._workers)],
+                **self.stats.to_dict()}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for _ in self._threads:
+            self._queue.put(None)
+        for w in self._workers:
+            try:
+                w["conn"].close()
+            except OSError:
+                pass
+        for w in self._workers:
+            w["proc"].join(timeout=5.0)
+            if w["proc"].is_alive():
+                w["proc"].terminate()
+                w["proc"].join(timeout=5.0)

@@ -3239,12 +3239,13 @@ def _ensure_compile_cache(path: Optional[str] = None) -> None:
         return
     _cache_configured = True
     import os
-    env = os.environ.get("ES_TPU_JAX_CACHE_DIR")
-    if env is not None:
-        path = env
-    elif path is None:
-        path = os.path.join(os.path.expanduser("~"), ".cache",
-                            "elasticsearch_tpu", "jax_cache")
+
+    # shared with the seed_compile_cache exporter/importer so "the dir
+    # the node compiles into" and "the dir the seeder packs/unpacks"
+    # can never drift apart
+    from elasticsearch_tpu.tools.seed_compile_cache import \
+        compile_cache_dir
+    path = compile_cache_dir(path)
     if not path:
         return
     try:

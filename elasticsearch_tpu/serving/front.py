@@ -148,6 +148,16 @@ class _FrontState:
         self.latency = SampleRing(512)
         self.metrics.register("serving.front.latency_seconds", self.latency,
                               help="Front-observed request latency")
+        # deferred coordinator merges executed on this front: the
+        # batcher ships the columnar descriptor, the k-way reduce runs
+        # here — its cost lands in THIS ring, not batch_wait stages
+        self.c_merges = self.metrics.register(
+            "serving.front.merges", CounterMetric(),
+            help="Deferred k-way merges executed on this front")
+        self.merge_ring = SampleRing(512)
+        self.metrics.register("serving.front.merge_seconds",
+                              self.merge_ring,
+                              help="Front-side merge execution seconds")
         self.sampler = None
         if cfg.get("profile_hz"):
             from elasticsearch_tpu.common.profiler import HostSampler
@@ -394,8 +404,23 @@ class _FrontHandler(BaseHTTPRequestHandler):
                 self._reply(429, "json", RING_FULL_BODY,
                             {"Retry-After": "1"})
                 return
-            from elasticsearch_tpu.search.serializer import splice_wire
-            text = splice_wire(wire["parts"], wire["columns"])
+            if "merge" in wire:
+                # deferred coordinator merge: the batcher handed off the
+                # shard-group columns; run the k-way reduce here
+                from elasticsearch_tpu.search import merge as merge_mod
+                from elasticsearch_tpu.search.serializer import \
+                    dumps_response
+                from elasticsearch_tpu.serving.shm import \
+                    unpack_merge_descriptor
+                tm = time.perf_counter()
+                out = merge_mod.merge_descriptor(
+                    unpack_merge_descriptor(wire["merge"]))
+                text = dumps_response(out)
+                state.merge_ring.add(time.perf_counter() - tm)
+                state.c_merges.inc()
+            else:
+                from elasticsearch_tpu.search.serializer import splice_wire
+                text = splice_wire(wire["parts"], wire["columns"])
             self._reply(wire["status"], wire["ctype"],
                         text.encode("utf-8"), wire.get("headers"))
         finally:
@@ -663,9 +688,13 @@ class FrontSupervisor:
             req = pickle.loads(data)
             if req["kind"] == "search":
                 body = self._memo_body(req["sig"], req["raw"])
-                status, payload = self.node.controller.dispatch(
-                    req["method"], req["path"], req["params"], body,
-                    req["raw"])
+                # the front that owns this reply performs the k-way
+                # merge; the batcher stops at the columns handoff
+                from elasticsearch_tpu.search import merge as merge_mod
+                with merge_mod.deferring(True):
+                    status, payload = self.node.controller.dispatch(
+                        req["method"], req["path"], req["params"], body,
+                        req["raw"])
             else:
                 status, payload = self.node.handle(
                     req["method"], req["path"], req["params"], None,
@@ -696,7 +725,13 @@ class FrontSupervisor:
     @staticmethod
     def _encode(status: int, payload: Any) -> Dict[str, Any]:
         """Mirror node._Handler._do's payload shaping, but columnar:
-        hits blocks leave as splice columns for the front's C splicer."""
+        hits blocks leave as splice columns for the front's C splicer,
+        and a deferred merge leaves as its packed descriptor."""
+        from elasticsearch_tpu.search import merge as merge_mod
+        if isinstance(payload, merge_mod.DeferredMerge):
+            from elasticsearch_tpu.serving.shm import pack_merge_descriptor
+            return {"status": status, "ctype": "json",
+                    "merge": pack_merge_descriptor(payload.descriptor)}
         headers = None
         if isinstance(payload, dict):
             # dispatch-attached response headers (Retry-After on

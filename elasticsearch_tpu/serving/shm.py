@@ -27,7 +27,39 @@ import struct
 from multiprocessing import shared_memory
 from typing import Any, Dict, Optional
 
-__all__ = ["SlotArena", "StatsBlock"]
+__all__ = ["SlotArena", "StatsBlock",
+           "pack_merge_descriptor", "unpack_merge_descriptor"]
+
+
+# ---------------------------------------------------------------------------
+# merge-descriptor wire shape (batcher → front deferred k-way merge)
+# ---------------------------------------------------------------------------
+
+#: magic + version prefix so a front can reject frames from a batcher
+#: running a different descriptor generation instead of mis-merging
+_MERGE_MAGIC = b"ESMG"
+_MERGE_VERSION = 1
+_MERGE_HDR = struct.Struct("<4sI")
+
+
+def pack_merge_descriptor(desc: Dict[str, Any]) -> bytes:
+    """One deferred-merge descriptor as self-describing bytes. JSON body
+    on purpose: shard-group partials are response material (hit dicts,
+    failures, profile sections), so JSON round-trips them exactly and
+    keeps the frame readable to any process without unpickling code."""
+    body = json.dumps(desc, separators=(",", ":")).encode("utf-8")
+    return _MERGE_HDR.pack(_MERGE_MAGIC, _MERGE_VERSION) + body
+
+
+def unpack_merge_descriptor(data: bytes) -> Dict[str, Any]:
+    if len(data) < _MERGE_HDR.size:
+        raise ValueError("merge descriptor frame too short")
+    magic, version = _MERGE_HDR.unpack_from(data, 0)
+    if magic != _MERGE_MAGIC:
+        raise ValueError(f"bad merge descriptor magic {magic!r}")
+    if version != _MERGE_VERSION:
+        raise ValueError(f"unsupported merge descriptor version {version}")
+    return json.loads(data[_MERGE_HDR.size:].decode("utf-8"))
 
 
 class SlotArena:

@@ -1,0 +1,3 @@
+"""Operational CLIs that ship with the package (``python -m
+elasticsearch_tpu.tools.<name>``). Import-light on purpose: tools run on
+build hosts and in init containers that may not have a device stack."""

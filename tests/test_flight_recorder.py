@@ -451,13 +451,14 @@ def test_front_wire_incident_and_exemplar_payloads_byte_compatible():
 # bench regression gate
 # ---------------------------------------------------------------------
 
-def _bench_round(stages_p99, kernel_ms):
-    return {"n": 1, "cmd": "x", "rc": 0, "tail": "",
-            "parsed": {
-                "stages": {k: {"seconds": 1.0, "count": 10, "p99_ms": v}
-                           for k, v in stages_p99.items()},
-                "kernel_compare": {k: {"device_ms_per_query": v}
-                                   for k, v in kernel_ms.items()}}}
+def _bench_round(stages_p99, kernel_ms, rest_qps=None):
+    parsed = {"stages": {k: {"seconds": 1.0, "count": 10, "p99_ms": v}
+                         for k, v in stages_p99.items()},
+              "kernel_compare": {k: {"device_ms_per_query": v}
+                                 for k, v in kernel_ms.items()}}
+    if rest_qps is not None:
+        parsed["rest_qps"] = rest_qps
+    return {"n": 1, "cmd": "x", "rc": 0, "tail": "", "parsed": parsed}
 
 
 def test_bench_compare_gates_regressions(tmp_path):
@@ -485,6 +486,41 @@ def test_bench_compare_gates_regressions(tmp_path):
     new.write_text(json.dumps(_bench_round(
         {"kernel": 10.0, "brand_new_stage": 99.0}, {})))
     assert compare.main([str(old), str(new)]) == 0
+
+
+def test_bench_compare_rest_qps_and_skip_notes(tmp_path, capsys):
+    from elasticsearch_tpu.benchmark import compare
+    old = tmp_path / "BENCH_r01.json"
+    new = tmp_path / "BENCH_r02.json"
+    # rest_qps gates with the sign INVERTED: a throughput drop is the
+    # regression, a rise never is
+    old.write_text(json.dumps(_bench_round(
+        {}, {}, rest_qps={"single_process": 100.0, "fronts": 200.0})))
+    new.write_text(json.dumps(_bench_round(
+        {}, {}, rest_qps={"single_process": 80.0, "fronts": 400.0})))
+    assert compare.main([str(old), str(new)]) == 1
+    new.write_text(json.dumps(_bench_round(
+        {}, {}, rest_qps={"single_process": 95.0, "fronts": 400.0})))
+    assert compare.main([str(old), str(new)]) == 0
+    capsys.readouterr()
+    # a round missing the rest_qps phase entirely, and rounds with
+    # differing kernel-variant sets, skip with a note — no KeyError,
+    # no phantom regression
+    old.write_text(json.dumps(_bench_round(
+        {"kernel": 10.0}, {"packed": 5.0, "pallas": 2.0},
+        rest_qps={"single_process": 100.0, "fronts": 200.0})))
+    new.write_text(json.dumps(_bench_round(
+        {"kernel": 10.5}, {"packed": 5.1})))
+    assert compare.main([str(old), str(new)]) == 0
+    out = capsys.readouterr().out
+    assert "note — skipped 3 metric(s) only in the old round" in out
+    assert "kernel.pallas.device_ms_per_query" in out
+    assert "rest_qps.single_process" in out
+    # ... and when NOTHING is shared, the notes still explain why
+    new.write_text(json.dumps(_bench_round({"fresh": 1.0}, {})))
+    assert compare.main([str(old), str(new)]) == 0
+    out = capsys.readouterr().out
+    assert "nothing to gate" in out and "note — skipped" in out
 
 
 def test_bench_compare_graceful_with_missing_rounds(tmp_path):

@@ -1,0 +1,508 @@
+"""Off-interpreter coordinator merge (search/merge.py): the columnar
+heap-based k-way merge must be byte-identical to the in-process
+`coordinator.merge_group_responses` across every response shape the
+cluster coordinator produces — multi-index interleaves, transport
+shard groups, partial `_shards` failures, failover stamps, sort
+tie-breaks, collapse, suggest, profile sections and hostile ids — and
+the merge pool (spawned workers) must produce the same bytes as an
+inline merge while surviving worker death."""
+
+import copy
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.search import coordinator
+from elasticsearch_tpu.search import merge as merge_mod
+from elasticsearch_tpu.search.merge import (DeferredMerge, MergePool,
+                                            MergeStats, build_descriptor,
+                                            can_defer, defer_active,
+                                            deferring, merge_descriptor)
+from elasticsearch_tpu.search.serializer import dumps_response
+from elasticsearch_tpu.serving.shm import (pack_merge_descriptor,
+                                           unpack_merge_descriptor)
+
+EVIL_IDS = ['plain', 'has"quote', 'has,comma', 'back\\slash', 'unié中',
+            'tab\there', '{"j":1}', "'single'", '[1,2]', 'curly}brace{']
+
+
+def _group(hits, *, total=None, relation="eq", timed_out=False,
+           skipped=0, shards=1, max_score=None, **extra):
+    g = {"hits": hits,
+         "total": len(hits) if total is None else total,
+         "relation": relation, "timed_out": timed_out,
+         "skipped": skipped, "shards": shards,
+         "max_score": max_score}
+    g.update(extra)
+    return g
+
+
+def _doc(index, _id, score, *, shard=0, sort=None, fields=None):
+    d = {"_index": index, "_id": _id, "_score": score}
+    if sort is not None:
+        d["sort"] = sort
+    if fields is not None:
+        d["fields"] = fields
+    d["__shard"] = shard
+    return d
+
+
+def assert_parity(groups, body=None, params=None, *, failed_shards=0,
+                  failures=None):
+    """The deferred path (descriptor → wire → k-way merge) must render
+    the same bytes as the in-process merge over the same partials.
+    `took` is the only time-dependent field — pinned on both sides."""
+    t0 = time.perf_counter()
+    ref = coordinator.merge_group_responses(
+        copy.deepcopy(groups), copy.deepcopy(body), dict(params or {}),
+        t0, failed_shards=failed_shards,
+        failures=copy.deepcopy(failures) if failures else None)
+    desc = build_descriptor(
+        copy.deepcopy(groups), copy.deepcopy(body), dict(params or {}),
+        t0, failed_shards=failed_shards,
+        failures=copy.deepcopy(failures) if failures else None)
+    # always exercise the wire shape: pack → unpack → merge
+    out = merge_descriptor(unpack_merge_descriptor(
+        pack_merge_descriptor(desc)))
+    ref["took"] = out["took"] = 0
+    assert dumps_response(out) == dumps_response(ref)
+    return out
+
+
+# ---------------------------------------------------------------------
+# byte-identity parity suite
+# ---------------------------------------------------------------------
+
+class TestMergeParity:
+    def test_score_merge_multi_group(self):
+        groups = [
+            _group([_doc("a", "a0", 9.0), _doc("a", "a1", 3.0)],
+                   max_score=9.0),
+            _group([_doc("a", "b0", 7.5, shard=1),
+                    _doc("a", "b1", 0.25, shard=1)], max_score=7.5),
+            _group([], total=0),
+        ]
+        out = assert_parity(groups, {}, {})
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert ids == ["a0", "b0", "a1", "b1"]
+        assert out["hits"]["max_score"] == 9.0
+
+    def test_multi_index_interleave_evil_ids(self):
+        groups = []
+        for gi in range(3):
+            hits = [_doc(f"logs-{(gi + r) % 3}", EVIL_IDS[(gi * 3 + r)
+                                                          % len(EVIL_IDS)],
+                         round(5.0 - r * 0.5 - gi * 0.1, 6),
+                         shard=r % 2)
+                    for r in range(5)]
+            groups.append(_group(hits, shards=2,
+                                 max_score=hits[0]["_score"]))
+        assert_parity(groups, {"size": 12}, {})
+
+    def test_exact_tie_breaks_by_index_shard_rank_then_group(self):
+        # same score everywhere: order must fall to _index, then
+        # __shard, then per-group rank, then group position — the
+        # in-process stable sort's exact cascade
+        groups = [
+            _group([_doc("b", "g0b", 1.0, shard=1),
+                    _doc("b", "g0b2", 1.0, shard=1)]),
+            _group([_doc("a", "g1a", 1.0, shard=0),
+                    _doc("b", "g1b", 1.0, shard=1)]),
+            _group([_doc("a", "g2a", 1.0, shard=0)]),
+        ]
+        out = assert_parity(groups, {}, {})
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert ids == ["g1a", "g2a", "g0b", "g0b2", "g1b"]
+
+    def test_field_sort_orders_and_missing(self):
+        for order, missing in (("asc", "_last"), ("desc", "_last"),
+                               ("asc", "_first"), ("desc", "_first"),
+                               ("asc", -1.5)):
+            groups = [
+                _group([_doc("i", "d0", 1.0, sort=[3.5]),
+                        _doc("i", "d1", 1.0, sort=[None])]),
+                _group([_doc("i", "d2", 1.0, sort=[0.25]),
+                        _doc("i", "d3", 1.0, sort=[99.0])]),
+            ]
+            assert_parity(groups, {"sort": [
+                {"f": {"order": order, "missing": missing}}]}, {})
+
+    def test_string_sort_desc_inverted_codepoints(self):
+        groups = [
+            _group([_doc("i", "d0", 1.0, sort=["zz"]),
+                    _doc("i", "d1", 1.0, sort=["ab"])]),
+            _group([_doc("i", "d2", 1.0, sort=["mm"]),
+                    _doc("i", "d3", 1.0, sort=[None])]),
+        ]
+        for order in ("asc", "desc"):
+            assert_parity(groups, {"sort": [{"s": order}]}, {})
+
+    def test_score_only_sort_keeps_max_score(self):
+        groups = [
+            _group([_doc("i", "d0", 2.0, sort=[2.0]),
+                    _doc("i", "d1", 0.5, sort=[0.5])], max_score=2.0),
+            _group([_doc("i", "d2", 8.25, sort=[8.25])], max_score=8.25),
+        ]
+        out = assert_parity(groups, {"sort": ["_score"]}, {})
+        assert out["hits"]["max_score"] == 8.25
+
+    def test_non_score_sort_nulls_window_scores(self):
+        groups = [_group([_doc("i", "d0", 3.0, sort=[1.0]),
+                          _doc("i", "d1", 2.0, sort=[2.0])])]
+        out = assert_parity(groups, {"sort": [{"f": "asc"}]}, {})
+        assert all(h["_score"] is None for h in out["hits"]["hits"])
+        assert out["hits"]["max_score"] is None
+
+    def test_partial_shard_failures_accounting(self):
+        failures = [
+            {"shard": 1, "index": "logs",
+             "reason": {"type": "node_disconnected",
+                        "reason": 'copy "gone" mid-flight'}},
+            {"shard": 0, "index": "metrics",
+             "reason": {"type": "circuit_breaking_exception",
+                        "reason": "hbm over limit"}},
+        ]
+        groups = [_group([_doc("logs", "d0", 1.0)], shards=3,
+                         skipped=1, max_score=1.0)]
+        # allow_partial_search_results is resolved upstream (it decides
+        # whether route_search raises); through the merge it is just a
+        # body key that must not disturb the bytes
+        out = assert_parity(groups,
+                            {"allow_partial_search_results": True}, {},
+                            failed_shards=1, failures=failures)
+        assert out["_shards"] == {
+            "total": 6, "successful": 3, "skipped": 1, "failed": 3,
+            "failures": failures}
+
+    def test_failover_timed_out_and_gte_relation(self):
+        groups = [
+            _group([_doc("i", "d0", 1.0)], total=10000,
+                   relation="gte", timed_out=True, max_score=1.0),
+            _group([_doc("i", "d1", 0.5)], total=3, max_score=0.5),
+        ]
+        out = assert_parity(groups, {}, {})
+        assert out["timed_out"] is True
+        assert out["hits"]["total"] == {"value": 10003,
+                                        "relation": "gte"}
+
+    def test_collapse_dedupes_across_groups(self):
+        groups = [
+            _group([_doc("i", "d0", 5.0, fields={"k": ["x"]}),
+                    _doc("i", "d1", 4.0, fields={"k": ["y"]})]),
+            _group([_doc("i", "d2", 4.5, fields={"k": ["x"]}),
+                    _doc("i", "d3", 1.0, fields={"k": ["z"]}),
+                    _doc("i", "d4", 0.5)]),  # no key: never collapsed
+        ]
+        out = assert_parity(groups, {"collapse": {"field": "k"}}, {})
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert ids == ["d0", "d1", "d3", "d4"]
+
+    def test_from_size_windows(self):
+        groups = [_group([_doc("i", f"a{r}", 10.0 - r)
+                          for r in range(6)]),
+                  _group([_doc("i", f"b{r}", 9.5 - r)
+                          for r in range(6)])]
+        for params in ({"from": "3", "size": "4"}, {"size": "0"},
+                       {"from": "50", "size": "10"}, {"from": "0"}):
+            assert_parity(groups, {}, params)
+
+    def test_body_from_size_and_params_precedence(self):
+        groups = [_group([_doc("i", f"d{r}", 5.0 - r)
+                          for r in range(5)])]
+        assert_parity(groups, {"from": 1, "size": 2}, {})
+        assert_parity(groups, {"from": 1, "size": 2}, {"size": "4"})
+
+    def test_suggest_sections_merge(self):
+        body = {"suggest": {"fix": {"text": "alph",
+                                    "term": {"field": "body"}}}}
+        partial_a = {"fix": [{"text": "alph", "offset": 0, "length": 4,
+                              "options": [{"text": "alpha",
+                                           "score": 0.75, "freq": 2}]}]}
+        partial_b = {"fix": [{"text": "alph", "offset": 0, "length": 4,
+                              "options": [{"text": "alpha",
+                                           "score": 0.9, "freq": 3},
+                                          {"text": "aleph",
+                                           "score": 0.5, "freq": 1}]}]}
+        groups = [_group([_doc("i", "d0", 1.0)], suggest=partial_a,
+                         max_score=1.0),
+                  _group([], total=0, suggest=partial_b)]
+        out = assert_parity(groups, body, {})
+        assert "suggest" in out
+
+    def test_profile_sections_concatenate(self):
+        groups = [
+            _group([_doc("i", "d0", 1.0)],
+                   profile_shards=[{"id": "[s0]", "searches": [],
+                                    "tpu": {"stage_ms": {"dispatch": 1}}}],
+                   max_score=1.0),
+            _group([_doc("i", "d1", 0.5)],
+                   profile_shards=[{"id": "[s1]", "searches": []}],
+                   max_score=0.5),
+        ]
+        out = assert_parity(groups, {"profile": True}, {})
+        assert [s["id"] for s in out["profile"]["shards"]] \
+            == ["[s0]", "[s1]"]
+        assert out["profile"]["tpu"] == [{"stage_ms": {"dispatch": 1}}]
+
+    def test_degraded_stamp_order_survives_the_wire(self):
+        # degraded stamps are applied to the merged dict by the serving
+        # layer; key insertion order (and therefore bytes) must come
+        # out of the descriptor round-trip exactly as from the
+        # in-process merge
+        groups = [_group([_doc("i", "d0", 1.0)], max_score=1.0)]
+        t0 = time.perf_counter()
+        ref = coordinator.merge_group_responses(
+            copy.deepcopy(groups), {}, {}, t0)
+        out = merge_descriptor(unpack_merge_descriptor(
+            pack_merge_descriptor(build_descriptor(
+                copy.deepcopy(groups), {}, {}, t0))))
+        stamp = {"reason": "device_quarantined", "devices": 3,
+                 "devices_total": 4}
+        ref["degraded"] = dict(stamp)
+        out["degraded"] = dict(stamp)
+        ref["took"] = out["took"] = 0
+        assert dumps_response(out) == dumps_response(ref)
+
+    def test_unsorted_group_run_still_matches(self):
+        # a group whose hits violate the local pre-merge ordering (the
+        # defensive path) must still merge to the reference bytes
+        groups = [_group([_doc("i", "low", 0.5),
+                          _doc("i", "high", 9.0),
+                          _doc("i", "mid", 3.0)]),
+                  _group([_doc("i", "other", 4.0)])]
+        assert_parity(groups, {}, {})
+
+
+# ---------------------------------------------------------------------
+# descriptor wire shape + deferral gating
+# ---------------------------------------------------------------------
+
+class TestDescriptorWire:
+    def test_round_trip(self):
+        desc = build_descriptor(
+            [_group([_doc("i", 'evil",id', 1.0)])], {"size": 3},
+            {"from": "1"}, 12.5, failed_shards=2,
+            failures=[{"shard": 0, "index": "i",
+                       "reason": {"type": "x", "reason": "y"}}])
+        assert unpack_merge_descriptor(
+            pack_merge_descriptor(desc)) == desc
+
+    def test_rejects_bad_magic_and_version(self):
+        good = pack_merge_descriptor(build_descriptor([], {}, {}, 0.0))
+        with pytest.raises(ValueError, match="magic"):
+            unpack_merge_descriptor(b"XXXX" + good[4:])
+        with pytest.raises(ValueError, match="version"):
+            unpack_merge_descriptor(good[:4] + b"\xff\x00\x00\x00"
+                                    + good[8:])
+        with pytest.raises(ValueError, match="short"):
+            unpack_merge_descriptor(b"ES")
+
+    def test_can_defer_gates_aggregations(self):
+        assert can_defer({}) and can_defer(None)
+        assert can_defer({"sort": ["_score"], "suggest": {}})
+        assert not can_defer({"aggs": {"a": {"terms": {"field": "f"}}}})
+        assert not can_defer(
+            {"aggregations": {"a": {"avg": {"field": "f"}}}})
+
+    def test_deferring_contextvar_scopes(self):
+        assert not defer_active()
+        with deferring(True):
+            assert defer_active()
+            with deferring(False):
+                assert not defer_active()
+            assert defer_active()
+        assert not defer_active()
+
+    def test_deferred_merge_resolve(self):
+        groups = [_group([_doc("i", "d0", 2.0)], max_score=2.0)]
+        dm = DeferredMerge(build_descriptor(
+            groups, {}, {}, time.perf_counter()))
+        out = dm.resolve()
+        assert out["hits"]["hits"][0]["_id"] == "d0"
+        assert "__shard" not in out["hits"]["hits"][0]
+
+
+# ---------------------------------------------------------------------
+# the worker pool
+# ---------------------------------------------------------------------
+
+def _sample_descriptor(n=4):
+    groups = [_group([_doc("idx", f"g{gi}d{r}", float(n - r),
+                           shard=gi)
+                      for r in range(n)], shards=1,
+                     max_score=float(n))
+              for gi in range(3)]
+    return build_descriptor(groups, {"size": 8}, {},
+                            time.perf_counter())
+
+
+@pytest.mark.merge_pool
+@pytest.mark.multiprocess
+class TestMergePool:
+    def test_pool_output_matches_inline(self):
+        pool = MergePool(2)
+        try:
+            for _ in range(4):
+                desc = _sample_descriptor()
+                got = pool.merge(copy.deepcopy(desc))
+                ref = merge_descriptor(copy.deepcopy(desc))
+                got["took"] = ref["took"] = 0
+                assert dumps_response(got) == dumps_response(ref)
+            assert pool.stats.merges.count >= 4
+            assert pool.stats.latency.percentiles()
+        finally:
+            pool.close()
+
+    def test_worker_death_respawns_and_recovers(self):
+        from elasticsearch_tpu.common import events as _events
+        rec = _events.FlightRecorder(None)
+        prior = _events.get_recorder()
+        _events.set_recorder(rec)
+        pool = MergePool(1)
+        try:
+            assert pool.merge(_sample_descriptor())["hits"]["hits"]
+            pool._workers[0]["proc"].kill()
+            pool._workers[0]["proc"].join(timeout=10.0)
+            # next merge hits the dead pipe → respawn + retry → answer
+            assert pool.merge(_sample_descriptor())["hits"]["hits"]
+            assert pool.stats.worker_restarts.count >= 1
+            assert rec.events(etype="merge.worker_exit")
+            assert rec.events(etype="merge.worker_respawn")
+        finally:
+            pool.close()
+            _events.set_recorder(prior)
+
+    def test_backlog_event_past_high_water(self, monkeypatch):
+        from elasticsearch_tpu.common import events as _events
+        rec = _events.FlightRecorder(None)
+        prior = _events.get_recorder()
+        _events.set_recorder(rec)
+        monkeypatch.setattr(MergePool, "HIGH_WATER", 0)
+        pool = MergePool(1)
+        try:
+            pool.merge(_sample_descriptor())
+            evts = rec.events(etype="merge.backlog")
+            assert evts and evts[-1]["severity"] == "warning"
+        finally:
+            pool.close()
+            _events.set_recorder(prior)
+
+    def test_closed_pool_falls_back_inline(self):
+        pool = MergePool(1, stats=MergeStats())
+        pool.close()
+        out = pool.merge(_sample_descriptor())
+        assert out["hits"]["hits"]
+        assert pool.stats.inline.count >= 1
+
+
+# ---------------------------------------------------------------------
+# end-to-end: the batcher defers, the pool merges, bytes match
+# ---------------------------------------------------------------------
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _h(node, method, path, params=None, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    return node.handle(method, path, params, None, raw)
+
+
+@pytest.fixture(scope="module")
+def merge_cluster_node(tmp_path_factory):
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    tmp = tmp_path_factory.mktemp("merge_cluster")
+    port = _free_port()
+    node = Node(str(tmp / "m-node"), node_name="m-node",
+                settings=Settings.of(
+                    {"search.tpu_serving.enabled": "false",
+                     "search.tpu_serving.merge_pool_size": "1"}))
+    node.start_cluster(transport_port=port,
+                       seed_hosts=[("127.0.0.1", port)],
+                       initial_master_nodes=["m-node"])
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if node.cluster.coordinator.is_master():
+            break
+        time.sleep(0.1)
+    else:
+        node.close()
+        raise AssertionError("single-node cluster did not elect itself")
+    try:
+        s, r = _h(node, "PUT", "/logs", body={
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        assert s == 200, r
+        for i in range(8):
+            _h(node, "PUT", f"/logs/_doc/{i}",
+               body={"body": f"alpha event {i}" if i % 2
+                     else f"beta event {i}"})
+        _h(node, "POST", "/logs/_refresh")
+    except BaseException:
+        node.close()
+        raise
+    yield node
+    node.close()
+
+
+@pytest.mark.merge_pool
+@pytest.mark.multiprocess
+class TestClusterDeferral:
+    def test_pool_merged_search_matches_inline_route(
+            self, merge_cluster_node):
+        node = merge_cluster_node
+        assert node.merge_pool is not None
+        before = node.merge_stats.merges.count
+        body = {"query": {"match": {"body": "alpha"}}, "size": 10}
+        s, via_pool = _h(node, "POST", "/logs/_search", body=body)
+        assert s == 200, via_pool
+        # the contextvar defaults to False here, so a direct
+        # route_search merges in-process — the reference bytes
+        ref = node.cluster.route_search("logs", dict(body), {})
+        via_pool["took"] = ref["took"] = 0
+        assert dumps_response(via_pool) == dumps_response(ref)
+        assert node.merge_stats.merges.count > before
+
+    def test_aggregations_stay_on_the_batcher(self, merge_cluster_node):
+        node = merge_cluster_node
+        inline_before = node.merge_stats.inline.count
+        pool_before = node.merge_stats.merges.count
+        s, r = _h(node, "POST", "/logs/_search", body={
+            "size": 0,
+            "aggs": {"by": {"terms": {"field": "body"}}}})
+        assert s == 200, r
+        # agg partials are pickled aggregator state — never deferred
+        assert node.merge_stats.merges.count == pool_before
+        assert node.merge_stats.inline.count == inline_before
+
+    def test_batcher_never_merges_deferred_searches(
+            self, merge_cluster_node, monkeypatch):
+        # purity: with deferral active the dispatch path must not call
+        # the in-process merge at all — poison it and search anyway
+        node = merge_cluster_node
+
+        def _boom(*a, **kw):
+            raise AssertionError(
+                "merge_group_responses ran on the batcher path")
+
+        monkeypatch.setattr(coordinator, "merge_group_responses", _boom)
+        s, r = _h(node, "POST", "/logs/_search",
+                  body={"query": {"match": {"body": "event"}},
+                        "size": 5})
+        assert s == 200, r
+        assert r["hits"]["hits"]
+
+    def test_tpu_stats_exposes_merge_block(self, merge_cluster_node):
+        node = merge_cluster_node
+        s, r = _h(node, "GET", "/_tpu/stats")
+        assert s == 200
+        assert r["merge"]["mode"] == "pool"
+        assert r["merge"]["pool_size"] == 1
+        assert "latency_ms" in r["merge"]

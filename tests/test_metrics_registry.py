@@ -246,7 +246,14 @@ def test_required_families_are_present(node):
             "es_tpu_events_total",
             "es_tpu_incidents_total",
             "es_tpu_events_dropped_total",
-            "es_tpu_events_ring_size"):
+            "es_tpu_events_ring_size",
+            "es_tpu_merge_merges_total",
+            "es_tpu_merge_inline_merges_total",
+            "es_tpu_merge_fallbacks_total",
+            "es_tpu_merge_worker_restarts_total",
+            "es_tpu_merge_latency",
+            "es_tpu_merge_queue_depth",
+            "es_tpu_merge_pool_size"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
     # per-pack rows are labeled by index/field and carry the raw-vs-
     # resident component split

@@ -172,6 +172,84 @@ class TestWireEnvelope:
             {"_id": "a", "_index": "i", "_score": 1.0}]}}
 
 
+class TestDeferredMergeWire:
+    """A search deferred to the front (merge descriptor on the wire)
+    must render the same bytes the batcher would have shipped had it
+    merged in-process and spliced the result."""
+
+    def _groups(self, ids, *, failed=0):
+        hits = [{"_index": "idx", "_id": i,
+                 "_score": round(4.0 - r * 0.25, 6), "__shard": r % 2}
+                for r, i in enumerate(ids)]
+        mid = len(hits) // 2
+        groups = [
+            {"hits": hits[:mid], "total": mid, "relation": "eq",
+             "timed_out": False, "skipped": 0, "shards": 1,
+             "max_score": hits[0]["_score"] if hits else None},
+            {"hits": hits[mid:], "total": len(hits) - mid,
+             "relation": "eq", "timed_out": False, "skipped": 0,
+             "shards": 1,
+             "max_score": hits[mid]["_score"] if hits[mid:] else None},
+        ]
+        failures = [{"shard": 0, "index": "idx",
+                     "reason": {"type": "boom",
+                                "reason": 'split "me"'}}] if failed \
+            else None
+        return groups, failed, failures
+
+    def _wire_vs_inline(self, groups, body, params, failed, failures):
+        import copy
+        import time
+
+        from elasticsearch_tpu.search import coordinator
+        from elasticsearch_tpu.search import merge as merge_mod
+        from elasticsearch_tpu.serving.shm import unpack_merge_descriptor
+        t0 = time.perf_counter()
+        ref = coordinator.merge_group_responses(
+            copy.deepcopy(groups), copy.deepcopy(body), dict(params),
+            t0, failed_shards=failed,
+            failures=copy.deepcopy(failures) if failures else None)
+        dm = merge_mod.DeferredMerge(merge_mod.build_descriptor(
+            groups, body, params, t0, failed_shards=failed,
+            failures=failures))
+        from elasticsearch_tpu.serving.front import FrontSupervisor
+        wire = FrontSupervisor._encode(200, dm)
+        assert wire["ctype"] == "json" and "merge" in wire
+        # the front leg: unpack and reduce, exactly what _do runs
+        out = merge_mod.merge_descriptor(
+            unpack_merge_descriptor(wire["merge"]))
+        return ref, out
+
+    def test_front_merge_matches_batcher_bytes(self):
+        groups, failed, failures = self._groups(EVIL_IDS)
+        ref, out = self._wire_vs_inline(groups, {"size": 20}, {},
+                                        failed, failures)
+        ref["took"] = out["took"] = 0
+        assert dumps_response(out) == dumps_response(ref)
+
+    def test_partial_failures_ride_the_descriptor(self):
+        groups, failed, failures = self._groups(["a", "b", "c", "d"],
+                                                failed=2)
+        ref, out = self._wire_vs_inline(groups, {}, {}, failed, failures)
+        ref["took"] = out["took"] = 0
+        assert dumps_response(out) == dumps_response(ref)
+        assert out["_shards"]["failed"] == 2 + len(failures)
+        assert out["_shards"]["failures"][0]["reason"]["reason"] \
+            == 'split "me"'
+
+    def test_degraded_stamp_insertion_order_is_stable(self):
+        # the serving layer stamps `degraded` onto whichever dict it
+        # gets back; post-stamp bytes must match regardless of which
+        # side of the wire the merge ran on
+        groups, failed, failures = self._groups(["a", "b"])
+        ref, out = self._wire_vs_inline(groups, {}, {}, failed, failures)
+        for resp in (ref, out):
+            resp["degraded"] = {"reason": "device_quarantined",
+                                "devices": 3, "devices_total": 4}
+            resp["took"] = 0
+        assert dumps_response(out) == dumps_response(ref)
+
+
 class TestNativePythonByteIdentity:
     def test_native_equals_python_on_every_shape(self, monkeypatch):
         monkeypatch.setattr(serializer, "_SPLICE_TRIED", False)
