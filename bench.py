@@ -648,6 +648,98 @@ def main() -> None:
             out["slo"]["error"] = f"{type(e).__name__}: {str(e)[:300]}"
             log(f"slo phase failed: {out['slo']['error']}")
 
+    # ---- streaming-ingest phase (ES_TPU_BENCH_BULK_SUSTAINED=1):
+    # sustained _bulk writers against a FRESH index under live read
+    # traffic, with the NRT refresh cycle running so append-only
+    # refreshes ride the device delta-pack path. Emits sustained
+    # docs/s, p99 search-visible lag, and the compactor's duty cycle.
+    # Like slo, the key is ALWAYS populated. ----
+    if _env("BULK_SUSTAINED", 0) == 1:
+        out["bulk_sustained"] = {"error": None}
+        try:
+            bs_s = _env("BULK_SUSTAINED_SECONDS", max(6, seconds))
+            bs_writers = _env("BULK_SUSTAINED_WRITERS", bulk_clients)
+            bs_batch = _env("BULK_SUSTAINED_BATCH", 1000)
+            sidx = node.create_index(
+                "bench_stream", Settings.of({"index": {
+                    "number_of_shards": n_shards,
+                    "translog": {"durability": "async"}}}),
+                {"properties": {"body": {"type": "text"}}})
+            if not getattr(node, "refresher_active", False):
+                node.start_refresher()  # visibility rides the NRT cycle
+            ds = (node.tpu_search.delta_stats
+                  if node.tpu_search else None)
+            compact_s0 = ds.compact_seconds if ds else 0.0
+            acked = [0] * bs_writers
+            bs_errors = []
+            stop_at = time.perf_counter() + bs_s
+            stream_q = {"query": {"match": {"body": corpus.query_text(0)}},
+                        "size": 10, "_source": False}
+
+            def bs_writer(ci: int) -> None:
+                n = 0
+                while time.perf_counter() < stop_at and not bs_errors:
+                    lines = []
+                    for j in range(bs_batch):
+                        di = (n + j) % corpus.num_docs
+                        lines.append(json.dumps(
+                            {"index": {"_id": f"w{ci}-{n + j}"}}))
+                        lines.append(json.dumps(
+                            {"body": corpus.doc_text(di)}))
+                    s, resp = node.handle("POST", "/bench_stream/_bulk",
+                                          {}, "\n".join(lines) + "\n")
+                    if s != 200 or resp.get("errors"):
+                        bs_errors.append(str(resp)[:300])
+                        return
+                    n += bs_batch
+                    acked[ci] = n
+
+            def bs_reader() -> None:
+                while time.perf_counter() < stop_at:
+                    node.handle("POST", "/bench_stream/_search", {},
+                                dict(stream_q))
+                    time.sleep(0.05)
+
+            t0 = time.perf_counter()
+            workers = ([threading.Thread(target=bs_writer, args=(ci,))
+                        for ci in range(bs_writers)]
+                       + [threading.Thread(target=bs_reader)
+                          for _ in range(2)])
+            [t.start() for t in workers]
+            [t.join() for t in workers]
+            dt = time.perf_counter() - t0
+            lag_p99 = 0.0
+            for shard in sidx.shards.values():
+                lag = shard.engine.stats().get(
+                    "search_visible_lag_seconds", {})
+                lag_p99 = max(lag_p99, float(lag.get("p99") or 0.0))
+            if bs_errors:
+                raise RuntimeError(f"bulk errors: {bs_errors[0]}")
+            out["bulk_sustained"] = {
+                "error": None,
+                "docs_per_s": round(sum(acked) / dt, 1),
+                "seconds": round(dt, 1),
+                "writers": bs_writers,
+                "batch_docs": bs_batch,
+                "p99_visible_lag_s": round(lag_p99, 3),
+                "compaction_duty_cycle": round(
+                    ((ds.compact_seconds - compact_s0) / dt)
+                    if ds else 0.0, 4),
+                "deltas": (node.tpu_search.stats().get("deltas")
+                           if node.tpu_search else None),
+            }
+            log(f"bulk_sustained: "
+                f"{out['bulk_sustained']['docs_per_s']} docs/s over "
+                f"{out['bulk_sustained']['seconds']}s, p99 visible lag "
+                f"{out['bulk_sustained']['p99_visible_lag_s']}s, "
+                f"compaction duty "
+                f"{out['bulk_sustained']['compaction_duty_cycle']}")
+        except Exception as e:  # noqa: BLE001 — the phase must emit
+            out["bulk_sustained"]["error"] = \
+                f"{type(e).__name__}: {str(e)[:300]}"
+            log(f"bulk_sustained phase failed: "
+                f"{out['bulk_sustained']['error']}")
+
     # ---- CPU oracle baseline on the same corpus/queries ----
     segments = []
     for shard in idx.shards.values():

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 THRESHOLD = 0.15
 
 #: metrics where MORE is better — the regression ratio inverts
-HIGHER_IS_BETTER = ("rest_qps.",)
+HIGHER_IS_BETTER = ("rest_qps.", "bulk_sustained.docs_per_s")
 
 _ROUND = re.compile(r"^BENCH_r(\d+)\.json$")
 
@@ -76,6 +76,15 @@ def collect_metrics(parsed: Dict[str, Any]) -> Dict[str, float]:
         for field in ("single_process", "fronts"):
             if isinstance(rest.get(field), (int, float)):
                 out[f"rest_qps.{field}"] = float(rest[field])
+    stream = parsed.get("bulk_sustained")
+    if isinstance(stream, dict) and isinstance(
+            stream.get("docs_per_s"), (int, float)):
+        # sustained streaming ingest (higher is better); its companion
+        # p99 visible lag gates as an ordinary latency metric
+        out["bulk_sustained.docs_per_s"] = float(stream["docs_per_s"])
+        if isinstance(stream.get("p99_visible_lag_s"), (int, float)):
+            out["bulk_sustained.p99_visible_lag_s"] = \
+                float(stream["p99_visible_lag_s"])
     return out
 
 

@@ -40,7 +40,8 @@ logger = logging.getLogger("elasticsearch_tpu.events")
 
 #: incident triggers pre-seeded as zero-valued counter children so the
 #: ``es_tpu_incidents_total`` family renders before any incident fires
-INCIDENT_TRIGGERS = ("wedge", "quarantine", "batcher_death", "pack_shed")
+INCIDENT_TRIGGERS = ("wedge", "quarantine", "batcher_death", "pack_shed",
+                     "compaction_failure")
 
 _ID_SAFE = re.compile(r"[^A-Za-z0-9._-]+")
 

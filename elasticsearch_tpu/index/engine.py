@@ -22,17 +22,21 @@ of unchanged segments (the HBM image is a derived cache, §5.4).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
 import threading
+import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu.common import events
 from elasticsearch_tpu.common.errors import (
     DocumentMissingException,
     EngineClosedException,
+    TranslogDurabilityException,
     VersionConflictEngineException,
 )
 from elasticsearch_tpu.index import store as seg_store
@@ -107,6 +111,23 @@ class InternalEngine:
         self._commit_file_crcs: Dict[str, int] = {}
         self._unpersisted_seq_nos: List[int] = []
 
+        # -- translog-gated visibility state ---------------------------
+        # An op is *searchable* only once a refresh checkpoint at-or-
+        # above its seqno has been stamped; it is *searchable-durable*
+        # only once its translog sync also ran (min of the two
+        # checkpoints). `live_version` bumps whenever the live masks of
+        # already-refreshed segments mutate (update/delete tombstones,
+        # merges) — the device delta-pack path uses it to tell "new
+        # segments appended" apart from "committed rows changed".
+        self._refresh_cond = threading.Condition(self._lock)
+        self._refresh_checkpoint = NO_OPS_PERFORMED
+        self._oldest_unrefreshed_ts: Optional[float] = None
+        self.visible_lag_samples: collections.deque = collections.deque(
+            maxlen=256)
+        self.last_visible_lag_s = 0.0
+        self.live_version = 0
+        self.replayed_ops = 0  # translog ops scanned by replay (monotonic)
+
         commit = seg_store.read_commit(config.path)
         self.translog = Translog(os.path.join(config.path, "translog"),
                                  config.durability)
@@ -174,12 +195,62 @@ class InternalEngine:
             self.tracker.mark_processed(op.seq_no)
             self.tracker.mark_persisted(op.seq_no)
             count += 1
+        if count:
+            self.replayed_ops += count
+            events.emit("translog.replay", ops=count, applied=count,
+                        from_seq_no=from_seq_no, reason="startup",
+                        path=self.config.path)
         return count
+
+    def replay_tail(self, reason: str = "recovery") -> Dict[str, int]:
+        """Durability audit + repair after a crash/teardown: re-read the
+        translog tail above the last refresh checkpoint, re-apply any op
+        the in-memory state is missing (ops at-or-below the processed
+        checkpoint are already applied — scanning them proves they
+        survived), then refresh so every acked op is searchable again.
+        Emits ``translog.replay`` then ``refresh.checkpoint`` — the
+        ordered chain the chaos drill asserts."""
+        with self._lock:
+            self._ensure_open()
+            from_seq = self._refresh_checkpoint + 1
+            scanned = applied = 0
+            for op in self.translog.snapshot(from_seq):
+                scanned += 1
+                if op.seq_no <= self.tracker.processed_checkpoint:
+                    continue  # applied in memory; replay is a pure audit
+                if op.op_type == "index":
+                    self._apply_index(op.doc_id, op.source,
+                                      seq_no=op.seq_no,
+                                      primary_term=op.primary_term,
+                                      version=op.version, log=False)
+                elif op.op_type == "delete":
+                    self._apply_delete(op.doc_id, seq_no=op.seq_no,
+                                       primary_term=op.primary_term,
+                                       version=op.version, log=False)
+                self.tracker.advance_max_seq_no(op.seq_no)
+                self.tracker.mark_processed(op.seq_no)
+                self.tracker.mark_persisted(op.seq_no)
+                applied += 1
+            self.replayed_ops += scanned
+            events.emit("translog.replay", ops=scanned, applied=applied,
+                        from_seq_no=from_seq, reason=reason,
+                        path=self.config.path)
+            before = self._refresh_checkpoint
+            self.refresh()
+            if self._refresh_checkpoint == before:
+                # refresh() only stamps on advance; the drill's chain
+                # needs the checkpoint confirmed even when the tail was
+                # empty (kill landed with nothing in flight)
+                events.emit("refresh.checkpoint",
+                            seq_no=self._refresh_checkpoint,
+                            reason=reason, path=self.config.path)
+            return {"scanned": scanned, "applied": applied}
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
             self.translog.close()
+            self._refresh_cond.notify_all()  # release wait_for waiters
 
     def _ensure_open(self) -> None:
         if self._closed:
@@ -278,30 +349,46 @@ class InternalEngine:
                                        existing.version, created=False,
                                        result="noop")
 
-            self._apply_index(doc_id, source, seq_no=seq_no,
-                              primary_term=primary_term, version=new_version,
-                              log=True)
+            try:
+                self._apply_index(doc_id, source, seq_no=seq_no,
+                                  primary_term=primary_term,
+                                  version=new_version, log=True)
+            except TranslogDurabilityException:
+                self._close_refused_gap(seq_no)
+                raise
             self.tracker.mark_processed(seq_no)
             self._mark_durable(seq_no)
             return IndexResult(doc_id, seq_no, primary_term, new_version,
                                created=not is_update,
                                result="updated" if is_update else "created")
 
+    def _note_unrefreshed(self) -> None:
+        # search-visible lag is measured from the OLDEST op awaiting a
+        # refresh; the stamp clears when the refresh that covers it runs
+        if self._oldest_unrefreshed_ts is None:
+            self._oldest_unrefreshed_ts = time.monotonic()
+
     def _apply_index(self, doc_id: str, source: dict, *, seq_no: int,
                      primary_term: int, version: int, log: bool) -> None:
+        # WAL ordering: parse (can refuse — nothing mutated), then log
+        # (can refuse — nothing mutated), then apply. A translog write
+        # fault must leave NO trace of the unacked op in the engine —
+        # the refused doc is neither gettable nor searchable, exactly
+        # as after a crash-and-replay (which never saw the op either).
+        parsed = self.config.mapper.parse_document(doc_id, source)
+        if log:
+            self.translog.add(TranslogOp("index", seq_no, primary_term,
+                                         doc_id, source, version))
+        self._note_unrefreshed()
         existing = self._resolve_version(doc_id)
         if existing is not None and existing.location is not None:
             self._tombstone_location(existing.location)
-        parsed = self.config.mapper.parse_document(doc_id, source)
         ord_ = self._writer.add_document(parsed, self.config.mapper.dv_kinds(),
                                          seq_no=seq_no,
                                          primary_term=primary_term,
                                          version=version)
         self._version_map[doc_id] = VersionValue(
             seq_no, primary_term, version, False, ("buffer", ord_))
-        if log:
-            self.translog.add(TranslogOp("index", seq_no, primary_term,
-                                         doc_id, source, version))
 
     def bulk_index(self, docs: List[Tuple[str, dict]]) -> List[Any]:
         """Primary-path bulk upsert (plain index ops — create/CAS/external
@@ -317,47 +404,65 @@ class InternalEngine:
                 parsed_docs.append(mapper.parse_document(d, s))
             except Exception as exc:  # per-item failure, like _bulk items
                 parsed_docs.append(exc)
-        results: List[Any] = []  # IndexResult | Exception, aligned with docs
+        results: List[Any] = [None] * len(parsed_docs)
         tl_ops: List[TranslogOp] = []
         with self._lock:
             self._ensure_open()
-            dv_kinds = mapper.dv_kinds()
-            dv_mapper = mapper.mapper
-            for parsed in parsed_docs:
+            # WAL ordering, batch form: plan every op (versions resolved
+            # against the live map plus the batch's own earlier ops),
+            # append the whole batch to the translog, and only then
+            # mutate the engine — a refused batch leaves no trace beyond
+            # its consumed seqnos, which are closed as gaps.
+            plan: List[Tuple[int, Any, int, int, int, bool]] = []
+            overlay: Dict[str, int] = {}  # doc_id -> version within batch
+            for i, parsed in enumerate(parsed_docs):
                 if isinstance(parsed, Exception):
-                    results.append(parsed)
+                    results[i] = parsed
                     continue
                 doc_id = parsed.doc_id
-                existing = self._resolve_version(doc_id)
-                is_update = existing is not None and not existing.deleted
-                new_version = (existing.version + 1) \
-                    if existing is not None else 1
+                if doc_id in overlay:
+                    is_update = True
+                    new_version = overlay[doc_id] + 1
+                else:
+                    existing = self._resolve_version(doc_id)
+                    is_update = existing is not None and not existing.deleted
+                    new_version = (existing.version + 1) \
+                        if existing is not None else 1
+                overlay[doc_id] = new_version
                 seq_no = self.tracker.generate_seq_no()
                 primary_term = self.config.primary_term
+                tl_ops.append({"op": "index", "seq_no": seq_no,
+                               "primary_term": primary_term,
+                               "version": new_version, "id": doc_id,
+                               "source": parsed.source})
+                plan.append((i, parsed, seq_no, primary_term,
+                             new_version, is_update))
+            try:
+                self.translog.add_batch(tl_ops)
+            except TranslogDurabilityException:
+                for _i, _p, seq_no, _pt, _v, _u in plan:
+                    self._close_refused_gap(seq_no)
+                raise
+            dv_kinds = mapper.dv_kinds()  # parses done; mapping is settled
+            for i, parsed, seq_no, primary_term, new_version, is_update \
+                    in plan:
+                doc_id = parsed.doc_id
+                self._note_unrefreshed()
+                existing = self._resolve_version(doc_id)
                 if existing is not None and existing.location is not None:
                     self._tombstone_location(existing.location)
-                if mapper.mapper is not dv_mapper:  # dynamic field mid-batch
-                    dv_kinds = mapper.dv_kinds()
-                    dv_mapper = mapper.mapper
                 ord_ = self._writer.add_document(
                     parsed, dv_kinds, seq_no=seq_no,
                     primary_term=primary_term, version=new_version)
                 self._version_map[doc_id] = VersionValue(
                     seq_no, primary_term, new_version, False,
                     ("buffer", ord_))
-                tl_ops.append({"op": "index", "seq_no": seq_no,
-                               "primary_term": primary_term,
-                               "version": new_version, "id": doc_id,
-                               "source": parsed.source})
-                results.append(IndexResult(
+                results[i] = IndexResult(
                     doc_id, seq_no, primary_term, new_version,
                     created=not is_update,
-                    result="updated" if is_update else "created"))
-            self.translog.add_batch(tl_ops)
-            for r in results:
-                if isinstance(r, IndexResult):
-                    self.tracker.mark_processed(r.seq_no)
-                    self._mark_durable(r.seq_no)
+                    result="updated" if is_update else "created")
+                self.tracker.mark_processed(seq_no)
+                self._mark_durable(seq_no)
         return results
 
     def delete(self, doc_id: str, *,
@@ -390,23 +495,40 @@ class InternalEngine:
             # version stays monotonic across repeated deletes while the
             # tombstone is retained (same continuity rule as index())
             version = (existing.version + 1) if existing is not None else 1
-            self._apply_delete(doc_id, seq_no=seq_no,
-                               primary_term=primary_term, version=version,
-                               log=True)
+            try:
+                self._apply_delete(doc_id, seq_no=seq_no,
+                                   primary_term=primary_term,
+                                   version=version, log=True)
+            except TranslogDurabilityException:
+                self._close_refused_gap(seq_no)
+                raise
             self.tracker.mark_processed(seq_no)
             self._mark_durable(seq_no)
             return DeleteResult(doc_id, seq_no, primary_term, version, found)
 
     def _apply_delete(self, doc_id: str, *, seq_no: int, primary_term: int,
                       version: int, log: bool) -> None:
+        # same WAL ordering as _apply_index: log before apply so a
+        # refused translog write leaves the tombstone un-applied
+        if log:
+            self.translog.add(TranslogOp("delete", seq_no, primary_term,
+                                         doc_id, None, version))
+        self._note_unrefreshed()
         existing = self._resolve_version(doc_id)
         if existing is not None and existing.location is not None:
             self._tombstone_location(existing.location)
         self._version_map[doc_id] = VersionValue(
             seq_no, primary_term, version, True, None)
-        if log:
-            self.translog.add(TranslogOp("delete", seq_no, primary_term,
-                                         doc_id, None, version))
+
+    def _close_refused_gap(self, seq_no: int) -> None:
+        """A write fault refused the op AFTER its seqno was issued: that
+        number now maps to no operation, ever (a crash-and-replay never
+        sees it either — WAL ordering kept it out of the translog). Mark
+        it processed+persisted so the contiguous checkpoints — and
+        everything gated on them: refresh visibility, wait_for_visible,
+        the async fsync cycle — don't wedge on the hole."""
+        self.tracker.mark_processed(seq_no)
+        self.tracker.mark_persisted(seq_no)
 
     def no_op(self, seq_no: int, primary_term: int, reason: str) -> None:
         """Seqno gap filler (reference: NoOp on primary failover)."""
@@ -503,15 +625,68 @@ class InternalEngine:
                     if seg_name in self._live:
                         self._live[seg_name][ord_] = False
                 self._pending_seg_deletes = []
+                # committed rows mutated in place — any device image of
+                # those segments (base or delta chain) is stale
+                self.live_version += 1
                 changed = True
             if changed or self._reader is None:
                 self._reader = ShardReader(
                     [(s, self._live[s.name]) for s in self._segments],
                     self.config.mapper, self.config.k1, self.config.b,
                     packs=self._packs_cache)
+                self._reader.live_version = self.live_version
                 self._packs_cache = {v.segment.name: v.pack
                                      for v in self._reader.views}
+            self._stamp_refresh_checkpoint()
             return changed
+
+    def _stamp_refresh_checkpoint(self) -> None:
+        """Called under the engine lock at the end of every refresh:
+        everything at-or-below the processed checkpoint is now in the
+        swapped-in reader, so the visibility watermark advances."""
+        new_ckpt = self.tracker.processed_checkpoint
+        if self._oldest_unrefreshed_ts is not None:
+            lag = time.monotonic() - self._oldest_unrefreshed_ts
+            self.last_visible_lag_s = lag
+            self.visible_lag_samples.append(lag)
+            self._oldest_unrefreshed_ts = None
+        if new_ckpt > self._refresh_checkpoint:
+            self._refresh_checkpoint = new_ckpt
+            events.emit("refresh.checkpoint", seq_no=new_ckpt,
+                        path=self.config.path)
+        self._refresh_cond.notify_all()
+
+    # -- visibility contract -------------------------------------------
+
+    @property
+    def refresh_checkpoint(self) -> int:
+        """Max seqno whose op is searchable (stamped at refresh)."""
+        return self._refresh_checkpoint
+
+    @property
+    def visible_durable_checkpoint(self) -> int:
+        """Max seqno that is BOTH searchable and fsync'd to the
+        translog — the only watermark an async-durability caller may
+        report as "searchable-durable" (satellite: the async path stays
+        honest; an op never counts before its translog sync)."""
+        return min(self._refresh_checkpoint,
+                   self.tracker.persisted_checkpoint)
+
+    def wait_for_visible(self, seq_no: int, timeout_s: float = 10.0) -> bool:
+        """Block until a refresh checkpoint covers ``seq_no`` (the
+        `refresh=wait_for` contract: ride the scheduled refresh cycle
+        instead of forcing a segment per request). Returns False on
+        timeout — callers fall back to an explicit refresh."""
+        deadline = time.monotonic() + timeout_s
+        with self._refresh_cond:
+            while self._refresh_checkpoint < seq_no:
+                if self._closed:
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._refresh_cond.wait(remaining)
+            return True
 
     def flush(self) -> None:
         """Commit: refresh + persist segments + manifest, then roll/trim
@@ -575,9 +750,11 @@ class InternalEngine:
                     if ord_ is not None:
                         vv.location = ("segment", merged.name, ord_)
             self._packs_cache = {}
+            self.live_version += 1  # segment set restructured in place
             self._reader = ShardReader(
                 [(merged, self._live[merged.name])], self.config.mapper,
                 self.config.k1, self.config.b)
+            self._reader.live_version = self.live_version
             self._packs_cache = {v.segment.name: v.pack
                                  for v in self._reader.views}
             return True
@@ -610,10 +787,20 @@ class InternalEngine:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            lag = list(self.visible_lag_samples)
             return {
                 "num_docs": self.num_docs(),
                 "segments": len(self._segments),
                 "max_seq_no": self.tracker.max_seq_no,
                 "local_checkpoint": self.tracker.processed_checkpoint,
+                "persisted_checkpoint": self.tracker.persisted_checkpoint,
+                "refresh_checkpoint": self._refresh_checkpoint,
+                "visible_durable_checkpoint":
+                    self.visible_durable_checkpoint,
+                "replayed_ops": self.replayed_ops,
+                "search_visible_lag_seconds": {
+                    "last": self.last_visible_lag_s,
+                    "p99": (float(np.percentile(lag, 99)) if lag else 0.0),
+                },
                 "translog": self.translog.stats(),
             }

@@ -40,6 +40,12 @@ class ShardReader:
         self.mapper = mapper
         self.k1 = k1
         self.b = b
+        # stamped by the engine: bumps when committed live masks mutate
+        # (update/delete tombstones, merges). The device delta-pack path
+        # chains small delta packs only while this is unchanged AND the
+        # old segment set is a prefix of this reader's — otherwise the
+        # resident image needs a full rebuild.
+        self.live_version = 0
         self.views: List[SegmentView] = []
         packs = packs or {}
         for seg, live in segments:
@@ -73,6 +79,10 @@ class ShardReader:
 
     def num_docs(self) -> int:
         return sum(int(v.live_mask.sum()) for v in self.views)
+
+    def segment_names(self) -> Tuple[str, ...]:
+        """Ordered segment names — the delta-pack coverage key."""
+        return tuple(v.segment.name for v in self.views)
 
     def max_docs(self) -> int:
         return sum(v.segment.num_docs for v in self.views)

@@ -129,6 +129,16 @@ class IndexShard:
     def refresh(self) -> bool:
         return self.engine.refresh()
 
+    def wait_for_visible(self, seq_no: int, timeout_s: float = 10.0) -> bool:
+        """`refresh=wait_for`: block until a refresh checkpoint covers
+        seq_no (False on timeout — caller decides whether to force)."""
+        return self.engine.wait_for_visible(seq_no, timeout_s)
+
+    def replay_visibility(self, reason: str = "recovery") -> Dict[str, int]:
+        """Replay the translog tail above the last refresh checkpoint so
+        every acked op is searchable again (crash/teardown recovery)."""
+        return self.engine.replay_tail(reason=reason)
+
     def flush(self) -> None:
         self.engine.flush()
 

@@ -353,4 +353,5 @@ class Translog:
         for _ in self.snapshot():
             ops += 1
         return {"operations": ops, "size_in_bytes": size,
-                "generation": self.checkpoint.generation}
+                "generation": self.checkpoint.generation,
+                "uncommitted_operations": self._unsynced}

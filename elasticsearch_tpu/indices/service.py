@@ -160,6 +160,13 @@ class IndexService:
         self._k1 = settings.get_float("index.similarity.default.k1", 1.2)
         self._b = settings.get_float("index.similarity.default.b", 0.75)
         self._durability = settings.get("index.translog.durability", "request")
+        if self._durability not in ("request", "async"):
+            raise IllegalArgumentException(
+                f"[index.translog.durability] must be [request] or "
+                f"[async], got [{self._durability}]")
+        # async-durability fsync cadence; <= 0 means the node default
+        self.sync_interval_s = settings.get_float(
+            "index.translog.sync_interval_seconds", -1.0)
         from elasticsearch_tpu.common.logging import SlowLog
         self.search_slowlog = SlowLog(name, settings)
 
@@ -208,7 +215,9 @@ class IndexService:
 
     DYNAMIC_PREFIXES = ("index.search.slowlog.threshold.",)
     DYNAMIC_KEYS = ("index.number_of_replicas", "index.default_pipeline",
-                    "index.blocks.write", "index.blocks.read_only")
+                    "index.blocks.write", "index.blocks.read_only",
+                    "index.translog.durability",
+                    "index.translog.sync_interval_seconds")
 
     @classmethod
     def validate_dynamic_settings(cls, changes: Dict[str, Any]) -> None:
@@ -227,18 +236,42 @@ class IndexService:
                     raise IllegalArgumentException(
                         f"[index.number_of_replicas] must be a "
                         f"non-negative integer, got [{value}]") from None
+            if (key == "index.translog.durability"
+                    and value not in ("request", "async")):
+                raise IllegalArgumentException(
+                    f"[index.translog.durability] must be [request] or "
+                    f"[async], got [{value}]")
 
     def apply_dynamic_settings(self, changes: Dict[str, Any]) -> None:
         """Apply validated dynamic changes to this open index."""
         self.settings.update_dynamic(changes)
         self.num_replicas = self.settings.get_int(
             "index.number_of_replicas", self.num_replicas)
+        if "index.translog.durability" in changes:
+            self._durability = self.settings.get(
+                "index.translog.durability", self._durability)
+            for s in self.shards.values():
+                s.engine.config.durability = self._durability
+                s.engine.translog.durability = self._durability
+        self.sync_interval_s = self.settings.get_float(
+            "index.translog.sync_interval_seconds", self.sync_interval_s)
         from elasticsearch_tpu.common.logging import SlowLog
         self.search_slowlog = SlowLog(self.name, self.settings)
 
     def refresh(self) -> None:
         for s in self.shards.values():
             s.refresh()
+
+    def replay_visibility(self, reason: str = "recovery") -> Dict[str, int]:
+        """Replay every local shard's translog tail above its refresh
+        checkpoint (crash/teardown recovery: makes every acked write
+        searchable again before pack re-residency rebuilds)."""
+        total = {"scanned": 0, "applied": 0}
+        for s in self.shards.values():
+            r = s.replay_visibility(reason=reason)
+            total["scanned"] += r["scanned"]
+            total["applied"] += r["applied"]
+        return total
 
     def flush(self) -> None:
         for s in self.shards.values():

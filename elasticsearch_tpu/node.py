@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
@@ -174,6 +175,19 @@ class Node:
                         "search.tpu_serving.placement.groups", 1),
                     "replicas": self.settings.get_int(
                         "search.tpu_serving.placement.replicas", 1),
+                },
+                # streaming delta packs: append-only refreshes ride as
+                # small device-resident deltas unioned into results; a
+                # background compactor folds chains back into the
+                # compressed base (disabled automatically under
+                # placement — replica groups must stay byte-identical)
+                delta={
+                    "enabled": self.settings.get_bool(
+                        "search.tpu_serving.delta.enabled", True),
+                    "max_packs": self.settings.get_int(
+                        "search.tpu_serving.delta.max_packs", 4),
+                    "max_docs": self.settings.get_int(
+                        "search.tpu_serving.delta.max_docs", 50_000),
                 })
             # recovery's eager re-residency resolves index names through
             # the live indices service
@@ -870,6 +884,46 @@ class Node:
             yield ("merge.pool_size", {},
                    pool.size if pool is not None else 0, "gauge")
         reg.add_collector(_merge)
+        reg.set_help("delta.packs",
+                     "Device-resident delta packs currently chained")
+        reg.set_help("delta.bytes",
+                     "HBM bytes held by resident delta packs")
+        reg.set_help("delta.appends",
+                     "Delta packs built from append-only refreshes")
+        reg.set_help("delta.compactions",
+                     "Delta chains folded back into their base pack")
+        reg.set_help("delta.compaction_failures",
+                     "Compactions that failed (chain kept serving)")
+        reg.set_help("delta.replayed_ops",
+                     "Translog ops replayed for search visibility")
+        reg.set_help("delta.search_visible_lag_seconds",
+                     "Worst current indexed-to-searchable lag across shards")
+
+        def _deltas():
+            # always present (zero-valued with the delta path off) so
+            # the es_tpu_delta_* families never vanish from a scrape
+            svc = self.tpu_search
+            ds = svc.delta_stats if svc is not None else None
+            packs, nbytes = (svc.packs.delta_totals()
+                             if svc is not None else (0, 0))
+            replayed = ds.replayed_ops if ds is not None else 0
+            lag = 0.0
+            for index_service in self.indices.indices.values():
+                for shard in index_service.shards.values():
+                    replayed += shard.engine.replayed_ops
+                    lag = max(lag, shard.engine.last_visible_lag_s)
+            yield ("delta.packs", {}, packs, "gauge")
+            yield ("delta.bytes", {}, nbytes, "gauge")
+            yield ("delta.appends", {},
+                   ds.appends if ds is not None else 0, "counter")
+            yield ("delta.compactions", {},
+                   ds.compactions if ds is not None else 0, "counter")
+            yield ("delta.compaction_failures", {},
+                   ds.compaction_failures if ds is not None else 0,
+                   "counter")
+            yield ("delta.replayed_ops", {}, replayed, "counter")
+            yield ("delta.search_visible_lag_seconds", {}, lag, "gauge")
+        reg.add_collector(_deltas)
 
     def _register_actions(self) -> None:
         from elasticsearch_tpu.rest.actions import (admin, aliases, cluster,
@@ -921,6 +975,11 @@ class Node:
 
     def start_refresher(self) -> None:
         """The 1s refresh cycle (reference: IndexService#refreshTask §3.2)."""
+        # refresh=wait_for blocks on the visibility checkpoint only when
+        # this cycle is running (otherwise nothing would ever refresh —
+        # the handler forces a refresh instead)
+        self.refresher_active = True
+
         def tick():
             if self._closed:
                 return
@@ -943,21 +1002,40 @@ class Node:
         # the async-durability fsync cycle (reference: 5s translog sync
         # timer) — advances the persisted checkpoint for durability=async
         # shards and bounds the unpersisted-seqno backlog
+        last_sync: Dict[str, float] = {}
+
+        def sync_delay() -> float:
+            # tick at the finest configured cadence so a per-index
+            # index.translog.sync_interval_seconds SHORTER than the node
+            # default is honored, not just longer ones
+            delay = self._sync_interval
+            for svc in list(self.indices.indices.values()):
+                per = getattr(svc, "sync_interval_s", -1.0)
+                if per > 0:
+                    delay = min(delay, per)
+            return max(0.05, delay)
+
         def sync_tick():
             if self._closed:
                 return
             try:
+                now = time.monotonic()
                 for svc in list(self.indices.indices.values()):
+                    per = getattr(svc, "sync_interval_s", -1.0)
+                    interval = per if per > 0 else self._sync_interval
+                    if now - last_sync.get(svc.name, 0.0) < interval - 1e-3:
+                        continue
+                    last_sync[svc.name] = now
                     for shard in list(svc.shards.values()):
                         try:
                             shard.engine.sync_translog()
                         except Exception:  # noqa: BLE001 — background task
                             pass
             finally:  # the cycle must survive any error
-                self._syncer = threading.Timer(self._sync_interval, sync_tick)
+                self._syncer = threading.Timer(sync_delay(), sync_tick)
                 self._syncer.daemon = True
                 self._syncer.start()
-        self._syncer = threading.Timer(self._sync_interval, sync_tick)
+        self._syncer = threading.Timer(sync_delay(), sync_tick)
         self._syncer.daemon = True
         self._syncer.start()
 
@@ -965,6 +1043,7 @@ class Node:
         if self._closed:
             return
         self._closed = True
+        self.refresher_active = False
         if self._refresher:
             self._refresher.cancel()
         if self._syncer:

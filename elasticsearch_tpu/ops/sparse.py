@@ -932,3 +932,30 @@ def eager_impacts(flat_docs: np.ndarray, flat_tfs: np.ndarray,
     with np.errstate(divide="ignore", invalid="ignore"):
         imp = tf / (tf + denom_add)
     return np.where(flat_tfs > 0, imp, 0.0).astype(np.float32)
+
+
+def union_topk(scores_list, rows_list, ords_list, row_offsets, k: int):
+    """Union-reduce per-pack kernel top-k columns (streaming delta path).
+
+    The base pack and each resident delta pack run the device merge
+    kernel independently; a doc lives in exactly one pack (deltas are
+    append-only — an update of a committed doc forces a full rebuild),
+    so the union is a pure k-way top-k over disjoint candidate sets: no
+    dedup, totals add. Rows re-base into the concatenated union row
+    space via ``row_offsets`` (per-pack starting row). Ties break by
+    (score desc, pack order, in-pack kernel rank) so the reduce is
+    deterministic and is the identity for a single operand.
+    """
+    scores = np.concatenate([np.asarray(s) for s in scores_list])
+    rows = np.concatenate(
+        [np.asarray(r, dtype=np.int64) + int(off)
+         for r, off in zip(rows_list, row_offsets)])
+    ords = np.concatenate([np.asarray(o) for o in ords_list])
+    pack_tag = np.concatenate(
+        [np.full(len(np.asarray(s)), i, dtype=np.int32)
+         for i, s in enumerate(scores_list)])
+    rank = np.concatenate(
+        [np.arange(len(np.asarray(s)), dtype=np.int32)
+         for s in scores_list])
+    order = np.lexsort((rank, pack_tag, -scores))[:k]
+    return scores[order], rows[order], ords[order]

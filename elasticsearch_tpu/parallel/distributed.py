@@ -117,7 +117,9 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
                        live_docs: Optional[Sequence[Optional[np.ndarray]]] = None,
                        k1: float = 1.2, b: float = 0.75,
                        pad_shards_to: Optional[int] = None,
-                       row_groups: Optional[Sequence[int]] = None
+                       row_groups: Optional[Sequence[int]] = None,
+                       pad_docs_to: Optional[int] = None,
+                       pad_postings_to: Optional[int] = None
                        ) -> StackedShardPack:
     """Each segment is one doc-axis shard (SURVEY.md §2.3 P1). Shapes pad to
     the max across shards + CHUNK_CAP slack so chunk slices never clamp.
@@ -125,7 +127,11 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
     row_groups[i] (optional) assigns segment i to a statistics group — one
     group per real index shard reproduces per-shard idf/avgdl (the
     reference's query_then_fetch). Omitted → one index-level group
-    (dfs_query_then_fetch)."""
+    (dfs_query_then_fetch).
+
+    pad_docs_to / pad_postings_to (optional) force the doc and posting
+    axes to at least those sizes — the streaming delta path buckets
+    shapes so successive small packs share compiled kernel signatures."""
     from elasticsearch_tpu.index.pack import build_field_pack
 
     s_real = len(segments)
@@ -134,9 +140,18 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
         raise ValueError(
             f"pad_shards_to={s} < {s_real} segments (would drop shards)")
     d_pad = max(_pad_to(seg.num_docs) for seg in segments)
+    if pad_docs_to is not None:
+        if pad_docs_to < d_pad:
+            raise ValueError(f"pad_docs_to={pad_docs_to} < d_pad={d_pad}")
+        d_pad = pad_docs_to
     packs = [build_field_pack(seg, field, d_pad) for seg in segments]
     p_pad = max((p.flat_docs.shape[0] for p in packs if p is not None),
                 default=LANE) + CHUNK_CAP
+    if pad_postings_to is not None:
+        if pad_postings_to < p_pad:
+            raise ValueError(
+                f"pad_postings_to={pad_postings_to} < p_pad={p_pad}")
+        p_pad = pad_postings_to
     flat_docs = np.full((s, p_pad), d_pad, dtype=np.int32)
     flat_tfs = np.zeros((s, p_pad), dtype=np.int32)
     norms = np.zeros((s, d_pad), dtype=np.uint8)
@@ -208,6 +223,44 @@ def build_stacked_pack(segments: Sequence[Segment], field: str,
                             shard_num_docs, shard_doc_ids, total_docs, avgdl,
                             df, k1, b, row_group=groups, group_df=group_df,
                             group_doc_count=group_doc_count)
+
+
+def _shape_bucket(n: int, floor: int) -> int:
+    """Smallest power-of-two-scaled multiple of `floor` that covers n."""
+    b = max(floor, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def build_delta_pack(segments: Sequence[Segment], field: str,
+                     live_docs: Optional[Sequence[Optional[np.ndarray]]] = None,
+                     k1: float = 1.2, b: float = 0.75,
+                     pad_shards_to: Optional[int] = None,
+                     row_groups: Optional[Sequence[int]] = None
+                     ) -> StackedShardPack:
+    """Small immutable pack for the streaming (LSM) delta path: identical
+    format to `build_stacked_pack`, with two contracts layered on top.
+
+    1. Shapes are padded UP to power-of-two buckets (doc axis from LANE,
+       posting axis from 2*CHUNK_CAP) so a steady stream of small deltas
+       reuses compiled kernel signatures — a per-delta XLA compile would
+       dominate the append path and unbound the search-visible lag.
+    2. Statistics partition: impacts bake `group_avgdl[row_group[i]]` at
+       BUILD time, so a delta pack's scores reflect the stats of ITS OWN
+       rows only (per-(delta,shard) groups). A full-rebuild oracle is
+       bit-comparable to base ∪ deltas only when built with the same
+       row_group partition — callers own that alignment."""
+    d_raw = max(_pad_to(seg.num_docs) for seg in segments)
+    from elasticsearch_tpu.index.pack import build_field_pack
+    probe = [build_field_pack(seg, field, d_raw) for seg in segments]
+    p_raw = max((p.flat_docs.shape[0] for p in probe if p is not None),
+                default=LANE) + CHUNK_CAP
+    return build_stacked_pack(
+        segments, field, live_docs=live_docs, k1=k1, b=b,
+        pad_shards_to=pad_shards_to, row_groups=row_groups,
+        pad_docs_to=_shape_bucket(d_raw, LANE),
+        pad_postings_to=_shape_bucket(p_raw, 2 * CHUNK_CAP))
 
 
 @dataclasses.dataclass

@@ -11,10 +11,13 @@ aborting otherwise) — stale snapshot data never silently overwrites a
 newer document. conflicts=proceed forgives ONLY version conflicts;
 any other bulk error aborts regardless.
 
-Like scroll itself, these run where every target shard is local (the
-cluster-remote case 400s rather than silently misbehaving). Documents
-indexed under CUSTOM ?routing= are out of scope: _routing is not
-persisted per doc, so by-query ops target shards by _id."""
+Scroll contexts are node-local; when a cluster-remote layout can't pin
+one, the source falls back to a `_doc`-sorted search_after walk through
+the distributed search path (no pinned snapshot, but every write is
+still guarded by its snapshot seq_no — a doc mutated mid-walk is a
+version_conflict, never a silent overwrite). Documents indexed under
+CUSTOM ?routing= are out of scope: _routing is not persisted per doc,
+so by-query ops target shards by _id."""
 
 from __future__ import annotations
 
@@ -36,14 +39,25 @@ class _Abort(Exception):
 
 def _scroll_source(node, index: str, query: Optional[dict],
                    batch_size: int, seq_no_primary_term: bool):
-    """Yield scroll pages (lists of hits) over a pinned snapshot."""
+    """Yield scroll pages (lists of hits) over a pinned snapshot.
+    Cluster-remote layouts can't pin a node-local scroll context: fall
+    back to a `_doc`-sorted search_after walk through the distributed
+    search path (same pages; the per-op seq_no guards stand in for the
+    snapshot) instead of 400ing the whole by-query request."""
     body: Dict[str, Any] = {"query": query or {"match_all": {}},
                             "sort": ["_doc"], "size": batch_size}
     if seq_no_primary_term:
         body["seq_no_primary_term"] = True
-    page = scroll_mod.start_scroll(node, index, body,
-                                   {"scroll": SCROLL_KEEPALIVE,
-                                    "size": str(batch_size)})
+    try:
+        page = scroll_mod.start_scroll(node, index, body,
+                                       {"scroll": SCROLL_KEEPALIVE,
+                                        "size": str(batch_size)})
+    except IllegalArgumentException as exc:
+        if "distributed contexts" not in str(exc):
+            raise
+        yield from _search_after_source(node, index, query, batch_size,
+                                        seq_no_primary_term)
+        return
     sid = page["_scroll_id"]
     try:
         while True:
@@ -54,6 +68,34 @@ def _scroll_source(node, index: str, query: Optional[dict],
             page = scroll_mod.next_page(node, sid, SCROLL_KEEPALIVE)
     finally:
         scroll_mod.clear(node, [sid])
+
+
+def _search_after_source(node, index: str, query: Optional[dict],
+                         batch_size: int, seq_no_primary_term: bool):
+    """The scroll-free source: an ordinary `_doc`-ordered search_after
+    walk through the full (possibly distributed) search path — the same
+    walk `_remote_source` asks a remote cluster to run."""
+    cursor = None
+    while True:
+        body: Dict[str, Any] = {"query": query or {"match_all": {}},
+                                "sort": ["_doc"], "size": batch_size}
+        if seq_no_primary_term:
+            body["seq_no_primary_term"] = True
+        if cursor is not None:
+            body["search_after"] = cursor
+        status, resp = node.handle("POST", f"/{index}/_search", {}, body)
+        if status != 200:
+            raise IllegalArgumentException(
+                f"[by-query] search_after walk failed ({status}): "
+                f"{resp}")
+        hits = resp["hits"]["hits"]
+        if not hits:
+            return
+        yield hits
+        cursor = hits[-1].get("sort")
+        if cursor is None:
+            raise IllegalArgumentException(
+                "[by-query] search did not return sort cursors")
 
 
 def _remote_source(node, cluster_alias: str, index: str,

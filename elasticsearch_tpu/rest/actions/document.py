@@ -65,6 +65,22 @@ def run_ingest_pipeline(node, svc, body: dict, params
     return pipeline.execute(body), pid
 
 
+def _apply_refresh(node, shard, params, seq_no: int) -> None:
+    """refresh= handling for a single-doc write. `wait_for` blocks on
+    the shard's visibility checkpoint (the background NRT cycle does
+    the refreshing) instead of forcing an immediate refresh — the
+    reference semantics — and falls back to a forced refresh when no
+    refresher is running or the wait times out, so the contract
+    ("searchable when the call returns") always holds."""
+    refresh = params.get("refresh")
+    if refresh not in ("", "true", "wait_for"):
+        return
+    if refresh == "wait_for" and getattr(node, "refresher_active", False):
+        if shard.wait_for_visible(seq_no):
+            return
+    shard.refresh()
+
+
 def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
                    op_type: str = "index",
                    shard_num: Optional[int] = None) -> Tuple[int, Dict]:
@@ -100,8 +116,7 @@ def exec_index_doc(node, index: str, doc_id: Optional[str], body, params,
             kwargs["version_type"] = params.get("version_type", "internal")
         result = shard.apply_index_on_primary(created_id, body, **kwargs)
         node.replicate("index", index, shard_num, created_id, body, result)
-        if params.get("refresh") in ("", "true", "wait_for"):
-            shard.refresh()
+        _apply_refresh(node, shard, params, result.seq_no)
         status = 201 if result.created else 200
         return status, {
             "_index": index, "_id": result.doc_id,
@@ -136,8 +151,7 @@ def exec_delete_doc(node, index: str, doc_id: str, params,
         shard = svc.shard(shard_num)
         result = shard.apply_delete_on_primary(doc_id)
         node.replicate("delete", index, shard_num, doc_id, None, result)
-        if params.get("refresh") in ("", "true", "wait_for"):
-            shard.refresh()
+        _apply_refresh(node, shard, params, result.seq_no)
     if not result.found:
         return 404, {"_index": index, "_id": doc_id,
                      "result": "not_found", "_version": result.version,
@@ -251,16 +265,14 @@ def _exec_update_doc(node, index: str, doc_id: str, body, params,
     if op == "delete":
         result = shard.apply_delete_on_primary(doc_id)
         node.replicate("delete", index, shard_num, doc_id, None, result)
-        if params.get("refresh") in ("", "true", "wait_for"):
-            shard.refresh()
+        _apply_refresh(node, shard, params, result.seq_no)
         return 200, {"_index": index, "_id": doc_id,
                      "_version": result.version, "result": "deleted",
                      "_seq_no": result.seq_no,
                      "_primary_term": result.primary_term}
     result = shard.apply_index_on_primary(doc_id, merged)
     node.replicate("index", index, shard_num, doc_id, merged, result)
-    if params.get("refresh") in ("", "true", "wait_for"):
-        shard.refresh()
+    _apply_refresh(node, shard, params, result.seq_no)
     return 200, {"_index": index, "_id": doc_id,
                  "_version": result.version, "result": result.result,
                  "_seq_no": result.seq_no,
@@ -309,6 +321,7 @@ def parse_bulk_body(raw: str, default_index: Optional[str]
 
 def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
                    refresh: bool = False,
+                   wait_for: bool = False,
                    pressure_stage: str = "coordinating"
                    ) -> List[Dict[str, Any]]:
     """Apply parsed bulk ops against LOCAL shards; returns response items
@@ -369,6 +382,13 @@ def apply_bulk_ops(node, ops: List[Dict[str, Any]], *,
                 i += 1
         if refresh:
             for shard in refresh_shards:
+                # refresh=wait_for rides the background NRT cycle: wait
+                # until the shard's visibility checkpoint covers every
+                # op this request applied (its local checkpoint), and
+                # only force a refresh when no cycle runs / wait times out
+                if wait_for and getattr(node, "refresher_active", False):
+                    if shard.wait_for_visible(shard.local_checkpoint):
+                        continue
                 shard.refresh()
         return items  # type: ignore[return-value]
     finally:
@@ -691,7 +711,9 @@ def register(controller: RestController, node) -> None:
         if node.cluster is not None:
             items = node.cluster.route_bulk(ops, refresh=refresh)
         else:
-            items = apply_bulk_ops(node, ops, refresh=refresh)
+            items = apply_bulk_ops(
+                node, ops, refresh=refresh,
+                wait_for=req.param("refresh") == "wait_for")
         return 200, {"took": int((time.perf_counter() - t0) * 1000),
                      "errors": bulk_has_errors(items), "items": items}
 

@@ -369,6 +369,117 @@ class ResidentPack:
         return self.id_cat[self.row_offset[rows] + ords]
 
 
+# -- streaming delta chain (LSM resident path) ------------------------------
+#
+# Append-only refreshes build a SMALL delta pack from only the new
+# segments instead of re-placing the whole (index, field) image; searches
+# run the kernel on base + each delta and union the per-pack top-ks
+# host-side (ops/sparse.union_topk). A background compactor folds the
+# chain back into one full (compressed) base pack. A doc lives in exactly
+# one pack: an update/delete of a committed doc mutates a live mask,
+# which bumps the engine's live_version and forces a full rebuild — the
+# delta path is append-only by construction.
+
+#: chaos seam (tests): each hook is called with the (index, field) key at
+#: the top of every compaction and may block or raise — "kill lands
+#: mid-compaction" is a hook that parks until the batcher dies.
+COMPACTION_FAULT_HOOKS: List[Any] = []
+
+
+@dataclasses.dataclass
+class DeltaStats:
+    """Node-wide delta lifecycle counters (rendered by node.py as the
+    ``es_tpu_delta_*`` Prometheus families)."""
+
+    appends: int = 0              # delta packs built
+    seals: int = 0                # delta packs made immutable on device
+    compactions: int = 0
+    compaction_failures: int = 0
+    replayed_ops: int = 0         # via supervisor recovery replay
+    compact_seconds: float = 0.0  # cumulative wall time folding chains
+
+
+@dataclasses.dataclass
+class _ChainMeta:
+    """What the delta chain currently covers, per shard: the chain serves
+    exactly `reader_key`; a new reader is delta-eligible iff every
+    shard's covered segments are a PREFIX of its segments and its
+    live_version is unchanged."""
+
+    reader_key: Tuple
+    covered: Dict[int, Tuple[str, ...]]
+    live_versions: Dict[int, int]
+    union: Optional["_UnionView"] = None
+
+
+@dataclasses.dataclass
+class PackChain:
+    """Resolved residency for one (index, field): the base pack, the
+    delta packs chained on it, and the row-space view results resolve
+    against (`base` itself when the chain is empty)."""
+
+    base: ResidentPack
+    deltas: Tuple[ResidentPack, ...]
+    view: Any
+    reader_key: Tuple
+
+
+class _UnionView:
+    """Read-only facade over base + delta packs presenting ONE
+    concatenated row/id space to the fetch phase. Pack i's kernel rows
+    re-base by ``offsets[i]`` (running sum of padded row counts); id
+    ordinals re-base via concatenated row_offset/id_cat tables. Exposes
+    exactly the members the serializer and columnar fetch consume
+    (resolve_ids / row_origin / row_segments / row_shard / readers)."""
+
+    def __init__(self, packs: List[ResidentPack]):
+        self.packs = tuple(packs)
+        offsets: List[int] = []
+        off = 0
+        id_off = 0
+        row_origin: List[Tuple[int, str]] = []
+        row_segments: List[Any] = []
+        shard_parts, off_parts, id_parts = [], [], []
+        for p in self.packs:
+            offsets.append(off)
+            s_pad = p.pack.num_shards
+            ro = list(p.row_origin)
+            ro += [(-1, "")] * (s_pad - len(ro))
+            row_origin.extend(ro)
+            rs = list(p.row_segments or ())
+            rs += [None] * (s_pad - len(rs))
+            row_segments.extend(rs)
+            shard_parts.append(p.row_shard)
+            off_parts.append(p.row_offset + id_off)
+            id_parts.append(p.id_cat)
+            id_off += len(p.id_cat)
+            off += s_pad
+        self.offsets = tuple(offsets)
+        self.row_origin = row_origin
+        self.row_segments = row_segments
+        self.row_shard = np.concatenate(shard_parts)
+        self.row_offset = np.concatenate(off_parts)
+        self.id_cat = np.concatenate(id_parts)
+        base = self.packs[0]
+        self.pack = base.pack          # stats consumers see the base
+        self.readers = base.readers
+        self.reader_key = base.reader_key  # kept current by the chain
+        self.hbm_bytes = sum(int(p.hbm_bytes) for p in self.packs)
+        self.hbm_detail = dict(base.hbm_detail)
+        self.comp_streams = None
+        self.group_mesh = base.group_mesh
+        self.group_id = base.group_id
+
+    @property
+    def compressed(self) -> bool:
+        return False
+
+    def resolve_ids(self, rows: np.ndarray, ords: np.ndarray) -> np.ndarray:
+        if len(rows) == 0:
+            return np.empty(0, dtype=object)
+        return self.id_cat[self.row_offset[rows] + ords]
+
+
 class IndexPackCache:
     """Builds and caches the StackedShardPack for an (index, field).
 
@@ -403,6 +514,15 @@ class IndexPackCache:
         # shrunken headroom before rebuilding anything.
         self._heat: Dict[Tuple[str, str], float] = {}
         self._last_bytes: Dict[Tuple[str, str], int] = {}
+        # -- streaming delta chain state -------------------------------
+        self.delta_enabled = False
+        self.delta_max_packs = 4       # chain length that requests a fold
+        self.delta_max_docs = 50_000   # total delta docs that request one
+        self.delta_stats: Optional[DeltaStats] = None
+        self.on_compact_needed = None  # callable(key), set by the service
+        self._deltas: Dict[Tuple[str, str], List[ResidentPack]] = {}
+        self._chain_meta: Dict[Tuple[str, str], _ChainMeta] = {}
+        self._services: Dict[Tuple[str, str], Any] = {}  # compactor's map
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -411,10 +531,25 @@ class IndexPackCache:
             # of the compressed-pack capacity win
             packs = {f"{idx}/{field}": dict(entry.hbm_detail)
                      for (idx, field), entry in self._cache.items()}
+            deltas = {
+                f"{idx}/{field}": {
+                    "packs": len(lst),
+                    "bytes": sum(int(p.hbm_bytes) for p in lst),
+                    "docs": sum(int(p.hbm_detail.get("docs", 0))
+                                for p in lst)}
+                for (idx, field), lst in self._deltas.items() if lst}
             return {"resident": len(self._cache), "hits": self.hits,
                     "misses": self.misses,
                     "stale_served": self.stale_served,
-                    "packs": packs}
+                    "packs": packs, "deltas": deltas}
+
+    def delta_totals(self) -> Tuple[int, int]:
+        """(resident delta packs, resident delta bytes) on this cache."""
+        with self._lock:
+            n = sum(len(lst) for lst in self._deltas.values())
+            b = sum(int(p.hbm_bytes) for lst in self._deltas.values()
+                    for p in lst)
+            return n, b
 
     @property
     def mesh(self):
@@ -493,6 +628,7 @@ class IndexPackCache:
                 self.misses += 1
             entry = self._build(readers, field, reader_key)
             old = None
+            dropped: List[ResidentPack] = []
             with self._lock:
                 if entry is not None:
                     old = self._cache.get(key)
@@ -500,17 +636,296 @@ class IndexPackCache:
                         self._breaker.release(old.hbm_bytes)
                     self._cache[key] = entry
                     self._last_bytes[key] = int(entry.hbm_bytes)
+                    # a full rebuild covers everything the chain did —
+                    # the folded deltas drain to exactly zero
+                    dropped = self._drop_deltas_locked(key)
+                    self._set_chain_meta_locked(key, readers, reader_key)
             if entry is not None:
                 events.emit("pack.build", index=key[0], field=key[1],
                             hbm_bytes=int(entry.hbm_bytes),
                             compressed=entry.compressed,
                             rebuild=old is not None,
                             group=self.group_id)
-            if old is not None and self.on_evict is not None:
-                self.on_evict(old)
+            if self.on_evict is not None:
+                for stale in ([old] if old is not None else []) + dropped:
+                    self.on_evict(stale)
             return entry
         finally:
             build_lock.release()
+
+    # -- streaming delta chain -----------------------------------------
+
+    def _drop_deltas_locked(self, key) -> List[ResidentPack]:
+        """Release every delta chained on `key` (caller holds _lock and
+        runs on_evict after dropping it)."""
+        dropped = self._deltas.pop(key, [])
+        for p in dropped:
+            if self._breaker is not None:
+                self._breaker.release(p.hbm_bytes)
+        meta = self._chain_meta.get(key)
+        if meta is not None:
+            meta.union = None
+        return dropped
+
+    def _set_chain_meta_locked(self, key, readers, reader_key) -> None:
+        if not self.delta_enabled:
+            return
+        self._chain_meta[key] = _ChainMeta(
+            reader_key=reader_key,
+            covered={num: tuple(v.segment.name for v in r.views)
+                     for num, r in readers},
+            live_versions={num: getattr(r, "live_version", 0)
+                           for num, r in readers})
+
+    def _chain_locked(self, key) -> Optional[PackChain]:
+        base = self._cache.get(key)
+        meta = self._chain_meta.get(key)
+        if base is None or meta is None:
+            return None
+        deltas = tuple(self._deltas.get(key, ()))
+        if not deltas:
+            return PackChain(base, (), base, meta.reader_key)
+        return PackChain(base, deltas, meta.union, meta.reader_key)
+
+    def _delta_eligible(self, meta: _ChainMeta, readers):
+        """Append-only check, per shard: the chain's covered segments
+        must be a PREFIX of the new reader's and its live_version
+        unchanged (an update/delete of a committed doc bumps it).
+        Returns {shard_num: [uncovered SegmentViews]} or None → full
+        rebuild."""
+        new = dict(readers)
+        if set(new) != set(meta.covered):
+            return None
+        fresh: Dict[int, List[Any]] = {}
+        for num, r in new.items():
+            names = tuple(v.segment.name for v in r.views)
+            old = meta.covered[num]
+            if names[:len(old)] != old:
+                return None
+            if getattr(r, "live_version", 0) != meta.live_versions.get(
+                    num, 0):
+                return None
+            fresh[num] = list(r.views[len(old):])
+        return fresh
+
+    def get_chain(self, index_service, field: str) -> Optional[PackChain]:
+        """Chain-aware residency: like get(), but an append-only refresh
+        builds a small delta pack over only the NEW segments instead of
+        re-placing the whole image."""
+        if not self.delta_enabled:
+            entry = self.get(index_service, field)
+            return None if entry is None else PackChain(
+                entry, (), entry, entry.reader_key)
+        readers = []
+        for shard_num, shard in sorted(index_service.shards.items()):
+            readers.append((shard_num, shard.acquire_searcher()))
+        reader_key = tuple(id(r) for _, r in readers)
+        key = (index_service.name, field)
+        with self._lock:
+            self._heat[key] = time.monotonic()
+            self._services[key] = index_service
+            chain = self._chain_locked(key)
+            if chain is None:
+                # base resident but never chained (built via get())
+                entry = self._cache.get(key)
+                if entry is not None and entry.reader_key == reader_key:
+                    self._set_chain_meta_locked(key, readers, reader_key)
+                    chain = self._chain_locked(key)
+            if chain is not None and chain.reader_key == reader_key:
+                self.hits += 1
+                return chain
+            build_lock = self._build_locks.setdefault(key,
+                                                      threading.Lock())
+        # stale-while-rebuild applies to the chain exactly as to get()
+        if not build_lock.acquire(blocking=False):
+            with self._lock:
+                chain = self._chain_locked(key)
+                if chain is not None:
+                    self.stale_served += 1
+            if chain is not None:
+                return chain
+            build_lock.acquire()
+        try:
+            with self._lock:
+                chain = self._chain_locked(key)
+                if chain is not None and chain.reader_key == reader_key:
+                    self.hits += 1
+                    return chain
+                base = self._cache.get(key)
+                meta = self._chain_meta.get(key)
+            fresh = None
+            if base is not None and meta is not None:
+                fresh = self._delta_eligible(meta, readers)
+            if fresh is None:
+                entry = self._build_and_swap(key, readers, field,
+                                             reader_key)
+                return None if entry is None else PackChain(
+                    entry, (), entry, reader_key)
+            return self._append_delta(key, base, fresh, readers, field,
+                                      reader_key)
+        finally:
+            build_lock.release()
+
+    def _build_and_swap(self, key, readers, field,
+                        reader_key) -> Optional[ResidentPack]:
+        """Full build + swap, chain reset. Caller holds the build lock."""
+        with self._lock:
+            self.misses += 1
+        entry = self._build(readers, field, reader_key)
+        old = None
+        dropped: List[ResidentPack] = []
+        with self._lock:
+            if entry is not None:
+                old = self._cache.get(key)
+                if old is not None and self._breaker is not None:
+                    self._breaker.release(old.hbm_bytes)
+                self._cache[key] = entry
+                self._last_bytes[key] = int(entry.hbm_bytes)
+                dropped = self._drop_deltas_locked(key)
+                self._set_chain_meta_locked(key, readers, reader_key)
+        if entry is not None:
+            events.emit("pack.build", index=key[0], field=key[1],
+                        hbm_bytes=int(entry.hbm_bytes),
+                        compressed=entry.compressed,
+                        rebuild=old is not None, group=self.group_id)
+        if self.on_evict is not None:
+            for stale in ([old] if old is not None else []) + dropped:
+                self.on_evict(stale)
+        return entry
+
+    def _append_delta(self, key, base: ResidentPack, fresh, readers,
+                      field: str, reader_key) -> PackChain:
+        """Build one immutable delta pack from the uncovered segments
+        and chain it on the base. Caller holds the build lock."""
+        docs = sum(v.segment.num_docs for views in fresh.values()
+                   for v in views
+                   if field in v.segment.postings)
+        events.emit("delta.append", index=key[0], field=field,
+                    docs=int(docs),
+                    segments=sum(len(v) for v in fresh.values()))
+        delta = self._build_delta(readers, fresh, field, reader_key)
+        want_compact = False
+        with self._lock:
+            if delta is not None:
+                self._deltas.setdefault(key, []).append(delta)
+            # even a field-less delta advances coverage: the chain now
+            # answers for this reader set
+            self._set_chain_meta_locked(key, readers, reader_key)
+            meta = self._chain_meta[key]
+            deltas = list(self._deltas.get(key, ()))
+            if deltas:
+                base_ = self._cache[key]
+                meta.union = _UnionView([base_] + deltas)
+                meta.union.reader_key = reader_key
+                total_docs = sum(
+                    int(p.hbm_detail.get("docs", 0)) for p in deltas)
+                want_compact = (len(deltas) > self.delta_max_packs
+                                or total_docs > self.delta_max_docs)
+            chain = self._chain_locked(key)
+        if delta is not None:
+            if self.delta_stats is not None:
+                self.delta_stats.appends += 1
+                self.delta_stats.seals += 1
+            events.emit("delta.seal", index=key[0], field=field,
+                        hbm_bytes=int(delta.hbm_bytes),
+                        chain_len=len(chain.deltas))
+        if want_compact and self.on_compact_needed is not None:
+            self.on_compact_needed(key)
+        return chain
+
+    def _build_delta(self, readers, fresh, field: str,
+                     reader_key) -> Optional[ResidentPack]:
+        segments, live, groups = [], [], []
+        row_origin: List[Tuple[int, str]] = []
+        row_segments: List[Any] = []
+        for group_idx, (shard_num, _reader) in enumerate(readers):
+            for view in fresh.get(shard_num, ()):
+                if field not in view.segment.postings:
+                    continue
+                segments.append(view.segment)
+                n = view.segment.num_docs
+                live.append(view.live_mask[:n].copy())
+                groups.append(group_idx)
+                row_origin.append((shard_num, view.segment.name))
+                row_segments.append(view.segment)
+        if not segments:
+            return None
+        k1 = readers[0][1].k1
+        b = readers[0][1].b
+        n_sh = self.mesh.shape[SHARD_AXIS]
+        s_pad = ((len(segments) + n_sh - 1) // n_sh) * n_sh
+        pack = dist.build_delta_pack(segments, field, live_docs=live,
+                                     k1=k1, b=b, pad_shards_to=s_pad,
+                                     row_groups=groups)
+        return self._place_pack(pack, field, readers, reader_key,
+                                row_origin, row_segments,
+                                label=f"delta[{field}]",
+                                compressible=False)
+
+    def compact(self, key) -> bool:
+        """Fold the delta chain into a fresh full (compressed) base pack.
+        Releases the old base + every delta exactly (the drain-to-zero
+        invariant covers compaction too); on failure the chain keeps
+        serving and a `compaction_failure` incident is opened."""
+        index_service = self._services.get(key)
+        if index_service is None:
+            return False
+        field = key[1]
+        with self._lock:
+            build_lock = self._build_locks.setdefault(key,
+                                                      threading.Lock())
+        with build_lock:
+            with self._lock:
+                deltas = list(self._deltas.get(key, ()))
+            if not deltas:
+                return False
+            delta_bytes = sum(int(p.hbm_bytes) for p in deltas)
+            t0 = time.monotonic()
+            events.emit("compaction.begin", index=key[0], field=field,
+                        delta_packs=len(deltas),
+                        delta_bytes=delta_bytes)
+            try:
+                for hook in list(COMPACTION_FAULT_HOOKS):
+                    hook(key)  # chaos seam: may park or raise
+                readers = []
+                for shard_num, shard in sorted(
+                        index_service.shards.items()):
+                    readers.append((shard_num, shard.acquire_searcher()))
+                reader_key = tuple(id(r) for _, r in readers)
+                entry = self._build(readers, field, reader_key)
+            except Exception as exc:  # noqa: BLE001 — chain keeps serving
+                if self.delta_stats is not None:
+                    self.delta_stats.compaction_failures += 1
+                events.emit("compaction.end", severity="error",
+                            index=key[0], field=field, error=str(exc),
+                            duration_s=round(time.monotonic() - t0, 6))
+                events.incident("compaction_failure", index=key[0],
+                                field=field, error=str(exc))
+                return False
+            evicted: List[ResidentPack] = []
+            with self._lock:
+                if entry is not None:
+                    old = self._cache.get(key)
+                    if old is not None and self._breaker is not None:
+                        self._breaker.release(old.hbm_bytes)
+                        evicted.append(old)
+                    self._cache[key] = entry
+                    self._last_bytes[key] = int(entry.hbm_bytes)
+                    evicted += self._drop_deltas_locked(key)
+                    self._set_chain_meta_locked(key, readers, reader_key)
+            if self.on_evict is not None:
+                for stale in evicted:
+                    self.on_evict(stale)
+            dur = time.monotonic() - t0
+            if self.delta_stats is not None:
+                self.delta_stats.compactions += 1
+                self.delta_stats.compact_seconds += dur
+            events.emit("compaction.end", index=key[0], field=field,
+                        duration_s=round(dur, 6),
+                        reclaimed_bytes=delta_bytes,
+                        hbm_bytes=(int(entry.hbm_bytes)
+                                   if entry is not None else 0))
+            return entry is not None
 
     def _build(self, readers, field: str,
                reader_key: Tuple) -> Optional[ResidentPack]:
@@ -539,6 +954,18 @@ class IndexPackCache:
         pack = dist.build_stacked_pack(segments, field, live_docs=live,
                                        k1=k1, b=b, pad_shards_to=s_pad,
                                        row_groups=groups)
+        return self._place_pack(pack, field, readers, reader_key,
+                                row_origin, row_segments,
+                                label=f"pack[{field}]", compressible=True)
+
+    def _place_pack(self, pack, field: str, readers, reader_key: Tuple,
+                    row_origin, row_segments, *, label: str,
+                    compressible: bool) -> ResidentPack:
+        """Charge the breaker, place `pack` on device, build resolution
+        tables. `compressible=False` (delta packs) forces the raw format:
+        deltas are small and short-lived — compaction folds them into
+        the compressed base, so per-delta stream compression would buy
+        bytes at the cost of append latency."""
         # what the uncompressed resident image costs: doc-sorted pack +
         # the impact-sorted copy (same two arrays re-ordered) — the
         # baseline both /_tpu/stats' compression_ratio and the bench's
@@ -548,7 +975,7 @@ class IndexPackCache:
         n_docs = int(sum(len(ids) for ids in pack.shard_doc_ids))
         streams = None
         comp_reason = None
-        if KERNEL_CONFIG["compressed_pack"]:
+        if compressible and KERNEL_CONFIG["compressed_pack"]:
             comp_reason = dist.compress_pack_reason(pack)
             if comp_reason is None:
                 streams = dist.build_compressed_streams(pack)
@@ -562,7 +989,7 @@ class IndexPackCache:
             hbm = streams.nbytes_device()
             if self._breaker is not None:
                 self._breaker.add_estimate_bytes_and_maybe_break(
-                    hbm, label=f"pack[{field}]")
+                    hbm, label=label)
             try:
                 arrays = dist.device_put_compressed(streams, self.mesh)
             except Exception:
@@ -577,7 +1004,7 @@ class IndexPackCache:
                    + imp_impacts.nbytes)
             if self._breaker is not None:
                 self._breaker.add_estimate_bytes_and_maybe_break(
-                    hbm, label=f"pack[{field}]")
+                    hbm, label=label)
             try:
                 arrays = dist.device_put_pack(pack, self.mesh)
                 imp_arrays = dist.device_put_pack(
@@ -647,11 +1074,15 @@ class IndexPackCache:
                 if self._breaker is not None:
                     self._breaker.release(entry.hbm_bytes)
                 evicted.append(entry)
+            for key in [k for k in self._deltas if k[0] == index_name]:
+                evicted.extend(self._drop_deltas_locked(key))
             # deliberate eviction forgets the key entirely (unlike
             # invalidate_all, whose keys recovery re-attains)
             for key in [k for k in self._heat if k[0] == index_name]:
                 self._heat.pop(key, None)
                 self._last_bytes.pop(key, None)
+                self._chain_meta.pop(key, None)
+                self._services.pop(key, None)
         if evicted:
             events.emit("pack.evict", index=index_name,
                         packs=len(evicted),
@@ -668,14 +1099,22 @@ class IndexPackCache:
         drain-to-zero invariant the per-index lifecycle tests assert.
         Returns the dropped (index, field) keys so recovery can
         re-attain residency eagerly."""
+        dropped: List[ResidentPack] = []
         with self._lock:
             entries = list(self._cache.items())
             self._cache.clear()
             for _key, entry in entries:
                 if self._breaker is not None:
                     self._breaker.release(entry.hbm_bytes)
+            for key in list(self._deltas):
+                dropped.extend(self._drop_deltas_locked(key))
+            # chain coverage died with the packs; recovery re-attains
+            # residency through a full rebuild which re-stamps it
+            self._chain_meta.clear()
         if self.on_evict is not None:
             for _key, entry in entries:
+                self.on_evict(entry)
+            for entry in dropped:
                 self.on_evict(entry)
         return [key for key, _entry in entries]
 
@@ -2037,6 +2476,7 @@ class BatcherSupervisor:
             # full-mesh respawn pays no recompile
             resolver = svc.index_resolver
             rebuilt = 0
+            replayed_indices: set = set()
             if resolver is not None:
                 for index_name, field in rebuild:
                     try:
@@ -2045,6 +2485,23 @@ class BatcherSupervisor:
                         index_service = None
                     if index_service is None:
                         continue
+                    # translog-gated visibility: before re-attaining the
+                    # device image, replay each index's translog tail
+                    # above its last refresh checkpoint so every acked
+                    # write is in the reader the rebuild snapshots —
+                    # the kill→recover→replay→checkpoint chain the
+                    # chaos drill asserts (zero lost acked writes)
+                    if index_name not in replayed_indices:
+                        replayed_indices.add(index_name)
+                        try:
+                            r = index_service.replay_visibility(
+                                reason="supervisor recovery")
+                            if svc.delta_stats is not None:
+                                svc.delta_stats.replayed_ops += \
+                                    r.get("scanned", 0)
+                        except Exception:  # noqa: BLE001 — best effort
+                            logger.exception("visibility replay for %s",
+                                             index_name)
                     try:
                         if svc.packs.get(index_service, field) is not None:
                             rebuilt += 1
@@ -2223,7 +2680,8 @@ class TpuSearchService:
                  pallas: bool = False,
                  launch_deadline_ms: float = 120_000.0,
                  device_health: Optional[Dict[str, Any]] = None,
-                 placement: Optional[Dict[str, Any]] = None):
+                 placement: Optional[Dict[str, Any]] = None,
+                 delta: Optional[Dict[str, Any]] = None):
         _ensure_compile_cache(compile_cache_dir)
         KERNEL_CONFIG["packed_sort"] = bool(packed_sort)
         KERNEL_CONFIG["compressed_pack"] = bool(compressed_pack)
@@ -2328,6 +2786,62 @@ class TpuSearchService:
         self._prewarm_lock = threading.Lock()
         self._prewarm_progress: Dict[str, Any] = {
             "state": "idle", "total": 0, "done": 0, "seconds": 0.0}
+        # -- streaming delta chain (LSM resident path) -----------------
+        # append-only refreshes chain small delta packs on the base
+        # image; a background compactor folds them back in. Placement
+        # group caches keep the classic full-rebuild path (replica
+        # groups must stay byte-identical to each other).
+        # opt-in: a bare TpuSearchService() keeps the classic
+        # rebuild-on-refresh contract (tests and embedders rely on a
+        # pack's bytes being the whole charge); Node passes the config
+        # dict, so the serving stack runs with the chain on by default
+        dcfg = dict(delta or {})
+        self.delta_stats = DeltaStats()
+        self.packs.delta_stats = self.delta_stats
+        self.packs.delta_enabled = (delta is not None
+                                    and bool(dcfg.get("enabled", True))
+                                    and self.placement is None)
+        self.packs.delta_max_packs = int(dcfg.get("max_packs", 4))
+        self.packs.delta_max_docs = int(dcfg.get("max_docs", 50_000))
+        self.packs.on_compact_needed = self._request_compaction
+        self._compact_pending: set = set()
+        self._compact_wakeup = threading.Event()
+        self._compact_closed = False
+        self._compact_thread: Optional[threading.Thread] = None
+
+    # -- background compaction -----------------------------------------
+
+    def _request_compaction(self, key) -> None:
+        """Pack-cache callback: the delta chain for `key` crossed its
+        fold threshold. Compaction runs on ONE background thread (a
+        full pack build is minutes at scale — never on a serving
+        thread), started lazily on first demand."""
+        with self._prewarm_lock:
+            self._compact_pending.add(tuple(key))
+            if self._compact_thread is None and not self._compact_closed:
+                self._compact_thread = threading.Thread(
+                    target=self._compact_loop, daemon=True,
+                    name="delta-compactor")
+                self._compact_thread.start()
+        self._compact_wakeup.set()
+
+    def _compact_loop(self) -> None:
+        while not self._compact_closed:
+            self._compact_wakeup.wait(timeout=1.0)
+            self._compact_wakeup.clear()
+            while True:
+                with self._prewarm_lock:
+                    if self._compact_closed or not self._compact_pending:
+                        break
+                    key = self._compact_pending.pop()
+                if self.degraded_active:
+                    # a teardown is in flight — the chain dies with the
+                    # residency drop; recovery rebuilds the full image
+                    continue
+                try:
+                    self.packs.compact(key)
+                except Exception:  # noqa: BLE001 — compact() reports
+                    logger.exception("delta compaction for %s", key)
 
     def _on_wedge(self, wedge: Dict[str, Any]) -> None:
         """Watchdog callback (scan thread): an overdue dispatch means
@@ -2813,6 +3327,7 @@ class TpuSearchService:
             self.fallback += 1
             return None
         route_gid: Optional[int] = None
+        chain: Optional[PackChain] = None
         if self.placement is not None:
             resident, route_gid = self._grouped_get(index_service,
                                                     flat.field)
@@ -2821,7 +3336,11 @@ class TpuSearchService:
                 self.fallback += 1
                 return None
         else:
-            resident = self.packs.get(index_service, flat.field)
+            # chain-aware residency: an append-only refresh rides as a
+            # small delta pack unioned into the result instead of a full
+            # rebuild; with deltas disabled this degenerates to get()
+            chain = self.packs.get_chain(index_service, flat.field)
+            resident = None if chain is None else chain.base
         t2 = time.perf_counter()
         self.stages.add("lower", t1 - t0)
         self.stages.add("pack_get", t2 - t1)
@@ -2831,12 +3350,16 @@ class TpuSearchService:
             if profile_sink is not None:
                 profile_sink["empty_pack"] = True
             return FlatQueryResult.empty()
+        # plans validate against the CHAIN's reader key when one exists:
+        # the base pack keeps its (older) key while deltas cover the new
+        # segments, and a plan is valid for exactly that reader set
+        rkey = chain.reader_key if chain is not None else resident.reader_key
         plan_outcome = ("uncacheable" if cache_key is None
                         else "hit" if cached is not None else "miss")
         if cache_key is not None:
             if cached is None:
-                self.plans.put(cache_key, (flat, resident.reader_key))
-            elif cached_rk != resident.reader_key:
+                self.plans.put(cache_key, (flat, rkey))
+            elif cached_rk != rkey:
                 plan_outcome = "revalidated"
                 # the resident pack was rebuilt since this plan was
                 # cached (refresh/merge mid-traffic): re-lower so no
@@ -2847,7 +3370,7 @@ class TpuSearchService:
                     self.plans.put(cache_key, NOT_LOWERABLE)
                     self.fallback += 1
                     return None
-                self.plans.put(cache_key, (flat, resident.reader_key))
+                self.plans.put(cache_key, (flat, rkey))
         if self._tripped:
             now = time.monotonic()
             if now < self._next_probe:
@@ -2869,6 +3392,14 @@ class TpuSearchService:
                 self.placement.note_submit(route_gid)
                 fut.add_done_callback(
                     lambda _f, g=route_gid: self.placement.note_done(g))
+            # the delta chain's packs are extra operands of the SAME
+            # lowered query: each delta batches independently (its own
+            # micro-batch queue keyed by pack identity) and the columns
+            # merge host-side — disjoint row spaces, totals add
+            delta_futs = []
+            if chain is not None and chain.deltas:
+                delta_futs = [self.batcher.submit(d, flat, k)
+                              for d in chain.deltas]
             pending = getattr(fut, "pending", None)
             # the batch wait is bounded: the service cap (default 30s —
             # the FIRST batch on a signature pays XLA compile; if it
@@ -2882,6 +3413,15 @@ class TpuSearchService:
             if deadline_limited:
                 wait = max(0.05, timeout_s)
             result = fut.result(timeout=wait)
+            if delta_futs:
+                # one SHARED deadline across the union: the base wait
+                # already consumed part of it, the deltas get the rest
+                deadline = t_sub + wait
+                parts = [result]
+                for df in delta_futs:
+                    remaining = max(0.01, deadline - time.perf_counter())
+                    parts.append(df.result(timeout=remaining))
+                result = self._union_results(parts, chain, k)
         except FuturesTimeout:
             self.fallback += 1
             self.timeouts += 1
@@ -2957,6 +3497,32 @@ class TpuSearchService:
             self.stages.add(f"batch_wait.{name}", dt)
             self.stages.add(f"batch_wait.{name}.{variant}", dt)
         return split
+
+    @staticmethod
+    def _union_results(parts: List["FlatQueryResult"], chain: PackChain,
+                       k: int) -> "FlatQueryResult":
+        """Merge base + delta kernel results into one top-k over the
+        chain's concatenated row space. The operands score DISJOINT doc
+        sets (deltas cover only segments the base doesn't), so totals
+        add and no dedup is needed; ties prefer the base pack, then
+        in-pack kernel rank (stable across chain growth)."""
+        scores, rows, ords = sparse.union_topk(
+            [p.scores for p in parts],
+            [p.rows for p in parts],
+            [p.ords for p in parts],
+            chain.view.offsets, k)
+        max_score = None
+        candidates = [p.max_score for p in parts if p.max_score is not None]
+        if candidates:
+            max_score = float(max(candidates))
+        return FlatQueryResult(
+            scores=scores, rows=rows, ords=ords,
+            total_hits=sum(int(p.total_hits) for p in parts),
+            max_score=max_score,
+            resident=chain.view,
+            total_relation=("gte" if any(p.total_relation == "gte"
+                                         for p in parts) else "eq"),
+            variant=parts[0].variant)
 
     def prewarm(self, index_service, field: str,
                 concurrency: Optional[int] = None) -> Dict[str, Any]:
@@ -3170,6 +3736,8 @@ class TpuSearchService:
     def stats(self) -> Dict[str, Any]:
         with self._prewarm_lock:
             prewarm = dict(self._prewarm_progress)
+        d_packs, d_bytes = self.packs.delta_totals()
+        ds = self.delta_stats
         return {"served": self.served, "fallback": self.fallback,
                 "timeouts": self.timeouts, "tripped": self._tripped,
                 "last_error": self.last_error,
@@ -3177,6 +3745,13 @@ class TpuSearchService:
                 "batched_queries": self.batcher.queries_executed,
                 "plan_cache": self.plans.stats(),
                 "pack_cache": self.packs.stats(),
+                "deltas": {"enabled": self.packs.delta_enabled,
+                           "packs": d_packs, "bytes": d_bytes,
+                           "appends": ds.appends, "seals": ds.seals,
+                           "compactions": ds.compactions,
+                           "compaction_failures": ds.compaction_failures,
+                           "replayed_ops": ds.replayed_ops,
+                           "compact_seconds": round(ds.compact_seconds, 4)},
                 "prewarm": prewarm,
                 "kernel": {"packed_sort": KERNEL_CONFIG["packed_sort"],
                            "compressed_pack":
@@ -3217,6 +3792,11 @@ class TpuSearchService:
         return out
 
     def close(self) -> None:
+        self._compact_closed = True
+        self._compact_wakeup.set()
+        t = self._compact_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
         self.watchdog.close()
         if self.health is not None:
             self.health.close()

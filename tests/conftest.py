@@ -85,7 +85,8 @@ def _multiprocess_timeout(request):
             and request.node.get_closest_marker("supervision") is None
             and request.node.get_closest_marker("device_loss") is None
             and request.node.get_closest_marker("placement") is None
-            and request.node.get_closest_marker("merge_pool") is None):
+            and request.node.get_closest_marker("merge_pool") is None
+            and request.node.get_closest_marker("streaming") is None):
         yield
         return
     import signal
@@ -156,6 +157,7 @@ def _multiprocess_orphan_reaper(request):
                  or item.get_closest_marker("device_loss") is not None
                  or item.get_closest_marker("placement") is not None
                  or item.get_closest_marker("merge_pool") is not None
+                 or item.get_closest_marker("streaming") is not None
                  for item in request.session.items
                  if item.nodeid.startswith(mod_id))
     if not marked:

@@ -253,7 +253,14 @@ def test_required_families_are_present(node):
             "es_tpu_merge_worker_restarts_total",
             "es_tpu_merge_latency",
             "es_tpu_merge_queue_depth",
-            "es_tpu_merge_pool_size"):
+            "es_tpu_merge_pool_size",
+            "es_tpu_delta_packs",
+            "es_tpu_delta_bytes",
+            "es_tpu_delta_appends_total",
+            "es_tpu_delta_compactions_total",
+            "es_tpu_delta_compaction_failures_total",
+            "es_tpu_delta_replayed_ops_total",
+            "es_tpu_delta_search_visible_lag_seconds"):
         assert f"# TYPE {family} " in text, f"missing family {family}"
     # per-pack rows are labeled by index/field and carry the raw-vs-
     # resident component split

@@ -1,0 +1,243 @@
+"""Translog-gated visibility and the `index.translog.durability` knob
+(ISSUE 20 satellites): an op is searchable only once a refresh
+checkpoint covers its seqno, and it is "searchable-durable" only once
+its translog record is fsync'd — under durability=async those are two
+different moments, and the async path must stay honest about it.
+
+Also covered: the durability knob's static/dynamic validation, write
+faults (disk-full) refusing the ack through the async path, the
+replay-tail audit and its flight-recorder events, and `refresh=wait_for`
+riding the node refresh cycle (with the forced-refresh fallback when no
+cycle runs). The crash tier lives in test_chaos_streaming.py.
+"""
+
+import json
+import threading
+
+import pytest
+
+from elasticsearch_tpu.common import events as events_mod
+from elasticsearch_tpu.common.errors import (IllegalArgumentException,
+                                             TranslogDurabilityException)
+from elasticsearch_tpu.common.events import FlightRecorder
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.indices.service import IndexService, IndicesService
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.testing.disruption import disk_full
+
+pytestmark = pytest.mark.streaming
+
+_MAPPING = {"properties": {"body": {"type": "text"}}}
+
+
+@pytest.fixture
+def svc(tmp_path):
+    s = IndicesService(str(tmp_path))
+    yield s
+    s.close()
+
+
+def _make(svc, name, durability="async", shards=1, **extra):
+    tl = {"durability": durability}
+    tl.update(extra)
+    return svc.create_index(
+        name, Settings.of({"index": {"number_of_shards": shards,
+                                     "translog": tl}}), _MAPPING)
+
+
+class TestDurabilityKnob:
+    def test_async_accepted_and_plumbed(self, svc):
+        idx = _make(svc, "a", durability="async", sync_interval_seconds=0.2)
+        shard = idx.shard(0)
+        assert shard.engine.translog.durability == "async"
+        assert idx.sync_interval_s == pytest.approx(0.2)
+
+    def test_invalid_value_rejected(self, svc):
+        with pytest.raises(IllegalArgumentException,
+                           match=r"index\.translog\.durability"):
+            _make(svc, "bad", durability="sometimes")
+
+    def test_dynamic_update_validated_and_applied(self, svc):
+        idx = _make(svc, "d", durability="request")
+        with pytest.raises(IllegalArgumentException):
+            IndexService.validate_dynamic_settings(
+                {"index.translog.durability": "never"})
+        idx.apply_dynamic_settings({"index.translog.durability": "async"})
+        assert idx.shard(0).engine.translog.durability == "async"
+        assert idx.shard(0).engine.config.durability == "async"
+
+
+class TestAsyncPathHonest:
+    def test_visible_durable_lags_until_sync(self, svc):
+        """Under async durability the op becomes SEARCHABLE at refresh
+        but must not count as searchable-durable until the translog
+        fsync — visible_durable = min(refresh ckpt, persisted ckpt)."""
+        idx = _make(svc, "h")
+        shard = idx.shard(0)
+        res = shard.apply_index_on_primary("x1", {"body": "alpha"})
+        assert res.seq_no == 0
+        eng = shard.engine
+        assert eng.refresh_checkpoint == -1
+        assert eng.visible_durable_checkpoint == -1
+        shard.refresh()
+        # searchable, but the record is only buffered — not durable yet
+        assert eng.refresh_checkpoint == 0
+        assert eng.tracker.persisted_checkpoint == -1
+        assert eng.visible_durable_checkpoint == -1
+        eng.sync_translog()
+        assert eng.tracker.persisted_checkpoint == 0
+        assert eng.visible_durable_checkpoint == 0
+        assert eng.stats()["translog"]["uncommitted_operations"] == 0
+
+    def test_request_path_durable_at_ack(self, svc):
+        idx = _make(svc, "r", durability="request")
+        shard = idx.shard(0)
+        shard.apply_index_on_primary("x1", {"body": "alpha"})
+        assert shard.engine.tracker.persisted_checkpoint == 0
+        # still gated on refresh for SEARCHABILITY
+        assert shard.engine.visible_durable_checkpoint == -1
+        shard.refresh()
+        assert shard.engine.visible_durable_checkpoint == 0
+
+    def test_disk_full_refuses_ack_through_async_path(self, svc):
+        """Async buffering must not swallow write faults: the append
+        itself fails typed and the op is never acked."""
+        idx = _make(svc, "f")
+        shard = idx.shard(0)
+        shard.apply_index_on_primary("ok", {"body": "alpha"})
+        with disk_full():
+            with pytest.raises(TranslogDurabilityException,
+                               match="not acknowledged"):
+                shard.apply_index_on_primary("lost", {"body": "beta"})
+        # healed: writes flow again, and the failed op never happened
+        res = shard.apply_index_on_primary("ok2", {"body": "gamma"})
+        shard.refresh()
+        assert shard.get("lost") is None
+        assert shard.get("ok2") is not None
+        assert shard.engine.tracker.processed_checkpoint == res.seq_no
+
+
+class TestWaitForVisible:
+    def test_times_out_without_refresh(self, svc):
+        idx = _make(svc, "w")
+        shard = idx.shard(0)
+        res = shard.apply_index_on_primary("x", {"body": "alpha"})
+        assert shard.wait_for_visible(res.seq_no, timeout_s=0.2) is False
+
+    def test_wakes_on_refresh(self, svc):
+        idx = _make(svc, "w2")
+        shard = idx.shard(0)
+        res = shard.apply_index_on_primary("x", {"body": "alpha"})
+        t = threading.Timer(0.25, shard.refresh)
+        t.start()
+        try:
+            assert shard.wait_for_visible(res.seq_no, timeout_s=5.0) is True
+        finally:
+            t.cancel()
+
+
+class TestReplayTail:
+    def test_replay_audit_and_events(self, svc):
+        """replay_tail scans the durable tail above the refresh
+        checkpoint, applies whatever the engine is missing (nothing, in
+        a live engine — pure audit), advances the checkpoint, and emits
+        the translog.replay / refresh.checkpoint event chain."""
+        idx = _make(svc, "rp", durability="request")
+        shard = idx.shard(0)
+        for i in range(3):
+            shard.apply_index_on_primary(f"a{i}", {"body": "alpha"})
+        shard.refresh()
+        for i in range(4):
+            shard.apply_index_on_primary(f"b{i}", {"body": "beta"})
+
+        rec = FlightRecorder(max_events=128, incident_settle_s=0.0)
+        prev = events_mod.get_recorder()
+        events_mod.set_recorder(rec)
+        try:
+            out = shard.replay_visibility(reason="test recovery")
+        finally:
+            events_mod.set_recorder(prev)
+        assert out == {"scanned": 4, "applied": 0}
+        assert shard.engine.refresh_checkpoint == 6
+        assert shard.engine.replayed_ops == 4
+        etypes = [e["type"] for e in rec.events()]
+        assert "translog.replay" in etypes
+        assert "refresh.checkpoint" in etypes
+        assert etypes.index("translog.replay") < \
+            etypes.index("refresh.checkpoint")
+        replay = rec.events(etype="translog.replay")[0]["attrs"]
+        assert replay["ops"] == 4 and replay["reason"] == "test recovery"
+
+    def test_unsynced_async_ops_are_not_replayable(self, svc):
+        """Honesty cuts both ways: an op still sitting in the process
+        buffer is NOT durable, so the replay scan must not claim it."""
+        idx = _make(svc, "rp2")
+        shard = idx.shard(0)
+        shard.apply_index_on_primary("u", {"body": "alpha"})
+        # the record is buffered in-process, not fsync'd: the durable
+        # tail is empty (the audit still refreshes, advancing the ckpt)
+        out = shard.replay_visibility(reason="audit")
+        assert out["scanned"] == 0
+        # a synced op above the checkpoint IS scanned by the next audit
+        shard.apply_index_on_primary("v", {"body": "beta"})
+        shard.engine.sync_translog()
+        out = shard.replay_visibility(reason="audit")
+        assert out["scanned"] == 1 and out["applied"] == 0
+
+
+class TestRestWaitFor:
+    def _do(self, node, method, path, body=None, **params):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return node.handle(method, path,
+                           {k: str(v) for k, v in params.items()},
+                           None, raw)
+
+    def test_forced_refresh_fallback_without_refresher(self, tmp_path):
+        node = Node(str(tmp_path / "data"))
+        try:
+            assert not getattr(node, "refresher_active", False)
+            st, _ = self._do(node, "PUT", "/wf", body={
+                "settings": {"index": {"number_of_shards": 1}}})
+            assert st == 200
+            st, _ = self._do(node, "PUT", "/wf/_doc/1",
+                             body={"body": "alpha"}, refresh="wait_for")
+            assert st in (200, 201)
+            # no refresh cycle exists to wait on → the handler must have
+            # forced a refresh so the contract still holds
+            st, out = self._do(node, "POST", "/wf/_search", body={
+                "query": {"match": {"body": "alpha"}}})
+            assert st == 200 and out["hits"]["total"]["value"] == 1
+        finally:
+            node.close()
+
+    def test_rides_refresh_cycle_with_refresher(self, tmp_path):
+        node = Node(str(tmp_path / "data"))
+        try:
+            st, _ = self._do(node, "PUT", "/wf2", body={
+                "settings": {"index": {"number_of_shards": 1}}})
+            assert st == 200
+            node.start_refresher()
+            eng = node.indices.indices["wf2"].shard(0).engine
+            st, _ = self._do(node, "PUT", "/wf2/_doc/1",
+                             body={"body": "alpha"}, refresh="wait_for")
+            assert st in (200, 201)
+            # visible the moment the write returns — the checkpoint
+            # covers the op's seqno (whether the cycle or the timeout
+            # fallback refreshed, the contract is visibility-at-return)
+            assert eng.refresh_checkpoint >= 0
+            st, out = self._do(node, "POST", "/wf2/_search", body={
+                "query": {"match": {"body": "alpha"}}})
+            assert st == 200 and out["hits"]["total"]["value"] == 1
+
+            # _bulk with refresh=wait_for holds the same contract
+            lines = (json.dumps({"index": {"_index": "wf2", "_id": "2"}})
+                     + "\n" + json.dumps({"body": "beta"}) + "\n")
+            st, out = node.handle("POST", "/_bulk",
+                                  {"refresh": "wait_for"}, None,
+                                  lines.encode())
+            assert st == 200 and not out["errors"]
+            st, out = self._do(node, "POST", "/wf2/_search", body={
+                "query": {"match": {"body": "beta"}}})
+            assert st == 200 and out["hits"]["total"]["value"] == 1
+        finally:
+            node.close()
